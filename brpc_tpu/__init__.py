@@ -1,0 +1,19 @@
+"""brpc_tpu — a TPU-pod-native RPC fabric with the capabilities of Apache bRPC.
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+
+  L5  API          brpc_tpu.rpc.Server / Channel / Controller; combo channels
+  L4  policies     brpc_tpu.policy.*  (wire protocols, load balancers,
+                   concurrency limiters, naming services)
+  L3  core runtime brpc_tpu.rpc.*  Socket, EventDispatcher, InputMessenger,
+                   Acceptor, SocketMap; brpc_tpu.ici.* (XLA collective
+                   transport — the rdma/ analogue)
+  L2  scheduling   brpc_tpu.bthread.*  tasklets, butex, correlation ids,
+                   execution queue, timer thread, device-completion waits
+  L1b metrics      brpc_tpu.bvar.*
+  L1  base         brpc_tpu.butil.*  IOBuf (HBM-block capable), ResourcePool,
+                   DoublyBufferedData, EndPoint, flags, logging
+"""
+__version__ = "0.1.0"
+
+from . import butil

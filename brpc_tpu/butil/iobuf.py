@@ -1,0 +1,391 @@
+"""IOBuf: zero-copy chained buffer — the universal payload type.
+
+Reference: src/butil/iobuf.{h,cpp} (IOBuf/Block/BlockRef at iobuf.h:70-97,
+append_user_data_with_meta at iobuf.h:253, IOPortal, IOBufCutter).
+
+The TPU-native generalization (SURVEY.md §2.1): a Block is no longer always a
+host slab.  Three storage kinds share one BlockRef chain:
+
+  * HOST   — bytearray slab (default 8 KiB), appendable in place
+  * USER   — externally-owned memory wrapped without copying
+             (``append_user_data_with_meta``: the reference's RDMA
+             registered-region pattern), with an optional deleter
+  * DEVICE — a flat uint8 ``jax.Array`` living in HBM.  Appending one is a
+             ref bump, never a transfer.  Host bytes are materialized only
+             when a device ref actually crosses a host-wire boundary
+             (``to_bytes`` / ``cut_into_file_descriptor``); the ici://
+             transport consumes device refs directly so payloads never leave
+             HBM.
+
+Cut/append/slice operations move BlockRefs, never bytes — same contract as
+the reference.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+DEFAULT_BLOCK_SIZE = 8192
+
+HOST = 0
+USER = 1
+DEVICE = 2
+
+
+class Block:
+    """Refcounted storage slab.  Lifetime is Python-GC-managed; the pooling
+    that the reference does with explicit refcounts lives in
+    :mod:`brpc_tpu.butil.block_pool` for device/pinned memory where it is
+    load-bearing."""
+
+    __slots__ = ("kind", "data", "size", "meta", "deleter", "_lock")
+
+    def __init__(self, kind: int, data: Any, meta: int = 0,
+                 deleter: Optional[Callable[[Any], None]] = None):
+        self.kind = kind
+        self.data = data            # bytearray | memoryview | jax.Array
+        self.size = 0 if kind == HOST else len(data)  # bytes used (HOST only grows)
+        self.meta = meta
+        self.deleter = deleter
+        self._lock = threading.Lock() if kind == HOST else None
+
+    @property
+    def cap(self) -> int:
+        return len(self.data)
+
+    def left_space(self) -> int:
+        return len(self.data) - self.size if self.kind == HOST else 0
+
+    def host_view(self, offset: int, length: int) -> memoryview:
+        """A memoryview of [offset, offset+length).  DEVICE blocks transfer
+        to host here — the only place a device->host copy can happen."""
+        if self.kind == DEVICE:
+            import numpy as np
+            return memoryview(np.asarray(self.data).tobytes())[offset:offset + length]
+        return memoryview(self.data)[offset:offset + length]
+
+    def __del__(self):
+        if self.deleter is not None:
+            try:
+                self.deleter(self.data)
+            except Exception:
+                pass
+
+
+def new_host_block(size: int = DEFAULT_BLOCK_SIZE) -> Block:
+    return Block(HOST, bytearray(size))
+
+
+class BlockRef:
+    __slots__ = ("block", "offset", "length")
+
+    def __init__(self, block: Block, offset: int, length: int):
+        self.block = block
+        self.offset = offset
+        self.length = length
+
+
+class IOBuf:
+    """Chained zero-copy buffer."""
+
+    __slots__ = ("_refs", "_size")
+
+    def __init__(self, data: Union[bytes, bytearray, str, "IOBuf", None] = None):
+        self._refs: List[BlockRef] = []
+        self._size = 0
+        if data is not None:
+            self.append(data)
+
+    # ---- size & repr -------------------------------------------------
+    def __len__(self) -> int:
+        return self._size
+
+    def size(self) -> int:
+        return self._size
+
+    def empty(self) -> bool:
+        return self._size == 0
+
+    def backing_block_num(self) -> int:
+        return len(self._refs)
+
+    def backing_block(self, i: int) -> BlockRef:
+        return self._refs[i]
+
+    def __repr__(self) -> str:
+        return f"IOBuf(size={self._size}, blocks={len(self._refs)})"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, (bytes, bytearray)):
+            return self.to_bytes() == bytes(other)
+        if isinstance(other, IOBuf):
+            return self.to_bytes() == other.to_bytes()
+        return NotImplemented
+
+    # ---- append ------------------------------------------------------
+    def append(self, data: Union[bytes, bytearray, memoryview, str, "IOBuf"]) -> None:
+        if isinstance(data, IOBuf):
+            self._refs.extend(data._refs)       # ref share, no copy
+            self._size += data._size
+            return
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        mv = memoryview(data)
+        n = len(mv)
+        if n == 0:
+            return
+        pos = 0
+        last = self._refs[-1] if self._refs else None
+        while pos < n:
+            blk = None
+            if (last is not None and last.block.kind == HOST
+                    and last.offset + last.length == last.block.size
+                    and last.block.left_space() > 0):
+                blk = last.block
+            if blk is None:
+                blk = new_host_block(max(DEFAULT_BLOCK_SIZE, 0))
+                last = BlockRef(blk, blk.size, 0)
+                self._refs.append(last)
+            take = min(n - pos, blk.left_space())
+            blk.data[blk.size:blk.size + take] = mv[pos:pos + take]
+            blk.size += take
+            last.length += take
+            pos += take
+            self._size += take
+
+    def append_user_data(self, data: Union[memoryview, bytes, bytearray],
+                         deleter: Optional[Callable[[Any], None]] = None,
+                         meta: int = 0) -> None:
+        """Wrap external memory zero-copy (iobuf.h:253
+        append_user_data_with_meta)."""
+        blk = Block(USER, memoryview(data), meta=meta, deleter=deleter)
+        self._refs.append(BlockRef(blk, 0, len(blk.data)))
+        self._size += len(blk.data)
+
+    def append_device_array(self, arr, meta: int = 0) -> None:
+        """Wrap a flat uint8 jax.Array living in HBM — zero-copy ref."""
+        if arr.dtype.name != "uint8" or arr.ndim != 1:
+            raise TypeError("device block must be a flat uint8 array")
+        blk = Block(DEVICE, arr, meta=meta)
+        self._refs.append(BlockRef(blk, 0, len(arr)))
+        self._size += len(arr)
+
+    def push_back(self, byte: int) -> None:
+        self.append(bytes([byte]))
+
+    # ---- consume -----------------------------------------------------
+    def clear(self) -> None:
+        self._refs.clear()
+        self._size = 0
+
+    def pop_front(self, n: int) -> int:
+        n = min(n, self._size)
+        left = n
+        while left > 0:
+            r = self._refs[0]
+            if r.length <= left:
+                left -= r.length
+                self._refs.pop(0)
+            else:
+                r.offset += left
+                r.length -= left
+                left = 0
+        self._size -= n
+        return n
+
+    def pop_back(self, n: int) -> int:
+        n = min(n, self._size)
+        left = n
+        while left > 0:
+            r = self._refs[-1]
+            if r.length <= left:
+                left -= r.length
+                self._refs.pop()
+            else:
+                r.length -= left
+                left = 0
+        self._size -= n
+        return n
+
+    def cutn(self, out: "IOBuf", n: int) -> int:
+        """Move first n bytes into out (ref moves, no copies)."""
+        n = min(n, self._size)
+        left = n
+        while left > 0:
+            r = self._refs[0]
+            if r.length <= left:
+                out._refs.append(r)
+                out._size += r.length
+                left -= r.length
+                self._refs.pop(0)
+            else:
+                out._refs.append(BlockRef(r.block, r.offset, left))
+                out._size += left
+                r.offset += left
+                r.length -= left
+                left = 0
+        self._size -= n
+        return n
+
+    def cut(self, n: int) -> "IOBuf":
+        out = IOBuf()
+        self.cutn(out, n)
+        return out
+
+    def cut_until(self, delim: bytes) -> Optional["IOBuf"]:
+        """Cut up to (excluding) delim, also consuming delim; None if absent."""
+        idx = self.to_bytes().find(delim)   # correctness first; hot path uses cutters
+        if idx < 0:
+            return None
+        out = self.cut(idx)
+        self.pop_front(len(delim))
+        return out
+
+    # ---- read --------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        if len(self._refs) == 1:
+            r = self._refs[0]
+            return bytes(r.block.host_view(r.offset, r.length))
+        return b"".join(
+            bytes(r.block.host_view(r.offset, r.length)) for r in self._refs)
+
+    def copy_to(self, n: Optional[int] = None, pos: int = 0) -> bytes:
+        data = self.to_bytes()
+        return data[pos:] if n is None else data[pos:pos + n]
+
+    def fetch(self, n: int) -> Optional[bytes]:
+        """Peek first n bytes without consuming; None if fewer available."""
+        if self._size < n:
+            return None
+        out = []
+        left = n
+        for r in self._refs:
+            take = min(left, r.length)
+            out.append(bytes(r.block.host_view(r.offset, take)))
+            left -= take
+            if left == 0:
+                break
+        return b"".join(out)
+
+    def fetch1(self) -> Optional[int]:
+        b = self.fetch(1)
+        return b[0] if b else None
+
+    def host_views(self) -> List[memoryview]:
+        """Per-ref memoryviews (device refs transfer)."""
+        return [r.block.host_view(r.offset, r.length) for r in self._refs]
+
+    def device_refs(self) -> List[BlockRef]:
+        return [r for r in self._refs if r.block.kind == DEVICE]
+
+    def has_device_blocks(self) -> bool:
+        return any(r.block.kind == DEVICE for r in self._refs)
+
+    # ---- fd IO (reference cut_into_file_descriptor iobuf.h:160) ------
+    def cut_into_file_descriptor(self, fd: int, size_hint: int = 1 << 20) -> int:
+        """writev the leading refs into fd; pops what was written."""
+        views = []
+        total = 0
+        for r in self._refs:
+            if total >= size_hint or len(views) >= 64:  # IOV_MAX safety
+                break
+            views.append(r.block.host_view(r.offset, r.length))
+            total += r.length
+        if not views:
+            return 0
+        written = os.writev(fd, views)
+        if written > 0:
+            self.pop_front(written)
+        return written
+
+    def copy_to_file_descriptor(self, fd: int) -> int:
+        written = 0
+        for v in self.host_views():
+            written += os.write(fd, v)
+        return written
+
+
+class IOPortal(IOBuf):
+    """IOBuf that can fill itself from an fd (reference IOPortal).  Keeps a
+    partially-filled tail block to amortize allocations."""
+
+    def append_from_file_descriptor(self, fd: int, max_count: int = 1 << 16) -> int:
+        blk = new_host_block(max(max_count, DEFAULT_BLOCK_SIZE))
+        try:
+            nr = os.readv(fd, [memoryview(blk.data)[:max_count]])
+        except BlockingIOError:
+            return -1
+        if nr > 0:
+            blk.size = nr
+            self._refs.append(BlockRef(blk, 0, nr))
+            self._size += nr
+        return nr
+
+    def append_from_socket(self, sock, max_count: int = 1 << 16) -> int:
+        blk = new_host_block(max(max_count, DEFAULT_BLOCK_SIZE))
+        try:
+            nr = sock.recv_into(memoryview(blk.data)[:max_count], max_count)
+        except BlockingIOError:
+            return -1
+        if nr > 0:
+            blk.size = nr
+            self._refs.append(BlockRef(blk, 0, nr))
+            self._size += nr
+        return nr
+
+
+class IOBufCutter:
+    """Fast parsing cursor over an IOBuf (reference IOBufCutter,
+    iobuf_inl.h).  Consumes from the front without re-materializing."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, buf: IOBuf):
+        self._buf = buf
+
+    def remaining(self) -> int:
+        return len(self._buf)
+
+    def cutn_bytes(self, n: int) -> Optional[bytes]:
+        if len(self._buf) < n:
+            return None
+        out = self._buf.cut(n)
+        return out.to_bytes()
+
+    def cutn(self, out: IOBuf, n: int) -> int:
+        return self._buf.cutn(out, n)
+
+    def cut_uint32_be(self) -> Optional[int]:
+        b = self.cutn_bytes(4)
+        return None if b is None else int.from_bytes(b, "big")
+
+    def cut_uint64_be(self) -> Optional[int]:
+        b = self.cutn_bytes(8)
+        return None if b is None else int.from_bytes(b, "big")
+
+    def cut_uint8(self) -> Optional[int]:
+        b = self.cutn_bytes(1)
+        return None if b is None else b[0]
+
+
+class IOBufAppender:
+    """Buffered sequential writer producing an IOBuf (reference
+    IOBufAppender)."""
+
+    def __init__(self):
+        self.buf = IOBuf()
+
+    def append(self, data) -> None:
+        self.buf.append(data)
+
+    def append_uint32_be(self, v: int) -> None:
+        self.buf.append(v.to_bytes(4, "big"))
+
+    def append_uint64_be(self, v: int) -> None:
+        self.buf.append(v.to_bytes(8, "big"))
+
+    def move_to(self) -> IOBuf:
+        out = self.buf
+        self.buf = IOBuf()
+        return out

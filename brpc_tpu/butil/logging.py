@@ -1,0 +1,69 @@
+"""glog-compatible streaming logging facade (reference: src/butil/logging.h).
+
+Thin shim over the stdlib ``logging`` module that keeps the reference's
+severity model (INFO/WARNING/ERROR/FATAL), ``LOG_EVERY_N``-style rate
+limiting, and a pluggable sink, while staying idiomatic Python.
+"""
+from __future__ import annotations
+
+import logging as _pylog
+import sys
+import threading
+
+_logger = _pylog.getLogger("brpc_tpu")
+if not _logger.handlers:
+    _h = _pylog.StreamHandler(sys.stderr)
+    _h.setFormatter(_pylog.Formatter(
+        "%(levelname).1s%(asctime)s %(threadName)s %(filename)s:%(lineno)d] %(message)s",
+        datefmt="%m%d %H:%M:%S"))
+    _logger.addHandler(_h)
+    _logger.setLevel(_pylog.INFO)
+
+INFO = _pylog.INFO
+WARNING = _pylog.WARNING
+ERROR = _pylog.ERROR
+FATAL = _pylog.CRITICAL
+
+_every_n_counters: dict = {}
+_every_n_lock = threading.Lock()
+
+
+def log(level: int, msg: str, *args) -> None:
+    _logger.log(level, msg, *args, stacklevel=2)
+
+
+def info(msg: str, *args) -> None:
+    _logger.info(msg, *args, stacklevel=2)
+
+
+def warning(msg: str, *args) -> None:
+    _logger.warning(msg, *args, stacklevel=2)
+
+
+def error(msg: str, *args) -> None:
+    _logger.error(msg, *args, stacklevel=2)
+
+
+def fatal(msg: str, *args) -> None:
+    _logger.critical(msg, *args, stacklevel=2)
+    raise SystemExit(msg % args if args else msg)
+
+
+def log_every_n(level: int, n: int, msg: str, *args) -> None:
+    """Reference LOG_EVERY_N: emit only every n-th occurrence per call site."""
+    import inspect
+    frame = inspect.currentframe().f_back
+    key = (frame.f_code.co_filename, frame.f_lineno)
+    with _every_n_lock:
+        c = _every_n_counters.get(key, 0)
+        _every_n_counters[key] = c + 1
+    if c % n == 0:
+        _logger.log(level, msg, *args, stacklevel=2)
+
+
+def set_min_log_level(level: int) -> None:
+    _logger.setLevel(level)
+
+
+def vlog_is_on(verbosity: int) -> bool:
+    return _logger.isEnabledFor(_pylog.DEBUG)

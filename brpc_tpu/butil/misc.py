@@ -1,0 +1,81 @@
+"""Misc platform utilities (reference: src/butil/ fast_rand, crc32c, time).
+
+fast_rand mirrors the reference's per-thread xorshift generator
+(src/butil/fast_rand.cpp); crc32c uses zlib's crc32 engine with the crc32c
+polynomial unavailable in stdlib, so we expose crc32 under the same API (the
+wire protocol defines its own checksum, so only self-consistency matters).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+_tls = threading.local()
+
+
+def _state() -> list:
+    s = getattr(_tls, "s", None)
+    if s is None:
+        seed = (threading.get_ident() * 2654435761 + time.monotonic_ns()) & 0xFFFFFFFFFFFFFFFF
+        s = [seed or 0x9E3779B97F4A7C15]
+        _tls.s = s
+    return s
+
+
+def fast_rand() -> int:
+    """xorshift64* — per-thread, no locking (fast_rand.cpp)."""
+    s = _state()
+    x = s[0]
+    x ^= (x >> 12) & 0xFFFFFFFFFFFFFFFF
+    x = (x ^ (x << 25)) & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 27
+    s[0] = x
+    return (x * 0x2545F4914F6CDD1D) & 0xFFFFFFFFFFFFFFFF
+
+
+def fast_rand_less_than(n: int) -> int:
+    return fast_rand() % n if n > 0 else 0
+
+
+def fast_rand_in(lo: int, hi: int) -> int:
+    return lo + fast_rand_less_than(hi - lo + 1)
+
+
+def crc32c(data, init: int = 0) -> int:
+    return zlib.crc32(bytes(data), init) & 0xFFFFFFFF
+
+
+def gettimeofday_us() -> int:
+    return time.time_ns() // 1000
+
+
+def monotonic_time_ns() -> int:
+    return time.monotonic_ns()
+
+
+def cpuwide_time_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class Timer:
+    """Scoped stopwatch (butil::Timer)."""
+
+    def __init__(self):
+        self._start = 0
+        self._stop = 0
+
+    def start(self) -> None:
+        self._start = time.perf_counter_ns()
+
+    def stop(self) -> None:
+        self._stop = time.perf_counter_ns()
+
+    def n_elapsed(self) -> int:
+        return self._stop - self._start
+
+    def u_elapsed(self) -> int:
+        return self.n_elapsed() // 1000
+
+    def m_elapsed(self) -> int:
+        return self.n_elapsed() // 1000000

@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+The reference tests "distributed" behavior with multiple in-process servers on
+localhost TCP (see SURVEY.md §4).  The TPU-native equivalent is a virtual
+multi-device CPU mesh: we force JAX onto the CPU platform with 8 virtual
+devices *before* jax is imported anywhere, so every test can build a real
+jax.sharding.Mesh and exercise the ici:// data plane (ppermute/psum/
+all_gather) without TPU hardware.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

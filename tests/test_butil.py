@@ -1,0 +1,291 @@
+"""Unit tests for the butil layer (mirrors reference test/iobuf_unittest.cpp,
+resource_pool_unittest.cpp, flat_map_unittest.cpp patterns)."""
+import os
+import threading
+
+import pytest
+
+from brpc_tpu import butil
+from brpc_tpu.butil import iobuf as iobuf_mod
+
+
+class TestIOBuf:
+    def test_append_and_read(self):
+        b = butil.IOBuf()
+        assert b.empty() and len(b) == 0
+        b.append(b"hello ")
+        b.append("world")
+        assert len(b) == 11
+        assert b.to_bytes() == b"hello world"
+        assert b == b"hello world"
+
+    def test_append_iobuf_shares_refs(self):
+        a = butil.IOBuf(b"x" * 100)
+        c = butil.IOBuf()
+        c.append(a)
+        assert c.backing_block(0).block is a.backing_block(0).block
+        assert c.to_bytes() == a.to_bytes()
+
+    def test_multiblock_spill(self):
+        b = butil.IOBuf()
+        payload = bytes(range(256)) * 100   # 25600 > 8192
+        b.append(payload)
+        assert b.backing_block_num() >= 3
+        assert b.to_bytes() == payload
+
+    def test_cut_and_pop(self):
+        b = butil.IOBuf(b"0123456789")
+        front = b.cut(4)
+        assert front.to_bytes() == b"0123"
+        assert b.to_bytes() == b"456789"
+        b.pop_front(2)
+        assert b.to_bytes() == b"6789"
+        b.pop_back(2)
+        assert b.to_bytes() == b"67"
+
+    def test_cutn_across_blocks(self):
+        b = butil.IOBuf()
+        b.append(b"a" * 9000)
+        b.append(b"b" * 9000)
+        out = butil.IOBuf()
+        n = b.cutn(out, 10000)
+        assert n == 10000
+        assert out.to_bytes() == b"a" * 9000 + b"b" * 1000
+        assert len(b) == 8000
+
+    def test_cut_until(self):
+        b = butil.IOBuf(b"GET / HTTP/1.1\r\nHost: x\r\n")
+        line = b.cut_until(b"\r\n")
+        assert line.to_bytes() == b"GET / HTTP/1.1"
+        assert b.to_bytes() == b"Host: x\r\n"
+        assert butil.IOBuf(b"abc").cut_until(b"\r\n") is None
+
+    def test_fetch_peek(self):
+        b = butil.IOBuf(b"abcdef")
+        assert b.fetch(3) == b"abc"
+        assert len(b) == 6          # peek does not consume
+        assert b.fetch(10) is None
+        assert b.fetch1() == ord("a")
+
+    def test_user_data_zero_copy(self):
+        deleted = []
+        big = bytearray(b"z" * 4096)
+        b = butil.IOBuf()
+        b.append_user_data(big, deleter=lambda d: deleted.append(1), meta=42)
+        assert len(b) == 4096
+        assert b.backing_block(0).block.meta == 42
+        assert b.backing_block(0).block.kind == butil.USER
+        del b
+        import gc; gc.collect()
+        assert deleted == [1]
+
+    def test_cutter(self):
+        b = butil.IOBuf((1234).to_bytes(4, "big") + b"payload")
+        c = butil.IOBufCutter(b)
+        assert c.cut_uint32_be() == 1234
+        assert c.cutn_bytes(7) == b"payload"
+        assert c.cut_uint8() is None
+
+    def test_appender(self):
+        a = butil.IOBufAppender()
+        a.append_uint32_be(7)
+        a.append(b"xy")
+        out = a.move_to()
+        assert out.to_bytes() == (7).to_bytes(4, "big") + b"xy"
+        assert len(a.move_to()) == 0
+
+    def test_fd_roundtrip(self, tmp_path):
+        r, w = os.pipe()
+        try:
+            b = butil.IOBuf()
+            b.append(b"first|")
+            b.append_user_data(b"second", meta=0)
+            total = len(b)
+            while len(b):
+                b.cut_into_file_descriptor(w)
+            portal = butil.IOPortal()
+            got = portal.append_from_file_descriptor(r, total)
+            assert got == total
+            assert portal.to_bytes() == b"first|second"
+        finally:
+            os.close(r); os.close(w)
+
+    def test_device_block(self):
+        import jax.numpy as jnp
+        arr = jnp.arange(16, dtype=jnp.uint8)
+        b = butil.IOBuf(b"hdr:")
+        b.append_device_array(arr)
+        assert b.has_device_blocks()
+        assert len(b.device_refs()) == 1
+        assert b.to_bytes() == b"hdr:" + bytes(range(16))
+        # cutting moves the device ref without transfer
+        b.pop_front(4)
+        assert b.to_bytes() == bytes(range(16))
+
+
+class TestResourcePool:
+    def test_versioned_ids(self):
+        pool = butil.ResourcePool()
+        rid = pool.get_resource("sock-1")
+        assert pool.address(rid) == "sock-1"
+        assert pool.return_resource(rid)
+        assert pool.address(rid) is None            # revoked
+        assert not pool.return_resource(rid)        # double-free rejected
+        rid2 = pool.get_resource("sock-2")
+        assert butil.id_slot(rid2) == butil.id_slot(rid)   # slot reused
+        assert rid2 != rid                                 # version differs
+        assert pool.address(rid) is None                   # old id stays dead
+        assert pool.address(rid2) == "sock-2"
+
+    def test_concurrent_churn(self):
+        pool = butil.ResourcePool()
+        errors = []
+
+        def churn():
+            try:
+                for i in range(200):
+                    rid = pool.get_resource(i)
+                    assert pool.address(rid) == i
+                    assert pool.return_resource(rid)
+            except Exception as e:   # pragma: no cover
+                errors.append(e)
+
+        ts = [threading.Thread(target=churn) for _ in range(4)]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        assert not errors
+        assert pool.size() == 0
+
+
+class TestDoublyBuffered:
+    def test_read_modify(self):
+        dbd = butil.DoublyBufferedData(list)
+        with dbd.read() as servers:
+            assert servers == []
+        dbd.modify(lambda l: l.append("s1"))
+        with dbd.read() as servers:
+            assert servers == ["s1"]
+
+    def test_concurrent_readers(self):
+        dbd = butil.DoublyBufferedData(dict)
+        stop = threading.event() if hasattr(threading, "event") else threading.Event()
+        errors = []
+
+        def reader():
+            for _ in range(300):
+                with dbd.read() as d:
+                    v = dict(d)
+                    if v and set(v.values()) != {v.get("k")}:
+                        errors.append(v)
+
+        def writer():
+            for i in range(50):
+                dbd.modify(lambda d, i=i: d.__setitem__("k", i))
+
+        ts = [threading.Thread(target=reader) for _ in range(3)] + [
+            threading.Thread(target=writer)]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        assert not errors
+
+
+class TestContainers:
+    def test_flat_map(self):
+        m = butil.FlatMap()
+        m.insert("a", 1)
+        assert m.seek("a") == 1
+        assert m.seek("b") is None
+        assert m.erase("a") == 1
+        assert m.erase("a") == 0
+
+    def test_case_ignored(self):
+        h = butil.CaseIgnoredFlatMap()
+        h["Content-Type"] = "text/html"
+        assert h["content-type"] == "text/html"
+        assert "CONTENT-TYPE" in h
+        assert list(h.keys()) == ["Content-Type"]
+
+    def test_bounded_queue(self):
+        q = butil.BoundedQueue(2)
+        assert q.push(1) and q.push(2) and not q.push(3)
+        ok, v = q.pop()
+        assert ok and v == 1
+        assert q.push(3)
+        assert [q.pop()[1] for _ in range(2)] == [2, 3]
+        assert q.pop() == (False, None)
+
+    def test_mru_cache(self):
+        c = butil.MRUCache(2)
+        c.put("a", 1); c.put("b", 2)
+        assert c.get("a") == 1
+        c.put("c", 3)                  # evicts b (least recently used)
+        assert c.get("b") is None
+        assert c.get("a") == 1 and c.get("c") == 3
+
+
+class TestEndPoint:
+    def test_parse_tcp(self):
+        ep = butil.parse_endpoint("10.1.2.3:8000")
+        assert (ep.scheme, ep.host, ep.port) == ("tcp", "10.1.2.3", 8000)
+        assert str(ep) == "10.1.2.3:8000"
+        assert butil.parse_endpoint("tcp://h:1") == butil.parse_endpoint("h:1")
+
+    def test_parse_ici(self):
+        ep = butil.parse_endpoint("ici://3")
+        assert ep.is_device() and ep.device_id == 3
+        ep2 = butil.parse_endpoint("ici://(0,1)")
+        assert ep2.coords == (0, 1)
+        assert str(ep2) == "ici://(0,1)"
+        assert butil.parse_endpoint(str(ep)) == ep
+
+    def test_parse_mem(self):
+        ep = butil.parse_endpoint("mem://test-server")
+        assert ep.scheme == "mem" and ep.host == "test-server"
+
+    def test_hashable_map_key(self):
+        d = {butil.parse_endpoint("ici://1"): "a",
+             butil.parse_endpoint("h:1"): "b"}
+        assert d[butil.parse_endpoint("ici://1")] == "a"
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            butil.parse_endpoint("nocolon")
+
+
+class TestFlags:
+    def test_define_get_set(self):
+        f = butil.define_flag("test_flag_x", 4, "help", butil.positive_integer)
+        assert butil.get_flag("test_flag_x") == 4
+        butil.set_flag("test_flag_x", 8)
+        assert butil.get_flag("test_flag_x") == 8
+        with pytest.raises(ValueError):
+            butil.set_flag("test_flag_x", -1)   # validator gates reload
+        assert butil.get_flag("test_flag_x") == 8
+        butil.set_flag("test_flag_x", "16")     # string coercion like /flags
+        assert butil.get_flag("test_flag_x") == 16
+
+    def test_non_reloadable(self):
+        butil.define_flag("test_flag_frozen", True, reloadable=False)
+        with pytest.raises(PermissionError):
+            butil.set_flag("test_flag_frozen", False)
+
+    def test_listing(self):
+        butil.define_flag("test_flag_listed", "v")
+        names = [f.name for f in butil.list_flags()]
+        assert "test_flag_listed" in names
+
+
+class TestMisc:
+    def test_fast_rand(self):
+        vals = {butil.fast_rand() for _ in range(100)}
+        assert len(vals) == 100
+        assert all(0 <= butil.fast_rand_less_than(10) < 10 for _ in range(100))
+
+    def test_crc(self):
+        assert butil.crc32c(b"hello") == butil.crc32c(b"hello")
+        assert butil.crc32c(b"hello") != butil.crc32c(b"world")
+
+    def test_timer(self):
+        t = butil.Timer()
+        t.start(); t.stop()
+        assert t.n_elapsed() >= 0
