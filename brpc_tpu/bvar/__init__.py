@@ -1,0 +1,10 @@
+"""bvar — metrics layer (reference: src/bvar/, SURVEY.md §2.2)."""
+from .variable import (Variable, Status, PassiveStatus, GFlag, find_exposed,
+                       list_exposed, dump_exposed, count_exposed,
+                       to_underscored_name)
+from .reducer import Reducer, Adder, Maxer, Miner
+from .window import Window, PerSecond, SamplerCollector
+from .latency_recorder import IntRecorder, Percentile, LatencyRecorder
+from .multi_dimension import MultiDimension
+from .default_variables import expose_default_variables
+from .collector import Collector, CollectorSpeedLimit, Collected

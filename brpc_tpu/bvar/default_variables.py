@@ -1,0 +1,79 @@
+"""Process/system metrics read from /proc (reference:
+src/bvar/default_variables.cpp) plus TPU-native device metrics.
+
+Exposed lazily by :func:`expose_default_variables` (the reference exposes at
+static-init; we defer so importing the package stays cheap).
+"""
+from __future__ import annotations
+
+import os
+import resource
+import threading
+import time
+from typing import List, Optional
+
+from .variable import PassiveStatus, Variable
+
+_exposed: List[Variable] = []
+_lock = threading.Lock()
+_start_time = time.time()
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def _fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except Exception:
+        return -1
+
+
+def _thread_count() -> int:
+    return threading.active_count()
+
+
+def _cpu_seconds() -> float:
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return ru.ru_utime + ru.ru_stime
+
+
+def _device_count() -> int:
+    try:
+        import jax
+        return jax.local_device_count()
+    except Exception:
+        return 0
+
+
+def _device_memory_bytes() -> int:
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return stats.get("bytes_in_use", 0)
+    except Exception:
+        pass
+    return 0
+
+
+def expose_default_variables() -> None:
+    with _lock:
+        if _exposed:
+            return
+        _exposed.extend([
+            PassiveStatus(lambda: os.getpid(), "process_pid"),
+            PassiveStatus(lambda: time.time() - _start_time, "process_uptime"),
+            PassiveStatus(_rss_bytes, "process_memory_resident"),
+            PassiveStatus(_fd_count, "process_fd_count"),
+            PassiveStatus(_thread_count, "process_thread_count"),
+            PassiveStatus(_cpu_seconds, "process_cpu_seconds"),
+            PassiveStatus(_device_count, "tpu_device_count"),
+            PassiveStatus(_device_memory_bytes, "tpu_hbm_bytes_in_use"),
+        ])

@@ -1,0 +1,199 @@
+"""IntRecorder / Percentile / LatencyRecorder.
+
+Reference: src/bvar/latency_recorder.h + detail/percentile.{h,cpp}.  The
+reference keeps per-thread reservoir samples combined on read; we keep the
+same write-local structure via Reducer agents holding small reservoirs.
+LatencyRecorder is the compound variable every method status exposes:
+latency (mean), qps, count, and the 80/90/99/99.9/99.99 percentiles over a
+sliding window.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import List, Optional, Tuple
+
+from ..butil.misc import fast_rand_less_than
+from .variable import Variable, PassiveStatus
+from .reducer import Adder, Maxer, Reducer
+from .window import Window, PerSecond, _ReducerSampler, SamplerCollector
+
+_SAMPLES_PER_AGENT = 254        # reference: PercentileInterval<254>
+
+
+class _PercentileSample:
+    """Fixed-size reservoir of latency samples (detail/percentile.h)."""
+
+    __slots__ = ("samples", "num_added")
+
+    def __init__(self):
+        self.samples: List[int] = []
+        self.num_added = 0
+
+    def add(self, value: int) -> None:
+        self.num_added += 1
+        if len(self.samples) < _SAMPLES_PER_AGENT:
+            self.samples.append(value)
+        else:
+            i = fast_rand_less_than(self.num_added)
+            if i < _SAMPLES_PER_AGENT:
+                self.samples[i] = value
+
+    def merge(self, other: "_PercentileSample") -> "_PercentileSample":
+        out = _PercentileSample()
+        out.num_added = self.num_added + other.num_added
+        combined = self.samples + other.samples
+        if len(combined) <= _SAMPLES_PER_AGENT:
+            out.samples = combined
+        else:
+            # weightless downsample, mirroring CombineOf in percentile.h
+            out.samples = [combined[fast_rand_less_than(len(combined))]
+                           for _ in range(_SAMPLES_PER_AGENT)]
+        return out
+
+    def get_number(self, ratio: float) -> int:
+        if not self.samples:
+            return 0
+        s = sorted(self.samples)
+        idx = min(int(ratio * len(s)), len(s) - 1)
+        return s[idx]
+
+
+def _merge_samples(a: _PercentileSample, b: _PercentileSample) -> _PercentileSample:
+    return a.merge(b)
+
+
+class Percentile(Reducer):
+    """Reducer of reservoirs; << records a latency sample."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(_PercentileSample(), _merge_samples, None, name)
+
+    def __lshift__(self, latency: int) -> "Percentile":
+        a = self._agent()
+        with a.lock:
+            if a.value is self._identity:
+                a.value = _PercentileSample()
+            a.value.add(int(latency))
+        return self
+
+    def _agent(self):
+        a = getattr(self._tls, "agent", None)
+        if a is None:
+            a = super()._agent()
+            a.value = _PercentileSample()
+        return a
+
+    def get_value(self) -> _PercentileSample:
+        result = _PercentileSample()
+        with self._agents_lock:
+            agents = list(self._agents)
+        for a in agents:
+            with a.lock:
+                result = result.merge(a.value)
+        return result
+
+    def describe(self) -> str:
+        s = self.get_value()
+        return f"p50={s.get_number(0.5)} p99={s.get_number(0.99)} n={s.num_added}"
+
+
+class IntRecorder(Variable):
+    """Average of recorded ints (reference bvar::IntRecorder): keeps
+    (sum, count) write-locally."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._sum = Adder()
+        self._count = Adder()
+        super().__init__(name)
+
+    def __lshift__(self, value: int) -> "IntRecorder":
+        self._sum << int(value)
+        self._count << 1
+        return self
+
+    def average(self) -> float:
+        c = self._count.get_value()
+        return self._sum.get_value() / c if c else 0.0
+
+    def get_value(self):
+        return self.average()
+
+    def sum(self) -> int:
+        return self._sum.get_value()
+
+    def count(self) -> int:
+        return self._count.get_value()
+
+
+class LatencyRecorder(Variable):
+    """Compound latency/qps variable (latency_recorder.h).  ``rec << us``
+    records one call's latency in microseconds."""
+
+    def __init__(self, prefix: Optional[str] = None, window_size: int = 10):
+        self._latency = IntRecorder()
+        self._max_latency = Maxer()
+        self._count = Adder()
+        self._qps_window = PerSecond(self._count, window_size)
+        self._percentile = Percentile()
+        self._win_percentile = _WindowedPercentile(self._percentile, window_size)
+        super().__init__(None)
+        if prefix:
+            self.expose(prefix)
+
+    def expose(self, prefix: str, _ignored: str = "") -> bool:
+        ok = super().expose(prefix + "_latency")
+        self._max_latency.expose(prefix + "_max_latency")
+        self._count.expose(prefix + "_count")
+        self._qps_window.expose(prefix + "_qps")
+        self._win_percentile.expose_percentiles(prefix)
+        return ok
+
+    def __lshift__(self, latency_us: int) -> "LatencyRecorder":
+        latency_us = int(latency_us)
+        self._latency << latency_us
+        self._max_latency << latency_us
+        self._count << 1
+        self._percentile << latency_us
+        return self
+
+    # reads ------------------------------------------------------------
+    def get_value(self):
+        return self.latency()
+
+    def latency(self) -> float:
+        return self._latency.average()
+
+    def max_latency(self) -> int:
+        return self._max_latency.get_value()
+
+    def count(self) -> int:
+        return self._count.get_value()
+
+    def qps(self) -> float:
+        return self._qps_window.get_value()
+
+    def latency_percentile(self, ratio: float) -> int:
+        return self._win_percentile.percentile(ratio)
+
+
+class _WindowedPercentile:
+    """Window over a Percentile reducer exposing pNN PassiveStatus vars."""
+
+    def __init__(self, percentile: Percentile, window_size: int):
+        self._sampler = _ReducerSampler(percentile, window_size)
+        self._sampler.take_sample()
+        SamplerCollector.instance().register(self._sampler)
+        self._window_size = window_size
+        self._exposed: List[Variable] = []
+
+    def percentile(self, ratio: float) -> int:
+        v, _ = self._sampler.value_in_window(self._window_size)
+        return v.get_number(ratio)
+
+    def expose_percentiles(self, prefix: str) -> None:
+        for tag, ratio in (("50", .5), ("80", .8), ("90", .9),
+                           ("99", .99), ("999", .999), ("9999", .9999)):
+            self._exposed.append(PassiveStatus(
+                lambda r=ratio: self.percentile(r),
+                f"{prefix}_latency_{tag}"))

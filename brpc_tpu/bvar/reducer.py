@@ -1,0 +1,113 @@
+"""Reducers: write-local, read-combine variables.
+
+Reference: src/bvar/reducer.h + detail/agent_group.h + detail/combiner.h.
+Each writing thread gets a private *agent* (so writes are uncontended and
+cache-local); reads combine every agent's value with the reducer's operator.
+The same structure is kept here because it is load-bearing under the C++
+core too (native/ shares this design), and because Python threads writing a
+shared int would race on read-modify-write despite the GIL.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, List, Optional, TypeVar
+
+from .variable import Variable
+
+T = TypeVar("T")
+
+
+class _Agent:
+    __slots__ = ("value", "lock")
+
+    def __init__(self, identity):
+        self.value = identity
+        self.lock = threading.Lock()
+
+
+class Reducer(Variable, Generic[T]):
+    def __init__(self, identity: T, op: Callable[[T, T], T],
+                 inv_op: Optional[Callable[[T, T], T]] = None,
+                 name: Optional[str] = None):
+        self._identity = identity
+        self._op = op
+        self._inv_op = inv_op           # enables Window sampling via subtraction
+        self._agents: List[_Agent] = []
+        self._agents_lock = threading.Lock()
+        self._tls = threading.local()
+        super().__init__(name)
+
+    def _agent(self) -> _Agent:
+        a = getattr(self._tls, "agent", None)
+        if a is None:
+            a = _Agent(self._identity)
+            self._tls.agent = a
+            with self._agents_lock:
+                self._agents.append(a)
+        return a
+
+    def __lshift__(self, value: T) -> "Reducer[T]":
+        a = self._agent()
+        with a.lock:
+            a.value = self._op(a.value, value)
+        return self
+
+    def add(self, value: T) -> None:
+        self.__lshift__(value)
+
+    def get_value(self) -> T:
+        result = self._identity
+        with self._agents_lock:
+            agents = list(self._agents)
+        for a in agents:
+            with a.lock:
+                result = self._op(result, a.value)
+        return result
+
+    def reset(self) -> T:
+        """Combine-and-clear; returns the combined value."""
+        result = self._identity
+        with self._agents_lock:
+            agents = list(self._agents)
+        for a in agents:
+            with a.lock:
+                result = self._op(result, a.value)
+                a.value = self._identity
+        return result
+
+    @property
+    def op(self):
+        return self._op
+
+    @property
+    def inv_op(self):
+        return self._inv_op
+
+
+class Adder(Reducer):
+    def __init__(self, name: Optional[str] = None, identity=0):
+        super().__init__(identity, lambda a, b: a + b, lambda a, b: a - b, name)
+
+    def increment(self) -> None:
+        self << 1
+
+    def decrement(self) -> None:
+        self << -1
+
+
+class Maxer(Reducer):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(float("-inf"), max, None, name)
+
+    def get_value(self):
+        v = super().get_value()
+        return 0 if v == float("-inf") else v
+
+
+class Miner(Reducer):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(float("inf"), min, None, name)
+
+    def get_value(self):
+        v = super().get_value()
+        return 0 if v == float("inf") else v

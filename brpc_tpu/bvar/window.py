@@ -1,0 +1,136 @@
+"""Window / PerSecond over reducers, driven by a background sampler thread.
+
+Reference: src/bvar/window.h + detail/sampler.{h,cpp}.  A single daemon
+thread ticks once per second, taking a snapshot of each registered reducer
+into a ring of samples; Window(reducer, N) reports the delta over the last N
+seconds, PerSecond divides by the window span.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+from .variable import Variable
+from .reducer import Reducer
+
+_MAX_WINDOW = 120
+
+
+class _ReducerSampler:
+    def __init__(self, reducer: Reducer, window_size: int):
+        self.reducer = reducer
+        self.window_size = max(window_size, 1)
+        self.samples: Deque[Tuple[float, object]] = deque(maxlen=_MAX_WINDOW + 1)
+
+    def take_sample(self) -> None:
+        self.samples.append((time.monotonic(), self.reducer.get_value()))
+
+    def value_in_window(self, window_size: int):
+        """Newest sample minus the sample window_size ticks ago (requires an
+        invertible op, e.g. Adder); for non-invertible ops combines samples."""
+        if not self.samples:
+            return self.reducer._identity, 0.0
+        newest_t, newest_v = self.samples[-1]
+        idx = max(0, len(self.samples) - 1 - window_size)
+        oldest_t, oldest_v = self.samples[idx]
+        span = newest_t - oldest_t
+        if self.reducer.inv_op is not None:
+            return self.reducer.inv_op(newest_v, oldest_v), span
+        # non-invertible (max/min): combine samples inside the window
+        vals = [v for _, v in list(self.samples)[idx:]]
+        acc = vals[0]
+        for v in vals[1:]:
+            acc = self.reducer.op(acc, v)
+        return acc, span
+
+
+class SamplerCollector:
+    """The once-per-second sampling thread (detail/sampler.cpp)."""
+
+    _instance: Optional["SamplerCollector"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._samplers: List[_ReducerSampler] = []
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @classmethod
+    def instance(cls) -> "SamplerCollector":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = SamplerCollector()
+            return cls._instance
+
+    def register(self, sampler: _ReducerSampler) -> None:
+        with self._lock:
+            self._samplers.append(sampler)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="bvar_sampler", daemon=True)
+                self._thread.start()
+
+    def unregister(self, sampler: _ReducerSampler) -> None:
+        with self._lock:
+            try:
+                self._samplers.remove(sampler)
+            except ValueError:
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(1.0):
+            self.sample_once()
+
+    def sample_once(self) -> None:
+        """One tick; also callable directly from tests (no sleeping)."""
+        with self._lock:
+            samplers = list(self._samplers)
+        for s in samplers:
+            try:
+                s.take_sample()
+            except Exception:
+                pass
+
+
+class Window(Variable):
+    """Value accumulated over the last ``window_size`` seconds."""
+
+    def __init__(self, reducer: Reducer, window_size: int = 10,
+                 name: Optional[str] = None):
+        self._sampler = _ReducerSampler(reducer, window_size)
+        self._sampler.take_sample()
+        SamplerCollector.instance().register(self._sampler)
+        self._window_size = window_size
+        super().__init__(name)
+
+    def get_value(self):
+        v, _ = self._sampler.value_in_window(self._window_size)
+        return v
+
+    def get_span(self) -> float:
+        _, span = self._sampler.value_in_window(self._window_size)
+        return span
+
+    def window_size(self) -> int:
+        return self._window_size
+
+    def __del__(self):
+        try:
+            SamplerCollector.instance().unregister(self._sampler)
+        except Exception:
+            pass
+        super().__del__()
+
+
+class PerSecond(Window):
+    """Windowed value divided by real elapsed seconds (reference
+    bvar::PerSecond)."""
+
+    def get_value(self):
+        v, span = self._sampler.value_in_window(self._window_size)
+        if span <= 0:
+            return 0
+        return v / span

@@ -1,0 +1,175 @@
+"""bvar tests (mirrors reference test/bvar_*_unittest.cpp patterns)."""
+import threading
+import time
+
+from brpc_tpu import bvar
+
+
+class TestAdder:
+    def test_basic(self):
+        a = bvar.Adder()
+        a << 5
+        a << 3
+        assert a.get_value() == 8
+        a.increment(); a.decrement()
+        assert a.get_value() == 8
+        assert a.reset() == 8
+        assert a.get_value() == 0
+
+    def test_multithreaded_writes(self):
+        a = bvar.Adder()
+
+        def work():
+            for _ in range(1000):
+                a << 1
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        assert a.get_value() == 8000
+
+    def test_maxer_miner(self):
+        mx, mn = bvar.Maxer(), bvar.Miner()
+        for v in (3, 9, 1):
+            mx << v
+            mn << v
+        assert mx.get_value() == 9
+        assert mn.get_value() == 1
+
+
+class TestRegistry:
+    def test_expose_dump(self):
+        a = bvar.Adder("test_counter_one")
+        a << 7
+        assert "test_counter_one" in bvar.list_exposed()
+        assert bvar.find_exposed("test_counter_one") is a
+        dump = dict(bvar.dump_exposed("test_counter*"))
+        assert dump["test_counter_one"] == "7"
+        a.hide()
+        assert bvar.find_exposed("test_counter_one") is None
+
+    def test_name_normalization(self):
+        assert bvar.to_underscored_name("Foo Bar-baz::Qux") == "foo_bar_baz_qux"
+
+    def test_duplicate_name_rejected(self):
+        a = bvar.Adder("test_dup_name")
+        b = bvar.Adder()
+        assert not b.expose("test_dup_name")
+        a.hide()
+
+    def test_status_and_passive(self):
+        s = bvar.Status(value=41)
+        s.set_value(42)
+        assert s.get_value() == 42
+        p = bvar.PassiveStatus(lambda: 7)
+        assert p.get_value() == 7
+
+
+class TestWindow:
+    def test_window_delta(self):
+        a = bvar.Adder()
+        w = bvar.Window(a, window_size=10)
+        a << 100
+        bvar.SamplerCollector.instance().sample_once()
+        assert w.get_value() == 100
+        a << 50
+        bvar.SamplerCollector.instance().sample_once()
+        assert w.get_value() == 150
+
+    def test_per_second(self):
+        a = bvar.Adder()
+        q = bvar.PerSecond(a, window_size=10)
+        time.sleep(0.05)
+        a << 500
+        bvar.SamplerCollector.instance().sample_once()
+        assert q.get_value() > 0
+
+    def test_window_over_maxer(self):
+        m = bvar.Maxer()
+        w = bvar.Window(m, window_size=10)
+        m << 3
+        bvar.SamplerCollector.instance().sample_once()
+        m << 9
+        bvar.SamplerCollector.instance().sample_once()
+        assert w.get_value() == 9
+
+
+class TestLatencyRecorder:
+    def test_record_and_read(self):
+        rec = bvar.LatencyRecorder()
+        for us in (100, 200, 300, 400, 500):
+            rec << us
+        assert rec.count() == 5
+        assert rec.latency() == 300
+        assert rec.max_latency() == 500
+        p50 = rec._percentile.get_value().get_number(0.5)
+        assert 100 <= p50 <= 500
+
+    def test_windowed_percentile(self):
+        rec = bvar.LatencyRecorder(window_size=10)
+        for us in range(1, 101):
+            rec << us
+        bvar.SamplerCollector.instance().sample_once()
+        p99 = rec.latency_percentile(0.99)
+        assert 50 <= p99 <= 100
+
+    def test_exposed_family(self):
+        rec = bvar.LatencyRecorder("test_method_a")
+        rec << 100
+        names = bvar.list_exposed("test_method_a*")
+        assert "test_method_a_latency" in names
+        assert "test_method_a_qps" in names
+        assert "test_method_a_latency_99" in names
+
+    def test_int_recorder(self):
+        r = bvar.IntRecorder()
+        r << 10
+        r << 20
+        assert r.average() == 15
+        assert r.sum() == 30 and r.count() == 2
+
+
+class TestMultiDimension:
+    def test_labelled_stats(self):
+        md = bvar.MultiDimension("test_md_requests", ["method", "status"],
+                                 bvar.Adder)
+        md.get_stats(["echo", "ok"]) << 3
+        md.get_stats(["echo", "err"]) << 1
+        md.get_stats(["echo", "ok"]) << 2
+        assert md.count_stats() == 2
+        assert md.get_stats(["echo", "ok"]).get_value() == 5
+        assert 'method="echo"' in md.describe()
+        md.delete_stats(["echo", "err"])
+        assert md.count_stats() == 1
+
+
+class TestCollector:
+    def test_speed_limit(self):
+        limit = bvar.CollectorSpeedLimit(max_samples_per_second=5)
+        accepted = sum(1 for _ in range(100) if limit.is_sampled())
+        assert accepted == 5
+        assert limit.submitted == 100
+
+    def test_submit_and_process(self):
+        class Sample(bvar.Collected):
+            def __init__(self, v): self.v = v
+
+        got = []
+        c = bvar.Collector.instance()
+        c.register_processor(Sample, lambda batch: got.extend(s.v for s in batch))
+        c.submit(Sample(1))
+        c.submit(Sample(2))
+        c.flush_for_test()
+        deadline = time.time() + 2
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert sorted(got) == [1, 2]
+
+
+class TestDefaultVariables:
+    def test_process_vars(self):
+        bvar.expose_default_variables()
+        dump = dict(bvar.dump_exposed("process_*"))
+        assert int(dump["process_pid"]) > 0
+        assert int(dump["process_thread_count"]) >= 1
+        assert "tpu_device_count" in dict(bvar.dump_exposed("tpu_*"))
