@@ -1,0 +1,17 @@
+"""bthread — tasklet scheduling layer (reference: src/bthread/, SURVEY.md §2.3).
+
+M:N tasklets with work stealing, butex blocking, versioned correlation ids,
+serialized execution queues, a timer thread, and the TPU-native addition:
+waits on device-stream completion (device_waiter).
+"""
+from .butex import Butex, ETIMEDOUT, EWOULDBLOCK
+from .scheduler import (TaskControl, Tasklet, start_urgent, start_background,
+                        join, self_id, current_tasklet, in_worker,
+                        yield_tasklet, local_set, local_get,
+                        note_worker_blocked, note_worker_unblocked)
+from .execution_queue import ExecutionQueue, TaskIterator, execution_queue_start
+from .timer_thread import TimerThread, timer_add, timer_del
+from .countdown import CountdownEvent
+from .device_waiter import (DeviceEventDispatcher, device_wait,
+                            device_on_ready)
+from . import id as bthread_id

@@ -1,0 +1,120 @@
+"""Device-completion waits: the scheduler⇄XLA bridge.
+
+This is the new primitive SURVEY.md §2.3 calls for: the reference's
+``bthread_fd_wait`` (src/bthread/fd.cpp) runs one EpollThread that maps fd
+readiness → butex wakes so bthreads block on IO without pinning workers.
+The TPU analogue maps *device-stream completion* → butex wakes: tasklets
+enqueue XLA work (a jitted transport step, a collective, a D2H copy), then
+either block on or register a callback for its completion.
+
+Design point that makes this correct without an epoll equivalent: XLA
+completes work on a device's stream in enqueue (FIFO) order, so ONE poller
+thread per device, blocking on the *oldest* outstanding array of that
+device, observes every completion in order — the exact multiplexing
+EpollThread provides for fds, with the stream standing in for the epoll set.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Deque, Dict, Optional, Tuple
+
+from .butex import Butex
+
+
+class _DevicePoller:
+    def __init__(self, device_key: str):
+        self.key = device_key
+        self.queue: Deque[Tuple[Any, Callable[[], None]]] = collections.deque()
+        self.cv = threading.Condition()
+        self.thread = threading.Thread(
+            target=self._run, name=f"device_poller_{device_key}", daemon=True)
+        self.completed_count = 0
+        self.thread.start()
+
+    def submit(self, arrays: Any, on_ready: Callable[[], None]) -> None:
+        with self.cv:
+            self.queue.append((arrays, on_ready))
+            self.cv.notify()
+
+    def _run(self) -> None:
+        import jax
+        while True:
+            with self.cv:
+                while not self.queue:
+                    self.cv.wait()
+                arrays, on_ready = self.queue.popleft()
+            try:
+                jax.block_until_ready(arrays)
+            except Exception:
+                pass        # errors surface to the waiter on its own access
+            self.completed_count += 1
+            try:
+                on_ready()
+            except Exception:
+                from ..butil import logging as log
+                log.error("device completion callback raised", exc_info=True)
+
+
+class DeviceEventDispatcher:
+    """Per-device completion pollers (the EventDispatcher of the device
+    plane)."""
+
+    _instance: Optional["DeviceEventDispatcher"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._pollers: Dict[str, _DevicePoller] = {}
+        self._plock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "DeviceEventDispatcher":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DeviceEventDispatcher()
+            return cls._instance
+
+    def _poller_for(self, arrays: Any) -> _DevicePoller:
+        key = self._device_key(arrays)
+        with self._plock:
+            p = self._pollers.get(key)
+            if p is None:
+                p = _DevicePoller(key)
+                self._pollers[key] = p
+            return p
+
+    @staticmethod
+    def _device_key(arrays: Any) -> str:
+        import jax
+        leaves = jax.tree_util.tree_leaves(arrays)
+        for leaf in leaves:
+            devs = getattr(leaf, "devices", None)
+            if devs is not None:
+                try:
+                    return ",".join(sorted(str(d) for d in leaf.devices()))
+                except Exception:
+                    pass
+        return "host"
+
+    def on_ready(self, arrays: Any, callback: Callable[[], None]) -> None:
+        """Invoke callback once every array in the pytree is computed."""
+        self._poller_for(arrays).submit(arrays, callback)
+
+    def wait(self, arrays: Any, timeout: Optional[float] = None) -> int:
+        """Block the calling tasklet until the arrays are ready (the
+        bthread_fd_wait analogue).  Returns 0 or ETIMEDOUT."""
+        done = Butex(0)
+        self.on_ready(arrays, lambda: done.wake_all_and_set(1))
+        return done.wait(0, timeout)
+
+    def stats(self) -> Dict[str, int]:
+        with self._plock:
+            return {k: p.completed_count for k, p in self._pollers.items()}
+
+
+def device_wait(arrays: Any, timeout: Optional[float] = None) -> int:
+    return DeviceEventDispatcher.instance().wait(arrays, timeout)
+
+
+def device_on_ready(arrays: Any, callback: Callable[[], None]) -> None:
+    DeviceEventDispatcher.instance().on_ready(arrays, callback)
