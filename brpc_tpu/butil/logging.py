@@ -28,24 +28,24 @@ _every_n_counters: dict = {}
 _every_n_lock = threading.Lock()
 
 
-def log(level: int, msg: str, *args) -> None:
-    _logger.log(level, msg, *args, stacklevel=2)
+def log(level: int, msg: str, *args, **kw) -> None:
+    _logger.log(level, msg, *args, stacklevel=2, **kw)
 
 
-def info(msg: str, *args) -> None:
-    _logger.info(msg, *args, stacklevel=2)
+def info(msg: str, *args, **kw) -> None:
+    _logger.info(msg, *args, stacklevel=2, **kw)
 
 
-def warning(msg: str, *args) -> None:
-    _logger.warning(msg, *args, stacklevel=2)
+def warning(msg: str, *args, **kw) -> None:
+    _logger.warning(msg, *args, stacklevel=2, **kw)
 
 
-def error(msg: str, *args) -> None:
-    _logger.error(msg, *args, stacklevel=2)
+def error(msg: str, *args, **kw) -> None:
+    _logger.error(msg, *args, stacklevel=2, **kw)
 
 
-def fatal(msg: str, *args) -> None:
-    _logger.critical(msg, *args, stacklevel=2)
+def fatal(msg: str, *args, **kw) -> None:
+    _logger.critical(msg, *args, stacklevel=2, **kw)
     raise SystemExit(msg % args if args else msg)
 
 

@@ -1,0 +1,5 @@
+"""policy — pluggable protocols, LBs, limiters, naming (reference:
+src/brpc/policy/, SURVEY.md §2.5).  Importing this package registers the
+default protocol set (the reference does this in global.cpp:354-581)."""
+from . import tpu_std
+from . import limiters

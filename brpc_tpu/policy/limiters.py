@@ -1,0 +1,122 @@
+"""Concurrency limiters (reference: src/brpc/policy/ — constant,
+auto_concurrency_limiter.{h,cpp}, timeout_concurrency_limiter.{h,cpp};
+interface concurrency_limiter.h:29-44).
+
+* Constant: fixed max concurrent requests.
+* Auto: gradient limiter — tracks min latency (no-load) vs sampled latency
+  and adapts max_concurrency toward peak qps × min_latency, the algorithm
+  described in docs/cn/auto_concurrency_limiter.md (re-derived: EMA of
+  latency, multiplicative expand/shrink against the latency ratio).
+* Timeout: admit while expected queueing delay stays under the deadline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ConcurrencyLimiter:
+    def on_requested(self, current_concurrency: int) -> bool:
+        raise NotImplementedError
+
+    def on_responded(self, error_code: int, latency_us: int) -> None:
+        pass
+
+    def max_concurrency(self) -> int:
+        raise NotImplementedError
+
+
+class ConstantConcurrencyLimiter(ConcurrencyLimiter):
+    def __init__(self, max_concurrency: int):
+        self._max = max_concurrency
+
+    def on_requested(self, current_concurrency: int) -> bool:
+        return current_concurrency < self._max
+
+    def max_concurrency(self) -> int:
+        return self._max
+
+
+class AutoConcurrencyLimiter(ConcurrencyLimiter):
+    ALPHA_FACTOR_ON_DECR = 0.75
+    MIN_LIMIT = 4
+
+    def __init__(self, initial: int = 40, sample_window_s: float = 0.1,
+                 min_sample_count: int = 20):
+        self._max = initial
+        self._lock = threading.Lock()
+        self._win_start = time.monotonic()
+        self._win_lat_sum = 0
+        self._win_count = 0
+        self._win_err = 0
+        self._min_latency_us = None     # EMA of the best observed latency
+        self._ema_peak_qps = 0.0
+        self._sample_window_s = sample_window_s
+        self._min_sample_count = min_sample_count
+
+    def on_requested(self, current_concurrency: int) -> bool:
+        return current_concurrency < self._max
+
+    def on_responded(self, error_code: int, latency_us: int) -> None:
+        with self._lock:
+            now = time.monotonic()
+            if error_code == 0:
+                self._win_lat_sum += latency_us
+                self._win_count += 1
+            else:
+                self._win_err += 1
+            span = now - self._win_start
+            if span < self._sample_window_s or self._win_count < 1:
+                return
+            if self._win_count < self._min_sample_count and span < 1.0:
+                return
+            avg_latency = self._win_lat_sum / self._win_count
+            qps = self._win_count / span
+            if self._min_latency_us is None:
+                self._min_latency_us = avg_latency
+            else:
+                # latency floor decays slowly so a quiet period can lower it
+                self._min_latency_us = min(self._min_latency_us * 1.02,
+                                           avg_latency,
+                                           self._min_latency_us)
+            self._ema_peak_qps = max(self._ema_peak_qps * 0.98, qps)
+            # ideal concurrency ≈ peak_qps × min_latency (Little's law)
+            ideal = self._ema_peak_qps * (self._min_latency_us / 1e6)
+            ratio = avg_latency / max(self._min_latency_us, 1e-9)
+            if ratio > 1.5:     # overloaded: shrink toward ideal
+                newmax = max(int(ideal * self.ALPHA_FACTOR_ON_DECR),
+                             self.MIN_LIMIT)
+            else:               # healthy: probe upward
+                newmax = max(int(max(ideal, self._max) * 1.1) + 1,
+                             self.MIN_LIMIT)
+            self._max = newmax
+            self._win_start = now
+            self._win_lat_sum = self._win_count = self._win_err = 0
+
+    def max_concurrency(self) -> int:
+        return self._max
+
+
+class TimeoutConcurrencyLimiter(ConcurrencyLimiter):
+    """Admit while estimated queue wait < timeout budget
+    (timeout_concurrency_limiter.cpp)."""
+
+    def __init__(self, timeout_ms: float = 500.0):
+        self._timeout_ms = timeout_ms
+        self._avg_latency_us = 1000.0
+        self._lock = threading.Lock()
+
+    def on_requested(self, current_concurrency: int) -> bool:
+        with self._lock:
+            expected_wait_ms = current_concurrency * self._avg_latency_us / 1000.0
+            return expected_wait_ms < self._timeout_ms
+
+    def on_responded(self, error_code: int, latency_us: int) -> None:
+        if error_code == 0:
+            with self._lock:
+                self._avg_latency_us = (self._avg_latency_us * 0.9
+                                        + latency_us * 0.1)
+
+    def max_concurrency(self) -> int:
+        with self._lock:
+            return max(int(self._timeout_ms * 1000 / max(self._avg_latency_us, 1)), 1)

@@ -1,0 +1,259 @@
+"""tpu_std: the canonical framed protocol (the baidu_std analogue).
+
+Reference behavior: src/brpc/policy/baidu_rpc_protocol.cpp — 12-byte header
+("PRPC", body_size, meta_size), protobuf RpcMeta, payload, then attachment;
+server path ProcessRpcRequest (:312), response path SendRpcResponse (:139),
+client path ProcessRpcResponse (:557).  This implementation keeps the frame
+shape (magic "TRPC" + u32 meta_size + u32 body_size) with our own RpcMeta
+schema (brpc_tpu/proto/rpc_meta.proto) and adds nothing CUDA/torch-ish: the
+same frames travel over mem://, tcp://, and the ici:// device fabric.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ..butil.iobuf import IOBuf, IOBufCutter
+from ..butil import logging as log
+from ..bthread import id as bthread_id
+from ..proto import rpc_meta_pb2 as meta_pb
+from ..rpc import errors
+from ..rpc.controller import Controller
+from ..rpc.protocol import (Protocol, ParseResult, ParseResultType,
+                            register_protocol)
+from ..rpc import compress as compress_mod
+
+MAGIC = b"TRPC"
+HEADER_SIZE = 12
+
+
+class StdMessage:
+    """A cut but not yet parsed frame."""
+    __slots__ = ("meta", "body")
+
+    def __init__(self, meta: meta_pb.RpcMeta, body: IOBuf):
+        self.meta = meta
+        self.body = body
+
+
+# ---- frame codec ------------------------------------------------------
+
+def pack_frame(meta: meta_pb.RpcMeta, payload: IOBuf) -> IOBuf:
+    meta_bytes = meta.SerializeToString()
+    out = IOBuf()
+    out.append(MAGIC)
+    out.append(len(meta_bytes).to_bytes(4, "big"))
+    out.append(len(payload).to_bytes(4, "big"))
+    out.append(meta_bytes)
+    out.append(payload)            # zero-copy ref share (device blocks ride)
+    return out
+
+
+def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
+    header = source.fetch(HEADER_SIZE)
+    if header is None:
+        prefix = source.fetch(min(len(source), 4)) or b""
+        if MAGIC.startswith(prefix):
+            return ParseResult.not_enough_data()
+        return ParseResult.try_others()
+    if header[:4] != MAGIC:
+        return ParseResult.try_others()
+    meta_size = int.from_bytes(header[4:8], "big")
+    body_size = int.from_bytes(header[8:12], "big")
+    if meta_size > (1 << 26) or body_size > (1 << 31):
+        return ParseResult.parse_error("absurd frame sizes")
+    total = HEADER_SIZE + meta_size + body_size
+    if len(source) < total:
+        return ParseResult.not_enough_data()
+    source.pop_front(HEADER_SIZE)
+    meta_buf = source.cut(meta_size)
+    body = source.cut(body_size)
+    meta = meta_pb.RpcMeta()
+    try:
+        meta.ParseFromString(meta_buf.to_bytes())
+    except Exception as e:
+        return ParseResult.parse_error(f"bad meta: {e}")
+    return ParseResult.ok(StdMessage(meta, body))
+
+
+# ---- client side ------------------------------------------------------
+
+def serialize_request(request: Any, cntl: Controller) -> IOBuf:
+    buf = IOBuf()
+    if request is None:
+        return buf
+    if hasattr(request, "SerializeToString"):
+        data = request.SerializeToString()
+    elif isinstance(request, (bytes, bytearray)):
+        data = bytes(request)
+    else:
+        raise TypeError(f"cannot serialize {type(request)}")
+    if cntl.compress_type:
+        data = compress_mod.compress(cntl.compress_type, data)
+    buf.append(data)
+    return buf
+
+
+def pack_request(payload: IOBuf, cid: int, cntl: Controller,
+                 method_full_name: str) -> IOBuf:
+    meta = meta_pb.RpcMeta()
+    service, _, method_name = method_full_name.rpartition(".")
+    meta.request.service_name = service
+    meta.request.method_name = method_name
+    meta.request.log_id = cntl.log_id
+    meta.correlation_id = cid
+    meta.compress_type = cntl.compress_type
+    if cntl.timeout_ms:
+        meta.request.timeout_ms = cntl.timeout_ms
+    if cntl.auth_token:
+        meta.request.auth_token = cntl.auth_token
+    if cntl.span is not None:
+        meta.request.trace_id = cntl.span.trace_id
+        meta.request.span_id = cntl.span.span_id
+        meta.request.parent_span_id = cntl.span.parent_span_id
+    body = IOBuf()
+    body.append(payload)
+    att_size = len(cntl.request_attachment)
+    if att_size:
+        meta.attachment_size = att_size
+        body.append(cntl.request_attachment)
+    return pack_frame(meta, body)
+
+
+def process_response(msg: StdMessage, socket) -> None:
+    """ProcessRpcResponse: lock the correlation id; stale versions fail to
+    lock and the response is dropped (the retry-race resolution)."""
+    cid = msg.meta.correlation_id
+    rc, cntl = bthread_id.lock(cid)
+    if rc != 0 or cntl is None:
+        return                      # stale/duplicate/cancelled — ignore
+    cntl.remote_side = socket.remote_side
+    cntl.handle_response(cid, msg.meta, msg.body)
+
+
+# ---- server side ------------------------------------------------------
+
+def process_request(msg: StdMessage, socket, server) -> None:
+    """ProcessRpcRequest (baidu_rpc_protocol.cpp:312): find method, check
+    limits, run user code in this tasklet, respond via socket write."""
+    meta = msg.meta
+    req_meta = meta.request
+    full_name = f"{req_meta.service_name}.{req_meta.method_name}"
+    cid = meta.correlation_id
+    start_us = time.monotonic_ns() // 1000
+
+    cntl = Controller()
+    cntl.server = server
+    cntl.log_id = req_meta.log_id
+    cntl.remote_side = socket.remote_side
+    cntl.auth_token = req_meta.auth_token
+    cntl.compress_type = meta.compress_type
+    if req_meta.timeout_ms:
+        cntl.method_deadline = time.monotonic() + req_meta.timeout_ms / 1000.0
+
+    md = server.find_method(full_name)
+    status = server.method_status(full_name) if md is not None else None
+    server_counted = [False]
+
+    def send_response(resp: Any = None) -> None:
+        rmeta = meta_pb.RpcMeta()
+        rmeta.correlation_id = cid
+        rmeta.response.error_code = cntl.error_code_
+        rmeta.response.error_text = cntl.error_text_
+        payload = IOBuf()
+        if resp is not None and not cntl.failed():
+            data = resp.SerializeToString() if hasattr(resp, "SerializeToString") \
+                else bytes(resp)
+            if meta.compress_type:
+                data = compress_mod.compress(meta.compress_type, data)
+                rmeta.compress_type = meta.compress_type
+            payload.append(data)
+        att_size = len(cntl.response_attachment)
+        if att_size:
+            rmeta.attachment_size = att_size
+            payload.append(cntl.response_attachment)
+        socket.write(pack_frame(rmeta, payload))
+        if status is not None:
+            status.on_responded(cntl.error_code_,
+                                time.monotonic_ns() // 1000 - start_us)
+        if server_counted[0]:
+            server.on_request_out()
+
+    if not server.on_request_in():
+        cntl.set_failed(errors.ELIMIT, "server max_concurrency reached")
+        send_response()
+        return
+    server_counted[0] = True
+    if md is None:
+        cntl.set_failed(errors.ENOMETHOD if req_meta.service_name in
+                        server.services() else errors.ENOSERVICE,
+                        f"no method {full_name}")
+        send_response()
+        return
+    if status is not None and not status.on_requested():
+        cntl.set_failed(errors.ELIMIT,
+                        f"method {full_name} max_concurrency reached")
+        status = None               # don't on_responded a rejected request
+        send_response()
+        return
+    # auth (reference: protocol verify hook)
+    if server.options.auth is not None:
+        if not server.options.auth.verify(cntl.auth_token, socket):
+            cntl.set_failed(errors.ERPCAUTH, "authentication failed")
+            send_response()
+            return
+
+    # parse request payload
+    try:
+        body = msg.body
+        if meta.attachment_size:
+            keep = len(body) - meta.attachment_size
+            payload_part = body.cut(keep)
+            body.cutn(cntl.request_attachment, meta.attachment_size)
+            body = payload_part
+        data = body.to_bytes()
+        if meta.compress_type:
+            data = compress_mod.decompress(meta.compress_type, data)
+        request = md.request_cls()
+        request.ParseFromString(data)
+    except Exception as e:
+        cntl.set_failed(errors.EREQUEST, f"fail to parse request: {e}")
+        send_response()
+        return
+
+    response = md.response_cls()
+    done_called = [False]
+
+    def done() -> None:
+        if done_called[0]:
+            return
+        done_called[0] = True
+        send_response(response)
+
+    cntl.set_server_done(done)
+    try:
+        md.fn(cntl, request, response, done)
+    except Exception as e:   # uncaught user exception → EINTERNAL
+        log.error("method %s raised: %s", full_name, e, exc_info=True)
+        if not done_called[0]:
+            cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
+            done()
+
+
+PROTOCOL = Protocol(
+    name="tpu_std",
+    parse=parse,
+    process_request=process_request,
+    process_response=process_response,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+)
+
+
+def _register() -> None:
+    from ..rpc.protocol import find_protocol
+    if find_protocol("tpu_std") is None:
+        register_protocol(PROTOCOL)
+
+
+_register()

@@ -1,0 +1,2 @@
+"""Builtin admin services (reference: src/brpc/builtin/, SURVEY.md §2.4)."""
+from .services import register_builtin_services, BuiltinDispatcher

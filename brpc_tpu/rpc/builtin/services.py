@@ -1,0 +1,195 @@
+"""Builtin admin service set.
+
+Reference: src/brpc/builtin/*.{h,cpp} (30+ services: /status /vars /flags
+/connections /health /rpcz /protobufs /brpc_metrics …).  TPU-native twist:
+every page is served both as an RPC method (BuiltinService.Call) reachable
+over any transport — including ici:// so an admin can query a chip's runtime
+through the mesh — and as HTTP via the admin protocol (http_admin.py).
+
+Pages return JSON (machine-readable first; the reference's HTML pages were
+for 2015 browsers — the /vars and /brpc_metrics text formats are kept
+Prometheus-compatible).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, Optional
+
+from ... import bvar
+from ...butil import flags as _flags
+
+
+class BuiltinDispatcher:
+    """path → handler(server, query: dict) -> (content_type, body_str)."""
+
+    def __init__(self, server):
+        self.server = server
+        self.handlers: Dict[str, Callable] = {}
+        self._register_defaults()
+
+    def add(self, path: str, fn: Callable) -> None:
+        self.handlers[path.strip("/")] = fn
+
+    def dispatch(self, path: str, query: Optional[dict] = None):
+        fn = self.handlers.get(path.strip("/"))
+        if fn is None:
+            return None
+        return fn(self.server, query or {})
+
+    def paths(self):
+        return sorted(self.handlers)
+
+    # ---- default pages ------------------------------------------------
+    def _register_defaults(self) -> None:
+        self.add("health", _health)
+        self.add("status", _status)
+        self.add("vars", _vars)
+        self.add("flags", _flags_page)
+        self.add("connections", _connections)
+        self.add("rpcz", _rpcz)
+        self.add("brpc_metrics", _metrics)
+        self.add("protobufs", _protobufs)
+        self.add("sockets", _sockets)
+        self.add("bthreads", _bthreads)
+        self.add("ids", _ids)
+        self.add("index", _index)
+        self.add("version", _version)
+        self.add("hotspots", _hotspots)
+
+
+def _health(server, q):
+    return "text/plain", "OK"
+
+
+def _version(server, q):
+    from ... import __version__
+    return "text/plain", server.version or f"brpc_tpu/{__version__}"
+
+
+def _status(server, q):
+    bvar.expose_default_variables()
+    return "application/json", json.dumps({
+        "server": str(server.listen_endpoint),
+        "uptime_s": round(time.time() - _start_time, 1),
+        "services": sorted(server.services()),
+        "methods": [ms.describe() for ms in server.method_statuses()],
+        "connections": len(server.connections()),
+    }, indent=1)
+
+
+def _vars(server, q):
+    bvar.expose_default_variables()
+    wildcard = q.get("filter", "")
+    lines = [f"{name} : {value}" for name, value in bvar.dump_exposed(wildcard)]
+    return "text/plain", "\n".join(lines) + "\n"
+
+
+def _flags_page(server, q):
+    setname = q.get("setvalue")
+    if setname:
+        try:
+            _flags.set_flag(setname, q.get("to", ""))
+            return "text/plain", f"set {setname} ok"
+        except Exception as e:
+            return "text/plain", f"error: {e}"
+    lines = [f"{f.name}={f.get()}  (default={f.default})  {f.help}"
+             for f in _flags.list_flags()]
+    return "text/plain", "\n".join(lines) + "\n"
+
+
+def _connections(server, q):
+    rows = []
+    for s in server.connections():
+        rows.append({
+            "remote": str(s.remote_side),
+            "in_bytes": s.stat.in_size, "out_bytes": s.stat.out_size,
+            "in_messages": s.stat.in_num_messages,
+            "out_messages": s.stat.out_num_messages,
+            "age_s": round(time.time() - s.create_time, 1),
+        })
+    return "application/json", json.dumps(rows, indent=1)
+
+
+def _rpcz(server, q):
+    from ..span import recent_spans, find_trace, rpcz_enabled
+    tid = q.get("trace_id")
+    if tid:
+        spans = find_trace(int(tid, 16))
+    else:
+        spans = recent_spans(int(q.get("limit", "100")))
+    return "application/json", json.dumps({
+        "enabled": rpcz_enabled(),
+        "spans": [s.describe() for s in spans],
+    }, indent=1)
+
+
+def _metrics(server, q):
+    """Prometheus exposition (prometheus_metrics_service.cpp)."""
+    bvar.expose_default_variables()
+    lines = []
+    for name, value in bvar.dump_exposed():
+        try:
+            float(value)
+        except (TypeError, ValueError):
+            continue
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    return "text/plain; version=0.0.4", "\n".join(lines) + "\n"
+
+
+def _protobufs(server, q):
+    out = {}
+    for full_name, md in server._methods.items():
+        out[full_name] = {
+            "request": md.request_cls.DESCRIPTOR.full_name
+            if hasattr(md.request_cls, "DESCRIPTOR") else str(md.request_cls),
+            "response": md.response_cls.DESCRIPTOR.full_name
+            if hasattr(md.response_cls, "DESCRIPTOR") else str(md.response_cls),
+        }
+    return "application/json", json.dumps(out, indent=1)
+
+
+def _sockets(server, q):
+    from ..socket import list_sockets
+    return "text/plain", "\n".join(s.description() for s in list_sockets())
+
+
+def _bthreads(server, q):
+    from ...bthread.scheduler import TaskControl
+    ctl = TaskControl.instance()
+    return "application/json", json.dumps({
+        "workers": ctl.worker_count(),
+        "tasklets": ctl.tasklet_count,
+        "queue_depths": [len(g.deque) for g in ctl.groups],
+        "steals": [g.steal_count for g in ctl.groups],
+    })
+
+
+def _ids(server, q):
+    from ...bthread.id import _pool
+    return "text/plain", f"live correlation ids: {_pool.size()}"
+
+
+def _hotspots(server, q):
+    """CPU profile via Python's stdlib profilers (the gperftools stand-in:
+    hotspots_service.cpp invokes ProfilerStart/pprof)."""
+    seconds = float(q.get("seconds", "1"))
+    import cProfile, pstats, io, threading
+    return "text/plain", (
+        "profiling requires in-process invocation; use "
+        "brpc_tpu.tools.profiler.profile_for(seconds) — HTTP-triggered "
+        f"sampling of {seconds}s is available via /pprof/profile")
+
+
+def _index(server, q):
+    return "application/json", json.dumps({
+        "paths": server._builtin.paths() if hasattr(server, "_builtin") else [],
+    })
+
+
+_start_time = time.time()
+
+
+def register_builtin_services(server) -> None:
+    server._builtin = BuiltinDispatcher(server)

@@ -1,0 +1,128 @@
+"""Channel: the client stub.
+
+Reference: src/brpc/channel.{h,cpp} (Init :236-393, CallMethod :407-592) and
+Controller::IssueRPC (controller.cpp:985-1144).  A channel targets a single
+endpoint or a naming service + load balancer; per-call state lives in the
+Controller; connection selection honors single/pooled/short types.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ..butil.endpoint import EndPoint, parse_endpoint
+from ..butil.iobuf import IOBuf
+from . import errors
+from .controller import Controller
+from .input_messenger import InputMessenger
+from .protocol import find_protocol
+from .socket_map import SocketMap
+
+
+@dataclass
+class ChannelOptions:
+    protocol: str = "tpu_std"
+    connection_type: str = "single"     # single | pooled | short
+    timeout_ms: int = 1000
+    max_retry: int = 3
+    backup_request_ms: int = 0          # 0 = disabled
+    connect_timeout_ms: int = 1000
+
+
+class Channel:
+    def __init__(self):
+        self.options = ChannelOptions()
+        self._endpoint: Optional[EndPoint] = None
+        self._lb = None                 # LoadBalancer
+        self._ns_thread = None          # NamingServiceThread
+        self._protocol = None
+        self.messenger = InputMessenger(server=None)
+
+    # ---- init ---------------------------------------------------------
+    def init(self, target: Any, lb_name: str = "",
+             options: Optional[ChannelOptions] = None) -> int:
+        if options is not None:
+            self.options = options
+        self._protocol = find_protocol(self.options.protocol)
+        if self._protocol is None:
+            raise ValueError(f"unknown protocol {self.options.protocol!r}")
+        if isinstance(target, EndPoint):
+            self._endpoint = target
+            return 0
+        if isinstance(target, str) and "://" in target and not (
+                target.startswith(("mem://", "ici://", "tcp://"))):
+            # naming-service url (file://, list://, http://, mesh://, …)
+            from ..policy.naming import get_naming_service_thread
+            from ..policy.load_balancers import create_load_balancer
+            self._lb = create_load_balancer(lb_name or "rr")
+            self._ns_thread = get_naming_service_thread(target)
+            self._ns_thread.add_watcher(self._lb)
+            return 0
+        self._endpoint = parse_endpoint(target) if isinstance(target, str) else target
+        return 0
+
+    # ---- calls ----------------------------------------------------------
+    def call_method(self, method_full_name: str, cntl: Controller,
+                    request: Any, response_cls: Any = None,
+                    done: Optional[Callable[[Controller], None]] = None):
+        """Sync when done is None (returns the response); async otherwise."""
+        payload = self._protocol.serialize_request(request, cntl)
+        if cntl.span is None:
+            from .span import maybe_start_client_span
+            maybe_start_client_span(cntl, method_full_name)
+        cntl._start_call(self, method_full_name, payload, response_cls, done)
+        if done is None:
+            timeout = ((cntl.timeout_ms or 0) / 1000.0 + 35.0)
+            cntl.join(timeout)
+            return cntl.response
+        return None
+
+    # IssueRPC: runs once per try -----------------------------------------
+    def _issue_rpc(self, cntl: Controller) -> None:
+        sock = self._select_socket(cntl)
+        cntl.remote_side = sock.remote_side
+        cid = cntl.current_cid()
+        packet = self._protocol.pack_request(
+            cntl._request_buf, cid, cntl, cntl._method_full_name)
+        if cntl.span is not None:
+            cntl.span.annotate("issue try=%d to %s" % (cntl.current_try,
+                                                       sock.remote_side))
+        rc = sock.write(packet, notify_cid=cid)
+        if rc != 0:
+            raise ConnectionError(f"write failed: {rc}")
+        cntl._last_socket = sock
+
+    def _select_socket(self, cntl: Controller):
+        ctype = self.options.connection_type
+        smap = SocketMap.instance()
+        if self._lb is not None:
+            ep = self._lb.select_server(cntl)
+            if ep is None:
+                raise ConnectionError("no available server")
+        else:
+            ep = self._endpoint
+        cntl._selected_endpoint = ep
+        if ctype == "pooled":
+            sock = smap.get_pooled_socket(ep, self.messenger)
+            cntl._pooled_from = ep
+        elif ctype == "short":
+            sock = smap.get_short_socket(ep, self.messenger)
+            cntl._short_socket = sock
+        else:
+            sock = smap.get_socket(ep, self.messenger)
+        return sock
+
+    def _on_call_end(self, cntl: Controller) -> None:
+        # pooled sockets go back to the pool; short ones close
+        sock = getattr(cntl, "_last_socket", None)
+        ep = getattr(cntl, "_pooled_from", None)
+        if ep is not None and sock is not None:
+            SocketMap.instance().return_pooled_socket(ep, sock)
+        short = getattr(cntl, "_short_socket", None)
+        if short is not None:
+            short.set_failed(errors.ECLOSE, "short connection done")
+        if self._lb is not None:
+            sel = getattr(cntl, "_selected_endpoint", None)
+            if sel is not None:
+                self._lb.feedback(sel, cntl.error_code_, cntl.latency_us)

@@ -1,0 +1,258 @@
+"""Controller: per-RPC state machine for both client and server sides.
+
+Reference: src/brpc/controller.{h,cpp} + the client call flow of SURVEY.md
+§3.3.  Client-side lifecycle:
+
+  Channel.call_method
+    → correlation id created ranged over max_retry+1 try-versions
+      (channel.cpp:442): try k sends version k; a *retry* advances the
+      current version so older tries' responses fail to lock (ignored); a
+      *backup request* leaves older versions valid so the first response
+      wins (backup_request.md semantics).
+    → timeout / backup timers through TimerThread (channel.cpp:537-574)
+    → issue_rpc: pick socket, pack, Socket.write (controller.cpp:985-1144)
+    → completion funnels through the correlation id's on_error/lock — the
+      single synchronization point (OnVersionedRPCReturned controller.cpp:568)
+
+Server side carries request metadata (deadline, attachment, peer) and the
+response sender closure.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..butil.iobuf import IOBuf
+from ..butil.endpoint import EndPoint
+from ..bthread import id as bthread_id
+from ..bthread.timer_thread import TimerThread
+from . import errors
+
+
+class Controller:
+    def __init__(self):
+        # common
+        self.error_code_: int = 0
+        self.error_text_: str = ""
+        self.log_id: int = 0
+        self.request_attachment = IOBuf()
+        self.response_attachment = IOBuf()
+        self.remote_side: Optional[EndPoint] = None
+        self.local_side: Optional[EndPoint] = None
+        self.auth_token: str = ""
+        self.compress_type: int = 0
+        # tracing
+        self.trace_id: int = 0
+        self.span_id: int = 0
+        self.parent_span_id: int = 0
+        self.span = None
+        # client call state
+        self.timeout_ms: Optional[int] = None
+        self.max_retry: Optional[int] = None
+        self.backup_request_ms: Optional[int] = None
+        self.retried_count: int = 0
+        self.current_try: int = 0
+        self.latency_us: int = 0
+        self.response: Any = None
+        self._response_cls: Any = None
+        self._done: Optional[Callable[["Controller"], None]] = None
+        self._cid: int = 0
+        self._timeout_timer = None
+        self._backup_timer = None
+        self._channel = None            # issuing channel (for re-issues)
+        self._method_full_name: str = ""
+        self._request_buf: Optional[IOBuf] = None
+        self._start_us: int = 0
+        self._ended = threading.Event()
+        self._unfinished_tries: int = 0
+        self._excluded_servers: set = set()
+        self.request_protocol: str = ""
+        self.stream_creator = None      # set by stream.create on host RPC
+        self.accepted_stream_id = 0
+        # server side
+        self.server = None
+        self.method_deadline: Optional[float] = None
+        self._server_done: Optional[Callable[[], None]] = None
+        self.http_request = None
+        self.http_response = None
+
+    # ---- error surface (reference Controller::SetFailed/Failed) -------
+    def set_failed(self, code: int, text: str = "") -> None:
+        self.error_code_ = code
+        self.error_text_ = text or errors.berror(code)
+
+    def failed(self) -> bool:
+        return self.error_code_ != 0
+
+    @property
+    def error_code(self) -> int:
+        return self.error_code_
+
+    @property
+    def error_text(self) -> str:
+        return self.error_text_
+
+    def reset(self) -> None:
+        self.__init__()
+
+    # ---- client call orchestration ------------------------------------
+    def _start_call(self, channel, method_full_name: str, request_buf: IOBuf,
+                    response_cls, done) -> None:
+        self._channel = channel
+        self._method_full_name = method_full_name
+        self._request_buf = request_buf
+        self._response_cls = response_cls
+        self._done = done
+        self._start_us = time.monotonic_ns() // 1000
+        opts = channel.options
+        if self.timeout_ms is None:
+            self.timeout_ms = opts.timeout_ms
+        if self.max_retry is None:
+            self.max_retry = opts.max_retry
+        if self.backup_request_ms is None:
+            self.backup_request_ms = opts.backup_request_ms
+        # +1: versions are try indices 0..max_retry
+        self._cid = bthread_id.create_ranged(
+            self, self._on_rpc_event, self.max_retry + 1)
+        if self.timeout_ms and self.timeout_ms > 0:
+            self._timeout_timer = TimerThread.instance().schedule_after(
+                self._handle_timeout, self.timeout_ms / 1000.0)
+        if self.backup_request_ms and self.backup_request_ms > 0 \
+                and self.backup_request_ms < (self.timeout_ms or 1 << 30):
+            self._backup_timer = TimerThread.instance().schedule_after(
+                self._handle_backup_request, self.backup_request_ms / 1000.0)
+        self._issue_rpc()
+
+    def current_cid(self) -> int:
+        return bthread_id.with_version(self._cid, self.current_try)
+
+    def _issue_rpc(self) -> None:
+        self._unfinished_tries += 1
+        try:
+            self._channel._issue_rpc(self)
+        except Exception as e:
+            bthread_id.error(self.current_cid(),
+                             errors.EFAILEDSOCKET)
+
+    # timer callbacks ---------------------------------------------------
+    def _handle_timeout(self) -> None:
+        bthread_id.error(bthread_id.with_version(self._cid, self.current_try),
+                         errors.ERPCTIMEDOUT)
+
+    def _handle_backup_request(self) -> None:
+        bthread_id.error(bthread_id.with_version(self._cid, self.current_try),
+                         errors.EBACKUPREQUEST)
+
+    # the correlation-id funnel (always entered with the id locked) ------
+    def _on_rpc_event(self, data, cid: int, error_code: int) -> None:
+        """on_error callback: timeout, backup trigger, send failure, or
+        remote response error all land here — the retry decision point."""
+        if error_code == errors.EBACKUPREQUEST:
+            # hedge: issue one more try; older versions stay valid so the
+            # first response to arrive wins.
+            if self.current_try < self.max_retry:
+                self.current_try += 1
+                self.retried_count += 1
+                self._issue_rpc()
+            bthread_id.unlock(cid)
+            return
+        if error_code == errors.ERPCTIMEDOUT:
+            self.set_failed(errors.ERPCTIMEDOUT,
+                            f"reached timeout={self.timeout_ms}ms")
+            self._end_rpc(cid)
+            return
+        # send/socket failure or server-pushed error: retry if allowed
+        if self._retryable(error_code) and self.current_try < self.max_retry:
+            self.current_try += 1
+            self.retried_count += 1
+            bthread_id.reset_version(self._cid, self.current_try)  # stale old tries
+            self._issue_rpc()
+            bthread_id.unlock(cid)
+            return
+        self.set_failed(error_code)
+        self._end_rpc(cid)
+
+    @staticmethod
+    def _retryable(error_code: int) -> bool:
+        return error_code in (errors.EFAILEDSOCKET, errors.EEOF,
+                              errors.ELOGOFF, errors.ECONNREFUSED,
+                              errors.ECONNRESET, errors.EAGAIN)
+
+    def handle_response(self, cid: int, meta, payload: IOBuf) -> None:
+        """Called by the protocol with the correlation id locked and
+        validated (stale tries never get here)."""
+        rmeta = meta.response
+        if rmeta.error_code != 0:
+            err = rmeta.error_code
+            self.set_failed(err, rmeta.error_text)
+            if self._retryable(err) and self.current_try < self.max_retry:
+                self.error_code_ = 0
+                self.error_text_ = ""
+                self.current_try += 1
+                self.retried_count += 1
+                bthread_id.reset_version(self._cid, self.current_try)
+                self._issue_rpc()
+                bthread_id.unlock(cid)
+                return
+            self._end_rpc(cid)
+            return
+        try:
+            att_size = meta.attachment_size
+            body = payload
+            if att_size:
+                att = IOBuf()
+                keep = len(body) - att_size
+                tmp = body.cut(keep)
+                body.cutn(att, att_size)
+                body = tmp
+                self.response_attachment = att
+            data = body.to_bytes()
+            if meta.compress_type:
+                from .compress import decompress
+                data = decompress(meta.compress_type, data)
+            if self._response_cls is not None:
+                resp = self._response_cls()
+                resp.ParseFromString(data)
+                self.response = resp
+            else:
+                self.response = data
+        except Exception as e:
+            self.set_failed(errors.ERESPONSE, f"fail to parse response: {e}")
+        self._end_rpc(cid)
+
+    def _end_rpc(self, cid: int) -> None:
+        if self._timeout_timer is not None:
+            TimerThread.instance().unschedule(self._timeout_timer)
+        if self._backup_timer is not None:
+            TimerThread.instance().unschedule(self._backup_timer)
+        self.latency_us = time.monotonic_ns() // 1000 - self._start_us
+        chan = self._channel
+        if chan is not None:
+            try:
+                chan._on_call_end(self)
+            except Exception:
+                pass
+        if self.span is not None:
+            from .span import end_client_span
+            end_client_span(self)
+        done = self._done
+        bthread_id.unlock_and_destroy(cid)   # wakes sync joiner
+        self._ended.set()
+        if done is not None:
+            from ..bthread import scheduler
+            scheduler.start_background(done, self, name="rpc_done")
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for RPC completion (sync calls)."""
+        if not self._ended.wait(timeout):
+            raise TimeoutError("RPC join timed out")
+
+    # ---- server side ---------------------------------------------------
+    def set_server_done(self, fn: Callable[[], None]) -> None:
+        self._server_done = fn
+
+    def send_response(self) -> None:
+        if self._server_done is not None:
+            fn, self._server_done = self._server_done, None
+            fn()

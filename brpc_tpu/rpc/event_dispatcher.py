@@ -1,0 +1,137 @@
+"""EventDispatcher: readiness loop feeding socket input events.
+
+Reference: src/brpc/event_dispatcher*.{h,cpp} — one or more epoll loops, each
+running in a bthread, edge-triggered; AddConsumer ties an fd to
+Socket::StartInputEvent; an EPOLLOUT path unblocks KeepWrite and async
+connects.  Here: a ``selectors``-based loop on a daemon thread per
+dispatcher, fds hashed across ``event_dispatcher_num`` dispatchers
+(GetGlobalEventDispatcher, event_dispatcher.cpp:58-62).  Write-readiness is
+level-triggered and registered on demand by KeepWrite.
+"""
+from __future__ import annotations
+
+import os
+import selectors
+import socket as pysocket
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..butil import flags as _flags
+from .socket import Socket
+
+_flags.define_flag("event_dispatcher_num", 1,
+                   "number of event dispatcher loops", _flags.positive_integer)
+
+
+class EventDispatcher:
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+        self._consumers: Dict[int, Tuple[int, bool]] = {}  # fd -> (sid, want_write)
+        self._lock = threading.Lock()
+        self._wakeup_r, self._wakeup_w = os.pipe()
+        os.set_blocking(self._wakeup_r, False)
+        self._sel.register(self._wakeup_r, selectors.EVENT_READ, None)
+        self._thread = threading.Thread(target=self._run, name="event_dispatcher",
+                                        daemon=True)
+        self._stop = False
+        self._thread.start()
+
+    def add_consumer(self, fd: int, socket_id: int) -> None:
+        with self._lock:
+            self._consumers[fd] = (socket_id, False)
+        self._poke(lambda: self._register(fd, selectors.EVENT_READ))
+
+    def add_epollout(self, fd: int, socket_id: int) -> None:
+        with self._lock:
+            sid, _ = self._consumers.get(fd, (socket_id, False))
+            self._consumers[fd] = (sid, True)
+        self._poke(lambda: self._register(
+            fd, selectors.EVENT_READ | selectors.EVENT_WRITE))
+
+    def remove_epollout(self, fd: int) -> None:
+        with self._lock:
+            entry = self._consumers.get(fd)
+            if entry:
+                self._consumers[fd] = (entry[0], False)
+        self._poke(lambda: self._register(fd, selectors.EVENT_READ))
+
+    def remove_consumer(self, fd: int) -> None:
+        with self._lock:
+            self._consumers.pop(fd, None)
+        def _unreg():
+            try:
+                self._sel.unregister(fd)
+            except (KeyError, ValueError, OSError):
+                pass
+        self._poke(_unreg)
+
+    # -- loop internals -------------------------------------------------
+    def _register(self, fd: int, events: int) -> None:
+        try:
+            self._sel.modify(fd, events, fd)
+        except KeyError:
+            try:
+                self._sel.register(fd, events, fd)
+            except (ValueError, OSError):
+                pass
+
+    def _poke(self, fn) -> None:
+        with self._lock:
+            self._pending = getattr(self, "_pending", [])
+            self._pending.append(fn)
+        try:
+            os.write(self._wakeup_w, b"x")
+        except BlockingIOError:
+            pass
+
+    def _run(self) -> None:
+        while not self._stop:
+            events = self._sel.select(timeout=0.5)
+            with self._lock:
+                pending = getattr(self, "_pending", [])
+                self._pending = []
+            for fn in pending:
+                fn()
+            for key, mask in events:
+                if key.fd == self._wakeup_r:
+                    try:
+                        os.read(self._wakeup_r, 4096)
+                    except BlockingIOError:
+                        pass
+                    continue
+                with self._lock:
+                    entry = self._consumers.get(key.fd)
+                if entry is None:
+                    continue
+                sid, want_write = entry
+                sock = Socket.address(sid)
+                if sock is None:
+                    self.remove_consumer(key.fd)
+                    continue
+                if mask & selectors.EVENT_READ:
+                    sock.start_input_event()
+                if mask & selectors.EVENT_WRITE and want_write:
+                    self.remove_epollout(key.fd)
+                    handler = getattr(sock, "handle_epollout", None)
+                    if handler is not None:
+                        handler()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            os.write(self._wakeup_w, b"x")
+        except Exception:
+            pass
+
+
+_dispatchers: list = []
+_dispatchers_lock = threading.Lock()
+
+
+def get_global_dispatcher(fd: int) -> EventDispatcher:
+    """Hash fd → dispatcher (event_dispatcher.cpp:58-62)."""
+    with _dispatchers_lock:
+        if not _dispatchers:
+            for _ in range(_flags.get_flag("event_dispatcher_num")):
+                _dispatchers.append(EventDispatcher())
+        return _dispatchers[fd % len(_dispatchers)]

@@ -1,0 +1,58 @@
+"""Per-method accounting: concurrency, qps, latency, errors.
+
+Reference: src/brpc/details/method_status.{h,cpp} — every server method owns
+a MethodStatus that the concurrency limiter consults (OnRequested /
+OnResponded) and the /status builtin renders.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .. import bvar
+from . import errors
+
+
+class MethodStatus:
+    def __init__(self, full_name: str, limiter=None):
+        safe = bvar.to_underscored_name(full_name)
+        self.full_name = full_name
+        self.latency_rec = bvar.LatencyRecorder(f"rpc_method_{safe}")
+        self.error_count = bvar.Adder(f"rpc_method_{safe}_error")
+        self._concurrency = 0
+        self._lock = threading.Lock()
+        self.limiter = limiter          # ConcurrencyLimiter or None
+
+    def on_requested(self) -> bool:
+        """False → reject with ELIMIT (limiter says no)."""
+        with self._lock:
+            if self.limiter is not None and not self.limiter.on_requested(
+                    self._concurrency):
+                return False
+            self._concurrency += 1
+            return True
+
+    def on_responded(self, error_code: int, latency_us: int) -> None:
+        with self._lock:
+            self._concurrency -= 1
+        if error_code == 0:
+            self.latency_rec << latency_us
+        else:
+            self.error_count << 1
+        if self.limiter is not None:
+            self.limiter.on_responded(error_code, latency_us)
+
+    @property
+    def concurrency(self) -> int:
+        return self._concurrency
+
+    def describe(self) -> dict:
+        return {
+            "method": self.full_name,
+            "count": self.latency_rec.count(),
+            "qps": round(self.latency_rec.qps(), 2),
+            "latency_us": round(self.latency_rec.latency(), 1),
+            "max_latency_us": self.latency_rec.max_latency(),
+            "concurrency": self.concurrency,
+            "errors": self.error_count.get_value(),
+        }

@@ -1,0 +1,188 @@
+"""Server: service registry + lifecycle over any transport.
+
+Reference: src/brpc/server.{h,cpp} (StartInternal :741, AddService :1477,
+AddBuiltinServices :459, BuildAcceptor :567).  A server listens on one or
+more endpoints (mem://name for in-process, tcp host:port for DCN, ici://
+via the device fabric), exposes registered services through every server
+protocol, tracks per-method status, and optionally mounts the builtin admin
+service set.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..butil.endpoint import EndPoint, parse_endpoint, SCHEME_MEM, SCHEME_TCP
+from ..butil import logging as log
+from .. import bvar
+from . import errors
+from .input_messenger import InputMessenger
+from .method_status import MethodStatus
+from .service import MethodDescriptor, Service
+
+
+@dataclass
+class ServerOptions:
+    max_concurrency: int = 0            # 0 = unlimited; else ELIMIT beyond
+    method_max_concurrency: Dict[str, Any] = field(default_factory=dict)
+    auth = None                         # Authenticator
+    enable_builtin_services: bool = True
+    server_info_name: str = ""
+    idle_timeout_s: int = -1
+    internal_port: int = -1
+    concurrency_limiter: str = ""       # "", "constant", "auto", "timeout"
+
+
+class Server:
+    def __init__(self, options: Optional[ServerOptions] = None):
+        self.options = options or ServerOptions()
+        self._services: Dict[str, Service] = {}
+        self._methods: Dict[str, MethodDescriptor] = {}
+        self._method_status: Dict[str, MethodStatus] = {}
+        self._started = False
+        self._listen_endpoints: List[EndPoint] = []
+        self._mem_listener = None
+        self._acceptor = None
+        self.messenger = InputMessenger(server=self)
+        self._server_concurrency = 0
+        self._conc_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self.version = ""
+        self._connections: List[Any] = []
+        self._conn_lock = threading.Lock()
+
+    # ---- registry -----------------------------------------------------
+    def add_service(self, svc: Service) -> int:
+        if self._started:
+            raise RuntimeError("cannot add service after start")
+        name = svc.service_name()
+        if name in self._services:
+            return errors.EINVAL
+        self._services[name] = svc
+        from ..butil import flags as _flags
+        for mname, md in svc.methods().items():
+            self._methods[md.full_name] = md
+            limiter = self._make_limiter(md.full_name)
+            self._method_status[md.full_name] = MethodStatus(md.full_name,
+                                                             limiter)
+        return 0
+
+    def _make_limiter(self, full_name: str):
+        mc = self.options.method_max_concurrency.get(full_name)
+        kind = self.options.concurrency_limiter
+        from ..policy import limiters
+        if isinstance(mc, int) and mc > 0:
+            return limiters.ConstantConcurrencyLimiter(mc)
+        if mc == "auto" or kind == "auto":
+            return limiters.AutoConcurrencyLimiter()
+        if kind == "timeout":
+            return limiters.TimeoutConcurrencyLimiter()
+        if kind == "constant" and self.options.max_concurrency > 0:
+            return limiters.ConstantConcurrencyLimiter(
+                self.options.max_concurrency)
+        return None
+
+    def find_method(self, full_name: str) -> Optional[MethodDescriptor]:
+        return self._methods.get(full_name)
+
+    def method_status(self, full_name: str) -> Optional[MethodStatus]:
+        return self._method_status.get(full_name)
+
+    def services(self) -> Dict[str, Service]:
+        return dict(self._services)
+
+    def method_statuses(self) -> List[MethodStatus]:
+        return list(self._method_status.values())
+
+    # ---- server-level concurrency (reference max_concurrency) ---------
+    def on_request_in(self) -> bool:
+        mc = self.options.max_concurrency
+        with self._conc_lock:
+            if mc > 0 and self._server_concurrency >= mc:
+                return False
+            self._server_concurrency += 1
+            return True
+
+    def on_request_out(self) -> None:
+        with self._conc_lock:
+            self._server_concurrency -= 1
+
+    # ---- lifecycle ----------------------------------------------------
+    def start(self, addr: Any = None, options: Optional[ServerOptions] = None) -> int:
+        if options is not None:
+            self.options = options
+        if self._started:
+            return errors.EINVAL
+        if self.options.enable_builtin_services:
+            from .builtin import register_builtin_services
+            register_builtin_services(self)
+        if addr is None:
+            addr = "mem://server"
+        if isinstance(addr, int):
+            ep = EndPoint(scheme=SCHEME_TCP, host="0.0.0.0", port=addr)
+        elif isinstance(addr, str):
+            if ":" not in addr and not addr.startswith(("mem://", "ici://")):
+                addr = "mem://" + addr
+            ep = parse_endpoint(addr)
+        else:
+            ep = addr
+        if ep.scheme == SCHEME_MEM:
+            from .mem_transport import mem_listen
+            self._mem_listener = mem_listen(ep.host, self._on_accept)
+        elif ep.scheme == SCHEME_TCP:
+            from .tcp_transport import Acceptor
+            self._acceptor = Acceptor(self._on_accept)
+            port = self._acceptor.start(ep.host or "0.0.0.0", ep.port)
+            ep = EndPoint(scheme=SCHEME_TCP, host=ep.host or "0.0.0.0",
+                          port=port)
+        else:
+            raise ValueError(f"cannot listen on scheme {ep.scheme}")
+        self._listen_endpoints.append(ep)
+        self._started = True
+        log.info("Server started on %s with %d services", ep,
+                 len(self._services))
+        return 0
+
+    def _on_accept(self, sock) -> None:
+        sock.messenger = self.messenger
+        with self._conn_lock:
+            self._connections = [s for s in self._connections if not s.failed]
+            self._connections.append(sock)
+
+    @property
+    def listen_endpoint(self) -> Optional[EndPoint]:
+        return self._listen_endpoints[0] if self._listen_endpoints else None
+
+    @property
+    def listen_port(self) -> int:
+        ep = self.listen_endpoint
+        return ep.port if ep else 0
+
+    def is_running(self) -> bool:
+        return self._started and not self._stopped.is_set()
+
+    def stop(self) -> int:
+        if not self._started:
+            return 0
+        if self._mem_listener is not None:
+            from .mem_transport import mem_unlisten
+            mem_unlisten(self._mem_listener.name)
+            self._mem_listener = None
+        if self._acceptor is not None:
+            self._acceptor.stop()
+            self._acceptor = None
+        with self._conn_lock:
+            conns = list(self._connections)
+        for s in conns:
+            s.set_failed(errors.ELOGOFF, "server stopping")
+        self._stopped.set()
+        self._started = False
+        return 0
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._stopped.wait(timeout)
+
+    def connections(self) -> List[Any]:
+        with self._conn_lock:
+            return [s for s in self._connections if not s.failed]

@@ -1,0 +1,255 @@
+"""Socket: revocable connection handles with serialized, batched writes.
+
+Reference: src/brpc/socket.{h,cpp} — the heart of the runtime.  Kept
+capabilities (SURVEY.md §2.4):
+
+  * SocketId: versioned id from a global ResourcePool (socket_id.h:35).
+    ``Socket.address(sid)`` fails after ``set_failed`` — handle revocation
+    without locks.
+  * Write path (socket.cpp:1584-1790): callers enqueue WriteRequests; the
+    first uncontended writer drains in place, leftover work moves to a
+    single "KeepWrite" tasklet that batches everyone else's requests.  One
+    writer at a time, writers never block each other.
+  * ``set_failed`` fails pending writes, notifies the health checker, and
+    revokes the id (socket.cpp:863).
+  * Input events are deduped by an atomic counter so exactly one reader
+    tasklet runs per socket no matter how many readiness events fire
+    (StartInputEvent, socket.cpp:2046-2090).
+
+Transport specifics (fd IO, in-process loopback, device streams) live in
+subclasses implementing ``_do_write``/``_do_read``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..butil.iobuf import IOBuf, IOPortal
+from ..butil.resource_pool import ResourcePool
+from ..butil.endpoint import EndPoint
+from .. import bvar
+from ..bthread import scheduler
+from ..bthread.butex import Butex
+from . import errors
+
+_socket_pool: ResourcePool = ResourcePool()
+
+_g_socket_count = bvar.Adder("rpc_socket_count")
+
+
+class SocketStat:
+    """Per-connection counters (reference SocketStat socket.h:123)."""
+
+    __slots__ = ("in_size", "out_size", "in_num_messages", "out_num_messages")
+
+    def __init__(self):
+        self.in_size = 0
+        self.out_size = 0
+        self.in_num_messages = 0
+        self.out_num_messages = 0
+
+
+class WriteRequest:
+    __slots__ = ("data", "notify_cid", "on_done", "completed")
+
+    def __init__(self, data: IOBuf, notify_cid: int = 0,
+                 on_done: Optional[Callable[[int], None]] = None):
+        self.data = data
+        self.notify_cid = notify_cid
+        self.on_done = on_done      # on_done(error_code)
+        self.completed = False
+
+
+class Socket:
+    """Base socket; see module docstring."""
+
+    def __init__(self, remote_side: Optional[EndPoint] = None,
+                 user: Any = None):
+        self.id: int = _socket_pool.get_resource(self)
+        self.remote_side = remote_side
+        self.local_side: Optional[EndPoint] = None
+        self.user = user                    # owner (Acceptor / SocketMap)
+        self.failed = False
+        self.failed_error = 0
+        self._write_queue: List[WriteRequest] = []
+        self._write_lock = threading.Lock()
+        self._writing = False
+        self._nevent = 0                    # input-event dedup counter
+        self._nevent_lock = threading.Lock()
+        self.messenger = None               # InputMessenger set by owner
+        self._read_portal = IOPortal()
+        self._selected_protocol_index = -1  # protocol memory per socket
+        self.stat = SocketStat()
+        self.create_time = time.time()
+        self.on_failed_callbacks: List[Callable[["Socket"], None]] = []
+        self.pipelined_contexts: List[Any] = []   # redis/memcache pipelining
+        self._pipeline_lock = threading.Lock()
+        self.health_check_interval_s = 0
+        self.correlation_map: Dict[int, Any] = {}  # cid -> waiting call ctx
+        self.is_server_side = False
+        _g_socket_count << 1
+
+    # ---- id management ----------------------------------------------
+    @staticmethod
+    def address(sid: int) -> Optional["Socket"]:
+        s = _socket_pool.address(sid)
+        return s if s is not None and not s.failed else None
+
+    def set_failed(self, error_code: int = errors.EFAILEDSOCKET,
+                   reason: str = "") -> bool:
+        with self._write_lock:
+            if self.failed:
+                return False
+            self.failed = True
+            self.failed_error = error_code
+            pending = self._write_queue
+            self._write_queue = []
+        _socket_pool.return_resource(self.id)
+        _g_socket_count << -1
+        for req in pending:
+            self._complete_write(req, error_code)
+        for cb in list(self.on_failed_callbacks):
+            try:
+                cb(self)
+            except Exception:
+                pass
+        self._transport_close()
+        return True
+
+    # ---- write path ---------------------------------------------------
+    def write(self, data: IOBuf, notify_cid: int = 0,
+              on_done: Optional[Callable[[int], None]] = None) -> int:
+        """Enqueue data; returns 0 or an error code immediately (completion
+        is reported through on_done / correlation error)."""
+        req = WriteRequest(data, notify_cid, on_done)
+        with self._write_lock:
+            if self.failed:
+                err = self.failed_error or errors.EFAILEDSOCKET
+                # complete outside the lock
+            else:
+                self._write_queue.append(req)
+                if self._writing:
+                    return 0
+                self._writing = True
+                err = None
+        if err is not None:
+            self._complete_write(req, err)
+            return err
+        # we are the writer: drain once in place; leftover (transport not
+        # writable) moves to a KeepWrite tasklet that batches later writers
+        if not self._drain():
+            scheduler.start_urgent(self._keep_write, name="keep_write")
+        return 0
+
+    def _drain(self) -> bool:
+        """Write head requests until the queue empties (release writer,
+        return True) or the transport stops accepting (stay writer, return
+        False so the caller reschedules via KeepWrite)."""
+        while True:
+            with self._write_lock:
+                if self.failed or not self._write_queue:
+                    self._writing = False
+                    return True
+                req = self._write_queue[0]
+            try:
+                n = self._do_write(req.data)
+            except Exception as e:
+                self.set_failed(errors.EFAILEDSOCKET, str(e))
+                return True
+            if n < 0:           # transport not writable now
+                return False
+            self.stat.out_size += n
+            if len(req.data) == 0:
+                with self._write_lock:
+                    if self._write_queue and self._write_queue[0] is req:
+                        self._write_queue.pop(0)
+                self.stat.out_num_messages += 1
+                self._complete_write(req, 0)
+
+    def _keep_write(self) -> None:
+        while True:
+            if self._drain():
+                return
+            if not self._wait_writable():
+                return
+
+    def _complete_write(self, req: WriteRequest, error_code: int) -> None:
+        with self._write_lock:
+            if req.completed:
+                return
+            req.completed = True
+        if req.on_done is not None:
+            try:
+                req.on_done(error_code)
+            except Exception:
+                pass
+        if error_code != 0 and req.notify_cid:
+            from ..bthread import id as bthread_id
+            bthread_id.error(req.notify_cid, error_code)
+
+    def _wait_writable(self, timeout: float = 30.0) -> bool:
+        """Block until the transport can accept bytes again (EPOLLOUT
+        analogue).  Default: brief yield for transports without readiness."""
+        time.sleep(0.001)
+        return not self.failed
+
+    # ---- input path ---------------------------------------------------
+    def start_input_event(self) -> None:
+        """Readiness notification; guarantees a single reader tasklet."""
+        with self._nevent_lock:
+            self._nevent += 1
+            if self._nevent > 1:
+                return
+        scheduler.start_urgent(self._process_event, name="sock_reader")
+
+    def _process_event(self) -> None:
+        while True:
+            if self.messenger is not None:
+                try:
+                    self.messenger.on_new_messages(self)
+                except Exception as e:
+                    from ..butil import logging as log
+                    log.error("input processing failed on %s: %s",
+                              self.remote_side, e)
+                    self.set_failed(errors.EFAILEDSOCKET, str(e))
+            with self._nevent_lock:
+                left = self._nevent - 1
+                self._nevent = 1 if left > 0 else 0
+                if left <= 0:
+                    return
+
+    # ---- pipelining (redis/memcache; socket.h:256-262) ----------------
+    def push_pipelined_context(self, ctx: Any) -> None:
+        with self._pipeline_lock:
+            self.pipelined_contexts.append(ctx)
+
+    def pop_pipelined_context(self) -> Optional[Any]:
+        with self._pipeline_lock:
+            return self.pipelined_contexts.pop(0) if self.pipelined_contexts else None
+
+    # ---- transport hooks ----------------------------------------------
+    def _do_write(self, data: IOBuf) -> int:
+        raise NotImplementedError
+
+    def _do_read(self, portal: IOPortal, max_count: int) -> int:
+        """Read available bytes into portal; -1 on EAGAIN, 0 on EOF."""
+        raise NotImplementedError
+
+    def _transport_close(self) -> None:
+        pass
+
+    def description(self) -> str:
+        return (f"Socket{{id={self.id} remote={self.remote_side} "
+                f"failed={self.failed} in={self.stat.in_size}B "
+                f"out={self.stat.out_size}B}}")
+
+
+def list_sockets() -> List[Socket]:
+    """Debug enumeration for the /sockets builtin service."""
+    out = []
+    for slot in range(len(_socket_pool._slots)):
+        entry = _socket_pool._slots[slot]
+        if entry[2] and isinstance(entry[1], Socket):
+            out.append(entry[1])
+    return out

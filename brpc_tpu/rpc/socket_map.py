@@ -1,0 +1,105 @@
+"""SocketMap: process-global EndPoint → single-connection cache.
+
+Reference: src/brpc/socket_map.{h,cpp} (SocketMapInsert :82,
+SingleConnection :180).  Channels to the same endpoint share one "single"
+connection; pooled and short connections hang off it (GetPooledSocket).
+Failed sockets are replaced on next use and handed to the health checker.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..butil.endpoint import EndPoint, SCHEME_MEM, SCHEME_TCP, SCHEME_ICI
+from . import errors
+from .socket import Socket
+
+
+class _SingleConnection:
+    def __init__(self):
+        self.socket: Optional[Socket] = None
+        self.pooled: List[Socket] = []       # idle pooled connections
+        self.lock = threading.Lock()
+
+
+class SocketMap:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._map: Dict[EndPoint, _SingleConnection] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "SocketMap":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = SocketMap()
+            return cls._instance
+
+    def _entry(self, ep: EndPoint) -> _SingleConnection:
+        with self._lock:
+            e = self._map.get(ep)
+            if e is None:
+                e = _SingleConnection()
+                self._map[ep] = e
+            return e
+
+    def get_socket(self, ep: EndPoint, messenger=None) -> Socket:
+        """The shared 'single' connection to ep (creates/replaces lazily)."""
+        e = self._entry(ep)
+        with e.lock:
+            if e.socket is not None and not e.socket.failed:
+                return e.socket
+            s = self._connect(ep)
+            s.messenger = messenger
+            e.socket = s
+            return s
+
+    def get_pooled_socket(self, ep: EndPoint, messenger=None) -> Socket:
+        """An exclusive connection from the pool (reference
+        GetPooledSocket); return it with return_pooled_socket."""
+        e = self._entry(ep)
+        with e.lock:
+            while e.pooled:
+                s = e.pooled.pop()
+                if not s.failed:
+                    return s
+        s = self._connect(ep)
+        s.messenger = messenger
+        return s
+
+    def return_pooled_socket(self, ep: EndPoint, s: Socket) -> None:
+        if s.failed:
+            return
+        e = self._entry(ep)
+        with e.lock:
+            e.pooled.append(s)
+
+    def get_short_socket(self, ep: EndPoint, messenger=None) -> Socket:
+        s = self._connect(ep)
+        s.messenger = messenger
+        return s
+
+    @staticmethod
+    def _connect(ep: EndPoint) -> Socket:
+        if ep.scheme == SCHEME_MEM:
+            from .mem_transport import mem_connect
+            return mem_connect(ep.host)
+        if ep.scheme == SCHEME_TCP:
+            from .tcp_transport import tcp_connect
+            return tcp_connect(ep)
+        if ep.scheme == SCHEME_ICI:
+            from ..ici.transport import ici_connect
+            return ici_connect(ep)
+        raise ValueError(f"unsupported scheme {ep.scheme}")
+
+    def remove(self, ep: EndPoint) -> None:
+        with self._lock:
+            self._map.pop(ep, None)
+
+    def stats(self) -> Dict[EndPoint, int]:
+        with self._lock:
+            return {ep: (0 if e.socket is None or e.socket.failed else 1)
+                    + len(e.pooled)
+                    for ep, e in self._map.items()}

@@ -1,0 +1,127 @@
+"""rpcz spans: per-RPC timelines sampled through the bvar Collector.
+
+Reference: src/brpc/span.{h,cpp} (Span at span.h:47-150, tls_parent :115,
+SpanDB :206-223) + builtin/rpcz_service.cpp.  Client and server spans record
+annotated timelines; sampling is speed-limited via CollectorSpeedLimit; kept
+spans land in an in-memory ring (the LevelDB store's stand-in) rendered by
+the /rpcz builtin service.  Propagation: trace/span/parent ids ride RpcMeta.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, List, Optional, Tuple
+
+from ..butil.misc import fast_rand
+from ..butil import flags as _flags
+from .. import bvar
+from ..bthread import scheduler
+
+_flags.define_flag("rpcz_enabled", False, "collect per-RPC rpcz spans")
+_flags.define_flag("rpcz_keep", 1000, "spans kept in memory",
+                   _flags.positive_integer)
+
+_speed_limit = bvar.CollectorSpeedLimit()
+_store_lock = threading.Lock()
+_store: Deque["Span"] = collections.deque(maxlen=10000)
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_span_id", "is_client",
+                 "method", "start_us", "end_us", "annotations", "error_code",
+                 "remote_side", "request_size", "response_size")
+
+    def __init__(self, method: str, is_client: bool, trace_id: int = 0,
+                 parent_span_id: int = 0):
+        self.trace_id = trace_id or fast_rand()
+        self.span_id = fast_rand()
+        self.parent_span_id = parent_span_id
+        self.is_client = is_client
+        self.method = method
+        self.start_us = time.monotonic_ns() // 1000
+        self.end_us = 0
+        self.annotations: List[Tuple[int, str]] = []
+        self.error_code = 0
+        self.remote_side = None
+        self.request_size = 0
+        self.response_size = 0
+
+    def annotate(self, text: str) -> None:
+        self.annotations.append((time.monotonic_ns() // 1000, text))
+
+    def latency_us(self) -> int:
+        return (self.end_us or time.monotonic_ns() // 1000) - self.start_us
+
+    def describe(self) -> dict:
+        return {
+            "trace_id": f"{self.trace_id:016x}",
+            "span_id": f"{self.span_id:016x}",
+            "parent": f"{self.parent_span_id:016x}",
+            "side": "client" if self.is_client else "server",
+            "method": self.method,
+            "latency_us": self.latency_us(),
+            "error_code": self.error_code,
+            "remote": str(self.remote_side),
+            "annotations": [(t - self.start_us, a) for t, a in self.annotations],
+        }
+
+
+def rpcz_enabled() -> bool:
+    return bool(_flags.get_flag("rpcz_enabled"))
+
+
+def maybe_start_client_span(cntl, method: str) -> None:
+    if not rpcz_enabled() or not _speed_limit.is_sampled():
+        return
+    # inherit trace from an enclosing server span (bthread-local parenting)
+    parent: Optional[Span] = scheduler.local_get("rpcz_span")
+    if parent is not None:
+        span = Span(method, True, parent.trace_id, parent.span_id)
+    else:
+        span = Span(method, True)
+    cntl.span = span
+    cntl.trace_id = span.trace_id
+    cntl.span_id = span.span_id
+    cntl.parent_span_id = span.parent_span_id
+
+
+def start_server_span(cntl, method: str, trace_id: int, parent_span_id: int) -> None:
+    if not rpcz_enabled() or not _speed_limit.is_sampled():
+        return
+    span = Span(method, False, trace_id, parent_span_id)
+    cntl.span = span
+    scheduler.local_set("rpcz_span", span)
+
+
+def end_client_span(cntl) -> None:
+    _finish(cntl)
+
+
+def end_server_span(cntl) -> None:
+    _finish(cntl)
+    scheduler.local_set("rpcz_span", None)
+
+
+def _finish(cntl) -> None:
+    span = cntl.span
+    if span is None:
+        return
+    span.end_us = time.monotonic_ns() // 1000
+    span.error_code = cntl.error_code_
+    span.remote_side = cntl.remote_side
+    with _store_lock:
+        _store.append(span)
+        while len(_store) > _flags.get_flag("rpcz_keep"):
+            _store.popleft()
+    cntl.span = None
+
+
+def recent_spans(limit: int = 100) -> List[Span]:
+    with _store_lock:
+        return list(_store)[-limit:]
+
+
+def find_trace(trace_id: int) -> List[Span]:
+    with _store_lock:
+        return [s for s in _store if s.trace_id == trace_id]

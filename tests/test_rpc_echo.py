@@ -1,0 +1,248 @@
+"""End-to-end RPC tests over mem:// and tcp:// — the in-process loopback
+pattern of reference test/brpc_channel_unittest.cpp:166-395."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy  # registers protocols
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_name_seq = [0]
+
+
+def unique_name(prefix="echo"):
+    _name_seq[0] += 1
+    return f"{prefix}-{_name_seq[0]}"
+
+
+class EchoService(rpc.Service):
+    def __init__(self):
+        self.call_count = 0
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        self.call_count += 1
+        if request.sleep_us:
+            time.sleep(request.sleep_us / 1e6)
+        response.message = request.message
+        # attachment round-trip (reference attachment semantics)
+        if len(cntl.request_attachment):
+            cntl.response_attachment.append(cntl.request_attachment)
+        done()
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Fail(self, cntl, request, response, done):
+        cntl.set_failed(errors.EINTERNAL, "deliberate failure")
+        done()
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Boom(self, cntl, request, response, done):
+        raise RuntimeError("kaboom")
+
+
+@pytest.fixture()
+def mem_server():
+    server = rpc.Server()
+    svc = EchoService()
+    server.add_service(svc)
+    name = unique_name()
+    assert server.start(f"mem://{name}") == 0
+    yield server, svc, f"mem://{name}"
+    server.stop()
+
+
+def make_channel(target, **opts):
+    ch = rpc.Channel()
+    options = rpc.ChannelOptions(**opts) if opts else None
+    assert ch.init(target, options=options) == 0
+    return ch
+
+
+class TestMemEcho:
+    def test_sync_echo(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        cntl = rpc.Controller()
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="hello"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "hello"
+        assert svc.call_count == 1
+        assert cntl.latency_us > 0
+
+    def test_async_echo(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        done_evt = threading.Event()
+        results = {}
+
+        def on_done(cntl):
+            results["failed"] = cntl.failed()
+            results["resp"] = cntl.response
+            done_evt.set()
+
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Echo", cntl,
+                       EchoRequest(message="async"), EchoResponse, on_done)
+        assert done_evt.wait(10)
+        assert not results["failed"]
+        assert results["resp"].message == "async"
+
+    def test_many_concurrent_calls(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        n = 50
+        done = threading.Event()
+        ok = []
+        lock = threading.Lock()
+
+        def on_done(cntl):
+            with lock:
+                ok.append(not cntl.failed() and cntl.response.message)
+                if len(ok) == n:
+                    done.set()
+
+        for i in range(n):
+            ch.call_method("EchoService.Echo", rpc.Controller(),
+                           EchoRequest(message=f"m{i}"), EchoResponse, on_done)
+        assert done.wait(30)
+        assert len(ok) == n and all(ok)
+        assert svc.call_count == n
+
+    def test_attachment_roundtrip(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        cntl = rpc.Controller()
+        cntl.request_attachment.append(b"\x00\x01raw-bytes")
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="a"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert cntl.response_attachment.to_bytes() == b"\x00\x01raw-bytes"
+
+    def test_compressed_call(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        cntl = rpc.Controller()
+        cntl.compress_type = rpc.compress.COMPRESS_TYPE_GZIP
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="z" * 5000), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "z" * 5000
+
+    def test_server_side_failure(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Fail", cntl,
+                       EchoRequest(message="x"), EchoResponse)
+        assert cntl.failed()
+        assert cntl.error_code == errors.EINTERNAL
+        assert "deliberate" in cntl.error_text
+
+    def test_uncaught_exception_becomes_einternal(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Boom", cntl,
+                       EchoRequest(message="x"), EchoResponse)
+        assert cntl.failed()
+        assert cntl.error_code == errors.EINTERNAL
+        assert "kaboom" in cntl.error_text
+
+    def test_no_such_method(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Nope", cntl,
+                       EchoRequest(), EchoResponse)
+        assert cntl.error_code == errors.ENOMETHOD
+
+    def test_no_such_service(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        cntl = rpc.Controller()
+        ch.call_method("NopeService.Echo", cntl,
+                       EchoRequest(), EchoResponse)
+        assert cntl.error_code == errors.ENOSERVICE
+
+    def test_timeout(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target, timeout_ms=50, max_retry=0)
+        cntl = rpc.Controller()
+        t0 = time.monotonic()
+        ch.call_method("EchoService.Echo", cntl,
+                       EchoRequest(message="slow", sleep_us=500_000),
+                       EchoResponse)
+        assert cntl.error_code == errors.ERPCTIMEDOUT
+        assert time.monotonic() - t0 < 5.0
+
+    def test_method_stats_recorded(self, mem_server):
+        server, svc, target = mem_server
+        ch = make_channel(target)
+        for _ in range(3):
+            ch.call_method("EchoService.Echo", rpc.Controller(),
+                           EchoRequest(message="s"), EchoResponse)
+        st = server.method_status("EchoService.Echo")
+        assert st.latency_rec.count() == 3
+        assert st.concurrency == 0
+
+    def test_connection_refused(self):
+        ch = make_channel("mem://nobody-listens", max_retry=1, timeout_ms=200)
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Echo", cntl, EchoRequest(), EchoResponse)
+        assert cntl.failed()
+
+
+class TestTcpEcho:
+    def test_sync_echo_over_tcp(self):
+        server = rpc.Server()
+        svc = EchoService()
+        server.add_service(svc)
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            port = server.listen_port
+            ch = make_channel(f"127.0.0.1:{port}")
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="over-tcp"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "over-tcp"
+        finally:
+            server.stop()
+
+    def test_large_payload_tcp(self):
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            ch = make_channel(f"127.0.0.1:{server.listen_port}",
+                              timeout_ms=20000)
+            big = "x" * (2 * 1024 * 1024)
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message=big), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == big
+        finally:
+            server.stop()
+
+    def test_server_stop_fails_inflight_cleanly(self):
+        server = rpc.Server()
+        server.add_service(EchoService())
+        server.start("127.0.0.1:0")
+        ch = make_channel(f"127.0.0.1:{server.listen_port}",
+                          timeout_ms=2000, max_retry=0)
+        cntl = rpc.Controller()
+        done = threading.Event()
+        ch.call_method("EchoService.Echo", cntl,
+                       EchoRequest(message="x", sleep_us=300_000),
+                       EchoResponse, lambda c: done.set())
+        time.sleep(0.05)
+        server.stop()
+        assert done.wait(10)
+        # either clean response (already processed) or socket failure
+        assert cntl.error_code in (0, errors.EFAILEDSOCKET, errors.EEOF,
+                                   errors.ELOGOFF, errors.ECONNRESET)
