@@ -1,0 +1,7 @@
+"""ici — the device-fabric transport and collectives layer (the rdma/
+analogue of SURVEY.md §2.4, rebuilt on XLA over the ICI mesh)."""
+from .mesh import IciMesh
+from .transport import (IciSocket, ici_listen, ici_unlisten, ici_connect,
+                        ici_transport_stats)
+from .collective import Collectives, default_collectives
+from .ring import ring_all_reduce, RingStream

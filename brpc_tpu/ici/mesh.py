@@ -1,0 +1,79 @@
+"""IciMesh: the device mesh underlying the ici:// transport.
+
+The reference's "cluster" is whatever naming services return; the TPU
+fabric's first-class cluster is the accelerator mesh itself
+(jax.sharding.Mesh).  This module owns the process-global mesh: logical
+device ids (the ``ici://k`` endpoints), the collective axis, and neighbor
+topology for ring pipelines.
+
+On test hosts the mesh is the 8-device virtual CPU platform from conftest;
+on hardware it is the real TPU slice.  Everything above (transport,
+collectives, combo-channel lowering) is written against this abstraction so
+the same code compiles for both.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from ..butil.endpoint import EndPoint, SCHEME_ICI
+
+AXIS = "ici"
+
+
+class IciMesh:
+    _default: Optional["IciMesh"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, devices: Optional[Sequence] = None,
+                 axis_name: str = AXIS):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.axis_name = axis_name
+        self.mesh = Mesh(np.array(self.devices), (axis_name,))
+        self.size = len(self.devices)
+
+    @classmethod
+    def default(cls) -> "IciMesh":
+        with cls._lock:
+            if cls._default is None:
+                cls._default = IciMesh()
+            return cls._default
+
+    @classmethod
+    def set_default(cls, mesh: "IciMesh") -> None:
+        with cls._lock:
+            cls._default = mesh
+
+    # ---- endpoints -----------------------------------------------------
+    def endpoint(self, device_id: int) -> EndPoint:
+        return EndPoint(scheme=SCHEME_ICI, coords=(device_id,))
+
+    def endpoints(self) -> List[EndPoint]:
+        return [self.endpoint(i) for i in range(self.size)]
+
+    def device(self, device_id: int):
+        return self.devices[device_id % self.size]
+
+    # ---- topology ------------------------------------------------------
+    def ring_perm(self, shift: int = 1) -> List[Tuple[int, int]]:
+        """Source→dest pairs rotating the ring by ``shift`` hops."""
+        n = self.size
+        return [(i, (i + shift) % n) for i in range(n)]
+
+    def neighbors(self, device_id: int) -> List[int]:
+        n = self.size
+        if n == 1:
+            return [0]
+        return sorted({(device_id - 1) % n, (device_id + 1) % n})
+
+    def sharding(self, spec=None):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh,
+                             spec if spec is not None else PartitionSpec())
+
+    def shard_along_axis(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec(self.axis_name))

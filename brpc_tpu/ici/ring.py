@@ -1,0 +1,132 @@
+"""Ring pipelines: chained Send/Recv with credit flow control.
+
+SURVEY.md §5.7: the reference's closest thing to sequence parallelism is
+Streaming RPC's sliding window (stream.cpp:274,307) and RDMA's explicit-ACK
+window (rdma_endpoint.cpp) — ordered chunk pipelines with credits.  Here
+that machinery becomes what ring/context-parallel patterns are made of:
+
+  * ``ring_all_reduce`` — the classic 2(n−1)-hop ring expressed as a
+    ``lax.scan`` of ``ppermute`` (reduce-scatter phase + all-gather phase),
+    compiled to ONE XLA program whose steady state keeps every ICI link busy
+    both directions of the scan.  This is the rdma_performance analogue.
+  * ``RingStream`` — host-paced chunk pipeline: a large device payload moves
+    hop-by-hop as fixed-size chunks with a sliding credit window; receiver
+    consumption returns credits (the StreamingRPC feedback loop), device
+    completion observed through the device waiter.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from ..bthread.butex import Butex
+from ..bthread.device_waiter import DeviceEventDispatcher
+from .mesh import IciMesh
+from .collective import Collectives, default_collectives
+
+
+def ring_all_reduce(x, mesh: Optional[IciMesh] = None):
+    """All-reduce (sum) of a (n, chunk...) sharded array via explicit ring
+    hops.  Equivalent to ``Collectives.all_reduce`` but lowered as 2(n−1)
+    chained ppermutes — the chained-Send/Recv benchmark path.  Returns the
+    summed value replicated as (n, chunk...) rows (row i = full sum of
+    chunk i's shards … i.e. a reduce-scatter + all-gather pipeline)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    mesh = mesh or IciMesh.default()
+    n = mesh.size
+    ax = mesh.axis_name
+    if n == 1:
+        return x
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def program(xs):                      # xs: (1, ...) local shard
+        chunk = xs[0]
+
+        def rs_step(carry, _):
+            acc = jax.lax.ppermute(carry, ax, perm)
+            return acc + chunk, None
+
+        # reduce-scatter phase: after n-1 hops every device holds the sum
+        acc, _ = jax.lax.scan(rs_step, chunk, None, length=n - 1)
+        return acc[None]
+
+    fn = jax.jit(shard_map(program, mesh=mesh.mesh, in_specs=P(ax),
+                           out_specs=P(ax), check_vma=False))
+    return fn(x)
+
+
+class RingStream:
+    """Sliding-window chunk pipeline between ring neighbors.
+
+    Sender pushes chunks (device arrays); each chunk advances one hop per
+    tick via ppermute; the receiver's ``on_chunk`` consumes it and returns a
+    credit.  ``window`` bounds in-flight chunks exactly like the reference
+    stream's ``_produced - _remote_consumed < window`` check
+    (stream.cpp:274); device completion is the delivery signal.
+    """
+
+    def __init__(self, hops: int = 1, window: int = 4,
+                 mesh: Optional[IciMesh] = None,
+                 on_chunk: Optional[Callable] = None):
+        self.mesh = mesh or IciMesh.default()
+        self.coll = Collectives(self.mesh)
+        self.hops = hops
+        self.window = window
+        self.on_chunk = on_chunk
+        self._credits = Butex(window)
+        self._produced = 0
+        self._consumed = 0
+        self._lock = threading.Lock()
+        self._error: Optional[str] = None
+
+    def write(self, chunk, timeout: float = 30.0) -> bool:
+        """Send one chunk ((n, ...) sharded row layout); blocks while the
+        window is exhausted (AppendIfNotFull semantics)."""
+        while True:
+            with self._credits._cond:
+                if self._credits._value > 0:
+                    self._credits._value -= 1
+                    break
+            if self._credits.wait(0, timeout) == 110:
+                return False
+        with self._lock:
+            self._produced += 1
+        moved = chunk
+        for _ in range(self.hops):
+            moved = self.coll.ppermute(moved, 1)
+        DeviceEventDispatcher.instance().on_ready(
+            moved, lambda m=moved: self._delivered(m))
+        return True
+
+    def _delivered(self, chunk) -> None:
+        try:
+            if self.on_chunk is not None:
+                self.on_chunk(chunk)
+        finally:
+            with self._lock:
+                self._consumed += 1
+            # feedback: credit returns to the sender (SendFeedback analogue)
+            with self._credits._cond:
+                self._credits._value += 1
+                self._credits._cond.notify_all()
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Wait until every produced chunk was consumed."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._consumed >= self._produced:
+                    return True
+            time.sleep(0.001)
+        return False
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._produced - self._consumed

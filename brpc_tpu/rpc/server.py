@@ -136,6 +136,9 @@ class Server:
             port = self._acceptor.start(ep.host or "0.0.0.0", ep.port)
             ep = EndPoint(scheme=SCHEME_TCP, host=ep.host or "0.0.0.0",
                           port=port)
+        elif ep.scheme == "ici":
+            from ..ici.transport import ici_listen
+            self._ici_listener = ici_listen(ep.device_id, self._on_accept)
         else:
             raise ValueError(f"cannot listen on scheme {ep.scheme}")
         self._listen_endpoints.append(ep)
@@ -172,6 +175,10 @@ class Server:
         if self._acceptor is not None:
             self._acceptor.stop()
             self._acceptor = None
+        if getattr(self, "_ici_listener", None) is not None:
+            from ..ici.transport import ici_unlisten
+            ici_unlisten(self._ici_listener.device_id)
+            self._ici_listener = None
         with self._conn_lock:
             conns = list(self._connections)
         for s in conns:

@@ -16,3 +16,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The env var alone is not enough when a TPU platform plugin (e.g. the axon
+# tunnel) is installed — pin the platform explicitly before any test touches
+# jax.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, (
+    "tests require the 8-device virtual CPU mesh; got %d" % len(jax.devices()))
