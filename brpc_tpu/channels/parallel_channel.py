@@ -1,0 +1,170 @@
+"""ParallelChannel: fan one RPC out to N sub-channels concurrently.
+
+Reference: src/brpc/parallel_channel.{h,cpp} (CallMethod :551, CallMapper::Map
+:94-107, ResponseMerger::Merge :127-144).  Semantics kept:
+
+  * CallMapper rewrites the request per sub-channel (replicate by default;
+    shard for scatter patterns) and may skip a sub-channel.
+  * ResponseMerger folds each arriving sub-response into the caller's
+    response (called serially, in arrival order, under the parent's lock).
+  * fail_limit: the call fails once that many sub-calls failed
+    (ETOOMANYFAILS); success completes when every non-skipped sub-call ends.
+
+When every sub-channel targets the same ICI mesh and payloads are device
+arrays, use channels/collective_lowering.py instead — the same fan-out
+semantics compile to ONE mesh collective (SURVEY.md §2.6's TPU-native
+lowering).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from ..rpc import errors
+from ..rpc.controller import Controller
+
+
+class SubCall:
+    """What CallMapper returns for one sub-channel."""
+    __slots__ = ("request", "skip")
+
+    def __init__(self, request: Any = None, skip: bool = False):
+        self.request = request
+        self.skip = skip
+
+    @staticmethod
+    def skip_call() -> "SubCall":
+        return SubCall(skip=True)
+
+
+class CallMapper:
+    def map(self, channel_index: int, method_full_name: str,
+            request: Any) -> SubCall:
+        return SubCall(request)             # default: replicate
+
+
+class ResponseMerger:
+    MERGED = 0
+    FAIL = 1
+    FAIL_ALL = 2
+
+    def merge(self, response: Any, sub_response: Any) -> int:
+        """Fold sub_response into response; default: protobuf MergeFrom."""
+        if response is not None and hasattr(response, "MergeFrom"):
+            response.MergeFrom(sub_response)
+            return self.MERGED
+        return self.MERGED
+
+
+class ParallelChannel:
+    def __init__(self, fail_limit: int = -1):
+        self._subs: List = []               # (channel, mapper, merger)
+        self.fail_limit = fail_limit
+
+    def add_channel(self, channel, mapper: Optional[CallMapper] = None,
+                    merger: Optional[ResponseMerger] = None) -> int:
+        self._subs.append((channel, mapper or CallMapper(),
+                           merger or ResponseMerger()))
+        return 0
+
+    def channel_count(self) -> int:
+        return len(self._subs)
+
+    def call_method(self, method_full_name: str, cntl: Controller,
+                    request: Any, response: Any = None,
+                    done: Optional[Callable[[Controller], None]] = None):
+        n = len(self._subs)
+        if n == 0:
+            cntl.set_failed(errors.EINVAL, "no sub channels")
+            if done: done(cntl)
+            return None
+        fail_limit = self.fail_limit if self.fail_limit > 0 else n
+        state = _ParallelCallState(cntl, response, n, fail_limit, done)
+
+        import time
+        cntl._start_us = time.monotonic_ns() // 1000
+        for i, (chan, mapper, merger) in enumerate(self._subs):
+            sub = mapper.map(i, method_full_name, request)
+            if sub.skip:
+                state.on_skip()
+                continue
+            sub_cntl = Controller()
+            sub_cntl.timeout_ms = cntl.timeout_ms
+            sub_cntl.max_retry = cntl.max_retry
+            sub_cntl.log_id = cntl.log_id
+            response_cls = type(response) if response is not None else None
+            chan.call_method(
+                method_full_name, sub_cntl, sub.request, response_cls,
+                done=lambda sc, idx=i, m=merger: state.on_sub_done(idx, m, sc))
+        if done is None:
+            state.wait()
+            return response
+        return None
+
+
+class _ParallelCallState:
+    def __init__(self, cntl: Controller, response: Any, total: int,
+                 fail_limit: int, done):
+        self.cntl = cntl
+        self.response = response
+        self.total = total
+        self.fail_limit = fail_limit
+        self.done = done
+        self.lock = threading.Lock()
+        self.finished = 0
+        self.failed = 0
+        self.ended = False
+        self.event = threading.Event()
+        self.sub_errors: List[int] = []
+
+    def on_skip(self) -> None:
+        with self.lock:
+            self.total -= 1
+            if self.finished >= self.total:
+                self._maybe_end_locked()
+
+    def on_sub_done(self, index: int, merger: ResponseMerger,
+                    sub_cntl: Controller) -> None:
+        with self.lock:
+            if self.ended:
+                return
+            self.finished += 1
+            if sub_cntl.failed():
+                self.failed += 1
+                self.sub_errors.append(sub_cntl.error_code_)
+            else:
+                try:
+                    rc = merger.merge(self.response, sub_cntl.response)
+                except Exception as e:
+                    rc = ResponseMerger.FAIL
+                if rc == ResponseMerger.FAIL:
+                    self.failed += 1
+                    self.sub_errors.append(errors.ERESPONSE)
+                elif rc == ResponseMerger.FAIL_ALL:
+                    self.failed = self.fail_limit
+            self._maybe_end_locked()
+
+    def _maybe_end_locked(self) -> None:
+        if self.ended:
+            return
+        if self.failed >= self.fail_limit:
+            self.cntl.set_failed(
+                errors.ETOOMANYFAILS,
+                f"{self.failed}/{self.total} sub-calls failed: "
+                f"{self.sub_errors[:4]}")
+            self._end_locked()
+        elif self.finished >= self.total:
+            self._end_locked()
+
+    def _end_locked(self) -> None:
+        self.ended = True
+        import time
+        self.cntl.latency_us = time.monotonic_ns() // 1000 - self.cntl._start_us
+        self.cntl.response = self.response
+        self.event.set()
+        if self.done is not None:
+            from ..bthread import scheduler
+            scheduler.start_background(self.done, self.cntl, name="pchan_done")
+
+    def wait(self) -> None:
+        self.event.wait()

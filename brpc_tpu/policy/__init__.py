@@ -3,3 +3,5 @@ src/brpc/policy/, SURVEY.md §2.5).  Importing this package registers the
 default protocol set (the reference does this in global.cpp:354-581)."""
 from . import tpu_std
 from . import limiters
+from . import load_balancers
+from . import naming

@@ -1,0 +1,382 @@
+"""Load balancers (reference: src/brpc/policy/*_load_balancer.cpp,
+registered in global.cpp:141-150; interface load_balancer.h).
+
+All nine reference strategies: round_robin, weighted_round_robin,
+randomized, weighted_randomized, consistent hashing (murmur/md5/ketama),
+locality-aware (LALB), dynpart.  Server lists live in DoublyBufferedData so
+the selection hot path never contends with membership changes, and
+``feedback`` closes the loop for LALB and the circuit breaker.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..butil.doubly_buffered import DoublyBufferedData
+from ..butil.endpoint import EndPoint
+from ..butil.misc import fast_rand_less_than
+from ..rpc import errors
+
+
+class ServerEntry:
+    __slots__ = ("endpoint", "weight", "tag")
+
+    def __init__(self, endpoint: EndPoint, weight: int = 100, tag: str = ""):
+        self.endpoint = endpoint
+        self.weight = weight
+        self.tag = tag
+
+
+class LoadBalancer:
+    """Interface (load_balancer.h): membership + selection + feedback."""
+
+    name = "base"
+
+    def add_server(self, ep: EndPoint, weight: int = 100, tag: str = "") -> bool:
+        raise NotImplementedError
+
+    def remove_server(self, ep: EndPoint) -> bool:
+        raise NotImplementedError
+
+    def reset_servers(self, entries: List[ServerEntry]) -> None:
+        raise NotImplementedError
+
+    def select_server(self, cntl=None) -> Optional[EndPoint]:
+        raise NotImplementedError
+
+    def feedback(self, ep: EndPoint, error_code: int, latency_us: int) -> None:
+        pass
+
+    def server_count(self) -> int:
+        raise NotImplementedError
+
+
+class _ListLB(LoadBalancer):
+    """Shared base: DoublyBufferedData<list[ServerEntry]>."""
+
+    def __init__(self):
+        self._dbd: DoublyBufferedData[List[ServerEntry]] = DoublyBufferedData(list)
+        self._excluded: Dict[EndPoint, float] = {}   # circuit-broken until ts
+        self._excl_lock = threading.Lock()
+
+    def add_server(self, ep, weight=100, tag="") -> bool:
+        def doit(lst):
+            if any(e.endpoint == ep for e in lst):
+                return False
+            lst.append(ServerEntry(ep, weight, tag))
+            return True
+        return self._dbd.modify(doit)
+
+    def remove_server(self, ep) -> bool:
+        def doit(lst):
+            for i, e in enumerate(lst):
+                if e.endpoint == ep:
+                    lst.pop(i)
+                    return True
+            return False
+        return self._dbd.modify(doit)
+
+    def reset_servers(self, entries) -> None:
+        def doit(lst):
+            lst.clear()
+            lst.extend(ServerEntry(e.endpoint, e.weight, e.tag)
+                       for e in entries)
+        self._dbd.modify(doit)
+
+    def server_count(self) -> int:
+        with self._dbd.read() as lst:
+            return len(lst)
+
+    def exclude(self, ep: EndPoint, until_ts: float) -> None:
+        with self._excl_lock:
+            self._excluded[ep] = until_ts
+
+    def _usable(self, lst, cntl) -> List[ServerEntry]:
+        import time
+        now = time.monotonic()
+        with self._excl_lock:
+            excl = {ep for ep, ts in self._excluded.items() if ts > now}
+        per_call = getattr(cntl, "_excluded_servers", None) if cntl else None
+        out = [e for e in lst if e.endpoint not in excl
+               and (per_call is None or e.endpoint not in per_call)]
+        # cluster-recover guard: if everything is excluded, serve anyway
+        return out if out else list(lst)
+
+
+class RoundRobinLB(_ListLB):
+    name = "rr"
+
+    def __init__(self):
+        super().__init__()
+        self._index = 0
+        self._ilock = threading.Lock()
+
+    def select_server(self, cntl=None):
+        with self._dbd.read() as lst:
+            usable = self._usable(lst, cntl)
+            if not usable:
+                return None
+            with self._ilock:
+                self._index = (self._index + 1) % len(usable)
+                return usable[self._index].endpoint
+
+
+class WeightedRoundRobinLB(_ListLB):
+    name = "wrr"
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.Lock()
+        self._current: Dict[EndPoint, int] = {}
+
+    def select_server(self, cntl=None):
+        """Smooth weighted RR (same distribution contract as
+        weighted_round_robin_load_balancer.cpp)."""
+        with self._dbd.read() as lst:
+            usable = self._usable(lst, cntl)
+            if not usable:
+                return None
+            with self._lock:
+                total = 0
+                best = None
+                for e in usable:
+                    cur = self._current.get(e.endpoint, 0) + e.weight
+                    self._current[e.endpoint] = cur
+                    total += e.weight
+                    if best is None or cur > self._current[best.endpoint]:
+                        best = e
+                self._current[best.endpoint] -= total
+                return best.endpoint
+
+
+class RandomizedLB(_ListLB):
+    name = "random"
+
+    def select_server(self, cntl=None):
+        with self._dbd.read() as lst:
+            usable = self._usable(lst, cntl)
+            if not usable:
+                return None
+            return usable[fast_rand_less_than(len(usable))].endpoint
+
+
+class WeightedRandomizedLB(_ListLB):
+    name = "wr"
+
+    def select_server(self, cntl=None):
+        with self._dbd.read() as lst:
+            usable = self._usable(lst, cntl)
+            if not usable:
+                return None
+            total = sum(e.weight for e in usable)
+            r = fast_rand_less_than(max(total, 1))
+            acc = 0
+            for e in usable:
+                acc += e.weight
+                if r < acc:
+                    return e.endpoint
+            return usable[-1].endpoint
+
+
+def _murmur32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86_32 (the reference's murmurhash3 third_party lib)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed
+    rounded = len(data) & ~3
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3: k ^= tail[2] << 16
+    if len(tail) >= 2: k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def _md5_32(data: bytes) -> int:
+    return int.from_bytes(hashlib.md5(data).digest()[:4], "little")
+
+
+class ConsistentHashingLB(_ListLB):
+    """Ketama-style ring with virtual nodes
+    (consistent_hashing_load_balancer.cpp + hasher.cpp).  ``kind`` selects
+    the hash: murmur | md5 | ketama (md5-based multi-point)."""
+
+    def __init__(self, kind: str = "murmur", vnodes: int = 64):
+        super().__init__()
+        self.kind = kind
+        self.name = "c_" + kind + "hash"
+        self._vnodes = vnodes
+        self._ring_lock = threading.Lock()
+        self._ring: List[Tuple[int, EndPoint]] = []
+
+    def _hash(self, data: bytes) -> int:
+        if self.kind == "murmur":
+            return _murmur32(data)
+        return _md5_32(data)
+
+    def _rebuild(self) -> None:
+        with self._dbd.read() as lst:
+            servers = list(lst)
+        ring = []
+        for e in servers:
+            base = str(e.endpoint).encode()
+            if self.kind == "ketama":
+                # 4 points per md5 digest, ketama style
+                for i in range((self._vnodes + 3) // 4):
+                    d = hashlib.md5(base + b"-%d" % i).digest()
+                    for j in range(4):
+                        ring.append((int.from_bytes(d[j*4:j*4+4], "little"),
+                                     e.endpoint))
+            else:
+                for i in range(self._vnodes):
+                    ring.append((self._hash(base + b"-%d" % i), e.endpoint))
+        ring.sort()
+        with self._ring_lock:
+            self._ring = ring
+
+    def add_server(self, ep, weight=100, tag="") -> bool:
+        ok = super().add_server(ep, weight, tag)
+        if ok:
+            self._rebuild()
+        return ok
+
+    def remove_server(self, ep) -> bool:
+        ok = super().remove_server(ep)
+        if ok:
+            self._rebuild()
+        return ok
+
+    def reset_servers(self, entries) -> None:
+        super().reset_servers(entries)
+        self._rebuild()
+
+    def select_server(self, cntl=None):
+        code = getattr(cntl, "request_code", None) if cntl is not None else None
+        if code is None:
+            code = fast_rand_less_than(1 << 32)
+        h = self._hash(str(code).encode()) if not isinstance(code, bytes) \
+            else self._hash(code)
+        with self._ring_lock:
+            ring = self._ring
+            if not ring:
+                return None
+            i = bisect.bisect_left(ring, (h,))
+            return ring[i % len(ring)][1]
+
+
+class LocalityAwareLB(_ListLB):
+    """LALB (locality_aware_load_balancer.{h,cpp}, docs/cn/lalb.md): server
+    weight ∝ 1/latency with error punishment; selection is weighted random
+    over dynamic weights (the reference's weight tree is an O(log n)
+    optimization of exactly this distribution)."""
+
+    name = "la"
+    INITIAL_WEIGHT = 1000.0
+    MIN_WEIGHT = 1.0
+
+    def __init__(self):
+        super().__init__()
+        self._w_lock = threading.Lock()
+        self._weights: Dict[EndPoint, float] = {}
+        self._avg_latency: Dict[EndPoint, float] = {}
+
+    def select_server(self, cntl=None):
+        with self._dbd.read() as lst:
+            usable = self._usable(lst, cntl)
+        if not usable:
+            return None
+        with self._w_lock:
+            ws = [max(self._weights.get(e.endpoint, self.INITIAL_WEIGHT),
+                      self.MIN_WEIGHT) for e in usable]
+        total = sum(ws)
+        r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
+        acc = 0.0
+        for e, w in zip(usable, ws):
+            acc += w
+            if r < acc:
+                return e.endpoint
+        return usable[-1].endpoint
+
+    def feedback(self, ep, error_code, latency_us) -> None:
+        with self._w_lock:
+            if error_code != 0:
+                # punish: halve weight (reference punishes via inflated
+                # latency; halving has the same direction and is bounded)
+                self._weights[ep] = max(
+                    self._weights.get(ep, self.INITIAL_WEIGHT) * 0.5,
+                    self.MIN_WEIGHT)
+                return
+            avg = self._avg_latency.get(ep)
+            avg = latency_us if avg is None else avg * 0.9 + latency_us * 0.1
+            self._avg_latency[ep] = max(avg, 1.0)
+            self._weights[ep] = 1e7 / self._avg_latency[ep]
+
+    def weight_of(self, ep) -> float:
+        with self._w_lock:
+            return self._weights.get(ep, self.INITIAL_WEIGHT)
+
+
+class DynPartLB(_ListLB):
+    """dynpart (dynpart_load_balancer.cpp): selection proportional to each
+    scheme's capacity; pairs with DynamicPartitionChannel."""
+
+    name = "dynpart"
+
+    def select_server(self, cntl=None):
+        with self._dbd.read() as lst:
+            usable = self._usable(lst, cntl)
+            if not usable:
+                return None
+            total = sum(e.weight for e in usable)
+            r = fast_rand_less_than(max(total, 1))
+            acc = 0
+            for e in usable:
+                acc += e.weight
+                if r < acc:
+                    return e.endpoint
+            return usable[-1].endpoint
+
+
+_factories = {
+    "rr": RoundRobinLB,
+    "wrr": WeightedRoundRobinLB,
+    "random": RandomizedLB,
+    "wr": WeightedRandomizedLB,
+    "c_murmurhash": lambda: ConsistentHashingLB("murmur"),
+    "c_md5": lambda: ConsistentHashingLB("md5"),
+    "c_ketama": lambda: ConsistentHashingLB("ketama"),
+    "la": LocalityAwareLB,
+    "dynpart": DynPartLB,
+}
+
+
+def create_load_balancer(name: str) -> LoadBalancer:
+    try:
+        return _factories[name]()
+    except KeyError:
+        raise ValueError(f"unknown load balancer {name!r}; "
+                         f"have {sorted(_factories)}")
+
+
+def list_load_balancers() -> List[str]:
+    return sorted(_factories)

@@ -1,0 +1,230 @@
+"""Naming services: cluster membership sources.
+
+Reference: src/brpc/policy/*naming_service.cpp + details/
+naming_service_thread.h (one shared polling thread per url).  Implemented
+sources:
+
+  * ``list://ep1,ep2,...``      static list (tags via ``ep weight tag``)
+  * ``file://path``             one endpoint per line, re-read periodically;
+                                supports ``endpoint weight tag`` columns and
+                                the "N/M" partition tags PartitionChannel
+                                parses (partition_channel.h:46-52)
+  * ``dns://host:port``         resolve host each period (the reference's
+                                http:// DomainNamingService)
+  * ``mesh://``                 TPU-native: every device of the default ICI
+                                mesh — topology discovery IS the naming
+                                service on a pod
+  * ``consul://host:port/name`` JSON HTTP discovery endpoint (consul-style
+                                watch; plain GET per period)
+
+A NamingServiceThread polls its source and pushes full server lists to
+watchers (load balancers implement the watcher interface via
+``reset_servers``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from ..butil.endpoint import EndPoint, parse_endpoint
+from ..butil import logging as log
+from ..butil import flags as _flags
+from .load_balancers import ServerEntry
+
+_flags.define_flag("ns_poll_interval_s", 1.0,
+                   "naming service polling period")
+
+
+class NamingService:
+    def get_servers(self) -> List[ServerEntry]:
+        raise NotImplementedError
+
+    def supports_watch(self) -> bool:
+        return False
+
+
+def _parse_line(line: str) -> Optional[ServerEntry]:
+    line = line.split("#", 1)[0].strip()
+    if not line:
+        return None
+    parts = line.split()
+    ep = parse_endpoint(parts[0])
+    weight = int(parts[1]) if len(parts) > 1 and parts[1].isdigit() else 100
+    tag = parts[-1] if len(parts) > 1 and not parts[-1].isdigit() else ""
+    return ServerEntry(ep, weight, tag)
+
+
+class ListNamingService(NamingService):
+    def __init__(self, body: str):
+        self._entries = []
+        for item in body.split(","):
+            e = _parse_line(item.replace(":tag=", " "))
+            if e is not None:
+                self._entries.append(e)
+
+    def get_servers(self) -> List[ServerEntry]:
+        return list(self._entries)
+
+
+class FileNamingService(NamingService):
+    def __init__(self, path: str):
+        self.path = path
+
+    def get_servers(self) -> List[ServerEntry]:
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                e = _parse_line(line)
+                if e is not None:
+                    out.append(e)
+        return out
+
+
+class DnsNamingService(NamingService):
+    def __init__(self, hostport: str):
+        host, _, port = hostport.rpartition(":")
+        self.host = host
+        self.port = int(port)
+
+    def get_servers(self) -> List[ServerEntry]:
+        import socket
+        infos = socket.getaddrinfo(self.host, self.port,
+                                   socket.AF_INET, socket.SOCK_STREAM)
+        eps = sorted({info[4][0] for info in infos})
+        return [ServerEntry(EndPoint(scheme="tcp", host=ip, port=self.port))
+                for ip in eps]
+
+
+class MeshNamingService(NamingService):
+    """Device mesh topology as membership: ici://0..n-1, with the device
+    kind as tag.  On a real pod the mesh shape comes from the runtime, so
+    membership tracks the hardware — no registry to operate."""
+
+    def get_servers(self) -> List[ServerEntry]:
+        from ..ici.mesh import IciMesh
+        mesh = IciMesh.default()
+        return [ServerEntry(mesh.endpoint(i), 100,
+                            tag=str(mesh.device(i)))
+                for i in range(mesh.size)]
+
+
+class ConsulNamingService(NamingService):
+    """GET http://host:port/v1/health/service/<name> (consul-compatible
+    JSON: [{"Service": {"Address": ..., "Port": ...}}, ...]); also accepts a
+    plain JSON list of "host:port" strings for generic HTTP discovery."""
+
+    def __init__(self, rest: str):
+        hostport, _, name = rest.partition("/")
+        self.url = f"http://{hostport}/v1/health/service/{name}"
+
+    def get_servers(self) -> List[ServerEntry]:
+        with urllib.request.urlopen(self.url, timeout=5) as r:
+            data = json.loads(r.read().decode())
+        out = []
+        for item in data:
+            if isinstance(item, str):
+                out.append(ServerEntry(parse_endpoint(item)))
+            else:
+                svc = item.get("Service", {})
+                out.append(ServerEntry(EndPoint(
+                    scheme="tcp", host=svc.get("Address", ""),
+                    port=int(svc.get("Port", 0)))))
+        return out
+
+
+def create_naming_service(url: str) -> NamingService:
+    scheme, _, rest = url.partition("://")
+    if scheme == "list":
+        return ListNamingService(rest)
+    if scheme == "file":
+        return FileNamingService(rest)
+    if scheme in ("dns", "http", "https"):
+        return DnsNamingService(rest)
+    if scheme == "mesh":
+        return MeshNamingService()
+    if scheme == "consul":
+        return ConsulNamingService(rest)
+    raise ValueError(f"unknown naming service scheme {scheme!r}")
+
+
+class NamingServiceThread:
+    """Shared per-url poller (details/naming_service_thread.h:58)."""
+
+    def __init__(self, url: str, filter_fn: Optional[Callable] = None):
+        self.url = url
+        self.ns = create_naming_service(url)
+        self.filter_fn = filter_fn
+        self._watchers: List = []
+        self._lock = threading.Lock()
+        self._last: List[ServerEntry] = []
+        self._have_last = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"ns:{url[:24]}", daemon=True)
+        self._poll_once()
+        self._thread.start()
+
+    def add_watcher(self, watcher) -> None:
+        """watcher has reset_servers(List[ServerEntry])."""
+        with self._lock:
+            self._watchers.append(watcher)
+            if self._have_last:
+                watcher.reset_servers(self._last)
+
+    def remove_watcher(self, watcher) -> None:
+        with self._lock:
+            try:
+                self._watchers.remove(watcher)
+            except ValueError:
+                pass
+
+    def servers(self) -> List[ServerEntry]:
+        with self._lock:
+            return list(self._last)
+
+    def _poll_once(self) -> None:
+        try:
+            entries = self.ns.get_servers()
+        except Exception as e:
+            log.log_every_n(log.WARNING, 60, "naming %s failed: %s",
+                            self.url, e)
+            return
+        if self.filter_fn is not None:
+            entries = [e for e in entries if self.filter_fn(e)]
+        with self._lock:
+            changed = (not self._have_last
+                       or [(str(e.endpoint), e.weight, e.tag) for e in entries]
+                       != [(str(e.endpoint), e.weight, e.tag) for e in self._last])
+            self._last = entries
+            self._have_last = True
+            watchers = list(self._watchers)
+        if changed:
+            for w in watchers:
+                try:
+                    w.reset_servers(entries)
+                except Exception:
+                    pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(_flags.get_flag("ns_poll_interval_s")):
+            self._poll_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+_threads: Dict[str, NamingServiceThread] = {}
+_threads_lock = threading.Lock()
+
+
+def get_naming_service_thread(url: str) -> NamingServiceThread:
+    with _threads_lock:
+        t = _threads.get(url)
+        if t is None:
+            t = NamingServiceThread(url)
+            _threads[url] = t
+        return t

@@ -126,3 +126,12 @@ class Channel:
             sel = getattr(cntl, "_selected_endpoint", None)
             if sel is not None:
                 self._lb.feedback(sel, cntl.error_code_, cntl.latency_us)
+                # circuit breaker + health-check revival (SURVEY.md §5.3)
+                from .circuit_breaker import BreakerRegistry
+                breaker = BreakerRegistry.instance().breaker(sel)
+                if not breaker.on_call_end(cntl.error_code_):
+                    from .health_check import start_health_check
+                    lb = self._lb
+                    lb.exclude(sel, breaker.isolated_until())
+                    start_health_check(
+                        sel, on_revived=lambda ep: lb.exclude(ep, 0.0))

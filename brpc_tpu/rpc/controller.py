@@ -164,6 +164,9 @@ class Controller:
             return
         # send/socket failure or server-pushed error: retry if allowed
         if self._retryable(error_code) and self.current_try < self.max_retry:
+            sel = getattr(self, "_selected_endpoint", None)
+            if sel is not None:
+                self._excluded_servers.add(sel)   # per-call blacklist
             self.current_try += 1
             self.retried_count += 1
             bthread_id.reset_version(self._cid, self.current_try)  # stale old tries

@@ -1,0 +1,116 @@
+"""Health checking: revive failed endpoints.
+
+Reference: src/brpc/details/health_check.{h,cpp} (:42-237) — failed sockets
+are probed periodically (reconnect, or an app-level RPC when
+``health_check_path`` is set); on success the endpoint returns to service
+and its circuit breaker is reset.  Probing runs on the shared TimerThread.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from ..butil.endpoint import EndPoint, SCHEME_MEM, SCHEME_TCP, SCHEME_ICI
+from ..butil import flags as _flags
+from ..butil import logging as log
+from ..bthread.timer_thread import TimerThread
+from .circuit_breaker import BreakerRegistry
+
+_flags.define_flag("health_check_interval_s", 0.1,
+                   "period between health probes of a failed endpoint")
+
+
+def probe_endpoint(ep: EndPoint, timeout: float = 1.0) -> bool:
+    """Transport-level reachability probe (the reference's periodic
+    connect)."""
+    try:
+        if ep.scheme == SCHEME_TCP:
+            import socket
+            with socket.create_connection((ep.host, ep.port), timeout=timeout):
+                return True
+        if ep.scheme == SCHEME_MEM:
+            from .mem_transport import _listeners, _listeners_lock
+            with _listeners_lock:
+                return ep.host in _listeners
+        if ep.scheme == SCHEME_ICI:
+            from ..ici.transport import _listeners as il, _listeners_lock as ill
+            with ill:
+                return ep.device_id in il
+    except OSError:
+        return False
+    return False
+
+
+class HealthCheckTask:
+    """Repeating probe for one endpoint until it revives."""
+
+    def __init__(self, ep: EndPoint,
+                 on_revived: Optional[Callable[[EndPoint], None]] = None,
+                 app_check: Optional[Callable[[EndPoint], bool]] = None,
+                 max_probes: int = 0):
+        self.ep = ep
+        self.on_revived = on_revived
+        self.app_check = app_check          # app-level RPC probe
+        self.probe_count = 0
+        self.max_probes = max_probes        # 0 = unlimited
+        self._cancelled = threading.Event()
+        self._schedule()
+
+    def _schedule(self) -> None:
+        TimerThread.instance().schedule_after(
+            self._probe, _flags.get_flag("health_check_interval_s"))
+
+    def _probe(self) -> None:
+        if self._cancelled.is_set():
+            return
+        self.probe_count += 1
+        ok = probe_endpoint(self.ep)
+        if ok and self.app_check is not None:
+            try:
+                ok = self.app_check(self.ep)
+            except Exception:
+                ok = False
+        if ok:
+            BreakerRegistry.instance().breaker(self.ep).mark_recovered()
+            _unregister(self.ep)
+            if self.on_revived is not None:
+                try:
+                    self.on_revived(self.ep)
+                except Exception:
+                    pass
+            log.info("endpoint %s revived after %d probes", self.ep,
+                     self.probe_count)
+            return
+        if self.max_probes and self.probe_count >= self.max_probes:
+            _unregister(self.ep)
+            return
+        self._schedule()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+        _unregister(self.ep)
+
+
+_tasks: Dict[EndPoint, HealthCheckTask] = {}
+_tasks_lock = threading.Lock()
+
+
+def start_health_check(ep: EndPoint,
+                       on_revived: Optional[Callable] = None,
+                       app_check: Optional[Callable] = None) -> HealthCheckTask:
+    with _tasks_lock:
+        t = _tasks.get(ep)
+        if t is None:
+            t = HealthCheckTask(ep, on_revived, app_check)
+            _tasks[ep] = t
+        return t
+
+
+def _unregister(ep: EndPoint) -> None:
+    with _tasks_lock:
+        _tasks.pop(ep, None)
+
+
+def checking(ep: EndPoint) -> bool:
+    with _tasks_lock:
+        return ep in _tasks

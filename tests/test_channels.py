@@ -1,0 +1,314 @@
+"""Combo-channel tests: Parallel/Partition/Selective over in-process servers
+(the reference's ChannelTest::ParallelChannel pattern,
+test/brpc_channel_unittest.cpp:361-395) + the collective lowering."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc, channels, ici
+from brpc_tpu.rpc import errors
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [100]
+
+
+def unique(prefix):
+    _seq[0] += 1
+    return f"{prefix}-{_seq[0]}"
+
+
+class TaggedEcho(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.calls = 0
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        self.calls += 1
+        response.message = f"{self.tag}:{request.message}"
+        done()
+
+
+def start_server(tag):
+    s = rpc.Server()
+    svc = TaggedEcho(tag)
+    s.add_service(svc)
+    name = unique(tag)
+    assert s.start(f"mem://{name}") == 0
+    return s, svc, f"mem://{name}"
+
+
+class ConcatMerger(channels.ResponseMerger):
+    def merge(self, response, sub_response):
+        response.message = (response.message + "|" + sub_response.message
+                            if response.message else sub_response.message)
+        return self.MERGED
+
+
+class TestParallelChannel:
+    def test_fanout_and_merge(self):
+        servers = [start_server(f"s{i}") for i in range(3)]
+        try:
+            pc = channels.ParallelChannel()
+            for _, _, target in servers:
+                ch = rpc.Channel(); ch.init(target)
+                pc.add_channel(ch, merger=ConcatMerger())
+            cntl = rpc.Controller()
+            resp = EchoResponse()
+            pc.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), resp)
+            assert not cntl.failed(), cntl.error_text
+            parts = sorted(resp.message.split("|"))
+            assert parts == ["s0:x", "s1:x", "s2:x"]
+            assert all(svc.calls == 1 for _, svc, _ in servers)
+        finally:
+            for s, _, _ in servers:
+                s.stop()
+
+    def test_call_mapper_shards_requests(self):
+        servers = [start_server(f"p{i}") for i in range(3)]
+        try:
+            class ShardMapper(channels.CallMapper):
+                def map(self, i, method, request):
+                    return channels.SubCall(
+                        EchoRequest(message=f"{request.message}-part{i}"))
+
+            pc = channels.ParallelChannel()
+            for _, _, target in servers:
+                ch = rpc.Channel(); ch.init(target)
+                pc.add_channel(ch, mapper=ShardMapper(), merger=ConcatMerger())
+            cntl = rpc.Controller()
+            resp = EchoResponse()
+            pc.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="q"), resp)
+            assert sorted(resp.message.split("|")) == [
+                "p0:q-part0", "p1:q-part1", "p2:q-part2"]
+        finally:
+            for s, _, _ in servers:
+                s.stop()
+
+    def test_skip_subcall(self):
+        servers = [start_server(f"k{i}") for i in range(2)]
+        try:
+            class SkipFirst(channels.CallMapper):
+                def map(self, i, method, request):
+                    if i == 0:
+                        return channels.SubCall.skip_call()
+                    return channels.SubCall(request)
+
+            pc = channels.ParallelChannel()
+            for _, _, target in servers:
+                ch = rpc.Channel(); ch.init(target)
+                pc.add_channel(ch, mapper=SkipFirst(), merger=ConcatMerger())
+            cntl = rpc.Controller()
+            resp = EchoResponse()
+            pc.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="m"), resp)
+            assert not cntl.failed()
+            assert resp.message == "k1:m"
+            assert servers[0][1].calls == 0
+        finally:
+            for s, _, _ in servers:
+                s.stop()
+
+    def test_fail_limit(self):
+        s0, svc0, t0 = start_server("ok")
+        try:
+            pc = channels.ParallelChannel(fail_limit=1)
+            ch_ok = rpc.Channel(); ch_ok.init(t0)
+            ch_bad = rpc.Channel()
+            ch_bad.init("mem://nobody", rpc.ChannelOptions(
+                timeout_ms=200, max_retry=0)
+                if False else None)
+            ch_bad.options.timeout_ms = 200
+            ch_bad.options.max_retry = 0
+            pc.add_channel(ch_ok, merger=ConcatMerger())
+            pc.add_channel(ch_bad, merger=ConcatMerger())
+            cntl = rpc.Controller()
+            resp = EchoResponse()
+            pc.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="f"), resp)
+            assert cntl.failed()
+            assert cntl.error_code == errors.ETOOMANYFAILS
+        finally:
+            s0.stop()
+
+    def test_async_fanout(self):
+        servers = [start_server(f"a{i}") for i in range(2)]
+        try:
+            pc = channels.ParallelChannel()
+            for _, _, target in servers:
+                ch = rpc.Channel(); ch.init(target)
+                pc.add_channel(ch, merger=ConcatMerger())
+            done = threading.Event()
+            out = {}
+
+            def cb(cntl):
+                out["resp"] = cntl.response
+                done.set()
+
+            pc.call_method("EchoService.Echo", rpc.Controller(),
+                           EchoRequest(message="y"), EchoResponse(), done=cb)
+            assert done.wait(10)
+            assert len(out["resp"].message.split("|")) == 2
+        finally:
+            for s, _, _ in servers:
+                s.stop()
+
+
+class TestPartitionChannel:
+    def test_partition_fanout(self, tmp_path):
+        servers = [start_server(f"part{i}") for i in range(2)]
+        listing = tmp_path / "cluster"
+        listing.write_text(
+            f"{servers[0][2]} 100 0/2\n{servers[1][2]} 100 1/2\n")
+        try:
+            pc = channels.PartitionChannel()
+            assert pc.init(2, f"file://{listing}",
+                           merger=ConcatMerger()) == 0
+            assert pc.partitions_ready()
+            cntl = rpc.Controller()
+            resp = EchoResponse()
+            pc.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="pt"), resp)
+            assert not cntl.failed(), cntl.error_text
+            assert sorted(resp.message.split("|")) == [
+                "part0:pt", "part1:pt"]
+        finally:
+            for s, _, _ in servers:
+                s.stop()
+
+    def test_partition_parser(self):
+        p = channels.PartitionParser()
+        assert p.parse_from_tag("0/4") == (0, 4)
+        assert p.parse_from_tag("3/4") == (3, 4)
+        assert p.parse_from_tag("4/4") is None
+        assert p.parse_from_tag("x") is None
+
+    def test_dynamic_partition_prefers_bigger_scheme(self, tmp_path):
+        servers = [start_server(f"dp{i}") for i in range(3)]
+        listing = tmp_path / "cluster"
+        # scheme 1: one server covers 1/1; scheme 2: two servers 0/2,1/2
+        listing.write_text(
+            f"{servers[0][2]} 100 0/1\n"
+            f"{servers[1][2]} 100 0/2\n{servers[2][2]} 100 1/2\n")
+        try:
+            dpc = channels.DynamicPartitionChannel()
+            assert dpc.init([1, 2], f"file://{listing}",
+                            merger=ConcatMerger()) == 0
+            oks = 0
+            for _ in range(20):
+                cntl = rpc.Controller()
+                resp = EchoResponse()
+                dpc.call_method("EchoService.Echo", cntl,
+                                EchoRequest(message="d"), resp)
+                if not cntl.failed():
+                    oks += 1
+            assert oks == 20
+            # scheme-2 servers (capacity 2) should see more traffic than
+            # the scheme-1 server (capacity 1)
+            assert (servers[1][1].calls + servers[2][1].calls
+                    ) >= servers[0][1].calls
+        finally:
+            for s, _, _ in servers:
+                s.stop()
+
+
+class TestSelectiveChannel:
+    def test_selects_and_retries_on_other_channel(self):
+        s_ok, svc_ok, t_ok = start_server("live")
+        try:
+            sc = channels.SelectiveChannel()
+            ch_dead = rpc.Channel()
+            ch_dead.init("mem://dead-endpoint")
+            ch_dead.options.timeout_ms = 200
+            ch_dead.options.max_retry = 0
+            ch_ok = rpc.Channel(); ch_ok.init(t_ok)
+            sc.add_channel(ch_dead)
+            sc.add_channel(ch_ok)
+            oks = 0
+            for _ in range(6):
+                cntl = rpc.Controller()
+                resp = sc.call_method("EchoService.Echo", cntl,
+                                      EchoRequest(message="s"), EchoResponse)
+                if not cntl.failed():
+                    oks += 1
+                    assert resp.message == "live:s"
+            assert oks == 6        # every call lands via retry-on-other
+        finally:
+            s_ok.stop()
+
+    def test_all_dead_fails(self):
+        sc = channels.SelectiveChannel()
+        for _ in range(2):
+            ch = rpc.Channel(); ch.init("mem://void")
+            ch.options.timeout_ms = 100
+            ch.options.max_retry = 0
+            sc.add_channel(ch)
+        cntl = rpc.Controller()
+        sc.call_method("EchoService.Echo", cntl, EchoRequest(), EchoResponse)
+        assert cntl.failed()
+
+
+class TestCollectiveLowering:
+    @pytest.fixture(scope="class")
+    def cc(self):
+        import jax
+        mesh = ici.IciMesh(jax.devices())
+        return channels.CollectiveChannel(mesh), mesh
+
+    def test_shard_and_sum_is_distributed_matvec(self, cc):
+        """PartitionChannel semantics: shard the weight, replicate the
+        activation, merge=sum → tensor-parallel matvec in ONE program."""
+        import jax.numpy as jnp
+        ch, mesh = cc
+        n = mesh.size
+        d = 8
+        w = jnp.arange(n * d * d, dtype=jnp.float32).reshape(n, d, d) / 100
+        x = jnp.ones((d,), jnp.float32)
+        ch.register("Shard.PartialMatVec",
+                    lambda w_shard, x_full: w_shard @ x_full,
+                    merge=channels.MERGE_SUM, mapping=channels.MAP_SHARD)
+        y = ch.call("Shard.PartialMatVec", ch.shard(w), ch.replicate(x))
+        expect = np.asarray(w).sum(0) @ np.ones(d, np.float32)
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5)
+
+    def test_gather_merge_collects_all_responses(self, cc):
+        import jax.numpy as jnp
+        ch, mesh = cc
+        n = mesh.size
+        ch.register("Shard.Scale",
+                    lambda idx, row: row * (idx + 1),
+                    merge=channels.MERGE_GATHER, mapping=channels.MAP_SHARD,
+                    takes_index=True)
+        x = jnp.ones((n, 4), jnp.float32)
+        y = ch.call("Shard.Scale", ch.shard(x))
+        expect = np.stack([np.full((4,), i + 1) for i in range(n)])
+        np.testing.assert_allclose(np.asarray(y), expect)
+
+    def test_none_merge_keeps_sharded(self, cc):
+        import jax.numpy as jnp
+        ch, mesh = cc
+        n = mesh.size
+        ch.register("Shard.Double", lambda row: row * 2,
+                    merge=channels.MERGE_NONE, mapping=channels.MAP_SHARD)
+        x = jnp.arange(n * 2, dtype=jnp.float32).reshape(n, 2)
+        y = ch.call("Shard.Double", ch.shard(x))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 2)
+        assert len(y.sharding.device_set) == n   # stayed sharded
+
+    def test_compiled_once_per_shape(self, cc):
+        import jax.numpy as jnp
+        ch, mesh = cc
+        n = mesh.size
+        ch.register("Shard.Id", lambda row: row, merge=channels.MERGE_NONE)
+        x = jnp.ones((n, 3), jnp.float32)
+        ch.call("Shard.Id", ch.shard(x))
+        before = len(ch._compiled)
+        ch.call("Shard.Id", ch.shard(x * 5))
+        assert len(ch._compiled) == before       # cache hit

@@ -1,0 +1,235 @@
+"""Load balancer / naming / limiter / breaker tests (mirrors reference
+test/brpc_load_balancer_unittest.cpp, brpc_naming_service_unittest.cpp,
+brpc_circuit_breaker_unittest.cpp patterns)."""
+import collections
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil.endpoint import parse_endpoint
+from brpc_tpu.policy import load_balancers as lbs
+from brpc_tpu.policy import naming, limiters
+from brpc_tpu.rpc.circuit_breaker import (CircuitBreaker,
+                                          ClusterRecoverPolicy)
+
+EPS = [parse_endpoint(f"10.0.0.{i}:80") for i in range(1, 6)]
+
+
+def make(name, n=3):
+    lb = lbs.create_load_balancer(name)
+    for ep in EPS[:n]:
+        lb.add_server(ep)
+    return lb
+
+
+class TestLoadBalancers:
+    def test_factory_covers_all_nine(self):
+        assert sorted(lbs.list_load_balancers()) == sorted([
+            "rr", "wrr", "random", "wr", "c_murmurhash", "c_md5",
+            "c_ketama", "la", "dynpart"])
+        for name in lbs.list_load_balancers():
+            assert lbs.create_load_balancer(name).server_count() == 0
+
+    def test_rr_even_distribution(self):
+        lb = make("rr")
+        counts = collections.Counter(lb.select_server() for _ in range(300))
+        assert all(abs(c - 100) <= 1 for c in counts.values())
+
+    def test_wrr_respects_weights(self):
+        lb = lbs.create_load_balancer("wrr")
+        lb.add_server(EPS[0], weight=300)
+        lb.add_server(EPS[1], weight=100)
+        counts = collections.Counter(lb.select_server() for _ in range(400))
+        assert 280 <= counts[EPS[0]] <= 320
+        assert 80 <= counts[EPS[1]] <= 120
+
+    def test_random_covers_all(self):
+        lb = make("random")
+        counts = collections.Counter(lb.select_server() for _ in range(600))
+        assert set(counts) == set(EPS[:3])
+        assert all(c > 100 for c in counts.values())
+
+    def test_weighted_random(self):
+        lb = lbs.create_load_balancer("wr")
+        lb.add_server(EPS[0], weight=900)
+        lb.add_server(EPS[1], weight=100)
+        counts = collections.Counter(lb.select_server() for _ in range(1000))
+        assert counts[EPS[0]] > counts[EPS[1]] * 4
+
+    @pytest.mark.parametrize("kind", ["c_murmurhash", "c_md5", "c_ketama"])
+    def test_consistent_hash_stickiness(self, kind):
+        lb = make(kind, n=5)
+
+        class C:
+            request_code = b"user-12345"
+        first = lb.select_server(C())
+        assert all(lb.select_server(C()) == first for _ in range(20))
+
+    def test_consistent_hash_minimal_reshuffle(self):
+        lb = make("c_ketama", n=5)
+
+        class C:
+            def __init__(self, code): self.request_code = code
+        before = {i: lb.select_server(C(b"key-%d" % i)) for i in range(200)}
+        lb.remove_server(EPS[0])
+        after = {i: lb.select_server(C(b"key-%d" % i)) for i in range(200)}
+        moved = sum(1 for i in before if before[i] != after[i])
+        # only keys previously on the removed node move (~1/5 of keys)
+        assert moved < 200 * 0.45
+        assert all(after[i] != EPS[0] for i in after)
+
+    def test_locality_aware_prefers_fast_server(self):
+        lb = make("la", n=2)
+        for _ in range(50):
+            lb.feedback(EPS[0], 0, 100)       # fast
+            lb.feedback(EPS[1], 0, 10000)     # 100x slower
+        counts = collections.Counter(lb.select_server() for _ in range(500))
+        assert counts[EPS[0]] > counts[EPS[1]] * 5
+
+    def test_locality_aware_punishes_errors(self):
+        lb = make("la", n=2)
+        for _ in range(20):
+            lb.feedback(EPS[0], 0, 1000)
+            lb.feedback(EPS[1], 1009, 1000)   # failing
+        assert lb.weight_of(EPS[0]) > lb.weight_of(EPS[1]) * 3
+
+    def test_exclusion_and_fallback(self):
+        lb = make("rr", n=2)
+        lb.exclude(EPS[0], time.monotonic() + 60)
+        assert all(lb.select_server() == EPS[1] for _ in range(10))
+        lb.exclude(EPS[1], time.monotonic() + 60)
+        # everything excluded → serve anyway (cluster recover guard)
+        assert lb.select_server() in (EPS[0], EPS[1])
+
+    def test_membership_changes_during_selection(self):
+        lb = make("rr", n=3)
+        stop = threading.Event()
+        errs = []
+
+        def churn():
+            while not stop.is_set():
+                lb.remove_server(EPS[0])
+                lb.add_server(EPS[0])
+
+        def select():
+            try:
+                for _ in range(2000):
+                    lb.select_server()
+            except Exception as e:
+                errs.append(e)
+
+        t1 = threading.Thread(target=churn)
+        t2 = threading.Thread(target=select)
+        t1.start(); t2.start()
+        t2.join(30); stop.set(); t1.join(5)
+        assert not errs
+
+
+class TestNaming:
+    def test_list_ns(self):
+        ns = naming.create_naming_service("list://10.0.0.1:80,10.0.0.2:81")
+        eps = [e.endpoint for e in ns.get_servers()]
+        assert eps == [parse_endpoint("10.0.0.1:80"),
+                       parse_endpoint("10.0.0.2:81")]
+
+    def test_file_ns_with_tags(self, tmp_path):
+        p = tmp_path / "servers"
+        p.write_text("10.0.0.1:80 100 0/2\n"
+                     "10.0.0.2:80 100 1/2\n"
+                     "# comment\n"
+                     "10.0.0.3:80\n")
+        ns = naming.create_naming_service(f"file://{p}")
+        entries = ns.get_servers()
+        assert len(entries) == 3
+        assert entries[0].tag == "0/2"
+        assert entries[2].tag == ""
+
+    def test_mesh_ns_matches_device_mesh(self):
+        ns = naming.create_naming_service("mesh://")
+        entries = ns.get_servers()
+        import jax
+        assert len(entries) == len(jax.devices())
+        assert entries[0].endpoint == parse_endpoint("ici://0")
+
+    def test_dns_ns_localhost(self):
+        ns = naming.create_naming_service("dns://localhost:1234")
+        entries = ns.get_servers()
+        assert entries and entries[0].endpoint.port == 1234
+
+    def test_ns_thread_pushes_updates(self, tmp_path):
+        p = tmp_path / "servers"
+        p.write_text("10.0.0.1:80\n")
+        got = []
+
+        class Watcher:
+            def reset_servers(self, entries):
+                got.append([str(e.endpoint) for e in entries])
+
+        t = naming.NamingServiceThread(f"file://{p}")
+        t.add_watcher(Watcher())
+        assert got and got[-1] == ["10.0.0.1:80"]
+        p.write_text("10.0.0.1:80\n10.0.0.2:80\n")
+        t._poll_once()
+        assert got[-1] == ["10.0.0.1:80", "10.0.0.2:80"]
+        t.stop()
+
+
+class TestLimiters:
+    def test_constant(self):
+        lim = limiters.ConstantConcurrencyLimiter(2)
+        assert lim.on_requested(0) and lim.on_requested(1)
+        assert not lim.on_requested(2)
+
+    def test_auto_adapts_down_under_overload(self):
+        lim = limiters.AutoConcurrencyLimiter(initial=100,
+                                              sample_window_s=0.0,
+                                              min_sample_count=1)
+        for _ in range(50):
+            lim.on_responded(0, 100)        # establish fast baseline
+        base = lim.max_concurrency()
+        for _ in range(200):
+            lim.on_responded(0, 50000)      # massive latency inflation
+        assert lim.max_concurrency() < max(base, 100)
+
+    def test_timeout_limiter(self):
+        lim = limiters.TimeoutConcurrencyLimiter(timeout_ms=10)
+        for _ in range(20):
+            lim.on_responded(0, 5000)       # 5ms per request
+        assert lim.on_requested(1)
+        assert not lim.on_requested(50)     # 50×5ms queue > 10ms budget
+
+
+class TestCircuitBreaker:
+    def test_trips_on_errors_and_recovers(self):
+        cb = CircuitBreaker()
+        tripped = False
+        for _ in range(30):
+            if not cb.on_call_end(1009):
+                tripped = True
+                break
+        assert tripped
+        assert cb.is_isolated()
+        cb.mark_recovered()
+        assert not cb.is_isolated()
+
+    def test_healthy_traffic_never_trips(self):
+        cb = CircuitBreaker()
+        assert all(cb.on_call_end(0) for _ in range(1000))
+
+    def test_isolation_duration_doubles(self):
+        cb = CircuitBreaker()
+        for _ in range(50):
+            cb.on_call_end(1009)
+        first = cb._isolation_ms
+        cb._isolated_until = 0  # force re-trip eligibility
+        for _ in range(50):
+            cb.on_call_end(1009)
+        assert cb._isolation_ms >= first
+
+    def test_cluster_recover_policy(self):
+        crp = ClusterRecoverPolicy(min_working_instances=2, hold_seconds=0.05)
+        assert crp.on_cluster_size(3, 5)
+        assert not crp.on_cluster_size(1, 5)      # entered recovery
+        time.sleep(0.06)
+        assert crp.on_cluster_size(1, 5)          # hold-off elapsed
