@@ -1,0 +1,163 @@
+"""Fabric benchmark — prints ONE JSON line.
+
+Metric of record (BASELINE.json): echo p50 latency in µs through the full
+RPC stack over the ici:// transport with a device-resident payload.  The
+north-star target is 10 µs chip-to-chip; ``vs_baseline`` reports
+target/measured (1.0 = target met, >1 = beating it).
+
+Secondary numbers (stderr): allreduce bandwidth via the ring path and
+echo QPS under concurrency — the other BASELINE.json configs.
+"""
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+
+def bench_echo_p50(iters: int = 300, payload_bytes: int = 4096):
+    import jax
+    import jax.numpy as jnp
+
+    import brpc_tpu.policy  # registers protocols
+    from brpc_tpu import rpc
+    from brpc_tpu.ici.mesh import IciMesh
+    sys.path.insert(0, "tests")
+    from tests.echo_pb2 import EchoRequest, EchoResponse
+
+    mesh = IciMesh.default()
+
+    class EchoService(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = request.message
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+            done()
+
+    server = rpc.Server()
+    server.add_service(EchoService())
+    server.start("ici://0")
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=10000,
+                                                  max_retry=0))
+    payload = jnp.arange(payload_bytes, dtype=jnp.uint8)
+    payload = jax.device_put(payload, mesh.device(0))
+    jax.block_until_ready(payload)
+
+    lat = []
+    for i in range(iters + 20):
+        cntl = rpc.Controller()
+        cntl.request_attachment.append_device_array(payload)
+        t0 = time.perf_counter_ns()
+        ch.call_method("EchoService.Echo", cntl,
+                       EchoRequest(message="b"), EchoResponse)
+        t1 = time.perf_counter_ns()
+        if cntl.failed():
+            raise RuntimeError(f"echo failed: {cntl.error_text}")
+        if i >= 20:                      # warmup excluded
+            lat.append((t1 - t0) / 1000.0)
+    server.stop()
+    lat.sort()
+    return {
+        "p50_us": lat[len(lat) // 2],
+        "p99_us": lat[int(len(lat) * 0.99)],
+        "mean_us": statistics.fmean(lat),
+    }
+
+
+def bench_allreduce_gbps(size_mb: int = 64):
+    import jax
+    import jax.numpy as jnp
+    from brpc_tpu.ici.mesh import IciMesh
+    from brpc_tpu.ici.collective import Collectives
+
+    mesh = IciMesh.default()
+    n = mesh.size
+    coll = Collectives(mesh)
+    elems = size_mb * 1024 * 1024 // 4
+    x = coll.shard(jnp.ones((n, elems // n if n > 1 else elems), jnp.float32))
+    out = coll.all_reduce(x); jax.block_until_ready(out)   # compile+warm
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = coll.all_reduce(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    nbytes = x.size * 4
+    return {"allreduce_gbps": nbytes / dt / 1e9, "bytes": nbytes,
+            "devices": n}
+
+
+def bench_qps(seconds: float = 2.0, concurrency: int = 32):
+    import brpc_tpu.policy
+    from brpc_tpu import rpc
+    sys.path.insert(0, "tests")
+    from tests.echo_pb2 import EchoRequest, EchoResponse
+    import threading
+
+    class EchoService(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = request.message
+            done()
+
+    server = rpc.Server()
+    server.add_service(EchoService())
+    server.start("mem://bench-qps")
+    ch = rpc.Channel()
+    ch.init("mem://bench-qps", options=rpc.ChannelOptions(timeout_ms=10000))
+    count = [0]
+    lock = threading.Lock()
+    stop = time.monotonic() + seconds
+
+    def worker():
+        while time.monotonic() < stop:
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="q"), EchoResponse)
+            if not cntl.failed():
+                with lock:
+                    count[0] += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads: t.start()
+    for t in threads: t.join()
+    dt = time.monotonic() - t0
+    server.stop()
+    return {"qps": count[0] / dt, "concurrency": concurrency}
+
+
+def main() -> None:
+    echo = bench_echo_p50()
+    print(f"# echo: {echo}", file=sys.stderr)
+    try:
+        ar = bench_allreduce_gbps()
+        print(f"# allreduce: {ar}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# allreduce failed: {e}", file=sys.stderr)
+        ar = {}
+    try:
+        qps = bench_qps()
+        print(f"# qps: {qps}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# qps failed: {e}", file=sys.stderr)
+        qps = {}
+    target_us = 10.0
+    print(json.dumps({
+        "metric": "ici echo p50 latency (4KB device payload, full RPC stack)",
+        "value": round(echo["p50_us"], 1),
+        "unit": "us",
+        "vs_baseline": round(target_us / echo["p50_us"], 4),
+        "extra": {
+            "echo_p99_us": round(echo["p99_us"], 1),
+            "allreduce_gbps": round(ar.get("allreduce_gbps", 0.0), 3),
+            "qps": round(qps.get("qps", 0.0), 0),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
