@@ -100,6 +100,10 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
     service, _, method_name = method_full_name.rpartition(".")
     meta.request.service_name = service
     meta.request.method_name = method_name
+    if cntl.stream_creator is not None:     # stream handshake rides the RPC
+        meta.stream_settings.stream_id = cntl.stream_creator.sid
+        meta.stream_settings.frame_type = 4
+        meta.stream_settings.need_feedback = True
     meta.request.log_id = cntl.log_id
     meta.correlation_id = cid
     meta.compress_type = cntl.compress_type
@@ -123,11 +127,20 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
 def process_response(msg: StdMessage, socket) -> None:
     """ProcessRpcResponse: lock the correlation id; stale versions fail to
     lock and the response is dropped (the retry-race resolution)."""
+    if msg.meta.correlation_id == 0 and msg.meta.HasField("stream_settings"):
+        from ..rpc.stream import on_stream_frame
+        on_stream_frame(msg.meta, msg.body, socket)
+        return
     cid = msg.meta.correlation_id
     rc, cntl = bthread_id.lock(cid)
     if rc != 0 or cntl is None:
         return                      # stale/duplicate/cancelled — ignore
     cntl.remote_side = socket.remote_side
+    if (msg.meta.HasField("stream_settings")
+            and cntl.stream_creator is not None):
+        # handshake completion: server accepted our stream
+        cntl.stream_creator.mark_connected(
+            msg.meta.stream_settings.remote_stream_id, socket)
     cntl.handle_response(cid, msg.meta, msg.body)
 
 
@@ -137,6 +150,10 @@ def process_request(msg: StdMessage, socket, server) -> None:
     """ProcessRpcRequest (baidu_rpc_protocol.cpp:312): find method, check
     limits, run user code in this tasklet, respond via socket write."""
     meta = msg.meta
+    if not meta.request.service_name and meta.HasField("stream_settings"):
+        from ..rpc.stream import on_stream_frame
+        on_stream_frame(meta, msg.body, socket)
+        return
     req_meta = meta.request
     full_name = f"{req_meta.service_name}.{req_meta.method_name}"
     cid = meta.correlation_id
@@ -160,6 +177,15 @@ def process_request(msg: StdMessage, socket, server) -> None:
         rmeta.correlation_id = cid
         rmeta.response.error_code = cntl.error_code_
         rmeta.response.error_text = cntl.error_text_
+        if cntl.accepted_stream_id and not cntl.failed():
+            # complete the stream handshake: echo ids both ways
+            from ..rpc.stream import find_stream
+            srv_stream = find_stream(cntl.accepted_stream_id)
+            client_sid = meta.stream_settings.stream_id
+            if srv_stream is not None:
+                rmeta.stream_settings.stream_id = client_sid
+                rmeta.stream_settings.remote_stream_id = cntl.accepted_stream_id
+                srv_stream.mark_connected(client_sid, socket)
         payload = IOBuf()
         if resp is not None and not cntl.failed():
             data = resp.SerializeToString() if hasattr(resp, "SerializeToString") \

@@ -13,3 +13,7 @@ from .socket_map import SocketMap
 from .method_status import MethodStatus
 from . import compress
 from . import span
+from .stream import (Stream, StreamOptions, StreamInputHandler, stream_create,
+                     stream_accept, find_stream)
+from .circuit_breaker import CircuitBreaker, ClusterRecoverPolicy, BreakerRegistry
+from .health_check import start_health_check, probe_endpoint, HealthCheckTask

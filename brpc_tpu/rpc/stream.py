@@ -1,0 +1,266 @@
+"""Streaming RPC: ordered byte/message streams attached to an RPC.
+
+Reference: src/brpc/stream.{h,cpp} + policy/streaming_rpc_protocol.cpp
+(SURVEY.md §3.4).  Semantics kept:
+
+  * StreamCreate (client, stream.cpp:732) / StreamAccept (server, :756):
+    the stream rides the host RPC's connection; ids are exchanged through
+    RpcMeta.stream_settings (the reference's handshake).
+  * Sliding window with consumed-bytes feedback: a writer may have at most
+    ``max_buf_size`` unconsumed bytes in flight (AppendIfNotFull :274);
+    the receiver reports consumption watermarks (SendFeedback :572) which
+    wake blocked writers (SetRemoteConsumed :307).
+  * Delivery through a per-stream ExecutionQueue so user handlers see
+    ordered batches without blocking the socket reader (Consume :526).
+
+Frames are tpu_std RpcMeta envelopes with ``stream_settings.frame_type``:
+DATA / FEEDBACK / CLOSE; tpu_std routes them here from both server and
+client parse paths.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..butil.iobuf import IOBuf
+from ..butil.resource_pool import ResourcePool
+from ..bthread.butex import Butex
+from ..bthread.execution_queue import ExecutionQueue
+from . import errors
+
+FRAME_DATA = 0
+FRAME_FEEDBACK = 1
+FRAME_RST = 2
+FRAME_CLOSE = 3
+
+DEFAULT_MAX_BUF_SIZE = 2 * 1024 * 1024
+
+
+class StreamOptions:
+    def __init__(self, handler: Optional["StreamInputHandler"] = None,
+                 max_buf_size: int = DEFAULT_MAX_BUF_SIZE,
+                 messages_in_batch: int = 64):
+        self.handler = handler
+        self.max_buf_size = max_buf_size
+        self.messages_in_batch = messages_in_batch
+
+
+class StreamInputHandler:
+    """User callback interface (reference StreamInputHandler)."""
+
+    def on_received_messages(self, stream_id: int,
+                             messages: List[IOBuf]) -> None:
+        raise NotImplementedError
+
+    def on_idle_timeout(self, stream_id: int) -> None:
+        pass
+
+    def on_closed(self, stream_id: int) -> None:
+        pass
+
+
+class Stream:
+    def __init__(self, options: StreamOptions, is_client: bool):
+        self.options = options
+        self.is_client = is_client
+        self.sid: int = 0               # local id (pool id)
+        self.remote_sid: int = 0        # peer's id, set after handshake
+        self.socket = None              # host connection
+        self.connected = False
+        self._conn_butex = Butex(0)
+        # flow control (sender side)
+        self._produced = 0
+        self._remote_consumed = 0
+        self._flow_lock = threading.Lock()
+        self._writable_butex = Butex(0)
+        # receiver side
+        self._local_consumed = 0
+        self._last_feedback = 0
+        self.closed = False
+        self._seq = 0
+        self._exec: Optional[ExecutionQueue] = None
+
+    # -- sender ---------------------------------------------------------
+    def writable_bytes(self) -> int:
+        with self._flow_lock:
+            return self.options.max_buf_size - (self._produced
+                                                - self._remote_consumed)
+
+    def append_if_not_full(self, data: IOBuf) -> int:
+        """0 ok; EAGAIN window full; EINVAL closed (stream.cpp:274)."""
+        n = len(data)
+        with self._flow_lock:
+            if self.closed:
+                return errors.EINVAL
+            if self._produced - self._remote_consumed + n \
+                    > self.options.max_buf_size:
+                return errors.EAGAIN
+            self._produced += n
+        self._send_frame(FRAME_DATA, data)
+        return 0
+
+    def write(self, data: IOBuf, timeout: Optional[float] = None) -> int:
+        """Blocking write: waits for window space (StreamWrite +
+        StreamWait)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            rc = self.append_if_not_full(data)
+            if rc != errors.EAGAIN:
+                return rc
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return errors.ETIMEDOUT
+            self._writable_butex.set_value(0)
+            if self.writable_bytes() > len(data) or self.closed:
+                continue
+            self._writable_butex.wait(0, remaining if remaining is not None
+                                      else 1.0)
+
+    def set_remote_consumed(self, consumed: int) -> None:
+        """Feedback arrival: wake blocked writers (stream.cpp:307)."""
+        with self._flow_lock:
+            if consumed > self._remote_consumed:
+                self._remote_consumed = consumed
+        self._writable_butex.wake_all_and_set(1)
+
+    # -- receiver -------------------------------------------------------
+    def on_data(self, data: IOBuf) -> None:
+        if self._exec is None:
+            self._exec = ExecutionQueue(self._consume_batch)
+        self._exec.execute(data)
+
+    def _consume_batch(self, it) -> None:
+        msgs = [m for m in it]
+        if not msgs:
+            return
+        handler = self.options.handler
+        if handler is not None:
+            try:
+                handler.on_received_messages(self.sid, msgs)
+            except Exception:
+                from ..butil import logging as log
+                log.error("stream handler raised", exc_info=True)
+        consumed = sum(len(m) for m in msgs)
+        self._local_consumed += consumed
+        # feedback when half a window was consumed since the last report
+        if (self._local_consumed - self._last_feedback
+                >= self.options.max_buf_size // 2):
+            self.send_feedback()
+
+    def send_feedback(self) -> None:
+        self._last_feedback = self._local_consumed
+        self._send_frame(FRAME_FEEDBACK, None,
+                         consumed_bytes=self._local_consumed)
+
+    # -- lifecycle ------------------------------------------------------
+    def wait_connected(self, timeout: float = 10.0) -> bool:
+        if self.connected:
+            return True
+        self._conn_butex.wait(0, timeout)
+        return self.connected
+
+    def mark_connected(self, remote_sid: int, socket) -> None:
+        self.remote_sid = remote_sid
+        self.socket = socket
+        self.connected = True
+        self._conn_butex.wake_all_and_set(1)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        if self.connected:
+            try:
+                self._send_frame(FRAME_CLOSE, None)
+            except Exception:
+                pass
+        self._on_closed_local()
+
+    def _on_closed_local(self) -> None:
+        self._writable_butex.wake_all_and_set(1)
+        if self._exec is not None:
+            self._exec.stop()
+        h = self.options.handler
+        if h is not None:
+            try:
+                h.on_closed(self.sid)
+            except Exception:
+                pass
+        _pool_remove(self.sid)
+
+    def on_remote_close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._on_closed_local()
+
+    # -- wire -----------------------------------------------------------
+    def _send_frame(self, frame_type: int, data: Optional[IOBuf],
+                    consumed_bytes: int = 0) -> None:
+        from ..proto import rpc_meta_pb2 as meta_pb
+        from ..policy.tpu_std import pack_frame
+        if self.socket is None:
+            raise ConnectionError("stream not connected")
+        meta = meta_pb.RpcMeta()
+        ss = meta.stream_settings
+        ss.stream_id = self.remote_sid       # addressed to receiver's id
+        ss.remote_stream_id = self.sid
+        ss.frame_type = frame_type
+        self._seq += 1
+        ss.frame_seq = self._seq
+        if consumed_bytes:
+            ss.consumed_bytes = consumed_bytes
+        payload = data if data is not None else IOBuf()
+        rc = self.socket.write(pack_frame(meta, payload))
+        if rc != 0:
+            raise ConnectionError(f"stream write failed: {rc}")
+
+
+# ---- stream registry (versioned ids like SocketId) ---------------------
+
+_streams: ResourcePool = ResourcePool()
+_registry_lock = threading.Lock()
+
+
+def _pool_remove(sid: int) -> None:
+    _streams.return_resource(sid)
+
+
+def stream_create(cntl, options: Optional[StreamOptions] = None) -> Stream:
+    """Client side, before issuing the host RPC (StreamCreate
+    stream.cpp:732)."""
+    s = Stream(options or StreamOptions(), is_client=True)
+    s.sid = _streams.get_resource(s)
+    cntl.stream_creator = s
+    return s
+
+
+def stream_accept(cntl, options: Optional[StreamOptions] = None) -> Stream:
+    """Server side, inside the handler before done() (StreamAccept
+    stream.cpp:756)."""
+    s = Stream(options or StreamOptions(), is_client=False)
+    s.sid = _streams.get_resource(s)
+    cntl.accepted_stream_id = s.sid
+    return s
+
+
+def find_stream(sid: int) -> Optional[Stream]:
+    return _streams.address(sid)
+
+
+def on_stream_frame(meta, body: IOBuf, socket) -> None:
+    """Entry from tpu_std for frames carrying stream_settings."""
+    ss = meta.stream_settings
+    s = find_stream(ss.stream_id)
+    if s is None:
+        return                           # stale frame for a closed stream
+    if not s.connected:
+        s.mark_connected(ss.remote_stream_id, socket)
+    if ss.frame_type == FRAME_DATA:
+        s.on_data(body)
+    elif ss.frame_type == FRAME_FEEDBACK:
+        s.set_remote_consumed(ss.consumed_bytes)
+    elif ss.frame_type in (FRAME_CLOSE, FRAME_RST):
+        s.on_remote_close()

@@ -1,0 +1,209 @@
+"""Streaming RPC tests (reference streaming_echo example +
+test/brpc_streaming_rpc_unittest.cpp patterns)."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [500]
+
+
+def unique(p="strm"):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class Collector(rpc.StreamInputHandler):
+    def __init__(self):
+        self.messages = []
+        self.closed = threading.Event()
+        self.lock = threading.Lock()
+
+    def on_received_messages(self, sid, msgs):
+        with self.lock:
+            self.messages.extend(m.to_bytes() for m in msgs)
+
+    def on_closed(self, sid):
+        self.closed.set()
+
+
+class StreamingEchoService(rpc.Service):
+    """Accepts a stream and echoes every chunk back on it."""
+
+    def __init__(self):
+        self.server_streams = []
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def StartStream(self, cntl, request, response, done):
+        outer = self
+
+        class EchoBack(rpc.StreamInputHandler):
+            def __init__(self):
+                self.stream = None
+
+            def on_received_messages(self, sid, msgs):
+                for m in msgs:
+                    self.stream.write(IOBuf(b"echo:" + m.to_bytes()))
+
+            def on_closed(self, sid):
+                pass
+
+        h = EchoBack()
+        stream = rpc.stream_accept(cntl, rpc.StreamOptions(handler=h))
+        h.stream = stream
+        outer.server_streams.append(stream)
+        response.message = "accepted"
+        done()
+
+
+def start_streaming_server():
+    server = rpc.Server()
+    svc = StreamingEchoService()
+    server.add_service(svc)
+    name = unique()
+    assert server.start(f"mem://{name}") == 0
+    return server, svc, f"mem://{name}"
+
+
+class TestStreaming:
+    def test_handshake_and_bidirectional_data(self):
+        server, svc, target = start_streaming_server()
+        try:
+            ch = rpc.Channel(); ch.init(target)
+            collector = Collector()
+            cntl = rpc.Controller()
+            stream = rpc.stream_create(cntl, rpc.StreamOptions(handler=collector))
+            resp = ch.call_method("StreamingEchoService.StartStream", cntl,
+                                  EchoRequest(message="s"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "accepted"
+            assert stream.wait_connected(5)
+            for i in range(5):
+                assert stream.write(IOBuf(b"chunk%d" % i)) == 0
+            deadline = time.time() + 10
+            while len(collector.messages) < 5 and time.time() < deadline:
+                time.sleep(0.01)
+            assert sorted(collector.messages) == [
+                b"echo:chunk%d" % i for i in range(5)]
+            stream.close()
+        finally:
+            server.stop()
+
+    def test_window_blocks_and_feedback_unblocks(self):
+        server, svc, target = start_streaming_server()
+        try:
+            ch = rpc.Channel(); ch.init(target)
+            collector = Collector()
+            cntl = rpc.Controller()
+            # tiny window: 100 bytes
+            stream = rpc.stream_create(
+                cntl, rpc.StreamOptions(handler=collector, max_buf_size=100))
+            ch.call_method("StreamingEchoService.StartStream", cntl,
+                           EchoRequest(message="s"), EchoResponse)
+            assert stream.wait_connected(5)
+            big = IOBuf(b"x" * 80)
+            assert stream.append_if_not_full(big) == 0
+            # window now 80/100 full; another 80 must be rejected
+            assert stream.append_if_not_full(IOBuf(b"y" * 80)) == errors.EAGAIN
+            # feedback from server consumption unblocks
+            stream.set_remote_consumed(80)
+            assert stream.append_if_not_full(IOBuf(b"y" * 80)) == 0
+            stream.close()
+        finally:
+            server.stop()
+
+    def test_blocking_write_waits_for_credits(self):
+        server, svc, target = start_streaming_server()
+        try:
+            ch = rpc.Channel(); ch.init(target)
+            cntl = rpc.Controller()
+            stream = rpc.stream_create(
+                cntl, rpc.StreamOptions(handler=Collector(), max_buf_size=64))
+            ch.call_method("StreamingEchoService.StartStream", cntl,
+                           EchoRequest(message="s"), EchoResponse)
+            assert stream.wait_connected(5)
+            assert stream.write(IOBuf(b"a" * 60)) == 0
+            t = threading.Thread(
+                target=lambda: stream.set_remote_consumed(60))
+            done = []
+
+            def blocked_write():
+                done.append(stream.write(IOBuf(b"b" * 60), timeout=10))
+
+            w = threading.Thread(target=blocked_write)
+            w.start()
+            time.sleep(0.05)
+            assert not done          # still blocked on window
+            t.start(); t.join()
+            w.join(10)
+            assert done == [0]
+            stream.close()
+        finally:
+            server.stop()
+
+    def test_close_propagates_to_peer(self):
+        server, svc, target = start_streaming_server()
+        try:
+            ch = rpc.Channel(); ch.init(target)
+            collector = Collector()
+            cntl = rpc.Controller()
+            stream = rpc.stream_create(cntl,
+                                       rpc.StreamOptions(handler=collector))
+            ch.call_method("StreamingEchoService.StartStream", cntl,
+                           EchoRequest(message="s"), EchoResponse)
+            assert stream.wait_connected(5)
+            srv_stream = svc.server_streams[-1]
+            stream.close()
+            deadline = time.time() + 5
+            while not srv_stream.closed and time.time() < deadline:
+                time.sleep(0.01)
+            assert srv_stream.closed
+        finally:
+            server.stop()
+
+    def test_write_after_close_fails(self):
+        server, svc, target = start_streaming_server()
+        try:
+            ch = rpc.Channel(); ch.init(target)
+            cntl = rpc.Controller()
+            stream = rpc.stream_create(cntl,
+                                       rpc.StreamOptions(handler=Collector()))
+            ch.call_method("StreamingEchoService.StartStream", cntl,
+                           EchoRequest(message="s"), EchoResponse)
+            assert stream.wait_connected(5)
+            stream.close()
+            assert stream.append_if_not_full(IOBuf(b"z")) == errors.EINVAL
+        finally:
+            server.stop()
+
+    def test_stream_over_tcp(self):
+        server = rpc.Server()
+        svc = StreamingEchoService()
+        server.add_service(svc)
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            ch = rpc.Channel(); ch.init(f"127.0.0.1:{server.listen_port}")
+            collector = Collector()
+            cntl = rpc.Controller()
+            stream = rpc.stream_create(cntl,
+                                       rpc.StreamOptions(handler=collector))
+            ch.call_method("StreamingEchoService.StartStream", cntl,
+                           EchoRequest(message="s"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert stream.wait_connected(5)
+            for i in range(3):
+                assert stream.write(IOBuf(b"tcp%d" % i)) == 0
+            deadline = time.time() + 10
+            while len(collector.messages) < 3 and time.time() < deadline:
+                time.sleep(0.01)
+            assert sorted(collector.messages) == [b"echo:tcp0",
+                                                  b"echo:tcp1", b"echo:tcp2"]
+            stream.close()
+        finally:
+            server.stop()
