@@ -1,0 +1,2 @@
+"""Codec side-libraries (reference: src/json2pb/, SURVEY.md §2.7)."""
+from . import json2pb

@@ -1,0 +1,57 @@
+"""JSON ↔ protobuf transcoding (reference: src/json2pb/ json_to_pb.h,
+pb_to_json.h).  Drives HTTP/JSON access to pb services.  Built on
+google.protobuf.json_format with the reference's option surface
+(bytes_to_base64, enum_as_number, jsonify_empty_array &c. map onto
+json_format's flags)."""
+from __future__ import annotations
+
+import json
+from typing import Any, Optional, Tuple, Type
+
+from google.protobuf import json_format
+
+
+class Pb2JsonOptions:
+    def __init__(self, bytes_to_base64: bool = True,
+                 jsonify_empty_array: bool = True,
+                 always_print_primitive_fields: bool = False,
+                 enum_option_as_number: bool = False):
+        self.bytes_to_base64 = bytes_to_base64
+        self.jsonify_empty_array = jsonify_empty_array
+        self.always_print_primitive_fields = always_print_primitive_fields
+        self.enum_option_as_number = enum_option_as_number
+
+
+def pb_to_json(message: Any,
+               options: Optional[Pb2JsonOptions] = None) -> Tuple[bool, str]:
+    options = options or Pb2JsonOptions()
+    try:
+        out = json_format.MessageToJson(
+            message,
+            preserving_proto_field_name=True,
+            always_print_fields_with_no_presence=options.always_print_primitive_fields,
+            use_integers_for_enums=options.enum_option_as_number,
+            indent=None)
+        return True, out
+    except Exception as e:
+        return False, str(e)
+
+
+def json_to_pb(json_str: str, message_cls: Type) -> Tuple[bool, Any, str]:
+    """Returns (ok, message, error)."""
+    msg = message_cls()
+    try:
+        json_format.Parse(json_str, msg, ignore_unknown_fields=True)
+        return True, msg, ""
+    except Exception as e:
+        return False, None, str(e)
+
+
+def pb_to_dict(message: Any) -> dict:
+    return json_format.MessageToDict(message, preserving_proto_field_name=True)
+
+
+def dict_to_pb(d: dict, message_cls: Type) -> Any:
+    msg = message_cls()
+    json_format.ParseDict(d, msg, ignore_unknown_fields=True)
+    return msg

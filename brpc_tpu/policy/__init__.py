@@ -5,3 +5,4 @@ from . import tpu_std
 from . import limiters
 from . import load_balancers
 from . import naming
+from . import http

@@ -1,0 +1,272 @@
+"""HTTP/1.1 protocol: JSON access to pb services + the builtin admin pages.
+
+Reference: src/brpc/policy/http_rpc_protocol.cpp (+ details/http_parser,
+http_message) — the same server port speaks HTTP next to tpu_std thanks to
+protocol detection (text method prefix vs "TRPC" magic).  Routes:
+
+  * ``POST /ServiceName/MethodName`` with a JSON body → the pb method
+    (json2pb both ways), mirroring the reference's /Service/Method mapping.
+  * ``GET /status|/vars|/flags|/connections|/rpcz|/brpc_metrics|...`` →
+    builtin admin pages (builtin/services.py).
+  * anything else → 404.
+
+Client side: ``Channel.init(..., options.protocol="http")`` issues HTTP
+requests with pb-JSON bodies and parses responses, completing the same
+Controller machinery (correlation by pipeline order — HTTP/1.1 on one
+connection answers in order, the reference's behavior without h2).
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..butil.containers import CaseIgnoredFlatMap
+from ..butil.iobuf import IOBuf
+from ..codec import json2pb
+from ..proto import rpc_meta_pb2 as meta_pb
+from ..rpc import errors
+from ..rpc.controller import Controller
+from ..rpc.protocol import (Protocol, ParseResult, ParseResultType,
+                            register_protocol)
+
+_METHODS = (b"GET ", b"POST", b"PUT ", b"DELE", b"HEAD", b"OPTI", b"PATC",
+            b"HTTP")
+
+
+class HttpMessage:
+    def __init__(self):
+        self.is_request = True
+        self.method = "GET"
+        self.path = "/"
+        self.query: Dict[str, str] = {}
+        self.status = 200
+        self.reason = "OK"
+        self.headers: CaseIgnoredFlatMap = CaseIgnoredFlatMap()
+        self.body = b""
+
+
+def _parse_http(source: IOBuf) -> ParseResult:
+    head = source.fetch(4)
+    if head is None:
+        return ParseResult.not_enough_data()
+    if not any(head == m[:len(head)] or head.startswith(m.strip())
+               for m in _METHODS):
+        return ParseResult.try_others()
+    data = source.fetch(len(source))
+    sep = data.find(b"\r\n\r\n")
+    if sep < 0:
+        if len(data) > 1 << 20:
+            return ParseResult.parse_error("header too large")
+        return ParseResult.not_enough_data()
+    header_bytes = data[:sep]
+    lines = header_bytes.split(b"\r\n")
+    msg = HttpMessage()
+    first = lines[0].decode("latin1")
+    parts = first.split(" ")
+    if first.startswith("HTTP/"):
+        msg.is_request = False
+        msg.status = int(parts[1])
+        msg.reason = " ".join(parts[2:]) if len(parts) > 2 else ""
+    else:
+        msg.is_request = True
+        msg.method = parts[0]
+        target = parts[1] if len(parts) > 1 else "/"
+        parsed = urllib.parse.urlsplit(target)
+        msg.path = parsed.path
+        msg.query = dict(urllib.parse.parse_qsl(parsed.query))
+    for line in lines[1:]:
+        k, _, v = line.decode("latin1").partition(":")
+        msg.headers[k.strip()] = v.strip()
+    length = int(msg.headers.get("Content-Length", "0") or 0)
+    total = sep + 4 + length
+    if len(data) < total:
+        return ParseResult.not_enough_data()
+    msg.body = data[sep + 4:total]
+    source.pop_front(total)
+    return ParseResult.ok(msg)
+
+
+def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
+    return _parse_http(source)
+
+
+def _render_response(status: int, body: bytes, content_type: str,
+                     extra_headers: Optional[Dict[str, str]] = None) -> IOBuf:
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              500: "Internal Server Error", 503: "Service Unavailable"}.get(
+                  status, "OK")
+    out = IOBuf()
+    head = [f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}"]
+    for k, v in (extra_headers or {}).items():
+        head.append(f"{k}: {v}")
+    out.append(("\r\n".join(head) + "\r\n\r\n").encode())
+    out.append(body)
+    return out
+
+
+# ---- server side ------------------------------------------------------
+
+def process_request(msg: HttpMessage, socket, server) -> None:
+    start_us = time.monotonic_ns() // 1000
+    path = msg.path.strip("/")
+    # 1) builtin pages
+    builtin = getattr(server, "_builtin", None)
+    if builtin is not None:
+        hit = builtin.dispatch(path or "index", dict(msg.query))
+        if hit is not None:
+            ctype, body = hit
+            socket.write(_render_response(200, body.encode(), ctype))
+            return
+    # 2) /Service/Method JSON RPC
+    parts = [p for p in path.split("/") if p]
+    if len(parts) == 2:
+        full_name = f"{parts[0]}.{parts[1]}"
+        md = server.find_method(full_name)
+        if md is not None:
+            _process_json_rpc(msg, socket, server, md, full_name, start_us)
+            return
+    socket.write(_render_response(
+        404, json.dumps({"error": f"no handler for /{path}"}).encode(),
+        "application/json"))
+
+
+def _process_json_rpc(msg: HttpMessage, socket, server, md, full_name,
+                      start_us) -> None:
+    cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = socket.remote_side
+    status = server.method_status(full_name)
+    if status is not None and not status.on_requested():
+        socket.write(_render_response(
+            503, b'{"error":"concurrency limit"}', "application/json"))
+        return
+
+    def finish(code: int, body: bytes) -> None:
+        socket.write(_render_response(code, body, "application/json"))
+        if status is not None:
+            status.on_responded(0 if code == 200 else code,
+                                time.monotonic_ns() // 1000 - start_us)
+
+    body = msg.body.decode("utf-8", "replace") if msg.body else "{}"
+    if msg.is_request and msg.method == "GET" and msg.query:
+        body = json.dumps(msg.query)
+    ok, request, err = json2pb.json_to_pb(body, md.request_cls)
+    if not ok:
+        finish(400, json.dumps({"error": f"bad request JSON: {err}"}).encode())
+        return
+    response = md.response_cls()
+    done_called = [False]
+
+    def done() -> None:
+        if done_called[0]:
+            return
+        done_called[0] = True
+        if cntl.failed():
+            finish(500, json.dumps({"error": cntl.error_text_,
+                                    "code": cntl.error_code_}).encode())
+        else:
+            ok2, js = json2pb.pb_to_json(response)
+            finish(200 if ok2 else 500, js.encode())
+
+    cntl.set_server_done(done)
+    try:
+        md.fn(cntl, request, response, done)
+    except Exception as e:
+        if not done_called[0]:
+            cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
+            done()
+
+
+# ---- client side ------------------------------------------------------
+
+def serialize_request(request: Any, cntl: Controller) -> IOBuf:
+    buf = IOBuf()
+    if request is None:
+        return buf
+    if hasattr(request, "SerializeToString"):
+        ok, js = json2pb.pb_to_json(request)
+        if not ok:
+            raise ValueError(f"cannot jsonify request: {js}")
+        buf.append(js)
+    elif isinstance(request, (bytes, bytearray, str)):
+        buf.append(request)
+    else:
+        buf.append(json.dumps(request))
+    return buf
+
+
+def pack_request(payload: IOBuf, cid: int, cntl: Controller,
+                 method_full_name: str) -> IOBuf:
+    service, _, method = method_full_name.rpartition(".")
+    body = payload.to_bytes()
+    out = IOBuf()
+    host = str(cntl.remote_side) if cntl.remote_side else "localhost"
+    out.append(f"POST /{service}/{method} HTTP/1.1\r\n"
+               f"Host: {host}\r\n"
+               f"Content-Type: application/json\r\n"
+               f"Content-Length: {len(body)}\r\n"
+               f"X-Correlation-Id: {cid}\r\n\r\n".encode())
+    out.append(body)
+    return out
+
+
+def process_response(msg: HttpMessage, socket) -> None:
+    """HTTP/1.1 single connection answers in order: correlate with the
+    oldest in-flight call on this socket (pipelined_contexts)."""
+    from ..bthread import id as bthread_id
+    ctx = socket.pop_pipelined_context()
+    if ctx is None:
+        return
+    cid = ctx
+    rc, cntl = bthread_id.lock(cid)
+    if rc != 0 or cntl is None:
+        return
+    meta = meta_pb.RpcMeta()
+    if msg.status != 200:
+        try:
+            err = json.loads(msg.body or b"{}")
+        except Exception:
+            err = {}
+        meta.response.error_code = int(err.get("code", errors.EHTTP))
+        meta.response.error_text = err.get("error",
+                                           f"HTTP {msg.status} {msg.reason}")
+        cntl.handle_response(cid, meta, IOBuf())
+        return
+    if cntl._response_cls is not None:
+        ok, resp, err = json2pb.json_to_pb(
+            msg.body.decode("utf-8", "replace"), cntl._response_cls)
+        if not ok:
+            meta.response.error_code = errors.ERESPONSE
+            meta.response.error_text = f"bad response JSON: {err}"
+            cntl.handle_response(cid, meta, IOBuf())
+            return
+        cntl.response = resp
+        cntl._parsed_response = resp
+    body = IOBuf()
+    body.append(msg.body)
+    cntl._http_ok_body = msg.body
+    cntl.handle_parsed_http_response(cid, msg)
+
+
+PROTOCOL = Protocol(
+    name="http",
+    parse=parse,
+    process_request=process_request,
+    process_response=process_response,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    pipelined=True,
+)
+
+
+def _register() -> None:
+    from ..rpc.protocol import find_protocol
+    if find_protocol("http") is None:
+        register_protocol(PROTOCOL)
+
+
+_register()

@@ -88,6 +88,8 @@ class Channel:
         if cntl.span is not None:
             cntl.span.annotate("issue try=%d to %s" % (cntl.current_try,
                                                        sock.remote_side))
+        if self._protocol.pipelined:
+            sock.push_pipelined_context(cid)
         rc = sock.write(packet, notify_cid=cid)
         if rc != 0:
             raise ConnectionError(f"write failed: {rc}")

@@ -224,6 +224,12 @@ class Controller:
             self.set_failed(errors.ERESPONSE, f"fail to parse response: {e}")
         self._end_rpc(cid)
 
+    def handle_parsed_http_response(self, cid: int, http_msg) -> None:
+        """HTTP client completion: response object was already parsed by the
+        protocol (json2pb); just record and finish."""
+        self.http_response = http_msg
+        self._end_rpc(cid)
+
     def _end_rpc(self, cid: int) -> None:
         if self._timeout_timer is not None:
             TimerThread.instance().unschedule(self._timeout_timer)

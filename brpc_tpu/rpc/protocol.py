@@ -71,6 +71,9 @@ class Protocol:
     supported_connection_type: int = CONNECTION_TYPE_ALL
     support_client: bool = True
     support_server: bool = True
+    # responses correlate by arrival order on the connection instead of an
+    # embedded correlation id (HTTP/1.1, redis, memcache pipelining)
+    pipelined: bool = False
 
 
 _protocols: List[Protocol] = []

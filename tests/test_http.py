@@ -1,0 +1,183 @@
+"""HTTP protocol tests: JSON RPC + builtin admin pages over the same port
+(reference test/brpc_http_rpc_protocol_unittest.cpp pattern)."""
+import json
+import socket as pysocket
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [900]
+
+
+def unique(p="http"):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class EchoService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = "http:" + request.message
+        done()
+
+
+def start_tcp_server():
+    server = rpc.Server()
+    server.add_service(EchoService())
+    assert server.start("127.0.0.1:0") == 0
+    return server
+
+
+def raw_http(port, request: bytes) -> bytes:
+    with pysocket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(request)
+        data = b""
+        s.settimeout(5)
+        while b"\r\n\r\n" not in data or not _complete(data):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        return data
+
+
+def _complete(data: bytes) -> bool:
+    head, _, rest = data.partition(b"\r\n\r\n")
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            return len(rest) >= int(line.split(b":")[1])
+    return True
+
+
+class TestHttpServer:
+    def test_json_rpc_post(self):
+        server = start_tcp_server()
+        try:
+            body = json.dumps({"message": "hello"}).encode()
+            req = (b"POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Type: application/json\r\n"
+                   b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+            resp = raw_http(server.listen_port, req)
+            assert resp.startswith(b"HTTP/1.1 200")
+            payload = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+            assert payload["message"] == "http:hello"
+        finally:
+            server.stop()
+
+    def test_get_with_query_params(self):
+        server = start_tcp_server()
+        try:
+            req = b"GET /EchoService/Echo?message=qs HTTP/1.1\r\nHost: x\r\n\r\n"
+            resp = raw_http(server.listen_port, req)
+            assert resp.startswith(b"HTTP/1.1 200")
+            assert json.loads(resp.split(b"\r\n\r\n", 1)[1])["message"] == "http:qs"
+        finally:
+            server.stop()
+
+    def test_404(self):
+        server = start_tcp_server()
+        try:
+            resp = raw_http(server.listen_port,
+                            b"GET /no/such/thing HTTP/1.1\r\nHost: x\r\n\r\n")
+            assert resp.startswith(b"HTTP/1.1 404")
+        finally:
+            server.stop()
+
+    def test_bad_json_is_400(self):
+        server = start_tcp_server()
+        try:
+            body = b"{not json"
+            req = (b"POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+            resp = raw_http(server.listen_port, req)
+            assert resp.startswith(b"HTTP/1.1 400")
+        finally:
+            server.stop()
+
+    @pytest.mark.parametrize("page,needle", [
+        ("health", b"OK"),
+        ("status", b"EchoService"),
+        ("vars", b"rpc_socket_count"),
+        ("flags", b"bthread_concurrency"),
+        ("connections", b"remote"),
+        ("brpc_metrics", b"# TYPE"),
+        ("protobufs", b"EchoRequest"),
+        ("bthreads", b"workers"),
+        ("rpcz", b"spans"),
+        ("version", b"brpc_tpu"),
+    ])
+    def test_builtin_pages(self, page, needle):
+        server = start_tcp_server()
+        try:
+            resp = raw_http(server.listen_port,
+                            b"GET /%s HTTP/1.1\r\nHost: x\r\n\r\n"
+                            % page.encode())
+            assert resp.startswith(b"HTTP/1.1 200"), resp[:200]
+            assert needle in resp
+        finally:
+            server.stop()
+
+    def test_flags_set_via_http(self):
+        from brpc_tpu.butil import flags as _flags
+        _flags.define_flag("test_http_reload", 5, "x",
+                           _flags.positive_integer)
+        server = start_tcp_server()
+        try:
+            resp = raw_http(server.listen_port,
+                            b"GET /flags?setvalue=test_http_reload&to=9 "
+                            b"HTTP/1.1\r\nHost: x\r\n\r\n")
+            assert b"ok" in resp
+            assert _flags.get_flag("test_http_reload") == 9
+        finally:
+            server.stop()
+
+    def test_protocol_coexists_with_tpu_std(self):
+        """Same port serves TRPC frames and HTTP text."""
+        server = start_tcp_server()
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}")
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="bin"), EchoResponse)
+            assert not cntl.failed() and resp.message == "http:bin"
+            http_resp = raw_http(server.listen_port,
+                                 b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+            assert b"OK" in http_resp
+        finally:
+            server.stop()
+
+
+class TestHttpClient:
+    def test_channel_with_http_protocol(self):
+        server = start_tcp_server()
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}",
+                    options=rpc.ChannelOptions(protocol="http",
+                                               timeout_ms=5000))
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="cli"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "http:cli"
+        finally:
+            server.stop()
+
+    def test_http_client_error_mapping(self):
+        server = start_tcp_server()
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}",
+                    options=rpc.ChannelOptions(protocol="http",
+                                               timeout_ms=5000, max_retry=0))
+            cntl = rpc.Controller()
+            ch.call_method("NoService.NoMethod", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+        finally:
+            server.stop()
