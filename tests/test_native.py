@@ -1,0 +1,85 @@
+"""Native core bindings tests (builds native/ on demand; skips when no
+toolchain — the reference's hardware-gated test pattern, SURVEY.md §4)."""
+import ctypes
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core not buildable here")
+
+
+class TestNativePool:
+    def test_versioned_ids(self):
+        lib = native.load()
+        pool = lib.brpc_tpu_pool_new()
+        buf = ctypes.create_string_buffer(b"x")
+        addr = ctypes.cast(buf, ctypes.c_void_p)
+        rid = lib.brpc_tpu_pool_get(pool, addr)
+        assert lib.brpc_tpu_pool_address(pool, rid) == addr.value
+        assert lib.brpc_tpu_pool_put(pool, rid) == 1
+        assert lib.brpc_tpu_pool_address(pool, rid) is None
+        assert lib.brpc_tpu_pool_put(pool, rid) == 0
+        rid2 = lib.brpc_tpu_pool_get(pool, addr)
+        assert rid2 != rid
+        assert (rid2 & 0xFFFFFFFF) == (rid & 0xFFFFFFFF)   # slot reuse
+
+
+class TestNativeButex:
+    def test_wait_wake(self):
+        lib = native.load()
+        b = lib.brpc_tpu_butex_new(0)
+        rc = []
+
+        def waiter():
+            rc.append(lib.brpc_tpu_butex_wait(b, 0, 5_000_000))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        lib.brpc_tpu_butex_set_wake_all(b, 1)
+        t.join(5)
+        assert rc == [0]
+        assert lib.brpc_tpu_butex_value(b) == 1
+
+    def test_timeout_and_wouldblock(self):
+        import errno
+        lib = native.load()
+        b = lib.brpc_tpu_butex_new(3)
+        assert lib.brpc_tpu_butex_wait(b, 0, 1000) == errno.EWOULDBLOCK
+        assert lib.brpc_tpu_butex_wait(b, 3, 20_000) == errno.ETIMEDOUT
+
+
+class TestNativeScheduler:
+    def test_spawn_join_many_native(self):
+        sched = native.NativeScheduler(workers=2)
+        assert sched.selftest(100) == 100
+        assert sched.completed() >= 100
+        assert sched.spawned() >= 100
+
+
+class TestNativeBlockPool:
+    def test_alloc_release_exhaust(self):
+        lib = native.load()
+        bp = lib.brpc_tpu_blockpool_new(4096, 4)
+        blocks = [lib.brpc_tpu_blockpool_alloc(bp) for _ in range(4)]
+        assert all(blocks)
+        assert lib.brpc_tpu_blockpool_alloc(bp) is None
+        for blk in blocks:
+            assert lib.brpc_tpu_blockpool_release(bp, blk) == 1
+        assert lib.brpc_tpu_blockpool_free_count(bp) == 4
+
+
+class TestNativeTimer:
+    def test_schedule_unschedule(self):
+        lib = native.load()
+        fired = []
+        cb = native._TIMER_FN(lambda arg: fired.append(1))
+        lib.brpc_tpu_timer_schedule(cb, None, 10_000)
+        tid = lib.brpc_tpu_timer_schedule(cb, None, 200_000)
+        assert lib.brpc_tpu_timer_unschedule(tid) == 0
+        time.sleep(0.3)
+        assert fired == [1]
