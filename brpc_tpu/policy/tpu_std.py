@@ -158,6 +158,9 @@ def process_request(msg: StdMessage, socket, server) -> None:
     full_name = f"{req_meta.service_name}.{req_meta.method_name}"
     cid = meta.correlation_id
     start_us = time.monotonic_ns() // 1000
+    from ..rpc import rpc_dump
+    if rpc_dump.dump_enabled():
+        rpc_dump.maybe_dump_request(pack_frame(meta, msg.body))
 
     cntl = Controller()
     cntl.server = server

@@ -1,0 +1,105 @@
+"""rpc_dump: sampled capture of inbound requests for replay debugging.
+
+Reference: src/brpc/rpc_dump.{h,cpp} — when ``rpc_dump`` is on, a sampled
+subset of requests (speed-limited through the bvar Collector) is appended to
+size-capped files under ``rpc_dump_dir``; tools/rpc_replay reads them back
+and fires them at a server.  The record format here is the tpu_std frame
+itself (magic+meta+payload), so a dump file is literally a byte-stream a
+socket could replay.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+from ..butil import flags as _flags
+from ..butil.iobuf import IOBuf
+from .. import bvar
+
+_flags.define_flag("rpc_dump", False, "capture sampled requests to disk")
+_flags.define_flag("rpc_dump_dir", "./rpc_dump", "dump output directory")
+_flags.define_flag("rpc_dump_max_files", 4, "rotated dump files kept",
+                   _flags.positive_integer)
+_flags.define_flag("rpc_dump_max_requests_in_one_file", 1000,
+                   "requests per file before rotation",
+                   _flags.positive_integer)
+
+_speed_limit = bvar.CollectorSpeedLimit(max_samples_per_second=100)
+_lock = threading.Lock()
+_current_file = None
+_current_count = 0
+_file_index = 0
+dumped_count = bvar.Adder("rpc_dump_count")
+
+
+def dump_enabled() -> bool:
+    return bool(_flags.get_flag("rpc_dump"))
+
+
+def maybe_dump_request(frame: IOBuf) -> bool:
+    """Called by protocols with the complete wire frame of a request."""
+    global _current_file, _current_count, _file_index
+    if not dump_enabled() or not _speed_limit.is_sampled():
+        return False
+    data = frame.to_bytes()
+    with _lock:
+        if _current_file is None or _current_count >= _flags.get_flag(
+                "rpc_dump_max_requests_in_one_file"):
+            _rotate_locked()
+        try:
+            _current_file.write(data)
+            _current_file.flush()
+            _current_count += 1
+        except OSError:
+            return False
+    dumped_count << 1
+    return True
+
+
+def _rotate_locked() -> None:
+    global _current_file, _current_count, _file_index
+    d = _flags.get_flag("rpc_dump_dir")
+    os.makedirs(d, exist_ok=True)
+    if _current_file is not None:
+        _current_file.close()
+    path = os.path.join(d, f"requests.{_file_index:06d}")
+    _current_file = open(path, "wb")
+    _current_count = 0
+    _file_index += 1
+    # prune old files
+    keep = _flags.get_flag("rpc_dump_max_files")
+    files = sorted(f for f in os.listdir(d) if f.startswith("requests."))
+    for old in files[:-keep] if len(files) > keep else []:
+        try:
+            os.unlink(os.path.join(d, old))
+        except OSError:
+            pass
+
+
+def list_dump_files(directory: Optional[str] = None) -> List[str]:
+    d = directory or _flags.get_flag("rpc_dump_dir")
+    if not os.path.isdir(d):
+        return []
+    return sorted(os.path.join(d, f) for f in os.listdir(d)
+                  if f.startswith("requests."))
+
+
+def load_dumped_frames(path: str) -> List[bytes]:
+    """Split a dump file back into frames (parse by header sizes)."""
+    from ..policy.tpu_std import MAGIC, HEADER_SIZE
+    frames = []
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos + HEADER_SIZE <= len(data):
+        if data[pos:pos + 4] != MAGIC:
+            break
+        meta_size = int.from_bytes(data[pos + 4:pos + 8], "big")
+        body_size = int.from_bytes(data[pos + 8:pos + 12], "big")
+        end = pos + HEADER_SIZE + meta_size + body_size
+        if end > len(data):
+            break
+        frames.append(data[pos:end])
+        pos = end
+    return frames

@@ -1,0 +1,112 @@
+"""rpc_press: protocol-generic load generator.
+
+Reference: tools/rpc_press — fires requests at a target qps (or max), from a
+JSON request body, reporting qps/latency through bvar.  Usage:
+
+    python -m brpc_tpu.tools.rpc_press --server mem://echo \
+        --method EchoService.Echo --request '{"message":"x"}' \
+        --qps 1000 --duration 5 [--proto tests/echo_pb2:EchoRequest,EchoResponse]
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+import threading
+import time
+from typing import Optional
+
+
+def _load_classes(spec: str):
+    mod_name, _, names = spec.partition(":")
+    req_name, _, resp_name = names.partition(",")
+    mod = importlib.import_module(mod_name.replace("/", ".").rstrip(".py"))
+    return getattr(mod, req_name), getattr(mod, resp_name)
+
+
+def run_press(server: str, method: str, request_json: str,
+              qps: int = 0, duration: float = 5.0, concurrency: int = 8,
+              proto: Optional[str] = None, protocol: str = "tpu_std",
+              out=sys.stderr) -> dict:
+    import brpc_tpu.policy  # noqa: F401 — registers protocols
+    from brpc_tpu import rpc, bvar
+    from brpc_tpu.codec import json2pb
+
+    if proto:
+        req_cls, resp_cls = _load_classes(proto)
+        request = json2pb.dict_to_pb(json.loads(request_json or "{}"), req_cls)
+    else:
+        req_cls = resp_cls = None
+        request = (request_json or "").encode()
+
+    ch = rpc.Channel()
+    ch.init(server, options=rpc.ChannelOptions(protocol=protocol,
+                                               timeout_ms=10000))
+    recorder = bvar.LatencyRecorder()
+    errors_count = [0]
+    sent = [0]
+    lock = threading.Lock()
+    deadline = time.monotonic() + duration
+    interval = concurrency / qps if qps > 0 else 0.0
+
+    def worker():
+        next_fire = time.monotonic()
+        while time.monotonic() < deadline:
+            if interval:
+                now = time.monotonic()
+                if now < next_fire:
+                    time.sleep(min(next_fire - now, 0.05))
+                    continue
+                next_fire += interval
+            cntl = rpc.Controller()
+            t0 = time.perf_counter_ns()
+            ch.call_method(method, cntl, request, resp_cls)
+            lat_us = (time.perf_counter_ns() - t0) // 1000
+            with lock:
+                sent[0] += 1
+                if cntl.failed():
+                    errors_count[0] += 1
+                else:
+                    recorder << lat_us
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t_start = time.monotonic()
+    for t in threads: t.start()
+    for t in threads: t.join()
+    elapsed = time.monotonic() - t_start
+    from brpc_tpu.bvar import SamplerCollector
+    SamplerCollector.instance().sample_once()
+    result = {
+        "sent": sent[0],
+        "errors": errors_count[0],
+        "qps": round(sent[0] / elapsed, 1),
+        "avg_latency_us": round(recorder.latency(), 1),
+        "max_latency_us": recorder.max_latency(),
+        "p99_latency_us": recorder.latency_percentile(0.99),
+        "elapsed_s": round(elapsed, 2),
+    }
+    print(json.dumps(result), file=out)
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--server", required=True)
+    ap.add_argument("--method", required=True)
+    ap.add_argument("--request", default="{}")
+    ap.add_argument("--qps", type=int, default=0, help="0 = unthrottled")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--proto", default=None,
+                    help="module:RequestCls,ResponseCls")
+    ap.add_argument("--protocol", default="tpu_std")
+    args = ap.parse_args(argv)
+    run_press(args.server, args.method, args.request, args.qps,
+              args.duration, args.concurrency, args.proto, args.protocol,
+              out=sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

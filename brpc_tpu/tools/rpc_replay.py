@@ -1,0 +1,106 @@
+"""rpc_replay: replay rpc_dump capture files against a server.
+
+Reference: tools/rpc_replay — reads sampled frames recorded by rpc_dump
+(see brpc_tpu/rpc/rpc_dump.py) and re-sends them, reporting success rate
+and latency.  Dumped frames are raw tpu_std bytes; replay re-correlates
+each with a fresh id so responses resolve normally.
+
+    python -m brpc_tpu.tools.rpc_replay --server mem://echo --dir ./rpc_dump \
+        [--times 2] [--qps 0]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def run_replay(server: str, dump_dir: str, times: int = 1, qps: int = 0,
+               timeout_s: float = 10.0, out=sys.stderr) -> dict:
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu.butil.endpoint import parse_endpoint
+    from brpc_tpu.butil.iobuf import IOBuf
+    from brpc_tpu.proto import rpc_meta_pb2 as meta_pb
+    from brpc_tpu.rpc import rpc_dump
+    from brpc_tpu.rpc.controller import Controller
+    from brpc_tpu.rpc.socket_map import SocketMap
+    from brpc_tpu.rpc.input_messenger import InputMessenger
+    from brpc_tpu.policy import tpu_std
+    from brpc_tpu.bthread import id as bthread_id
+
+    files = rpc_dump.list_dump_files(dump_dir)
+    if not files:
+        print(json.dumps({"error": f"no dump files in {dump_dir}"}), file=out)
+        return {"sent": 0, "ok": 0}
+
+    ep = parse_endpoint(server)
+    messenger = InputMessenger(server=None)
+    sock = SocketMap.instance().get_socket(ep, messenger)
+    interval = 1.0 / qps if qps > 0 else 0.0
+    inflight = []
+    sent = 0
+    t0 = time.monotonic()
+
+    for _ in range(times):
+        for path in files:
+            for frame in rpc_dump.load_dumped_frames(path):
+                meta_size = int.from_bytes(frame[4:8], "big")
+                meta = meta_pb.RpcMeta()
+                meta.ParseFromString(frame[12:12 + meta_size])
+                body = frame[12 + meta_size:]
+                cntl = Controller()
+                cntl.timeout_ms = int(timeout_s * 1000)
+                cntl.max_retry = 0
+                cntl._cid = bthread_id.create_ranged(
+                    cntl, cntl._on_rpc_event, 1)
+                cid = bthread_id.with_version(cntl._cid, 0)
+                cntl._start_us = time.monotonic_ns() // 1000
+                meta.correlation_id = cid
+                new_meta = meta.SerializeToString()
+                buf = IOBuf()
+                buf.append(tpu_std.MAGIC)
+                buf.append(len(new_meta).to_bytes(4, "big"))
+                buf.append(len(body).to_bytes(4, "big"))
+                buf.append(new_meta)
+                buf.append(body)
+                sock.write(buf, notify_cid=cid)
+                inflight.append(cntl)
+                sent += 1
+                if interval:
+                    time.sleep(interval)
+
+    ok = 0
+    errors_n = 0
+    deadline = time.monotonic() + timeout_s
+    for cntl in inflight:
+        remaining = max(deadline - time.monotonic(), 0.01)
+        try:
+            cntl.join(remaining)
+            if cntl.failed():
+                errors_n += 1
+            else:
+                ok += 1
+        except TimeoutError:
+            errors_n += 1
+    elapsed = time.monotonic() - t0
+    result = {"sent": sent, "ok": ok, "errors": errors_n,
+              "elapsed_s": round(elapsed, 2), "files": len(files),
+              "qps": round(sent / elapsed, 1) if elapsed else 0}
+    print(json.dumps(result), file=out)
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--server", required=True)
+    ap.add_argument("--dir", default="./rpc_dump")
+    ap.add_argument("--times", type=int, default=1)
+    ap.add_argument("--qps", type=int, default=0)
+    args = ap.parse_args(argv)
+    run_replay(args.server, args.dir, args.times, args.qps, out=sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

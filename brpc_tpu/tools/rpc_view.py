@@ -1,0 +1,66 @@
+"""rpc_view: render another server's builtin pages from the CLI.
+
+Reference: tools/rpc_view — a proxy that fetches and displays a remote
+server's admin pages.  Works against any transport the target listens on
+(tcp via HTTP; mem/ici via the HTTP protocol over that transport).
+
+    python -m brpc_tpu.tools.rpc_view --server 127.0.0.1:8000 --page status
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import urllib.request
+
+
+def fetch_page(server: str, page: str, query: str = "") -> str:
+    if server.startswith(("mem://", "ici://")):
+        # in-process targets: speak the HTTP protocol over the fabric socket
+        import brpc_tpu.policy  # noqa: F401
+        from brpc_tpu.butil.endpoint import parse_endpoint
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.rpc.socket_map import SocketMap
+        from brpc_tpu.rpc.input_messenger import InputMessenger
+        from brpc_tpu.policy import http as http_proto
+        import threading
+
+        got = {}
+        evt = threading.Event()
+
+        def process_response(msg, socket):
+            got["msg"] = msg
+            evt.set()
+
+        proto = http_proto.Protocol(
+            name="http_view", parse=http_proto.parse,
+            process_response=process_response)
+        messenger = InputMessenger(protocols=[proto])
+        sock = SocketMap.instance().get_short_socket(
+            parse_endpoint(server), messenger)
+        req = IOBuf()
+        req.append(f"GET /{page}{'?' + query if query else ''} HTTP/1.1\r\n"
+                   f"Host: {server}\r\n\r\n")
+        sock.write(req)
+        if not evt.wait(5):
+            raise TimeoutError("no response")
+        msg = got["msg"]
+        from brpc_tpu.rpc import errors as _e
+        sock.set_failed(_e.ECLOSE, "view done")
+        return msg.body.decode("utf-8", "replace")
+    url = f"http://{server}/{page}{'?' + query if query else ''}"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--server", required=True)
+    ap.add_argument("--page", default="status")
+    ap.add_argument("--query", default="")
+    args = ap.parse_args(argv)
+    print(fetch_page(args.server, args.page, args.query))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""Tools + rpc_dump tests."""
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.butil import flags as _flags
+from brpc_tpu.rpc import rpc_dump
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [2000]
+
+
+def unique(p="tool"):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class EchoService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+def start_server():
+    s = rpc.Server()
+    s.add_service(EchoService())
+    name = unique()
+    assert s.start(f"mem://{name}") == 0
+    return s, f"mem://{name}"
+
+
+class TestRpcPress:
+    def test_press_reports_qps(self):
+        from brpc_tpu.tools.rpc_press import run_press
+        server, target = start_server()
+        try:
+            result = run_press(target, "EchoService.Echo",
+                               '{"message":"p"}', qps=0, duration=0.5,
+                               concurrency=4,
+                               proto="tests.echo_pb2:EchoRequest,EchoResponse",
+                               out=io.StringIO())
+            assert result["sent"] > 10
+            assert result["errors"] == 0
+            assert result["qps"] > 0
+        finally:
+            server.stop()
+
+    def test_press_throttled(self):
+        from brpc_tpu.tools.rpc_press import run_press
+        server, target = start_server()
+        try:
+            result = run_press(target, "EchoService.Echo",
+                               '{"message":"p"}', qps=50, duration=1.0,
+                               concurrency=2,
+                               proto="tests.echo_pb2:EchoRequest,EchoResponse",
+                               out=io.StringIO())
+            assert result["errors"] == 0
+            assert result["qps"] < 120   # throttle held (some slack)
+        finally:
+            server.stop()
+
+
+class TestRpcDumpAndReplay:
+    def test_dump_then_replay(self, tmp_path):
+        from brpc_tpu.tools.rpc_replay import run_replay
+        dump_dir = str(tmp_path / "dump")
+        _flags.set_flag("rpc_dump_dir", dump_dir)
+        _flags.set_flag("rpc_dump", True)
+        server, target = start_server()
+        try:
+            ch = rpc.Channel(); ch.init(target)
+            for i in range(5):
+                cntl = rpc.Controller()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message=f"d{i}"), EchoResponse)
+                assert not cntl.failed()
+            _flags.set_flag("rpc_dump", False)
+            files = rpc_dump.list_dump_files(dump_dir)
+            assert files
+            frames = rpc_dump.load_dumped_frames(files[0])
+            assert len(frames) == 5
+            # replay against the same server
+            result = run_replay(target, dump_dir, times=2, out=io.StringIO())
+            assert result["sent"] == 10
+            assert result["ok"] == 10
+        finally:
+            _flags.set_flag("rpc_dump", False)
+            server.stop()
+
+
+class TestRpcView:
+    def test_view_mem_server(self):
+        from brpc_tpu.tools.rpc_view import fetch_page
+        server, target = start_server()
+        try:
+            body = fetch_page(target, "health")
+            assert body == "OK"
+            status = json.loads(fetch_page(target, "status"))
+            assert "EchoService" in status["services"]
+        finally:
+            server.stop()
+
+    def test_view_tcp_server(self):
+        from brpc_tpu.tools.rpc_view import fetch_page
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            body = fetch_page(f"127.0.0.1:{server.listen_port}", "health")
+            assert body == "OK"
+        finally:
+            server.stop()
+
+
+class TestParallelHttp:
+    def test_fetch_many(self):
+        from brpc_tpu.tools.parallel_http import fetch_all
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            base = f"http://127.0.0.1:{server.listen_port}"
+            urls = [f"{base}/health", f"{base}/status", f"{base}/vars",
+                    f"{base}/nope"]
+            out = fetch_all(urls, concurrency=4, out=io.StringIO())
+            assert out["summary"]["ok"] == 3
+            assert out["summary"]["failed"] == 1
+        finally:
+            server.stop()
