@@ -30,8 +30,16 @@ class InputMessenger:
         return self._protocols if self._protocols is not None else list_protocols()
 
     # called from Socket._process_event (single reader per socket)
-    def on_new_messages(self, socket) -> None:
+    def on_new_messages(self, socket):
+        """Read until EAGAIN, cut messages, dispatch all but the last to
+        their own tasklets, and RETURN the last one (already cut from the
+        buffer).  The socket releases readership before processing it in
+        place (input_messenger.cpp:205-311 + the socket.cpp:2046 single-
+        reader discipline): a slow handler must block only itself, never
+        the connection's later messages — the tail-latency-isolation
+        doctrine of docs/en/io.md."""
         read_eof = False
+        last = None
         while not read_eof and not socket.failed:
             nr = socket._do_read(socket._read_portal, 1 << 16)
             if nr < 0:
@@ -42,16 +50,24 @@ class InputMessenger:
             msgs = self._cut_messages(socket)
             if msgs is None:                  # corrupt stream
                 socket.set_failed(errors.EREQUEST, "protocol parse error")
-                return
-            # n-1 dispatched to new tasklets, the last processed in place
-            # (input_messenger.cpp:205-311 keeps the last for cache locality)
+                return None
+            if last is not None:              # previous batch's holdover
+                self._queue_message(*last, socket)
+                last = None
             for proto, msg in msgs[:-1]:
                 self._queue_message(proto, msg, socket)
             if msgs:
-                proto, msg = msgs[-1]
-                self._process_message(proto, msg, socket)
+                last = msgs[-1]
         if read_eof:
+            if last is not None:
+                self._queue_message(*last, socket)
+                last = None
             socket.set_failed(errors.EEOF, "remote closed")
+        return last
+
+    def process_in_place(self, last, socket) -> None:
+        proto, msg = last
+        self._process_message(proto, msg, socket)
 
     def _cut_messages(self, socket) -> Optional[list]:
         out = []

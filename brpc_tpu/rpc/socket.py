@@ -205,9 +205,10 @@ class Socket:
 
     def _process_event(self) -> None:
         while True:
+            last = None
             if self.messenger is not None:
                 try:
-                    self.messenger.on_new_messages(self)
+                    last = self.messenger.on_new_messages(self)
                 except Exception as e:
                     from ..butil import logging as log
                     log.error("input processing failed on %s: %s",
@@ -216,8 +217,18 @@ class Socket:
             with self._nevent_lock:
                 left = self._nevent - 1
                 self._nevent = 1 if left > 0 else 0
-                if left <= 0:
-                    return
+                more = left > 0
+            if not more:
+                # readership released: the last message runs in this tasklet
+                # for cache locality, but a slow handler now only blocks
+                # itself — new readiness spawns a fresh reader
+                if last is not None and self.messenger is not None:
+                    self.messenger.process_in_place(last, self)
+                return
+            # more events pending: keep readership, hand the holdover to its
+            # own tasklet and loop back to read
+            if last is not None and self.messenger is not None:
+                self.messenger._queue_message(*last, self)
 
     # ---- pipelining (redis/memcache; socket.h:256-262) ----------------
     def push_pipelined_context(self, ctx: Any) -> None:

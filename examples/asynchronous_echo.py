@@ -1,0 +1,36 @@
+"""Async echo with completion callbacks — example/asynchronous_echo_c++."""
+from __future__ import annotations
+
+import threading
+
+from examples.common import EchoRequest, EchoResponse, start_echo_server, rpc
+
+
+def main() -> None:
+    server = start_echo_server("mem://example-async")
+    channel = rpc.Channel()
+    channel.init("mem://example-async")
+    done = threading.Event()
+    remaining = [5]
+    lock = threading.Lock()
+
+    def on_done(cntl: rpc.Controller) -> None:
+        if cntl.failed():
+            print("failed:", cntl.error_text)
+        else:
+            print("async response:", cntl.response.message)
+        with lock:
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                done.set()
+
+    for i in range(5):
+        channel.call_method("EchoService.Echo", rpc.Controller(),
+                            EchoRequest(message=f"async-{i}"), EchoResponse,
+                            on_done)
+    assert done.wait(10)
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Auto concurrency limiter under overload —
+example/auto_concurrency_limiter."""
+from __future__ import annotations
+
+import threading
+import time
+
+from examples.common import EchoRequest, EchoResponse, rpc
+
+
+def main() -> None:
+    opts = rpc.ServerOptions()
+    opts.method_max_concurrency = {"EchoService.Echo": "auto"}
+    server = rpc.Server(opts)
+
+    from examples.common import EchoService
+    server.add_service(EchoService())
+    assert server.start("mem://example-autolimit") == 0
+    try:
+        ch = rpc.Channel()
+        ch.init("mem://example-autolimit",
+                options=rpc.ChannelOptions(timeout_ms=3000))
+        oks = [0]; limited = [0]
+        lock = threading.Lock()
+
+        def worker():
+            for _ in range(30):
+                cntl = rpc.Controller()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="x", sleep_us=2000),
+                               EchoResponse)
+                with lock:
+                    if cntl.failed():
+                        limited[0] += 1
+                    else:
+                        oks[0] += 1
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        st = server.method_status("EchoService.Echo")
+        print(f"ok={oks[0]} rejected={limited[0]} "
+              f"adaptive max_concurrency={st.limiter.max_concurrency()}")
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

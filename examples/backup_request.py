@@ -1,0 +1,50 @@
+"""Backup (hedged) requests — example/backup_request_c++ +
+docs/cn/backup_request.md semantics: a second try fires after
+backup_request_ms; the first response wins, the loser is ignored."""
+from __future__ import annotations
+
+import time
+
+from examples.common import EchoRequest, EchoResponse, rpc
+
+
+class SlowThenFastService(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self):
+        self.calls = 0
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        self.calls += 1
+        if self.calls == 1:
+            time.sleep(0.3)          # first try is slow
+        response.message = f"reply-to-try-{self.calls}"
+        done()
+
+
+def main() -> None:
+    server = rpc.Server()
+    svc = SlowThenFastService()
+    server.add_service(svc)
+    assert server.start("mem://example-backup") == 0
+    try:
+        ch = rpc.Channel()
+        ch.init("mem://example-backup",
+                options=rpc.ChannelOptions(timeout_ms=2000, max_retry=2,
+                                           backup_request_ms=50))
+        cntl = rpc.Controller()
+        t0 = time.monotonic()
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="h"), EchoResponse)
+        dt = (time.monotonic() - t0) * 1000
+        assert not cntl.failed(), cntl.error_text
+        print(f"got {resp.message!r} in {dt:.0f}ms "
+              f"(server saw {svc.calls} tries; hedge beat the 300ms try)")
+        assert dt < 280, "backup request should beat the slow first try"
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

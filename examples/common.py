@@ -1,0 +1,37 @@
+"""Shared example scaffolding: an in-process echo server (the examples run
+client+server in one process, like the reference's test fixtures; point the
+client flags at a remote address to split them)."""
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])   # repo root
+
+import brpc_tpu.policy  # noqa: F401  (registers protocols)
+from brpc_tpu import rpc
+from examples.example_echo_pb2 import EchoRequest, EchoResponse
+
+
+class EchoService(rpc.Service):
+    def __init__(self, tag: str = ""):
+        self.tag = tag
+        self.calls = 0
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        self.calls += 1
+        if request.sleep_us:
+            time.sleep(request.sleep_us / 1e6)
+        response.message = (self.tag + ":" if self.tag else "") + request.message
+        if len(cntl.request_attachment):
+            cntl.response_attachment.append(cntl.request_attachment)
+        done()
+
+
+def start_echo_server(addr: str, tag: str = "") -> rpc.Server:
+    server = rpc.Server()
+    server.add_service(EchoService(tag))
+    rc = server.start(addr)
+    assert rc == 0, f"server start failed: {rc}"
+    return server

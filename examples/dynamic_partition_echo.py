@@ -1,0 +1,41 @@
+"""DynamicPartitionChannel: traffic migrates to the scheme with capacity —
+example/dynamic_partition_echo_c++."""
+from __future__ import annotations
+
+import tempfile
+
+from examples.common import EchoRequest, EchoResponse, start_echo_server, rpc
+from brpc_tpu import channels
+from examples.parallel_echo import ConcatMerger
+
+
+def main() -> None:
+    servers = [start_echo_server(f"mem://example-dp-{i}", tag=f"n{i}")
+               for i in range(3)]
+    listing = tempfile.NamedTemporaryFile("w", suffix=".cluster", delete=False)
+    # scheme 1 has one replica, scheme 2 has two: capacity-weighted choice
+    listing.write("mem://example-dp-0 100 0/1\n"
+                  "mem://example-dp-1 100 0/2\n"
+                  "mem://example-dp-2 100 1/2\n")
+    listing.close()
+    try:
+        dpc = channels.DynamicPartitionChannel()
+        assert dpc.init([1, 2], f"file://{listing.name}",
+                        merger=ConcatMerger()) == 0
+        scheme_hits = {1: 0, 2: 0}
+        for _ in range(20):
+            cntl = rpc.Controller()
+            resp = EchoResponse()
+            dpc.call_method("EchoService.Echo", cntl,
+                            EchoRequest(message="d"), resp)
+            assert not cntl.failed(), cntl.error_text
+            scheme_hits[len(resp.message.split("|"))] += 1
+        print(f"calls served by 1-partition scheme: {scheme_hits[1]}, "
+              f"2-partition scheme: {scheme_hits[2]}")
+    finally:
+        for s in servers:
+            s.stop()
+
+
+if __name__ == "__main__":
+    main()

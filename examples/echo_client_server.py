@@ -1,0 +1,28 @@
+"""Sync unary echo — the example/echo_c++ analogue (BASELINE config 1)."""
+from __future__ import annotations
+
+from examples.common import EchoRequest, EchoResponse, start_echo_server, rpc
+
+
+def main() -> None:
+    server = start_echo_server("mem://example-echo")
+    try:
+        channel = rpc.Channel()
+        channel.init("mem://example-echo",
+                     options=rpc.ChannelOptions(timeout_ms=1000, max_retry=3))
+        for i in range(3):
+            cntl = rpc.Controller()
+            cntl.request_attachment.append(b"attached-bytes")
+            response = channel.call_method(
+                "EchoService.Echo", cntl,
+                EchoRequest(message=f"hello-{i}"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            print(f"echo -> {response.message!r} "
+                  f"(latency={cntl.latency_us}us, "
+                  f"attachment={cntl.response_attachment.to_bytes()!r})")
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""HTTP/JSON access + builtin admin pages — example/http_c++."""
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from examples.common import start_echo_server
+
+
+def main() -> None:
+    server = start_echo_server("127.0.0.1:0")
+    port = server.listen_port
+    try:
+        base = f"http://127.0.0.1:{port}"
+        req = urllib.request.Request(
+            f"{base}/EchoService/Echo",
+            data=json.dumps({"message": "over-http"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            print("JSON RPC:", json.loads(r.read()))
+        for page in ("health", "status", "vars?filter=rpc_*", "brpc_metrics"):
+            with urllib.request.urlopen(f"{base}/{page}", timeout=5) as r:
+                body = r.read().decode()
+                print(f"/{page}: {body[:80].strip()!r}...")
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

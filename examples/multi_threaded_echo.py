@@ -1,0 +1,42 @@
+"""Concurrent echo QPS — the example/multi_threaded_echo_c++ analogue
+(BASELINE config 2)."""
+from __future__ import annotations
+
+import threading
+import time
+
+from examples.common import EchoRequest, EchoResponse, start_echo_server, rpc
+
+
+def main(threads: int = 8, seconds: float = 2.0) -> None:
+    server = start_echo_server("mem://example-mt-echo")
+    channel = rpc.Channel()
+    channel.init("mem://example-mt-echo",
+                 options=rpc.ChannelOptions(timeout_ms=5000))
+    stop_at = time.monotonic() + seconds
+    counts = [0] * threads
+    errors = [0]
+
+    def worker(idx: int) -> None:
+        while time.monotonic() < stop_at:
+            cntl = rpc.Controller()
+            channel.call_method("EchoService.Echo", cntl,
+                                EchoRequest(message="m"), EchoResponse)
+            if cntl.failed():
+                errors[0] += 1
+            else:
+                counts[idx] += 1
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+    t0 = time.monotonic()
+    for t in ts: t.start()
+    for t in ts: t.join()
+    dt = time.monotonic() - t0
+    total = sum(counts)
+    print(f"{total} calls in {dt:.2f}s over {threads} threads "
+          f"-> {total/dt:.0f} qps, {errors[0]} errors")
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""PartitionChannel over tagged naming — example/partition_echo_c++."""
+from __future__ import annotations
+
+import tempfile
+
+from examples.common import EchoRequest, EchoResponse, start_echo_server, rpc
+from brpc_tpu import channels
+from examples.parallel_echo import ConcatMerger
+
+
+def main() -> None:
+    servers = [start_echo_server(f"mem://example-part-{i}", tag=f"part{i}")
+               for i in range(3)]
+    listing = tempfile.NamedTemporaryFile("w", suffix=".cluster", delete=False)
+    for i in range(3):
+        listing.write(f"mem://example-part-{i} 100 {i}/3\n")
+    listing.close()
+    try:
+        pc = channels.PartitionChannel()
+        assert pc.init(3, f"file://{listing.name}",
+                       merger=ConcatMerger()) == 0
+        assert pc.partitions_ready()
+        cntl = rpc.Controller()
+        resp = EchoResponse()
+        pc.call_method("EchoService.Echo", cntl,
+                       EchoRequest(message="pt"), resp)
+        assert not cntl.failed(), cntl.error_text
+        print("partition responses:", sorted(resp.message.split("|")))
+    finally:
+        for s in servers:
+            s.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""SelectiveChannel: LB between channels with retry-on-other —
+example/selective_echo_c++."""
+from __future__ import annotations
+
+from examples.common import EchoRequest, EchoResponse, start_echo_server, rpc
+from brpc_tpu import channels
+
+
+def main() -> None:
+    live = start_echo_server("mem://example-sel-live", tag="live")
+    try:
+        schan = channels.SelectiveChannel()
+        dead = rpc.Channel()
+        dead.init("mem://example-sel-dead")      # nobody listens here
+        dead.options.timeout_ms = 200
+        dead.options.max_retry = 0
+        ok = rpc.Channel()
+        ok.init("mem://example-sel-live")
+        schan.add_channel(dead)
+        schan.add_channel(ok)
+        for i in range(4):
+            cntl = rpc.Controller()
+            resp = schan.call_method("EchoService.Echo", cntl,
+                                     EchoRequest(message=f"sel-{i}"),
+                                     EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            print(f"selected -> {resp.message} (retried={cntl.retried_count})")
+    finally:
+        live.stop()
+
+
+if __name__ == "__main__":
+    main()

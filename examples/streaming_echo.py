@@ -1,0 +1,72 @@
+"""Streaming RPC with flow control — example/streaming_echo_c++
+(BASELINE config 3)."""
+from __future__ import annotations
+
+import threading
+import time
+
+from examples.common import EchoRequest, EchoResponse, rpc
+from brpc_tpu.butil.iobuf import IOBuf
+
+
+class StreamingService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def StartStream(self, cntl, request, response, done):
+        class EchoBack(rpc.StreamInputHandler):
+            def __init__(self):
+                self.stream = None
+
+            def on_received_messages(self, sid, msgs):
+                for m in msgs:
+                    self.stream.write(IOBuf(b"echo:" + m.to_bytes()))
+
+            def on_closed(self, sid):
+                print("server stream closed")
+
+        handler = EchoBack()
+        handler.stream = rpc.stream_accept(
+            cntl, rpc.StreamOptions(handler=handler))
+        response.message = "stream accepted"
+        done()
+
+
+class ClientCollector(rpc.StreamInputHandler):
+    def __init__(self, expect: int):
+        self.got = []
+        self.expect = expect
+        self.done = threading.Event()
+
+    def on_received_messages(self, sid, msgs):
+        self.got.extend(m.to_bytes() for m in msgs)
+        if len(self.got) >= self.expect:
+            self.done.set()
+
+
+def main() -> None:
+    server = rpc.Server()
+    server.add_service(StreamingService())
+    assert server.start("mem://example-streaming") == 0
+    try:
+        channel = rpc.Channel()
+        channel.init("mem://example-streaming")
+        collector = ClientCollector(expect=10)
+        cntl = rpc.Controller()
+        stream = rpc.stream_create(
+            cntl, rpc.StreamOptions(handler=collector, max_buf_size=4096))
+        channel.call_method("StreamingService.StartStream", cntl,
+                            EchoRequest(message="go"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert stream.wait_connected(5)
+        for i in range(10):
+            rc = stream.write(IOBuf(b"chunk-%d" % i), timeout=5)
+            assert rc == 0, rc
+        assert collector.done.wait(10)
+        print(f"received {len(collector.got)} echoed chunks, "
+              f"first={collector.got[0]!r}")
+        stream.close()
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
