@@ -114,7 +114,7 @@ class IciSocket(Socket):
     def _deliver(self, peer: "IciSocket", chunks: List) -> None:
         device_arrays = [c[0] for c in chunks if isinstance(c, tuple)]
 
-        def commit() -> None:
+        def commit(inline: bool) -> None:
             buf = IOBuf()
             for c in chunks:
                 if isinstance(c, tuple):
@@ -123,13 +123,14 @@ class IciSocket(Socket):
                     buf.append(c)
             with peer._inbox_lock:
                 peer._inbox.append(buf)
-            peer.start_input_event()
+            peer.start_input_event(inline=inline and not peer.is_server_side)
 
-        if device_arrays:
+        if device_arrays and not _all_ready(device_arrays):
             # read event only after the payload landed in peer HBM
-            DeviceEventDispatcher.instance().on_ready(device_arrays, commit)
+            DeviceEventDispatcher.instance().on_ready(
+                device_arrays, lambda: commit(True))
         else:
-            commit()
+            commit(True)
 
     def _do_read(self, portal: IOPortal, max_count: int) -> int:
         with self._inbox_lock:
@@ -146,6 +147,14 @@ class IciSocket(Socket):
             with peer._inbox_lock:
                 peer._peer_closed = True
             peer.start_input_event()
+
+
+def _all_ready(arrays) -> bool:
+    """True when every transfer already completed (skip the poller hop)."""
+    try:
+        return all(a.is_ready() for a in arrays)
+    except AttributeError:
+        return False
 
 
 # ---- listener registry (ici "ports") ----------------------------------

@@ -35,7 +35,10 @@ class MemSocket(Socket):
         chunk = data.cut(n)
         with peer._inbox_lock:
             peer._inbox.append(chunk)
-        peer.start_input_event()
+        # responses (client-side peer) process inline on this thread —
+        # framework code, bounded latency; requests (server-side peer)
+        # go to a tasklet so user handlers can't block the writer
+        peer.start_input_event(inline=not peer.is_server_side)
         return n
 
     def _do_read(self, portal: IOPortal, max_count: int) -> int:
