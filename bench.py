@@ -36,7 +36,9 @@ def bench_echo_p50(iters: int = 300, payload_bytes: int = 4096):
                 cntl.response_attachment.append(cntl.request_attachment)
             done()
 
-    server = rpc.Server()
+    opts = rpc.ServerOptions()
+    opts.usercode_inline = True       # echo handler is non-blocking
+    server = rpc.Server(opts)
     server.add_service(EchoService())
     server.start("ici://0")
     ch = rpc.Channel()

@@ -101,7 +101,13 @@ class IciSocket(Socket):
                 arr = r.block.data
                 if r.offset or r.length != len(arr):
                     arr = arr[r.offset:r.offset + r.length]
-                moved = jax.device_put(arr, target)
+                try:
+                    resident = target in arr.devices()
+                except Exception:
+                    resident = False
+                # already in the target chip's HBM: pure ref pass — the
+                # zero-copy case the block_pool discipline exists for
+                moved = arr if resident else jax.device_put(arr, target)
                 chunks.append((moved, r.length))
                 with _ici_stats_lock:
                     _ici_device_bytes_moved += r.length
@@ -123,7 +129,9 @@ class IciSocket(Socket):
                     buf.append(c)
             with peer._inbox_lock:
                 peer._inbox.append(buf)
-            peer.start_input_event(inline=inline and not peer.is_server_side)
+            ok_inline = (not peer.is_server_side
+                         or getattr(peer, "usercode_inline", False))
+            peer.start_input_event(inline=inline and ok_inline)
 
         if device_arrays and not _all_ready(device_arrays):
             # read event only after the payload landed in peer HBM

@@ -38,7 +38,9 @@ class MemSocket(Socket):
         # responses (client-side peer) process inline on this thread —
         # framework code, bounded latency; requests (server-side peer)
         # go to a tasklet so user handlers can't block the writer
-        peer.start_input_event(inline=not peer.is_server_side)
+        inline = (not peer.is_server_side
+                  or getattr(peer, "usercode_inline", False))
+        peer.start_input_event(inline=inline)
         return n
 
     def _do_read(self, portal: IOPortal, max_count: int) -> int:

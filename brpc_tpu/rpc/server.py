@@ -32,6 +32,11 @@ class ServerOptions:
     idle_timeout_s: int = -1
     internal_port: int = -1
     concurrency_limiter: str = ""       # "", "constant", "auto", "timeout"
+    # Run user handlers directly on the delivering thread for loopback/ici
+    # transports (the reference's default runs usercode in the IO bthread;
+    # its usercode_in_pthread flag is the inverse).  Minimal latency; only
+    # safe when handlers are fast/non-blocking.
+    usercode_inline: bool = False
 
 
 class Server:
@@ -149,6 +154,7 @@ class Server:
 
     def _on_accept(self, sock) -> None:
         sock.messenger = self.messenger
+        sock.usercode_inline = self.options.usercode_inline
         with self._conn_lock:
             self._connections = [s for s in self._connections if not s.failed]
             self._connections.append(sock)
