@@ -115,14 +115,19 @@ class Controller:
         # +1: versions are try indices 0..max_retry
         self._cid = bthread_id.create_ranged(
             self, self._on_rpc_event, self.max_retry + 1)
-        if self.timeout_ms and self.timeout_ms > 0:
-            self._timeout_timer = TimerThread.instance().schedule_after(
-                self._handle_timeout, self.timeout_ms / 1000.0)
-        if self.backup_request_ms and self.backup_request_ms > 0 \
-                and self.backup_request_ms < (self.timeout_ms or 1 << 30):
+        needs_backup = (self.backup_request_ms and self.backup_request_ms > 0
+                        and self.backup_request_ms < (self.timeout_ms or 1 << 30))
+        if needs_backup:
+            # hedging must be armed before the first try leaves
             self._backup_timer = TimerThread.instance().schedule_after(
                 self._handle_backup_request, self.backup_request_ms / 1000.0)
         self._issue_rpc()
+        # deadline timer is only needed if the call is still in flight —
+        # inline loopback/device completions skip the timer heap entirely
+        if (self.timeout_ms and self.timeout_ms > 0
+                and not self._ended.is_set()):
+            self._timeout_timer = TimerThread.instance().schedule_after(
+                self._handle_timeout, self.timeout_ms / 1000.0)
 
     def current_cid(self) -> int:
         return bthread_id.with_version(self._cid, self.current_try)
