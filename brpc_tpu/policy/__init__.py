@@ -6,3 +6,5 @@ from . import limiters
 from . import load_balancers
 from . import naming
 from . import http
+from . import redis
+from . import memcache

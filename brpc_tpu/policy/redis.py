@@ -1,0 +1,318 @@
+"""Redis protocol: RESP client + server.
+
+Reference: src/brpc/policy/redis_protocol.cpp + redis.{h,cpp},
+redis_command.cpp, redis_reply.cpp — the client speaks RESP with command
+pipelining (multiple commands per RedisRequest, responses correlated by
+arrival order, socket.h:256-262 pipelined_count); the server side
+(RedisService) lets a brpc server answer redis-cli directly, dispatching on
+the command name.
+
+Usage, client:
+    ch.init(target, options=ChannelOptions(protocol="redis"))
+    req = RedisRequest(); req.add_command("SET", "k", "v")
+    resp = ch.call_method("redis", cntl, req, RedisResponse)
+
+Usage, server:
+    class MyRedis(RedisService):
+        def __init__(self):
+            super().__init__()
+            self.add_handler("GET", lambda args: self.data.get(args[0]))
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from ..butil.iobuf import IOBuf
+from ..rpc import errors
+from ..rpc.controller import Controller
+from ..rpc.protocol import (Protocol, ParseResult, ParseResultType,
+                            register_protocol)
+
+# ---- RESP codec -------------------------------------------------------
+
+REPLY_STATUS = "status"
+REPLY_ERROR = "error"
+REPLY_INTEGER = "integer"
+REPLY_BULK = "bulk"
+REPLY_ARRAY = "array"
+REPLY_NIL = "nil"
+
+
+class RedisReply:
+    __slots__ = ("type", "value")
+
+    def __init__(self, type_: str, value: Any = None):
+        self.type = type_
+        self.value = value
+
+    def is_error(self) -> bool:
+        return self.type == REPLY_ERROR
+
+    def __repr__(self) -> str:
+        return f"RedisReply({self.type}, {self.value!r})"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, RedisReply):
+            return (self.type, self.value) == (other.type, other.value)
+        return self.value == other
+
+
+def encode_command(*args) -> bytes:
+    """RESP array-of-bulk-strings encoding."""
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, str):
+            a = a.encode()
+        elif isinstance(a, (int, float)):
+            a = str(a).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+    return b"".join(out)
+
+
+def encode_reply(value: Any) -> bytes:
+    """Python value → RESP reply bytes."""
+    if isinstance(value, RedisReply):
+        if value.type == REPLY_STATUS:
+            return b"+%s\r\n" % str(value.value).encode()
+        if value.type == REPLY_ERROR:
+            return b"-%s\r\n" % str(value.value).encode()
+        value = value.value
+    if value is None:
+        return b"$-1\r\n"
+    if isinstance(value, bool):
+        return b":%d\r\n" % int(value)
+    if isinstance(value, int):
+        return b":%d\r\n" % value
+    if isinstance(value, str):
+        value = value.encode()
+    if isinstance(value, (bytes, bytearray)):
+        return b"$%d\r\n%s\r\n" % (len(value), bytes(value))
+    if isinstance(value, (list, tuple)):
+        return b"*%d\r\n" % len(value) + b"".join(
+            encode_reply(v) for v in value)
+    raise TypeError(f"cannot encode {type(value)} as RESP")
+
+
+def _parse_one(data: bytes, pos: int) -> Optional[Tuple[RedisReply, int]]:
+    """Parse one reply at pos; None if incomplete."""
+    if pos >= len(data):
+        return None
+    line_end = data.find(b"\r\n", pos)
+    if line_end < 0:
+        return None
+    marker = data[pos:pos + 1]
+    line = data[pos + 1:line_end]
+    nxt = line_end + 2
+    if marker == b"+":
+        return RedisReply(REPLY_STATUS, line.decode()), nxt
+    if marker == b"-":
+        return RedisReply(REPLY_ERROR, line.decode()), nxt
+    if marker == b":":
+        return RedisReply(REPLY_INTEGER, int(line)), nxt
+    if marker == b"$":
+        n = int(line)
+        if n < 0:
+            return RedisReply(REPLY_NIL), nxt
+        if len(data) < nxt + n + 2:
+            return None
+        return RedisReply(REPLY_BULK, data[nxt:nxt + n]), nxt + n + 2
+    if marker == b"*":
+        n = int(line)
+        if n < 0:
+            return RedisReply(REPLY_NIL), nxt
+        items = []
+        for _ in range(n):
+            r = _parse_one(data, nxt)
+            if r is None:
+                return None
+            item, nxt = r
+            items.append(item)
+        return RedisReply(REPLY_ARRAY, items), nxt
+    raise ValueError(f"bad RESP marker {marker!r}")
+
+
+# ---- request/response objects ----------------------------------------
+
+class RedisRequest:
+    def __init__(self):
+        self._commands: List[bytes] = []
+        self.command_names: List[str] = []
+
+    def add_command(self, *args) -> None:
+        self._commands.append(encode_command(*args))
+        self.command_names.append(str(args[0]).upper())
+
+    def command_count(self) -> int:
+        return len(self._commands)
+
+    def serialize(self) -> bytes:
+        return b"".join(self._commands)
+
+
+class RedisResponse:
+    def __init__(self):
+        self.replies: List[RedisReply] = []
+
+    def reply(self, i: int = 0) -> RedisReply:
+        return self.replies[i]
+
+    def reply_count(self) -> int:
+        return len(self.replies)
+
+
+# ---- client side ------------------------------------------------------
+
+class _PipelinedRedisCtx:
+    __slots__ = ("cid", "expected", "replies")
+
+    def __init__(self, cid: int, expected: int):
+        self.cid = cid
+        self.expected = expected
+        self.replies: List[RedisReply] = []
+
+
+def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
+    """Cut ALL complete RESP units into ONE bundle.  Unlike tpu_std frames
+    (independent, processed concurrently), pipelined redis commands must be
+    handled strictly in order — bundling keeps the batch on one processor
+    (the reference's redis server consumes command batches serially too)."""
+    data = source.fetch(len(source))
+    if not data:
+        return ParseResult.not_enough_data()
+    if data[:1] not in b"+-:$*":
+        return ParseResult.try_others()
+    units: List[RedisReply] = []
+    pos = 0
+    try:
+        while pos < len(data):
+            r = _parse_one(data, pos)
+            if r is None:
+                break
+            reply, pos = r
+            units.append(reply)
+    except (ValueError, IndexError) as e:
+        return ParseResult.parse_error(str(e))
+    if not units:
+        return ParseResult.not_enough_data()
+    source.pop_front(pos)
+    return ParseResult.ok(units)
+
+
+def serialize_request(request: Any, cntl: Controller) -> IOBuf:
+    buf = IOBuf()
+    if isinstance(request, RedisRequest):
+        buf.append(request.serialize())
+        cntl._redis_expected = request.command_count()
+    elif isinstance(request, (list, tuple)):
+        buf.append(encode_command(*request))
+        cntl._redis_expected = 1
+    else:
+        raise TypeError("redis request must be RedisRequest or arg tuple")
+    return buf
+
+
+def pack_request(payload: IOBuf, cid: int, cntl: Controller,
+                 method_full_name: str) -> IOBuf:
+    out = IOBuf()
+    out.append(payload)
+    return out
+
+
+def process_response(bundle: List[RedisReply], socket) -> None:
+    """Replies correlate by arrival order; one ctx may span several."""
+    from ..bthread import id as bthread_id
+    for msg in bundle:
+        with socket._pipeline_lock:
+            ctx = (socket.pipelined_contexts[0]
+                   if socket.pipelined_contexts else None)
+        if ctx is None:
+            return
+        ctx.replies.append(msg)
+        if len(ctx.replies) < ctx.expected:
+            continue
+        with socket._pipeline_lock:
+            if socket.pipelined_contexts and socket.pipelined_contexts[0] is ctx:
+                socket.pipelined_contexts.pop(0)
+        rc, cntl = bthread_id.lock(ctx.cid)
+        if rc != 0 or cntl is None:
+            continue
+        resp = RedisResponse()
+        resp.replies = ctx.replies
+        cntl.response = resp
+        cntl.remote_side = socket.remote_side
+        cntl.finish_parsed_response(ctx.cid)
+
+
+# ---- server side ------------------------------------------------------
+
+class RedisService:
+    """Server-side redis dispatcher (reference RedisService): register
+    command handlers; unknown commands get -ERR."""
+
+    def __init__(self):
+        self._handlers: Dict[str, Callable[[List[bytes]], Any]] = {}
+        self.add_handler("PING", lambda args: RedisReply(REPLY_STATUS, "PONG"))
+        self.add_handler("COMMAND", lambda args: [])
+
+    def add_handler(self, command: str,
+                    fn: Callable[[List[bytes]], Any]) -> None:
+        self._handlers[command.upper()] = fn
+
+    def dispatch(self, command: List[RedisReply]) -> bytes:
+        if not command:
+            return encode_reply(RedisReply(REPLY_ERROR, "ERR empty command"))
+        parts = [c.value if isinstance(c.value, (bytes, bytearray))
+                 else str(c.value).encode() for c in command]
+        name = parts[0].decode().upper()
+        fn = self._handlers.get(name)
+        if fn is None:
+            return encode_reply(RedisReply(
+                REPLY_ERROR, f"ERR unknown command '{name}'"))
+        try:
+            return encode_reply(fn(parts[1:]))
+        except Exception as e:
+            return encode_reply(RedisReply(REPLY_ERROR, f"ERR {e}"))
+
+
+def process_request(bundle: List[RedisReply], socket, server) -> None:
+    svc = getattr(server, "redis_service", None)
+    if svc is None:
+        socket.write(IOBuf(encode_reply(RedisReply(
+            REPLY_ERROR, "ERR this server has no RedisService"))))
+        return
+    out = []
+    for msg in bundle:          # strict order within the pipeline batch
+        if msg.type == REPLY_ARRAY:
+            out.append(svc.dispatch(msg.value))
+        else:                   # inline command
+            parts = [RedisReply(REPLY_BULK, p) for p in bytes(
+                msg.value if isinstance(msg.value, (bytes, bytearray))
+                else str(msg.value).encode()).split()]
+            out.append(svc.dispatch(parts))
+    socket.write(IOBuf(b"".join(out)))
+
+
+def _make_pipeline_ctx(cid: int, cntl: Controller) -> _PipelinedRedisCtx:
+    return _PipelinedRedisCtx(cid, getattr(cntl, "_redis_expected", 1))
+
+
+PROTOCOL = Protocol(
+    name="redis",
+    parse=parse,
+    process_request=process_request,
+    process_response=process_response,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    pipelined=True,
+    make_pipeline_ctx=_make_pipeline_ctx,
+)
+
+
+def _register() -> None:
+    from ..rpc.protocol import find_protocol
+    if find_protocol("redis") is None:
+        register_protocol(PROTOCOL)
+
+
+_register()

@@ -89,7 +89,9 @@ class Channel:
             cntl.span.annotate("issue try=%d to %s" % (cntl.current_try,
                                                        sock.remote_side))
         if self._protocol.pipelined:
-            sock.push_pipelined_context(cid)
+            maker = getattr(self._protocol, "make_pipeline_ctx", None)
+            ctx = maker(cid, cntl) if maker is not None else cid
+            sock.push_pipelined_context(ctx)
         rc = sock.write(packet, notify_cid=cid)
         if rc != 0:
             raise ConnectionError(f"write failed: {rc}")

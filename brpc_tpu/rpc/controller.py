@@ -229,6 +229,11 @@ class Controller:
             self.set_failed(errors.ERESPONSE, f"fail to parse response: {e}")
         self._end_rpc(cid)
 
+    def finish_parsed_response(self, cid: int) -> None:
+        """Completion for protocols that parse the response themselves
+        (http/redis/memcache): cntl.response is already set."""
+        self._end_rpc(cid)
+
     def handle_parsed_http_response(self, cid: int, http_msg) -> None:
         """HTTP client completion: response object was already parsed by the
         protocol (json2pb); just record and finish."""

@@ -74,6 +74,8 @@ class Protocol:
     # responses correlate by arrival order on the connection instead of an
     # embedded correlation id (HTTP/1.1, redis, memcache pipelining)
     pipelined: bool = False
+    # optional: build the per-call pipeline context (default: the raw cid)
+    make_pipeline_ctx: Optional[Callable[[int, Any], Any]] = None
 
 
 _protocols: List[Protocol] = []

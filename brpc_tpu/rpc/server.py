@@ -58,9 +58,14 @@ class Server:
         self._conn_lock = threading.Lock()
 
     # ---- registry -----------------------------------------------------
-    def add_service(self, svc: Service) -> int:
+    def add_service(self, svc) -> int:
         if self._started:
             raise RuntimeError("cannot add service after start")
+        # RedisService-style dispatchers register as the connection-level
+        # redis handler (duck-typed to avoid a policy import cycle)
+        if hasattr(svc, "dispatch") and hasattr(svc, "add_handler"):
+            self.redis_service = svc
+            return 0
         name = svc.service_name()
         if name in self._services:
             return errors.EINVAL

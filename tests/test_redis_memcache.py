@@ -1,0 +1,267 @@
+"""Redis + memcache protocol tests (reference test/brpc_redis_unittest.cpp /
+brpc_memcache_unittest.cpp patterns: golden-byte codec checks + in-process
+servers)."""
+import struct
+import threading
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.policy import redis as redis_proto
+from brpc_tpu.policy import memcache as mc
+from brpc_tpu.butil.iobuf import IOBuf
+
+_seq = [3000]
+
+
+def unique(p):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class TestRespCodec:
+    def test_encode_command_golden(self):
+        assert redis_proto.encode_command("SET", "k", "v") == \
+            b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+
+    def test_parse_replies_golden(self):
+        cases = [
+            (b"+OK\r\n", redis_proto.REPLY_STATUS, "OK"),
+            (b"-ERR nope\r\n", redis_proto.REPLY_ERROR, "ERR nope"),
+            (b":42\r\n", redis_proto.REPLY_INTEGER, 42),
+            (b"$5\r\nhello\r\n", redis_proto.REPLY_BULK, b"hello"),
+            (b"$-1\r\n", redis_proto.REPLY_NIL, None),
+        ]
+        for raw, typ, val in cases:
+            reply, consumed = redis_proto._parse_one(raw, 0)
+            assert consumed == len(raw)
+            assert reply.type == typ
+            assert reply.value == val
+
+    def test_parse_array(self):
+        raw = b"*2\r\n$1\r\na\r\n:7\r\n"
+        reply, consumed = redis_proto._parse_one(raw, 0)
+        assert reply.type == redis_proto.REPLY_ARRAY
+        assert reply.value[0].value == b"a"
+        assert reply.value[1].value == 7
+
+    def test_partial_returns_none(self):
+        assert redis_proto._parse_one(b"$5\r\nhel", 0) is None
+        assert redis_proto._parse_one(b"*2\r\n$1\r\na\r\n", 0) is None
+
+    def test_encode_reply_roundtrip(self):
+        for value in ["s", b"b", 7, None, [b"x", 1]]:
+            raw = redis_proto.encode_reply(value)
+            reply, consumed = redis_proto._parse_one(raw, 0)
+            assert consumed == len(raw)
+
+
+class KvRedis(redis_proto.RedisService):
+    def __init__(self):
+        super().__init__()
+        self.data = {}
+        self.add_handler("SET", self._set)
+        self.add_handler("GET", self._get)
+        self.add_handler("DEL", self._del)
+        self.add_handler("INCR", self._incr)
+
+    def _set(self, args):
+        self.data[bytes(args[0])] = bytes(args[1])
+        return redis_proto.RedisReply(redis_proto.REPLY_STATUS, "OK")
+
+    def _get(self, args):
+        return self.data.get(bytes(args[0]))
+
+    def _del(self, args):
+        return 1 if self.data.pop(bytes(args[0]), None) is not None else 0
+
+    def _incr(self, args):
+        v = int(self.data.get(bytes(args[0]), b"0")) + 1
+        self.data[bytes(args[0])] = str(v).encode()
+        return v
+
+
+class TestRedisEndToEnd:
+    def _start(self):
+        server = rpc.Server()
+        server.add_service(KvRedis())
+        name = unique("redis")
+        assert server.start(f"mem://{name}") == 0
+        ch = rpc.Channel()
+        ch.init(f"mem://{name}",
+                options=rpc.ChannelOptions(protocol="redis", timeout_ms=5000))
+        return server, ch
+
+    def test_set_get(self):
+        server, ch = self._start()
+        try:
+            req = redis_proto.RedisRequest()
+            req.add_command("SET", "name", "tpu")
+            req.add_command("GET", "name")
+            cntl = rpc.Controller()
+            resp = ch.call_method("redis", cntl, req, None)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.reply(0).value == "OK"
+            assert resp.reply(1).value == b"tpu"
+        finally:
+            server.stop()
+
+    def test_pipeline_many(self):
+        server, ch = self._start()
+        try:
+            req = redis_proto.RedisRequest()
+            for i in range(10):
+                req.add_command("INCR", "ctr")
+            cntl = rpc.Controller()
+            resp = ch.call_method("redis", cntl, req, None)
+            assert not cntl.failed(), cntl.error_text
+            assert [r.value for r in resp.replies] == list(range(1, 11))
+        finally:
+            server.stop()
+
+    def test_unknown_command(self):
+        server, ch = self._start()
+        try:
+            cntl = rpc.Controller()
+            resp = ch.call_method("redis", cntl, ("BOGUS",), None)
+            assert not cntl.failed()
+            assert resp.reply(0).is_error()
+        finally:
+            server.stop()
+
+    def test_ping(self):
+        server, ch = self._start()
+        try:
+            cntl = rpc.Controller()
+            resp = ch.call_method("redis", cntl, ("PING",), None)
+            assert resp.reply(0).value == "PONG"
+        finally:
+            server.stop()
+
+
+class MiniMemcached:
+    """In-process memcached speaking the binary protocol (test fixture —
+    the reference tests against golden bytes + real memcached)."""
+
+    def __init__(self):
+        self.data = {}
+
+    def handle_frame(self, frame: bytes) -> bytes:
+        (magic, opcode, keylen, extraslen, _dt, _vb, bodylen, opaque,
+         cas) = mc._HDR.unpack(frame[:24])
+        body = frame[24:24 + bodylen]
+        extras = body[:extraslen]
+        key = body[extraslen:extraslen + keylen]
+        value = body[extraslen + keylen:]
+        status = mc.STATUS_OK
+        rextras = b""
+        rvalue = b""
+        if opcode == mc.OP_SET:
+            self.data[key] = value
+        elif opcode == mc.OP_GET:
+            if key in self.data:
+                rextras = struct.pack(">I", 0)
+                rvalue = self.data[key]
+            else:
+                status = mc.STATUS_KEY_NOT_FOUND
+        elif opcode == mc.OP_DELETE:
+            if self.data.pop(key, None) is None:
+                status = mc.STATUS_KEY_NOT_FOUND
+        elif opcode == mc.OP_INCREMENT:
+            delta, initial, _ = struct.unpack(">QQI", extras)
+            cur = int(self.data.get(key, str(initial).encode()))
+            if key in self.data:
+                cur += delta
+            self.data[key] = str(cur).encode()
+            rvalue = struct.pack(">Q", cur)
+        elif opcode == mc.OP_VERSION:
+            rvalue = b"1.6.0-tpu"
+        hdr = mc._HDR.pack(mc.MAGIC_RESPONSE, opcode, 0, len(rextras), 0,
+                           status, len(rextras) + len(rvalue), opaque, cas)
+        return hdr + rextras + rvalue
+
+
+def start_mini_memcached():
+    """Serve the binary protocol over a mem:// listener."""
+    from brpc_tpu.rpc.mem_transport import mem_listen
+    from brpc_tpu.rpc.protocol import Protocol
+    from brpc_tpu.rpc.input_messenger import InputMessenger
+
+    backend = MiniMemcached()
+
+    def parse_req(source, socket, read_eof, arg):
+        from brpc_tpu.rpc.protocol import ParseResult
+        data = source.fetch(len(source)) or b""
+        if len(data) < 24:
+            return ParseResult.not_enough_data()
+        if data[0] != mc.MAGIC_REQUEST:
+            return ParseResult.try_others()
+        frames, pos = [], 0
+        while pos + 24 <= len(data):
+            bodylen = mc._HDR.unpack(data[pos:pos + 24])[6]
+            if pos + 24 + bodylen > len(data):
+                break
+            frames.append(data[pos:pos + 24 + bodylen])
+            pos += 24 + bodylen
+        if not frames:
+            return ParseResult.not_enough_data()
+        source.pop_front(pos)
+        return ParseResult.ok(frames)
+
+    def process_req(frames, socket, server):
+        out = b"".join(backend.handle_frame(f) for f in frames)
+        socket.write(IOBuf(out))
+
+    proto = Protocol(name="mini_memcached", parse=parse_req,
+                     process_request=process_req)
+    messenger = InputMessenger(protocols=[proto], server=object())
+
+    name = unique("mc")
+
+    def on_accept(sock):
+        sock.messenger = messenger
+
+    listener = mem_listen(name, on_accept)
+    return backend, f"mem://{name}", listener
+
+
+class TestMemcacheClient:
+    def test_set_get_delete_incr(self):
+        backend, target, listener = start_mini_memcached()
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(protocol="memcache",
+                                                       timeout_ms=5000))
+            req = mc.MemcacheRequest()
+            req.set("k", "val")
+            req.get("k")
+            req.incr("n", 5, initial=10)
+            req.delete("k")
+            req.get("k")
+            cntl = rpc.Controller()
+            resp = ch.call_method("memcache", cntl, req, None)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.op(0).ok()
+            assert resp.op(1).value == b"val"
+            assert struct.unpack(">Q", resp.op(2).value)[0] == 10
+            assert resp.op(3).ok()
+            assert resp.op(4).status == mc.STATUS_KEY_NOT_FOUND
+        finally:
+            from brpc_tpu.rpc.mem_transport import mem_unlisten
+            mem_unlisten(listener.name)
+
+    def test_version(self):
+        backend, target, listener = start_mini_memcached()
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(protocol="memcache",
+                                                       timeout_ms=5000))
+            req = mc.MemcacheRequest()
+            req.version()
+            cntl = rpc.Controller()
+            resp = ch.call_method("memcache", cntl, req, None)
+            assert resp.op(0).value == b"1.6.0-tpu"
+        finally:
+            from brpc_tpu.rpc.mem_transport import mem_unlisten
+            mem_unlisten(listener.name)
