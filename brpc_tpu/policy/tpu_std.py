@@ -171,6 +171,9 @@ def process_request(msg: StdMessage, socket, server) -> None:
     if req_meta.timeout_ms:
         cntl.method_deadline = time.monotonic() + req_meta.timeout_ms / 1000.0
 
+    from ..rpc.span import start_server_span, end_server_span
+    start_server_span(cntl, full_name, req_meta.trace_id,
+                      req_meta.span_id)
     md = server.find_method(full_name)
     status = server.method_status(full_name) if md is not None else None
     server_counted = [False]
@@ -202,6 +205,8 @@ def process_request(msg: StdMessage, socket, server) -> None:
             rmeta.attachment_size = att_size
             payload.append(cntl.response_attachment)
         socket.write(pack_frame(rmeta, payload))
+        if cntl.span is not None:
+            end_server_span(cntl)
         if status is not None:
             status.on_responded(cntl.error_code_,
                                 time.monotonic_ns() // 1000 - start_us)

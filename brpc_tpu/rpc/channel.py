@@ -28,6 +28,7 @@ class ChannelOptions:
     max_retry: int = 3
     backup_request_ms: int = 0          # 0 = disabled
     connect_timeout_ms: int = 1000
+    auth: object = None                 # Authenticator
 
 
 class Channel:
@@ -67,6 +68,8 @@ class Channel:
                     request: Any, response_cls: Any = None,
                     done: Optional[Callable[[Controller], None]] = None):
         """Sync when done is None (returns the response); async otherwise."""
+        if self.options.auth is not None and not cntl.auth_token:
+            cntl.auth_token = self.options.auth.generate_credential(cntl)
         payload = self._protocol.serialize_request(request, cntl)
         if cntl.span is None:
             from .span import maybe_start_client_span

@@ -26,7 +26,7 @@ from .service import MethodDescriptor, Service
 class ServerOptions:
     max_concurrency: int = 0            # 0 = unlimited; else ELIMIT beyond
     method_max_concurrency: Dict[str, Any] = field(default_factory=dict)
-    auth = None                         # Authenticator
+    auth: Any = None                    # Authenticator
     enable_builtin_services: bool = True
     server_info_name: str = ""
     idle_timeout_s: int = -1

