@@ -56,6 +56,7 @@ class BuiltinDispatcher:
         self.add("index", _index)
         self.add("version", _version)
         self.add("hotspots", _hotspots)
+        self.add("contention", _contention)
 
 
 def _health(server, q):
@@ -172,14 +173,28 @@ def _ids(server, q):
 
 
 def _hotspots(server, q):
-    """CPU profile via Python's stdlib profilers (the gperftools stand-in:
-    hotspots_service.cpp invokes ProfilerStart/pprof)."""
-    seconds = float(q.get("seconds", "1"))
-    import cProfile, pstats, io, threading
-    return "text/plain", (
-        "profiling requires in-process invocation; use "
-        "brpc_tpu.tools.profiler.profile_for(seconds) — HTTP-triggered "
-        f"sampling of {seconds}s is available via /pprof/profile")
+    """CPU profile (the gperftools/pprof stand-in: hotspots_service.cpp)."""
+    from ..profiler import profile_for
+    seconds = min(float(q.get("seconds", "1")), 30.0)
+    return "text/plain", profile_for(seconds, top=int(q.get("top", "40")))
+
+
+def _contention(server, q):
+    """Lock contention profile (bthread/mutex.cpp contention profiler)."""
+    from ..profiler import (contention_profile, enable_contention_profiler,
+                            _contention_enabled)
+    if q.get("enable") == "1":
+        enable_contention_profiler(True)
+        return "text/plain", "contention profiler enabled"
+    if q.get("enable") == "0":
+        enable_contention_profiler(False)
+        return "text/plain", "contention profiler disabled"
+    rows = contention_profile()
+    lines = [f"enabled: {_contention_enabled}",
+             f"{'total_wait_s':>12}  {'samples':>8}  site"]
+    for site, n, total in rows[:50]:
+        lines.append(f"{total:12.4f}  {n:8d}  {site}")
+    return "text/plain", "\n".join(lines) + "\n"
 
 
 def _index(server, q):
