@@ -124,6 +124,19 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
     return pack_frame(meta, body)
 
 
+def process_inline(msg: StdMessage, socket) -> bool:
+    """Reader-order consumption of stream frames (data/feedback/close):
+    their relative order is the stream's byte order, so they must never go
+    through the concurrent per-message dispatch."""
+    meta = msg.meta
+    if (meta.correlation_id == 0 and not meta.request.service_name
+            and meta.HasField("stream_settings")):
+        from ..rpc.stream import on_stream_frame
+        on_stream_frame(meta, msg.body, socket)
+        return True
+    return False
+
+
 def process_response(msg: StdMessage, socket) -> None:
     """ProcessRpcResponse: lock the correlation id; stale versions fail to
     lock and the response is dropped (the retry-race resolution)."""
@@ -281,6 +294,7 @@ PROTOCOL = Protocol(
     process_response=process_response,
     serialize_request=serialize_request,
     pack_request=pack_request,
+    process_inline=process_inline,
 )
 
 

@@ -17,3 +17,8 @@ from .stream import (Stream, StreamOptions, StreamInputHandler, stream_create,
                      stream_accept, find_stream)
 from .circuit_breaker import CircuitBreaker, ClusterRecoverPolicy, BreakerRegistry
 from .health_check import start_health_check, probe_endpoint, HealthCheckTask
+from .progressive import (ProgressiveReader, ProgressiveAttachment,
+                          response_will_be_read_progressively,
+                          create_progressive_attachment)
+from . import profiler
+from . import rpc_dump

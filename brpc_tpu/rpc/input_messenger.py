@@ -94,9 +94,14 @@ class InputMessenger:
                 break
             if result.type == ParseResultType.ERROR:
                 return None
-            out.append((proto, result.message))
             socket.stat.in_num_messages += 1
             _g_messages << 1
+            # order-sensitive messages (stream frames) are consumed here,
+            # in cut order, before per-message tasklet dispatch can reorder
+            if proto.process_inline is not None and proto.process_inline(
+                    result.message, socket):
+                continue
+            out.append((proto, result.message))
         return out
 
     def _queue_message(self, proto: Protocol, msg: Any, socket) -> None:

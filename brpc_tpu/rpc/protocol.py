@@ -76,6 +76,9 @@ class Protocol:
     pipelined: bool = False
     # optional: build the per-call pipeline context (default: the raw cid)
     make_pipeline_ctx: Optional[Callable[[int, Any], Any]] = None
+    # optional: consume order-sensitive messages in the reader, in cut order
+    # (stream frames: cheap enqueue/credit ops).  Returns True if consumed.
+    process_inline: Optional[Callable[[Any, Any], bool]] = None
 
 
 _protocols: List[Protocol] = []

@@ -1,0 +1,82 @@
+"""Progressive attachment tests (reference progressive_attachment semantics)."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [5000]
+
+
+def unique(p):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class DownloadService(rpc.Service):
+    def __init__(self, nparts=5, part=b"x" * 1000):
+        self.nparts = nparts
+        self.part = part
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Download(self, cntl, request, response, done):
+        pa = rpc.create_progressive_attachment(cntl)
+        response.message = "header"
+        done()                       # response header out first
+
+        def feed():
+            for i in range(self.nparts):
+                assert pa.append(b"%d:" % i + self.part) == 0
+            pa.close()
+
+        threading.Thread(target=feed).start()
+
+
+class TestProgressive:
+    def test_parts_stream_after_response(self):
+        server = rpc.Server()
+        server.add_service(DownloadService())
+        name = unique("dl")
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}")
+            reader = rpc.ProgressiveReader()
+            cntl = rpc.Controller()
+            rpc.response_will_be_read_progressively(cntl, reader)
+            resp = ch.call_method("DownloadService.Download", cntl,
+                                  EchoRequest(message="get"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "header"       # header arrived first
+            assert reader.wait(10)
+            assert reader.error_code == 0
+            data = reader.data()
+            assert data.startswith(b"0:")
+            assert len(data) == 5 * (1000 + 2)
+            # order preserved
+            for i in range(5):
+                assert b"%d:" % i in data
+        finally:
+            server.stop()
+
+    def test_large_progressive_with_flow_control(self):
+        server = rpc.Server()
+        server.add_service(DownloadService(nparts=40, part=b"y" * 4096))
+        name = unique("dl")
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}")
+            got = []
+            reader = rpc.ProgressiveReader(on_part=lambda d: got.append(len(d)))
+            cntl = rpc.Controller()
+            rpc.response_will_be_read_progressively(cntl, reader)
+            ch.call_method("DownloadService.Download", cntl,
+                           EchoRequest(message="g"), EchoResponse)
+            assert reader.wait(15)
+            assert sum(got) == 40 * (4096 + len(b"0:")) or sum(got) > 40 * 4096
+        finally:
+            server.stop()
