@@ -127,28 +127,40 @@ class Stream:
         self._writable_butex.wake_all_and_set(1)
 
     # -- receiver -------------------------------------------------------
+    _CLOSE_MARKER = object()
+
     def on_data(self, data: IOBuf) -> None:
         if self._exec is None:
             self._exec = ExecutionQueue(self._consume_batch)
         self._exec.execute(data)
 
     def _consume_batch(self, it) -> None:
-        msgs = [m for m in it]
-        if not msgs:
-            return
+        msgs = []
+        fire_closed = False
+        for m in it:
+            if m is Stream._CLOSE_MARKER:
+                fire_closed = True
+            else:
+                msgs.append(m)
         handler = self.options.handler
-        if handler is not None:
+        if msgs and handler is not None:
             try:
                 handler.on_received_messages(self.sid, msgs)
             except Exception:
                 from ..butil import logging as log
                 log.error("stream handler raised", exc_info=True)
-        consumed = sum(len(m) for m in msgs)
-        self._local_consumed += consumed
-        # feedback when half a window was consumed since the last report
-        if (self._local_consumed - self._last_feedback
-                >= self.options.max_buf_size // 2):
-            self.send_feedback()
+        if msgs:
+            consumed = sum(len(m) for m in msgs)
+            self._local_consumed += consumed
+            # feedback when half a window was consumed since the last report
+            if (self._local_consumed - self._last_feedback
+                    >= self.options.max_buf_size // 2):
+                self.send_feedback()
+        if fire_closed and handler is not None:
+            try:
+                handler.on_closed(self.sid)
+            except Exception:
+                pass
 
     def send_feedback(self) -> None:
         self._last_feedback = self._local_consumed
@@ -182,13 +194,16 @@ class Stream:
     def _on_closed_local(self) -> None:
         self._writable_butex.wake_all_and_set(1)
         if self._exec is not None:
+            # ordered after every queued data batch, then the queue stops
+            self._exec.execute(Stream._CLOSE_MARKER)
             self._exec.stop()
-        h = self.options.handler
-        if h is not None:
-            try:
-                h.on_closed(self.sid)
-            except Exception:
-                pass
+        else:
+            h = self.options.handler
+            if h is not None:
+                try:
+                    h.on_closed(self.sid)
+                except Exception:
+                    pass
         _pool_remove(self.sid)
 
     def on_remote_close(self) -> None:
