@@ -29,6 +29,7 @@ class ChannelOptions:
     backup_request_ms: int = 0          # 0 = disabled
     connect_timeout_ms: int = 1000
     auth: object = None                 # Authenticator
+    ssl_context: object = None          # ssl.SSLContext for TLS channels
 
 
 class Channel:
@@ -117,7 +118,8 @@ class Channel:
             sock = smap.get_short_socket(ep, self.messenger)
             cntl._short_socket = sock
         else:
-            sock = smap.get_socket(ep, self.messenger)
+            sock = smap.get_socket(ep, self.messenger,
+                                   ssl_context=self.options.ssl_context)
         return sock
 
     def _on_call_end(self, cntl: Controller) -> None:

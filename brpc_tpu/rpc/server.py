@@ -37,6 +37,7 @@ class ServerOptions:
     # its usercode_in_pthread flag is the inverse).  Minimal latency; only
     # safe when handlers are fast/non-blocking.
     usercode_inline: bool = False
+    ssl_context: Any = None             # ssl.SSLContext for TLS listeners
 
 
 class Server:
@@ -142,7 +143,8 @@ class Server:
             self._mem_listener = mem_listen(ep.host, self._on_accept)
         elif ep.scheme == SCHEME_TCP:
             from .tcp_transport import Acceptor
-            self._acceptor = Acceptor(self._on_accept)
+            self._acceptor = Acceptor(self._on_accept,
+                                      ssl_context=self.options.ssl_context)
             port = self._acceptor.start(ep.host or "0.0.0.0", ep.port)
             ep = EndPoint(scheme=SCHEME_TCP, host=ep.host or "0.0.0.0",
                           port=port)

@@ -45,13 +45,14 @@ class SocketMap:
                 self._map[ep] = e
             return e
 
-    def get_socket(self, ep: EndPoint, messenger=None) -> Socket:
+    def get_socket(self, ep: EndPoint, messenger=None,
+                   ssl_context=None) -> Socket:
         """The shared 'single' connection to ep (creates/replaces lazily)."""
         e = self._entry(ep)
         with e.lock:
             if e.socket is not None and not e.socket.failed:
                 return e.socket
-            s = self._connect(ep)
+            s = self._connect(ep, ssl_context)
             s.messenger = messenger
             e.socket = s
             return s
@@ -82,13 +83,13 @@ class SocketMap:
         return s
 
     @staticmethod
-    def _connect(ep: EndPoint) -> Socket:
+    def _connect(ep: EndPoint, ssl_context=None) -> Socket:
         if ep.scheme == SCHEME_MEM:
             from .mem_transport import mem_connect
             return mem_connect(ep.host)
         if ep.scheme == SCHEME_TCP:
             from .tcp_transport import tcp_connect
-            return tcp_connect(ep)
+            return tcp_connect(ep, ssl_context=ssl_context)
         if ep.scheme == SCHEME_ICI:
             from ..ici.transport import ici_connect
             return ici_connect(ep)

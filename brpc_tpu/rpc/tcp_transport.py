@@ -3,7 +3,10 @@
 Reference: Socket fd IO (socket.cpp DoWrite :1790 writev batching,
 HandleEpollOut :1336) and Acceptor (acceptor.cpp OnNewConnections :243,327).
 Non-blocking fds driven by the EventDispatcher; KeepWrite blocks on a butex
-that EPOLLOUT wakes.
+that EPOLLOUT wakes.  TLS (reference details/ssl_helper.cpp + Socket SSL
+state machine): pass an ``ssl.SSLContext`` — the handshake runs blocking at
+connect/accept, then the wrapped socket joins the normal non-blocking loop
+(SSLWantRead/WriteError map to EAGAIN).
 """
 from __future__ import annotations
 
@@ -43,12 +46,37 @@ class TcpSocket(Socket):
 
     # transport hooks ---------------------------------------------------
     def _do_write(self, data: IOBuf) -> int:
+        import ssl as _ssl
+        if isinstance(self.sock, _ssl.SSLSocket):
+            # SSL sockets cannot writev raw fds: send per-view
+            views = data.host_views()
+            if not views:
+                return 0
+            try:
+                n = self.sock.send(views[0])
+            except (_ssl.SSLWantWriteError, _ssl.SSLWantReadError,
+                    BlockingIOError, InterruptedError):
+                return -1
+            if n > 0:
+                data.pop_front(n)
+            return n
         try:
             return data.cut_into_file_descriptor(self.sock.fileno())
         except (BlockingIOError, InterruptedError):
             return -1
 
     def _do_read(self, portal: IOPortal, max_count: int) -> int:
+        import ssl as _ssl
+        if isinstance(self.sock, _ssl.SSLSocket):
+            try:
+                chunk = self.sock.recv(max_count)
+            except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError,
+                    BlockingIOError, InterruptedError):
+                return -1
+            if not chunk:
+                return 0
+            portal.append(chunk)
+            return len(chunk)
         return portal.append_from_socket(self.sock, max_count)
 
     def _wait_writable(self, timeout: float = 30.0) -> bool:
@@ -71,8 +99,13 @@ class TcpSocket(Socket):
             pass
 
 
-def tcp_connect(ep: EndPoint, timeout: float = 5.0) -> TcpSocket:
+def tcp_connect(ep: EndPoint, timeout: float = 5.0,
+                ssl_context=None, server_hostname: str = "") -> TcpSocket:
     raw = pysocket.create_connection((ep.host, ep.port), timeout=timeout)
+    if ssl_context is not None:
+        raw.settimeout(timeout)
+        raw = ssl_context.wrap_socket(
+            raw, server_hostname=server_hostname or ep.host)
     s = TcpSocket(raw, remote_side=ep)
     s.register_with_dispatcher()
     return s
@@ -82,7 +115,9 @@ class Acceptor:
     """Listener: accepts until EAGAIN, wraps each connection in a TcpSocket
     bound to the server's InputMessenger (acceptor.cpp)."""
 
-    def __init__(self, on_accept: Callable[[TcpSocket], None]):
+    def __init__(self, on_accept: Callable[[TcpSocket], None],
+                 ssl_context=None):
+        self.ssl_context = ssl_context
         self.on_accept = on_accept
         self.listen_sock: Optional[pysocket.socket] = None
         self.port = 0
@@ -112,6 +147,17 @@ class Acceptor:
                 continue
             except OSError:
                 return
+            if self.ssl_context is not None:
+                try:
+                    conn.settimeout(5.0)
+                    conn = self.ssl_context.wrap_socket(conn,
+                                                        server_side=True)
+                except Exception:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
             s = TcpSocket(conn, remote_side=EndPoint(
                 scheme=SCHEME_TCP, host=addr[0], port=addr[1]))
             s.is_server_side = True

@@ -1,0 +1,100 @@
+"""TLS transport tests (reference SSL support, details/ssl_helper.cpp)."""
+import os
+import ssl
+import subprocess
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    key, crt = str(d / "key.pem"), str(d / "cert.pem")
+    subprocess.run([
+        "openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+        "-out", crt, "-days", "1", "-nodes", "-subj",
+        "/CN=localhost", "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+    ], check=True, capture_output=True)
+    return key, crt
+
+
+class EchoService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = "tls:" + request.message
+        done()
+
+
+class TestTls:
+    def test_tls_echo(self, certs):
+        key, crt = certs
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(crt, key)
+        opts = rpc.ServerOptions()
+        opts.ssl_context = server_ctx
+        server = rpc.Server(opts)
+        server.add_service(EchoService())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            client_ctx.load_verify_locations(crt)
+            copts = rpc.ChannelOptions(timeout_ms=5000)
+            copts.ssl_context = client_ctx
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}", options=copts)
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="secure"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "tls:secure"
+        finally:
+            server.stop()
+
+    def test_tls_large_payload(self, certs):
+        key, crt = certs
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(crt, key)
+        opts = rpc.ServerOptions()
+        opts.ssl_context = server_ctx
+        server = rpc.Server(opts)
+        server.add_service(EchoService())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            client_ctx.load_verify_locations(crt)
+            copts = rpc.ChannelOptions(timeout_ms=20000)
+            copts.ssl_context = client_ctx
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}", options=copts)
+            big = "z" * 500_000
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message=big), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "tls:" + big
+        finally:
+            server.stop()
+
+    def test_plaintext_client_rejected_by_tls_server(self, certs):
+        key, crt = certs
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(crt, key)
+        opts = rpc.ServerOptions()
+        opts.ssl_context = server_ctx
+        server = rpc.Server(opts)
+        server.add_service(EchoService())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}",
+                    options=rpc.ChannelOptions(timeout_ms=1000, max_retry=0))
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="nope"), EchoResponse)
+            assert cntl.failed()
+        finally:
+            server.stop()
