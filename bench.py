@@ -105,7 +105,9 @@ def bench_qps(seconds: float = 2.0, concurrency: int = 32):
             response.message = request.message
             done()
 
-    server = rpc.Server()
+    opts = rpc.ServerOptions()
+    opts.usercode_inline = True           # echo handler is non-blocking
+    server = rpc.Server(opts)
     server.add_service(EchoService())
     server.start("mem://bench-qps")
     ch = rpc.Channel()

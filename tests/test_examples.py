@@ -20,6 +20,7 @@ EXAMPLES = [
     "examples.ici_echo",
     "examples.http_server",
     "examples.auto_concurrency_limiter",
+    "examples.param_server",
 ]
 
 

@@ -1,0 +1,94 @@
+"""Cross-process DCN test: a real server in another process, tcp transport
+(the closest CI can get to multi-host; the reference's cluster tests used
+multiple machines)."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+SERVER_CODE = r'''
+import sys, os
+sys.path.insert(0, os.getcwd())
+sys.path.insert(0, "tests")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+class EchoService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = "remote:" + request.message
+        done()
+
+server = rpc.Server()
+server.add_service(EchoService())
+assert server.start("127.0.0.1:0") == 0
+print(f"PORT={server.listen_port}", flush=True)
+import time
+time.sleep(60)
+'''
+
+
+class TestCrossProcess:
+    def test_echo_to_another_process(self):
+        proc = subprocess.Popen([sys.executable, "-c", SERVER_CODE],
+                                stdout=subprocess.PIPE, text=True,
+                                cwd=os.getcwd())
+        try:
+            line = ""
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if line.startswith("PORT="):
+                    break
+            assert line.startswith("PORT="), "server did not start"
+            port = int(line.strip().split("=")[1])
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{port}",
+                    options=rpc.ChannelOptions(timeout_ms=10000))
+            for i in range(5):
+                cntl = rpc.Controller()
+                resp = ch.call_method("EchoService.Echo", cntl,
+                                      EchoRequest(message=f"x{i}"),
+                                      EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert resp.message == f"remote:x{i}"
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(10)
+
+    def test_client_survives_server_death(self):
+        proc = subprocess.Popen([sys.executable, "-c", SERVER_CODE],
+                                stdout=subprocess.PIPE, text=True,
+                                cwd=os.getcwd())
+        try:
+            line = proc.stdout.readline()
+            while not line.startswith("PORT="):
+                line = proc.stdout.readline()
+            port = int(line.strip().split("=")[1])
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{port}",
+                    options=rpc.ChannelOptions(timeout_ms=3000, max_retry=0))
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="a"), EchoResponse)
+            assert not cntl.failed()
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(10)
+            time.sleep(0.2)
+            cntl2 = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl2,
+                           EchoRequest(message="b"), EchoResponse)
+            assert cntl2.failed()      # clean failure, not a hang
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(10)
