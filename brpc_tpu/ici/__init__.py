@@ -5,3 +5,4 @@ from .transport import (IciSocket, ici_listen, ici_unlisten, ici_connect,
                         ici_transport_stats)
 from .collective import Collectives, default_collectives
 from .ring import ring_all_reduce, RingStream
+from . import pallas_ring

@@ -1,0 +1,192 @@
+"""Pallas ring collectives: the kernel-level RdmaEndpoint.
+
+Reference mapping (SURVEY.md §3.5): RdmaEndpoint posts zero-copy sends from
+registered blocks with a double-buffered sliding window and waits CQ
+completions.  On TPU the same machinery is a Pallas kernel:
+
+  * ``pltpu.make_async_remote_copy``  = ibv_post_send over ICI
+  * send/recv DMA semaphores          = completion queue events
+  * double-buffered VMEM comm slots   = the registered block ring (_sbuf/_rbuf)
+  * neighbor barrier semaphore        = the QP handshake
+
+Two kernels, each one hop per step around the logical ring:
+
+  * ``ring_all_gather(x)``  — every device ends with every chunk
+  * ``ring_all_reduce(x)``  — every device ends with the sum of all chunks
+
+Compiled natively on TPU; on CPU/test meshes they run in Pallas interpret
+mode (auto-detected) so CI exercises the exact kernel control flow the TPU
+executes.  The lax.ppermute-based path in ring.py remains the XLA-scheduled
+alternative; this module is the hand-scheduled one for when the compiler's
+schedule is the bottleneck.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from .mesh import IciMesh
+
+_cache: Dict[Tuple, Callable] = {}
+_cache_lock = threading.Lock()
+
+
+def _interpret_default() -> bool:
+    import jax
+    return jax.devices()[0].platform != "tpu"
+
+
+def _build_all_gather(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    n = mesh.size
+    ax = mesh.axis_name
+
+    def kernel(local_ref, out_ref, comm_buf, send_sem, recv_sem):
+        my_id = lax.axis_index(ax)
+        out_ref[pl.dslice(my_id, 1)] = local_ref[:][None]
+        comm_buf[0] = local_ref[:]
+
+        def step_body(step, _):
+            send_slot = lax.rem(step, 2)
+            recv_slot = 1 - send_slot
+            dst = lax.rem(my_id + 1, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[send_slot],
+                dst_ref=comm_buf.at[recv_slot],
+                send_sem=send_sem.at[send_slot],
+                recv_sem=recv_sem.at[recv_slot],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait()
+            src_dev = lax.rem(my_id - step - 1 + 2 * n, n)
+            out_ref[pl.dslice(src_dev, 1)] = comm_buf[recv_slot][None]
+            return 0
+
+        lax.fori_loop(0, n - 1, step_body, 0)
+
+    def per_device(x_local):            # (1, *chunk)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((n,) + chunk_shape, dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((2,) + chunk_shape, dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                                 collective_id=0),
+            interpret=interpret,
+        )(x_local[0])
+        return out[None]
+
+    return jax.jit(shard_map(per_device, mesh=mesh.mesh, in_specs=P(ax),
+                             out_specs=P(ax), check_vma=False))
+
+
+def _build_all_reduce(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    n = mesh.size
+    ax = mesh.axis_name
+
+    def kernel(local_ref, out_ref, acc_buf, comm_buf, send_sem, recv_sem):
+        """Ring accumulate: carry moves one hop per step, adding the local
+        chunk at every stop; after n-1 hops every carry holds the sum."""
+        my_id = lax.axis_index(ax)
+        acc_buf[0] = local_ref[:]       # the travelling carry (send side)
+
+        def step_body(step, _):
+            send_slot = lax.rem(step, 2)
+            recv_slot = 1 - send_slot
+            dst = lax.rem(my_id + 1, n)
+            comm_buf[send_slot] = acc_buf[0]
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[send_slot],
+                dst_ref=comm_buf.at[recv_slot],
+                send_sem=send_sem.at[send_slot],
+                recv_sem=recv_sem.at[recv_slot],
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait()
+            acc_buf[0] = comm_buf[recv_slot] + local_ref[:]
+            return 0
+
+        lax.fori_loop(0, n - 1, step_body, 0)
+        out_ref[:] = acc_buf[0]
+
+    def per_device(x_local):            # (1, *chunk)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(chunk_shape, dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((1,) + chunk_shape, dtype),
+                pltpu.VMEM((2,) + chunk_shape, dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                                 collective_id=1),
+            interpret=interpret,
+        )(x_local[0])
+        return out[None]
+
+    return jax.jit(shard_map(per_device, mesh=mesh.mesh, in_specs=P(ax),
+                             out_specs=P(ax), check_vma=False))
+
+
+def _cached(key: Tuple, builder: Callable) -> Callable:
+    with _cache_lock:
+        fn = _cache.get(key)
+        if fn is None:
+            fn = builder()
+            _cache[key] = fn
+        return fn
+
+
+def ring_all_gather(x, mesh: Optional[IciMesh] = None,
+                    interpret: Optional[bool] = None):
+    """x: (n, *chunk) sharded one row per device → (n, n, *chunk) sharded:
+    device d's row holds every device's chunk."""
+    mesh = mesh or IciMesh.default()
+    if mesh.size == 1:
+        return x[:, None]
+    interp = _interpret_default() if interpret is None else interpret
+    chunk_shape = tuple(x.shape[1:])
+    key = ("ag", mesh.size, chunk_shape, str(x.dtype), interp)
+    fn = _cached(key, lambda: _build_all_gather(mesh, chunk_shape, x.dtype,
+                                                interp))
+    return fn(x)
+
+
+def ring_all_reduce(x, mesh: Optional[IciMesh] = None,
+                    interpret: Optional[bool] = None):
+    """x: (n, *chunk) sharded → (n, *chunk) sharded where every row is the
+    elementwise sum over all rows."""
+    mesh = mesh or IciMesh.default()
+    if mesh.size == 1:
+        return x
+    interp = _interpret_default() if interpret is None else interpret
+    chunk_shape = tuple(x.shape[1:])
+    key = ("ar", mesh.size, chunk_shape, str(x.dtype), interp)
+    fn = _cached(key, lambda: _build_all_reduce(mesh, chunk_shape, x.dtype,
+                                                interp))
+    return fn(x)
