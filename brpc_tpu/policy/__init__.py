@@ -8,3 +8,5 @@ from . import naming
 from . import http
 from . import redis
 from . import memcache
+from . import thrift
+from . import auth

@@ -62,10 +62,13 @@ class Server:
     def add_service(self, svc) -> int:
         if self._started:
             raise RuntimeError("cannot add service after start")
-        # RedisService-style dispatchers register as the connection-level
-        # redis handler (duck-typed to avoid a policy import cycle)
+        # RedisService / ThriftService dispatchers register as connection-
+        # level protocol handlers (duck-typed to avoid policy import cycles)
         if hasattr(svc, "dispatch") and hasattr(svc, "add_handler"):
             self.redis_service = svc
+            return 0
+        if hasattr(svc, "handle") and hasattr(svc, "add_method"):
+            self.thrift_service = svc
             return 0
         name = svc.service_name()
         if name in self._services:

@@ -1,0 +1,132 @@
+"""Thrift framed protocol tests (reference WITH_THRIFT support,
+test pattern: codec golden checks + in-process server)."""
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.policy import thrift as tproto
+from brpc_tpu.policy.thrift import TType, ThriftMessage, ThriftService
+
+_seq = [6000]
+
+
+def unique(p):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+ARG_SPEC = {1: ("name", TType.STRING), 2: ("id", TType.I32),
+            3: ("scores", TType.LIST, (TType.DOUBLE, None))}
+RESULT_SPEC = {1: ("greeting", TType.STRING), 2: ("total", TType.DOUBLE)}
+
+
+class TestCodec:
+    def test_struct_roundtrip(self):
+        w = tproto._Writer()
+        values = {"name": b"alice", "id": 7, "scores": [1.5, 2.5]}
+        tproto.write_struct(w, values, ARG_SPEC)
+        out = tproto.read_struct(tproto._Reader(w.getvalue()), ARG_SPEC)
+        assert out["name"] == b"alice"
+        assert out["id"] == 7
+        assert out["scores"] == [1.5, 2.5]
+
+    def test_nested_struct_and_map(self):
+        inner = {1: ("x", TType.I64)}
+        spec = {1: ("child", TType.STRUCT, inner),
+                2: ("tags", TType.MAP,
+                    ((TType.STRING, None), (TType.I32, None)))}
+        w = tproto._Writer()
+        tproto.write_struct(w, {"child": {"x": 99},
+                                "tags": {b"a": 1, b"b": 2}}, spec)
+        out = tproto.read_struct(tproto._Reader(w.getvalue()), spec)
+        assert out["child"]["x"] == 99
+        assert out["tags"] == {b"a": 1, b"b": 2}
+
+    def test_unknown_fields_skipped(self):
+        w = tproto._Writer()
+        tproto.write_struct(w, {"name": b"n", "id": 3}, ARG_SPEC)
+        # read with a narrower spec: unknown fields must be skipped safely
+        out = tproto.read_struct(tproto._Reader(w.getvalue()),
+                                 {2: ("id", TType.I32)})
+        assert out == {"id": 3}
+
+    def test_message_framing(self):
+        raw = tproto.pack_message("Greet", tproto.MSG_CALL, 42, b"PAYLOAD")
+        import struct
+        assert struct.unpack(">i", raw[:4])[0] == len(raw) - 4
+        r = tproto._Reader(raw[4:])
+        ver = r.u32()
+        assert (ver & 0xFF) == tproto.MSG_CALL
+        assert r.string() == b"Greet"
+        assert r.i32() == 42
+
+
+def make_service():
+    svc = ThriftService()
+
+    def greet(args):
+        total = sum(args.get("scores", []))
+        return {"greeting": f"hello {args['name'].decode()}",
+                "total": total}
+
+    svc.add_method("Greet", greet, ARG_SPEC, RESULT_SPEC)
+    return svc
+
+
+class TestThriftEndToEnd:
+    def _start(self):
+        server = rpc.Server()
+        server.add_service(make_service())
+        name = unique("thrift")
+        assert server.start(f"mem://{name}") == 0
+        ch = rpc.Channel()
+        ch.init(f"mem://{name}",
+                options=rpc.ChannelOptions(protocol="thrift",
+                                           timeout_ms=5000))
+        return server, ch
+
+    def test_call(self):
+        server, ch = self._start()
+        try:
+            req = ThriftMessage("Greet",
+                                {"name": "bob", "id": 1,
+                                 "scores": [1.0, 2.0, 3.5]},
+                                ARG_SPEC, RESULT_SPEC)
+            cntl = rpc.Controller()
+            resp = ch.call_method("Greet", cntl, req, None)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.values["greeting"] == b"hello bob"
+            assert resp.values["total"] == 6.5
+        finally:
+            server.stop()
+
+    def test_unknown_method_is_exception(self):
+        server, ch = self._start()
+        try:
+            req = ThriftMessage("Nope", {}, {}, RESULT_SPEC)
+            cntl = rpc.Controller()
+            ch.call_method("Nope", cntl, req, None)
+            assert cntl.failed()
+            assert "unknown method" in cntl.error_text
+        finally:
+            server.stop()
+
+    def test_handler_exception_propagates(self):
+        svc = ThriftService()
+        svc.add_method("Boom", lambda args: 1 / 0, {}, RESULT_SPEC)
+        server = rpc.Server()
+        server.add_service(svc)
+        name = unique("thrift")
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}",
+                    options=rpc.ChannelOptions(protocol="thrift",
+                                               timeout_ms=5000))
+            cntl = rpc.Controller()
+            ch.call_method("Boom", cntl, ThriftMessage("Boom", {}, {}, {}),
+                           None)
+            assert cntl.failed()
+            assert "ZeroDivisionError" in cntl.error_text
+        finally:
+            server.stop()
