@@ -10,3 +10,4 @@ from . import redis
 from . import memcache
 from . import thrift
 from . import auth
+from . import grpc

@@ -1,0 +1,386 @@
+"""HTTP/2 + gRPC protocol (client + server).
+
+Reference: src/brpc/policy/http2_rpc_protocol.cpp + grpc.{h,cpp} +
+details/hpack.cpp.  Self-contained implementation of the h2 framing layer
+(RFC 7540: preface, SETTINGS/PING/WINDOW_UPDATE/HEADERS/DATA/RST/GOAWAY,
+stream states) with HPACK (policy/hpack.py), carrying gRPC semantics
+(RFC-style: 5-byte length-prefixed protobuf messages, ``:path`` =
+/Service/Method, trailers with grpc-status/grpc-message).
+
+Scope note: unary gRPC calls against our own client/server pair across all
+transports; grpc streaming and interop against foreign stacks are untested
+here (no grpc/h2 libraries in the image) — the frame and HPACK layers
+follow the RFCs so foreign interop is a validation task, not a redesign.
+
+Connection state (hpack tables, live streams, ids) hangs off the socket —
+the per-connection context the reference keeps in H2Context.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..butil.iobuf import IOBuf
+from ..rpc import errors
+from ..rpc.controller import Controller
+from ..rpc.protocol import Protocol, ParseResult, register_protocol
+from . import hpack
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+FRAME_DATA = 0x0
+FRAME_HEADERS = 0x1
+FRAME_RST_STREAM = 0x3
+FRAME_SETTINGS = 0x4
+FRAME_PING = 0x6
+FRAME_GOAWAY = 0x7
+FRAME_WINDOW_UPDATE = 0x8
+FRAME_CONTINUATION = 0x9
+
+FLAG_END_STREAM = 0x1
+FLAG_END_HEADERS = 0x4
+FLAG_ACK = 0x1
+
+GRPC_OK = 0
+GRPC_UNKNOWN = 2
+GRPC_UNIMPLEMENTED = 12
+GRPC_INTERNAL = 13
+
+_GRPC_TO_RPC = {GRPC_UNIMPLEMENTED: errors.ENOMETHOD,
+                GRPC_INTERNAL: errors.EINTERNAL}
+
+
+def frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes:
+    return (struct.pack(">I", len(payload))[1:]
+            + bytes([ftype, flags]) + struct.pack(">I", stream_id & 0x7FFFFFFF)
+            + payload)
+
+
+def grpc_message(pb_bytes: bytes) -> bytes:
+    return b"\x00" + struct.pack(">I", len(pb_bytes)) + pb_bytes
+
+
+def split_grpc_messages(data: bytes) -> List[bytes]:
+    out = []
+    pos = 0
+    while pos + 5 <= len(data):
+        _compressed = data[pos]
+        n = struct.unpack(">I", data[pos + 1:pos + 5])[0]
+        out.append(data[pos + 5:pos + 5 + n])
+        pos += 5 + n
+    return out
+
+
+class _H2Stream:
+    __slots__ = ("stream_id", "headers", "trailers", "data", "ended",
+                 "headers_done")
+
+    def __init__(self, stream_id: int):
+        self.stream_id = stream_id
+        self.headers: List[Tuple[bytes, bytes]] = []
+        self.trailers: List[Tuple[bytes, bytes]] = []
+        self.data = bytearray()
+        self.ended = False
+        self.headers_done = False
+
+    def header(self, name: bytes, default: bytes = b"") -> bytes:
+        for k, v in self.headers + self.trailers:
+            if k == name:
+                return v
+        return default
+
+
+class _H2Conn:
+    """Per-socket connection context (the reference's H2Context)."""
+
+    def __init__(self, is_server: bool):
+        self.is_server = is_server
+        self.preface_seen = not is_server
+        self.preface_sent = False
+        self.settings_sent = False
+        self.enc = hpack.Encoder()
+        self.dec = hpack.Decoder()
+        self.streams: Dict[int, _H2Stream] = {}
+        self.next_stream_id = 1          # client-initiated odd ids
+        self.cid_by_stream: Dict[int, int] = {}
+        self.lock = threading.Lock()
+
+
+def _conn(socket, is_server: bool) -> _H2Conn:
+    c = getattr(socket, "_h2_conn", None)
+    if c is None:
+        c = _H2Conn(is_server)
+        socket._h2_conn = c
+    return c
+
+
+class CompletedCall:
+    """A fully-received request or response stream."""
+
+    __slots__ = ("stream", "is_request")
+
+    def __init__(self, stream: _H2Stream, is_request: bool):
+        self.stream = stream
+        self.is_request = is_request
+
+
+# ---- parse ------------------------------------------------------------
+
+def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
+    """Consume every complete frame in order (HPACK state is sequential);
+    returns the list of CompletedCalls that finished in this batch."""
+    is_server = getattr(arg, "server", None) is not None
+    head = source.fetch(min(len(source), len(PREFACE)))
+    if head is None:
+        return ParseResult.not_enough_data()
+    conn = getattr(socket, "_h2_conn", None)
+    if conn is None:
+        if not is_server:
+            return ParseResult.try_others()   # client conns init at pack time
+        if len(head) < 4:
+            if PREFACE.startswith(head):
+                return ParseResult.not_enough_data()
+            return ParseResult.try_others()
+        if head[:4] != PREFACE[:4]:
+            return ParseResult.try_others()
+    conn = _conn(socket, is_server)
+    data = source.fetch(len(source))
+    pos = 0
+    if is_server and not conn.preface_seen:
+        if len(data) < len(PREFACE):
+            return ParseResult.not_enough_data()
+        if data[:len(PREFACE)] != PREFACE:
+            return ParseResult.parse_error("bad h2 preface")
+        conn.preface_seen = True
+        pos = len(PREFACE)
+        _server_send_settings(socket, conn)
+    completed: List[CompletedCall] = []
+    while pos + 9 <= len(data):
+        length = int.from_bytes(data[pos:pos + 3], "big")
+        ftype = data[pos + 3]
+        flags = data[pos + 4]
+        stream_id = int.from_bytes(data[pos + 5:pos + 9], "big") & 0x7FFFFFFF
+        if pos + 9 + length > len(data):
+            break
+        payload = data[pos + 9:pos + 9 + length]
+        pos += 9 + length
+        _handle_frame(conn, socket, ftype, flags, stream_id, payload,
+                      completed)
+    source.pop_front(pos)
+    if not completed:
+        return ParseResult.not_enough_data()
+    return ParseResult.ok(completed)
+
+
+def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
+                  stream_id: int, payload: bytes,
+                  completed: List[CompletedCall]) -> None:
+    if ftype == FRAME_SETTINGS:
+        if not (flags & FLAG_ACK):
+            socket.write(IOBuf(frame(FRAME_SETTINGS, FLAG_ACK, 0, b"")))
+        return
+    if ftype == FRAME_PING:
+        if not (flags & FLAG_ACK):
+            socket.write(IOBuf(frame(FRAME_PING, FLAG_ACK, 0, payload)))
+        return
+    if ftype in (FRAME_WINDOW_UPDATE, FRAME_GOAWAY):
+        return
+    if ftype == FRAME_RST_STREAM:
+        conn.streams.pop(stream_id, None)
+        return
+    st = conn.streams.get(stream_id)
+    if st is None:
+        st = _H2Stream(stream_id)
+        conn.streams[stream_id] = st
+    if ftype in (FRAME_HEADERS, FRAME_CONTINUATION):
+        hdrs = conn.dec.decode(payload)
+        if st.headers_done:
+            st.trailers.extend(hdrs)      # trailers
+        else:
+            st.headers.extend(hdrs)
+            if flags & FLAG_END_HEADERS:
+                st.headers_done = True
+    elif ftype == FRAME_DATA:
+        st.data.extend(payload)
+        if payload:
+            # auto-replenish flow-control windows
+            inc = struct.pack(">I", len(payload))
+            socket.write(IOBuf(frame(FRAME_WINDOW_UPDATE, 0, 0, inc)
+                               + frame(FRAME_WINDOW_UPDATE, 0, stream_id,
+                                       inc)))
+    if flags & FLAG_END_STREAM:
+        st.ended = True
+        conn.streams.pop(stream_id, None)
+        completed.append(CompletedCall(st, conn.is_server))
+
+
+def _server_send_settings(socket, conn: _H2Conn) -> None:
+    if not conn.settings_sent:
+        conn.settings_sent = True
+        socket.write(IOBuf(frame(FRAME_SETTINGS, 0, 0, b"")))
+
+
+# ---- server side ------------------------------------------------------
+
+def process_request(calls: List[CompletedCall], socket, server) -> None:
+    for call in calls:
+        _process_one_request(call.stream, socket, server)
+
+
+def _process_one_request(st: _H2Stream, socket, server) -> None:
+    path = st.header(b":path").decode()
+    parts = [p for p in path.split("/") if p]
+    full_name = ".".join(parts[-2:]) if len(parts) >= 2 else path
+    md = server.find_method(full_name)
+    if md is None:
+        _send_grpc_response(socket, st.stream_id, None,
+                            GRPC_UNIMPLEMENTED, f"unknown method {path}")
+        return
+    msgs = split_grpc_messages(bytes(st.data))
+    try:
+        request = md.request_cls()
+        request.ParseFromString(msgs[0] if msgs else b"")
+    except Exception as e:
+        _send_grpc_response(socket, st.stream_id, None, GRPC_INTERNAL,
+                            f"bad request: {e}")
+        return
+    cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = socket.remote_side
+    response = md.response_cls()
+    done_called = [False]
+
+    def done() -> None:
+        if done_called[0]:
+            return
+        done_called[0] = True
+        if cntl.failed():
+            _send_grpc_response(socket, st.stream_id, None, GRPC_INTERNAL,
+                                cntl.error_text_)
+        else:
+            _send_grpc_response(socket, st.stream_id,
+                                response.SerializeToString(), GRPC_OK, "")
+
+    cntl.set_server_done(done)
+    try:
+        md.fn(cntl, request, response, done)
+    except Exception as e:
+        if not done_called[0]:
+            cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
+            done()
+
+
+def _send_grpc_response(socket, stream_id: int, pb_bytes: Optional[bytes],
+                        status: int, message: str) -> None:
+    conn = socket._h2_conn
+    with conn.lock:
+        out = IOBuf()
+        hdr = conn.enc.encode([(b":status", b"200"),
+                               (b"content-type", b"application/grpc+proto")])
+        out.append(frame(FRAME_HEADERS, FLAG_END_HEADERS, stream_id, hdr))
+        if pb_bytes is not None:
+            out.append(frame(FRAME_DATA, 0, stream_id,
+                             grpc_message(pb_bytes)))
+        trailers = conn.enc.encode([
+            (b"grpc-status", str(status).encode()),
+            (b"grpc-message", message.encode()[:512])])
+        out.append(frame(FRAME_HEADERS,
+                         FLAG_END_HEADERS | FLAG_END_STREAM, stream_id,
+                         trailers))
+        socket.write(out)
+
+
+# ---- client side ------------------------------------------------------
+
+def serialize_request(request: Any, cntl: Controller) -> IOBuf:
+    buf = IOBuf()
+    if request is None:
+        return buf
+    if hasattr(request, "SerializeToString"):
+        buf.append(request.SerializeToString())
+    else:
+        buf.append(bytes(request))
+    return buf
+
+
+def pack_request(payload: IOBuf, cid: int, cntl: Controller,
+                 method_full_name: str) -> IOBuf:
+    sock = cntl._pack_socket
+    conn = _conn(sock, is_server=False)
+    service, _, method = method_full_name.rpartition(".")
+    with conn.lock:
+        out = IOBuf()
+        if not conn.preface_sent:
+            conn.preface_sent = True
+            out.append(PREFACE)
+            out.append(frame(FRAME_SETTINGS, 0, 0, b""))
+        stream_id = conn.next_stream_id
+        conn.next_stream_id += 2
+        conn.cid_by_stream[stream_id] = cid
+        authority = str(cntl.remote_side or "").encode() or b"fabric"
+        hdr = conn.enc.encode([
+            (b":method", b"POST"),
+            (b":scheme", b"http"),
+            (b":path", f"/{service}/{method}".encode()),
+            (b":authority", authority),
+            (b"content-type", b"application/grpc+proto"),
+            (b"te", b"trailers"),
+        ])
+        out.append(frame(FRAME_HEADERS, FLAG_END_HEADERS, stream_id, hdr))
+        out.append(frame(FRAME_DATA, FLAG_END_STREAM, stream_id,
+                         grpc_message(payload.to_bytes())))
+        return out
+
+
+def process_response(calls: List[CompletedCall], socket) -> None:
+    from ..bthread import id as bthread_id
+    conn = _conn(socket, is_server=False)
+    for call in calls:
+        st = call.stream
+        with conn.lock:
+            cid = conn.cid_by_stream.pop(st.stream_id, None)
+        if cid is None:
+            continue
+        rc, cntl = bthread_id.lock(cid)
+        if rc != 0 or cntl is None:
+            continue
+        cntl.remote_side = socket.remote_side
+        status = int(st.header(b"grpc-status", b"0") or b"0")
+        if status != GRPC_OK:
+            cntl.set_failed(_GRPC_TO_RPC.get(status, errors.EINTERNAL),
+                            st.header(b"grpc-message").decode("utf-8",
+                                                              "replace")
+                            or f"grpc-status {status}")
+            cntl.finish_parsed_response(cid)
+            continue
+        msgs = split_grpc_messages(bytes(st.data))
+        try:
+            if cntl._response_cls is not None:
+                resp = cntl._response_cls()
+                resp.ParseFromString(msgs[0] if msgs else b"")
+                cntl.response = resp
+            else:
+                cntl.response = msgs[0] if msgs else b""
+        except Exception as e:
+            cntl.set_failed(errors.ERESPONSE, f"bad grpc response: {e}")
+        cntl.finish_parsed_response(cid)
+
+
+PROTOCOL = Protocol(
+    name="grpc",
+    parse=parse,
+    process_request=process_request,
+    process_response=process_response,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+)
+
+
+def _register() -> None:
+    from ..rpc.protocol import find_protocol
+    if find_protocol("grpc") is None:
+        register_protocol(PROTOCOL)
+
+
+_register()

@@ -86,6 +86,7 @@ class Channel:
     def _issue_rpc(self, cntl: Controller) -> None:
         sock = self._select_socket(cntl)
         cntl.remote_side = sock.remote_side
+        cntl._pack_socket = sock       # connection-stateful protocols (h2)
         cid = cntl.current_cid()
         packet = self._protocol.pack_request(
             cntl._request_buf, cid, cntl, cntl._method_full_name)

@@ -1,0 +1,162 @@
+"""HTTP/2 + gRPC protocol tests (reference
+test/brpc_grpc_protocol_unittest.cpp pattern: frame/HPACK golden checks +
+in-process client↔server)."""
+import struct
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.policy import grpc as g2
+from brpc_tpu.policy import hpack
+from brpc_tpu.rpc import errors
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [7000]
+
+
+def unique(p):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class TestHpack:
+    def test_static_indexed_roundtrip(self):
+        enc, dec = hpack.Encoder(), hpack.Decoder()
+        headers = [(b":method", b"POST"), (b":scheme", b"http"),
+                   (b":status", b"200")]
+        assert dec.decode(enc.encode(headers)) == headers
+
+    def test_literal_roundtrip(self):
+        enc, dec = hpack.Encoder(), hpack.Decoder()
+        headers = [(b":path", b"/Echo/Do"), (b"grpc-status", b"0"),
+                   (b"x-custom", b"v" * 300)]
+        assert dec.decode(enc.encode(headers)) == headers
+
+    def test_dynamic_table_incremental(self):
+        # encode literal-with-incremental-indexing by hand; decoder must
+        # index it and resolve a later indexed reference
+        dec = hpack.Decoder()
+        name, value = b"x-session", b"abc"
+        block = (bytes([0x40])                    # literal w/ indexing, new name
+                 + bytes([len(name)]) + name
+                 + bytes([len(value)]) + value)
+        assert dec.decode(block) == [(name, value)]
+        # index 62 = first dynamic entry
+        assert dec.decode(bytes([0x80 | 62])) == [(name, value)]
+
+    def test_huffman_decode(self):
+        # "www.example.com" huffman-coded (RFC 7541 C.4.1)
+        data = bytes.fromhex("f1e3c2e5f23a6ba0ab90f4ff")
+        assert hpack.huffman_decode(data) == b"www.example.com"
+
+    def test_integer_coding(self):
+        assert hpack._encode_int(10, 5, 0) == bytes([10])
+        raw = hpack._encode_int(1337, 5, 0)
+        v, pos = hpack._decode_int(raw, 0, 5)
+        assert v == 1337 and pos == len(raw)
+
+
+class TestFrames:
+    def test_frame_header(self):
+        f = g2.frame(g2.FRAME_DATA, g2.FLAG_END_STREAM, 5, b"hello")
+        assert len(f) == 9 + 5
+        assert int.from_bytes(f[:3], "big") == 5
+        assert f[3] == g2.FRAME_DATA
+        assert f[4] == g2.FLAG_END_STREAM
+        assert int.from_bytes(f[5:9], "big") == 5
+
+    def test_grpc_message_framing(self):
+        m = g2.grpc_message(b"PAYLOAD")
+        assert m[0] == 0
+        assert struct.unpack(">I", m[1:5])[0] == 7
+        assert g2.split_grpc_messages(m + g2.grpc_message(b"x")) == \
+            [b"PAYLOAD", b"x"]
+
+
+class GrpcEchoService(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = "grpc:" + request.message
+        done()
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Fail(self, cntl, request, response, done):
+        cntl.set_failed(errors.EINTERNAL, "grpc boom")
+        done()
+
+
+class TestGrpcEndToEnd:
+    def _start(self, transport="mem"):
+        server = rpc.Server()
+        server.add_service(GrpcEchoService())
+        if transport == "mem":
+            name = unique("grpc")
+            assert server.start(f"mem://{name}") == 0
+            target = f"mem://{name}"
+        else:
+            assert server.start("127.0.0.1:0") == 0
+            target = f"127.0.0.1:{server.listen_port}"
+        ch = rpc.Channel()
+        ch.init(target, options=rpc.ChannelOptions(protocol="grpc",
+                                                   timeout_ms=5000))
+        return server, ch
+
+    def test_unary_call_mem(self):
+        server, ch = self._start("mem")
+        try:
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="hi"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "grpc:hi"
+        finally:
+            server.stop()
+
+    def test_unary_call_tcp(self):
+        server, ch = self._start("tcp")
+        try:
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="tcp"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "grpc:tcp"
+        finally:
+            server.stop()
+
+    def test_multiple_calls_one_connection(self):
+        server, ch = self._start("mem")
+        try:
+            for i in range(10):
+                cntl = rpc.Controller()
+                resp = ch.call_method("EchoService.Echo", cntl,
+                                      EchoRequest(message=f"n{i}"),
+                                      EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert resp.message == f"grpc:n{i}"
+        finally:
+            server.stop()
+
+    def test_server_error_maps_to_grpc_status(self):
+        server, ch = self._start("mem")
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Fail", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+            assert "grpc boom" in cntl.error_text
+        finally:
+            server.stop()
+
+    def test_unknown_method_is_unimplemented(self):
+        server, ch = self._start("mem")
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Nope", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code == errors.ENOMETHOD
+        finally:
+            server.stop()
