@@ -6,3 +6,4 @@ from .transport import (IciSocket, ici_listen, ici_unlisten, ici_connect,
 from .collective import Collectives, default_collectives
 from .ring import ring_all_reduce, RingStream
 from . import pallas_ring
+from . import ring_attention
