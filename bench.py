@@ -149,6 +149,13 @@ def main() -> None:
     except Exception as e:  # pragma: no cover
         print(f"# qps failed: {e}", file=sys.stderr)
         qps = {}
+    try:
+        from brpc_tpu.butil.native import native_echo_p50_us
+        native_p50 = native_echo_p50_us()
+        print(f"# native echo p50: {native_p50:.1f} us", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# native echo failed: {e}", file=sys.stderr)
+        native_p50 = -1.0
     target_us = 10.0
     print(json.dumps({
         "metric": "ici echo p50 latency (4KB device payload, full RPC stack)",
@@ -159,6 +166,7 @@ def main() -> None:
             "echo_p99_us": round(echo["p99_us"], 1),
             "allreduce_gbps": round(ar.get("allreduce_gbps", 0.0), 3),
             "qps": round(qps.get("qps", 0.0), 0),
+            "native_echo_p50_us": round(native_p50, 2),
         },
     }))
 

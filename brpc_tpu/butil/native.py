@@ -96,6 +96,9 @@ def load() -> Optional[ctypes.CDLL]:
                                                 ctypes.c_int64]
         lib.brpc_tpu_timer_unschedule.restype = ctypes.c_int
         lib.brpc_tpu_timer_unschedule.argtypes = [ctypes.c_uint64]
+        lib.brpc_tpu_native_echo_p50_ns.restype = ctypes.c_int64
+        lib.brpc_tpu_native_echo_p50_ns.argtypes = [ctypes.c_int,
+                                                    ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -124,3 +127,12 @@ class NativeScheduler:
 
     def spawned(self) -> int:
         return self.lib.brpc_tpu_sched_spawned()
+
+
+def native_echo_p50_us(iters: int = 2000, payload: int = 4096) -> float:
+    """Native epoll TCP echo round-trip p50 (µs); -1 if unavailable."""
+    lib = load()
+    if lib is None:
+        return -1.0
+    ns = lib.brpc_tpu_native_echo_p50_ns(iters, payload)
+    return ns / 1000.0 if ns > 0 else -1.0

@@ -679,6 +679,166 @@ uint64_t brpc_tpu_timer_triggered() {
 
 int brpc_tpu_core_version() { return 1; }
 
+}  // extern "C" (reopened below after system includes)
+
+// ====================================================================
+// Native epoll loop + TCP echo datapath (event_dispatcher_epoll.cpp +
+// the Socket fd hot path).  Serves as the reference-grade native latency
+// demonstration and the seed of the native transport: the echo server
+// runs an edge-triggered epoll loop; the bench measures request p50 the
+// way example/echo_c++ does, all in native code.
+// ====================================================================
+
+#ifdef __linux__
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <algorithm>
+
+namespace core {
+
+static void set_nonblock(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+}
+
+struct EchoServer {
+  int listen_fd{-1};
+  int epfd{-1};
+  int port{0};
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> messages{0};
+
+  bool start() {
+    listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = 0;
+    if (bind(listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd, (sockaddr*)&addr, &len);
+    port = ntohs(addr.sin_port);
+    listen(listen_fd, 64);
+    set_nonblock(listen_fd);
+    epfd = epoll_create1(0);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, listen_fd, &ev);
+    thread = std::thread([this] { run(); });
+    return true;
+  }
+
+  void run() {
+    epoll_event events[64];
+    char buf[65536];
+    while (!stop.load(std::memory_order_relaxed)) {
+      int n = epoll_wait(epfd, events, 64, 100);
+      for (int i = 0; i < n; ++i) {
+        int fd = events[i].data.fd;
+        if (fd == listen_fd) {
+          for (;;) {
+            int c = accept(listen_fd, nullptr, nullptr);
+            if (c < 0) break;
+            set_nonblock(c);
+            int one = 1;
+            setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            epoll_event cev{};
+            cev.events = EPOLLIN;
+            cev.data.fd = c;
+            epoll_ctl(epfd, EPOLL_CTL_ADD, c, &cev);
+          }
+          continue;
+        }
+        for (;;) {  // echo until EAGAIN (edge-ish drain)
+          ssize_t r = read(fd, buf, sizeof(buf));
+          if (r > 0) {
+            ssize_t off = 0;
+            while (off < r) {
+              ssize_t w = write(fd, buf + off, r - off);
+              if (w <= 0) break;
+              off += w;
+            }
+            messages.fetch_add(1, std::memory_order_relaxed);
+          } else if (r == 0) {
+            epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr);
+            close(fd);
+            break;
+          } else {
+            break;  // EAGAIN
+          }
+        }
+      }
+    }
+  }
+
+  void shutdown() {
+    stop.store(true);
+    if (thread.joinable()) thread.join();
+    if (listen_fd >= 0) close(listen_fd);
+    if (epfd >= 0) close(epfd);
+  }
+};
+
+}  // namespace core
+
+extern "C" {
+
+// Runs a native echo latency benchmark: starts the epoll echo server,
+// does `iters` blocking round-trips of `payload` bytes, returns p50
+// nanoseconds (-1 on failure).
+int64_t brpc_tpu_native_echo_p50_ns(int iters, int payload) {
+  core::EchoServer server;
+  if (!server.start()) return -1;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(server.port);
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    server.shutdown();
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<char> buf(payload, 'x');
+  std::vector<char> rbuf(payload);
+  std::vector<int64_t> lat;
+  lat.reserve(iters);
+  auto now_ns = [] {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  };
+  for (int i = 0; i < iters + 20; ++i) {
+    int64_t t0 = now_ns();
+    ssize_t w = write(fd, buf.data(), payload);
+    (void)w;
+    ssize_t got = 0;
+    while (got < payload) {
+      ssize_t r = read(fd, rbuf.data() + got, payload - got);
+      if (r <= 0) break;
+      got += r;
+    }
+    if (i >= 20) lat.push_back(now_ns() - t0);
+  }
+  close(fd);
+  server.shutdown();
+  if (lat.empty()) return -1;
+  std::sort(lat.begin(), lat.end());
+  return lat[lat.size() / 2];
+}
+
+}  // extern "C"
+#else
+extern "C" int64_t brpc_tpu_native_echo_p50_ns(int, int) { return -1; }
+#endif
+
 // Self-contained scheduler exercise: spawn n fibers bumping an internal
 // counter; returns the counter after all complete (for bindings tests —
 // Python callables must NOT run on fiber stacks: CPython's stack-bound
@@ -689,7 +849,7 @@ static void selftest_fn(void* arg) {
   g_selftest_counter.fetch_add((intptr_t)arg);
 }
 
-int64_t brpc_tpu_sched_selftest(int n) {
+extern "C" int64_t brpc_tpu_sched_selftest(int n) {
   g_selftest_counter.store(0);
   std::vector<uint64_t> ids;
   ids.reserve(n);
@@ -701,5 +861,3 @@ int64_t brpc_tpu_sched_selftest(int n) {
     usleep(1000);
   return g_selftest_counter.load();
 }
-
-}  // extern "C"

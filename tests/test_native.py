@@ -83,3 +83,11 @@ class TestNativeTimer:
         assert lib.brpc_tpu_timer_unschedule(tid) == 0
         time.sleep(0.3)
         assert fired == [1]
+
+
+class TestNativeEcho:
+    def test_native_echo_latency(self):
+        from brpc_tpu.butil.native import native_echo_p50_us
+        p50 = native_echo_p50_us(iters=300, payload=1024)
+        assert p50 > 0
+        assert p50 < 10_000       # sanity: < 10ms
