@@ -267,6 +267,15 @@ class Controller:
         if not self._ended.wait(timeout):
             raise TimeoutError("RPC join timed out")
 
+    def cancel(self) -> None:
+        """Cancel the in-flight call (reference StartCancel/CancelRPC): the
+        caller completes with ECANCELED; a late response is dropped by the
+        correlation id."""
+        if self._cid and not self._ended.is_set():
+            bthread_id.error(
+                bthread_id.with_version(self._cid, self.current_try),
+                errors.ECANCELED)
+
     # ---- server side ---------------------------------------------------
     def set_server_done(self, fn: Callable[[], None]) -> None:
         self._server_done = fn

@@ -1,0 +1,156 @@
+"""Flagship integration: channel + naming + LB + circuit breaker +
+health-check revival across server death (the reference's multi-server
+in-process cluster pattern, SURVEY.md §4)."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.butil import flags as _flags
+from brpc_tpu.rpc import errors
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [8000]
+
+
+def unique(p):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class TaggedEcho(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.calls = 0
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        self.calls += 1
+        response.message = self.tag
+        done()
+
+
+class TestClusterLifecycle:
+    def test_lb_spread_failover_and_revival(self, tmp_path):
+        names = [unique("cluster") for _ in range(3)]
+        servers = {}
+        svcs = {}
+        for i, name in enumerate(names):
+            s = rpc.Server()
+            svc = TaggedEcho(f"s{i}")
+            s.add_service(svc)
+            assert s.start(f"mem://{name}") == 0
+            servers[name] = s
+            svcs[name] = svc
+        listing = tmp_path / "cluster"
+        listing.write_text("".join(f"mem://{n}\n" for n in names))
+
+        ch = rpc.Channel()
+        assert ch.init(f"file://{listing}", "rr",
+                       rpc.ChannelOptions(timeout_ms=500, max_retry=3)) == 0
+
+        def call_ok():
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="x"), EchoResponse)
+            return (not cntl.failed()), (resp.message if resp else None)
+
+        # 1) traffic spreads over all three
+        results = [call_ok() for _ in range(30)]
+        assert all(ok for ok, _ in results)
+        assert all(svc.calls > 0 for svc in svcs.values())
+
+        # 2) kill one server: every call still succeeds via retry+exclusion
+        dead = names[0]
+        servers[dead].stop()
+        ok_count = sum(1 for _ in range(30) if call_ok()[0])
+        assert ok_count == 30
+
+        # 3) revive it (same name): health check revives the endpoint and
+        #    traffic returns
+        s = rpc.Server()
+        svc_new = TaggedEcho("s0-reborn")
+        s.add_service(svc_new)
+        assert s.start(f"mem://{dead}") == 0
+        servers[dead] = s
+        deadline = time.time() + 10
+        while svc_new.calls == 0 and time.time() < deadline:
+            call_ok()
+            time.sleep(0.02)
+        assert svc_new.calls > 0, "revived server never got traffic back"
+        for s in servers.values():
+            s.stop()
+
+    def test_locality_aware_channel(self, tmp_path):
+        names = [unique("la") for _ in range(2)]
+
+        class SlowEcho(TaggedEcho):
+            def __init__(self, tag, delay):
+                super().__init__(tag)
+                self.delay = delay
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                self.calls += 1
+                time.sleep(self.delay)
+                response.message = self.tag
+                done()
+
+        servers = []
+        fast = SlowEcho("fast", 0.0)
+        slow = SlowEcho("slow", 0.02)
+        for name, svc in zip(names, (fast, slow)):
+            s = rpc.Server()
+            s.add_service(svc)
+            assert s.start(f"mem://{name}") == 0
+            servers.append(s)
+        listing = tmp_path / "cluster"
+        listing.write_text("".join(f"mem://{n}\n" for n in names))
+        ch = rpc.Channel()
+        assert ch.init(f"file://{listing}", "la",
+                       rpc.ChannelOptions(timeout_ms=2000)) == 0
+        for _ in range(60):
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+        assert fast.calls > slow.calls   # locality-aware shifted traffic
+        for s in servers:
+            s.stop()
+
+
+class TestCancel:
+    def test_cancel_inflight(self):
+        name = unique("cancel")
+
+        class SlowService(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                time.sleep(0.3)
+                response.message = "late"
+                done()
+
+        server = rpc.Server()
+        server.add_service(SlowService())
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}",
+                    options=rpc.ChannelOptions(timeout_ms=5000, max_retry=0))
+            cntl = rpc.Controller()
+            done_evt = threading.Event()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse,
+                           lambda c: done_evt.set())
+            time.sleep(0.05)
+            cntl.cancel()
+            assert done_evt.wait(5)
+            assert cntl.error_code == errors.ECANCELED
+            time.sleep(0.4)      # late response must be dropped silently
+        finally:
+            server.stop()
