@@ -121,7 +121,14 @@ def process_request(msg: HttpMessage, socket, server) -> None:
             ctype, body = hit
             socket.write(_render_response(200, body.encode(), ctype))
             return
-    # 2) /Service/Method JSON RPC
+    # 2) restful mappings (reference restful.{h,cpp})
+    mapped = server.options.restful_mappings.get("/" + path)
+    if mapped is not None:
+        md = server.find_method(mapped)
+        if md is not None:
+            _process_json_rpc(msg, socket, server, md, mapped, start_us)
+            return
+    # 3) /Service/Method JSON RPC
     parts = [p for p in path.split("/") if p]
     if len(parts) == 2:
         full_name = f"{parts[0]}.{parts[1]}"

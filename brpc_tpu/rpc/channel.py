@@ -30,6 +30,7 @@ class ChannelOptions:
     connect_timeout_ms: int = 1000
     auth: object = None                 # Authenticator
     ssl_context: object = None          # ssl.SSLContext for TLS channels
+    ns_filter: object = None            # NamingServiceFilter: fn(ServerEntry)->bool
 
 
 class Channel:
@@ -59,7 +60,10 @@ class Channel:
             from ..policy.load_balancers import create_load_balancer
             self._lb = create_load_balancer(lb_name or "rr")
             self._ns_thread = get_naming_service_thread(target)
-            self._ns_thread.add_watcher(self._lb)
+            watcher = self._lb
+            if self.options.ns_filter is not None:
+                watcher = _FilteredWatcher(self._lb, self.options.ns_filter)
+            self._ns_thread.add_watcher(watcher)
             return 0
         self._endpoint = parse_endpoint(target) if isinstance(target, str) else target
         return 0
@@ -145,3 +149,14 @@ class Channel:
                     lb.exclude(sel, breaker.isolated_until())
                     start_health_check(
                         sel, on_revived=lambda ep: lb.exclude(ep, 0.0))
+
+
+class _FilteredWatcher:
+    """Per-channel membership filter (reference naming_service_filter.h)."""
+
+    def __init__(self, lb, filter_fn):
+        self._lb = lb
+        self._filter = filter_fn
+
+    def reset_servers(self, entries):
+        self._lb.reset_servers([e for e in entries if self._filter(e)])

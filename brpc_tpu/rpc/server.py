@@ -38,6 +38,9 @@ class ServerOptions:
     # safe when handlers are fast/non-blocking.
     usercode_inline: bool = False
     ssl_context: Any = None             # ssl.SSLContext for TLS listeners
+    # restful mappings (reference restful.cpp): url path -> method
+    #   {"/v1/echo": "EchoService.Echo"}
+    restful_mappings: Dict[str, str] = field(default_factory=dict)
 
 
 class Server:

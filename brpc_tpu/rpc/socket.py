@@ -26,6 +26,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..butil.iobuf import IOBuf, IOPortal
+from ..butil import flags as _flags
 from ..butil.resource_pool import ResourcePool
 from ..butil.endpoint import EndPoint
 from .. import bvar
@@ -34,6 +35,10 @@ from ..bthread.butex import Butex
 from . import errors
 
 _socket_pool: ResourcePool = ResourcePool()
+
+_flags.define_flag("socket_max_unwritten_bytes", 64 * 1024 * 1024,
+                   "reject writes with EOVERCROWDED beyond this backlog",
+                   _flags.positive_integer)
 
 _g_socket_count = bvar.Adder("rpc_socket_count")
 
@@ -117,6 +122,9 @@ class Socket:
         self._transport_close()
         return True
 
+    def _unwritten_bytes(self) -> int:
+        return sum(len(r.data) for r in self._write_queue)
+
     # ---- write path ---------------------------------------------------
     def write(self, data: IOBuf, notify_cid: int = 0,
               on_done: Optional[Callable[[int], None]] = None) -> int:
@@ -127,6 +135,9 @@ class Socket:
             if self.failed:
                 err = self.failed_error or errors.EFAILEDSOCKET
                 # complete outside the lock
+            elif self._unwritten_bytes() > _flags.get_flag(
+                    "socket_max_unwritten_bytes"):
+                err = errors.EOVERCROWDED
             else:
                 self._write_queue.append(req)
                 if self._writing:

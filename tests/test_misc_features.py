@@ -1,0 +1,139 @@
+"""ns_filter, EOVERCROWDED, restful mappings, pooled/short connections."""
+import json
+import socket as pysocket
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.butil import flags as _flags
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [9000]
+
+
+def unique(p):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class EchoService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+class TestNsFilter:
+    def test_filter_excludes_tagged_servers(self, tmp_path):
+        names = [unique("nsf") for _ in range(2)]
+        servers = []
+        for i, name in enumerate(names):
+            s = rpc.Server()
+            s.add_service(EchoService())
+            assert s.start(f"mem://{name}") == 0
+            servers.append(s)
+        listing = tmp_path / "servers"
+        listing.write_text(f"mem://{names[0]} 100 keep\n"
+                           f"mem://{names[1]} 100 drop\n")
+        opts = rpc.ChannelOptions(timeout_ms=1000)
+        opts.ns_filter = lambda e: e.tag != "drop"
+        ch = rpc.Channel()
+        assert ch.init(f"file://{listing}", "rr", opts) == 0
+        assert ch._lb.server_count() == 1
+        for _ in range(5):
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="f"), EchoResponse)
+            assert not cntl.failed()
+        for s in servers:
+            s.stop()
+
+
+class TestOvercrowded:
+    def test_write_backlog_rejected(self):
+        from brpc_tpu.rpc.mem_transport import new_mem_pair
+        a, b = new_mem_pair()
+        _flags.set_flag("socket_max_unwritten_bytes", 1024)
+        try:
+            # block the drain by failing the peer reference AFTER hooking:
+            # simulate stuck transport by monkeypatching _do_write to EAGAIN
+            a._do_write = lambda data: -1
+            rc1 = a.write(IOBuf(b"x" * 800))
+            rc2 = a.write(IOBuf(b"y" * 800))
+            rc3 = a.write(IOBuf(b"z" * 800))
+            assert rc1 == 0
+            assert errors.EOVERCROWDED in (rc2, rc3)
+        finally:
+            _flags.set_flag("socket_max_unwritten_bytes", 64 * 1024 * 1024)
+            a.set_failed()
+            b.set_failed()
+
+
+class TestRestful:
+    def test_restful_mapping(self):
+        opts = rpc.ServerOptions()
+        opts.restful_mappings = {"/v1/echo": "EchoService.Echo"}
+        server = rpc.Server(opts)
+        server.add_service(EchoService())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.listen_port}/v1/echo",
+                data=json.dumps({"message": "restful"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                body = json.loads(r.read())
+            assert body["message"] == "restful"
+        finally:
+            server.stop()
+
+
+class TestConnectionTypes:
+    @pytest.mark.parametrize("ctype", ["pooled", "short"])
+    def test_connection_type_works(self, ctype):
+        name = unique("conn")
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}",
+                    options=rpc.ChannelOptions(connection_type=ctype,
+                                               timeout_ms=2000))
+            for i in range(5):
+                cntl = rpc.Controller()
+                resp = ch.call_method("EchoService.Echo", cntl,
+                                      EchoRequest(message=f"c{i}"),
+                                      EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert resp.message == f"c{i}"
+        finally:
+            server.stop()
+
+    def test_pooled_reuses_connections(self):
+        name = unique("pool")
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}",
+                    options=rpc.ChannelOptions(connection_type="pooled",
+                                               timeout_ms=2000))
+            for _ in range(10):
+                cntl = rpc.Controller()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="p"), EchoResponse)
+            # sequential pooled calls reuse one connection
+            from brpc_tpu.butil.endpoint import parse_endpoint
+            from brpc_tpu.rpc.socket_map import SocketMap
+            stats = SocketMap.instance().stats()
+            ep = parse_endpoint(f"mem://{name}")
+            assert stats.get(ep, 0) <= 2
+        finally:
+            server.stop()
