@@ -25,6 +25,12 @@ _FIBER_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 _SINK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_size_t,
                             ctypes.c_void_p)
 _TIMER_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+# native RPC request hook: (token, method, payload, payload_len, att,
+# att_len, log_id) — see native/rpc.cpp py_request_fn
+_NREQ_FN = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_char_p,
+                            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+                            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+                            ctypes.c_uint64)
 
 
 def _build() -> bool:
@@ -45,62 +51,112 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(_SO)
-        except OSError:
+            if not hasattr(lib, "brpc_tpu_nserver_start"):
+                # stale .so predating native/rpc.cpp: rebuild once
+                if not _build():
+                    return None
+                lib = ctypes.CDLL(_SO)
+            return _bind(lib)
+        except (OSError, AttributeError):
+            # missing symbols (e.g. non-Linux stub) → no native core;
+            # callers fall back to the pure-Python implementations
             return None
-        # signatures
-        lib.brpc_tpu_pool_new.restype = ctypes.c_void_p
-        lib.brpc_tpu_pool_get.restype = ctypes.c_uint64
-        lib.brpc_tpu_pool_get.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-        lib.brpc_tpu_pool_address.restype = ctypes.c_void_p
-        lib.brpc_tpu_pool_address.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.brpc_tpu_pool_put.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.brpc_tpu_pool_live.restype = ctypes.c_uint64
-        lib.brpc_tpu_pool_live.argtypes = [ctypes.c_void_p]
-        lib.brpc_tpu_butex_new.restype = ctypes.c_void_p
-        lib.brpc_tpu_butex_new.argtypes = [ctypes.c_int32]
-        lib.brpc_tpu_butex_wait.restype = ctypes.c_int
-        lib.brpc_tpu_butex_wait.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    global _lib
+    # signatures
+    lib.brpc_tpu_pool_new.restype = ctypes.c_void_p
+    lib.brpc_tpu_pool_get.restype = ctypes.c_uint64
+    lib.brpc_tpu_pool_get.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.brpc_tpu_pool_address.restype = ctypes.c_void_p
+    lib.brpc_tpu_pool_address.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.brpc_tpu_pool_put.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.brpc_tpu_pool_live.restype = ctypes.c_uint64
+    lib.brpc_tpu_pool_live.argtypes = [ctypes.c_void_p]
+    lib.brpc_tpu_butex_new.restype = ctypes.c_void_p
+    lib.brpc_tpu_butex_new.argtypes = [ctypes.c_int32]
+    lib.brpc_tpu_butex_wait.restype = ctypes.c_int
+    lib.brpc_tpu_butex_wait.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                        ctypes.c_int64]
+    lib.brpc_tpu_butex_set_wake_all.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int32]
+    lib.brpc_tpu_butex_value.restype = ctypes.c_int32
+    lib.brpc_tpu_butex_value.argtypes = [ctypes.c_void_p]
+    lib.brpc_tpu_sched_start.argtypes = [ctypes.c_int]
+    lib.brpc_tpu_sched_spawn.restype = ctypes.c_uint64
+    lib.brpc_tpu_sched_spawn.argtypes = [_FIBER_FN, ctypes.c_void_p,
+                                         ctypes.c_int]
+    lib.brpc_tpu_sched_join.restype = ctypes.c_int
+    lib.brpc_tpu_sched_join.argtypes = [ctypes.c_uint64, ctypes.c_int64]
+    lib.brpc_tpu_sched_selftest.restype = ctypes.c_int64
+    lib.brpc_tpu_sched_selftest.argtypes = [ctypes.c_int]
+    lib.brpc_tpu_sched_completed.restype = ctypes.c_uint64
+    lib.brpc_tpu_sched_spawned.restype = ctypes.c_uint64
+    lib.brpc_tpu_mpsc_new.restype = ctypes.c_void_p
+    lib.brpc_tpu_mpsc_push.restype = ctypes.c_int
+    lib.brpc_tpu_mpsc_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_uint64]
+    lib.brpc_tpu_mpsc_drain.restype = ctypes.c_uint64
+    lib.brpc_tpu_mpsc_drain.argtypes = [ctypes.c_void_p, _SINK_FN,
+                                        ctypes.c_void_p]
+    lib.brpc_tpu_blockpool_new.restype = ctypes.c_void_p
+    lib.brpc_tpu_blockpool_new.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.brpc_tpu_blockpool_alloc.restype = ctypes.c_void_p
+    lib.brpc_tpu_blockpool_alloc.argtypes = [ctypes.c_void_p]
+    lib.brpc_tpu_blockpool_release.restype = ctypes.c_int
+    lib.brpc_tpu_blockpool_release.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_void_p]
+    lib.brpc_tpu_blockpool_free_count.restype = ctypes.c_uint64
+    lib.brpc_tpu_blockpool_free_count.argtypes = [ctypes.c_void_p]
+    lib.brpc_tpu_timer_schedule.restype = ctypes.c_uint64
+    lib.brpc_tpu_timer_schedule.argtypes = [_TIMER_FN, ctypes.c_void_p,
                                             ctypes.c_int64]
-        lib.brpc_tpu_butex_set_wake_all.argtypes = [ctypes.c_void_p,
-                                                    ctypes.c_int32]
-        lib.brpc_tpu_butex_value.restype = ctypes.c_int32
-        lib.brpc_tpu_butex_value.argtypes = [ctypes.c_void_p]
-        lib.brpc_tpu_sched_start.argtypes = [ctypes.c_int]
-        lib.brpc_tpu_sched_spawn.restype = ctypes.c_uint64
-        lib.brpc_tpu_sched_spawn.argtypes = [_FIBER_FN, ctypes.c_void_p,
-                                             ctypes.c_int]
-        lib.brpc_tpu_sched_join.restype = ctypes.c_int
-        lib.brpc_tpu_sched_join.argtypes = [ctypes.c_uint64, ctypes.c_int64]
-        lib.brpc_tpu_sched_selftest.restype = ctypes.c_int64
-        lib.brpc_tpu_sched_selftest.argtypes = [ctypes.c_int]
-        lib.brpc_tpu_sched_completed.restype = ctypes.c_uint64
-        lib.brpc_tpu_sched_spawned.restype = ctypes.c_uint64
-        lib.brpc_tpu_mpsc_new.restype = ctypes.c_void_p
-        lib.brpc_tpu_mpsc_push.restype = ctypes.c_int
-        lib.brpc_tpu_mpsc_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                           ctypes.c_uint64]
-        lib.brpc_tpu_mpsc_drain.restype = ctypes.c_uint64
-        lib.brpc_tpu_mpsc_drain.argtypes = [ctypes.c_void_p, _SINK_FN,
-                                            ctypes.c_void_p]
-        lib.brpc_tpu_blockpool_new.restype = ctypes.c_void_p
-        lib.brpc_tpu_blockpool_new.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
-        lib.brpc_tpu_blockpool_alloc.restype = ctypes.c_void_p
-        lib.brpc_tpu_blockpool_alloc.argtypes = [ctypes.c_void_p]
-        lib.brpc_tpu_blockpool_release.restype = ctypes.c_int
-        lib.brpc_tpu_blockpool_release.argtypes = [ctypes.c_void_p,
-                                                   ctypes.c_void_p]
-        lib.brpc_tpu_blockpool_free_count.restype = ctypes.c_uint64
-        lib.brpc_tpu_blockpool_free_count.argtypes = [ctypes.c_void_p]
-        lib.brpc_tpu_timer_schedule.restype = ctypes.c_uint64
-        lib.brpc_tpu_timer_schedule.argtypes = [_TIMER_FN, ctypes.c_void_p,
-                                                ctypes.c_int64]
-        lib.brpc_tpu_timer_unschedule.restype = ctypes.c_int
-        lib.brpc_tpu_timer_unschedule.argtypes = [ctypes.c_uint64]
-        lib.brpc_tpu_native_echo_p50_ns.restype = ctypes.c_int64
-        lib.brpc_tpu_native_echo_p50_ns.argtypes = [ctypes.c_int,
+    lib.brpc_tpu_timer_unschedule.restype = ctypes.c_int
+    lib.brpc_tpu_timer_unschedule.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_native_echo_p50_ns.restype = ctypes.c_int64
+    lib.brpc_tpu_native_echo_p50_ns.argtypes = [ctypes.c_int,
+                                                ctypes.c_int]
+    # ---- native RPC datapath (native/rpc.cpp) ----
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.brpc_tpu_nserver_start.restype = ctypes.c_uint64
+    lib.brpc_tpu_nserver_start.argtypes = [ctypes.c_int]
+    lib.brpc_tpu_nserver_port.restype = ctypes.c_int
+    lib.brpc_tpu_nserver_port.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_nserver_register_echo.restype = ctypes.c_int
+    lib.brpc_tpu_nserver_register_echo.argtypes = [ctypes.c_uint64,
+                                                   ctypes.c_char_p]
+    lib.brpc_tpu_nserver_set_handler.restype = ctypes.c_int
+    lib.brpc_tpu_nserver_set_handler.argtypes = [ctypes.c_uint64,
+                                                 _NREQ_FN]
+    lib.brpc_tpu_nserver_requests.restype = ctypes.c_uint64
+    lib.brpc_tpu_nserver_requests.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_nserver_respond.restype = ctypes.c_int
+    lib.brpc_tpu_nserver_respond.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p, u8p,
+        ctypes.c_uint64, u8p, ctypes.c_uint64]
+    lib.brpc_tpu_nserver_stop.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_nchannel_connect.restype = ctypes.c_uint64
+    lib.brpc_tpu_nchannel_connect.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_int]
+    lib.brpc_tpu_nchannel_call.restype = ctypes.c_uint64
+    lib.brpc_tpu_nchannel_call.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, u8p, ctypes.c_uint64, u8p,
+        ctypes.c_uint64, ctypes.c_int64, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.brpc_tpu_buf_free.argtypes = [ctypes.c_void_p]
+    lib.brpc_tpu_nchannel_close.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_native_rpc_echo_p50_ns.restype = ctypes.c_int64
+    lib.brpc_tpu_native_rpc_echo_p50_ns.argtypes = [ctypes.c_int,
                                                     ctypes.c_int]
-        _lib = lib
-        return _lib
+    lib.brpc_tpu_native_rpc_qps.restype = ctypes.c_double
+    lib.brpc_tpu_native_rpc_qps.argtypes = [ctypes.c_int, ctypes.c_int,
+                                            ctypes.c_int]
+    _lib = lib
+    return _lib
+
 
 
 def available() -> bool:
@@ -136,3 +192,23 @@ def native_echo_p50_us(iters: int = 2000, payload: int = 4096) -> float:
         return -1.0
     ns = lib.brpc_tpu_native_echo_p50_ns(iters, payload)
     return ns / 1000.0 if ns > 0 else -1.0
+
+
+def native_rpc_echo_p50_us(iters: int = 3000, payload: int = 4096) -> float:
+    """Full native RPC stack echo p50 (µs): channel → TRPC frame → epoll
+    server → dispatch → response → correlation wake, all in native/rpc.cpp.
+    -1 if unavailable."""
+    lib = load()
+    if lib is None:
+        return -1.0
+    ns = lib.brpc_tpu_native_rpc_echo_p50_ns(iters, payload)
+    return ns / 1000.0 if ns > 0 else -1.0
+
+
+def native_rpc_qps(threads: int = 16, duration_ms: int = 1500,
+                   payload: int = 128) -> float:
+    """Multi-threaded native RPC echo QPS; -1 if unavailable."""
+    lib = load()
+    if lib is None:
+        return -1.0
+    return lib.brpc_tpu_native_rpc_qps(threads, duration_ms, payload)

@@ -1,0 +1,231 @@
+"""Native-datapath Server/Channel facades.
+
+This is the deployment shape SURVEY.md §7 calls for ("host runtime must be
+C++ with Python bindings on the control plane only"): the RPC hot path —
+TRPC framing, epoll loop, method dispatch, response correlation — runs in
+native/rpc.cpp; Python supplies service registration and (optionally) user
+handlers.  Two handler tiers:
+
+* **native echo methods** (``register_native_echo``): served entirely in
+  C++, zero Python in the loop — the <10 µs tier (the reference's C++
+  handlers are this tier; echo/relay/byte-oriented services qualify).
+* **Python services** (``add_service`` with regular ``rpc.Service``
+  classes): the native server upcalls into Python once per request with
+  the cut payload; protobuf parse + user code + respond happen under the
+  GIL, everything else stays native.
+
+Wire format is byte-identical to ``policy/tpu_std.py`` frames, so native
+servers serve Python ``rpc.Channel`` clients over tcp:// and native
+channels call Python ``rpc.Server``s (tests/test_native_rpc.py proves both
+directions).
+
+Reference anchors: server hot path baidu_rpc_protocol.cpp:312, client
+correlation controller.cpp:568.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any, Dict, Optional, Type
+
+from ..butil import logging as log
+from ..butil import native
+from ..butil.native import _NREQ_FN
+from . import errors
+from .controller import Controller
+from .service import MethodDescriptor, Service
+
+
+class NativeServer:
+    """Server whose datapath (accept/read/frame/dispatch/write) is native.
+
+    Python handlers run via a single upcall per request; ``done()`` sends
+    the response from whichever thread calls it (the native side serializes
+    per-connection writes).
+    """
+
+    def __init__(self):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._handle = 0
+        self._methods: Dict[str, MethodDescriptor] = {}
+        self._native_echo: set = set()
+        # keep the callback object alive for the server's lifetime
+        self._cb = _NREQ_FN(self._on_request)
+        self._lock = threading.Lock()
+
+    # ---- control plane ------------------------------------------------
+
+    def add_service(self, service: Service) -> None:
+        for md in service.methods().values():
+            if md.full_name in self._methods:
+                raise ValueError(f"duplicate method {md.full_name}")
+            self._methods[md.full_name] = md
+
+    def register_native_echo(self, full_method: str) -> None:
+        """Serve `full_method` natively: response body = request body (the
+        reference's C++ echo handler tier; no Python per request)."""
+        self._native_echo.add(full_method)
+
+    def start(self, port: int = 0) -> int:
+        h = self._lib.brpc_tpu_nserver_start(port)
+        if h == 0:
+            raise RuntimeError(f"cannot bind port {port}")
+        self._handle = h
+        for m in self._native_echo:
+            self._lib.brpc_tpu_nserver_register_echo(h, m.encode())
+        if self._methods:
+            self._lib.brpc_tpu_nserver_set_handler(h, self._cb)
+        self.port = self._lib.brpc_tpu_nserver_port(h)
+        log.info("NativeServer started on port %d (%d py methods, %d native)",
+                 self.port, len(self._methods), len(self._native_echo))
+        return self.port
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._handle:
+                self._lib.brpc_tpu_nserver_stop(self._handle)
+                self._handle = 0
+
+    def requests(self) -> int:
+        return self._lib.brpc_tpu_nserver_requests(self._handle)
+
+    # ---- data plane upcall --------------------------------------------
+
+    def _respond(self, token: int, err: int, err_text: str,
+                 payload: bytes, att: bytes) -> None:
+        p = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload) \
+            if payload else None
+        a = (ctypes.c_uint8 * len(att)).from_buffer_copy(att) if att else None
+        self._lib.brpc_tpu_nserver_respond(
+            token, err, err_text.encode() if err_text else b"", p,
+            len(payload), a, len(att))
+
+    def _on_request(self, token, method, payload_p, payload_len,
+                    att_p, att_len, log_id):
+        try:
+            full = method.decode()
+            payload = ctypes.string_at(payload_p, payload_len) \
+                if payload_len else b""
+            att = ctypes.string_at(att_p, att_len) if att_len else b""
+            md = self._methods.get(full)
+            if md is None:
+                self._respond(token, errors.ENOMETHOD,
+                              f"no method {full}", b"", b"")
+                return
+            cntl = Controller()
+            cntl.log_id = log_id
+            if att:
+                cntl.request_attachment.append(att)
+            try:
+                request = md.request_cls()
+                request.ParseFromString(payload)
+            except Exception as e:
+                self._respond(token, errors.EREQUEST,
+                              f"fail to parse request: {e}", b"", b"")
+                return
+            response = md.response_cls()
+            done_called = [False]
+
+            def done() -> None:
+                if done_called[0]:
+                    return
+                done_called[0] = True
+                if cntl.failed():
+                    self._respond(token, cntl.error_code_, cntl.error_text_,
+                                  b"", b"")
+                    return
+                self._respond(token, 0, "", response.SerializeToString(),
+                              cntl.response_attachment.to_bytes())
+
+            cntl.set_server_done(done)
+            try:
+                md.fn(cntl, request, response, done)
+            except Exception as e:
+                log.error("native-server method %s raised: %s", full, e,
+                          exc_info=True)
+                if not done_called[0]:
+                    cntl.set_failed(errors.EINTERNAL,
+                                    f"{type(e).__name__}: {e}")
+                    done()
+        except Exception as e:          # never let an exception cross ctypes
+            log.error("native-server upcall failed: %s", e, exc_info=True)
+            try:
+                self._respond(token, errors.EINTERNAL, str(e), b"", b"")
+            except Exception:
+                pass
+
+
+class NativeChannel:
+    """Client whose datapath is native: serialize in Python once, then the
+    frame/write/read/correlate cycle runs in C++ with the GIL released."""
+
+    def __init__(self):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._handle = 0
+
+    def init(self, address: str) -> None:
+        """address: "host:port" or "ntcp://host:port"."""
+        addr = address.split("://", 1)[-1]
+        host, _, port = addr.rpartition(":")
+        h = self._lib.brpc_tpu_nchannel_connect(host.encode() or b"127.0.0.1",
+                                                int(port))
+        if h == 0:
+            raise ConnectionError(f"cannot connect {address}")
+        self._handle = h
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.brpc_tpu_nchannel_close(self._handle)
+            self._handle = 0
+
+    def call_method(self, full_name: str, cntl: Controller, request: Any,
+                    response_cls: Optional[Type] = None):
+        """Synchronous call over the native datapath.  Fills cntl error
+        state and response_attachment; returns the parsed response."""
+        if hasattr(request, "SerializeToString"):
+            req = request.SerializeToString()
+        else:
+            req = bytes(request) if request is not None else b""
+        att = cntl.request_attachment.to_bytes() \
+            if len(cntl.request_attachment) else b""
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        reqb = (ctypes.c_uint8 * len(req)).from_buffer_copy(req) if req \
+            else None
+        attb = (ctypes.c_uint8 * len(att)).from_buffer_copy(att) if att \
+            else None
+        resp_p, resp_len = u8p(), ctypes.c_uint64()
+        ratt_p, ratt_len = u8p(), ctypes.c_uint64()
+        err_text = ctypes.c_char_p()
+        timeout_us = int((cntl.timeout_ms or 5000) * 1000)
+        rc = self._lib.brpc_tpu_nchannel_call(
+            self._handle, full_name.encode(), reqb, len(req), attb, len(att),
+            timeout_us, ctypes.byref(resp_p), ctypes.byref(resp_len),
+            ctypes.byref(ratt_p), ctypes.byref(ratt_len),
+            ctypes.byref(err_text))
+        try:
+            if rc != 0:
+                text = err_text.value.decode() if err_text.value else \
+                    errors.berror(int(rc))
+                cntl.set_failed(int(rc), text)
+                return None
+            payload = ctypes.string_at(resp_p, resp_len.value) \
+                if resp_len.value else b""
+            if ratt_len.value:
+                cntl.response_attachment.append(
+                    ctypes.string_at(ratt_p, ratt_len.value))
+            if response_cls is None:
+                return payload
+            response = response_cls()
+            response.ParseFromString(payload)
+            return response
+        finally:
+            if resp_p:
+                self._lib.brpc_tpu_buf_free(resp_p)
+            if ratt_p:
+                self._lib.brpc_tpu_buf_free(ratt_p)
+            if err_text:
+                self._lib.brpc_tpu_buf_free(err_text)

@@ -78,6 +78,7 @@ class Socket:
         self.failed = False
         self.failed_error = 0
         self._write_queue: List[WriteRequest] = []
+        self._unwritten = 0          # queued-but-unwritten bytes (EOVERCROWDED)
         self._write_lock = threading.Lock()
         self._writing = False
         self._nevent = 0                    # input-event dedup counter
@@ -110,6 +111,7 @@ class Socket:
             self.failed_error = error_code
             pending = self._write_queue
             self._write_queue = []
+            self._unwritten = 0
         _socket_pool.return_resource(self.id)
         _g_socket_count << -1
         for req in pending:
@@ -123,7 +125,10 @@ class Socket:
         return True
 
     def _unwritten_bytes(self) -> int:
-        return sum(len(r.data) for r in self._write_queue)
+        # running counter (maintained under _write_lock): the queue can hold
+        # tens of thousands of requests under backlog, exactly when an
+        # O(queue) scan per write would make the guard quadratic
+        return self._unwritten
 
     # ---- write path ---------------------------------------------------
     def write(self, data: IOBuf, notify_cid: int = 0,
@@ -140,6 +145,7 @@ class Socket:
                 err = errors.EOVERCROWDED
             else:
                 self._write_queue.append(req)
+                self._unwritten += len(data)
                 if self._writing:
                     return 0
                 self._writing = True
@@ -171,6 +177,9 @@ class Socket:
             if n < 0:           # transport not writable now
                 return False
             self.stat.out_size += n
+            if n > 0:
+                with self._write_lock:
+                    self._unwritten = max(0, self._unwritten - n)
             if len(req.data) == 0:
                 with self._write_lock:
                     if self._write_queue and self._write_queue[0] is req:

@@ -1,0 +1,1095 @@
+// brpc_tpu native RPC datapath: framing + dispatch + correlation in C++.
+//
+// This is the "move framing+dispatch onto the native core" stage promised in
+// docs/DESIGN.md §4: the full RPC hot path — client channel, TRPC frame
+// codec, epoll server loop, method dispatch, response correlation — runs
+// native, with Python only on the control plane (service registration,
+// protobuf user payloads).  Reference anchors:
+//   * frame shape + server path: src/brpc/policy/baidu_rpc_protocol.cpp
+//     (ProcessRpcRequest :312, SendRpcResponse :139) — ours is the TRPC
+//     frame of brpc_tpu/policy/tpu_std.py, byte-compatible with the Python
+//     stack so native and Python peers interoperate on one wire
+//   * meta schema: brpc_tpu/proto/rpc_meta.proto (hand-rolled proto3 wire
+//     codec below — no protobuf C++ dep; unknown fields are skipped the way
+//     any proto3 parser must)
+//   * client correlation: src/brpc/controller.cpp OnVersionedRPCReturned —
+//     a cid→slot table; the caller-becomes-reader election mirrors
+//     Socket::StartInputEvent's single-reader discipline (socket.cpp:2046)
+//
+// Build: compiled into libbrpc_tpu_core.so (see native/Makefile).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#ifdef __linux__
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+#include <algorithm>
+
+namespace nrpc {
+
+// ====================================================================
+// proto3 wire codec (varint + length-delimited), RpcMeta subset
+// ====================================================================
+
+static void put_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back((char)((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out.push_back((char)v);
+}
+
+static bool get_varint(const uint8_t*& p, const uint8_t* end, uint64_t* v) {
+  uint64_t r = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    r |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *v = r;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+static void put_tag(std::string& out, int field, int wire) {
+  put_varint(out, (uint64_t)((field << 3) | wire));
+}
+
+static void put_len_field(std::string& out, int field, const std::string& s) {
+  if (s.empty()) return;
+  put_tag(out, field, 2);
+  put_varint(out, s.size());
+  out.append(s);
+}
+
+static void put_u64_field(std::string& out, int field, uint64_t v) {
+  if (v == 0) return;
+  put_tag(out, field, 0);
+  put_varint(out, v);
+}
+
+static bool skip_field(const uint8_t*& p, const uint8_t* end, int wire) {
+  uint64_t tmp;
+  switch (wire) {
+    case 0: return get_varint(p, end, &tmp);
+    case 1: if (end - p < 8) return false; p += 8; return true;
+    case 2:
+      if (!get_varint(p, end, &tmp) || (uint64_t)(end - p) < tmp) return false;
+      p += tmp;
+      return true;
+    case 5: if (end - p < 4) return false; p += 4; return true;
+    default: return false;
+  }
+}
+
+struct MetaRequest {
+  std::string service_name, method_name, auth_token;
+  uint64_t log_id = 0, trace_id = 0, span_id = 0, parent_span_id = 0;
+  uint64_t timeout_ms = 0;
+  bool present = false;
+};
+
+struct MetaResponse {
+  uint64_t error_code = 0;
+  std::string error_text;
+  bool present = false;
+};
+
+struct RpcMeta {
+  MetaRequest request;
+  MetaResponse response;
+  uint64_t compress_type = 0;
+  uint64_t correlation_id = 0;
+  uint64_t attachment_size = 0;
+  bool has_stream_settings = false;  // parsed-but-skipped (native path
+                                     // doesn't own streams; Python does)
+};
+
+static std::string encode_request_meta(const MetaRequest& r) {
+  std::string out;
+  put_len_field(out, 1, r.service_name);
+  put_len_field(out, 2, r.method_name);
+  put_u64_field(out, 3, r.log_id);
+  put_u64_field(out, 4, r.trace_id);
+  put_u64_field(out, 5, r.span_id);
+  put_u64_field(out, 6, r.parent_span_id);
+  put_u64_field(out, 7, r.timeout_ms);
+  put_len_field(out, 8, r.auth_token);
+  return out;
+}
+
+static std::string encode_response_meta(const MetaResponse& r) {
+  std::string out;
+  put_u64_field(out, 1, r.error_code);
+  put_len_field(out, 2, r.error_text);
+  return out;
+}
+
+static std::string encode_meta(const RpcMeta& m) {
+  std::string out;
+  if (m.request.present) {
+    std::string sub = encode_request_meta(m.request);
+    put_tag(out, 1, 2);
+    put_varint(out, sub.size());
+    out.append(sub);
+  }
+  if (m.response.present) {
+    std::string sub = encode_response_meta(m.response);
+    put_tag(out, 2, 2);
+    put_varint(out, sub.size());
+    out.append(sub);
+  }
+  put_u64_field(out, 3, m.compress_type);
+  put_u64_field(out, 4, m.correlation_id);
+  put_u64_field(out, 5, m.attachment_size);
+  return out;
+}
+
+static bool decode_len(const uint8_t*& p, const uint8_t* end,
+                       const uint8_t** sub, const uint8_t** sub_end) {
+  uint64_t n;
+  if (!get_varint(p, end, &n) || (uint64_t)(end - p) < n) return false;
+  *sub = p;
+  *sub_end = p + n;
+  p += n;
+  return true;
+}
+
+static bool decode_string(const uint8_t*& p, const uint8_t* end,
+                          std::string* s) {
+  const uint8_t *sub, *sub_end;
+  if (!decode_len(p, end, &sub, &sub_end)) return false;
+  s->assign((const char*)sub, sub_end - sub);
+  return true;
+}
+
+static bool decode_request_meta(const uint8_t* p, const uint8_t* end,
+                                MetaRequest* r) {
+  r->present = true;
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return false;
+    int field = (int)(tag >> 3), wire = (int)(tag & 7);
+    uint64_t v;
+    switch (field) {
+      case 1: if (!decode_string(p, end, &r->service_name)) return false; break;
+      case 2: if (!decode_string(p, end, &r->method_name)) return false; break;
+      case 3: if (!get_varint(p, end, &r->log_id)) return false; break;
+      case 4: if (!get_varint(p, end, &r->trace_id)) return false; break;
+      case 5: if (!get_varint(p, end, &r->span_id)) return false; break;
+      case 6: if (!get_varint(p, end, &r->parent_span_id)) return false; break;
+      case 7: if (!get_varint(p, end, &r->timeout_ms)) return false; break;
+      case 8: if (!decode_string(p, end, &r->auth_token)) return false; break;
+      default: if (!skip_field(p, end, wire)) return false; break;
+    }
+    (void)v;
+  }
+  return true;
+}
+
+static bool decode_response_meta(const uint8_t* p, const uint8_t* end,
+                                 MetaResponse* r) {
+  r->present = true;
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return false;
+    int field = (int)(tag >> 3), wire = (int)(tag & 7);
+    switch (field) {
+      case 1: if (!get_varint(p, end, &r->error_code)) return false; break;
+      case 2: if (!decode_string(p, end, &r->error_text)) return false; break;
+      default: if (!skip_field(p, end, wire)) return false; break;
+    }
+  }
+  return true;
+}
+
+static bool decode_meta(const uint8_t* p, const uint8_t* end, RpcMeta* m) {
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return false;
+    int field = (int)(tag >> 3), wire = (int)(tag & 7);
+    const uint8_t *sub, *sub_end;
+    switch (field) {
+      case 1:
+        if (!decode_len(p, end, &sub, &sub_end) ||
+            !decode_request_meta(sub, sub_end, &m->request))
+          return false;
+        break;
+      case 2:
+        if (!decode_len(p, end, &sub, &sub_end) ||
+            !decode_response_meta(sub, sub_end, &m->response))
+          return false;
+        break;
+      case 3: if (!get_varint(p, end, &m->compress_type)) return false; break;
+      case 4: if (!get_varint(p, end, &m->correlation_id)) return false; break;
+      case 5: if (!get_varint(p, end, &m->attachment_size)) return false; break;
+      case 6:
+        m->has_stream_settings = true;
+        if (!skip_field(p, end, wire)) return false;
+        break;
+      default: if (!skip_field(p, end, wire)) return false; break;
+    }
+  }
+  return true;
+}
+
+// ====================================================================
+// TRPC frame: "TRPC" + u32be meta_size + u32be body_size
+// ====================================================================
+
+static const char kMagic[4] = {'T', 'R', 'P', 'C'};
+static const size_t kHeaderSize = 12;
+
+static void put_u32be(std::string& out, uint32_t v) {
+  out.push_back((char)(v >> 24));
+  out.push_back((char)(v >> 16));
+  out.push_back((char)(v >> 8));
+  out.push_back((char)v);
+}
+
+static std::string pack_frame(const RpcMeta& meta, const void* body,
+                              size_t body_len) {
+  std::string meta_bytes = encode_meta(meta);
+  std::string out;
+  out.reserve(kHeaderSize + meta_bytes.size() + body_len);
+  out.append(kMagic, 4);
+  put_u32be(out, (uint32_t)meta_bytes.size());
+  put_u32be(out, (uint32_t)body_len);
+  out.append(meta_bytes);
+  out.append((const char*)body, body_len);
+  return out;
+}
+
+static uint32_t get_u32be(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+// ====================================================================
+// fd helpers
+// ====================================================================
+
+static void set_nonblock(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+}
+
+static void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+// write fully, polling through EAGAIN (the drain discipline of
+// Socket::DoWrite — callers already serialized per connection)
+static bool write_all(int fd, const char* data, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t w = ::write(fd, data + off, len - off);
+    if (w > 0) {
+      off += (size_t)w;
+    } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      struct pollfd pfd{fd, POLLOUT, 0};
+      ::poll(&pfd, 1, 100);
+    } else if (w < 0 && errno == EINTR) {
+      continue;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// ====================================================================
+// NativeServer
+// ====================================================================
+
+// Python request hook: (token, method, payload, payload_len, att, att_len,
+// log_id).  Respond via brpc_tpu_nserver_respond(token, ...) from any
+// thread; each token must be answered exactly once.
+typedef void (*py_request_fn)(uint64_t token, const char* method,
+                              const uint8_t* payload, uint64_t payload_len,
+                              const uint8_t* att, uint64_t att_len,
+                              uint64_t log_id);
+
+// Conns are shared_ptr-owned: the epoll thread, the conns_ map, and any
+// in-flight respond() each hold a reference, so closing a connection can
+// never free memory under another thread (the reference gets this from
+// Socket's versioned-id ResourcePool; shared_ptr is the C++-idiomatic
+// equivalent here).  After close, fd is -1 under wmu — respond() checks it
+// so a recycled fd number is never written.
+struct Conn {
+  int fd = -1;
+  std::string rbuf;
+  std::mutex wmu;
+  uint64_t id = 0;
+};
+using ConnPtr = std::shared_ptr<Conn>;
+
+struct PendingReply;
+
+class NativeServer {
+ public:
+  bool start(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)port);
+    if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &len);
+    port_ = ntohs(addr.sin_port);
+    ::listen(listen_fd_, 128);
+    set_nonblock(listen_fd_);
+    epfd_ = epoll_create1(0);
+    epoll_event ev{};
+    ev.events = EPOLLIN;                 // listen fd: level-triggered accept
+    ev.data.u64 = 0;                     // 0 = listener
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    thread_ = std::thread([this] { run(); });
+    return true;
+  }
+
+  void stop();          // defined after the token registry (purges tokens)
+
+  void set_handle(uint64_t h) { handle_ = h; }
+  uint64_t handle() const { return handle_; }
+
+  int port() const { return port_; }
+
+  void register_echo(const std::string& full_method) {
+    std::lock_guard<std::mutex> g(methods_mu_);
+    echo_methods_.insert({full_method, true});
+  }
+
+  void set_py_handler(py_request_fn fn) { py_handler_ = fn; }
+
+  uint64_t requests() const { return requests_.load(); }
+
+  bool respond(uint64_t conn_id, uint64_t cid, uint64_t err,
+               const std::string& err_text, const void* data, size_t len,
+               const void* att, size_t att_len);
+
+ private:
+  void run() {
+    epoll_event events[64];
+    while (!stop_.load(std::memory_order_relaxed)) {
+      int n = epoll_wait(epfd_, events, 64, 50);
+      for (int i = 0; i < n; ++i) {
+        if (events[i].data.u64 == 0) {
+          accept_all();
+        } else {
+          ConnPtr c = find_conn(events[i].data.u64);
+          if (c != nullptr) handle_readable(c);
+        }
+      }
+    }
+  }
+
+  void accept_all() {
+    for (;;) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      set_nonblock(fd);
+      set_nodelay(fd);
+      ConnPtr c = std::make_shared<Conn>();
+      c->fd = fd;
+      c->id = next_conn_id_.fetch_add(1) + 1;  // ids start at 1 (0=listener)
+      {
+        std::lock_guard<std::mutex> g(conns_mu_);
+        conns_[c->id] = c;
+      }
+      epoll_event ev{};
+      ev.events = EPOLLIN | EPOLLET;           // edge-triggered data path
+      ev.data.u64 = c->id;
+      epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+    }
+  }
+
+  ConnPtr find_conn(uint64_t id) {
+    std::lock_guard<std::mutex> g(conns_mu_);
+    auto it = conns_.find(id);
+    return it == conns_.end() ? nullptr : it->second;
+  }
+
+  void close_conn(const ConnPtr& c) {
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      conns_.erase(c->id);
+    }
+    std::lock_guard<std::mutex> wg(c->wmu);
+    if (c->fd >= 0) {
+      epoll_ctl(epfd_, EPOLL_CTL_DEL, c->fd, nullptr);
+      ::close(c->fd);
+      c->fd = -1;     // respond() checks under wmu: no write to recycled fd
+    }
+  }
+
+  void handle_readable(const ConnPtr& c) {
+    char buf[65536];
+    for (;;) {                       // ET: drain until EAGAIN
+      ssize_t r = ::read(c->fd, buf, sizeof(buf));
+      if (r > 0) {
+        c->rbuf.append(buf, (size_t)r);
+      } else if (r == 0) {
+        close_conn(c);
+        return;
+      } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        break;
+      } else if (errno == EINTR) {
+        continue;
+      } else {
+        close_conn(c);
+        return;
+      }
+    }
+    // cut complete frames
+    size_t off = 0;
+    const std::string& rb = c->rbuf;
+    while (rb.size() - off >= kHeaderSize) {
+      const uint8_t* p = (const uint8_t*)rb.data() + off;
+      if (memcmp(p, kMagic, 4) != 0) {  // protocol error: drop conn
+        close_conn(c);
+        return;
+      }
+      uint32_t meta_size = get_u32be(p + 4);
+      uint32_t body_size = get_u32be(p + 8);
+      if (meta_size > (1u << 26) || body_size > (1u << 31)) {
+        close_conn(c);   // absurd frame sizes (tpu_std.py parse guard)
+        return;
+      }
+      size_t total = kHeaderSize + (size_t)meta_size + body_size;
+      if (rb.size() - off < total) break;
+      process_frame(c, p + kHeaderSize, meta_size,
+                    p + kHeaderSize + meta_size, body_size);
+      off += total;
+    }
+    if (off > 0) c->rbuf.erase(0, off);
+  }
+
+  void process_frame(const ConnPtr& c, const uint8_t* meta_p,
+                     size_t meta_len, const uint8_t* body, size_t body_len);
+
+  int listen_fd_ = -1, epfd_ = -1, port_ = 0;
+  uint64_t handle_ = 0;
+  std::thread thread_;
+  std::atomic<bool> stop_{false};
+  std::mutex conns_mu_;
+  std::unordered_map<uint64_t, ConnPtr> conns_;
+  std::atomic<uint64_t> next_conn_id_{0};
+  std::mutex methods_mu_;
+  std::unordered_map<std::string, bool> echo_methods_;
+  py_request_fn py_handler_ = nullptr;
+  std::atomic<uint64_t> requests_{0};
+};
+
+// Tokens for in-flight Python-handled requests.  A token stores the
+// server's registry HANDLE, never a pointer: respond() re-resolves both
+// the server (g_servers, shared_ptr) and the conn (conns_, shared_ptr) so
+// replies after a disconnect or a server stop are dropped, not crashed —
+// the reference's Socket::Address versioned-id discipline.
+struct PendingReply {
+  uint64_t server_handle;
+  uint64_t conn_id;
+  uint64_t cid;
+};
+
+static std::mutex g_tokens_mu;
+static std::unordered_map<uint64_t, PendingReply> g_tokens;
+static std::atomic<uint64_t> g_next_token{1};
+
+void NativeServer::stop() {
+  stop_.store(true);
+  if (thread_.joinable()) thread_.join();
+  {
+    // drop replies parked in Python for this server: their tokens must not
+    // resolve once we're gone
+    std::lock_guard<std::mutex> g(g_tokens_mu);
+    for (auto it = g_tokens.begin(); it != g_tokens.end();) {
+      if (it->second.server_handle == handle_) it = g_tokens.erase(it);
+      else ++it;
+    }
+  }
+  std::vector<ConnPtr> conns;
+  {
+    std::lock_guard<std::mutex> g(conns_mu_);
+    for (auto& kv : conns_) conns.push_back(kv.second);
+    conns_.clear();
+  }
+  for (auto& c : conns) {
+    std::lock_guard<std::mutex> wg(c->wmu);
+    if (c->fd >= 0) {
+      ::close(c->fd);
+      c->fd = -1;
+    }
+  }
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+  if (epfd_ >= 0) ::close(epfd_);
+  listen_fd_ = epfd_ = -1;
+}
+
+bool NativeServer::respond(uint64_t conn_id, uint64_t cid, uint64_t err,
+                           const std::string& err_text, const void* data,
+                           size_t len, const void* att, size_t att_len) {
+  ConnPtr c = find_conn(conn_id);
+  if (c == nullptr) return false;
+  RpcMeta rmeta;
+  rmeta.response.present = true;
+  rmeta.response.error_code = err;
+  rmeta.response.error_text = err_text;
+  rmeta.correlation_id = cid;
+  rmeta.attachment_size = att_len;
+  std::string body((const char*)data, len);
+  if (att_len) body.append((const char*)att, att_len);
+  std::string frame = pack_frame(rmeta, body.data(), body.size());
+  std::lock_guard<std::mutex> g(c->wmu);
+  if (c->fd < 0) return false;       // closed while the handler ran
+  return write_all(c->fd, frame.data(), frame.size());
+}
+
+void NativeServer::process_frame(const ConnPtr& c, const uint8_t* meta_p,
+                                 size_t meta_len, const uint8_t* body,
+                                 size_t body_len) {
+  RpcMeta meta;
+  if (!decode_meta(meta_p, meta_p + meta_len, &meta)) {
+    close_conn(c);
+    return;
+  }
+  requests_.fetch_add(1, std::memory_order_relaxed);
+  std::string full = meta.request.service_name + "." +
+                     meta.request.method_name;
+  {
+    std::lock_guard<std::mutex> g(methods_mu_);
+    if (echo_methods_.count(full)) {
+      // native echo: response payload = request payload, attachment echoed
+      RpcMeta rmeta;
+      rmeta.response.present = true;
+      rmeta.correlation_id = meta.correlation_id;
+      rmeta.attachment_size = meta.attachment_size;
+      std::string frame = pack_frame(rmeta, body, body_len);
+      std::lock_guard<std::mutex> wg(c->wmu);
+      write_all(c->fd, frame.data(), frame.size());
+      return;
+    }
+  }
+  if (py_handler_ != nullptr) {
+    uint64_t token = g_next_token.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> g(g_tokens_mu);
+      g_tokens[token] = PendingReply{handle_, c->id, meta.correlation_id};
+    }
+    size_t att = std::min((size_t)meta.attachment_size, body_len);
+    size_t payload_len = body_len - att;
+    py_handler_(token, full.c_str(), body, payload_len, body + payload_len,
+                att, meta.request.log_id);
+    return;
+  }
+  // ENOMETHOD (brpc_tpu/rpc/errors.py values mirror the reference's)
+  RpcMeta rmeta;
+  rmeta.response.present = true;
+  rmeta.response.error_code = 1002;  // ENOMETHOD (rpc/errors.py)
+  rmeta.response.error_text = "no method " + full;
+  rmeta.correlation_id = meta.correlation_id;
+  std::string frame = pack_frame(rmeta, nullptr, 0);
+  std::lock_guard<std::mutex> wg(c->wmu);
+  write_all(c->fd, frame.data(), frame.size());
+}
+
+// ====================================================================
+// NativeChannel: correlation table + caller-becomes-reader election
+// ====================================================================
+
+// Slots are shared_ptr-owned: the caller, the slots_ map, and a reader
+// mid-dispatch each hold a reference, so a timed-out caller erasing its
+// slot can never free it under the reader (the review finding this fixes:
+// dispatch_frame resolved a raw pointer, released slots_mu_, then locked
+// the slot — a deleted slot in between was a use-after-free).
+struct CallSlot {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  uint64_t error_code = 0;
+  std::string error_text;
+  std::string payload;       // response body minus attachment
+  std::string attachment;
+};
+using SlotPtr = std::shared_ptr<CallSlot>;
+
+class NativeChannel {
+ public:
+  ~NativeChannel() {
+    // fd closes only here, once every in-flight call has dropped its
+    // shared_ptr to this channel — an fd number is never recycled while a
+    // caller could still write it
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool connect_to(const char* host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return false;
+    if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return false;
+    }
+    set_nodelay(fd_);
+    set_nonblock(fd_);   // readers use poll(); an exact-64KiB read burst
+                         // must hit EAGAIN, not block holding read_mu_
+    return true;
+  }
+
+  void close_ch() {
+    closing_.store(true, std::memory_order_release);
+    // fail all pending; fd itself closes in the destructor
+    std::lock_guard<std::mutex> g(slots_mu_);
+    for (auto& kv : slots_) {
+      std::lock_guard<std::mutex> sg(kv.second->mu);
+      kv.second->done = true;
+      kv.second->error_code = 1009;  // EFAILEDSOCKET (rpc/errors.py)
+      kv.second->error_text = "channel closed";
+      kv.second->cv.notify_all();
+    }
+    slots_.clear();
+  }
+
+  // 0 ok; 1008 ERPCTIMEDOUT; 1009 broken socket; else server error code
+  uint64_t call(const char* service_dot_method, const void* req,
+                size_t req_len, const void* att, size_t att_len,
+                int64_t timeout_us, std::string* resp, std::string* resp_att,
+                std::string* err_text) {
+    if (fd_ < 0 || closing_.load(std::memory_order_acquire)) {
+      *err_text = "channel not connected";
+      return 1009;
+    }
+    uint64_t cid = next_cid_.fetch_add(1) + 1;
+    SlotPtr slot = std::make_shared<CallSlot>();
+    {
+      std::lock_guard<std::mutex> g(slots_mu_);
+      slots_[cid] = slot;
+    }
+    // pack + write
+    RpcMeta meta;
+    meta.request.present = true;
+    const char* dot = strrchr(service_dot_method, '.');
+    if (dot == nullptr) {
+      meta.request.method_name = service_dot_method;
+    } else {
+      meta.request.service_name.assign(service_dot_method,
+                                       dot - service_dot_method);
+      meta.request.method_name = dot + 1;
+    }
+    meta.correlation_id = cid;
+    meta.attachment_size = att_len;
+    if (timeout_us > 0) meta.request.timeout_ms = (uint64_t)(timeout_us / 1000);
+    std::string body((const char*)req, req_len);
+    if (att_len) body.append((const char*)att, att_len);
+    std::string frame = pack_frame(meta, body.data(), body.size());
+    {
+      std::lock_guard<std::mutex> g(wmu_);
+      if (closing_.load(std::memory_order_acquire) ||
+          !write_all(fd_, frame.data(), frame.size())) {
+        erase_slot(cid);
+        *err_text = "write failed";
+        return 1009;
+      }
+    }
+    // wait: become the reader or wait for the reader to fill our slot
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::microseconds(timeout_us > 0 ? timeout_us
+                                                             : (int64_t)1e12);
+    uint64_t rc = 0;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> sl(slot->mu);
+        if (slot->done) break;
+      }
+      if (read_mu_.try_lock()) {
+        bool progressed = read_once(200);
+        read_mu_.unlock();
+        if (!progressed && closing_.load(std::memory_order_acquire)) {
+          std::unique_lock<std::mutex> sl(slot->mu);
+          if (!slot->done) {
+            slot->done = true;
+            slot->error_code = 1009;
+            slot->error_text = "connection lost";
+          }
+          break;
+        }
+      } else {
+        std::unique_lock<std::mutex> sl(slot->mu);
+        slot->cv.wait_for(sl, std::chrono::milliseconds(1));
+        if (slot->done) break;
+      }
+      if (std::chrono::steady_clock::now() >= deadline) {
+        erase_slot(cid);   // response arriving later finds no slot: dropped,
+                           // exactly the stale-version drop of bthread_id
+        *err_text = "rpc timeout";
+        return 1008;       // ERPCTIMEDOUT (rpc/errors.py)
+      }
+    }
+    rc = slot->error_code;
+    *err_text = slot->error_text;
+    *resp = std::move(slot->payload);
+    *resp_att = std::move(slot->attachment);
+    erase_slot(cid);
+    return rc;
+  }
+
+ private:
+  void erase_slot(uint64_t cid) {
+    std::lock_guard<std::mutex> g(slots_mu_);
+    slots_.erase(cid);
+  }
+
+  // Read whatever is available (poll up to timeout_ms), dispatch complete
+  // frames into slots.  Returns true if bytes were read.
+  bool read_once(int timeout_ms) {
+    struct pollfd pfd{fd_, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, timeout_ms);
+    if (pr <= 0) return false;
+    char buf[65536];
+    bool any = false;
+    for (;;) {
+      ssize_t r = ::read(fd_, buf, sizeof(buf));
+      if (r > 0) {
+        rbuf_.append(buf, (size_t)r);
+        any = true;
+        if ((size_t)r < sizeof(buf)) break;
+      } else if (r == 0) {
+        // peer EOF: shutdown (not close) so the fd number cannot be
+        // recycled while concurrent writers still reference it; the
+        // destructor does the close
+        ::shutdown(fd_, SHUT_RDWR);
+        closing_.store(true, std::memory_order_release);
+        break;
+      } else {
+        break;  // EAGAIN (fd is nonblocking)
+      }
+    }
+    size_t off = 0;
+    while (rbuf_.size() - off >= kHeaderSize) {
+      const uint8_t* p = (const uint8_t*)rbuf_.data() + off;
+      if (memcmp(p, kMagic, 4) != 0) { off = rbuf_.size(); break; }
+      uint32_t meta_size = get_u32be(p + 4);
+      uint32_t body_size = get_u32be(p + 8);
+      if (meta_size > (1u << 26) || body_size > (1u << 31)) {
+        off = rbuf_.size();  // poisoned stream: drop buffered bytes
+        break;
+      }
+      size_t total = kHeaderSize + (size_t)meta_size + body_size;
+      if (rbuf_.size() - off < total) break;
+      dispatch_frame(p + kHeaderSize, meta_size, p + kHeaderSize + meta_size,
+                     body_size);
+      off += total;
+    }
+    if (off > 0) rbuf_.erase(0, off);
+    return any;
+  }
+
+  void dispatch_frame(const uint8_t* meta_p, size_t meta_len,
+                      const uint8_t* body, size_t body_len) {
+    RpcMeta meta;
+    if (!decode_meta(meta_p, meta_p + meta_len, &meta)) return;
+    SlotPtr slot;
+    {
+      std::lock_guard<std::mutex> g(slots_mu_);
+      auto it = slots_.find(meta.correlation_id);
+      if (it != slots_.end()) slot = it->second;  // shared ref held past mu
+    }
+    if (slot == nullptr) return;  // timed out / stale: drop
+    size_t att = std::min((size_t)meta.attachment_size, body_len);
+    size_t payload_len = body_len - att;
+    std::lock_guard<std::mutex> sg(slot->mu);
+    slot->error_code = meta.response.error_code;
+    slot->error_text = meta.response.error_text;
+    slot->payload.assign((const char*)body, payload_len);
+    slot->attachment.assign((const char*)body + payload_len, att);
+    slot->done = true;
+    slot->cv.notify_all();
+  }
+
+  int fd_ = -1;
+  std::atomic<bool> closing_{false};
+  std::atomic<uint64_t> next_cid_{0};
+  std::mutex wmu_;
+  std::mutex read_mu_;
+  std::string rbuf_;
+  std::mutex slots_mu_;
+  std::unordered_map<uint64_t, SlotPtr> slots_;
+};
+
+// ====================================================================
+// handle registries.  shared_ptr ownership: a stop/close erases the map
+// entry, but callers that already resolved the handle keep the object
+// alive until they return — no free-under-caller (the registry is the
+// versioned-id check; the shared_ptr is the reference count the C ABI
+// can't express).
+// ====================================================================
+
+static std::mutex g_handles_mu;
+static std::unordered_map<uint64_t, std::shared_ptr<NativeServer>> g_servers;
+static std::unordered_map<uint64_t, std::shared_ptr<NativeChannel>> g_channels;
+static std::atomic<uint64_t> g_next_handle{1};
+
+static std::shared_ptr<NativeServer> find_server(uint64_t h) {
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  auto it = g_servers.find(h);
+  return it == g_servers.end() ? nullptr : it->second;
+}
+
+static std::shared_ptr<NativeChannel> find_channel(uint64_t h) {
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  auto it = g_channels.find(h);
+  return it == g_channels.end() ? nullptr : it->second;
+}
+
+}  // namespace nrpc
+
+// ====================================================================
+// C ABI
+// ====================================================================
+
+extern "C" {
+
+uint64_t brpc_tpu_nserver_start(int port) {
+  auto s = std::make_shared<nrpc::NativeServer>();
+  if (!s->start(port)) return 0;
+  uint64_t h = nrpc::g_next_handle.fetch_add(1);
+  s->set_handle(h);
+  std::lock_guard<std::mutex> g(nrpc::g_handles_mu);
+  nrpc::g_servers[h] = s;
+  return h;
+}
+
+int brpc_tpu_nserver_port(uint64_t h) {
+  auto s = nrpc::find_server(h);
+  return s == nullptr ? -1 : s->port();
+}
+
+int brpc_tpu_nserver_register_echo(uint64_t h, const char* full_method) {
+  auto s = nrpc::find_server(h);
+  if (s == nullptr) return -1;
+  s->register_echo(full_method);
+  return 0;
+}
+
+int brpc_tpu_nserver_set_handler(uint64_t h, nrpc::py_request_fn fn) {
+  auto s = nrpc::find_server(h);
+  if (s == nullptr) return -1;
+  s->set_py_handler(fn);
+  return 0;
+}
+
+uint64_t brpc_tpu_nserver_requests(uint64_t h) {
+  auto s = nrpc::find_server(h);
+  return s == nullptr ? 0 : s->requests();
+}
+
+int brpc_tpu_nserver_respond(uint64_t token, uint64_t err,
+                             const char* err_text, const uint8_t* data,
+                             uint64_t len, const uint8_t* att,
+                             uint64_t att_len) {
+  nrpc::PendingReply pr;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_tokens_mu);
+    auto it = nrpc::g_tokens.find(token);
+    if (it == nrpc::g_tokens.end()) return -1;
+    pr = it->second;
+    nrpc::g_tokens.erase(it);
+  }
+  // resolve by handle: a stopped server no longer resolves (its tokens
+  // were purged too; this is belt-and-braces for the in-between window)
+  auto s = nrpc::find_server(pr.server_handle);
+  if (s == nullptr) return -1;
+  bool ok = s->respond(pr.conn_id, pr.cid, err, err_text ? err_text : "",
+                       data, len, att, att_len);
+  return ok ? 0 : -2;
+}
+
+void brpc_tpu_nserver_stop(uint64_t h) {
+  std::shared_ptr<nrpc::NativeServer> s;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_handles_mu);
+    auto it = nrpc::g_servers.find(h);
+    if (it == nrpc::g_servers.end()) return;
+    s = it->second;
+    nrpc::g_servers.erase(it);
+  }
+  s->stop();   // frees when the last concurrent resolver drops its ref
+}
+
+uint64_t brpc_tpu_nchannel_connect(const char* host, int port) {
+  auto c = std::make_shared<nrpc::NativeChannel>();
+  if (!c->connect_to(host, port)) return 0;
+  uint64_t h = nrpc::g_next_handle.fetch_add(1);
+  std::lock_guard<std::mutex> g(nrpc::g_handles_mu);
+  nrpc::g_channels[h] = c;
+  return h;
+}
+
+// Returns error code (0 ok).  Response/attachment/error-text returned as
+// malloc'd buffers the caller frees with brpc_tpu_buf_free.
+uint64_t brpc_tpu_nchannel_call(uint64_t h, const char* method,
+                                const uint8_t* req, uint64_t req_len,
+                                const uint8_t* att, uint64_t att_len,
+                                int64_t timeout_us, uint8_t** resp_out,
+                                uint64_t* resp_len, uint8_t** att_out,
+                                uint64_t* att_out_len, char** err_text_out) {
+  *resp_out = nullptr; *resp_len = 0;
+  *att_out = nullptr; *att_out_len = 0;
+  *err_text_out = nullptr;
+  auto c = nrpc::find_channel(h);    // shared ref: close can't free mid-call
+  if (c == nullptr) return 1009;
+  std::string resp, resp_att, err_text;
+  uint64_t rc = c->call(method, req, req_len, att, att_len, timeout_us,
+                        &resp, &resp_att, &err_text);
+  if (!resp.empty()) {
+    *resp_out = (uint8_t*)malloc(resp.size());
+    memcpy(*resp_out, resp.data(), resp.size());
+    *resp_len = resp.size();
+  }
+  if (!resp_att.empty()) {
+    *att_out = (uint8_t*)malloc(resp_att.size());
+    memcpy(*att_out, resp_att.data(), resp_att.size());
+    *att_out_len = resp_att.size();
+  }
+  if (!err_text.empty()) {
+    *err_text_out = (char*)malloc(err_text.size() + 1);
+    memcpy(*err_text_out, err_text.c_str(), err_text.size() + 1);
+  }
+  return rc;
+}
+
+void brpc_tpu_buf_free(void* p) { free(p); }
+
+void brpc_tpu_nchannel_close(uint64_t h) {
+  std::shared_ptr<nrpc::NativeChannel> c;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_handles_mu);
+    auto it = nrpc::g_channels.find(h);
+    if (it == nrpc::g_channels.end()) return;
+    c = it->second;
+    nrpc::g_channels.erase(it);
+  }
+  c->close_ch();   // destructor (and the fd close) runs when the last
+                   // in-flight call drops its reference
+}
+
+// Full-native-stack echo benchmark: channel → frame → epoll server →
+// dispatch → response → correlation wake, all in this library.  Measures
+// per-call round trips the way example/echo_c++'s client does.  Returns
+// p50 ns (-1 failure).
+int64_t brpc_tpu_native_rpc_echo_p50_ns(int iters, int payload_len) {
+  uint64_t sh = brpc_tpu_nserver_start(0);
+  if (sh == 0) return -1;
+  brpc_tpu_nserver_register_echo(sh, "EchoService.Echo");
+  int port = brpc_tpu_nserver_port(sh);
+  uint64_t ch = brpc_tpu_nchannel_connect("127.0.0.1", port);
+  if (ch == 0) {
+    brpc_tpu_nserver_stop(sh);
+    return -1;
+  }
+  std::string payload(payload_len, 'x');
+  std::vector<int64_t> lat;
+  lat.reserve(iters);
+  auto now_ns = [] {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  };
+  auto c = nrpc::find_channel(ch);
+  for (int i = 0; i < iters + 50; ++i) {
+    std::string resp, resp_att, err;
+    int64_t t0 = now_ns();
+    uint64_t rc = c->call("EchoService.Echo", payload.data(), payload.size(),
+                          nullptr, 0, 5 * 1000 * 1000, &resp, &resp_att,
+                          &err);
+    int64_t t1 = now_ns();
+    if (rc != 0 || resp.size() != payload.size()) {
+      brpc_tpu_nchannel_close(ch);
+      brpc_tpu_nserver_stop(sh);
+      return -1;
+    }
+    if (i >= 50) lat.push_back(t1 - t0);
+  }
+  brpc_tpu_nchannel_close(ch);
+  brpc_tpu_nserver_stop(sh);
+  std::sort(lat.begin(), lat.end());
+  return lat[lat.size() / 2];
+}
+
+// Multi-threaded native QPS benchmark (the multi_threaded_echo_c++ config):
+// `threads` client threads, one connection each, run for duration_ms.
+double brpc_tpu_native_rpc_qps(int threads, int duration_ms,
+                               int payload_len) {
+  uint64_t sh = brpc_tpu_nserver_start(0);
+  if (sh == 0) return -1.0;
+  brpc_tpu_nserver_register_echo(sh, "EchoService.Echo");
+  int port = brpc_tpu_nserver_port(sh);
+  std::atomic<uint64_t> count{0};
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < threads; ++t) {
+    ts.emplace_back([&, t] {
+      uint64_t ch = brpc_tpu_nchannel_connect("127.0.0.1", port);
+      if (ch == 0) return;
+      auto c = nrpc::find_channel(ch);
+      std::string payload(payload_len, 'x');
+      while (!stop.load(std::memory_order_relaxed)) {
+        std::string resp, resp_att, err;
+        uint64_t rc = c->call("EchoService.Echo", payload.data(),
+                              payload.size(), nullptr, 0, 5 * 1000 * 1000,
+                              &resp, &resp_att, &err);
+        if (rc == 0) count.fetch_add(1, std::memory_order_relaxed);
+      }
+      brpc_tpu_nchannel_close(ch);
+    });
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(std::chrono::milliseconds(duration_ms));
+  stop.store(true);
+  for (auto& th : ts) th.join();
+  double secs = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0)
+                    .count();
+  brpc_tpu_nserver_stop(sh);
+  return count.load() / secs;
+}
+
+}  // extern "C"
+
+#else  // !__linux__
+
+extern "C" {
+uint64_t brpc_tpu_nserver_start(int) { return 0; }
+int brpc_tpu_nserver_port(uint64_t) { return -1; }
+int64_t brpc_tpu_native_rpc_echo_p50_ns(int, int) { return -1; }
+double brpc_tpu_native_rpc_qps(int, int, int) { return -1.0; }
+}
+
+#endif

@@ -1,0 +1,266 @@
+"""Native RPC datapath tests (native/rpc.cpp + rpc/native_fabric.py).
+
+Covers the four peer pairings on the one TRPC wire format:
+  1. native channel ↔ native server (native echo handler, zero Python)
+  2. native channel ↔ native server (Python service handler)
+  3. Python rpc.Channel (tcp://) → native server   [wire interop A]
+  4. native channel → Python rpc.Server (tcp://)   [wire interop B]
+plus error paths (no method, timeout) and the in-C benchmark entries.
+
+The reference's analogue is brpc_channel_unittest.cpp's in-process
+client/server fixtures; interop here additionally pins the hand-rolled C++
+proto3 codec against python-protobuf's output byte-for-byte.
+"""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401  (registers protocols)
+from brpc_tpu import rpc
+from brpc_tpu.butil import native
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.native_fabric import NativeChannel, NativeServer
+
+from echo_pb2 import EchoRequest, EchoResponse
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core unavailable")
+
+
+class EchoService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        if len(cntl.request_attachment):
+            cntl.response_attachment.append(cntl.request_attachment)
+        done()
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Fail(self, cntl, request, response, done):
+        cntl.set_failed(errors.EINTERNAL, "deliberate")
+        done()
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Slow(self, cntl, request, response, done):
+        time.sleep((request.sleep_us or 0) / 1e6)
+        response.message = "slow"
+        done()
+
+
+def test_native_to_native_echo():
+    server = NativeServer()
+    server.register_native_echo("EchoService.Echo")
+    port = server.start()
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    try:
+        cntl = rpc.Controller()
+        req = EchoRequest(message="hello-native")
+        resp = ch.call_method("EchoService.Echo", cntl, req, EchoResponse)
+        assert not cntl.failed(), cntl.error_text_
+        # native echo reflects bytes; EchoRequest/EchoResponse share field 1
+        assert resp.message == "hello-native"
+    finally:
+        ch.close()
+        server.stop()
+
+
+def test_native_server_python_service():
+    server = NativeServer()
+    server.add_service(EchoService())
+    port = server.start()
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    try:
+        cntl = rpc.Controller()
+        cntl.request_attachment.append(b"att-bytes")
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="py-handler"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text_
+        assert resp.message == "py-handler"
+        assert cntl.response_attachment.to_bytes() == b"att-bytes"
+        # error propagation
+        cntl2 = rpc.Controller()
+        ch.call_method("EchoService.Fail", cntl2, EchoRequest(message="x"),
+                       EchoResponse)
+        assert cntl2.failed()
+        assert cntl2.error_code_ == errors.EINTERNAL
+        assert "deliberate" in cntl2.error_text_
+    finally:
+        ch.close()
+        server.stop()
+
+
+def test_native_server_no_method():
+    server = NativeServer()
+    server.add_service(EchoService())
+    port = server.start()
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    try:
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Nope", cntl, EchoRequest(message="x"),
+                       EchoResponse)
+        assert cntl.failed()
+        assert cntl.error_code_ == errors.ENOMETHOD
+    finally:
+        ch.close()
+        server.stop()
+
+
+def test_native_channel_timeout():
+    server = NativeServer()
+    server.add_service(EchoService())
+    port = server.start()
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    try:
+        cntl = rpc.Controller()
+        cntl.timeout_ms = 50
+        ch.call_method("EchoService.Slow", cntl,
+                       EchoRequest(message="x", sleep_us=300_000),
+                       EchoResponse)
+        assert cntl.failed()
+        assert cntl.error_code_ == errors.ERPCTIMEDOUT
+    finally:
+        ch.close()
+        server.stop()
+
+
+def test_python_channel_to_native_server():
+    """Wire interop A: the Python stack's tcp:// channel (tpu_std protocol,
+    python-protobuf-encoded meta) against the C++ frame parser."""
+    server = NativeServer()
+    server.add_service(EchoService())
+    server.register_native_echo("NativeEcho.Echo")
+    port = server.start()
+    try:
+        ch = rpc.Channel()
+        ch.init(f"tcp://127.0.0.1:{port}",
+                options=rpc.ChannelOptions(timeout_ms=5000, max_retry=0))
+        cntl = rpc.Controller()
+        cntl.request_attachment.append(b"pyatt")
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="from-python"),
+                              EchoResponse)
+        assert not cntl.failed(), cntl.error_text_
+        assert resp.message == "from-python"
+        assert cntl.response_attachment.to_bytes() == b"pyatt"
+        # and the zero-python native echo tier
+        cntl2 = rpc.Controller()
+        resp2 = ch.call_method("NativeEcho.Echo", cntl2,
+                               EchoRequest(message="native-tier"),
+                               EchoResponse)
+        assert not cntl2.failed(), cntl2.error_text_
+        assert resp2.message == "native-tier"
+    finally:
+        server.stop()
+
+
+def test_native_channel_to_python_server():
+    """Wire interop B: the C++ channel's hand-encoded meta parsed by the
+    Python server (python-protobuf decoder)."""
+    opts = rpc.ServerOptions()
+    opts.usercode_inline = True
+    server = rpc.Server(opts)
+    server.add_service(EchoService())
+    server.start("127.0.0.1:0")
+    port = server.listen_port
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    try:
+        cntl = rpc.Controller()
+        cntl.request_attachment.append(b"natt")
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="from-native"),
+                              EchoResponse)
+        assert not cntl.failed(), cntl.error_text_
+        assert resp.message == "from-native"
+        assert cntl.response_attachment.to_bytes() == b"natt"
+    finally:
+        ch.close()
+        server.stop()
+
+
+def test_meta_codec_matches_python_protobuf():
+    """Byte-level pin: C++ encoder output must parse with python-protobuf
+    and embed the same fields (unknown-field skipping covers the rest)."""
+    from brpc_tpu.proto import rpc_meta_pb2 as meta_pb
+    # encode with python protobuf, ship through the native server: covered
+    # by interop A.  Here: decode a python-encoded meta that contains
+    # stream_settings (a field the C++ side skips) — the native server must
+    # still answer the RPC (skip-unknown correctness).
+    server = NativeServer()
+    server.add_service(EchoService())
+    port = server.start()
+    import socket as pysock
+    s = pysock.create_connection(("127.0.0.1", port))
+    try:
+        meta = meta_pb.RpcMeta()
+        meta.request.service_name = "EchoService"
+        meta.request.method_name = "Echo"
+        meta.correlation_id = 77
+        meta.stream_settings.stream_id = 5          # unknown to C++ parser
+        meta.stream_settings.frame_type = 4
+        body = EchoRequest(message="skipfield").SerializeToString()
+        mb = meta.SerializeToString()
+        frame = (b"TRPC" + len(mb).to_bytes(4, "big")
+                 + len(body).to_bytes(4, "big") + mb + body)
+        s.sendall(frame)
+        # read one response frame
+        hdr = b""
+        while len(hdr) < 12:
+            hdr += s.recv(12 - len(hdr))
+        assert hdr[:4] == b"TRPC"
+        msize = int.from_bytes(hdr[4:8], "big")
+        bsize = int.from_bytes(hdr[8:12], "big")
+        rest = b""
+        while len(rest) < msize + bsize:
+            rest += s.recv(msize + bsize - len(rest))
+        rmeta = meta_pb.RpcMeta()
+        rmeta.ParseFromString(rest[:msize])
+        assert rmeta.correlation_id == 77
+        assert rmeta.response.error_code == 0
+        resp = EchoResponse()
+        resp.ParseFromString(rest[msize:])
+        assert resp.message == "skipfield"
+    finally:
+        s.close()
+        server.stop()
+
+
+def test_native_concurrent_calls():
+    """Many threads share one native channel: correlation must not cross."""
+    server = NativeServer()
+    server.add_service(EchoService())
+    port = server.start()
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    failures = []
+
+    def worker(i):
+        for j in range(20):
+            cntl = rpc.Controller()
+            msg = f"w{i}-{j}"
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message=msg), EchoResponse)
+            if cntl.failed() or resp.message != msg:
+                failures.append((i, j, cntl.error_text_))
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads: t.start()
+        for t in threads: t.join()
+        assert not failures, failures[:3]
+    finally:
+        ch.close()
+        server.stop()
+
+
+def test_native_rpc_bench_entries():
+    p50 = native.native_rpc_echo_p50_us(iters=300, payload=1024)
+    assert p50 > 0, "bench entry failed"
+    assert p50 < 2000  # generous CI bound; ~10us on quiet hardware
+    qps = native.native_rpc_qps(threads=4, duration_ms=300, payload=64)
+    assert qps > 1000
