@@ -177,6 +177,7 @@ def main() -> None:
         "unit": "us",
         "vs_baseline": round(target_us / headline, 4),
         "extra": {
+            "host_cores": __import__("os").cpu_count(),
             "native_rpc_qps_16thr": round(nqps, 0),
             "raw_epoll_echo_p50_us": round(raw_p50, 2),
             "python_stack_ici_echo_p50_us": round(echo["p50_us"], 1),

@@ -52,14 +52,24 @@ def load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_SO)
             if not hasattr(lib, "brpc_tpu_nserver_start"):
-                # stale .so predating native/rpc.cpp: rebuild once
+                # stale .so predating native/rpc.cpp: rebuild, then load
+                # through a unique temp copy — dlopen dedups by pathname,
+                # so re-opening _SO would return the stale mapping
                 if not _build():
                     return None
-                lib = ctypes.CDLL(_SO)
+                import shutil
+                import tempfile
+                tmp = tempfile.NamedTemporaryFile(
+                    suffix=".so", prefix="brpc_tpu_core_", delete=False)
+                tmp.close()
+                shutil.copy(_SO, tmp.name)
+                lib = ctypes.CDLL(tmp.name)
+                if not hasattr(lib, "brpc_tpu_nserver_start"):
+                    return None
             return _bind(lib)
         except (OSError, AttributeError):
-            # missing symbols (e.g. non-Linux stub) → no native core;
-            # callers fall back to the pure-Python implementations
+            # broken core library → none; callers fall back to the
+            # pure-Python implementations
             return None
 
 

@@ -8,6 +8,7 @@ from . import naming
 from . import http
 from . import redis
 from . import memcache
+from . import mongo
 from . import thrift
 from . import auth
 from . import grpc

@@ -121,6 +121,12 @@ def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
         return ParseResult.not_enough_data()
     if head[0] not in (MAGIC_RESPONSE, MAGIC_REQUEST):
         return ParseResult.try_others()
+    # 0x80/0x81 collide with small binary frames of other protocols (mongo
+    # lengths 128/129); memcache is client-only (reference parity) — only
+    # claim when a memcache call is outstanding on this socket
+    if getattr(arg, "server", None) is not None or \
+            not getattr(socket, "pipelined_contexts", None):
+        return ParseResult.try_others()
     data = source.fetch(len(source))
     ops: List[MemcacheOpResponse] = []
     pos = 0

@@ -182,6 +182,17 @@ def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
         return ParseResult.not_enough_data()
     if data[:1] not in b"+-:$*":
         return ParseResult.try_others()
+    # RESP's markers are single bytes that collide with binary frames (e.g.
+    # '$' = 0x24 is a plausible little-endian mongo length); only claim the
+    # stream when redis is actually in play here — server side: a
+    # RedisService is registered; client side: a redis call is outstanding
+    # (the reference gates server protocols on enabled services too)
+    server = getattr(arg, "server", None)
+    if server is not None:
+        if getattr(server, "redis_service", None) is None:
+            return ParseResult.try_others()
+    elif not getattr(socket, "pipelined_contexts", None):
+        return ParseResult.try_others()
     units: List[RedisReply] = []
     pos = 0
     try:

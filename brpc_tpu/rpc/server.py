@@ -73,6 +73,10 @@ class Server:
         if hasattr(svc, "handle") and hasattr(svc, "add_method"):
             self.thrift_service = svc
             return 0
+        if getattr(svc, "SERVICE_NAME", None) == "mongo" and \
+                hasattr(svc, "process"):
+            self._mongo_service = svc
+            return 0
         name = svc.service_name()
         if name in self._services:
             return errors.EINVAL

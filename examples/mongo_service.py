@@ -1,0 +1,56 @@
+"""A mongo-wire key-value service: the server answers OP_MSG commands
+(insert/find/ping) with BSON documents — the mongo_protocol.cpp adaptor
+pattern, usable without any external driver."""
+from __future__ import annotations
+
+from examples.common import rpc
+from brpc_tpu.policy.mongo import MongoRequest, MongoResponse, MongoService
+
+
+class KvMongo(MongoService):
+    def __init__(self):
+        self.store = {}
+
+    def process(self, cntl, doc):
+        if "ping" in doc:
+            return {"ok": 1}
+        if "insert" in doc:
+            for d in doc.get("documents", []):
+                self.store[d["_id"]] = d
+            return {"ok": 1, "n": len(doc.get("documents", []))}
+        if "find" in doc:
+            key = doc.get("filter", {}).get("_id")
+            hit = self.store.get(key)
+            return {"ok": 1, "cursor": {"firstBatch": [hit] if hit else [],
+                                        "id": 0}}
+        return {"ok": 0, "errmsg": f"unknown command {list(doc)[:1]}"}
+
+
+def main() -> None:
+    server = rpc.Server()
+    server.add_service(KvMongo())
+    server.start("mem://example-mongo")
+    try:
+        ch = rpc.Channel()
+        ch.init("mem://example-mongo",
+                options=rpc.ChannelOptions(timeout_ms=2000,
+                                           protocol="mongo"))
+        cntl = rpc.Controller()
+        r = ch.call_method("mongo", cntl, MongoRequest(
+            {"insert": "kv", "documents": [{"_id": "a", "v": 1},
+                                           {"_id": "b", "v": 2}]}),
+            MongoResponse)
+        assert not cntl.failed() and r.doc["n"] == 2
+        cntl = rpc.Controller()
+        r = ch.call_method("mongo", cntl, MongoRequest(
+            {"find": "kv", "filter": {"_id": "b"}}), MongoResponse)
+        assert not cntl.failed()
+        batch = r.doc["cursor"]["firstBatch"]
+        print(f"mongo find -> {batch}")
+        assert batch[0]["v"] == 2
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,7 @@
 #ifdef __linux__
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
 #endif
 
 // ====================================================================
@@ -167,6 +168,25 @@ class Butex {
 
 constexpr size_t kFiberStackSize = 256 * 1024;
 
+// mmap'd stack with a PROT_NONE guard page at the low end (stacks grow
+// down), the reference's bthread/stack.cpp FLAGS_guard_page_size
+// discipline: an overflowing fiber faults instead of corrupting the
+// neighbouring allocation.  Fibers are pooled and never freed, matching
+// the reference's stack pools.
+static char* alloc_fiber_stack() {
+#ifdef __linux__
+  const size_t page = 4096;
+  char* base = (char*)mmap(nullptr, kFiberStackSize + page,
+                           PROT_READ | PROT_WRITE,
+                           MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (base != MAP_FAILED) {
+    mprotect(base, page, PROT_NONE);
+    return base + page;
+  }
+#endif
+  return (char*)malloc(kFiberStackSize);
+}
+
 struct Fiber;
 struct Worker;
 
@@ -222,7 +242,7 @@ class Scheduler {
     }
     if (f == nullptr) {
       f = new Fiber();
-      f->stack = (char*)malloc(kFiberStackSize);
+      f->stack = alloc_fiber_stack();
     }
     f->fn = fn;
     f->arg = arg;

@@ -300,16 +300,27 @@ static void set_nodelay(int fd) {
 }
 
 // write fully, polling through EAGAIN (the drain discipline of
-// Socket::DoWrite — callers already serialized per connection)
-static bool write_all(int fd, const char* data, size_t len) {
+// Socket::DoWrite — callers already serialized per connection).  Bounded:
+// a peer that stops reading must not wedge the caller forever (the epoll
+// thread calls this inline, so an unbounded loop would starve every
+// connection on the loop and deadlock stop()).  ~5 s of refusal = dead.
+static bool write_all(int fd, const char* data, size_t len,
+                      const std::atomic<bool>* abort_flag = nullptr,
+                      int timeout_ms = 5000) {
   size_t off = 0;
+  int waited_ms = 0;
   while (off < len) {
+    if (abort_flag != nullptr &&
+        abort_flag->load(std::memory_order_relaxed))
+      return false;
     ssize_t w = ::write(fd, data + off, len - off);
     if (w > 0) {
       off += (size_t)w;
     } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (waited_ms >= timeout_ms) return false;
       struct pollfd pfd{fd, POLLOUT, 0};
       ::poll(&pfd, 1, 100);
+      waited_ms += 100;
     } else if (w < 0 && errno == EINTR) {
       continue;
     } else {
@@ -342,6 +353,7 @@ struct Conn {
   std::string rbuf;
   std::mutex wmu;
   uint64_t id = 0;
+  int loop = 0;       // owning epoll loop (reads are single-threaded per conn)
 };
 using ConnPtr = std::shared_ptr<Conn>;
 
@@ -349,7 +361,10 @@ struct PendingReply;
 
 class NativeServer {
  public:
-  bool start(int port) {
+  // nloops: epoll loops (the reference's FLAGS_event_dispatcher_num,
+  // event_dispatcher.cpp:30).  Loop 0 owns the listener; accepted conns
+  // hash across loops so request processing scales past one core.
+  bool start(int port, int nloops = 4) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
     setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -363,12 +378,15 @@ class NativeServer {
     port_ = ntohs(addr.sin_port);
     ::listen(listen_fd_, 128);
     set_nonblock(listen_fd_);
-    epfd_ = epoll_create1(0);
+    nloops_ = nloops < 1 ? 1 : nloops;
+    epfds_.resize(nloops_);
+    for (int i = 0; i < nloops_; ++i) epfds_[i] = epoll_create1(0);
     epoll_event ev{};
     ev.events = EPOLLIN;                 // listen fd: level-triggered accept
     ev.data.u64 = 0;                     // 0 = listener
-    epoll_ctl(epfd_, EPOLL_CTL_ADD, listen_fd_, &ev);
-    thread_ = std::thread([this] { run(); });
+    epoll_ctl(epfds_[0], EPOLL_CTL_ADD, listen_fd_, &ev);
+    for (int i = 0; i < nloops_; ++i)
+      threads_.emplace_back([this, i] { run(i); });
     return true;
   }
 
@@ -393,10 +411,10 @@ class NativeServer {
                const void* att, size_t att_len);
 
  private:
-  void run() {
+  void run(int loop) {
     epoll_event events[64];
     while (!stop_.load(std::memory_order_relaxed)) {
-      int n = epoll_wait(epfd_, events, 64, 50);
+      int n = epoll_wait(epfds_[loop], events, 64, 50);
       for (int i = 0; i < n; ++i) {
         if (events[i].data.u64 == 0) {
           accept_all();
@@ -417,6 +435,7 @@ class NativeServer {
       ConnPtr c = std::make_shared<Conn>();
       c->fd = fd;
       c->id = next_conn_id_.fetch_add(1) + 1;  // ids start at 1 (0=listener)
+      c->loop = (int)(c->id % nloops_);        // conn pinned to one loop
       {
         std::lock_guard<std::mutex> g(conns_mu_);
         conns_[c->id] = c;
@@ -424,7 +443,7 @@ class NativeServer {
       epoll_event ev{};
       ev.events = EPOLLIN | EPOLLET;           // edge-triggered data path
       ev.data.u64 = c->id;
-      epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+      epoll_ctl(epfds_[c->loop], EPOLL_CTL_ADD, fd, &ev);
     }
   }
 
@@ -441,7 +460,7 @@ class NativeServer {
     }
     std::lock_guard<std::mutex> wg(c->wmu);
     if (c->fd >= 0) {
-      epoll_ctl(epfd_, EPOLL_CTL_DEL, c->fd, nullptr);
+      epoll_ctl(epfds_[c->loop], EPOLL_CTL_DEL, c->fd, nullptr);
       ::close(c->fd);
       c->fd = -1;     // respond() checks under wmu: no write to recycled fd
     }
@@ -492,9 +511,11 @@ class NativeServer {
   void process_frame(const ConnPtr& c, const uint8_t* meta_p,
                      size_t meta_len, const uint8_t* body, size_t body_len);
 
-  int listen_fd_ = -1, epfd_ = -1, port_ = 0;
+  int listen_fd_ = -1, port_ = 0;
+  int nloops_ = 1;
+  std::vector<int> epfds_;
   uint64_t handle_ = 0;
-  std::thread thread_;
+  std::vector<std::thread> threads_;
   std::atomic<bool> stop_{false};
   std::mutex conns_mu_;
   std::unordered_map<uint64_t, ConnPtr> conns_;
@@ -522,7 +543,9 @@ static std::atomic<uint64_t> g_next_token{1};
 
 void NativeServer::stop() {
   stop_.store(true);
-  if (thread_.joinable()) thread_.join();
+  for (auto& t : threads_)
+    if (t.joinable()) t.join();
+  threads_.clear();
   {
     // drop replies parked in Python for this server: their tokens must not
     // resolve once we're gone
@@ -546,8 +569,10 @@ void NativeServer::stop() {
     }
   }
   if (listen_fd_ >= 0) ::close(listen_fd_);
-  if (epfd_ >= 0) ::close(epfd_);
-  listen_fd_ = epfd_ = -1;
+  for (int fd : epfds_)
+    if (fd >= 0) ::close(fd);
+  epfds_.clear();
+  listen_fd_ = -1;
 }
 
 bool NativeServer::respond(uint64_t conn_id, uint64_t cid, uint64_t err,
@@ -566,7 +591,7 @@ bool NativeServer::respond(uint64_t conn_id, uint64_t cid, uint64_t err,
   std::string frame = pack_frame(rmeta, body.data(), body.size());
   std::lock_guard<std::mutex> g(c->wmu);
   if (c->fd < 0) return false;       // closed while the handler ran
-  return write_all(c->fd, frame.data(), frame.size());
+  return write_all(c->fd, frame.data(), frame.size(), &stop_);
 }
 
 void NativeServer::process_frame(const ConnPtr& c, const uint8_t* meta_p,
@@ -580,19 +605,27 @@ void NativeServer::process_frame(const ConnPtr& c, const uint8_t* meta_p,
   requests_.fetch_add(1, std::memory_order_relaxed);
   std::string full = meta.request.service_name + "." +
                      meta.request.method_name;
+  bool is_echo;
   {
     std::lock_guard<std::mutex> g(methods_mu_);
-    if (echo_methods_.count(full)) {
-      // native echo: response payload = request payload, attachment echoed
-      RpcMeta rmeta;
-      rmeta.response.present = true;
-      rmeta.correlation_id = meta.correlation_id;
-      rmeta.attachment_size = meta.attachment_size;
-      std::string frame = pack_frame(rmeta, body, body_len);
+    is_echo = echo_methods_.count(full) != 0;
+  }  // released before any write: a stalled peer must not hold the
+     // server-wide method table against other loops
+  if (is_echo) {
+    // native echo: response payload = request payload, attachment echoed
+    RpcMeta rmeta;
+    rmeta.response.present = true;
+    rmeta.correlation_id = meta.correlation_id;
+    rmeta.attachment_size = meta.attachment_size;
+    std::string frame = pack_frame(rmeta, body, body_len);
+    bool ok;
+    {
       std::lock_guard<std::mutex> wg(c->wmu);
-      write_all(c->fd, frame.data(), frame.size());
-      return;
+      ok = c->fd >= 0 &&
+           write_all(c->fd, frame.data(), frame.size(), &stop_);
     }
+    if (!ok) close_conn(c);     // non-reading peer: drop it, free the loop
+    return;
   }
   if (py_handler_ != nullptr) {
     uint64_t token = g_next_token.fetch_add(1);
@@ -613,8 +646,13 @@ void NativeServer::process_frame(const ConnPtr& c, const uint8_t* meta_p,
   rmeta.response.error_text = "no method " + full;
   rmeta.correlation_id = meta.correlation_id;
   std::string frame = pack_frame(rmeta, nullptr, 0);
-  std::lock_guard<std::mutex> wg(c->wmu);
-  write_all(c->fd, frame.data(), frame.size());
+  bool ok;
+  {
+    std::lock_guard<std::mutex> wg(c->wmu);
+    ok = c->fd >= 0 &&
+         write_all(c->fd, frame.data(), frame.size(), &stop_);
+  }
+  if (!ok) close_conn(c);
 }
 
 // ====================================================================
@@ -665,10 +703,14 @@ class NativeChannel {
 
   void close_ch() {
     closing_.store(true, std::memory_order_release);
-    // fail all pending; fd itself closes in the destructor
+    fail_all_pending();     // fd itself closes in the destructor
+  }
+
+  void fail_all_pending() {
     std::lock_guard<std::mutex> g(slots_mu_);
     for (auto& kv : slots_) {
       std::lock_guard<std::mutex> sg(kv.second->mu);
+      if (kv.second->done) continue;   // delivered result stays delivered
       kv.second->done = true;
       kv.second->error_code = 1009;  // EFAILEDSOCKET (rpc/errors.py)
       kv.second->error_text = "channel closed";
@@ -786,6 +828,7 @@ class NativeChannel {
         // destructor does the close
         ::shutdown(fd_, SHUT_RDWR);
         closing_.store(true, std::memory_order_release);
+        fail_all_pending();
         break;
       } else {
         break;  // EAGAIN (fd is nonblocking)
@@ -794,12 +837,17 @@ class NativeChannel {
     size_t off = 0;
     while (rbuf_.size() - off >= kHeaderSize) {
       const uint8_t* p = (const uint8_t*)rbuf_.data() + off;
-      if (memcmp(p, kMagic, 4) != 0) { off = rbuf_.size(); break; }
       uint32_t meta_size = get_u32be(p + 4);
       uint32_t body_size = get_u32be(p + 8);
-      if (meta_size > (1u << 26) || body_size > (1u << 31)) {
-        off = rbuf_.size();  // poisoned stream: drop buffered bytes
-        break;
+      if (memcmp(p, kMagic, 4) != 0 || meta_size > (1u << 26) ||
+          body_size > (1u << 31)) {
+        // mid-frame desync is unrecoverable on a byte stream: fail the
+        // channel so callers get 1009 now instead of timing out forever
+        ::shutdown(fd_, SHUT_RDWR);
+        closing_.store(true, std::memory_order_release);
+        fail_all_pending();
+        rbuf_.clear();
+        return any;
       }
       size_t total = kHeaderSize + (size_t)meta_size + body_size;
       if (rbuf_.size() - off < total) break;
@@ -1085,9 +1133,27 @@ double brpc_tpu_native_rpc_qps(int threads, int duration_ms,
 
 #else  // !__linux__
 
+// Full stub set: every symbol the Python bindings reference must exist so
+// _bind() succeeds and the rest of the native core (pools, butex, fibers,
+// timers) stays usable even where the epoll datapath is unavailable.
+#include <cstdint>
 extern "C" {
 uint64_t brpc_tpu_nserver_start(int) { return 0; }
 int brpc_tpu_nserver_port(uint64_t) { return -1; }
+int brpc_tpu_nserver_register_echo(uint64_t, const char*) { return -1; }
+int brpc_tpu_nserver_set_handler(uint64_t, void*) { return -1; }
+uint64_t brpc_tpu_nserver_requests(uint64_t) { return 0; }
+int brpc_tpu_nserver_respond(uint64_t, uint64_t, const char*,
+                             const uint8_t*, uint64_t, const uint8_t*,
+                             uint64_t) { return -1; }
+void brpc_tpu_nserver_stop(uint64_t) {}
+uint64_t brpc_tpu_nchannel_connect(const char*, int) { return 0; }
+uint64_t brpc_tpu_nchannel_call(uint64_t, const char*, const uint8_t*,
+                                uint64_t, const uint8_t*, uint64_t, int64_t,
+                                uint8_t**, uint64_t*, uint8_t**, uint64_t*,
+                                char**) { return 1009; }
+void brpc_tpu_buf_free(void* p) { free(p); }
+void brpc_tpu_nchannel_close(uint64_t) {}
 int64_t brpc_tpu_native_rpc_echo_p50_ns(int, int) { return -1; }
 double brpc_tpu_native_rpc_qps(int, int, int) { return -1.0; }
 }

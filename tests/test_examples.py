@@ -21,6 +21,8 @@ EXAMPLES = [
     "examples.http_server",
     "examples.auto_concurrency_limiter",
     "examples.param_server",
+    "examples.native_echo",
+    "examples.mongo_service",
 ]
 
 

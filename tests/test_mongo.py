@@ -1,0 +1,152 @@
+"""Mongo protocol tests: BSON codec round-trips, OP_MSG framing, and an
+in-process MongoService server driven by the mongo client channel (the
+reference covers this in test/brpc_mongo_protocol_unittest.cpp with golden
+buffers + in-process servers)."""
+import struct
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.policy.mongo import (MongoHead, MongoRequest, MongoResponse,
+                                   MongoService, bson_decode, bson_encode,
+                                   OP_MSG, OP_QUERY, HEAD_SIZE,
+                                   _pack_op_msg, _parse_op_msg)
+
+
+class TestBson:
+    def test_roundtrip_scalars(self):
+        doc = {"int": 42, "big": 1 << 40, "f": 3.5, "s": "hello",
+               "b": True, "n": None, "raw": b"\x00\x01\x02"}
+        assert bson_decode(bson_encode(doc)) == doc
+
+    def test_roundtrip_nested(self):
+        doc = {"outer": {"inner": [1, 2, {"deep": "x"}]}, "arr": ["a", "b"]}
+        assert bson_decode(bson_encode(doc)) == doc
+
+    def test_negative_and_bounds(self):
+        doc = {"neg": -5, "min32": -(1 << 31), "max32": (1 << 31) - 1,
+               "over": 1 << 31}
+        out = bson_decode(bson_encode(doc))
+        assert out == doc
+
+    def test_bool_not_int(self):
+        # bool must encode as BSON bool (0x08), not int32
+        data = bson_encode({"t": True})
+        assert data[4] == 0x08
+
+    def test_empty_doc(self):
+        assert bson_decode(bson_encode({})) == {}
+
+
+class TestOpMsg:
+    def test_kind0_roundtrip(self):
+        doc = {"ping": 1, "$db": "admin"}
+        assert _parse_op_msg(_pack_op_msg(doc)) == doc
+
+    def test_kind1_sequence(self):
+        # kind 0 command + kind 1 document sequence named "documents"
+        body = struct.pack("<I", 0)
+        body += b"\x00" + bson_encode({"insert": "c"})
+        seq = b"documents\x00" + bson_encode({"a": 1}) + bson_encode({"a": 2})
+        body += b"\x01" + struct.pack("<i", len(seq) + 4) + seq
+        doc = _parse_op_msg(body)
+        assert doc["insert"] == "c"
+        assert doc["documents"] == [{"a": 1}, {"a": 2}]
+
+    def test_head_roundtrip(self):
+        h = MongoHead(100, 7, 3, OP_MSG)
+        h2 = MongoHead.unpack(h.pack())
+        assert (h2.message_length, h2.request_id, h2.response_to,
+                h2.op_code) == (100, 7, 3, OP_MSG)
+
+
+class PingPongService(MongoService):
+    def process(self, cntl, doc):
+        if "ping" in doc:
+            return {"ok": 1, "pong": True}
+        if "echo" in doc:
+            return {"ok": 1, "echoed": doc["echo"]}
+        if "boom" in doc:
+            raise RuntimeError("kaboom")
+        return None        # default {"ok": 1}
+
+
+class TestMongoRpc:
+    def _serve(self, scheme="mem://mongo-test"):
+        server = rpc.Server()
+        server.add_service(PingPongService())
+        server.start(scheme)
+        ch = rpc.Channel()
+        ch.init(scheme, options=rpc.ChannelOptions(timeout_ms=5000,
+                                                   protocol="mongo"))
+        return server, ch
+
+    def test_ping(self):
+        server, ch = self._serve()
+        try:
+            cntl = rpc.Controller()
+            resp = ch.call_method("mongo", cntl,
+                                  MongoRequest({"ping": 1, "$db": "admin"}),
+                                  MongoResponse)
+            assert not cntl.failed(), cntl.error_text_
+            assert resp.doc["ok"] == 1 and resp.doc["pong"] is True
+        finally:
+            server.stop()
+
+    def test_echo_nested_doc(self):
+        server, ch = self._serve("mem://mongo-echo")
+        try:
+            cntl = rpc.Controller()
+            payload = {"list": [1, "two", {"three": 3}], "flag": False}
+            resp = ch.call_method("mongo", cntl,
+                                  MongoRequest({"echo": payload}), None)
+            assert not cntl.failed(), cntl.error_text_
+            assert resp.doc["echoed"] == payload
+        finally:
+            server.stop()
+
+    def test_handler_exception_becomes_error_doc(self):
+        server, ch = self._serve("mem://mongo-err")
+        try:
+            cntl = rpc.Controller()
+            resp = ch.call_method("mongo", cntl,
+                                  MongoRequest({"boom": 1}), None)
+            assert not cntl.failed()       # transport-level ok
+            assert resp.doc["ok"] == 0
+            assert "kaboom" in resp.doc["errmsg"]
+        finally:
+            server.stop()
+
+    def test_over_tcp(self):
+        server = rpc.Server()
+        server.add_service(PingPongService())
+        server.start("127.0.0.1:0")
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}",
+                    options=rpc.ChannelOptions(timeout_ms=5000,
+                                               protocol="mongo"))
+            cntl = rpc.Controller()
+            resp = ch.call_method("mongo", cntl, MongoRequest({"ping": 1}),
+                                  None)
+            assert not cntl.failed(), cntl.error_text_
+            assert resp.doc["ok"] == 1
+        finally:
+            server.stop()
+
+    def test_no_service_registered(self):
+        server = rpc.Server()
+        server.start("mem://mongo-nosvc")
+        try:
+            ch = rpc.Channel()
+            ch.init("mem://mongo-nosvc",
+                    options=rpc.ChannelOptions(timeout_ms=2000,
+                                               protocol="mongo"))
+            cntl = rpc.Controller()
+            resp = ch.call_method("mongo", cntl, MongoRequest({"ping": 1}),
+                                  None)
+            assert not cntl.failed()
+            assert resp.doc["ok"] == 0
+        finally:
+            server.stop()
