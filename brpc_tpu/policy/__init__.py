@@ -12,3 +12,8 @@ from . import mongo
 from . import thrift
 from . import auth
 from . import grpc
+from . import nshead
+from . import legacy_pbrpc
+from . import nova
+from . import public_pbrpc
+from . import esp

@@ -23,7 +23,9 @@ from .socket_map import SocketMap
 @dataclass
 class ChannelOptions:
     protocol: str = "tpu_std"
-    connection_type: str = "single"     # single | pooled | short
+    # "" = adaptive: single when the protocol supports it, else pooled
+    # (reference adaptive_connection_type.h); explicit values are enforced
+    connection_type: str = ""           # "" | single | pooled | short
     timeout_ms: int = 1000
     max_retry: int = 3
     backup_request_ms: int = 0          # 0 = disabled
@@ -50,6 +52,23 @@ class Channel:
         self._protocol = find_protocol(self.options.protocol)
         if self._protocol is None:
             raise ValueError(f"unknown protocol {self.options.protocol!r}")
+        from .protocol import (CONNECTION_TYPE_SINGLE, CONNECTION_TYPE_POOLED,
+                               CONNECTION_TYPE_SHORT)
+        _ctype_bits = {"single": CONNECTION_TYPE_SINGLE,
+                       "pooled": CONNECTION_TYPE_POOLED,
+                       "short": CONNECTION_TYPE_SHORT}
+        if self.options.connection_type not in ("",) and \
+                self.options.connection_type not in _ctype_bits:
+            raise ValueError(
+                f"unknown connection_type {self.options.connection_type!r}")
+        want = _ctype_bits.get(self.options.connection_type)
+        if want is not None and not (
+                self._protocol.supported_connection_type & want):
+            # the reference fails Channel::Init on an unsupported explicit
+            # connection type rather than silently changing it
+            raise ValueError(
+                f"protocol {self._protocol.name!r} does not support "
+                f"connection_type={self.options.connection_type!r}")
         if isinstance(target, EndPoint):
             self._endpoint = target
             return 0
@@ -100,6 +119,7 @@ class Channel:
         if self._protocol.pipelined:
             maker = getattr(self._protocol, "make_pipeline_ctx", None)
             ctx = maker(cid, cntl) if maker is not None else cid
+            cntl._pipeline_ctx = ctx
             sock.push_pipelined_context(ctx)
         rc = sock.write(packet, notify_cid=cid)
         if rc != 0:
@@ -108,6 +128,13 @@ class Channel:
 
     def _select_socket(self, cntl: Controller):
         ctype = self.options.connection_type
+        # adaptive connection type (reference adaptive_connection_type.h):
+        # when unset, protocols without an on-wire correlation id can't
+        # share a single connection across concurrent calls → pooled
+        from .protocol import CONNECTION_TYPE_SINGLE
+        if ctype == "" and not (self._protocol.supported_connection_type
+                                & CONNECTION_TYPE_SINGLE):
+            ctype = "pooled"
         smap = SocketMap.instance()
         if self._lb is not None:
             ep = self._lb.select_server(cntl)
@@ -131,6 +158,23 @@ class Channel:
         # pooled sockets go back to the pool; short ones close
         sock = getattr(cntl, "_last_socket", None)
         ep = getattr(cntl, "_pooled_from", None)
+        own_ctx = getattr(cntl, "_pipeline_ctx", None)
+        exclusive = ep is not None or \
+            getattr(cntl, "_short_socket", None) is not None
+        if cntl.failed() and sock is not None and own_ctx is not None \
+                and exclusive:
+            # THIS call's context is still queued on an exclusive
+            # (pooled/short) connection: the response never arrived, and
+            # reusing the connection would mis-correlate the next call's
+            # response (the reference closes cid-less connections on
+            # error).  Shared single connections are left alone — their
+            # other calls' contexts are legitimately outstanding and a
+            # late response pops the stale context harmlessly.
+            with sock._pipeline_lock:
+                dangling = own_ctx in sock.pipelined_contexts
+            if dangling:
+                sock.set_failed(errors.ECLOSE,
+                                "own pipelined context still outstanding")
         if ep is not None and sock is not None:
             SocketMap.instance().return_pooled_socket(ep, sock)
         short = getattr(cntl, "_short_socket", None)

@@ -77,6 +77,21 @@ class Server:
                 hasattr(svc, "process"):
             self._mongo_service = svc
             return 0
+        # NsheadService / adaptors (nova, public_pbrpc, ubrpc ride on this):
+        # exactly one may own the connection's nshead frames
+        if getattr(svc, "SERVICE_NAME", None) == "nshead" and \
+                hasattr(svc, "process_nshead_request"):
+            if getattr(self, "_nshead_service", None) is not None:
+                return errors.EINVAL
+            self._nshead_service = svc
+            return 0
+        # EspService raw handler (same single-owner rule)
+        if getattr(svc, "SERVICE_NAME", None) == "esp" and \
+                hasattr(svc, "process_esp_request"):
+            if getattr(self, "_esp_service", None) is not None:
+                return errors.EINVAL
+            self._esp_service = svc
+            return 0
         name = svc.service_name()
         if name in self._services:
             return errors.EINVAL
