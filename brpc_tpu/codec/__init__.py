@@ -1,2 +1,3 @@
-"""Codec side-libraries (reference: src/json2pb/, SURVEY.md §2.7)."""
+"""Codec side-libraries (reference: src/json2pb/ + src/mcpack2pb/, SURVEY.md §2.7)."""
 from . import json2pb
+from . import mcpack

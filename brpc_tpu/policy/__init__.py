@@ -17,3 +17,4 @@ from . import legacy_pbrpc
 from . import nova
 from . import public_pbrpc
 from . import esp
+from . import ubrpc

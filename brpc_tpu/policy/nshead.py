@@ -130,7 +130,13 @@ class NsheadPbServiceAdaptor(NsheadService):
                 pass
             done()
 
-        self.parse_nshead_meta(server, request, controller, meta)
+        # adaptor hooks run under exception guards: a raise must become a
+        # protocol-level error response, not an empty-body reply
+        try:
+            self.parse_nshead_meta(server, request, controller, meta)
+        except Exception as e:
+            controller.set_failed(errors.EREQUEST,
+                                  f"{type(e).__name__}: {e}")
         if controller.failed():
             fail_out()
             return
@@ -141,7 +147,11 @@ class NsheadPbServiceAdaptor(NsheadService):
             fail_out()
             return
         pb_req = md.request_cls()
-        self.parse_request_from_iobuf(meta, request, controller, pb_req)
+        try:
+            self.parse_request_from_iobuf(meta, request, controller, pb_req)
+        except Exception as e:
+            controller.set_failed(errors.EREQUEST,
+                                  f"{type(e).__name__}: {e}")
         if controller.failed():
             fail_out()
             return

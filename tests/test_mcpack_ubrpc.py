@@ -1,0 +1,253 @@
+"""mcpack v2 codec + ubrpc protocol tests (reference:
+test/brpc_ubrpc2pb_protocol_unittest.cpp and the mcpack2pb test suite —
+golden byte layouts + in-process adaptor round trips)."""
+import struct
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.codec.mcpack import (FIELD_BOOL, FIELD_INT8, FIELD_INT32,
+                                   FIELD_OBJECT, FIELD_SHORT_MASK,
+                                   FIELD_STRING, McpackError,
+                                   mcpack_decode, mcpack_encode,
+                                   dict_to_pb, pb_to_dict)
+from brpc_tpu.policy.ubrpc import UbrpcAdaptor
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [0]
+
+
+def unique_name(prefix):
+    _seq[0] += 1
+    return f"{prefix}-{_seq[0]}"
+
+
+class TestMcpackCodec:
+    def test_roundtrip_scalars(self):
+        doc = {"i8": 5, "neg": -7, "i32": 70000, "i64": 1 << 40,
+               "u64": (1 << 63) + 1, "f": 2.5, "s": "hello", "b": True,
+               "raw": b"\x00\x01", "n": None}
+        assert mcpack_decode(mcpack_encode(doc)) == doc
+
+    def test_roundtrip_nested(self):
+        doc = {"obj": {"inner": {"x": 1}}, "arr": [1, "two", {"three": 3}],
+               "empty_obj": {}, "empty_arr": []}
+        assert mcpack_decode(mcpack_encode(doc)) == doc
+
+    def test_golden_top_level_head(self):
+        # top-level object: FieldLongHead(type=0x10, name_size=0, u32 size)
+        raw = mcpack_encode({})
+        assert raw[0] == FIELD_OBJECT
+        assert raw[1] == 0                       # unnamed
+        assert struct.unpack("<I", raw[2:6])[0] == 4   # just ItemsHead
+        assert struct.unpack("<I", raw[6:10])[0] == 0  # zero items
+
+    def test_golden_fixed_int(self):
+        # {"a": 1} → item: fixed head (0x11, name_size=2) "a\0" 0x01
+        raw = mcpack_encode({"a": 1})
+        item = raw[10:]
+        assert item[0] == FIELD_INT8
+        assert item[1] == 2
+        assert item[2:4] == b"a\x00"
+        assert item[4] == 1
+
+    def test_golden_short_string(self):
+        # short strings: type|0x80, value includes trailing NUL
+        raw = mcpack_encode({"s": "hi"})
+        item = raw[10:]
+        assert item[0] == (FIELD_STRING | FIELD_SHORT_MASK)
+        assert item[1] == 2                      # "s\0"
+        assert item[2] == 3                      # "hi\0"
+        assert item[3:5] == b"s\x00"
+        assert item[5:8] == b"hi\x00"
+
+    def test_golden_bool(self):
+        raw = mcpack_encode({"b": False})
+        item = raw[10:]
+        assert item[0] == FIELD_BOOL
+        assert item[4] == 0
+
+    def test_long_string(self):
+        s = "x" * 1000
+        assert mcpack_decode(mcpack_encode({"s": s}))["s"] == s
+
+    def test_long_binary(self):
+        b = bytes(range(256)) * 5
+        assert mcpack_decode(mcpack_encode({"b": b}))["b"] == b
+
+    def test_int_width_selection(self):
+        for v, t in ((1, FIELD_INT8), (300, 0x12), (70000, FIELD_INT32),
+                     ((1 << 40), 0x18), ((1 << 63) + 1, 0x28)):
+            raw = mcpack_encode({"v": v})
+            assert raw[10] == t, (v, hex(raw[10]))
+
+    def test_isoarray_decode(self):
+        # hand-build an isoarray of int32s: long head + IsoItemsHead
+        items = struct.pack("<iii", 10, 20, 30)
+        body = bytes([FIELD_INT32]) + items
+        field = bytes([0x30, 2]) + struct.pack("<I", len(body)) + b"a\x00" \
+            + body
+        inner = struct.pack("<I", 1) + field
+        raw = bytes([FIELD_OBJECT, 0]) + struct.pack("<I", len(inner)) + inner
+        assert mcpack_decode(raw) == {"a": [10, 20, 30]}
+
+    def test_truncated_raises(self):
+        raw = mcpack_encode({"a": 1})
+        with pytest.raises(McpackError):
+            mcpack_decode(raw[:-2])
+
+    def test_pb_bridge_roundtrip(self):
+        req = EchoRequest(message="bridged", sleep_us=42)
+        d = pb_to_dict(req)
+        assert d == {"message": "bridged", "sleep_us": 42}
+        req2 = dict_to_pb(mcpack_decode(mcpack_encode(d)), EchoRequest())
+        assert req2.message == "bridged" and req2.sleep_us == 42
+
+    def test_pb_bridge_maps(self):
+        from tests.echo_pb2 import TagBag, EchoResponse as ER
+        bag = TagBag()
+        bag.counts["a"] = 1
+        bag.counts["b"] = 2
+        bag.nested["x"].message = "deep"
+        bag.ids.extend([7, 8])
+        d = pb_to_dict(bag)
+        assert d["counts"] == {"a": 1, "b": 2}
+        assert d["nested"] == {"x": {"message": "deep"}}
+        bag2 = dict_to_pb(mcpack_decode(mcpack_encode(d)), TagBag())
+        assert dict(bag2.counts) == {"a": 1, "b": 2}
+        assert bag2.nested["x"].message == "deep"
+        assert list(bag2.ids) == [7, 8]
+
+
+class EchoService(rpc.Service):
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Fail(self, cntl, request, response, done):
+        cntl.set_failed(errors.EINTERNAL, "ubrpc failure")
+        done()
+
+
+class TestUbrpc:
+    @pytest.fixture()
+    def ubrpc_server(self):
+        server = rpc.Server()
+        server.add_service(EchoService())
+        server.add_service(UbrpcAdaptor())
+        target = f"mem://{unique_name('ubrpc')}"
+        assert server.start(target) == 0
+        yield target
+        server.stop()
+
+    @pytest.mark.parametrize("proto", ["ubrpc_mcpack2", "ubrpc_compack"])
+    def test_echo(self, ubrpc_server, proto):
+        ch = rpc.Channel()
+        assert ch.init(ubrpc_server,
+                       options=rpc.ChannelOptions(protocol=proto)) == 0
+        cntl = rpc.Controller()
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="ub!"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "ub!"
+
+    def test_error_propagates(self, ubrpc_server):
+        ch = rpc.Channel()
+        assert ch.init(ubrpc_server, options=rpc.ChannelOptions(
+            protocol="ubrpc_mcpack2", max_retry=0)) == 0
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Fail", cntl,
+                       EchoRequest(message="x"), EchoResponse)
+        assert cntl.failed()
+        assert cntl.error_code == errors.EINTERNAL
+        assert "ubrpc failure" in cntl.error_text
+
+    def test_unknown_method(self, ubrpc_server):
+        ch = rpc.Channel()
+        assert ch.init(ubrpc_server, options=rpc.ChannelOptions(
+            protocol="ubrpc_mcpack2", max_retry=0)) == 0
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Nope", cntl,
+                       EchoRequest(message="x"), EchoResponse)
+        assert cntl.failed()
+        assert cntl.error_code == errors.ENOMETHOD
+
+    @pytest.mark.parametrize("bad_body", [
+        b"\xde\xad\xbe\xef",                               # not mcpack
+        None,                                              # filled in test
+    ])
+    def test_malformed_response_fails_not_hangs(self, bad_body):
+        # a server replying garbage (or shape-invalid mcpack) must complete
+        # the call with ERESPONSE — never leave the cid locked
+        from brpc_tpu.codec.mcpack import mcpack_encode as enc
+        from brpc_tpu.policy.nshead import NsheadService
+        if bad_body is None:
+            bad_body = enc({"content": [{"id": 1, "error": {"code": {}}}]})
+
+        class BadServer(NsheadService):
+            def process_nshead_request(self, server, cntl, request,
+                                       response, done):
+                response.body.append(bad_body)
+                done()
+
+        server = rpc.Server()
+        server.add_service(BadServer())
+        target = f"mem://{unique_name('ubrpc-bad')}"
+        assert server.start(target) == 0
+        try:
+            ch = rpc.Channel()
+            assert ch.init(target, options=rpc.ChannelOptions(
+                protocol="ubrpc_mcpack2", max_retry=0,
+                timeout_ms=3000)) == 0
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code in (errors.ERESPONSE, errors.EINTERNAL)
+        finally:
+            server.stop()
+
+    def test_early_request_error_echoes_cid(self):
+        # an envelope rejected before dispatch must still echo the caller's
+        # id so the client reports the server's EREQUEST, not an id mismatch
+        server = rpc.Server()
+        server.add_service(EchoService())
+        server.add_service(UbrpcAdaptor())
+        target = f"mem://{unique_name('ubrpc-early')}"
+        assert server.start(target) == 0
+        try:
+            ch = rpc.Channel()
+            assert ch.init(target, options=rpc.ChannelOptions(
+                protocol="ubrpc_mcpack2", max_retry=0)) == 0
+            cntl = rpc.Controller()
+            # missing method → server-side EREQUEST before dispatch
+            ch.call_method("EchoService.", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code == errors.EREQUEST
+            assert "service_name/method" in cntl.error_text
+        finally:
+            server.stop()
+
+    def test_tcp_roundtrip(self):
+        server = rpc.Server()
+        server.add_service(EchoService())
+        server.add_service(UbrpcAdaptor())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            ch = rpc.Channel()
+            assert ch.init(f"127.0.0.1:{server.listen_port}",
+                           options=rpc.ChannelOptions(
+                               protocol="ubrpc_mcpack2")) == 0
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="ub-tcp"),
+                                  EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "ub-tcp"
+        finally:
+            server.stop()
