@@ -79,3 +79,13 @@ class Timer:
 
     def m_elapsed(self) -> int:
         return self.n_elapsed() // 1000000
+
+
+def u24(b, off: int = 0) -> int:
+    """Read a 24-bit big-endian integer (RTMP/FLV tag headers)."""
+    return (b[off] << 16) | (b[off + 1] << 8) | b[off + 2]
+
+
+def p24(v: int) -> bytes:
+    """Pack a 24-bit big-endian integer."""
+    return bytes(((v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF))

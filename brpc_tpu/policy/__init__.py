@@ -18,3 +18,5 @@ from . import nova
 from . import public_pbrpc
 from . import esp
 from . import ubrpc
+from . import amf
+from . import rtmp

@@ -85,6 +85,14 @@ class Server:
                 return errors.EINVAL
             self._nshead_service = svc
             return 0
+        # RtmpService: per-connection stream factory (rtmp.h RtmpService);
+        # the rtmp protocol only claims connections when one is registered
+        if getattr(svc, "SERVICE_NAME", None) == "rtmp" and \
+                hasattr(svc, "new_stream"):
+            if getattr(self, "_rtmp_service", None) is not None:
+                return errors.EINVAL
+            self._rtmp_service = svc
+            return 0
         # EspService raw handler (same single-owner rule)
         if getattr(svc, "SERVICE_NAME", None) == "esp" and \
                 hasattr(svc, "process_esp_request"):
