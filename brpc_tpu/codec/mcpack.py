@@ -14,9 +14,11 @@ The reference generates per-message C++ codecs (generator.cpp); here the
 bridge walks protobuf descriptors at runtime — same wire, no codegen.
 Python values map: dict→OBJECT, list→ARRAY, str→STRING, bytes→BINARY,
 bool→BOOL, int→smallest signed/unsigned fit, float→DOUBLE, None→NULL.
-compack (the older sibling format selectable via SerializationFormat in
-the reference) is not provided: mcpack_v2 is the only format our peers
-speak.
+compack (the reference's FORMAT_COMPACK, selectable via
+SerializationFormat) shares these field heads; its only wire difference
+is that homogeneous primitive arrays are serialized as ISOARRAYs
+(mcpack2pb/serializer.cpp:716-740) — pass compack=True to mcpack_encode/
+pb_to_mcpack for that variant (used by the ubrpc_compack protocol).
 """
 from __future__ import annotations
 
@@ -110,7 +112,30 @@ def _pick_int_type(v: int) -> int:
     raise McpackError(f"int out of range: {v}")
 
 
-def _encode_field(out: bytearray, name: str, value: Any) -> None:
+def _iso_item_type(value: List[Any]) -> Tuple[int, str, int]:
+    """Uniform primitive item (type, pack fmt, size) for a compack
+    isoarray, or (0, "", 0) when the list is not isoarray-eligible."""
+    if not value:
+        return 0, "", 0
+    if all(isinstance(v, bool) for v in value):
+        return FIELD_BOOL, "", 1
+    if all(isinstance(v, int) and not isinstance(v, bool) for v in value):
+        lo, hi = _pick_int_type(min(value)), _pick_int_type(max(value))
+        if FIELD_UINT64 in (lo, hi):
+            if min(value) < 0:
+                return 0, "", 0           # mixed sign beyond int64: bail
+            t = FIELD_UINT64
+        else:
+            t = lo if (lo & FIELD_FIXED_MASK) >= (hi & FIELD_FIXED_MASK) \
+                else hi
+        return t, _INT_PACK[t], t & FIELD_FIXED_MASK
+    if all(isinstance(v, float) for v in value):
+        return FIELD_DOUBLE, "<d", 8
+    return 0, "", 0
+
+
+def _encode_field(out: bytearray, name: str, value: Any,
+                  compack: bool = False) -> None:
     if value is None:
         _fixed(out, FIELD_NULL, name, b"\x00")
     elif isinstance(value, bool):
@@ -126,30 +151,46 @@ def _encode_field(out: bytearray, name: str, value: Any) -> None:
         _short_or_long(out, FIELD_BINARY, name, bytes(value))
     elif isinstance(value, dict):
         _encode_group(out, FIELD_OBJECT, name,
-                      [(k, v) for k, v in value.items()])
+                      [(k, v) for k, v in value.items()], compack)
     elif isinstance(value, (list, tuple)):
-        _encode_group(out, FIELD_ARRAY, name, [("", v) for v in value])
+        # FORMAT_COMPACK (mcpack2pb/serializer.cpp:716-740): primitive
+        # arrays carry one item-type byte + raw values, no per-item heads
+        if compack:
+            t, fmt, isize = _iso_item_type(list(value))
+            if t:
+                body = bytearray([t])
+                for v in value:
+                    body += (b"\x01" if v else b"\x00") if t == FIELD_BOOL \
+                        else struct.pack(fmt, v)
+                _short_or_long(out, FIELD_ISOARRAY, name, bytes(body))
+                return
+        _encode_group(out, FIELD_ARRAY, name, [("", v) for v in value],
+                      compack)
     else:
         raise McpackError(f"cannot mcpack-encode {type(value).__name__}")
 
 
 def _encode_group(out: bytearray, ftype: int, name: str,
-                  items: List[Tuple[str, Any]]) -> None:
+                  items: List[Tuple[str, Any]],
+                  compack: bool = False) -> None:
     body = bytearray(struct.pack("<I", len(items)))
     for n, v in items:
-        _encode_field(body, n, v)
+        _encode_field(body, n, v, compack)
     nb = _name_bytes(name)
     out += struct.pack("<BBI", ftype, len(nb), len(body))
     out += nb
     out += body
 
 
-def mcpack_encode(obj: Dict[str, Any]) -> bytes:
-    """Serialize a dict as a top-level (unnamed) mcpack_v2 object."""
+def mcpack_encode(obj: Dict[str, Any], compack: bool = False) -> bytes:
+    """Serialize a dict as a top-level (unnamed) object.  With
+    compack=True, emit the reference's FORMAT_COMPACK variant
+    (mcpack2pb.h:41): identical field heads, but homogeneous primitive
+    arrays become ISOARRAYs."""
     if not isinstance(obj, dict):
         raise McpackError("top-level mcpack value must be a dict")
     out = bytearray()
-    _encode_group(out, FIELD_OBJECT, "", list(obj.items()))
+    _encode_group(out, FIELD_OBJECT, "", list(obj.items()), compack)
     return bytes(out)
 
 
@@ -333,8 +374,8 @@ def dict_to_pb(d: Dict[str, Any], msg: Any) -> Any:
     return msg
 
 
-def pb_to_mcpack(msg: Any) -> bytes:
-    return mcpack_encode(pb_to_dict(msg))
+def pb_to_mcpack(msg: Any, compack: bool = False) -> bytes:
+    return mcpack_encode(pb_to_dict(msg), compack=compack)
 
 
 def mcpack_to_pb(data: bytes, msg: Any) -> Any:

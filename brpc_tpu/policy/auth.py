@@ -61,3 +61,50 @@ class HmacAuthenticator(Authenticator):
         if abs(window - now_window) > 1:
             return False                  # expired credential
         return hmac.compare_digest(sig, self._sig(window))
+
+
+class RedisAuthenticator(Authenticator):
+    """Redis AUTH (policy/redis_authenticator.{h,cpp}): the credential is
+    the password (or "user password" for Redis 6 ACL); the redis protocol
+    prepends an AUTH command on each connection's first call and consumes
+    its reply (pack_request/process_response in policy/redis.py)."""
+
+    def __init__(self, password: str, user: str = ""):
+        # NUL-joined so passwords containing spaces survive the arg split
+        # in policy/redis.py pack_request
+        self._cred = f"{user}\x00{password}" if user else password
+
+    def generate_credential(self, cntl) -> str:
+        return self._cred
+
+    def verify(self, token: str, socket) -> bool:
+        return hmac.compare_digest(token, self._cred)
+
+
+class CouchbaseAuthenticator(Authenticator):
+    """SASL PLAIN over the memcache binary protocol
+    (policy/couchbase_authenticator.{h,cpp}): credential "user:password";
+    the memcache protocol sends OP_SASL_AUTH first on each connection."""
+
+    def __init__(self, user: str, password: str):
+        self._cred = f"{user}:{password}"
+
+    def generate_credential(self, cntl) -> str:
+        return self._cred
+
+    def verify(self, token: str, socket) -> bool:
+        return hmac.compare_digest(token, self._cred)
+
+
+class EspAuthenticator(Authenticator):
+    """ESP magic-number credential (policy/esp_authenticator.cpp:7-15:
+    6-byte magic + 2-byte local port); servers accept anything, matching
+    the reference's no-op VerifyCredential."""
+
+    _MAGIC = b"\x00ESP\x01\x02"
+
+    def generate_credential(self, cntl) -> str:
+        return (self._MAGIC + b"\x00\x00").decode("latin-1")
+
+    def verify(self, token: str, socket) -> bool:
+        return True

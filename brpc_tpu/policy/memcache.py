@@ -11,6 +11,7 @@ import struct
 from typing import Any, List, Optional, Tuple
 
 from ..butil.iobuf import IOBuf
+from ..rpc import errors
 from ..rpc.controller import Controller
 from ..rpc.protocol import Protocol, ParseResult, register_protocol
 
@@ -154,24 +155,46 @@ def serialize_request(request: Any, cntl: Controller) -> IOBuf:
     return IOBuf(request.serialize())
 
 
+OP_SASL_AUTH = 0x21
+
+
 def pack_request(payload: IOBuf, cid: int, cntl: Controller,
                  method_full_name: str) -> IOBuf:
     out = IOBuf()
+    # CouchbaseAuthenticator (policy/couchbase_authenticator.cpp): SASL
+    # PLAIN auth precedes the first op on each connection; its response
+    # is consumed via ctx.auth_skip
+    sock = getattr(cntl, "_pack_socket", None)
+    cntl._memcache_auth_skip = 0
+    if cntl.auth_token and sock is not None and \
+            not getattr(sock, "_memcache_authed", False):
+        sock._memcache_authed = True
+        mech = b"PLAIN"
+        user, _, password = cntl.auth_token.partition(":")
+        value = b"\x00" + user.encode() + b"\x00" + password.encode()
+        out.append(_HDR.pack(MAGIC_REQUEST, OP_SASL_AUTH, len(mech), 0, 0,
+                             0, len(mech) + len(value), 0, 0)
+                   + mech + value)
+        cntl._memcache_auth_skip = 1
     out.append(payload)
     return out
 
 
 class _Ctx:
-    __slots__ = ("cid", "expected", "ops")
+    __slots__ = ("cid", "expected", "ops", "auth_skip")
 
     def __init__(self, cid, expected):
         self.cid = cid
         self.expected = expected
         self.ops: List[MemcacheOpResponse] = []
+        self.auth_skip = 0
 
 
 def _make_pipeline_ctx(cid: int, cntl: Controller) -> _Ctx:
-    return _Ctx(cid, getattr(cntl, "_memcache_expected", 1))
+    skip = getattr(cntl, "_memcache_auth_skip", 0)
+    ctx = _Ctx(cid, getattr(cntl, "_memcache_expected", 1) + skip)
+    ctx.auth_skip = skip
+    return ctx
 
 
 def process_response(bundle: List[MemcacheOpResponse], socket) -> None:
@@ -191,8 +214,13 @@ def process_response(bundle: List[MemcacheOpResponse], socket) -> None:
         rc, cntl = bthread_id.lock(ctx.cid)
         if rc != 0 or cntl is None:
             continue
+        auth_ops, user_ops = (ctx.ops[:ctx.auth_skip],
+                              ctx.ops[ctx.auth_skip:])
+        if any(not op.ok() for op in auth_ops):
+            socket._memcache_authed = False
+            cntl.set_failed(errors.ERPCAUTH, "memcache SASL auth failed")
         resp = MemcacheResponse()
-        resp.ops = ctx.ops
+        resp.ops = user_ops
         cntl.response = resp
         cntl.remote_side = socket.remote_side
         cntl.finish_parsed_response(ctx.cid)

@@ -136,6 +136,84 @@ class ConsulNamingService(NamingService):
         return out
 
 
+class RemoteFileNamingService(NamingService):
+    """remotefile://<url-without-scheme>: fetch a server list over HTTP,
+    one "host:port [tag]" per line (policy/remote_file_naming_service.cpp)."""
+
+    def __init__(self, rest: str):
+        self.url = rest if rest.startswith(("http://", "https://")) \
+            else f"http://{rest}"
+
+    def get_servers(self) -> List[ServerEntry]:
+        with urllib.request.urlopen(self.url, timeout=5) as r:
+            body = r.read().decode()
+        out = []
+        for line in body.splitlines():
+            e = _parse_line(line)
+            if e is not None:
+                out.append(e)
+        return out
+
+
+class NacosNamingService(NamingService):
+    """nacos://host:port/serviceName[?namespaceId=..&groupName=..]:
+    Nacos open API GET /nacos/v1/ns/instance/list
+    (policy/nacos_naming_service.cpp; JSON {"hosts": [{"ip", "port",
+    "weight", "healthy", "enabled"}]}).  Weights scale the reference's
+    default 100 so weighted LBs keep working."""
+
+    def __init__(self, rest: str):
+        hostport, _, svc = rest.partition("/")
+        name, _, query = svc.partition("?")
+        q = f"serviceName={name}" + (f"&{query}" if query else "")
+        self.url = f"http://{hostport}/nacos/v1/ns/instance/list?{q}"
+
+    def get_servers(self) -> List[ServerEntry]:
+        with urllib.request.urlopen(self.url, timeout=5) as r:
+            data = json.loads(r.read().decode())
+        out = []
+        for h in data.get("hosts", []):
+            if not h.get("healthy", True) or not h.get("enabled", True):
+                continue
+            out.append(ServerEntry(
+                EndPoint(scheme="tcp", host=str(h.get("ip", "")),
+                         port=int(h.get("port", 0))),
+                weight=int(float(h.get("weight", 1.0)) * 100),
+                tag=str(h.get("clusterName", ""))))
+        return out
+
+
+class DiscoveryNamingService(NamingService):
+    """discovery://host:port/appid[?env=..&status=1]: Bilibili discovery
+    GET /discovery/fetchs (policy/discovery_naming_service.cpp; JSON
+    {"data": {appid: {"instances": [{"addrs": ["scheme://ip:port"],
+    "status": 1}]}}})."""
+
+    def __init__(self, rest: str):
+        hostport, _, app = rest.partition("/")
+        self.appid, _, query = app.partition("?")
+        q = f"appid={self.appid}" + (f"&{query}" if query else
+                                     "&env=prod&status=1")
+        self.url = f"http://{hostport}/discovery/fetchs?{q}"
+
+    def get_servers(self) -> List[ServerEntry]:
+        with urllib.request.urlopen(self.url, timeout=5) as r:
+            data = json.loads(r.read().decode())
+        out = []
+        app = data.get("data", {}).get(self.appid, {})
+        for inst in app.get("instances", []):
+            if inst.get("status", 1) != 1:
+                continue
+            for addr in inst.get("addrs", []):
+                _, _, hp = addr.partition("://")
+                host, _, port = hp.rpartition(":")
+                if host and port.isdigit():
+                    out.append(ServerEntry(
+                        EndPoint(scheme="tcp", host=host, port=int(port)),
+                        tag=str(inst.get("zone", ""))))
+        return out
+
+
 def create_naming_service(url: str) -> NamingService:
     scheme, _, rest = url.partition("://")
     if scheme == "list":
@@ -148,6 +226,12 @@ def create_naming_service(url: str) -> NamingService:
         return MeshNamingService()
     if scheme == "consul":
         return ConsulNamingService(rest)
+    if scheme == "remotefile":
+        return RemoteFileNamingService(rest)
+    if scheme == "nacos":
+        return NacosNamingService(rest)
+    if scheme == "discovery":
+        return DiscoveryNamingService(rest)
     raise ValueError(f"unknown naming service scheme {scheme!r}")
 
 

@@ -164,9 +164,10 @@ class RedisResponse:
 # ---- client side ------------------------------------------------------
 
 class _PipelinedRedisCtx:
-    __slots__ = ("cid", "expected", "replies")
+    __slots__ = ("cid", "expected", "replies", "auth_skip")
 
     def __init__(self, cid: int, expected: int):
+        self.auth_skip = 0
         self.cid = cid
         self.expected = expected
         self.replies: List[RedisReply] = []
@@ -226,6 +227,16 @@ def serialize_request(request: Any, cntl: Controller) -> IOBuf:
 def pack_request(payload: IOBuf, cid: int, cntl: Controller,
                  method_full_name: str) -> IOBuf:
     out = IOBuf()
+    # RedisAuthenticator (policy/redis_authenticator.cpp): AUTH precedes
+    # the first command on each connection; its +OK is consumed by the
+    # response path via ctx.auth_skip, never surfaced to the user
+    sock = getattr(cntl, "_pack_socket", None)
+    cntl._redis_auth_skip = 0
+    if cntl.auth_token and sock is not None and \
+            not getattr(sock, "_redis_authed", False):
+        sock._redis_authed = True
+        out.append(encode_command("AUTH", *cntl.auth_token.split("\x00")))
+        cntl._redis_auth_skip = 1
     out.append(payload)
     return out
 
@@ -248,8 +259,14 @@ def process_response(bundle: List[RedisReply], socket) -> None:
         rc, cntl = bthread_id.lock(ctx.cid)
         if rc != 0 or cntl is None:
             continue
+        auth_replies, user_replies = (ctx.replies[:ctx.auth_skip],
+                                      ctx.replies[ctx.auth_skip:])
+        if any(r.is_error() for r in auth_replies):
+            socket._redis_authed = False
+            cntl.set_failed(errors.ERPCAUTH,
+                            f"redis AUTH failed: {auth_replies[0].value}")
         resp = RedisResponse()
-        resp.replies = ctx.replies
+        resp.replies = user_replies
         cntl.response = resp
         cntl.remote_side = socket.remote_side
         cntl.finish_parsed_response(ctx.cid)
@@ -305,7 +322,11 @@ def process_request(bundle: List[RedisReply], socket, server) -> None:
 
 
 def _make_pipeline_ctx(cid: int, cntl: Controller) -> _PipelinedRedisCtx:
-    return _PipelinedRedisCtx(cid, getattr(cntl, "_redis_expected", 1))
+    skip = getattr(cntl, "_redis_auth_skip", 0)
+    ctx = _PipelinedRedisCtx(cid,
+                             getattr(cntl, "_redis_expected", 1) + skip)
+    ctx.auth_skip = skip
+    return ctx
 
 
 PROTOCOL = Protocol(

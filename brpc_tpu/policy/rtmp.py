@@ -343,7 +343,7 @@ class RtmpConnection:
         self._next_txn = 2                          # 1 was "connect"
         self._pending: Dict[int, tuple] = {}        # txn -> (event, box)
         self._pending_lock = threading.Lock()
-        self._out_lock = threading.Lock()
+        self._out_lock = threading.RLock()
         self._c1_sent = b""
         self._connect_request: Dict[str, Any] = {}
         socket.on_failed_callbacks.append(self._on_socket_failed)
@@ -408,8 +408,11 @@ class RtmpConnection:
                                  amf.encode("onStatus", 0.0, None, info))
 
     def set_out_chunk_size(self, size: int) -> None:
-        self._send_control(MSG_SET_CHUNK_SIZE, struct.pack(">I", size))
+        # announce + apply atomically w.r.t. concurrent senders (the lock
+        # is reentrant: send_message chunks under it too), so no message
+        # can be chunked with the old size after the peer switched
         with self._out_lock:
+            self._send_control(MSG_SET_CHUNK_SIZE, struct.pack(">I", size))
             self.out_chunk_size = size
 
     # ---- client transactions ------------------------------------------

@@ -40,7 +40,7 @@ def serialize_request(request: Any, cntl: Controller) -> IOBuf:
 
 
 def pack_request(payload: IOBuf, cid: int, cntl: Controller,
-                 method_full_name: str) -> IOBuf:
+                 method_full_name: str, _compack: bool = False) -> IOBuf:
     service, _, method_name = method_full_name.rpartition(".")
     request = getattr(cntl, "_ubrpc_request", None)
     params = pb_to_dict(request) if request is not None else {}
@@ -54,7 +54,7 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
             "params": {"req": params},
         }],
     }
-    data = mcpack_encode(envelope)
+    data = mcpack_encode(envelope, compack=_compack)
     head = NsheadHead(log_id=cntl.log_id, body_len=len(data))
     out = IOBuf()
     out.append(head.pack())
@@ -192,11 +192,19 @@ UBRPC_MCPACK2 = Protocol(
     make_pipeline_ctx=make_pipeline_ctx,
 )
 
+def pack_request_compack(payload: IOBuf, cid: int, cntl: Controller,
+                         method_full_name: str) -> IOBuf:
+    """FORMAT_COMPACK wire (ubrpc2pb_protocol.cpp:530): same envelope,
+    primitive arrays serialized as isoarrays."""
+    return pack_request(payload, cid, cntl, method_full_name,
+                        _compack=True)
+
+
 UBRPC_COMPACK = Protocol(
     name="ubrpc_compack",
     parse=_never_parse,
     serialize_request=serialize_request,
-    pack_request=pack_request,
+    pack_request=pack_request_compack,
     supported_connection_type=CONNECTION_TYPE_POOLED | CONNECTION_TYPE_SHORT,
     support_server=False,
     pipelined=True,

@@ -190,6 +190,10 @@ class Server:
         self._started = True
         log.info("Server started on %s with %d services", ep,
                  len(self._services))
+        # version ping, off unless the trackme_server flag is set
+        # (reference server.cpp StartInternal → trackme.cpp:36)
+        from .trackme import start_trackme
+        start_trackme(str(ep))
         return 0
 
     def _on_accept(self, sock) -> None:

@@ -1,0 +1,259 @@
+"""Protocol authenticators, HTTP-registry naming services, compack
+serialization, trackme pings.
+
+Reference patterns: brpc_naming_service_unittest.cpp mocks registry
+payloads; redis/couchbase authenticator tests drive the client against
+in-process backends (SURVEY.md §4)."""
+import http.server
+import json
+import struct
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from brpc_tpu.butil import flags as _flags
+from brpc_tpu.codec.mcpack import (FIELD_ISOARRAY, FIELD_INT8, FIELD_INT32,
+                                   mcpack_decode, mcpack_encode)
+from brpc_tpu.policy import memcache as mc
+from brpc_tpu.policy import redis as redis_proto
+from brpc_tpu.policy.auth import (CouchbaseAuthenticator, EspAuthenticator,
+                                  RedisAuthenticator)
+from brpc_tpu.policy.naming import create_naming_service
+from brpc_tpu.rpc import errors
+from tests.test_redis_memcache import (KvRedis, start_mini_memcached,
+                                       unique)
+
+
+# ------------------------------------------------------ redis AUTH ------
+
+class AuthKvRedis(KvRedis):
+    def __init__(self, password):
+        super().__init__()
+        self.password = password
+        self.auth_attempts = []
+        self.add_handler("AUTH", self._auth)
+
+    def _auth(self, args):
+        self.auth_attempts.append(bytes(args[0]))
+        if bytes(args[0]).decode() == self.password:
+            return redis_proto.RedisReply(redis_proto.REPLY_STATUS, "OK")
+        return redis_proto.RedisReply(redis_proto.REPLY_ERROR,
+                                      "ERR invalid password")
+
+
+class TestRedisAuth:
+    def _start(self, password="sesame", auth=None):
+        server = rpc.Server()
+        svc = AuthKvRedis(password)
+        server.add_service(svc)
+        name = unique("redisauth")
+        assert server.start(f"mem://{name}") == 0
+        ch = rpc.Channel()
+        ch.init(f"mem://{name}", options=rpc.ChannelOptions(
+            protocol="redis", timeout_ms=5000, auth=auth))
+        return server, svc, ch
+
+    def test_auth_sent_once_and_hidden(self):
+        server, svc, ch = self._start(
+            auth=RedisAuthenticator("sesame"))
+        try:
+            for i in range(3):
+                cntl = rpc.Controller()
+                resp = ch.call_method("redis", cntl, ("PING",), None)
+                assert not cntl.failed(), cntl.error_text
+                # the AUTH +OK must never leak into user replies
+                assert resp.reply(0).value == "PONG"
+            assert svc.auth_attempts == [b"sesame"]   # once per connection
+        finally:
+            server.stop()
+
+    def test_bad_password_fails_rpc(self):
+        server, svc, ch = self._start(
+            auth=RedisAuthenticator("wrong"))
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("redis", cntl, ("PING",), None)
+            assert cntl.failed()
+            assert cntl.error_code == errors.ERPCAUTH
+        finally:
+            server.stop()
+
+
+# -------------------------------------------------- memcache SASL -------
+
+class TestCouchbaseAuth:
+    def test_sasl_plain_sent_and_hidden(self):
+        backend, target, listener = start_mini_memcached(
+            sasl_expect=b"\x00bucket\x00pw")
+        ch = rpc.Channel()
+        ch.init(target, options=rpc.ChannelOptions(
+            protocol="memcache", timeout_ms=5000,
+            auth=CouchbaseAuthenticator("bucket", "pw")))
+        req = mc.MemcacheRequest()
+        req.set("k", b"v")
+        req.get("k")
+        cntl = rpc.Controller()
+        resp = ch.call_method("memcache", cntl, req, None)
+        assert not cntl.failed(), cntl.error_text
+        assert backend.sasl_seen == 1
+        assert len(resp.ops) == 2                 # SASL reply consumed
+        assert resp.op(1).value == b"v"
+
+    def test_sasl_rejected(self):
+        backend, target, listener = start_mini_memcached(
+            sasl_expect=b"\x00bucket\x00right")
+        ch = rpc.Channel()
+        ch.init(target, options=rpc.ChannelOptions(
+            protocol="memcache", timeout_ms=5000,
+            auth=CouchbaseAuthenticator("bucket", "wrong")))
+        req = mc.MemcacheRequest()
+        req.get("k")
+        cntl = rpc.Controller()
+        ch.call_method("memcache", cntl, req, None)
+        assert cntl.failed() and cntl.error_code == errors.ERPCAUTH
+
+    def test_esp_authenticator_magic(self):
+        cred = EspAuthenticator().generate_credential(None)
+        assert cred.encode("latin-1").startswith(b"\x00ESP\x01\x02")
+
+
+# ------------------------------------- HTTP-registry naming services ----
+
+class _Registry(http.server.BaseHTTPRequestHandler):
+    payloads = {}
+
+    def do_GET(self):
+        for prefix, body in self.payloads.items():
+            if self.path.startswith(prefix):
+                data = body if isinstance(body, bytes) else \
+                    json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+        self.send_response(404)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def registry():
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Registry)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{httpd.server_port}"
+    httpd.shutdown()
+
+
+class TestRegistryNaming:
+    def test_nacos(self, registry):
+        _Registry.payloads["/nacos/v1/ns/instance/list"] = {
+            "hosts": [
+                {"ip": "10.0.0.1", "port": 8000, "weight": 2.0,
+                 "healthy": True, "enabled": True, "clusterName": "c1"},
+                {"ip": "10.0.0.2", "port": 8000, "weight": 1.0,
+                 "healthy": False},
+                {"ip": "10.0.0.3", "port": 8001, "weight": 1.0,
+                 "healthy": True, "enabled": False},
+            ]}
+        ns = create_naming_service(f"nacos://{registry}/my-service")
+        servers = ns.get_servers()
+        assert len(servers) == 1                  # only healthy+enabled
+        assert servers[0].endpoint.host == "10.0.0.1"
+        assert servers[0].weight == 200
+        assert servers[0].tag == "c1"
+
+    def test_discovery(self, registry):
+        _Registry.payloads["/discovery/fetchs"] = {
+            "data": {"my.app": {"instances": [
+                {"addrs": ["grpc://10.1.0.1:9000",
+                           "http://10.1.0.1:8080"], "status": 1,
+                 "zone": "sh001"},
+                {"addrs": ["grpc://10.1.0.2:9000"], "status": 3},
+            ]}}}
+        ns = create_naming_service(f"discovery://{registry}/my.app")
+        servers = ns.get_servers()
+        assert [(s.endpoint.host, s.endpoint.port) for s in servers] == \
+            [("10.1.0.1", 9000), ("10.1.0.1", 8080)]
+        assert servers[0].tag == "sh001"
+
+    def test_remotefile(self, registry):
+        _Registry.payloads["/servers.txt"] = \
+            b"10.2.0.1:80 tagA\n# comment\n10.2.0.2:81\n"
+        ns = create_naming_service(f"remotefile://{registry}/servers.txt")
+        servers = ns.get_servers()
+        assert len(servers) == 2
+        assert servers[0].endpoint.port == 80 and servers[0].tag == "tagA"
+
+
+# -------------------------------------------------------- compack -------
+
+class TestCompack:
+    def test_primitive_array_becomes_isoarray(self):
+        data = mcpack_encode({"xs": [1, 2, 3]}, compack=True)
+        # short isoarray head present with int8 item type
+        assert bytes([FIELD_ISOARRAY | 0x80]) in data
+        assert mcpack_decode(data) == {"xs": [1, 2, 3]}
+
+    def test_widest_int_type_wins(self):
+        data = mcpack_encode({"xs": [1, 70000]}, compack=True)
+        assert mcpack_decode(data) == {"xs": [1, 70000]}
+        i = data.index(bytes([FIELD_ISOARRAY | 0x80]))
+        # short head: [type][name_size][value_size] + name + item-type byte
+        assert data[i + 3 + data[i + 1]] == FIELD_INT32
+
+    def test_doubles_and_bools(self):
+        for xs in ([1.5, -2.5], [True, False, True]):
+            data = mcpack_encode({"xs": xs}, compack=True)
+            assert mcpack_decode(data) == {"xs": xs}
+
+    def test_mixed_list_falls_back(self):
+        data = mcpack_encode({"xs": [1, "two"]}, compack=True)
+        assert bytes([FIELD_ISOARRAY | 0x80]) not in data
+        assert mcpack_decode(data) == {"xs": [1, "two"]}
+
+    def test_mcpack_v2_unchanged_by_default(self):
+        assert mcpack_encode({"xs": [1, 2, 3]}) == \
+            mcpack_encode({"xs": [1, 2, 3]}, compack=False)
+
+
+# -------------------------------------------------------- trackme -------
+
+class TestTrackme:
+    def test_ping_and_bulletin(self):
+        from brpc_tpu.rpc import trackme
+        from brpc_tpu.tools.trackme_server import TrackMeService
+        from brpc_tpu.proto.trackme_pb2 import TRACKME_WARNING
+
+        svc = TrackMeService()
+        svc.add_bulletin(0, 10**9, TRACKME_WARNING, "upgrade me")
+        hub = rpc.Server()
+        hub.add_service(svc)
+        name = unique("trackme")
+        assert hub.start(f"mem://{name}") == 0
+        _flags.set_flag("trackme_server", f"mem://{name}")
+        _flags.set_flag("trackme_interval", 1)
+        try:
+            app = rpc.Server()
+            assert app.start(f"mem://{unique('app')}") == 0
+            deadline = time.monotonic() + 5
+            while not svc.version_counts() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            counts = svc.version_counts()
+            assert counts.get(trackme.RPC_VERSION, 0) >= 1
+            app.stop()
+        finally:
+            trackme.stop_trackme()
+            _flags.set_flag("trackme_server", "")
+            hub.stop()
+
+    def test_off_by_default(self):
+        from brpc_tpu.rpc import trackme
+        assert _flags.get_flag("trackme_server") == ""
+        assert trackme.start_trackme("x") is False

@@ -144,8 +144,10 @@ class MiniMemcached:
     """In-process memcached speaking the binary protocol (test fixture —
     the reference tests against golden bytes + real memcached)."""
 
-    def __init__(self):
+    def __init__(self, sasl_expect: bytes = b""):
         self.data = {}
+        self.sasl_expect = sasl_expect    # b"\0user\0pass" when required
+        self.sasl_seen = 0
 
     def handle_frame(self, frame: bytes) -> bytes:
         (magic, opcode, keylen, extraslen, _dt, _vb, bodylen, opaque,
@@ -177,18 +179,22 @@ class MiniMemcached:
             rvalue = struct.pack(">Q", cur)
         elif opcode == mc.OP_VERSION:
             rvalue = b"1.6.0-tpu"
+        elif opcode == mc.OP_SASL_AUTH:
+            self.sasl_seen += 1
+            if self.sasl_expect and value != self.sasl_expect:
+                status = 0x20             # auth error
         hdr = mc._HDR.pack(mc.MAGIC_RESPONSE, opcode, 0, len(rextras), 0,
                            status, len(rextras) + len(rvalue), opaque, cas)
         return hdr + rextras + rvalue
 
 
-def start_mini_memcached():
+def start_mini_memcached(sasl_expect: bytes = b""):
     """Serve the binary protocol over a mem:// listener."""
     from brpc_tpu.rpc.mem_transport import mem_listen
     from brpc_tpu.rpc.protocol import Protocol
     from brpc_tpu.rpc.input_messenger import InputMessenger
 
-    backend = MiniMemcached()
+    backend = MiniMemcached(sasl_expect)
 
     def parse_req(source, socket, read_eof, arg):
         from brpc_tpu.rpc.protocol import ParseResult

@@ -217,8 +217,10 @@ class _Hub(RtmpService):
         self.audio = []
         self.video = []
         self.stopped = []
+        self.connect_infos = []
 
     def new_stream(self, remote_side, connect_info):
+        self.connect_infos.append(dict(connect_info))
         return _RecordingServerStream(self)
 
 
@@ -240,8 +242,12 @@ class TestRtmpEndToEnd:
         try:
             stream = client.create_stream()
             assert stream.stream_id >= 1
-            conn = stream._conn
-            assert conn.connect_info == {} or True  # client side
+            assert stream.publish("s") == 0
+            # the connect command's object reached the server's stream
+            # factory (rtmp.h RtmpService::NewStream gets connect info)
+            assert _wait_for(lambda: hub.connect_infos)
+            assert hub.connect_infos[0]["app"] == "myapp"
+            assert hub.connect_infos[0]["tcUrl"].endswith("/myapp")
         finally:
             client.stop()
 
