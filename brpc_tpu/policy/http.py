@@ -181,7 +181,7 @@ def _process_json_rpc(msg: HttpMessage, socket, server, md, full_name,
 
     cntl.set_server_done(done)
     try:
-        md.fn(cntl, request, response, done)
+        md.invoke(cntl, request, response, done)
     except Exception as e:
         if not done_called[0]:
             cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")

@@ -84,7 +84,7 @@ def _run_method(server, cntl: Controller, md, data: bytes,
 
     cntl.set_server_done(done)
     try:
-        md.fn(cntl, request, response, done)
+        md.invoke(cntl, request, response, done)
     except Exception as e:
         log.error("method %s raised: %s", md.full_name, e, exc_info=True)
         if not fired[0]:

@@ -167,7 +167,7 @@ class NsheadPbServiceAdaptor(NsheadService):
             done()
 
         try:
-            md.fn(controller, pb_req, pb_res, pb_done)
+            md.invoke(controller, pb_req, pb_res, pb_done)
         except Exception as e:
             log.error("nshead pb method %s raised: %s",
                       meta.full_method_name, e, exc_info=True)

@@ -279,7 +279,7 @@ def process_request(msg: StdMessage, socket, server) -> None:
 
     cntl.set_server_done(done)
     try:
-        md.fn(cntl, request, response, done)
+        md.invoke(cntl, request, response, done)
     except Exception as e:   # uncaught user exception → EINTERNAL
         log.error("method %s raised: %s", full_name, e, exc_info=True)
         if not done_called[0]:

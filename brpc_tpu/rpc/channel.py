@@ -143,16 +143,31 @@ class Channel:
         else:
             ep = self._endpoint
         cntl._selected_endpoint = ep
+        group = self._channel_signature()
+        ssl_ctx = self.options.ssl_context
         if ctype == "pooled":
-            sock = smap.get_pooled_socket(ep, self.messenger)
+            sock = smap.get_pooled_socket(ep, self.messenger, group=group,
+                                          ssl_context=ssl_ctx)
             cntl._pooled_from = ep
         elif ctype == "short":
-            sock = smap.get_short_socket(ep, self.messenger)
+            sock = smap.get_short_socket(ep, self.messenger,
+                                         ssl_context=ssl_ctx)
             cntl._short_socket = sock
         else:
             sock = smap.get_socket(ep, self.messenger,
-                                   ssl_context=self.options.ssl_context)
+                                   ssl_context=ssl_ctx, group=group)
         return sock
+
+    def _channel_signature(self) -> tuple:
+        """Connection-compatibility key (reference channel.cpp
+        ComputeChannelSignature): channels may share a connection only
+        when the peer would parse it identically — protocol, TLS, and
+        auth identity all partition the space.  The auth object itself is
+        part of the key (the map then pins it, so identity can never be
+        recycled while its connections live)."""
+        return (self._protocol.name,
+                self.options.ssl_context is not None,
+                self.options.auth)
 
     def _on_call_end(self, cntl: Controller) -> None:
         # pooled sockets go back to the pool; short ones close
@@ -176,7 +191,8 @@ class Channel:
                 sock.set_failed(errors.ECLOSE,
                                 "own pipelined context still outstanding")
         if ep is not None and sock is not None:
-            SocketMap.instance().return_pooled_socket(ep, sock)
+            SocketMap.instance().return_pooled_socket(
+                ep, sock, group=self._channel_signature())
         short = getattr(cntl, "_short_socket", None)
         if short is not None:
             short.set_failed(errors.ECLOSE, "short connection done")

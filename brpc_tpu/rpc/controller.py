@@ -72,10 +72,24 @@ class Controller:
         self.accepted_stream_id = 0
         # server side
         self.server = None
+        self._session_data: Any = None
         self.method_deadline: Optional[float] = None
         self._server_done: Optional[Callable[[], None]] = None
         self.http_request = None
         self.http_response = None
+
+    # ---- per-RPC session data (reference Controller::session_local_data,
+    # backed by ServerOptions.session_local_data_factory's pool) ---------
+    def session_local_data(self) -> Any:
+        if self._session_data is None and self.server is not None:
+            self._session_data = self.server._get_session_data()
+        return self._session_data
+
+    def _release_session_data(self) -> None:
+        # idempotent: called from MethodDescriptor.invoke's wrapped done
+        if self._session_data is not None and self.server is not None:
+            self.server._return_session_data(self._session_data)
+            self._session_data = None
 
     # ---- error surface (reference Controller::SetFailed/Failed) -------
     def set_failed(self, code: int, text: str = "") -> None:
@@ -263,9 +277,17 @@ class Controller:
             scheduler.start_background(done, self, name="rpc_done")
 
     def join(self, timeout: Optional[float] = None) -> None:
-        """Wait for RPC completion (sync calls)."""
-        if not self._ended.wait(timeout):
-            raise TimeoutError("RPC join timed out")
+        """Wait for RPC completion (sync calls).  When the caller is a
+        scheduler tasklet, compensate the blocked worker so server-side
+        processing can't be starved by sync callers (the reference blocks
+        on a butex, which yields the bthread worker for free)."""
+        from ..bthread import scheduler
+        scheduler.note_worker_blocked()
+        try:
+            if not self._ended.wait(timeout):
+                raise TimeoutError("RPC join timed out")
+        finally:
+            scheduler.note_worker_unblocked()
 
     def cancel(self) -> None:
         """Cancel the in-flight call (reference StartCancel/CancelRPC): the

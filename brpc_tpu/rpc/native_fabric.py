@@ -141,7 +141,7 @@ class NativeServer:
 
             cntl.set_server_done(done)
             try:
-                md.fn(cntl, request, response, done)
+                md.invoke(cntl, request, response, done)
             except Exception as e:
                 log.error("native-server method %s raised: %s", full, e,
                           exc_info=True)

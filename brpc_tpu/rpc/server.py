@@ -38,6 +38,13 @@ class ServerOptions:
     # safe when handlers are fast/non-blocking.
     usercode_inline: bool = False
     ssl_context: Any = None             # ssl.SSLContext for TLS listeners
+    # per-RPC session data: factory() -> object, pooled across requests
+    # (reference server.h:146-150 session_local_data_factory; reached via
+    # Controller.session_local_data() inside handlers)
+    session_local_data_factory: Any = None
+    # per-worker-thread data: factory() -> object (server.h
+    # thread_local_data_factory; reached via Server.thread_local_data())
+    thread_local_data_factory: Any = None
     # restful mappings (reference restful.cpp): url path -> method
     #   {"/v1/echo": "EchoService.Echo"}
     restful_mappings: Dict[str, str] = field(default_factory=dict)
@@ -60,6 +67,9 @@ class Server:
         self.version = ""
         self._connections: List[Any] = []
         self._conn_lock = threading.Lock()
+        self._session_data_pool: List[Any] = []
+        self._session_data_lock = threading.Lock()
+        self._thread_local = threading.local()
 
     # ---- registry -----------------------------------------------------
     def add_service(self, svc) -> int:
@@ -151,6 +161,33 @@ class Server:
     def on_request_out(self) -> None:
         with self._conc_lock:
             self._server_concurrency -= 1
+
+    # ---- per-RPC / per-thread user data (server.h:126-150) ------------
+    def _get_session_data(self) -> Any:
+        if self.options.session_local_data_factory is None:
+            return None
+        with self._session_data_lock:
+            if self._session_data_pool:
+                return self._session_data_pool.pop()
+        return self.options.session_local_data_factory()
+
+    def _return_session_data(self, data: Any) -> None:
+        if data is None:
+            return
+        with self._session_data_lock:
+            if len(self._session_data_pool) < 1024:
+                self._session_data_pool.append(data)
+
+    def thread_local_data(self) -> Any:
+        """Data attached to the calling worker thread, created on first
+        use by options.thread_local_data_factory."""
+        factory = self.options.thread_local_data_factory
+        if factory is None:
+            return None
+        data = getattr(self._thread_local, "data", None)
+        if data is None:
+            data = self._thread_local.data = factory()
+        return data
 
     # ---- lifecycle ----------------------------------------------------
     def start(self, addr: Any = None, options: Optional[ServerOptions] = None) -> int:

@@ -40,6 +40,17 @@ class MethodDescriptor:
         self.response_cls = response_cls
         self.fn = fn
 
+    def invoke(self, cntl, request, response, done) -> None:
+        """Run the handler with a done that recycles per-RPC server
+        resources (session-local data) once the response is sent — the
+        protocol-agnostic completion point every wire protocol shares."""
+        def wrapped_done(*args, **kwargs):
+            try:
+                return done(*args, **kwargs)
+            finally:
+                cntl._release_session_data()
+        self.fn(cntl, request, response, wrapped_done)
+
 
 class Service:
     SERVICE_NAME: Optional[str] = None

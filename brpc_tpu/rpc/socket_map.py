@@ -8,7 +8,7 @@ Failed sockets are replaced on next use and handed to the health checker.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ..butil.endpoint import EndPoint, SCHEME_MEM, SCHEME_TCP, SCHEME_ICI
 from . import errors
@@ -27,7 +27,7 @@ class SocketMap:
     _instance_lock = threading.Lock()
 
     def __init__(self):
-        self._map: Dict[EndPoint, _SingleConnection] = {}
+        self._map: Dict[tuple, _SingleConnection] = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -37,18 +37,25 @@ class SocketMap:
                 cls._instance = SocketMap()
             return cls._instance
 
-    def _entry(self, ep: EndPoint) -> _SingleConnection:
+    def _entry(self, ep: EndPoint,
+               group: Any = "") -> _SingleConnection:
+        # key = (endpoint, channel signature): channels speaking different
+        # protocols to one endpoint must not share a connection, because
+        # the peer locks each connection to the first detected protocol
+        # (reference channel.cpp ComputeChannelSignature folds protocol
+        # and auth into the SocketMapKey)
+        key = (ep, group)
         with self._lock:
-            e = self._map.get(ep)
+            e = self._map.get(key)
             if e is None:
                 e = _SingleConnection()
-                self._map[ep] = e
+                self._map[key] = e
             return e
 
     def get_socket(self, ep: EndPoint, messenger=None,
-                   ssl_context=None) -> Socket:
+                   ssl_context=None, group: Any = "") -> Socket:
         """The shared 'single' connection to ep (creates/replaces lazily)."""
-        e = self._entry(ep)
+        e = self._entry(ep, group)
         with e.lock:
             if e.socket is not None and not e.socket.failed:
                 return e.socket
@@ -57,28 +64,31 @@ class SocketMap:
             e.socket = s
             return s
 
-    def get_pooled_socket(self, ep: EndPoint, messenger=None) -> Socket:
+    def get_pooled_socket(self, ep: EndPoint, messenger=None,
+                          group: Any = "", ssl_context=None) -> Socket:
         """An exclusive connection from the pool (reference
         GetPooledSocket); return it with return_pooled_socket."""
-        e = self._entry(ep)
+        e = self._entry(ep, group)
         with e.lock:
             while e.pooled:
                 s = e.pooled.pop()
                 if not s.failed:
                     return s
-        s = self._connect(ep)
+        s = self._connect(ep, ssl_context)
         s.messenger = messenger
         return s
 
-    def return_pooled_socket(self, ep: EndPoint, s: Socket) -> None:
+    def return_pooled_socket(self, ep: EndPoint, s: Socket,
+                             group: Any = "") -> None:
         if s.failed:
             return
-        e = self._entry(ep)
+        e = self._entry(ep, group)
         with e.lock:
             e.pooled.append(s)
 
-    def get_short_socket(self, ep: EndPoint, messenger=None) -> Socket:
-        s = self._connect(ep)
+    def get_short_socket(self, ep: EndPoint, messenger=None,
+                         ssl_context=None) -> Socket:
+        s = self._connect(ep, ssl_context)
         s.messenger = messenger
         return s
 
@@ -95,12 +105,15 @@ class SocketMap:
             return ici_connect(ep)
         raise ValueError(f"unsupported scheme {ep.scheme}")
 
-    def remove(self, ep: EndPoint) -> None:
+    def remove(self, ep: EndPoint, group: Any = "") -> None:
         with self._lock:
-            self._map.pop(ep, None)
+            self._map.pop((ep, group), None)
 
     def stats(self) -> Dict[EndPoint, int]:
         with self._lock:
-            return {ep: (0 if e.socket is None or e.socket.failed else 1)
-                    + len(e.pooled)
-                    for ep, e in self._map.items()}
+            out: Dict[EndPoint, int] = {}
+            for (ep, _group), e in self._map.items():
+                out[ep] = out.get(ep, 0) + \
+                    (0 if e.socket is None or e.socket.failed else 1) + \
+                    len(e.pooled)
+            return out

@@ -1,0 +1,53 @@
+"""Cascade echo — a handler that itself calls a downstream server
+(reference example/cascade_echo_c++: demonstrates client calls from
+inside server code, with the downstream latency inside the upstream
+deadline)."""
+from __future__ import annotations
+
+from examples.common import EchoRequest, EchoResponse, start_echo_server, rpc
+
+
+class CascadeService(rpc.Service):
+    """Echoes via a downstream echo server, tagging each hop."""
+
+    def __init__(self, downstream_target: str):
+        self.channel = rpc.Channel()
+        self.channel.init(downstream_target,
+                          options=rpc.ChannelOptions(timeout_ms=500))
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        inner_cntl = rpc.Controller()
+        inner = self.channel.call_method(
+            "EchoService.Echo", inner_cntl,
+            EchoRequest(message=request.message), EchoResponse)
+        if inner_cntl.failed():
+            cntl.set_failed(inner_cntl.error_code, inner_cntl.error_text)
+        else:
+            response.message = "front:" + inner.message
+        done()
+
+
+def main() -> None:
+    back = start_echo_server("mem://cascade-back", tag="back")
+    front = rpc.Server()
+    front.add_service(CascadeService("mem://cascade-back"))
+    assert front.start("mem://cascade-front") == 0
+    try:
+        ch = rpc.Channel()
+        ch.init("mem://cascade-front",
+                options=rpc.ChannelOptions(timeout_ms=1000))
+        cntl = rpc.Controller()
+        resp = ch.call_method("CascadeService.Echo", cntl,
+                              EchoRequest(message="hop"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "front:back:hop"
+        print(f"cascade -> {resp.message!r} (2 hops, "
+              f"latency={cntl.latency_us}us)")
+    finally:
+        front.stop()
+        back.stop()
+
+
+if __name__ == "__main__":
+    main()

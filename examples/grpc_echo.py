@@ -1,0 +1,31 @@
+"""gRPC echo — pb service served over HTTP/2+gRPC framing (reference
+example/grpc_c++; the same service would answer tpu_std/http/grpc on one
+port via protocol detection)."""
+from __future__ import annotations
+
+from examples.common import (EchoRequest, EchoResponse, EchoService,
+                             rpc)
+
+
+def main() -> None:
+    server = rpc.Server()
+    server.add_service(EchoService(tag="grpc"))
+    assert server.start("127.0.0.1:0") == 0
+    try:
+        ch = rpc.Channel()
+        ch.init(f"127.0.0.1:{server.listen_port}",
+                options=rpc.ChannelOptions(protocol="grpc",
+                                           timeout_ms=2000))
+        for i in range(3):
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message=f"g{i}"),
+                                  EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            print(f"grpc echo -> {resp.message!r}")
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Memcache binary-protocol client (reference example/memcache_c++).
+Runs against an in-process binary-protocol backend so the example is
+self-contained; point `target` at a real memcached/couchbase to split."""
+from __future__ import annotations
+
+import struct
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.policy import memcache as mc
+
+
+def start_backend(name: str):
+    """Minimal in-process memcached (binary protocol, get/set only)."""
+    from brpc_tpu.rpc.input_messenger import InputMessenger
+    from brpc_tpu.rpc.mem_transport import mem_listen
+    from brpc_tpu.rpc.protocol import ParseResult, Protocol
+
+    data = {}
+
+    def handle(frame: bytes) -> bytes:
+        (magic, opcode, keylen, extraslen, _dt, _vb, bodylen, opaque,
+         cas) = mc._HDR.unpack(frame[:24])
+        body = frame[24:24 + bodylen]
+        key = body[extraslen:extraslen + keylen]
+        value = body[extraslen + keylen:]
+        status, rextras, rvalue = mc.STATUS_OK, b"", b""
+        if opcode == mc.OP_SET:
+            data[key] = value
+        elif opcode == mc.OP_GET:
+            if key in data:
+                rextras, rvalue = struct.pack(">I", 0), data[key]
+            else:
+                status = mc.STATUS_KEY_NOT_FOUND
+        return mc._HDR.pack(mc.MAGIC_RESPONSE, opcode, 0, len(rextras), 0,
+                            status, len(rextras) + len(rvalue), opaque,
+                            cas) + rextras + rvalue
+
+    def parse(source, socket, read_eof, arg):
+        raw = source.fetch(len(source)) or b""
+        frames, pos = [], 0
+        while pos + 24 <= len(raw):
+            bodylen = mc._HDR.unpack(raw[pos:pos + 24])[6]
+            if pos + 24 + bodylen > len(raw):
+                break
+            frames.append(raw[pos:pos + 24 + bodylen])
+            pos += 24 + bodylen
+        if not frames:
+            return ParseResult.not_enough_data()
+        source.pop_front(pos)
+        return ParseResult.ok(frames)
+
+    def process(frames, socket, server):
+        socket.write(IOBuf(b"".join(handle(f) for f in frames)))
+
+    messenger = InputMessenger(
+        protocols=[Protocol(name="mini_mc", parse=parse,
+                            process_request=process)],
+        server=object())
+    return mem_listen(name, lambda s: setattr(s, "messenger", messenger))
+
+
+def main() -> None:
+    from brpc_tpu.rpc.mem_transport import mem_unlisten
+    start_backend("memcache-example")
+    try:
+        target = "mem://memcache-example"
+        ch = rpc.Channel()
+        ch.init(target, options=rpc.ChannelOptions(protocol="memcache",
+                                                   timeout_ms=2000))
+        req = mc.MemcacheRequest()
+        req.set("answer", b"42")
+        req.get("answer")
+        req.get("missing")
+        cntl = rpc.Controller()
+        resp = ch.call_method("memcache", cntl, req, None)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.op(1).value == b"42"
+        assert resp.op(2).status == mc.STATUS_KEY_NOT_FOUND
+        print("memcache -> set ok, get:", resp.op(1).value,
+              "miss status:", resp.op(2).status)
+    finally:
+        mem_unlisten("memcache-example")
+
+
+if __name__ == "__main__":
+    main()

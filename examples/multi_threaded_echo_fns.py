@@ -1,0 +1,48 @@
+"""Concurrent echo from tasklets (reference
+example/multi_threaded_echo_fns_c++: callers are bthreads started with
+bthread_start_background rather than pthreads — here, scheduler
+tasklets)."""
+from __future__ import annotations
+
+import threading
+
+from examples.common import EchoRequest, EchoResponse, start_echo_server, rpc
+from brpc_tpu.bthread import scheduler
+from brpc_tpu.bthread.countdown import CountdownEvent
+
+
+def main(tasklets: int = 16, calls_per_tasklet: int = 5) -> None:
+    server = start_echo_server("mem://echo-fns")
+    try:
+        channel = rpc.Channel()
+        channel.init("mem://echo-fns",
+                     options=rpc.ChannelOptions(timeout_ms=2000))
+        done = CountdownEvent(tasklets)
+        ok = [0]
+        lock = threading.Lock()
+
+        def worker(wid: int) -> None:
+            try:
+                for i in range(calls_per_tasklet):
+                    cntl = rpc.Controller()
+                    resp = channel.call_method(
+                        "EchoService.Echo", cntl,
+                        EchoRequest(message=f"w{wid}-{i}"), EchoResponse)
+                    assert not cntl.failed(), cntl.error_text
+                    assert resp.message == f"w{wid}-{i}"
+                    with lock:
+                        ok[0] += 1
+            finally:
+                done.signal()
+
+        for wid in range(tasklets):
+            scheduler.start_background(worker, wid, name=f"echo-fn-{wid}")
+        assert done.wait(30) == 0, "tasklets did not finish"
+        assert ok[0] == tasklets * calls_per_tasklet
+        print(f"{ok[0]} echoes from {tasklets} tasklets OK")
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""nshead protocol extension (reference example/nshead_extension_c++:
+serve a home-grown nshead-framed protocol by subclassing NsheadService)."""
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.policy.nshead import NsheadMessage, NsheadService
+
+
+class ReverseService(NsheadService):
+    """The custom wire payload here is raw bytes, reversed."""
+
+    def process_nshead_request(self, server, cntl, request, response,
+                               done):
+        response.body.append(request.body.to_bytes()[::-1])
+        done()
+
+
+def main() -> None:
+    server = rpc.Server()
+    server.add_service(ReverseService())
+    assert server.start("mem://nshead-example") == 0
+    try:
+        ch = rpc.Channel()
+        ch.init("mem://nshead-example",
+                options=rpc.ChannelOptions(protocol="nshead",
+                                           timeout_ms=2000))
+        req = NsheadMessage()
+        req.head.log_id = 7
+        req.body.append(b"stressed")
+        cntl = rpc.Controller()
+        resp = ch.call_method("", cntl, req)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.body.to_bytes() == b"desserts"
+        print("nshead ->", resp.body.to_bytes())
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

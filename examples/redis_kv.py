@@ -1,0 +1,58 @@
+"""Redis server + client with AUTH (reference example/redis_c++: brpc as
+both a redis-speaking client and a RedisService server)."""
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.policy import redis as redis_proto
+from brpc_tpu.policy.auth import RedisAuthenticator
+
+PASSWORD = "open-sesame"
+
+
+def make_service() -> redis_proto.RedisService:
+    svc = redis_proto.RedisService()
+    data = {}
+
+    svc.add_handler("AUTH", lambda args: (
+        redis_proto.RedisReply(redis_proto.REPLY_STATUS, "OK")
+        if bytes(args[0]).decode() == PASSWORD
+        else redis_proto.RedisReply(redis_proto.REPLY_ERROR, "ERR denied")))
+    svc.add_handler("SET", lambda args: (
+        data.__setitem__(bytes(args[0]), bytes(args[1])),
+        redis_proto.RedisReply(redis_proto.REPLY_STATUS, "OK"))[1])
+    svc.add_handler("GET", lambda args: data.get(bytes(args[0])))
+    svc.add_handler("DEL", lambda args: int(
+        data.pop(bytes(args[0]), None) is not None))
+    return svc
+
+
+def main() -> None:
+    server = rpc.Server()
+    server.add_service(make_service())
+    assert server.start("mem://redis-example") == 0
+    try:
+        ch = rpc.Channel()
+        ch.init("mem://redis-example", options=rpc.ChannelOptions(
+            protocol="redis", timeout_ms=2000,
+            auth=RedisAuthenticator(PASSWORD)))
+        req = redis_proto.RedisRequest()
+        req.add_command("SET", "fabric", "tpu")
+        req.add_command("GET", "fabric")
+        req.add_command("DEL", "fabric")
+        cntl = rpc.Controller()
+        resp = ch.call_method("redis", cntl, req, None)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.reply(1).value == b"tpu"
+        print("redis pipeline ->",
+              [r.value for r in resp.replies])
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

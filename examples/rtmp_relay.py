@@ -1,0 +1,68 @@
+"""RTMP publish→play relay (the reference's rtmp.h live-streaming API:
+one client publishes, the server relays frames to players)."""
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.policy.rtmp import (RtmpClient, RtmpClientStream,
+                                  RtmpServerStream, RtmpService)
+
+
+class RelayService(RtmpService):
+    def __init__(self):
+        self.players = {}
+
+    def new_stream(self, remote_side, connect_info):
+        relay = self
+
+        class Stream(RtmpServerStream):
+            def on_play(s, name):
+                relay.players.setdefault(name, []).append(s)
+                return 0
+
+            def on_video_message(s, timestamp, data):
+                for p in relay.players.get(s.publish_name, []):
+                    p.send_video_message(data, timestamp)
+        return Stream()
+
+
+def main() -> None:
+    server = rpc.Server()
+    server.add_service(RelayService())
+    assert server.start("127.0.0.1:0") == 0
+    target = f"127.0.0.1:{server.listen_port}"
+    try:
+        publisher = RtmpClient(target)
+        pub = publisher.create_stream()
+        assert pub.publish("cam0") == 0
+
+        frames = []
+
+        class Player(RtmpClientStream):
+            def on_video_message(self, timestamp, data):
+                frames.append((timestamp, len(data)))
+
+        viewer = RtmpClient(target)
+        play = viewer.create_stream(Player())
+        assert play.play("cam0") == 0
+
+        for i in range(10):
+            pub.send_video_message(b"\x17\x01" + bytes(4096), i * 40)
+        deadline = time.monotonic() + 5
+        while len(frames) < 10 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(frames) == 10, frames
+        print(f"relayed {len(frames)} video frames, ts 0..{frames[-1][0]}")
+        publisher.stop()
+        viewer.stop()
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Thrift framed-binary echo (reference example/thrift_extension_c++:
+a ThriftService served alongside every other protocol)."""
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.policy.thrift import ThriftMessage, ThriftService, TType
+
+ARG_SPEC = {1: ("name", TType.STRING)}
+RESULT_SPEC = {0: ("greeting", TType.STRING)}
+
+
+def main() -> None:
+    svc = ThriftService()
+    svc.add_method("Greet",
+                   lambda args: {"greeting":
+                                 b"hello " + args.get("name", b"?")},
+                   ARG_SPEC, RESULT_SPEC)
+    server = rpc.Server()
+    server.add_service(svc)
+    assert server.start("mem://thrift-example") == 0
+    try:
+        ch = rpc.Channel()
+        ch.init("mem://thrift-example",
+                options=rpc.ChannelOptions(protocol="thrift",
+                                           timeout_ms=2000))
+        cntl = rpc.Controller()
+        req = ThriftMessage("Greet", {"name": "fabric"}, ARG_SPEC,
+                            RESULT_SPEC)
+        resp = ch.call_method("Greet", cntl, req, None)
+        assert not cntl.failed(), cntl.error_text
+        print("thrift ->", resp.values["greeting"])
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,15 @@ EXAMPLES = [
     "examples.param_server",
     "examples.native_echo",
     "examples.mongo_service",
+    "examples.cascade_echo",
+    "examples.grpc_echo",
+    "examples.redis_kv",
+    "examples.memcache_client",
+    "examples.thrift_echo",
+    "examples.nshead_extension",
+    "examples.session_data_and_thread_local",
+    "examples.multi_threaded_echo_fns",
+    "examples.rtmp_relay",
 ]
 
 
