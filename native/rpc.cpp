@@ -268,8 +268,8 @@ static void put_u32be(std::string& out, uint32_t v) {
   out.push_back((char)v);
 }
 
-static std::string pack_frame(const RpcMeta& meta, const void* body,
-                              size_t body_len) {
+// header + meta only; the payload rides separate iovecs (no copy)
+static std::string pack_head(const RpcMeta& meta, size_t body_len) {
   std::string meta_bytes = encode_meta(meta);
   std::string out;
   out.reserve(kHeaderSize + meta_bytes.size() + body_len);
@@ -277,8 +277,32 @@ static std::string pack_frame(const RpcMeta& meta, const void* body,
   put_u32be(out, (uint32_t)meta_bytes.size());
   put_u32be(out, (uint32_t)body_len);
   out.append(meta_bytes);
+  return out;
+}
+
+static std::string pack_frame(const RpcMeta& meta, const void* body,
+                              size_t body_len) {
+  std::string out = pack_head(meta, body_len);
   out.append((const char*)body, body_len);
   return out;
+}
+
+// head + up-to-two payload segments as iovecs; returns the entry count
+static int build_iov(struct iovec* iov, const std::string& head,
+                     const void* data, size_t len, const void* att,
+                     size_t att_len) {
+  int n = 0;
+  iov[n].iov_base = (void*)head.data();
+  iov[n++].iov_len = head.size();
+  if (len) {
+    iov[n].iov_base = (void*)data;
+    iov[n++].iov_len = len;
+  }
+  if (att_len) {
+    iov[n].iov_base = (void*)att;
+    iov[n++].iov_len = att_len;
+  }
+  return n;
 }
 
 static uint32_t get_u32be(const uint8_t* p) {
@@ -299,23 +323,34 @@ static void set_nodelay(int fd) {
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
-// write fully, polling through EAGAIN (the drain discipline of
-// Socket::DoWrite — callers already serialized per connection).  Bounded:
-// a peer that stops reading must not wedge the caller forever (the epoll
-// thread calls this inline, so an unbounded loop would starve every
-// connection on the loop and deadlock stop()).  ~5 s of refusal = dead.
-static bool write_all(int fd, const char* data, size_t len,
-                      const std::atomic<bool>* abort_flag = nullptr,
-                      int timeout_ms = 5000) {
-  size_t off = 0;
+// Scatter-gather bounded write: one syscall for header+meta+payload+
+// attachment with no assembly copy (the zero-copy discipline of
+// Socket::DoWrite's writev batching, socket.cpp:1790).  iov entries are
+// consumed in place.  Polls through EAGAIN (callers already serialized
+// per connection) but bounded: a peer that stops reading must not wedge
+// the caller forever (the epoll thread calls this inline, so an
+// unbounded loop would starve every connection on the loop and deadlock
+// stop()).  ~5 s of refusal = dead.
+static bool write_all_iov(int fd, struct iovec* iov, int iovcnt,
+                          const std::atomic<bool>* abort_flag = nullptr,
+                          int timeout_ms = 5000) {
   int waited_ms = 0;
-  while (off < len) {
+  int cur = 0;
+  while (cur < iovcnt) {
     if (abort_flag != nullptr &&
         abort_flag->load(std::memory_order_relaxed))
       return false;
-    ssize_t w = ::write(fd, data + off, len - off);
+    ssize_t w = ::writev(fd, iov + cur, iovcnt - cur);
     if (w > 0) {
-      off += (size_t)w;
+      size_t n = (size_t)w;
+      while (cur < iovcnt && n >= iov[cur].iov_len) {
+        n -= iov[cur].iov_len;
+        ++cur;
+      }
+      if (cur < iovcnt && n > 0) {
+        iov[cur].iov_base = (char*)iov[cur].iov_base + n;
+        iov[cur].iov_len -= n;
+      }
     } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
       if (waited_ms >= timeout_ms) return false;
       struct pollfd pfd{fd, POLLOUT, 0};
@@ -328,6 +363,13 @@ static bool write_all(int fd, const char* data, size_t len,
     }
   }
   return true;
+}
+
+static bool write_all(int fd, const char* data, size_t len,
+                      const std::atomic<bool>* abort_flag = nullptr,
+                      int timeout_ms = 5000) {
+  struct iovec iov{(void*)data, len};
+  return write_all_iov(fd, &iov, 1, abort_flag, timeout_ms);
 }
 
 // ====================================================================
@@ -472,6 +514,10 @@ class NativeServer {
       ssize_t r = ::read(c->fd, buf, sizeof(buf));
       if (r > 0) {
         c->rbuf.append(buf, (size_t)r);
+        // short read = socket buffer drained; data arriving after this
+        // read raises a fresh edge, so skipping the EAGAIN round-trip is
+        // safe and saves one syscall per request
+        if ((size_t)r < sizeof(buf)) break;
       } else if (r == 0) {
         close_conn(c);
         return;
@@ -586,12 +632,20 @@ bool NativeServer::respond(uint64_t conn_id, uint64_t cid, uint64_t err,
   rmeta.response.error_text = err_text;
   rmeta.correlation_id = cid;
   rmeta.attachment_size = att_len;
-  std::string body((const char*)data, len);
-  if (att_len) body.append((const char*)att, att_len);
-  std::string frame = pack_frame(rmeta, body.data(), body.size());
-  std::lock_guard<std::mutex> g(c->wmu);
-  if (c->fd < 0) return false;       // closed while the handler ran
-  return write_all(c->fd, frame.data(), frame.size(), &stop_);
+  std::string head = pack_head(rmeta, len + att_len);
+  struct iovec iov[3];
+  int iovcnt = build_iov(iov, head, data, len, att, att_len);
+  bool ok;
+  {
+    std::lock_guard<std::mutex> g(c->wmu);
+    ok = c->fd >= 0 &&               // closed while the handler ran?
+         write_all_iov(c->fd, iov, iovcnt, &stop_);
+  }
+  // a timed-out/partial write leaves the stream desynced mid-frame: drop
+  // the connection now (matching the echo path) instead of letting a
+  // later respond() append after the truncation
+  if (!ok) close_conn(c);
+  return ok;
 }
 
 void NativeServer::process_frame(const ConnPtr& c, const uint8_t* meta_p,
@@ -612,17 +666,19 @@ void NativeServer::process_frame(const ConnPtr& c, const uint8_t* meta_p,
   }  // released before any write: a stalled peer must not hold the
      // server-wide method table against other loops
   if (is_echo) {
-    // native echo: response payload = request payload, attachment echoed
+    // native echo: response payload = request payload, attachment echoed;
+    // payload goes out via writev straight from the read buffer (no copy)
     RpcMeta rmeta;
     rmeta.response.present = true;
     rmeta.correlation_id = meta.correlation_id;
     rmeta.attachment_size = meta.attachment_size;
-    std::string frame = pack_frame(rmeta, body, body_len);
+    std::string head = pack_head(rmeta, body_len);
+    struct iovec iov[3];
+    int iovcnt = build_iov(iov, head, body, body_len, nullptr, 0);
     bool ok;
     {
       std::lock_guard<std::mutex> wg(c->wmu);
-      ok = c->fd >= 0 &&
-           write_all(c->fd, frame.data(), frame.size(), &stop_);
+      ok = c->fd >= 0 && write_all_iov(c->fd, iov, iovcnt, &stop_);
     }
     if (!ok) close_conn(c);     // non-reading peer: drop it, free the loop
     return;
@@ -748,13 +804,13 @@ class NativeChannel {
     meta.correlation_id = cid;
     meta.attachment_size = att_len;
     if (timeout_us > 0) meta.request.timeout_ms = (uint64_t)(timeout_us / 1000);
-    std::string body((const char*)req, req_len);
-    if (att_len) body.append((const char*)att, att_len);
-    std::string frame = pack_frame(meta, body.data(), body.size());
+    std::string head = pack_head(meta, req_len + att_len);
+    struct iovec iov[3];
+    int iovcnt = build_iov(iov, head, req, req_len, att, att_len);
     {
       std::lock_guard<std::mutex> g(wmu_);
       if (closing_.load(std::memory_order_acquire) ||
-          !write_all(fd_, frame.data(), frame.size())) {
+          !write_all_iov(fd_, iov, iovcnt)) {
         erase_slot(cid);
         *err_text = "write failed";
         return 1009;
@@ -808,32 +864,43 @@ class NativeChannel {
     slots_.erase(cid);
   }
 
-  // Read whatever is available (poll up to timeout_ms), dispatch complete
-  // frames into slots.  Returns true if bytes were read.
-  bool read_once(int timeout_ms) {
-    struct pollfd pfd{fd_, POLLIN, 0};
-    int pr = ::poll(&pfd, 1, timeout_ms);
-    if (pr <= 0) return false;
+  // drain the socket into rbuf_ until EAGAIN/short read; sets *eof on
+  // peer close (handled by the caller AFTER buffered frames dispatch, so
+  // a response sharing a segment with FIN still reaches its slot);
+  // returns the number of bytes read
+  ssize_t drain_fd(bool* eof) {
     char buf[65536];
-    bool any = false;
+    ssize_t got = 0;
     for (;;) {
       ssize_t r = ::read(fd_, buf, sizeof(buf));
       if (r > 0) {
         rbuf_.append(buf, (size_t)r);
-        any = true;
-        if ((size_t)r < sizeof(buf)) break;
+        got += r;
+        if ((size_t)r < sizeof(buf)) break;   // socket buffer drained
       } else if (r == 0) {
-        // peer EOF: shutdown (not close) so the fd number cannot be
-        // recycled while concurrent writers still reference it; the
-        // destructor does the close
-        ::shutdown(fd_, SHUT_RDWR);
-        closing_.store(true, std::memory_order_release);
-        fail_all_pending();
+        *eof = true;
         break;
       } else {
         break;  // EAGAIN (fd is nonblocking)
       }
     }
+    return got;
+  }
+
+  // Read whatever is available (one optimistic drain, else poll up to
+  // timeout_ms and drain), dispatch complete frames into slots; returns
+  // true if bytes were read.
+  bool read_once(int timeout_ms) {
+    // optimistic drain first: under pipelining/1-core scheduling the
+    // response is often already buffered, making poll() a wasted syscall
+    bool eof = false;
+    ssize_t got = drain_fd(&eof);
+    if (got == 0 && !eof) {
+      struct pollfd pfd{fd_, POLLIN, 0};
+      if (::poll(&pfd, 1, timeout_ms) <= 0) return false;
+      got = drain_fd(&eof);
+    }
+    bool any = got > 0;
     size_t off = 0;
     while (rbuf_.size() - off >= kHeaderSize) {
       const uint8_t* p = (const uint8_t*)rbuf_.data() + off;
@@ -856,6 +923,15 @@ class NativeChannel {
       off += total;
     }
     if (off > 0) rbuf_.erase(0, off);
+    if (eof) {
+      // peer EOF — processed only after the dispatch loop above, so
+      // responses riding the final segment were delivered.  shutdown
+      // (not close) so the fd number cannot be recycled while concurrent
+      // writers still reference it; the destructor does the close
+      ::shutdown(fd_, SHUT_RDWR);
+      closing_.store(true, std::memory_order_release);
+      fail_all_pending();
+    }
     return any;
   }
 
