@@ -126,7 +126,14 @@ class IOBuf:
     # ---- append ------------------------------------------------------
     def append(self, data: Union[bytes, bytearray, memoryview, str, "IOBuf"]) -> None:
         if isinstance(data, IOBuf):
-            self._refs.extend(data._refs)       # ref share, no copy
+            # block-level zero-copy: share the BLOCKS, but copy the tiny
+            # BlockRef structs.  Refs are mutated in place by cutn/
+            # pop_front, so sharing the ref OBJECTS would corrupt every
+            # other holder when one of them is cut (the reference stores
+            # BlockRef by value in each IOBuf for exactly this reason,
+            # iobuf.h:70-97)
+            self._refs.extend(BlockRef(r.block, r.offset, r.length)
+                              for r in data._refs)
             self._size += data._size
             return
         if isinstance(data, str):

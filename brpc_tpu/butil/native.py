@@ -15,6 +15,10 @@ import subprocess
 import threading
 from typing import Callable, Optional
 
+# stale-.so detector: ALWAYS the most recently added C symbol, so an old
+# build triggers a rebuild instead of silently disabling the native layer
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_native_rpc_throughput_gbps"
+
 _lib = None
 _lib_lock = threading.Lock()
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
@@ -51,7 +55,7 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(_SO)
-            if not hasattr(lib, "brpc_tpu_nserver_start"):
+            if not hasattr(lib, _BRPC_TPU_NEWEST_SYMBOL_):
                 # stale .so predating native/rpc.cpp: rebuild, then load
                 # through a unique temp copy — dlopen dedups by pathname,
                 # so re-opening _SO would return the stale mapping
@@ -64,7 +68,7 @@ def load() -> Optional[ctypes.CDLL]:
                 tmp.close()
                 shutil.copy(_SO, tmp.name)
                 lib = ctypes.CDLL(tmp.name)
-                if not hasattr(lib, "brpc_tpu_nserver_start"):
+                if not hasattr(lib, _BRPC_TPU_NEWEST_SYMBOL_):
                     return None
             return _bind(lib)
         except (OSError, AttributeError):
@@ -164,6 +168,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_native_rpc_qps.restype = ctypes.c_double
     lib.brpc_tpu_native_rpc_qps.argtypes = [ctypes.c_int, ctypes.c_int,
                                             ctypes.c_int]
+    lib.brpc_tpu_native_rpc_throughput_gbps.restype = ctypes.c_double
+    lib.brpc_tpu_native_rpc_throughput_gbps.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
     _lib = lib
     return _lib
 
@@ -222,3 +229,14 @@ def native_rpc_qps(threads: int = 16, duration_ms: int = 1500,
     if lib is None:
         return -1.0
     return lib.brpc_tpu_native_rpc_qps(threads, duration_ms, payload)
+
+
+def native_rpc_throughput_gbps(threads: int = 2, duration_ms: int = 1500,
+                               payload: int = 4 << 20) -> float:
+    """Large-request echo throughput GB/s, 1 client -> 1 server (the
+    reference's 2.3 GB/s headline config); -1 if unavailable."""
+    lib = load()
+    if lib is None:
+        return -1.0
+    return lib.brpc_tpu_native_rpc_throughput_gbps(threads, duration_ms,
+                                                   payload)

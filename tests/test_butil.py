@@ -289,3 +289,41 @@ class TestMisc:
         t = butil.Timer()
         t.start(); t.stop()
         assert t.n_elapsed() >= 0
+
+
+class TestIOBufRefAliasing:
+    """append(IOBuf) shares blocks but must copy BlockRefs: cutting one
+    buffer must never corrupt another that shares its blocks (the
+    reference stores BlockRef by value, iobuf.h:70-97)."""
+
+    def test_cut_of_composite_leaves_source_intact(self):
+        from brpc_tpu.butil.iobuf import IOBuf
+        payload = IOBuf(b"A" * 1000)
+        frame = IOBuf(b"HDR")
+        frame.append(payload)               # block-share
+        # transport-style partial consumption of the frame
+        frame.cut(500)
+        frame.cut(400)
+        assert payload.to_bytes() == b"A" * 1000
+
+    def test_reused_payload_across_frames(self):
+        from brpc_tpu.butil.iobuf import IOBuf
+        payload = IOBuf(b"xyz" * 100)
+        wire = IOBuf()
+        for i in range(10):                 # 10 frames share one payload
+            frame = IOBuf(b"H%d" % i)
+            frame.append(payload)
+            wire.append(frame.cut(len(frame)))
+        out = bytes(wire.to_bytes())
+        for i in range(10):
+            assert out[i * 302:i * 302 + 2] == b"H%d" % i
+            assert out[i * 302 + 2:(i + 1) * 302] == b"xyz" * 100
+
+    def test_pop_front_does_not_corrupt_sharer(self):
+        from brpc_tpu.butil.iobuf import IOBuf
+        a = IOBuf(b"0123456789")
+        b = IOBuf()
+        b.append(a)
+        b.pop_front(4)
+        assert a.to_bytes() == b"0123456789"
+        assert b.to_bytes() == b"456789"
