@@ -35,7 +35,12 @@ class BuiltinDispatcher:
         fn = self.handlers.get(path.strip("/"))
         if fn is None:
             return None
-        return fn(self.server, query or {})
+        try:
+            return fn(self.server, query or {})
+        except Exception as e:
+            # a handler exception must become a response, never a hung
+            # client (bad query args, unreadable paths, ...)
+            return "text/plain", f"error: {type(e).__name__}: {e}\n"
 
     def paths(self):
         return sorted(self.handlers)
@@ -57,6 +62,13 @@ class BuiltinDispatcher:
         self.add("version", _version)
         self.add("hotspots", _hotspots)
         self.add("contention", _contention)
+        self.add("threads", _threads)
+        self.add("list_services", _list_services)
+        self.add("vlog", _vlog)
+        self.add("dir", _dir)
+        self.add("pprof/cmdline", _pprof_cmdline)
+        self.add("pprof/profile", _pprof_profile)
+        self.add("pprof/symbol", _pprof_symbol)
 
 
 def _health(server, q):
@@ -195,6 +207,92 @@ def _contention(server, q):
     for site, n, total in rows[:50]:
         lines.append(f"{total:12.4f}  {n:8d}  {site}")
     return "text/plain", "\n".join(lines) + "\n"
+
+
+def _threads(server, q):
+    """Stack dump of every live thread (builtin/threads_service.cpp does
+    this for pthreads via SIGQUIT; here: sys._current_frames)."""
+    import sys
+    import threading as _threading
+    import traceback
+    names = {t.ident: t.name for t in _threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} (tid={tid}) ---")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+    return "text/plain", "\n".join(out) + "\n"
+
+
+def _list_services(server, q):
+    """Service/method registry (builtin/list_service.cpp ListService)."""
+    out = {}
+    for name, svc in server.services().items():
+        out[name] = [
+            {"method": m, "request": md.request_cls.__name__
+             if md.request_cls else "",
+             "response": md.response_cls.__name__
+             if md.response_cls else ""}
+            for m, md in svc.methods().items()]
+    return "application/json", json.dumps(out, indent=1)
+
+
+def _vlog(server, q):
+    """Verbose-logging control (builtin/vlog_service.cpp); maps to the
+    logging module's min level here."""
+    import logging as _pylog
+
+    from ...butil import logging as log
+    if "level" in q:
+        level = _pylog.getLevelNamesMapping().get(q["level"].upper())
+        if level is None:
+            return "text/plain", f"unknown level {q['level']!r}\n"
+        log.set_min_log_level(level)
+        return "text/plain", f"min level set to {q['level']}\n"
+    return "text/plain", (
+        f"min level: {_pylog.getLevelName(log._logger.level)}\n")
+
+
+def _dir(server, q):
+    """Filesystem browser (builtin/dir_service.cpp), restricted to the
+    server's working directory subtree."""
+    import os
+    root = os.path.realpath(os.getcwd())
+    rel = q.get("path", ".")
+    path = os.path.realpath(os.path.join(root, rel))
+    # commonpath, not startswith: /data/app must not admit /data/app-x
+    if os.path.commonpath([root, path]) != root:
+        return "text/plain", "path escapes working directory\n"
+    try:
+        if os.path.isdir(path):
+            entries = sorted(os.listdir(path))
+            return "application/json", json.dumps(
+                {"dir": os.path.relpath(path, root), "entries": entries})
+        with open(path, "rb") as f:
+            data = f.read(1 << 20)
+        return "text/plain", data.decode("utf-8", "replace")
+    except OSError as e:
+        return "text/plain", f"cannot read: {e}\n"
+
+
+def _pprof_cmdline(server, q):
+    """pprof remote protocol: the profiled binary's command line
+    (builtin/pprof_service.cpp)."""
+    import sys
+    return "text/plain", "\x00".join([sys.executable] + sys.argv)
+
+
+def _pprof_profile(server, q):
+    """pprof remote protocol: CPU profile for ?seconds=N — same engine as
+    /hotspots (pprof_service.cpp shares ProfilerStart with hotspots)."""
+    return _hotspots(server, {"seconds": q.get("seconds", "2"),
+                              "top": q.get("top", "60")})
+
+
+def _pprof_symbol(server, q):
+    """pprof symbol endpoint: Python frames are already symbolic; report
+    the symbol count convention (pprof probes with a GET first)."""
+    return "text/plain", "num_symbols: 1\n"
 
 
 def _index(server, q):

@@ -109,6 +109,12 @@ class TestHttpServer:
         ("bthreads", b"workers"),
         ("rpcz", b"spans"),
         ("version", b"brpc_tpu"),
+        ("threads", b"--- thread"),
+        ("list_services", b"EchoRequest"),
+        ("vlog", b"min level"),
+        ("dir", b"entries"),
+        ("pprof/cmdline", b"python"),
+        ("pprof/symbol", b"num_symbols"),
     ])
     def test_builtin_pages(self, page, needle):
         server = start_tcp_server()
