@@ -41,10 +41,8 @@ class StdMessage:
 def pack_frame(meta: meta_pb.RpcMeta, payload: IOBuf) -> IOBuf:
     meta_bytes = meta.SerializeToString()
     out = IOBuf()
-    out.append(MAGIC)
-    out.append(len(meta_bytes).to_bytes(4, "big"))
-    out.append(len(payload).to_bytes(4, "big"))
-    out.append(meta_bytes)
+    out.append(MAGIC + len(meta_bytes).to_bytes(4, "big")
+               + len(payload).to_bytes(4, "big") + meta_bytes)
     out.append(payload)            # zero-copy ref share (device blocks ride)
     return out
 

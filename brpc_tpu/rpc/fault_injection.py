@@ -1,0 +1,83 @@
+"""Fault injection — drop/delay/sever writes to exercise resilience.
+
+The reference ships no built-in fault injection (SURVEY.md §5.3: tests
+kill in-process servers); this module goes one step further so retry,
+backup-request, health-check, and circuit-breaker machinery can be
+exercised deterministically.  Faults act at the Socket.write boundary —
+the same place a lossy or partitioned network would.
+
+    from brpc_tpu.rpc import fault_injection as fi
+    with fi.inject(fi.FaultInjector(drop_ratio=1.0,
+                                    match=lambda s: s.remote_side == ep)):
+        ...   # every write toward ep silently vanishes
+
+Deterministic given a seed; thread-safe; uninstalls on context exit.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+PASS = "pass"
+DROP = "drop"          # bytes vanish (lossy link / partition)
+ERROR = "error"        # connection severed (peer reset)
+
+
+class FaultInjector:
+    def __init__(self, drop_ratio: float = 0.0, error_ratio: float = 0.0,
+                 delay_ms: float = 0.0, seed: int = 0,
+                 match: Optional[Callable] = None):
+        self.drop_ratio = drop_ratio
+        self.error_ratio = error_ratio
+        self.delay_ms = delay_ms
+        self.match = match
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected = {DROP: 0, ERROR: 0, "delayed": 0}
+
+    def decide(self, socket) -> str:
+        if self.match is not None and not self.match(socket):
+            return PASS
+        with self._lock:
+            r = self._rng.random()
+            if r < self.drop_ratio:
+                self.injected[DROP] += 1
+                return DROP
+            if r < self.drop_ratio + self.error_ratio:
+                self.injected[ERROR] += 1
+                return ERROR
+            if self.delay_ms > 0:
+                self.injected["delayed"] += 1
+        if self.delay_ms > 0:
+            time.sleep(self.delay_ms / 1000.0)
+        return PASS
+
+
+_active: Optional[FaultInjector] = None
+
+
+def install(injector: Optional[FaultInjector]) -> None:
+    global _active
+    _active = injector
+
+
+def active() -> Optional[FaultInjector]:
+    return _active
+
+
+class inject:
+    """Context manager: install for the with-block, restore after."""
+
+    def __init__(self, injector: FaultInjector):
+        self.injector = injector
+        self._prev: Optional[FaultInjector] = None
+
+    def __enter__(self) -> FaultInjector:
+        self._prev = _active
+        install(self.injector)
+        return self.injector
+
+    def __exit__(self, *exc) -> None:
+        install(self._prev)

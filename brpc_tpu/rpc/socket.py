@@ -135,6 +135,15 @@ class Socket:
               on_done: Optional[Callable[[int], None]] = None) -> int:
         """Enqueue data; returns 0 or an error code immediately (completion
         is reported through on_done / correlation error)."""
+        from . import fault_injection as _fi
+        injector = _fi.active()
+        if injector is not None:
+            action = injector.decide(self)
+            if action == _fi.DROP:
+                return 0                 # bytes vanish: lossy link
+            if action == _fi.ERROR:
+                self.set_failed(errors.EFAILEDSOCKET, "injected fault")
+                return errors.EFAILEDSOCKET
         req = WriteRequest(data, notify_cid, on_done)
         with self._write_lock:
             if self.failed:

@@ -327,3 +327,52 @@ class TestIOBufRefAliasing:
         b.pop_front(4)
         assert a.to_bytes() == b"0123456789"
         assert b.to_bytes() == b"456789"
+
+
+class TestIOBufDifferentialFuzz:
+    """Randomized op sequences on IOBuf mirrored against plain bytes —
+    the whole aliasing/offset-bookkeeping bug class fails this (the
+    reference's iobuf_unittest.cpp plays similar random push/cut games)."""
+
+    def test_random_ops_match_bytes_model(self):
+        import random
+        from brpc_tpu.butil.iobuf import IOBuf
+
+        rng = random.Random(0xB21C)
+        for trial in range(30):
+            bufs = [(IOBuf(), bytearray())]
+            for step in range(120):
+                i = rng.randrange(len(bufs))
+                buf, model = bufs[i]
+                op = rng.randrange(6)
+                if op == 0:                       # append bytes
+                    data = bytes([rng.randrange(256)]) * rng.randrange(1, 400)
+                    buf.append(data)
+                    model += data
+                elif op == 1 and len(bufs) > 1:   # append another IOBuf
+                    j = rng.randrange(len(bufs))
+                    if j != i:
+                        src, src_model = bufs[j]
+                        buf.append(src)
+                        model += src_model
+                elif op == 2 and len(buf):        # cut prefix to new buf
+                    n = rng.randrange(1, len(buf) + 1)
+                    out = buf.cut(n)
+                    bufs.append((out, bytearray(model[:n])))
+                    del model[:n]
+                elif op == 3 and len(buf):        # pop_front
+                    n = rng.randrange(1, len(buf) + 1)
+                    buf.pop_front(n)
+                    del model[:n]
+                elif op == 4 and len(buf):        # pop_back
+                    n = rng.randrange(1, len(buf) + 1)
+                    buf.pop_back(n)
+                    del model[len(model) - n:]
+                elif op == 5:                     # fresh buffer
+                    data = bytes([rng.randrange(256)]) * rng.randrange(0, 200)
+                    bufs.append((IOBuf(data), bytearray(data)))
+                # every buffer must match its model after every op
+                for k, (b, m) in enumerate(bufs):
+                    assert b.to_bytes() == bytes(m), \
+                        f"trial {trial} step {step} buf {k} diverged"
+                    assert len(b) == len(m)

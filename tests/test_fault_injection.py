@@ -1,0 +1,177 @@
+"""Fault injection driving the resilience machinery (retry, backup
+request, failover) — beyond-reference coverage (SURVEY.md §5.3: the
+reference has no built-in fault injection)."""
+import time
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc import fault_injection as fi
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+_seq = [7000]
+
+
+def unique(p):
+    _seq[0] += 1
+    return f"{p}-{_seq[0]}"
+
+
+class EchoService(rpc.Service):
+    def __init__(self, tag):
+        self.tag = tag
+        self.calls = 0
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        self.calls += 1
+        response.message = f"{self.tag}:{request.message}"
+        done()
+
+
+def start(tag):
+    server = rpc.Server()
+    svc = EchoService(tag)
+    server.add_service(svc)
+    target = f"mem://{unique(tag)}"
+    assert server.start(target) == 0
+    return server, svc, target
+
+
+class TestFaultInjection:
+    def test_no_injector_no_effect(self):
+        server, svc, target = start("a")
+        try:
+            ch = rpc.Channel()
+            ch.init(target)
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed() and resp.message == "a:x"
+        finally:
+            server.stop()
+
+    def test_total_drop_times_out(self):
+        server, svc, target = start("a")
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(timeout_ms=200,
+                                                       max_retry=0))
+            with fi.inject(fi.FaultInjector(drop_ratio=1.0)) as inj:
+                cntl = rpc.Controller()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="x"), EchoResponse)
+                assert cntl.failed()
+                assert cntl.error_code == errors.ERPCTIMEDOUT
+                assert inj.injected[fi.DROP] >= 1
+            assert svc.calls == 0
+        finally:
+            server.stop()
+
+    def test_request_drops_recovered_by_retry(self):
+        """First try's request vanishes; the retry (fresh try) succeeds —
+        the correlation-id versioning must accept try 2's response."""
+        server, svc, target = start("a")
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(timeout_ms=300,
+                                                       max_retry=3))
+            # drop exactly the first matched write, pass the rest
+            state = {"dropped": False}
+
+            class OneShot(fi.FaultInjector):
+                def decide(self, socket):
+                    if not state["dropped"] and not socket.is_server_side:
+                        state["dropped"] = True
+                        self.injected[fi.DROP] += 1
+                        return fi.DROP
+                    return fi.PASS
+
+            with fi.inject(OneShot()):
+                cntl = rpc.Controller()
+                resp = ch.call_method("EchoService.Echo", cntl,
+                                      EchoRequest(message="r"),
+                                      EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert resp.message == "a:r"
+        finally:
+            server.stop()
+
+    def test_injected_sever_fails_fast_not_timeout(self):
+        server, svc, target = start("a")
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(timeout_ms=2000,
+                                                       max_retry=0))
+            t0 = time.monotonic()
+            with fi.inject(fi.FaultInjector(error_ratio=1.0)):
+                cntl = rpc.Controller()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="x"), EchoResponse)
+                assert cntl.failed()
+            assert time.monotonic() - t0 < 1.0   # severed, not timed out
+        finally:
+            server.stop()
+
+    def test_delay_triggers_backup_request(self):
+        """Injected latency on the first try's path makes the hedged
+        backup request win (docs/cn/backup_request.md behavior)."""
+        server, svc, target = start("a")
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(
+                timeout_ms=3000, max_retry=1, backup_request_ms=50))
+            first = {"seen": False}
+
+            class DelayFirst(fi.FaultInjector):
+                def decide(self, socket):
+                    if not first["seen"] and not socket.is_server_side:
+                        first["seen"] = True
+                        time.sleep(0.4)       # stall try 0's request
+                    return fi.PASS
+
+            with fi.inject(DelayFirst()):
+                cntl = rpc.Controller()
+                t0 = time.monotonic()
+                resp = ch.call_method("EchoService.Echo", cntl,
+                                      EchoRequest(message="b"),
+                                      EchoResponse)
+                dt = time.monotonic() - t0
+                assert not cntl.failed(), cntl.error_text
+                assert resp.message == "a:b"
+        finally:
+            server.stop()
+
+    def test_match_scopes_faults_to_one_backend(self):
+        """Drops scoped to server A: an LB channel over A+B keeps
+        succeeding via B (failover through retry + exclusion)."""
+        sa, svca, ta = start("A")
+        sb, svcb, tb = start("B")
+        try:
+            ch = rpc.Channel()
+            ch.init(f"list://{ta.split('://')[1]},{tb.split('://')[1]}",
+                    "rr", options=rpc.ChannelOptions(timeout_ms=300,
+                                                     max_retry=3))
+            a_host = ta.split("://")[1]
+
+            def match(socket):
+                return (socket.remote_side is not None
+                        and a_host in str(socket.remote_side)
+                        and not socket.is_server_side)
+
+            with fi.inject(fi.FaultInjector(drop_ratio=1.0, match=match)):
+                ok = 0
+                for i in range(6):
+                    cntl = rpc.Controller()
+                    resp = ch.call_method("EchoService.Echo", cntl,
+                                          EchoRequest(message=str(i)),
+                                          EchoResponse)
+                    if not cntl.failed() and resp.message.startswith("B:"):
+                        ok += 1
+                assert ok == 6, f"only {ok}/6 failed over to B"
+            assert svcb.calls >= 6 and svca.calls == 0
+        finally:
+            sa.stop()
+            sb.stop()

@@ -160,3 +160,61 @@ class TestGrpcEndToEnd:
             assert cntl.error_code == errors.ENOMETHOD
         finally:
             server.stop()
+
+
+class TestHpackRfc7541Vectors:
+    """RFC 7541 Appendix C golden byte sequences — decoding foreign-encoder
+    output proves interop without an h2 peer in the image."""
+
+    def test_c3_requests_without_huffman(self):
+        d = hpack.Decoder()
+        # C.3.1
+        block1 = bytes.fromhex("828684410f7777772e6578616d706c652e636f6d")
+        assert d.decode(block1) == [
+            (b":method", b"GET"), (b":scheme", b"http"),
+            (b":path", b"/"), (b":authority", b"www.example.com")]
+        # C.3.2 — dynamic table entry from C.3.1 must resolve
+        block2 = bytes.fromhex("828684be58086e6f2d6361636865")
+        assert d.decode(block2) == [
+            (b":method", b"GET"), (b":scheme", b"http"),
+            (b":path", b"/"), (b":authority", b"www.example.com"),
+            (b"cache-control", b"no-cache")]
+        # C.3.3
+        block3 = bytes.fromhex(
+            "828785bf400a637573746f6d2d6b65790c637573746f6d2d76616c7565")
+        assert d.decode(block3) == [
+            (b":method", b"GET"), (b":scheme", b"https"),
+            (b":path", b"/index.html"), (b":authority", b"www.example.com"),
+            (b"custom-key", b"custom-value")]
+
+    def test_c4_requests_with_huffman(self):
+        d = hpack.Decoder()
+        # C.4.1
+        block1 = bytes.fromhex("828684418cf1e3c2e5f23a6ba0ab90f4ff")
+        assert d.decode(block1) == [
+            (b":method", b"GET"), (b":scheme", b"http"),
+            (b":path", b"/"), (b":authority", b"www.example.com")]
+        # C.4.2
+        block2 = bytes.fromhex("828684be5886a8eb10649cbf")
+        assert d.decode(block2)[-1] == (b"cache-control", b"no-cache")
+        # C.4.3
+        block3 = bytes.fromhex(
+            "828785bf408825a849e95ba97d7f8925a849e95bb8e8b4bf")
+        assert d.decode(block3)[-1] == (b"custom-key", b"custom-value")
+
+    def test_c6_responses_with_huffman(self):
+        d = hpack.Decoder(max_table_size=256)
+        # C.6.1
+        block1 = bytes.fromhex(
+            "488264025885aec3771a4b6196d07abe941054d444a8200595040b8166"
+            "e082a62d1bff6e919d29ad171863c78f0b97c8e9ae82ae43d3")
+        assert d.decode(block1) == [
+            (b":status", b"302"), (b"cache-control", b"private"),
+            (b"date", b"Mon, 21 Oct 2013 20:13:21 GMT"),
+            (b"location", b"https://www.example.com")]
+        # C.6.2 — :status 307 indexes over the evicted 302 entry
+        block2 = bytes.fromhex("4883640effc1c0bf")
+        assert d.decode(block2) == [
+            (b":status", b"307"), (b"cache-control", b"private"),
+            (b"date", b"Mon, 21 Oct 2013 20:13:21 GMT"),
+            (b"location", b"https://www.example.com")]
