@@ -70,9 +70,28 @@ def parse_endpoint(s: str) -> EndPoint:
         return EndPoint(scheme=SCHEME_MEM, host=s[len("mem://"):])
     if s.startswith("tcp://"):
         s = s[len("tcp://"):]
-    # ip:port or host:port
-    if ":" not in s:
-        raise ValueError(f"bad endpoint {s!r}: missing port")
+        if ":" not in s:
+            raise ValueError(f"bad endpoint {s!r}: missing port")
+    elif ":" not in s:
+        # Bare token without a port: an in-process mem:// registry name.
+        # Naming services (list://, file://) carry mem/ici backends this way
+        # (reference list_naming_service.cpp only ever names ip:port; our
+        # fabric has three transports, so scheme-less entries default to the
+        # loopback registry rather than failing).  Heuristic guard: dotted
+        # names/IPs, "localhost", and all-digit tokens still error — those
+        # are almost certainly tcp targets with the port forgotten, and
+        # routing them to a nonexistent registry would hide the typo.
+        # (A dotless bare hostname like "node2" is indistinguishable from
+        # a registry slug and resolves as mem:// — use tcp://node2:port
+        # for network targets.)
+        if not s:
+            raise ValueError("empty endpoint")
+        if "." in s or s == "localhost" or s.isdigit():
+            raise ValueError(f"bad endpoint {s!r}: missing port "
+                             f"(host-like names need host:port; "
+                             f"mem:// registry names don't contain dots "
+                             f"and aren't all digits)")
+        return EndPoint(scheme=SCHEME_MEM, host=s)
     host, _, port = s.rpartition(":")
     return EndPoint(scheme=SCHEME_TCP, host=host, port=int(port))
 

@@ -58,10 +58,32 @@ def _parse_line(line: str) -> Optional[ServerEntry]:
     return ServerEntry(ep, weight, tag)
 
 
+def _split_list(body: str) -> List[str]:
+    """Split a list:// body on commas, but not inside ici mesh coords —
+    ``list://ici://(0,1),ici://(0,2)`` is two entries, not four.  Spaces
+    inside the parens are squeezed out so the whitespace-splitting
+    _parse_line sees ``ici://(0,1)`` as one token."""
+    out, depth, cur = [], 0, []
+    for ch in body:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        elif ch.isspace() and depth > 0:
+            continue
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return [x for x in out if x.strip()]
+
+
 class ListNamingService(NamingService):
     def __init__(self, body: str):
         self._entries = []
-        for item in body.split(","):
+        for item in _split_list(body):
             e = _parse_line(item.replace(":tag=", " "))
             if e is not None:
                 self._entries.append(e)

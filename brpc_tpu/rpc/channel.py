@@ -29,6 +29,14 @@ class ChannelOptions:
     timeout_ms: int = 1000
     max_retry: int = 3
     backup_request_ms: int = 0          # 0 = disabled
+    # Opt-in: split timeout_ms evenly over max_retry+1 tries and hedge a
+    # fresh try when a try's share elapses silently (recovers requests a
+    # lossy fabric *dropped*).  Off by default because a hedged try can
+    # duplicate a non-idempotent request — same caveat as backup_request_ms
+    # (docs/cn/backup_request.md); the reference treats ERPCTIMEDOUT as
+    # final.  Ignored when backup_request_ms is set (that is already the
+    # user's explicit hedging schedule).
+    retry_on_timeout: bool = False
     connect_timeout_ms: int = 1000
     auth: object = None                 # Authenticator
     ssl_context: object = None          # ssl.SSLContext for TLS channels

@@ -51,6 +51,7 @@ class Controller:
         self.timeout_ms: Optional[int] = None
         self.max_retry: Optional[int] = None
         self.backup_request_ms: Optional[int] = None
+        self.retry_on_timeout: Optional[bool] = None
         self.retried_count: int = 0
         self.current_try: int = 0
         self.latency_us: int = 0
@@ -65,7 +66,6 @@ class Controller:
         self._request_buf: Optional[IOBuf] = None
         self._start_us: int = 0
         self._ended = threading.Event()
-        self._unfinished_tries: int = 0
         self._excluded_servers: set = set()
         self.request_protocol: str = ""
         self.stream_creator = None      # set by stream.create on host RPC
@@ -126,6 +126,8 @@ class Controller:
             self.max_retry = opts.max_retry
         if self.backup_request_ms is None:
             self.backup_request_ms = opts.backup_request_ms
+        if self.retry_on_timeout is None:
+            self.retry_on_timeout = opts.retry_on_timeout
         # +1: versions are try indices 0..max_retry
         self._cid = bthread_id.create_ranged(
             self, self._on_rpc_event, self.max_retry + 1)
@@ -140,14 +142,51 @@ class Controller:
         # inline loopback/device completions skip the timer heap entirely
         if (self.timeout_ms and self.timeout_ms > 0
                 and not self._ended.is_set()):
-            self._timeout_timer = TimerThread.instance().schedule_after(
-                self._handle_timeout, self.timeout_ms / 1000.0)
+            self._schedule_try_timer()
+
+    def _timeout_hedging(self) -> bool:
+        """Per-try deadline hedging is active only when opted in via
+        ChannelOptions.retry_on_timeout, and backup_request_ms is unset
+        (that is already an explicit hedging schedule — running both would
+        double-hedge and burn the retry budget)."""
+        return bool(self.retry_on_timeout) and not self.backup_request_ms
+
+    def _schedule_try_timer(self) -> None:
+        """Arm the deadline timer for the current try.
+
+        Default (reference semantics, controller.cpp HandleTimeout):
+        timeout_ms is a single overall deadline and ERPCTIMEDOUT is final.
+        With retry_on_timeout opted in, the deadline is instead split
+        evenly over the tries that remain: a try that produces neither a
+        response nor a connection error gets remaining/tries_left ms before
+        the correlation id is poked with ERPCTIMEDOUT, where the funnel
+        hedges a fresh try instead of failing (see _on_rpc_event).  The
+        total deadline is always honored.
+        """
+        if self._timeout_timer is not None:
+            TimerThread.instance().unschedule(self._timeout_timer)
+            self._timeout_timer = None
+        if not self.timeout_ms or self.timeout_ms <= 0 or self._ended.is_set():
+            return
+        elapsed_ms = (time.monotonic_ns() // 1000 - self._start_us) / 1000.0
+        remaining = max(0.0, self.timeout_ms - elapsed_ms)
+        if self._timeout_hedging():
+            tries_left = max(1, (self.max_retry or 0) - self.current_try + 1)
+            remaining = remaining / tries_left
+        # Bind the try version NOW: unschedule() can't stop a timer that
+        # already popped from the heap, and a stale tasklet reading
+        # current_try at run time would poke the *live* try with
+        # ERPCTIMEDOUT long before its deadline.  A version-bound stale
+        # timer instead fails to lock (after reset_version) or is dropped
+        # by the straggler guard.
+        ver = self.current_try
+        self._timeout_timer = TimerThread.instance().schedule_after(
+            lambda: self._handle_timeout(ver), remaining / 1000.0)
 
     def current_cid(self) -> int:
         return bthread_id.with_version(self._cid, self.current_try)
 
     def _issue_rpc(self) -> None:
-        self._unfinished_tries += 1
         try:
             self._channel._issue_rpc(self)
         except Exception as e:
@@ -155,8 +194,10 @@ class Controller:
                              errors.EFAILEDSOCKET)
 
     # timer callbacks ---------------------------------------------------
-    def _handle_timeout(self) -> None:
-        bthread_id.error(bthread_id.with_version(self._cid, self.current_try),
+    def _handle_timeout(self, ver: int) -> None:
+        # ver is bound at arm time by _schedule_try_timer — never read
+        # current_try here (a stale pop would shoot the live try).
+        bthread_id.error(bthread_id.with_version(self._cid, ver),
                          errors.ERPCTIMEDOUT)
 
     def _handle_backup_request(self) -> None:
@@ -167,16 +208,48 @@ class Controller:
     def _on_rpc_event(self, data, cid: int, error_code: int) -> None:
         """on_error callback: timeout, backup trigger, send failure, or
         remote response error all land here — the retry decision point."""
+        ver = bthread_id.get_version(cid)
+        if ver < self.current_try and error_code not in (
+                errors.EBACKUPREQUEST, errors.ECANCELED):
+            # A straggler: an older hedge try died *after* a newer try was
+            # issued (hedging keeps old versions lockable so their slow
+            # responses can still win — but their failures must not decide
+            # the call while the live try is in flight, nor blacklist the
+            # live try's server).
+            bthread_id.unlock(cid)
+            return
         if error_code == errors.EBACKUPREQUEST:
             # hedge: issue one more try; older versions stay valid so the
             # first response to arrive wins.
             if self.current_try < self.max_retry:
                 self.current_try += 1
                 self.retried_count += 1
+                # the deadline timer is version-bound; re-arm it at the
+                # new current version or the straggler guard would swallow
+                # the overall deadline after this hedge
+                self._schedule_try_timer()
                 self._issue_rpc()
             bthread_id.unlock(cid)
             return
         if error_code == errors.ERPCTIMEDOUT:
+            elapsed_ms = (time.monotonic_ns() // 1000
+                          - self._start_us) / 1000.0
+            remaining = (self.timeout_ms or 0) - elapsed_ms
+            if (self._timeout_hedging() and remaining > 1.0
+                    and self.current_try < self.max_retry):
+                # This try's share of the deadline elapsed with no reply:
+                # hedge a fresh try.  Old versions stay valid (no
+                # reset_version) so a merely-slow response still wins; the
+                # silent server is excluded so an LB steers elsewhere.
+                sel = getattr(self, "_selected_endpoint", None)
+                if sel is not None:
+                    self._excluded_servers.add(sel)
+                self.current_try += 1
+                self.retried_count += 1
+                self._schedule_try_timer()
+                self._issue_rpc()
+                bthread_id.unlock(cid)
+                return
             self.set_failed(errors.ERPCTIMEDOUT,
                             f"reached timeout={self.timeout_ms}ms")
             self._end_rpc(cid)
@@ -189,6 +262,7 @@ class Controller:
             self.current_try += 1
             self.retried_count += 1
             bthread_id.reset_version(self._cid, self.current_try)  # stale old tries
+            self._schedule_try_timer()
             self._issue_rpc()
             bthread_id.unlock(cid)
             return
@@ -206,6 +280,14 @@ class Controller:
         validated (stale tries never get here)."""
         rmeta = meta.response
         if rmeta.error_code != 0:
+            if bthread_id.get_version(cid) < self.current_try:
+                # Under hedging old versions stay lockable so a slow
+                # *success* can still win — but an abandoned try's error
+                # response must not decide the call or stale the live
+                # hedge (same rule as the straggler guard in
+                # _on_rpc_event).
+                bthread_id.unlock(cid)
+                return
             err = rmeta.error_code
             self.set_failed(err, rmeta.error_text)
             if self._retryable(err) and self.current_try < self.max_retry:
@@ -214,6 +296,7 @@ class Controller:
                 self.current_try += 1
                 self.retried_count += 1
                 bthread_id.reset_version(self._cid, self.current_try)
+                self._schedule_try_timer()
                 self._issue_rpc()
                 bthread_id.unlock(cid)
                 return

@@ -203,7 +203,11 @@ class Server:
         if isinstance(addr, int):
             ep = EndPoint(scheme=SCHEME_TCP, host="0.0.0.0", port=addr)
         elif isinstance(addr, str):
-            if ":" not in addr and not addr.startswith(("mem://", "ici://")):
+            # A port-less bare name is unambiguous on the LISTEN side (you
+            # can't listen on tcp without a port), so any such name — even
+            # dotted or all-digits ones parse_endpoint would reject as
+            # probable client-side typos — is an in-process registry.
+            if ":" not in addr and "://" not in addr:
                 addr = "mem://" + addr
             ep = parse_endpoint(addr)
         else:

@@ -249,7 +249,15 @@ class TestEndPoint:
 
     def test_bad(self):
         with pytest.raises(ValueError):
-            butil.parse_endpoint("nocolon")
+            butil.parse_endpoint("tcp://nocolon")
+        with pytest.raises(ValueError):
+            butil.parse_endpoint("")
+
+    def test_bare_name_is_mem(self):
+        # scheme-less, port-less tokens are loopback registry names so
+        # list://A,B naming can carry mem backends
+        ep = butil.parse_endpoint("backend-a")
+        assert ep.scheme == "mem" and ep.host == "backend-a"
 
 
 class TestFlags:

@@ -77,7 +77,8 @@ class TestFaultInjection:
         try:
             ch = rpc.Channel()
             ch.init(target, options=rpc.ChannelOptions(timeout_ms=300,
-                                                       max_retry=3))
+                                                       max_retry=3,
+                                                       retry_on_timeout=True))
             # drop exactly the first matched write, pass the rest
             state = {"dropped": False}
 
@@ -144,6 +145,109 @@ class TestFaultInjection:
         finally:
             server.stop()
 
+    def test_timeout_is_final_without_optin(self):
+        """Default semantics match the reference: a dropped request dies at
+        the overall deadline, no hedging (controller.cpp HandleTimeout)."""
+        server, svc, target = start("a")
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(timeout_ms=200,
+                                                       max_retry=3))
+            with fi.inject(fi.FaultInjector(drop_ratio=1.0)):
+                cntl = rpc.Controller()
+                t0 = time.monotonic()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="x"), EchoResponse)
+                dt = time.monotonic() - t0
+            assert cntl.failed()
+            assert cntl.error_code == errors.ERPCTIMEDOUT
+            assert dt >= 0.15          # waited the whole deadline, no split
+            assert cntl.retried_count == 0
+        finally:
+            server.stop()
+
+    def test_drop_recovered_end_to_end_single_server(self):
+        """Happy hedge path: try 0's request vanishes, the hedge try to the
+        same (only) server answers within the overall deadline."""
+        server, svc, target = start("a")
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(timeout_ms=600,
+                                                       max_retry=1,
+                                                       retry_on_timeout=True))
+            state = {"n": 0}
+
+            class DropFirst(fi.FaultInjector):
+                def decide(self, socket):
+                    if socket.is_server_side:
+                        return fi.PASS
+                    state["n"] += 1
+                    if state["n"] == 1:
+                        self.injected[fi.DROP] += 1
+                        return fi.DROP       # try 0 vanishes
+                    return fi.PASS           # hedge try passes
+
+            with fi.inject(DropFirst()):
+                cntl = rpc.Controller()
+                resp = ch.call_method("EchoService.Echo", cntl,
+                                      EchoRequest(message="s"), EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert resp.message == "a:s"
+        finally:
+            server.stop()
+
+    def test_straggler_error_does_not_fail_live_hedge(self):
+        """Drives the straggler guard in Controller._on_rpc_event directly:
+        after a timeout hedge advanced current_try, a late connection error
+        locked at the abandoned try's version must neither fail the call
+        nor blacklist the live try's server."""
+        from brpc_tpu.bthread import id as bthread_id
+        import time as _t
+        cntl = rpc.Controller()
+        cntl.timeout_ms = 1000
+        cntl.max_retry = 1
+        cntl.retry_on_timeout = True
+        cntl._start_us = _t.monotonic_ns() // 1000
+        cntl._cid = bthread_id.create_ranged(cntl, cntl._on_rpc_event, 2)
+        cntl.current_try = 1              # hedge already in flight
+        cntl._selected_endpoint = "live-server"
+        # straggler: try 0's connection dies after the hedge was issued
+        rc = bthread_id.error(
+            bthread_id.with_version(cntl._cid, 0), errors.ECONNRESET)
+        assert rc == 0                    # the event was delivered (ver 0
+        #                                   is still lockable under hedging)
+        assert not cntl.failed()          # ...but must not decide the call
+        assert not cntl._ended.is_set()
+        assert "live-server" not in cntl._excluded_servers
+        # a current-try error, by contrast, does end the call (retry budget
+        # exhausted)
+        rc = bthread_id.error(
+            bthread_id.with_version(cntl._cid, 1), errors.ECONNRESET)
+        assert rc == 0
+        assert cntl.failed() and cntl.error_code == errors.ECONNRESET
+        assert cntl._ended.is_set()
+
+    def test_backup_request_still_times_out_when_all_tries_blackholed(self):
+        """Regression: a backup hedge advances current_try; the overall
+        deadline timer (version-bound) must be re-armed at the new version
+        or the call never times out."""
+        server, svc, target = start("a")
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(
+                timeout_ms=400, max_retry=1, backup_request_ms=50))
+            with fi.inject(fi.FaultInjector(drop_ratio=1.0)):
+                cntl = rpc.Controller()
+                t0 = time.monotonic()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="x"), EchoResponse)
+                dt = time.monotonic() - t0
+            assert cntl.failed()
+            assert cntl.error_code == errors.ERPCTIMEDOUT
+            assert dt < 2.0, f"hung {dt:.1f}s instead of timing out at 400ms"
+        finally:
+            server.stop()
+
     def test_match_scopes_faults_to_one_backend(self):
         """Drops scoped to server A: an LB channel over A+B keeps
         succeeding via B (failover through retry + exclusion)."""
@@ -153,7 +257,8 @@ class TestFaultInjection:
             ch = rpc.Channel()
             ch.init(f"list://{ta.split('://')[1]},{tb.split('://')[1]}",
                     "rr", options=rpc.ChannelOptions(timeout_ms=300,
-                                                     max_retry=3))
+                                                     max_retry=3,
+                                                     retry_on_timeout=True))
             a_host = ta.split("://")[1]
 
             def match(socket):

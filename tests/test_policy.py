@@ -133,6 +133,19 @@ class TestNaming:
         assert eps == [parse_endpoint("10.0.0.1:80"),
                        parse_endpoint("10.0.0.2:81")]
 
+    def test_list_ns_ici_coords_and_mixed_schemes(self):
+        # commas inside mesh coords are not entry separators; spaces
+        # around them are squeezed; bare slugs are mem registries
+        ns = naming.create_naming_service(
+            "list://ici://(0, 1),ici://(0,2),backend-a,tcp://1.2.3.4:80")
+        eps = [e.endpoint for e in ns.get_servers()]
+        assert eps == [parse_endpoint("ici://(0,1)"),
+                       parse_endpoint("ici://(0,2)"),
+                       parse_endpoint("backend-a"),
+                       parse_endpoint("1.2.3.4:80")]
+        assert eps[0].coords == (0, 1)
+        assert eps[2].scheme == "mem"
+
     def test_file_ns_with_tags(self, tmp_path):
         p = tmp_path / "servers"
         p.write_text("10.0.0.1:80 100 0/2\n"
