@@ -39,7 +39,8 @@ class Block:
     :mod:`brpc_tpu.butil.block_pool` for device/pinned memory where it is
     load-bearing."""
 
-    __slots__ = ("kind", "data", "size", "meta", "deleter", "_lock")
+    __slots__ = ("kind", "data", "size", "meta", "deleter", "_lock",
+                 "on_send_complete")
 
     def __init__(self, kind: int, data: Any, meta: int = 0,
                  deleter: Optional[Callable[[Any], None]] = None):
@@ -49,6 +50,11 @@ class Block:
         self.meta = meta
         self.deleter = deleter
         self._lock = threading.Lock() if kind == HOST else None
+        # DEVICE blocks: invoked by the transport once an outbound ICI
+        # transfer sourced from this block completed — the earliest point
+        # the block may be reused/donated (rdma_endpoint.cpp:926 frees
+        # _sbuf refs on CQ completion; block_pool release hooks in here)
+        self.on_send_complete: Optional[Callable[[], None]] = None
 
     @property
     def cap(self) -> int:

@@ -1,0 +1,534 @@
+"""Multi-controller ici://: cross-process handshake + device data plane.
+
+Reference analogue (SURVEY.md §3.5, src/brpc/rdma/rdma_endpoint.h:37-108):
+RdmaEndpoint forms a connection with an out-of-band TCP handshake that
+exchanges GID/QPN, then moves payloads over verbs with an explicit-ACK
+window, freeing send buffers only on CQ completion.  The TPU translation:
+
+  * **Out-of-band channel** — the JAX coordination service
+    (jax.distributed): each process publishes its fabric contact info
+    (control TCP address, transfer-server address, owned device ids) under
+    a well-known KV key; peers resolve it with a blocking get.  This is
+    the GID/QPN exchange.
+  * **Control plane** — a plain TCP connection per socket pair carries
+    protocol bytes (frames, meta — small) plus the window bookkeeping
+    (CREDIT) and transfer completions (PULLED — the CQ-completion
+    analogue).
+  * **Data plane** — DEVICE payloads never ride the control TCP: the
+    sender stages arrays on its jax.experimental.transfer server under a
+    uuid (``await_pull``) and ships only a descriptor; the receiver pulls
+    straight into its local device memory (on TPU pods this is a
+    DMA-style fetch, the RDMA-READ model).  Source blocks stay pinned
+    until the peer's PULLED ack — the rdma_endpoint.cpp:926 discipline.
+  * **Flow control** — same credit window as the in-process IciSocket
+    (rdma_endpoint.cpp:771): at most ``ici_socket_window_bytes``
+    unconsumed bytes per socket; CREDIT frames replenish on consume.
+
+Addressing: ``ici://k`` is position k in the GLOBAL jax.devices() list
+(identical in every process); ownership is ``devices[k].process_index``.
+``connect_any(ep)`` routes in-process targets through the zero-copy
+IciSocket and remote ones through a FabricSocket transparently, so
+Server/Channel code is identical single- or multi-controller.
+"""
+from __future__ import annotations
+
+import json
+import socket as _pysocket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..butil import logging as log
+from ..butil.iobuf import IOBuf, IOPortal, DEVICE
+from ..rpc import errors
+from ..rpc.socket import Socket
+from .transport import CreditWindow, OrderedDelivery
+
+_KV_PREFIX = "brpc_tpu/fabric/"
+
+# control-channel frame types
+_F_HELLO = 1       # json: {target_dev, client_dev, pid}
+_F_HELLO_OK = 2
+_F_HELLO_ERR = 3
+_F_DATA = 4        # chunk list: host bytes + device descriptors
+_F_CREDIT = 5      # u64 consumed bytes
+_F_PULLED = 6      # u64 uuid — receiver finished pulling (CQ completion)
+_F_FIN = 7
+
+_HDR = struct.Struct("<BI")          # type, body length
+
+
+def _send_frame(sock: _pysocket.socket, ftype: int, body: bytes) -> None:
+    sock.sendall(_HDR.pack(ftype, len(body)) + body)
+
+
+def _recv_exact(sock: _pysocket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(sock: _pysocket.socket) -> Optional[Tuple[int, bytes]]:
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    ftype, length = _HDR.unpack(hdr)
+    body = _recv_exact(sock, length) if length else b""
+    if length and body is None:
+        return None
+    return ftype, body
+
+
+class FabricNode:
+    """Per-process fabric runtime: transfer server + control listener +
+    the coordination-service registry."""
+
+    _instance: Optional["FabricNode"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.process_id = -1
+        self.num_processes = 0
+        self._kv = None
+        self._xfer_server = None
+        self._xfer_conns: Dict[int, object] = {}      # pid -> TransferConnection
+        self._xfer_lock = threading.Lock()
+        self._ctrl_listener: Optional[_pysocket.socket] = None
+        self.ctrl_addr = ""
+        self._uuid_lock = threading.Lock()
+        self._next_uuid = 1
+        self._peers: Dict[int, dict] = {}             # pid -> contact info
+        self._accept_thread: Optional[threading.Thread] = None
+        self._shutdown = False
+
+    # ---- lifecycle -----------------------------------------------------
+    @classmethod
+    def instance(cls) -> Optional["FabricNode"]:
+        with cls._lock:
+            return cls._instance
+
+    @classmethod
+    def initialize(cls, coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   host_ip: str = "127.0.0.1") -> "FabricNode":
+        """Join the fabric.  Calls jax.distributed.initialize when the
+        coordination service isn't up yet (the reference's equivalent is
+        whatever launched the processes); then performs the handshake
+        publication.  Idempotent per process."""
+        with cls._lock:
+            if cls._instance is not None:
+                return cls._instance
+            node = FabricNode()
+            node._start(coordinator_address, num_processes, process_id,
+                        host_ip)
+            cls._instance = node
+            return node
+
+    def _start(self, coordinator_address, num_processes, process_id,
+               host_ip) -> None:
+        import jax
+        from jax._src import distributed
+        if distributed.global_state.client is None:
+            jax.distributed.initialize(coordinator_address,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+        self._kv = distributed.global_state.client
+        self.process_id = distributed.global_state.process_id
+        self.num_processes = distributed.global_state.num_processes
+        # data plane: transfer server (explicit TCP transport addresses —
+        # the same-host "local" bulk transport is not usable in sandboxed
+        # containers, and TCP is the portable choice; on real pods the
+        # premapped DMA path takes over)
+        from jax.experimental import transfer
+        backend = jax.local_devices()[0].client
+        self._xfer_server = transfer.start_transfer_server(
+            backend, f"{host_ip}:0", [f"{host_ip}:0"])
+        # control plane listener
+        self._ctrl_listener = _pysocket.socket()
+        self._ctrl_listener.setsockopt(_pysocket.SOL_SOCKET,
+                                       _pysocket.SO_REUSEADDR, 1)
+        self._ctrl_listener.bind((host_ip, 0))
+        self._ctrl_listener.listen(64)
+        self.ctrl_addr = "%s:%d" % self._ctrl_listener.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fabric_accept", daemon=True)
+        self._accept_thread.start()
+        # the handshake publication (GID/QPN analogue)
+        info = {
+            "ctrl": self.ctrl_addr,
+            "xfer": self._xfer_server.address(),
+            "devices": [i for i, d in enumerate(jax.devices())
+                        if d.process_index == self.process_id],
+        }
+        self._kv.key_value_set(_KV_PREFIX + str(self.process_id),
+                               json.dumps(info))
+        log.info("fabric: process %d/%d up ctrl=%s xfer=%s devices=%s",
+                 self.process_id, self.num_processes, info["ctrl"],
+                 info["xfer"], info["devices"])
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            if self._ctrl_listener is not None:
+                self._ctrl_listener.close()
+        except Exception:
+            pass
+
+    # ---- registry ------------------------------------------------------
+    def peer_info(self, pid: int, timeout_ms: int = 60000) -> dict:
+        info = self._peers.get(pid)
+        if info is None:
+            raw = self._kv.blocking_key_value_get(_KV_PREFIX + str(pid),
+                                                  timeout_ms)
+            info = json.loads(raw)
+            self._peers[pid] = info
+        return info
+
+    @staticmethod
+    def device_owner(device_id: int) -> int:
+        import jax
+        return jax.devices()[device_id].process_index
+
+    def xfer_connection(self, pid: int):
+        with self._xfer_lock:
+            conn = self._xfer_conns.get(pid)
+            if conn is None:
+                conn = self._xfer_server.connect(self.peer_info(pid)["xfer"])
+                self._xfer_conns[pid] = conn
+            return conn
+
+    def next_uuid(self) -> int:
+        with self._uuid_lock:
+            u = (self.process_id + 1) << 40 | self._next_uuid
+            self._next_uuid += 1
+            return u
+
+    def stage(self, uuid: int, arrays: List) -> None:
+        self._xfer_server.await_pull(uuid, arrays)
+
+    # ---- server side ---------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn, _ = self._ctrl_listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handshake_server, args=(conn,),
+                             name="fabric_handshake", daemon=True).start()
+
+    def _handshake_server(self, conn: _pysocket.socket) -> None:
+        try:
+            conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+            fr = _recv_frame(conn)
+            if fr is None or fr[0] != _F_HELLO:
+                conn.close()
+                return
+            hello = json.loads(fr[1])
+            target = hello["target_dev"]
+            from .transport import _listeners, _listeners_lock
+            with _listeners_lock:
+                listener = _listeners.get(target)
+            if listener is None:
+                _send_frame(conn, _F_HELLO_ERR,
+                            f"no server at ici://{target}".encode())
+                conn.close()
+                return
+            sock = FabricSocket(conn, local_dev=target,
+                                remote_dev=hello["client_dev"],
+                                peer_pid=hello["pid"], node=self)
+            sock.is_server_side = True
+            # on_accept attaches the messenger BEFORE any frame can be
+            # read — a reader that fires first would drain the input
+            # event with no messenger and drop the first request
+            listener.on_accept(sock)
+            _send_frame(conn, _F_HELLO_OK, b"")
+            sock.start_io()
+        except Exception as e:
+            log.error("fabric handshake failed: %s", e)
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    # ---- client side ---------------------------------------------------
+    def connect(self, target_dev: int, client_dev: int) -> "FabricSocket":
+        owner = self.device_owner(target_dev)
+        info = self.peer_info(owner)
+        host, _, port = info["ctrl"].rpartition(":")
+        conn = _pysocket.create_connection((host, int(port)), timeout=30)
+        conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+        _send_frame(conn, _F_HELLO, json.dumps({
+            "target_dev": target_dev, "client_dev": client_dev,
+            "pid": self.process_id}).encode())
+        fr = _recv_frame(conn)
+        if fr is None or fr[0] != _F_HELLO_OK:
+            msg = fr[1].decode() if fr else "connection closed"
+            conn.close()
+            raise ConnectionRefusedError(f"fabric: {msg}")
+        sock = FabricSocket(conn, local_dev=client_dev,
+                            remote_dev=target_dev, peer_pid=owner, node=self)
+        sock.start_io()
+        return sock
+
+
+class FabricSocket(CreditWindow, OrderedDelivery, Socket):
+    """Cross-process ici socket: control TCP + transfer-server pulls,
+    with the same credit window as the in-process IciSocket."""
+
+    def __init__(self, conn: _pysocket.socket, local_dev: int,
+                 remote_dev: int, peer_pid: int, node: FabricNode,
+                 window_bytes: Optional[int] = None):
+        from .mesh import IciMesh
+        mesh = IciMesh.default()
+        super().__init__(remote_side=mesh.endpoint(remote_dev))
+        self.local_side = mesh.endpoint(local_dev)
+        self.local_dev = local_dev
+        self.remote_dev = remote_dev
+        self.peer_pid = peer_pid
+        self.node = node
+        self._conn = conn
+        self._conn_wlock = threading.Lock()
+        self._inbox = IOBuf()
+        self._inbox_lock = threading.Lock()
+        self._peer_closed = False
+        self._init_window(window_bytes)
+        self._init_delivery()
+        self._staged: Dict[int, Tuple] = {}    # uuid -> (src_block, array)
+        self._staged_lock = threading.Lock()
+        self._reader: Optional[threading.Thread] = None
+
+    def start_io(self) -> None:
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="fabric_read", daemon=True)
+        self._reader.start()
+
+    def inflight_send_blocks(self) -> int:
+        with self._staged_lock:
+            return len(self._staged)
+
+    def _peer_gone(self) -> bool:
+        return self._peer_closed
+
+    # ---- write path ----------------------------------------------------
+    def _do_write(self, data: IOBuf) -> int:
+        n = self._consume_window(len(data))
+        if n < 0:
+            return -1
+        frame = data.cut(n)
+        body = self._encode_data(frame)
+        try:
+            with self._conn_wlock:
+                _send_frame(self._conn, _F_DATA, body)
+        except OSError as e:
+            raise ConnectionError(f"fabric control channel: {e}")
+        return n
+
+    def _encode_data(self, frame: IOBuf) -> bytes:
+        """Serialize a frame: host refs inline, DEVICE refs staged on the
+        transfer server and shipped as (uuid, dtype, shape, length)."""
+        out = [b""]
+        nchunks = 0
+        pending_host: List[bytes] = []
+
+        def flush_host():
+            nonlocal nchunks
+            if pending_host:
+                blob = b"".join(pending_host)
+                out.append(struct.pack("<BI", 0, len(blob)))
+                out.append(blob)
+                pending_host.clear()
+                nchunks += 1
+
+        for i in range(frame.backing_block_num()):
+            r = frame.backing_block(i)
+            if r.block.kind == DEVICE:
+                flush_host()
+                arr = r.block.data
+                if r.offset or r.length != len(arr):
+                    arr = arr[r.offset:r.offset + r.length]
+                uuid = self.node.next_uuid()
+                self.node.stage(uuid, [arr])
+                with self._staged_lock:
+                    self._staged[uuid] = (r.block, arr)
+                dt = str(arr.dtype).encode()
+                shape = arr.shape
+                out.append(struct.pack("<BQH", 1, uuid, len(dt)))
+                out.append(dt)
+                out.append(struct.pack("<B", len(shape)))
+                out.append(struct.pack("<%dQ" % len(shape), *shape)
+                           if shape else b"")
+                out.append(struct.pack("<Q", r.length))
+                nchunks += 1
+            else:
+                pending_host.append(
+                    bytes(r.block.host_view(r.offset, r.length)))
+        flush_host()
+        out[0] = struct.pack("<I", nchunks)
+        return b"".join(out)
+
+    # ---- read path -----------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            while not self.failed:
+                fr = _recv_frame(self._conn)
+                if fr is None:
+                    break
+                ftype, body = fr
+                if ftype == _F_DATA:
+                    self._on_data(body)
+                elif ftype == _F_CREDIT:
+                    self._on_credits(struct.unpack("<Q", body)[0])
+                elif ftype == _F_PULLED:
+                    self._on_pulled(struct.unpack("<Q", body)[0])
+                elif ftype == _F_FIN:
+                    break
+        except OSError:
+            pass
+        except Exception as e:
+            # a malformed frame or failed pull must not strand the socket
+            # with a silently-dead reader — surface it as a failure
+            log.error("fabric read loop died on %s: %s", self.remote_side, e)
+        # connection over: wake readers (EOF), writers (window), and
+        # release every pinned send block — their transfers will never be
+        # acknowledged now (the reference completes _sbuf refs with an
+        # error on QP teardown)
+        with self._inbox_lock:
+            self._peer_closed = True
+        self.start_input_event()
+        self._wake_window()
+        self._flush_staged()
+
+    def _flush_staged(self) -> None:
+        with self._staged_lock:
+            staged, self._staged = self._staged, {}
+        for blk, _arr in staged.values():
+            cb = getattr(blk, "on_send_complete", None)
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+    def _on_data(self, body: bytes) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import SingleDeviceSharding
+        (nchunks,) = struct.unpack_from("<I", body, 0)
+        off = 4
+        buf = IOBuf()
+        pulled_uuids: List[int] = []
+        device_arrays: List = []
+        local_device = jax.devices()[self.local_dev]
+        for _ in range(nchunks):
+            kind, = struct.unpack_from("<B", body, off)
+            off += 1
+            if kind == 0:
+                (blen,) = struct.unpack_from("<I", body, off)
+                off += 4
+                buf.append(body[off:off + blen])
+                off += blen
+            else:
+                uuid, dtlen = struct.unpack_from("<QH", body, off)
+                off += 10
+                dt = body[off:off + dtlen].decode()
+                off += dtlen
+                (ndim,) = struct.unpack_from("<B", body, off)
+                off += 1
+                shape = struct.unpack_from("<%dQ" % ndim, body, off) \
+                    if ndim else ()
+                off += 8 * ndim
+                (length,) = struct.unpack_from("<Q", body, off)
+                off += 8
+                sds = jax.ShapeDtypeStruct(
+                    shape, jnp.dtype(dt),
+                    sharding=SingleDeviceSharding(local_device))
+                arr = self.node.xfer_connection(self.peer_pid).pull(
+                    uuid, [sds])[0]
+                buf.append_device_array(arr)
+                device_arrays.append(arr)
+                pulled_uuids.append(uuid)
+
+        def commit():
+            # the PULLED ack (CQ completion): data is resident locally,
+            # sender may reuse its source blocks
+            for u in pulled_uuids:
+                try:
+                    with self._conn_wlock:
+                        _send_frame(self._conn, _F_PULLED,
+                                    struct.pack("<Q", u))
+                except OSError:
+                    pass
+            with self._inbox_lock:
+                self._inbox.append(buf)
+            self.start_input_event()
+
+        # ordered per-socket commit — a host-only frame must not jump
+        # ahead of an earlier device-bearing frame still in flight
+        self._enqueue_delivery(device_arrays, commit)
+
+    def _on_pulled(self, uuid: int) -> None:
+        with self._staged_lock:
+            entry = self._staged.pop(uuid, None)
+        if entry is not None:
+            blk = entry[0]
+            cb = getattr(blk, "on_send_complete", None)
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+    def _do_read(self, portal: IOPortal, max_count: int) -> int:
+        with self._inbox_lock:
+            avail = len(self._inbox)
+            if avail == 0:
+                return 0 if self._peer_closed else -1
+            n = min(avail, max_count)
+            self._inbox.cutn(portal, n)
+        try:
+            with self._conn_wlock:
+                _send_frame(self._conn, _F_CREDIT, struct.pack("<Q", n))
+        except OSError:
+            pass
+        return n
+
+    def _transport_close(self) -> None:
+        try:
+            with self._conn_wlock:
+                _send_frame(self._conn, _F_FIN, b"")
+        except OSError:
+            pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+        self._wake_window()
+        self._flush_staged()
+
+
+def connect_any(ep, local_dev: Optional[int] = None):
+    """Route an ici:// connect: in-process targets use the zero-copy
+    IciSocket path; remote ones the fabric.  This is what makes
+    Channel("ici://k") work identically single- and multi-controller."""
+    from .transport import ici_connect
+    node = FabricNode.instance()
+    target = ep.device_id
+    if node is None:
+        return ici_connect(ep, local_dev)
+    if local_dev is None:
+        # default client residence must be a device THIS process owns —
+        # ici_connect's neighbor default can land on another controller's
+        # device, which this process cannot address
+        import jax
+        me = node.process_id
+        owned = [i for i, d in enumerate(jax.devices())
+                 if d.process_index == me]
+        local_dev = next((i for i in owned if i != target), owned[0])
+    if FabricNode.device_owner(target) == node.process_id:
+        return ici_connect(ep, local_dev)
+    return node.connect(target, local_dev)

@@ -30,10 +30,13 @@ through point-to-point sockets — see collective.py.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+import time as _time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..butil.endpoint import EndPoint, SCHEME_ICI
+from ..butil import flags as _flags
 from ..butil.iobuf import IOBuf, IOPortal, DEVICE
+from ..bthread.butex import Butex
 from ..bthread.device_waiter import DeviceEventDispatcher
 from ..rpc import errors
 from ..rpc.socket import Socket
@@ -43,23 +46,147 @@ _ici_stats_lock = threading.Lock()
 _ici_bytes_moved = 0
 _ici_device_bytes_moved = 0
 
+# Transport-level sliding window (reference: the RDMA explicit-ACK window,
+# rdma_endpoint.cpp:771 CutFromIOBufList checks _window_size before posting;
+# credits return piggybacked on completions).  A writer may have at most
+# this many un-CONSUMED bytes at the peer; beyond it _do_write reports
+# not-writable and the KeepWrite tasklet blocks until the reader drains.
+# This bounds the peer inbox (a slow reader exerts backpressure instead of
+# growing memory) — the flow-control VERDICT.md item #3.
+_flags.define_flag("ici_socket_window_bytes", 4 * 1024 * 1024,
+                   "per-ici-socket send window (unconsumed bytes at peer)",
+                   _flags.positive_integer)
+
 
 def ici_transport_stats() -> Tuple[int, int]:
     with _ici_stats_lock:
         return _ici_bytes_moved, _ici_device_bytes_moved
 
 
-class _Delivery:
-    """One ordered unit: host bytes interleaved with relocated device refs."""
-    __slots__ = ("chunks",)
+class CreditWindow:
+    """Mixin: explicit-ACK sliding window shared by the in-process
+    IciSocket and the multi-controller FabricSocket (reference
+    rdma_endpoint.cpp:771 window check; credits return on consume).
 
-    def __init__(self, chunks: List):
-        self.chunks = chunks        # list of bytes | (jax.Array, length)
+    Contract for the host class (a Socket subclass): call
+    ``_init_window(window_bytes)`` in __init__, gate each ``_do_write``
+    through ``_consume_window(len)``, and call ``_on_credits(n)`` when the
+    peer reports n consumed bytes.  A writer stalled past the
+    ``_wait_writable`` timeout FAILS the socket — pending writes complete
+    with an error instead of silently wedging forever."""
+
+    def _init_window(self, window_bytes: Optional[int]) -> None:
+        self.window_bytes = (window_bytes if window_bytes is not None
+                             else _flags.get_flag("ici_socket_window_bytes"))
+        self._send_window = self.window_bytes
+        self._window_lock = threading.Lock()
+        self._window_gen = Butex(0)       # bumped whenever credits return
+
+    def send_window_left(self) -> int:
+        with self._window_lock:
+            return self._send_window
+
+    def unacked_send_bytes(self) -> int:
+        """Bytes written but not yet consumed by the peer (≤ window)."""
+        with self._window_lock:
+            return self.window_bytes - self._send_window
+
+    def _consume_window(self, want: int) -> int:
+        """Take up to ``want`` bytes of window; -1 when the window is
+        closed (transport not writable)."""
+        with self._window_lock:
+            if self._send_window <= 0:
+                return -1
+            n = min(want, self._send_window)
+            self._send_window -= n
+            return n
+
+    def _on_credits(self, n: int) -> None:
+        """Peer consumed n bytes: replenish the window, wake blocked
+        writers (the piggybacked-ACK path of rdma_endpoint.cpp)."""
+        with self._window_lock:
+            self._send_window = min(self.window_bytes, self._send_window + n)
+        self._wake_window()
+
+    def _wake_window(self) -> None:
+        self._window_gen.fetch_add(1)
+        self._window_gen.wake_all()
+
+    def _peer_gone(self) -> bool:
+        """Transport-specific: the far side can no longer return credits."""
+        return False
+
+    def _wait_writable(self, timeout: float = 30.0) -> bool:
+        deadline = _time.monotonic() + timeout
+        while not self.failed:
+            gen = self._window_gen.value
+            with self._window_lock:
+                if self._send_window > 0:
+                    return True
+            if self._peer_gone():
+                self.set_failed(errors.EFAILEDSOCKET,
+                                "ici peer closed while window full")
+                return False
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                # a stalled window must not black-hole the socket: fail it
+                # so queued writes complete with an error and callers see
+                # EFAILEDSOCKET rather than waiting forever
+                self.set_failed(
+                    errors.EFAILEDSOCKET,
+                    f"ici send window stalled >{timeout:.0f}s "
+                    f"(peer not consuming)")
+                return False
+            self._window_gen.wait(gen, min(left, 0.5))
+        return False
 
 
-class IciSocket(Socket):
+class OrderedDelivery:
+    """Mixin: per-socket in-order commit of received frames whose device
+    payloads become ready asynchronously.  A host-only frame arriving
+    after a device-bearing one must not jump the queue (byte-stream
+    ordering is the transport contract the parsers rely on)."""
+
+    def _init_delivery(self) -> None:
+        import collections
+        self._dq = collections.deque()    # entries: [ready, commit_fn]
+        self._dq_lock = threading.Lock()
+        self._dq_draining = False
+
+    def _enqueue_delivery(self, device_arrays: List,
+                          commit_fn: Callable[[], None]) -> None:
+        entry = [False, commit_fn]
+        with self._dq_lock:
+            self._dq.append(entry)
+
+        def mark():
+            entry[0] = True
+            self._drain_deliveries()
+
+        if device_arrays and not _all_ready(device_arrays):
+            DeviceEventDispatcher.instance().on_ready(device_arrays, mark)
+        else:
+            mark()
+
+    def _drain_deliveries(self) -> None:
+        while True:
+            with self._dq_lock:
+                if (self._dq_draining or not self._dq
+                        or not self._dq[0][0]):
+                    return
+                self._dq_draining = True
+                fn = self._dq.popleft()[1]
+            try:
+                fn()
+            finally:
+                with self._dq_lock:
+                    self._dq_draining = False
+
+
+class IciSocket(CreditWindow, OrderedDelivery, Socket):
     def __init__(self, local_dev: int, remote_dev: int,
-                 mesh: Optional[IciMesh] = None):
+                 mesh: Optional[IciMesh] = None,
+                 window_bytes: Optional[int] = None):
         self.mesh = mesh or IciMesh.default()
         super().__init__(remote_side=self.mesh.endpoint(remote_dev))
         self.local_dev = local_dev
@@ -69,13 +196,29 @@ class IciSocket(Socket):
         self._inbox = IOBuf()
         self._inbox_lock = threading.Lock()
         self._peer_closed = False
+        self._init_window(window_bytes)
+        self._init_delivery()
+        # source device blocks pinned until their ICI transfer completed
+        # (reference frees _sbuf refs only on CQ completion,
+        # rdma_endpoint.cpp:926 HandleCompletion) — load-bearing once
+        # buffer donation reuses send blocks
+        self._inflight_sends: Dict[int, Tuple] = {}
+        self._inflight_seq = 0
+        self._inflight_lock = threading.Lock()
+
+    def inflight_send_blocks(self) -> int:
+        """Device source blocks pinned awaiting transfer completion."""
+        with self._inflight_lock:
+            return len(self._inflight_sends)
 
     # -- transport hooks -------------------------------------------------
     def _do_write(self, data: IOBuf) -> int:
         peer = self.peer
         if peer is None or peer.failed:
             raise ConnectionError("ici peer closed")
-        n = len(data)
+        n = self._consume_window(len(data))
+        if n < 0:
+            return -1                     # window full: not writable now
         frame = data.cut(n)
         chunks = self._relocate(frame)
         self._deliver(peer, chunks)
@@ -107,7 +250,11 @@ class IciSocket(Socket):
                     resident = False
                 # already in the target chip's HBM: pure ref pass — the
                 # zero-copy case the block_pool discipline exists for
-                moved = arr if resident else jax.device_put(arr, target)
+                if resident:
+                    moved = arr
+                else:
+                    moved = jax.device_put(arr, target)
+                    self._pin_until_sent(r.block, moved)
                 chunks.append((moved, r.length))
                 with _ici_stats_lock:
                     _ici_device_bytes_moved += r.length
@@ -120,7 +267,7 @@ class IciSocket(Socket):
     def _deliver(self, peer: "IciSocket", chunks: List) -> None:
         device_arrays = [c[0] for c in chunks if isinstance(c, tuple)]
 
-        def commit(inline: bool) -> None:
+        def commit() -> None:
             buf = IOBuf()
             for c in chunks:
                 if isinstance(c, tuple):
@@ -131,14 +278,36 @@ class IciSocket(Socket):
                 peer._inbox.append(buf)
             ok_inline = (not peer.is_server_side
                          or getattr(peer, "usercode_inline", False))
-            peer.start_input_event(inline=inline and ok_inline)
+            peer.start_input_event(inline=ok_inline)
 
-        if device_arrays and not _all_ready(device_arrays):
-            # read event only after the payload landed in peer HBM
-            DeviceEventDispatcher.instance().on_ready(
-                device_arrays, lambda: commit(True))
-        else:
-            commit(True)
+        # ordered per-socket commit: the read event fires only after the
+        # payload landed in peer HBM, and never out of arrival order
+        peer._enqueue_delivery(device_arrays, commit)
+
+    def _pin_until_sent(self, src_block, moved) -> None:
+        """Hold the SOURCE device block (and the moved array) until the
+        ICI transfer completes; only then may the source block be reused /
+        donated.  Mirrors the reference's completion-driven `_sbuf` free
+        (rdma_endpoint.cpp:926): the completion source here is the device
+        stream, observed through the per-device poller."""
+        with self._inflight_lock:
+            seq = self._inflight_seq
+            self._inflight_seq += 1
+            self._inflight_sends[seq] = (src_block, moved)
+
+        def _done(seq=seq):
+            with self._inflight_lock:
+                entry = self._inflight_sends.pop(seq, None)
+            if entry is not None:
+                blk = entry[0]
+                cb = getattr(blk, "on_send_complete", None)
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:
+                        pass
+
+        DeviceEventDispatcher.instance().on_ready([moved], _done)
 
     def _do_read(self, portal: IOPortal, max_count: int) -> int:
         with self._inbox_lock:
@@ -147,7 +316,17 @@ class IciSocket(Socket):
                 return 0 if self._peer_closed else -1
             n = min(avail, max_count)
             self._inbox.cutn(portal, n)
-            return n
+        # consumed-bytes feedback: replenish the writer's window (in
+        # multi-controller mode this rides the control channel as an ACK
+        # frame — see fabric.py)
+        peer = self.peer
+        if peer is not None and not peer.failed:
+            peer._on_credits(n)
+        return n
+
+    def _peer_gone(self) -> bool:
+        peer = self.peer
+        return peer is None or peer.failed or self._peer_closed
 
     def _transport_close(self) -> None:
         peer = self.peer
@@ -155,6 +334,11 @@ class IciSocket(Socket):
             with peer._inbox_lock:
                 peer._peer_closed = True
             peer.start_input_event()
+            # wake the peer's blocked writers so they observe _peer_gone
+            # instead of stalling out their full timeout
+            peer._wake_window()
+        # release our own writers blocked on the (now dead) window
+        self._wake_window()
 
 
 def _all_ready(arrays) -> bool:

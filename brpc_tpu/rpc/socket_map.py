@@ -101,8 +101,10 @@ class SocketMap:
             from .tcp_transport import tcp_connect
             return tcp_connect(ep, ssl_context=ssl_context)
         if ep.scheme == SCHEME_ICI:
-            from ..ici.transport import ici_connect
-            return ici_connect(ep)
+            # routes in-process targets through the zero-copy IciSocket,
+            # remote (other-controller) ones through the fabric
+            from ..ici.fabric import connect_any
+            return connect_any(ep)
         raise ValueError(f"unsupported scheme {ep.scheme}")
 
     def remove(self, ep: EndPoint, group: Any = "") -> None:

@@ -90,6 +90,138 @@ class TestIciTransport:
         assert before_dev >= 4096
 
 
+class TestIciWindow:
+    """Transport-level sliding window (VERDICT #3; reference
+    rdma_endpoint.cpp:771 window check, :926 completion-driven free)."""
+
+    def _pair(self, mesh, window):
+        from brpc_tpu.ici.transport import IciSocket
+        a = IciSocket(0, 0, mesh, window_bytes=window)
+        b = IciSocket(0, 0, mesh, window_bytes=window)
+        a.peer, b.peer = b, a
+        return a, b
+
+    def test_slow_reader_bounds_memory_and_stalls_writer(self, mesh):
+        from brpc_tpu.butil.iobuf import IOBuf, IOPortal
+        win = 8 * 1024
+        a, b = self._pair(mesh, win)
+        chunk = 4 * 1024
+        total = 10 * chunk
+        done_codes = []
+        for _ in range(total // chunk):
+            rc = a.write(IOBuf(b"x" * chunk),
+                         on_done=lambda ec: done_codes.append(ec))
+            assert rc == 0
+        # nobody reads: the peer inbox must stay bounded by the window
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline and len(b._inbox) < win:
+            time.sleep(0.01)
+        assert len(b._inbox) <= win
+        assert a.send_window_left() == 0
+        stalled_unacked = a.unacked_send_bytes()
+        assert stalled_unacked == win
+        # reader drains: writer must resume and deliver everything
+        portal = IOPortal()
+        got = 0
+        deadline = time.monotonic() + 10
+        while got < total and time.monotonic() < deadline:
+            n = b._do_read(portal, 1 << 20)
+            if n <= 0:
+                time.sleep(0.005)
+                continue
+            got += n
+        assert got == total, f"delivered {got}/{total}"
+        # all writes completed OK once the window reopened
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(done_codes) < total // chunk:
+            time.sleep(0.01)
+        assert done_codes == [0] * (total // chunk)
+        a.set_failed()
+        b.set_failed()
+
+    def test_window_replenishes_exactly_consumed_bytes(self, mesh):
+        from brpc_tpu.butil.iobuf import IOBuf, IOPortal
+        win = 4096
+        a, b = self._pair(mesh, win)
+        assert a.write(IOBuf(b"y" * 3000)) == 0
+        assert a.send_window_left() == win - 3000
+        portal = IOPortal()
+        n = b._do_read(portal, 1000)
+        assert n == 1000
+        assert a.send_window_left() == win - 2000
+        assert b._do_read(portal, 1 << 20) == 2000
+        assert a.send_window_left() == win
+        a.set_failed()
+        b.set_failed()
+
+    def test_device_blocks_pinned_until_transfer_complete(self, mesh):
+        """A cross-device write pins the SOURCE block until the moved
+        array is ready (completion-driven reuse, rdma_endpoint.cpp:926)."""
+        import jax
+        import jax.numpy as jnp
+        from brpc_tpu.butil.iobuf import IOBuf, IOPortal
+        if mesh.size < 2:
+            pytest.skip("needs 2 devices")
+        from brpc_tpu.ici.transport import IciSocket
+        a = IciSocket(0, 1, mesh, window_bytes=1 << 20)
+        b = IciSocket(1, 0, mesh, window_bytes=1 << 20)
+        a.peer, b.peer = b, a
+        freed = []
+        arr = jax.device_put(jnp.arange(1024, dtype=jnp.uint8),
+                             mesh.device(0))
+        jax.block_until_ready(arr)
+        buf = IOBuf()
+        buf.append_device_array(arr)
+        ref_block = buf.backing_block(0).block
+        ref_block.on_send_complete = lambda: freed.append(1)
+        assert a.write(buf) == 0
+        portal = IOPortal()
+        deadline = time.monotonic() + 5
+        got = 0
+        while got < 1024 and time.monotonic() < deadline:
+            n = b._do_read(portal, 1 << 20)
+            got += max(0, n)
+            if n <= 0:
+                time.sleep(0.005)
+        assert got == 1024
+        deadline = time.monotonic() + 5
+        while not freed and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert freed, "source block completion hook never fired"
+        assert a.inflight_send_blocks() == 0
+        a.set_failed()
+        b.set_failed()
+
+
+class TestOrderedDelivery:
+    def test_host_frame_cannot_jump_pending_device_frame(self, monkeypatch):
+        """Byte-stream ordering: a host-only frame arriving after a
+        device-bearing frame whose transfer is still in flight must wait
+        for it (the parsers rely on transport ordering)."""
+        from brpc_tpu.ici import transport as T
+
+        class Host(T.OrderedDelivery):
+            def __init__(self):
+                self._init_delivery()
+
+        h = Host()
+        order = []
+        pending = []
+
+        class FakeDisp:
+            def on_ready(self, arrays, cb):
+                pending.append(cb)
+
+        monkeypatch.setattr(T, "_all_ready", lambda arrays: False)
+        monkeypatch.setattr(T.DeviceEventDispatcher, "instance",
+                            classmethod(lambda cls: FakeDisp()))
+        h._enqueue_delivery([object()], lambda: order.append(1))
+        h._enqueue_delivery([], lambda: order.append(2))
+        assert order == []          # 2 must not jump ahead of pending 1
+        pending[0]()                # device payload lands
+        assert order == [1, 2]
+
+
 class TestCollectives:
     def test_all_reduce(self, mesh):
         import jax.numpy as jnp
