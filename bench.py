@@ -238,6 +238,98 @@ def bench_qps(seconds: float = 2.0, concurrency: int = 32):
     return {"qps": count[0] / dt, "concurrency": concurrency}
 
 
+def bench_tail_isolation(seconds: float = 2.0, concurrency: int = 16,
+                         tail_ratio: float = 0.01, tail_ms: float = 5.0):
+    """The reference's signature experiment (docs/cn/benchmark.md:126-140):
+    inject a long tail into 1% of handlers and check the OTHER 99% barely
+    move — per-request tasklets + work stealing must isolate them.  Returns
+    p99 of normal requests with and without the tail."""
+    import threading
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc
+    sys.path.insert(0, "tests")
+    from tests.echo_pb2 import EchoRequest, EchoResponse
+
+    def run(inject_tail: bool):
+        class EchoService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                if request.message == "tail":
+                    time.sleep(tail_ms / 1000.0)
+                response.message = request.message
+                done()
+
+        server = rpc.Server()          # handlers in tasklets (NOT inline):
+        server.add_service(EchoService())   # isolation is the point
+        name = f"bench-tail-{'t' if inject_tail else 'n'}"
+        server.start(f"mem://{name}")
+        ch = rpc.Channel()
+        ch.init(f"mem://{name}",
+                options=rpc.ChannelOptions(timeout_ms=10000))
+        normal_lat = []
+        lat_lock = threading.Lock()
+        stop = time.monotonic() + seconds
+
+        def worker(wid):
+            i = 0
+            while time.monotonic() < stop:
+                i += 1
+                is_tail = inject_tail and (i % int(1 / tail_ratio) == 0)
+                cntl = rpc.Controller()
+                t0 = time.perf_counter_ns()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(
+                                   message="tail" if is_tail else "n"),
+                               EchoResponse)
+                t1 = time.perf_counter_ns()
+                if not cntl.failed() and not is_tail:
+                    with lat_lock:
+                        normal_lat.append((t1 - t0) / 1000.0)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(concurrency)]
+        for t in threads: t.start()
+        for t in threads: t.join()
+        server.stop()
+        normal_lat.sort()
+        if not normal_lat:
+            return -1.0
+        return normal_lat[int(len(normal_lat) * 0.99)]
+
+    p99_clean = run(False)
+    p99_tail = run(True)
+    return {"normal_p99_us_no_tail": p99_clean,
+            "normal_p99_us_with_tail": p99_tail,
+            "tail_isolation_ratio": (p99_tail / p99_clean
+                                     if p99_clean > 0 else -1.0)}
+
+
+def device_backend_reachable() -> bool:
+    """Fast-fail probe for the device backend (VERDICT r1 #1): under the
+    axon tunnel, jax backend init dials the terminal's stateless port —
+    if nothing listens there, jax.devices() hangs FOREVER, so probe the
+    TCP port (2s) instead of burning the 240s subprocess timeout."""
+    import os
+    import socket
+    if "axon" not in os.environ.get("JAX_PLATFORMS", ""):
+        return True                       # cpu/tpu-direct: init won't hang
+    for port in (8083, 8082):
+        s = socket.socket()
+        s.settimeout(2.0)
+        try:
+            s.connect(("127.0.0.1", port))
+            s.close()
+            return True
+        except OSError:
+            s.close()
+    print("# DEVICE BACKEND UNREACHABLE: axon terminal ports 8082/8083 "
+          "refuse connections — the TPU tunnel is down. Device benches "
+          "skipped (they would hang in PJRT init). Re-run when the "
+          "tunnel is up.", file=sys.stderr)
+    return False
+
+
 def _run_subbench(name: str, timeout_s: int = 240) -> dict:
     """Run one jax-dependent bench in a subprocess with a hard timeout:
     device-backend init (the axon tunnel) can hang indefinitely when the
@@ -291,7 +383,8 @@ def main() -> None:
     except Exception as e:  # pragma: no cover
         print(f"# native rpc bench failed: {e}", file=sys.stderr)
         rpc_p50 = raw_p50 = nqps = ngbps = -1.0
-    echo = _run_subbench("echo")
+    reachable = device_backend_reachable()
+    echo = _run_subbench("echo") if reachable else {}
     device_ok = bool(echo)
     if not echo:
         echo = {"p50_us": -1.0, "p99_us": -1.0, "mean_us": -1.0}
@@ -318,16 +411,37 @@ def main() -> None:
     except Exception as e:  # pragma: no cover
         print(f"# fanout failed: {e}", file=sys.stderr)
         fan = {}
+    try:
+        tail = bench_tail_isolation()
+        print(f"# tail isolation: {tail}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# tail isolation failed: {e}", file=sys.stderr)
+        tail = {}
     target_us = 10.0
-    headline = rpc_p50 if rpc_p50 > 0 else echo["p50_us"]
+    # Metric of record (BASELINE.md): echo p50 over ici:// with a device
+    # payload.  Only when the chip is unreachable does the native
+    # localhost-TCP number stand in — and the metric label says so.
+    if echo["p50_us"] > 0:
+        headline = echo["p50_us"]
+        metric = ("echo p50 latency over ici:// (device-resident 4KB "
+                  "payload through the full RPC stack)")
+    else:
+        headline = rpc_p50
+        why = ("device backend unreachable" if not reachable
+               else "ici echo subbench failed despite reachable backend")
+        metric = ("echo p50 latency, full RPC stack over localhost TCP "
+                  f"(native C++ datapath; STAND-IN — {why}, ici number "
+                  "unmeasured)")
     print(json.dumps({
-        "metric": "echo p50 latency, full RPC stack (native datapath: "
-                  "frame+dispatch+correlation in C++, 4KB payload)",
+        "metric": metric,
         "value": round(headline, 2),
         "unit": "us",
-        "vs_baseline": round(target_us / headline, 4),
+        "vs_baseline": round(target_us / headline, 4) if headline > 0
+        else -1.0,
         "extra": {
             "host_cores": __import__("os").cpu_count(),
+            "device_backend_reachable": reachable,
+            "native_tcp_echo_p50_us": round(rpc_p50, 2),
             "native_rpc_qps_16thr": round(nqps, 0),
             "native_large_req_gbps": round(ngbps, 3),
             "raw_epoll_echo_p50_us": round(raw_p50, 2),
@@ -338,6 +452,12 @@ def main() -> None:
             "streaming_mbps": round(strm.get("stream_mbps", 0.0), 1),
             "parallel_fanout8_p50_us": round(fan.get("fanout_p50_us", 0.0),
                                              1),
+            "tail_isolation_ratio": round(
+                tail.get("tail_isolation_ratio", -1.0), 3),
+            "normal_p99_us_no_tail": round(
+                tail.get("normal_p99_us_no_tail", -1.0), 1),
+            "normal_p99_us_with_tail": round(
+                tail.get("normal_p99_us_with_tail", -1.0), 1),
         },
     }))
 

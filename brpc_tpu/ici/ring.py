@@ -19,7 +19,6 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional
 
-from ..bthread.butex import Butex
 from ..bthread.device_waiter import DeviceEventDispatcher
 from .mesh import IciMesh
 from .collective import Collectives, default_collectives
@@ -78,27 +77,35 @@ class RingStream:
         self.hops = hops
         self.window = window
         self.on_chunk = on_chunk
-        self._credits = Butex(window)
+        # window accounting: produced - consumed < window, one condition
+        # guards both (the stream.cpp:274 check, host-side pacing only)
+        self._cv = threading.Condition()
         self._produced = 0
         self._consumed = 0
-        self._lock = threading.Lock()
-        self._error: Optional[str] = None
 
     def write(self, chunk, timeout: float = 30.0) -> bool:
         """Send one chunk ((n, ...) sharded row layout); blocks while the
         window is exhausted (AppendIfNotFull semantics)."""
-        while True:
-            with self._credits._cond:
-                if self._credits._value > 0:
-                    self._credits._value -= 1
-                    break
-            if self._credits.wait(0, timeout) == 110:
-                return False
-        with self._lock:
-            self._produced += 1
+        import time
+        from ..bthread import scheduler
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._produced - self._consumed >= self.window:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                scheduler.note_worker_blocked()
+                try:
+                    self._cv.wait(left)
+                finally:
+                    scheduler.note_worker_unblocked()
+        # dispatch BEFORE counting as produced: if ppermute raises, no
+        # window credit is consumed and flush() stays consistent
         moved = chunk
         for _ in range(self.hops):
             moved = self.coll.ppermute(moved, 1)
+        with self._cv:
+            self._produced += 1
         DeviceEventDispatcher.instance().on_ready(
             moved, lambda m=moved: self._delivered(m))
         return True
@@ -108,25 +115,25 @@ class RingStream:
             if self.on_chunk is not None:
                 self.on_chunk(chunk)
         finally:
-            with self._lock:
+            # feedback: credit returns to the sender (SendFeedback
+            # analogue) and flush()ers see consumption progress
+            with self._cv:
                 self._consumed += 1
-            # feedback: credit returns to the sender (SendFeedback analogue)
-            with self._credits._cond:
-                self._credits._value += 1
-                self._credits._cond.notify_all()
+                self._cv.notify_all()
 
     def flush(self, timeout: float = 60.0) -> bool:
-        """Wait until every produced chunk was consumed."""
-        import time
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._lock:
-                if self._consumed >= self._produced:
-                    return True
-            time.sleep(0.001)
-        return False
+        """Wait until every produced chunk was consumed (no busy-poll:
+        rides the same condition as the window credits)."""
+        from ..bthread import scheduler
+        with self._cv:
+            scheduler.note_worker_blocked()
+            try:
+                return self._cv.wait_for(
+                    lambda: self._consumed >= self._produced, timeout)
+            finally:
+                scheduler.note_worker_unblocked()
 
     @property
     def in_flight(self) -> int:
-        with self._lock:
+        with self._cv:
             return self._produced - self._consumed
