@@ -17,7 +17,7 @@ from typing import Callable, Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_native_rpc_throughput_gbps"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_ici_echo_p50_ns"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -35,6 +35,32 @@ _NREQ_FN = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_char_p,
                             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
                             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
                             ctypes.c_uint64)
+
+
+class IciSegC(ctypes.Structure):
+    """Attachment segment descriptor for the native ici plane (the SGE of
+    a zero-copy post — native/rpc.cpp IciSegC).  Host segments name a span
+    of the att_host byte stream; device segments name a registry key."""
+    _fields_ = [("key", ctypes.c_uint64),
+                ("nbytes", ctypes.c_uint64),
+                ("dev", ctypes.c_int32),
+                ("is_dev", ctypes.c_int32)]
+
+
+# relocation upcall: (key, target_dev) -> new key (0 = failure)
+_ICI_RELOCATE_FN = ctypes.CFUNCTYPE(ctypes.c_uint64, ctypes.c_uint64,
+                                    ctypes.c_int32)
+# release upcall: native custody of a key ends on a drop path
+_ICI_RELEASE_FN = ctypes.CFUNCTYPE(None, ctypes.c_uint64)
+# ici request hook: (token, method, payload, payload_len, att_host,
+# att_host_len, segs, nsegs, log_id, peer_dev)
+_ICI_REQ_FN = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.c_uint64,
+                               ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.c_uint64,
+                               ctypes.POINTER(IciSegC), ctypes.c_uint64,
+                               ctypes.c_uint64, ctypes.c_int32)
 
 
 def _build() -> bool:
@@ -171,6 +197,43 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_native_rpc_throughput_gbps.restype = ctypes.c_double
     lib.brpc_tpu_native_rpc_throughput_gbps.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    # ---- native ici:// plane (native/rpc.cpp ici section) ----
+    segp = ctypes.POINTER(IciSegC)
+    lib.brpc_tpu_ici_set_hooks.argtypes = [_ICI_RELOCATE_FN, _ICI_RELEASE_FN]
+    lib.brpc_tpu_ici_listen.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_listen.argtypes = [ctypes.c_int32, _ICI_REQ_FN]
+    lib.brpc_tpu_ici_register_echo.restype = ctypes.c_int
+    lib.brpc_tpu_ici_register_echo.argtypes = [ctypes.c_uint64,
+                                               ctypes.c_char_p]
+    lib.brpc_tpu_ici_set_handler.restype = ctypes.c_int
+    lib.brpc_tpu_ici_set_handler.argtypes = [ctypes.c_uint64, _ICI_REQ_FN]
+    lib.brpc_tpu_ici_requests.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_requests.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_ici_has_listener.restype = ctypes.c_int
+    lib.brpc_tpu_ici_has_listener.argtypes = [ctypes.c_int32]
+    lib.brpc_tpu_ici_unlisten.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_ici_connect.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_connect.argtypes = [ctypes.c_int32, ctypes.c_int32,
+                                         ctypes.c_int64]
+    lib.brpc_tpu_ici_close.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_ici_window_left.restype = ctypes.c_int64
+    lib.brpc_tpu_ici_window_left.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_ici_call.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_call.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, u8p, ctypes.c_uint64, u8p,
+        ctypes.c_uint64, segp, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(segp), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.brpc_tpu_ici_respond.restype = ctypes.c_int
+    lib.brpc_tpu_ici_respond.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p, u8p,
+        ctypes.c_uint64, u8p, ctypes.c_uint64, segp, ctypes.c_uint64]
+    lib.brpc_tpu_ici_echo_p50_ns.restype = ctypes.c_int64
+    lib.brpc_tpu_ici_echo_p50_ns.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_int32]
     _lib = lib
     return _lib
 

@@ -34,6 +34,12 @@ class IciMesh:
         self.axis_name = axis_name
         self.mesh = Mesh(np.array(self.devices), (axis_name,))
         self.size = len(self.devices)
+        # O(1) device→logical-id lookup for the transport hot path
+        self._dev_index = {d: i for i, d in enumerate(self.devices)}
+
+    def device_index(self, device) -> int:
+        """Logical id of a jax device in this mesh (-1 if absent)."""
+        return self._dev_index.get(device, -1)
 
     @classmethod
     def default(cls) -> "IciMesh":

@@ -1,0 +1,529 @@
+"""Native ici:// plane — Python control plane over native/rpc.cpp's ici
+datapath.
+
+This is the fusion VERDICT r2/r3 task #1 demanded: the full unary hot path
+(window reservation → TRPC frame encode → queue hop → dispatch →
+correlation wake) runs in C++; Python appears on the datapath ONLY for
+device-ref relocation (``jax.device_put``, the HBM→HBM ICI transfer), and
+only when a ref is not already resident on the target chip.  Reference
+anchors: the wait-free write discipline src/brpc/socket.cpp:1584-1596 and
+the RDMA endpoint's zero-copy post + completion custody
+src/brpc/rdma/rdma_endpoint.cpp:771,926.
+
+Three pieces:
+
+* **device-ref registry** — keeps jax arrays alive while their keys are in
+  native custody.  Custody rules (must mirror native/rpc.cpp exactly):
+  a key given to native exits custody either INTO Python (``take`` at an
+  upcall or response boundary) or via the release upcall on drop paths.
+* **ServerBinding** — attaches an ``rpc.Server``'s method table to a
+  native listener; per-request upcall parses + dispatches user code
+  (inline or on a tasklet, mirroring InputMessenger's dispatch).
+* **ChannelBinding** — the client side used by ``rpc.Channel`` when the
+  target device has a native listener in this process.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..butil import logging as log
+from ..butil import native
+from ..butil.iobuf import IOBuf, DEVICE
+from ..butil.native import IciSegC, _ICI_RELEASE_FN, _ICI_RELOCATE_FN, \
+    _ICI_REQ_FN
+from ..rpc import errors
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+# ---------------------------------------------------------------------
+# device-ref registry
+# ---------------------------------------------------------------------
+
+class _DevRegistry:
+    """key → jax.Array, alive while the key is in native custody."""
+
+    def __init__(self):
+        self._m: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        self._next = 1
+
+    def put(self, arr) -> int:
+        with self._lock:
+            key = self._next
+            self._next += 1
+            self._m[key] = arr
+            return key
+
+    def peek(self, key: int):
+        with self._lock:
+            return self._m.get(key)
+
+    def take(self, key: int):
+        """Remove and return — the Python side assumes custody."""
+        with self._lock:
+            return self._m.pop(key, None)
+
+    def release(self, key: int) -> None:
+        with self._lock:
+            self._m.pop(key, None)
+
+    def live(self) -> int:
+        with self._lock:
+            return len(self._m)
+
+
+_registry = _DevRegistry()
+
+
+def registry() -> _DevRegistry:
+    return _registry
+
+
+# ---------------------------------------------------------------------
+# hooks (relocation = the only Python on the datapath)
+# ---------------------------------------------------------------------
+
+def _relocate(key: int, target_dev: int) -> int:
+    """Move the array behind ``key`` to mesh device ``target_dev``; returns
+    a NEW key for the moved array (native releases the old one) or the same
+    key when already resident.  0 = failure (native fails the RPC)."""
+    try:
+        import jax
+        from .mesh import IciMesh
+        arr = _registry.peek(key)
+        if arr is None:
+            return 0
+        target = IciMesh.default().device(target_dev)
+        try:
+            if target in arr.devices():
+                return key                       # resident: pure ref pass
+        except Exception:
+            pass
+        moved = jax.device_put(arr, target)      # HBM→HBM over ICI
+        return _registry.put(moved)
+    except Exception as e:                       # never raise across ctypes
+        log.error("ici relocate(key=%d, dev=%d) failed: %s", key,
+                  target_dev, e)
+        return 0
+
+
+def _release(key: int) -> None:
+    _registry.release(key)
+
+
+_hooks_installed = False
+_hooks_lock = threading.Lock()
+_relocate_cb = None
+_release_cb = None
+
+
+def ensure_hooks() -> bool:
+    """Install the relocate/release upcalls once per process."""
+    global _hooks_installed, _relocate_cb, _release_cb
+    lib = native.load()
+    if lib is None:
+        return False
+    with _hooks_lock:
+        if not _hooks_installed:
+            _relocate_cb = _ICI_RELOCATE_FN(_relocate)
+            _release_cb = _ICI_RELEASE_FN(_release)
+            lib.brpc_tpu_ici_set_hooks(_relocate_cb, _release_cb)
+            _hooks_installed = True
+    return True
+
+
+def available() -> bool:
+    return native.available()
+
+
+def has_listener(device_id: int) -> bool:
+    lib = native.load()
+    return lib is not None and \
+        lib.brpc_tpu_ici_has_listener(device_id) == 1
+
+
+# ---------------------------------------------------------------------
+# IOBuf ⇄ (att_host, segs) marshalling
+# ---------------------------------------------------------------------
+
+def split_attachment(buf: IOBuf) -> Tuple[bytes, List[IciSegC]]:
+    """Decompose an attachment IOBuf into the host byte-stream plus the
+    ordered segment descriptor list.  Device blocks are registered (native
+    custody begins); host runs merge into one descriptor each."""
+    host_parts: List[bytes] = []
+    segs: List[IciSegC] = []
+    run = 0
+    for i in range(buf.backing_block_num()):
+        r = buf.backing_block(i)
+        if r.block.kind == DEVICE:
+            if run:
+                segs.append(IciSegC(0, run, 0, 0))
+                run = 0
+            arr = r.block.data
+            if r.offset or r.length != len(arr):
+                arr = arr[r.offset:r.offset + r.length]
+            dev = _device_index(arr)
+            segs.append(IciSegC(_registry.put(arr), r.length, dev, 1))
+        else:
+            host_parts.append(bytes(r.block.host_view(r.offset, r.length)))
+            run += r.length
+    if run:
+        segs.append(IciSegC(0, run, 0, 0))
+    return b"".join(host_parts), segs
+
+
+def build_attachment(att_host: bytes, segs) -> IOBuf:
+    """Inverse of split_attachment on the receiving side: takes each
+    device key out of the registry (custody moves to this IOBuf)."""
+    buf = IOBuf()
+    off = 0
+    for s in segs:
+        if s.is_dev:
+            arr = _registry.take(s.key)
+            if arr is None:
+                raise KeyError(f"ici device ref {s.key} missing")
+            buf.append_device_array(arr)
+        else:
+            buf.append(att_host[off:off + s.nbytes])
+            off += s.nbytes
+    return buf
+
+
+def _device_index(arr) -> int:
+    """Logical mesh id of the array's residence, or -1 when the device is
+    not in the mesh.  -1 never equals a target id, so native relocation
+    always upcalls for such refs — the relocate hook then does the real
+    residency check/device_put, preserving Python-plane semantics instead
+    of silently skipping relocation (review finding: a 0 default would
+    alias device 0)."""
+    from .mesh import IciMesh
+    mesh = IciMesh.default()
+    try:
+        idx = mesh.device_index(arr.device)      # single-device fast path
+        if idx >= 0:
+            return idx
+    except Exception:
+        pass
+    try:
+        for d in arr.devices():
+            idx = mesh.device_index(d)
+            if idx >= 0:
+                return idx
+    except Exception:
+        pass
+    return -1
+
+
+def release_segs(segs) -> None:
+    for s in segs:
+        if s.is_dev:
+            _registry.release(s.key)
+
+
+# ---------------------------------------------------------------------
+# server binding
+# ---------------------------------------------------------------------
+
+class ServerBinding:
+    """Native listener for one device id, dispatching into an
+    ``rpc.Server``'s method table (the Python-handler tier; echo-class
+    methods can additionally be served fully native via
+    ``register_native_echo``)."""
+
+    def __init__(self, server, device_id: int):
+        lib = native.load()
+        if lib is None or not ensure_hooks():
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self._server = server
+        self.device_id = device_id
+        self._cb = _ICI_REQ_FN(self._on_request)   # pinned for lifetime
+        # handler rides the listen call: the listener is never visible
+        # half-initialized (a racing caller could otherwise ENOMETHOD)
+        h = lib.brpc_tpu_ici_listen(device_id, self._cb)
+        if h == 0:
+            raise OSError(errors.EINVAL,
+                          f"ici://{device_id} already listening (native)")
+        self._handle = h
+
+    def register_native_echo(self, full_method: str) -> None:
+        self._lib.brpc_tpu_ici_register_echo(self._handle,
+                                             full_method.encode())
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.brpc_tpu_ici_unlisten(self._handle)
+            self._handle = 0
+
+    def requests(self) -> int:
+        return self._lib.brpc_tpu_ici_requests(self._handle)
+
+    # ---- data-plane upcall -------------------------------------------
+
+    def _on_request(self, token, method, payload_p, payload_len,
+                    att_p, att_len, segs_p, nsegs, log_id, peer_dev):
+        try:
+            full = method.decode()
+            payload = ctypes.string_at(payload_p, payload_len) \
+                if payload_len else b""
+            att_host = ctypes.string_at(att_p, att_len) if att_len else b""
+            # custody: the registry takes happen HERE, inside the upcall —
+            # native clears its seg list when we return
+            segs = [IciSegC(segs_p[i].key, segs_p[i].nbytes, segs_p[i].dev,
+                            segs_p[i].is_dev) for i in range(nsegs)]
+            try:
+                attachment = build_attachment(att_host, segs)
+            except KeyError as e:
+                self._respond_err(token, errors.EINTERNAL, str(e))
+                return
+            if getattr(self._server.options, "usercode_inline", False):
+                self._process(token, full, payload, attachment, log_id,
+                              peer_dev)
+            else:
+                from ..bthread import scheduler
+                scheduler.start_background(
+                    self._process, token, full, payload, attachment,
+                    log_id, peer_dev, name=f"ici-req:{full}")
+        except Exception as e:       # never let an exception cross ctypes
+            log.error("ici upcall failed: %s", e, exc_info=True)
+            try:
+                self._respond_err(token, errors.EINTERNAL, str(e))
+            except Exception:
+                pass
+
+    def _process(self, token, full, payload, attachment, log_id, peer_dev):
+        from ..rpc.controller import Controller
+        from .mesh import IciMesh
+        server = self._server
+        md = server.find_method(full)
+        if md is None:
+            self._respond_err(token, errors.ENOMETHOD, f"no method {full}")
+            return
+        status = server.method_status(full)
+        if not server.on_request_in():
+            self._respond_err(token, errors.ELIMIT,
+                              "server max_concurrency reached")
+            return
+        if status is not None and not status.on_requested():
+            server.on_request_out()
+            self._respond_err(token, errors.ELIMIT,
+                              f"{full} concurrency limit")
+            return
+        cntl = Controller()
+        cntl.log_id = log_id
+        cntl.server = server
+        cntl.remote_side = IciMesh.default().endpoint(peer_dev)
+        cntl.request_attachment = attachment
+        cntl._session_data = server._get_session_data()
+        import time as _time
+        start_ns = _time.monotonic_ns()
+        try:
+            request = md.request_cls()
+            request.ParseFromString(payload)
+        except Exception as e:
+            server.on_request_out()
+            if status is not None:
+                status.on_responded(errors.EREQUEST, 0)
+            self._respond_err(token, errors.EREQUEST,
+                              f"fail to parse request: {e}")
+            return
+        response = md.response_cls()
+        done_called = [False]
+
+        def done() -> None:
+            if done_called[0]:
+                return
+            done_called[0] = True
+            latency_us = (_time.monotonic_ns() - start_ns) // 1000
+            server.on_request_out()
+            if status is not None:
+                status.on_responded(cntl.error_code_, latency_us)
+            server._return_session_data(
+                getattr(cntl, "_session_data", None))
+            if cntl.failed():
+                self._respond_err(token, cntl.error_code_, cntl.error_text_)
+                return
+            att_host, segs = split_attachment(cntl.response_attachment)
+            self._respond(token, 0, "", response.SerializeToString(),
+                          att_host, segs)
+
+        cntl.set_server_done(done)
+        try:
+            md.invoke(cntl, request, response, done)
+        except Exception as e:
+            log.error("ici method %s raised: %s", full, e, exc_info=True)
+            if not done_called[0]:
+                cntl.set_failed(errors.EINTERNAL,
+                                f"{type(e).__name__}: {e}")
+                done()
+
+    def _respond(self, token, err, err_text, payload, att_host, segs):
+        p = ctypes.cast(payload, _U8P) if payload else None
+        a = ctypes.cast(att_host, _U8P) if att_host else None
+        seg_arr = (IciSegC * len(segs))(*segs) if segs else None
+        rc = self._lib.brpc_tpu_ici_respond(
+            token, err, err_text.encode() if err_text else b"", p,
+            len(payload), a, len(att_host), seg_arr, len(segs))
+        if rc != 0 and segs:
+            # token vanished before custody transferred (server stopping):
+            # native never saw the keys, release them here
+            release_segs(segs)
+
+    def _respond_err(self, token, err, text):
+        self._respond(token, err, text, b"", b"", [])
+
+
+# ---------------------------------------------------------------------
+# channel binding
+# ---------------------------------------------------------------------
+
+class ChannelBinding:
+    """Client half: one native connection (with its credit window) to the
+    in-process native listener at ``remote_dev``."""
+
+    def __init__(self, remote_dev: int, local_dev: Optional[int] = None,
+                 window_bytes: int = 0):
+        lib = native.load()
+        if lib is None or not ensure_hooks():
+            raise RuntimeError("native core unavailable")
+        from .mesh import IciMesh
+        mesh = IciMesh.default()
+        if local_dev is None:
+            local_dev = (remote_dev + 1) % mesh.size
+        self._lib = lib
+        self.local_dev = local_dev
+        self.remote_dev = remote_dev
+        self.window_bytes = window_bytes if window_bytes > 0 else (4 << 20)
+        self._remote_ep = mesh.endpoint(remote_dev)
+        h = lib.brpc_tpu_ici_connect(local_dev, remote_dev, window_bytes)
+        if h == 0:
+            raise ConnectionRefusedError(
+                f"no native listener at ici://{remote_dev}")
+        self._handle = h
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.brpc_tpu_ici_close(self._handle)
+            self._handle = 0
+
+    def __del__(self):                   # noqa: D105 — native conn must not
+        try:                             # outlive its Python owner
+            self.close()
+        except Exception:
+            pass
+
+    def window_left(self) -> int:
+        return self._lib.brpc_tpu_ici_window_left(self._handle)
+
+    def call(self, full_name: str, cntl, request: Any,
+             response_cls: Optional[type] = None):
+        """Unary call over the native datapath.  Fills cntl; returns the
+        parsed response (or raw payload bytes when response_cls is None)."""
+        import time as _time
+        from . import transport as _t
+        t0 = _time.monotonic_ns()
+        if hasattr(request, "SerializeToString"):
+            req = request.SerializeToString()
+        else:
+            req = bytes(request) if request is not None else b""
+        att_host, segs = split_attachment(cntl.request_attachment)
+        # bytes objects pass by pointer (cast, no copy): the native side
+        # never writes through request pointers and copies before returning
+        u8p = _U8P
+        reqb = ctypes.cast(req, u8p) if req else None
+        attb = ctypes.cast(att_host, u8p) if att_host else None
+        seg_arr = (IciSegC * len(segs))(*segs) if segs else None
+        resp_p, resp_len = u8p(), ctypes.c_uint64()
+        ratt_p, ratt_len = u8p(), ctypes.c_uint64()
+        rsegs_p = ctypes.POINTER(IciSegC)()
+        rnsegs = ctypes.c_uint64()
+        err_text = ctypes.c_char_p()
+        # timeout_ms <= 0 means NO deadline (controller.py:169 semantics);
+        # the native side treats timeout_us <= 0 the same way
+        tms = cntl.timeout_ms
+        timeout_us = int(tms * 1000) if tms is not None and tms > 0 else 0
+        dev_bytes = sum(s.nbytes for s in segs if s.is_dev)
+        # the FFI call can park on a C condvar (Python-tier handler): a
+        # tasklet-pool worker must note itself blocked so the scheduler
+        # compensates — otherwise handler tasklets starve behind us and
+        # the call deadlocks until timeout (review finding r4)
+        from ..bthread import scheduler
+        blocked = scheduler.in_worker()
+        if blocked:
+            scheduler.note_worker_blocked()
+        try:
+            rc = self._lib.brpc_tpu_ici_call(
+                self._handle, full_name.encode(), reqb, len(req), attb,
+                len(att_host), seg_arr, len(segs), timeout_us,
+                ctypes.byref(resp_p), ctypes.byref(resp_len),
+                ctypes.byref(ratt_p), ctypes.byref(ratt_len),
+                ctypes.byref(rsegs_p), ctypes.byref(rnsegs),
+                ctypes.byref(err_text))
+        finally:
+            if blocked:
+                scheduler.note_worker_unblocked()
+        try:
+            cntl.remote_side = self._remote_ep
+            if rc != 0:
+                text = err_text.value.decode() if err_text.value else \
+                    errors.berror(int(rc))
+                cntl.set_failed(int(rc), text)
+                return None
+            payload = ctypes.string_at(resp_p, resp_len.value) \
+                if resp_len.value else b""
+            r_att_host = ctypes.string_at(ratt_p, ratt_len.value) \
+                if ratt_len.value else b""
+            rsegs = [IciSegC(rsegs_p[i].key, rsegs_p[i].nbytes,
+                             rsegs_p[i].dev, rsegs_p[i].is_dev)
+                     for i in range(rnsegs.value)]
+            if rsegs or r_att_host:
+                cntl.response_attachment.append(
+                    build_attachment(r_att_host, rsegs))
+            # transport accounting (the Python plane's counters — one
+            # fabric-wide truth regardless of datapath)
+            with _t._ici_stats_lock:
+                _t._ici_bytes_moved += len(req) + len(att_host) + dev_bytes
+                _t._ici_device_bytes_moved += dev_bytes
+            cntl.error_code_ = 0
+            if response_cls is None:
+                return payload
+            response = response_cls()
+            response.ParseFromString(payload)
+            cntl.response = response
+            return response
+        finally:
+            cntl.latency_us = (_time.monotonic_ns() - t0) // 1000
+            if resp_p:
+                self._lib.brpc_tpu_buf_free(resp_p)
+            if ratt_p:
+                self._lib.brpc_tpu_buf_free(ratt_p)
+            if rsegs_p:
+                self._lib.brpc_tpu_buf_free(rsegs_p)
+            if err_text:
+                self._lib.brpc_tpu_buf_free(err_text)
+
+
+def native_ici_echo_p50_us(iters: int = 3000, payload: int = 128,
+                           device_array=None) -> float:
+    """Native-loop ici echo p50 (µs): the C++ client loop over the full
+    native ici datapath (window → frame codec → queue hop → dispatch →
+    correlation wake).  With ``device_array``, the frame carries that
+    array as a device ref (resident = the pure-HBM round trip).  -1 when
+    unavailable."""
+    lib = native.load()
+    if lib is None or not ensure_hooks():
+        return -1.0
+    key, nbytes, dev = 0, 0, 0
+    if device_array is not None:
+        key = _registry.put(device_array)    # borrowed for the bench
+        nbytes = device_array.nbytes
+        dev = _device_index(device_array)
+    try:
+        ns = lib.brpc_tpu_ici_echo_p50_ns(iters, payload, key, nbytes, dev)
+        return ns / 1000.0 if ns > 0 else -1.0
+    finally:
+        if key:
+            _registry.release(key)

@@ -51,6 +51,8 @@ class Channel:
         self._ns_thread = None          # NamingServiceThread
         self._protocol = None
         self.messenger = InputMessenger(server=None)
+        self._native_ici = None
+        self._native_ici_lock = threading.Lock()
 
     # ---- init ---------------------------------------------------------
     def init(self, target: Any, lb_name: str = "",
@@ -100,6 +102,62 @@ class Channel:
                     request: Any, response_cls: Any = None,
                     done: Optional[Callable[[Controller], None]] = None):
         """Sync when done is None (returns the response); async otherwise."""
+        # ici:// fast path: when the target device has a native listener in
+        # this process, the whole unary hot path (frame/window/dispatch/
+        # correlation) runs in native/rpc.cpp — no Python between
+        # serialize and parse except device-ref relocation (VERDICT r3 #1).
+        # Streaming, auth, non-tpu_std protocols, backup-request hedging,
+        # and frames too large for the native send window ride the Python
+        # plane (which drains big payloads chunkwise through its credit
+        # window).
+        nch = self._native_ici_binding(cntl)
+        if nch is not None:
+            try:                        # payload + attachment vs window
+                req_sz = request.ByteSize() \
+                    if hasattr(request, "ByteSize") else 0
+            except Exception:
+                req_sz = 0
+            if len(cntl.request_attachment) + req_sz + 65536 > \
+                    nch.window_bytes or self.options.backup_request_ms > 0:
+                nch = None
+        if nch is not None:
+            if cntl.timeout_ms is None:
+                cntl.timeout_ms = self.options.timeout_ms
+            if done is None:
+                result = self._native_ici_call(nch, method_full_name, cntl,
+                                               request, response_cls)
+                if not self._native_ici_fallback(cntl):
+                    if cntl.span is not None:
+                        from .span import end_client_span
+                        end_client_span(cntl)
+                    return result
+            else:
+                from ..bthread import scheduler
+
+                def _run():
+                    try:
+                        self._native_ici_call(nch, method_full_name, cntl,
+                                              request, response_cls)
+                    except Exception as e:   # done() must ALWAYS fire
+                        if not cntl.failed():
+                            cntl.set_failed(errors.EINTERNAL,
+                                            f"{type(e).__name__}: {e}")
+                        done(cntl)
+                        return
+                    if self._native_ici_fallback(cntl):
+                        # dead native conn (server restarted) or oversize
+                        # fast-fail: re-route through the Python plane
+                        self.call_method(method_full_name, cntl, request,
+                                         response_cls, done=done)
+                    else:
+                        if cntl.span is not None:
+                            from .span import end_client_span
+                            end_client_span(cntl)
+                        done(cntl)
+
+                scheduler.start_background(
+                    _run, name=f"ici-call:{method_full_name}")
+                return None
         if self.options.auth is not None and not cntl.auth_token:
             cntl.auth_token = self.options.auth.generate_credential(cntl)
         payload = self._protocol.serialize_request(request, cntl)
@@ -112,6 +170,82 @@ class Channel:
             cntl.join(timeout)
             return cntl.response
         return None
+
+    def _native_ici_call(self, nch, method_full_name: str,
+                         cntl: Controller, request, response_cls):
+        """One fast-path RPC with the Python plane's client semantics:
+        rpcz span, and max_retry honored for the retryable error codes
+        (controller.py _retryable) — scheme choice must not silently
+        change retry behavior (review finding r4)."""
+        if cntl.span is None:
+            from .span import maybe_start_client_span
+            maybe_start_client_span(cntl, method_full_name)
+        result = None
+        for attempt in range(max(0, self.options.max_retry) + 1):
+            if attempt:
+                cntl.error_code_ = 0
+                cntl.error_text_ = ""
+                if cntl.span is not None:
+                    cntl.span.annotate(f"ici retry try={attempt}")
+            result = nch.call(method_full_name, cntl, request, response_cls)
+            if not cntl.failed() or \
+                    not Controller._retryable(cntl.error_code_) or \
+                    cntl.error_code_ == errors.EFAILEDSOCKET:
+                break                  # EFAILEDSOCKET → reroute, not spin
+        return result
+
+    def _native_ici_fallback(self, cntl: Controller) -> bool:
+        """After a fast-path failure, decide whether to re-route the call
+        through the Python plane (once per call).  Two cases:
+        * EFAILEDSOCKET — OUR cached conn died (server restarted): drop
+          the cache; the Python plane reconnects per call.
+        * EOVERCROWDED oversize fast-fail — the frame can never fit the
+          native window; the Python plane drains it chunkwise."""
+        code = cntl.error_code_
+        if code == errors.EFAILEDSOCKET:
+            drop_cache = True
+        elif code == errors.EOVERCROWDED and \
+                cntl.error_text_.startswith("frame larger"):
+            drop_cache = False
+        else:
+            return False
+        if getattr(cntl, "_ici_rerouted", False):
+            return False               # one re-route per call: no flapping
+        cntl._ici_rerouted = True
+        if drop_cache:
+            with self._native_ici_lock:
+                stale, self._native_ici = self._native_ici, None
+            if stale is not None:
+                stale.close()
+        # reset the controller so the fallback attempt starts clean
+        cntl.error_code_ = 0
+        cntl.error_text_ = ""
+        return True
+
+    def _native_ici_binding(self, cntl: Controller):
+        """The native in-process ici connection, or None (→ Python plane:
+        other-controller targets, streaming calls, auth, non-tpu_std)."""
+        ep = self._endpoint
+        if (ep is None or getattr(ep, "scheme", None) != "ici"
+                or self.options.protocol != "tpu_std"
+                or self.options.auth is not None
+                or getattr(cntl, "stream_creator", None) is not None):
+            return None
+        cached = getattr(self, "_native_ici", None)
+        if cached is not None:
+            return cached
+        try:
+            from ..ici import native_plane
+            if not (native_plane.available()
+                    and native_plane.has_listener(ep.device_id)):
+                return None
+            with self._native_ici_lock:
+                if getattr(self, "_native_ici", None) is None:
+                    self._native_ici = native_plane.ChannelBinding(
+                        ep.device_id)
+                return self._native_ici
+        except Exception:
+            return None
 
     # IssueRPC: runs once per try -----------------------------------------
     def _issue_rpc(self, cntl: Controller) -> None:

@@ -48,6 +48,11 @@ class ServerOptions:
     # restful mappings (reference restful.cpp): url path -> method
     #   {"/v1/echo": "EchoService.Echo"}
     restful_mappings: Dict[str, str] = field(default_factory=dict)
+    # ici:// servers also open the native-datapath front door (the C++
+    # plane in native/rpc.cpp; in-process channels prefer it).  The Python
+    # IciListener stays registered either way — it serves fabric peers and
+    # non-tpu_std protocols.  Disable to force the pure-Python plane.
+    native_ici: bool = True
 
 
 class Server:
@@ -225,6 +230,16 @@ class Server:
         elif ep.scheme == "ici":
             from ..ici.transport import ici_listen
             self._ici_listener = ici_listen(ep.device_id, self._on_accept)
+            if self.options.native_ici:
+                try:
+                    from ..ici import native_plane
+                    if native_plane.available():
+                        self._native_ici = native_plane.ServerBinding(
+                            self, ep.device_id)
+                except Exception as e:   # native plane is an accelerator,
+                    log.warning(         # not a requirement
+                        "native ici plane unavailable (%s); "
+                        "Python datapath only", e)
         else:
             raise ValueError(f"cannot listen on scheme {ep.scheme}")
         self._listen_endpoints.append(ep)
@@ -270,6 +285,9 @@ class Server:
             from ..ici.transport import ici_unlisten
             ici_unlisten(self._ici_listener.device_id)
             self._ici_listener = None
+        if getattr(self, "_native_ici", None) is not None:
+            self._native_ici.stop()
+            self._native_ici = None
         with self._conn_lock:
             conns = list(self._connections)
         for s in conns:

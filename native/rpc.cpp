@@ -1007,6 +1007,508 @@ class NativeChannel {
 };
 
 // ====================================================================
+// ici:// in-process plane: the native device-endpoint datapath.
+//
+// Analogue of the reference's RDMA endpoint (rdma_endpoint.cpp): control
+// frames (TRPC header+meta+payload+host-attachment bytes) move through
+// the native codec above; bulk device payloads ride a sidecar of
+// "device refs" — {key, nbytes, resident-device} descriptors naming
+// arrays held alive by a Python-side registry (the SGE list of a
+// zero-copy post, rdma_endpoint.cpp:771 CutFromIOBufList).  The ONLY
+// Python on the datapath is the relocation upcall, and only when a ref
+// is not already resident on the target device (the HBM→HBM ICI
+// device_put); a resident ref passes through with zero upcalls.
+//
+// Custody discipline for refs (mirrors the completion-driven _sbuf free,
+// rdma_endpoint.cpp:926): a key entering native custody (call/respond)
+// leaves it either INTO Python (an upcall or a returned response — the
+// Python side takes it from the registry) or by an explicit release
+// upcall on drop paths (timeout, dead peer, relocation).  Exactly one
+// exit per key: the registry can never leak or free-under-use.
+// ====================================================================
+
+struct IciSegC {
+  uint64_t key;      // registry key for device segs; unused for host segs
+  uint64_t nbytes;   // logical byte length of this attachment segment
+  int32_t dev;       // resident device id (device segs)
+  int32_t is_dev;    // 1 = device ref, 0 = host bytes (span of att_host)
+};
+
+typedef uint64_t (*py_relocate_fn)(uint64_t key, int32_t target_dev);
+typedef void (*py_release_fn)(uint64_t key);
+// (token, method, payload, len, att_host, att_host_len, segs, nsegs,
+//  log_id, peer_dev); answer exactly once via brpc_tpu_ici_respond
+typedef void (*py_ici_request_fn)(uint64_t token, const char* method,
+                                  const uint8_t* payload,
+                                  uint64_t payload_len,
+                                  const uint8_t* att_host,
+                                  uint64_t att_host_len,
+                                  const IciSegC* segs, uint64_t nsegs,
+                                  uint64_t log_id, int32_t peer_dev);
+
+static std::atomic<py_relocate_fn> g_ici_relocate{nullptr};
+static std::atomic<py_release_fn> g_ici_release{nullptr};
+
+static void ici_release_segs(const std::vector<IciSegC>& segs) {
+  py_release_fn rel = g_ici_release.load(std::memory_order_acquire);
+  if (rel == nullptr) return;
+  for (const auto& s : segs)
+    if (s.is_dev) rel(s.key);
+}
+
+// Move every non-resident device ref to target_dev via the Python/JAX
+// upcall (jax.device_put = the ICI transfer).  Returns false when the
+// device plane can't relocate (caller fails the RPC).  The replaced key
+// is released — its custody ends here.
+static bool ici_relocate_segs(std::vector<IciSegC>& segs,
+                              int32_t target_dev) {
+  py_relocate_fn rf = g_ici_relocate.load(std::memory_order_acquire);
+  py_release_fn rel = g_ici_release.load(std::memory_order_acquire);
+  for (auto& s : segs) {
+    if (!s.is_dev || s.dev == target_dev) continue;
+    if (rf == nullptr) return false;
+    uint64_t nk = rf(s.key, target_dev);
+    if (nk == 0) return false;
+    if (nk != s.key && rel != nullptr) rel(s.key);
+    s.key = nk;
+    s.dev = target_dev;
+  }
+  return true;
+}
+
+struct IciSlot {
+  std::mutex mu;
+  std::condition_variable cv;
+  // lock-free fast-path check: the native echo tier delivers inline
+  // before the caller ever reaches its wait, so `done` is usually
+  // already true and the mutex/condvar is skipped entirely
+  std::atomic<bool> done{false};
+  uint64_t error_code = 0;
+  std::string error_text;
+  std::string payload, att_host;
+  std::vector<IciSegC> segs;
+};
+using IciSlotPtr = std::shared_ptr<IciSlot>;
+
+class IciServer;
+
+class IciChannel {
+ public:
+  IciChannel(int32_t local_dev, int32_t remote_dev)
+      : local_dev_(local_dev), remote_dev_(remote_dev) {}
+
+  int32_t local_dev() const { return local_dev_; }
+  int32_t remote_dev() const { return remote_dev_; }
+
+  IciSlotPtr make_slot(uint64_t* cid) {
+    *cid = next_cid_.fetch_add(1) + 1;
+    auto slot = std::make_shared<IciSlot>();
+    std::lock_guard<std::mutex> g(slots_mu_);
+    slots_[*cid] = slot;
+    return slot;
+  }
+
+  void erase_slot(uint64_t cid) {
+    std::lock_guard<std::mutex> g(slots_mu_);
+    slots_.erase(cid);
+  }
+
+  // Response delivery from the server worker (or respond()).  A missing
+  // slot (timeout/close) drops the payload and releases ref custody.
+  void deliver(uint64_t cid, uint64_t err, std::string err_text,
+               std::string payload, std::string att_host,
+               std::vector<IciSegC> segs) {
+    IciSlotPtr slot;
+    {
+      std::lock_guard<std::mutex> g(slots_mu_);
+      auto it = slots_.find(cid);
+      if (it != slots_.end()) {
+        slot = it->second;
+        slots_.erase(it);
+      }
+    }
+    if (slot == nullptr) {
+      ici_release_segs(segs);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> g(slot->mu);
+      slot->error_code = err;
+      slot->error_text = std::move(err_text);
+      slot->payload = std::move(payload);
+      slot->att_host = std::move(att_host);
+      slot->segs = std::move(segs);
+      slot->done.store(true, std::memory_order_release);
+    }
+    slot->cv.notify_all();
+  }
+
+  void fail_all(uint64_t err, const char* text) {
+    std::unordered_map<uint64_t, IciSlotPtr> victims;
+    {
+      std::lock_guard<std::mutex> g(slots_mu_);
+      victims.swap(slots_);
+    }
+    for (auto& kv : victims) {
+      {
+        std::lock_guard<std::mutex> g(kv.second->mu);
+        if (kv.second->done.load(std::memory_order_acquire)) continue;
+        kv.second->error_code = err;
+        kv.second->error_text = text;
+        kv.second->done.store(true, std::memory_order_release);
+      }
+      kv.second->cv.notify_all();
+    }
+  }
+
+ private:
+  int32_t local_dev_, remote_dev_;
+  std::atomic<uint64_t> next_cid_{0};
+  std::mutex slots_mu_;
+  std::unordered_map<uint64_t, IciSlotPtr> slots_;
+};
+using IciChannelPtr = std::shared_ptr<IciChannel>;
+
+// One accepted connection: the client→server credit window lives here
+// (requests are windowed; responses deliver into a waiting slot, so the
+// reverse direction cannot queue unboundedly in-process).
+struct IciConn {
+  uint64_t id = 0;
+  int32_t client_dev = 0;
+  std::weak_ptr<IciChannel> client;
+  std::shared_ptr<IciServer> server;
+  std::mutex wmu;
+  std::condition_variable wcv;
+  int64_t window_left = 0;
+  int64_t window_bytes = 0;
+  std::atomic<bool> closed{false};
+
+  void return_credits(int64_t n) {
+    {
+      std::lock_guard<std::mutex> g(wmu);
+      window_left = std::min(window_bytes, window_left + n);
+    }
+    wcv.notify_all();
+  }
+};
+using IciConnPtr = std::shared_ptr<IciConn>;
+
+struct IciMsg {
+  IciConnPtr conn;
+  uint64_t cid = 0;
+  std::string bytes;             // full TRPC frame (header+meta+payload+att)
+  std::vector<IciSegC> segs;
+  int64_t wire_bytes = 0;        // credits returned when consumed
+};
+
+// Dispatch discipline: the in-process transport's "IO thread" is the
+// CALLER — ici_do_call runs the server's frame processing inline on the
+// client thread (the reference's usercode-in-IO-thread default,
+// baidu_rpc_protocol.cpp:312, specialized to a loopback transport; this
+// box may have ONE core, where any thread-hop design serializes both
+// sides' wakeups and loses ~100 µs/round).  Python-tier handlers keep
+// their isolation anyway: the ServerBinding upcall parks user code on a
+// tasklet unless the server opted into usercode_inline.
+class IciServer : public std::enable_shared_from_this<IciServer> {
+ public:
+  // handler arrives at construction so the listener is never visible in
+  // a half-initialized state (a racing call between listen and a later
+  // set_handler would ENOMETHOD a method that exists)
+  explicit IciServer(int32_t dev, py_ici_request_fn handler)
+      : dev_(dev), handler_(handler) {}
+
+  void start() {}
+
+  void stop() {
+    stop_.store(true, std::memory_order_release);
+    std::vector<IciConnPtr> conns;
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      for (auto& kv : conns_) conns.push_back(kv.second);
+      conns_.clear();
+    }
+    for (auto& c : conns) {
+      c->closed.store(true, std::memory_order_release);
+      c->wcv.notify_all();
+      if (auto ch = c->client.lock())
+        ch->fail_all(1009, "ici server stopped");
+    }
+  }
+
+  int32_t dev() const { return dev_; }
+  void set_handle(uint64_t h) { handle_ = h; }
+  uint64_t handle() const { return handle_; }
+  uint64_t requests() const { return requests_.load(); }
+
+  void register_echo(const std::string& m) {
+    std::lock_guard<std::mutex> g(mmu_);
+    echo_methods_.insert({m, true});
+  }
+
+  void set_handler(py_ici_request_fn fn) {
+    handler_.store(fn, std::memory_order_release);
+  }
+
+  IciConnPtr accept(const IciChannelPtr& ch, int32_t client_dev,
+                    int64_t window_bytes) {
+    auto c = std::make_shared<IciConn>();
+    c->id = next_conn_id_.fetch_add(1) + 1;
+    c->client_dev = client_dev;
+    c->client = ch;
+    c->server = shared_from_this();
+    c->window_bytes = window_bytes;
+    c->window_left = window_bytes;
+    std::lock_guard<std::mutex> g(conns_mu_);
+    conns_[c->id] = c;
+    return c;
+  }
+
+  void drop_conn(uint64_t id) {
+    std::lock_guard<std::mutex> g(conns_mu_);
+    conns_.erase(id);
+  }
+
+  bool stopped() const { return stop_.load(std::memory_order_acquire); }
+
+  // Inline dispatch entry: runs on the caller's thread; returns the
+  // frame's credits to the connection when the frame is consumed.
+  void dispatch(IciMsg&& m) {
+    process(m);
+    // request frame consumed: return its credits (the piggybacked-ACK
+    // of the RDMA window; the reference replenishes on completion)
+    m.conn->return_credits(m.wire_bytes);
+  }
+
+ private:
+  void reply_error(const IciMsg& msg, uint64_t cid, uint64_t err,
+                   const std::string& text) {
+    if (auto ch = msg.conn->client.lock())
+      ch->deliver(cid, err, text, "", "", {});
+  }
+
+  void process(IciMsg& msg) {
+    const uint8_t* p = (const uint8_t*)msg.bytes.data();
+    size_t sz = msg.bytes.size();
+    if (sz < kHeaderSize || memcmp(p, kMagic, 4) != 0) {
+      ici_release_segs(msg.segs);
+      return;                         // malformed: drop (framing guard)
+    }
+    uint32_t meta_size = get_u32be(p + 4);
+    uint32_t body_size = get_u32be(p + 8);
+    if (kHeaderSize + (size_t)meta_size + body_size != sz) {
+      ici_release_segs(msg.segs);
+      return;
+    }
+    RpcMeta meta;
+    if (!decode_meta(p + kHeaderSize, p + kHeaderSize + meta_size, &meta)) {
+      ici_release_segs(msg.segs);
+      return;
+    }
+    requests_.fetch_add(1, std::memory_order_relaxed);
+    const uint8_t* body = p + kHeaderSize + meta_size;
+    // body = payload + host-attachment bytes; attachment_size in the meta
+    // counts host attachment bytes only (device bytes ride the sidecar)
+    size_t att = std::min((size_t)meta.attachment_size, (size_t)body_size);
+    size_t payload_len = body_size - att;
+    std::string full = meta.request.service_name + "." +
+                       meta.request.method_name;
+    uint64_t cid = meta.correlation_id;
+    bool is_echo;
+    {
+      std::lock_guard<std::mutex> g(mmu_);
+      is_echo = echo_methods_.count(full) != 0;
+    }
+    if (is_echo) {
+      // native echo tier: refs pass through toward the client (resident
+      // refs = zero upcalls, the pure-HBM round trip)
+      if (!ici_relocate_segs(msg.segs, msg.conn->client_dev)) {
+        ici_release_segs(msg.segs);
+        reply_error(msg, cid, 1009, "ici relocation failed");
+        return;
+      }
+      if (auto ch = msg.conn->client.lock()) {
+        ch->deliver(cid, 0, "",
+                    std::string((const char*)body, payload_len),
+                    std::string((const char*)body + payload_len, att),
+                    std::move(msg.segs));
+      } else {
+        ici_release_segs(msg.segs);
+      }
+      return;
+    }
+    py_ici_request_fn h = handler_.load(std::memory_order_acquire);
+    if (h != nullptr) {
+      // user-code tier: refs land resident on the SERVER device before
+      // the handler sees them (the test contract: a handler observes its
+      // attachment in local HBM)
+      if (!ici_relocate_segs(msg.segs, dev_)) {
+        ici_release_segs(msg.segs);
+        reply_error(msg, cid, 1009, "ici relocation failed");
+        return;
+      }
+      uint64_t token = register_token(msg.conn, cid);
+      h(token, full.c_str(), body, payload_len, body + payload_len, att,
+        msg.segs.data(), msg.segs.size(), meta.request.log_id,
+        msg.conn->client_dev);
+      // the upcall TOOK the refs (Python popped them into its IOBuf):
+      // native custody ends without release
+      msg.segs.clear();
+      return;
+    }
+    ici_release_segs(msg.segs);
+    reply_error(msg, cid, 1002, "no method " + full);
+  }
+
+  uint64_t register_token(const IciConnPtr& conn, uint64_t cid);
+
+  int32_t dev_;
+  uint64_t handle_ = 0;
+  std::atomic<bool> stop_{false};
+  std::mutex conns_mu_;
+  std::unordered_map<uint64_t, IciConnPtr> conns_;
+  std::atomic<uint64_t> next_conn_id_{0};
+  std::mutex mmu_;
+  std::unordered_map<std::string, bool> echo_methods_;
+  std::atomic<py_ici_request_fn> handler_{nullptr};
+  std::atomic<uint64_t> requests_{0};
+};
+using IciServerPtr = std::shared_ptr<IciServer>;
+
+struct IciPending {
+  std::weak_ptr<IciConn> conn;
+  uint64_t cid = 0;
+};
+
+static std::mutex g_ici_mu;
+static std::unordered_map<int32_t, IciServerPtr> g_ici_listeners;
+static std::unordered_map<uint64_t, IciServerPtr> g_ici_servers;  // by handle
+static std::unordered_map<uint64_t, std::pair<IciChannelPtr, IciConnPtr>>
+    g_ici_channels;
+static std::mutex g_ici_tokens_mu;
+static std::unordered_map<uint64_t, IciPending> g_ici_tokens;
+static std::atomic<uint64_t> g_ici_next_token{1};
+
+uint64_t IciServer::register_token(const IciConnPtr& conn, uint64_t cid) {
+  uint64_t token = g_ici_next_token.fetch_add(1);
+  std::lock_guard<std::mutex> g(g_ici_tokens_mu);
+  g_ici_tokens[token] = IciPending{conn, cid};
+  return token;
+}
+
+// The client-side unary call: window reservation → TRPC frame encode →
+// relocation toward the server → queue hop → slot wait (spin, then park).
+static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
+                            const char* service_dot_method,
+                            const uint8_t* req, uint64_t req_len,
+                            const uint8_t* att_host, uint64_t att_host_len,
+                            std::vector<IciSegC> segs, int64_t timeout_us,
+                            IciSlot* out, std::string* err_text) {
+  IciServerPtr srv = conn->server;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::microseconds(timeout_us > 0 ? timeout_us
+                                                           : (int64_t)1e12);
+  // ---- encode the frame (the same codec the TCP path uses) ----
+  RpcMeta meta;
+  meta.request.present = true;
+  const char* dot = strrchr(service_dot_method, '.');
+  if (dot == nullptr) {
+    meta.request.method_name = service_dot_method;
+  } else {
+    meta.request.service_name.assign(service_dot_method,
+                                     dot - service_dot_method);
+    meta.request.method_name = dot + 1;
+  }
+  uint64_t cid;
+  IciSlotPtr slot = ch->make_slot(&cid);
+  meta.correlation_id = cid;
+  meta.attachment_size = att_host_len;
+  if (timeout_us > 0) meta.request.timeout_ms = (uint64_t)(timeout_us / 1000);
+  std::string frame = pack_head(meta, req_len + att_host_len);
+  frame.append((const char*)req, req_len);
+  frame.append((const char*)att_host, att_host_len);
+  int64_t dev_bytes = 0;
+  for (const auto& s : segs)
+    if (s.is_dev) dev_bytes += (int64_t)s.nbytes;
+  int64_t wire = (int64_t)frame.size() + dev_bytes;
+
+  // ---- window reservation (check-and-reserve under one lock — the
+  // AppendIfNotFull discipline, stream.cpp:274) ----
+  if (wire > conn->window_bytes) {
+    // can NEVER fit: fail now instead of burning the whole rpc deadline
+    ch->erase_slot(cid);
+    ici_release_segs(segs);
+    *err_text = "frame larger than the ici send window";
+    return 1011;  // EOVERCROWDED (rpc/errors.py)
+  }
+  {
+    std::unique_lock<std::mutex> g(conn->wmu);
+    while (conn->window_left < wire) {
+      if (conn->closed.load(std::memory_order_acquire) || srv->stopped()) {
+        g.unlock();
+        ch->erase_slot(cid);
+        ici_release_segs(segs);
+        *err_text = "ici peer closed while window full";
+        return 1009;
+      }
+      if (conn->wcv.wait_until(g, deadline) == std::cv_status::timeout) {
+        g.unlock();
+        ch->erase_slot(cid);
+        ici_release_segs(segs);
+        *err_text = "ici send window stalled (peer not consuming)";
+        return 1011;  // EOVERCROWDED (rpc/errors.py)
+      }
+    }
+    conn->window_left -= wire;
+  }
+  if (conn->closed.load(std::memory_order_acquire) || srv->stopped()) {
+    ch->erase_slot(cid);
+    ici_release_segs(segs);
+    conn->return_credits(wire);
+    *err_text = "ici peer closed";
+    return 1009;
+  }
+  // ---- relocate toward the server's device (HBM→HBM; resident = noop),
+  // then hand the frame to the server queue ----
+  if (!ici_relocate_segs(segs, srv->dev())) {
+    ch->erase_slot(cid);
+    ici_release_segs(segs);
+    conn->return_credits(wire);
+    *err_text = "ici relocation failed";
+    return 1009;
+  }
+  IciMsg msg;
+  msg.conn = conn;
+  msg.cid = cid;
+  msg.bytes = std::move(frame);
+  msg.segs = std::move(segs);
+  msg.wire_bytes = wire;
+  srv->dispatch(std::move(msg));   // inline: caller is the IO thread
+
+  // ---- wait.  The native echo tier already delivered synchronously
+  // (the common case: done before we get here, zero parks).  A Python
+  // handler completes from its tasklet thread → park on the condvar.
+  if (!slot->done.load(std::memory_order_acquire)) {
+    std::unique_lock<std::mutex> g(slot->mu);
+    while (!slot->done.load(std::memory_order_acquire)) {
+      if (slot->cv.wait_until(g, deadline) == std::cv_status::timeout) {
+        g.unlock();
+        ch->erase_slot(cid);   // late response finds no slot → dropped
+        *err_text = "rpc timeout";
+        return 1008;
+      }
+    }
+  }
+  std::lock_guard<std::mutex> g(slot->mu);
+  out->error_code = slot->error_code;
+  out->error_text = std::move(slot->error_text);
+  out->payload = std::move(slot->payload);
+  out->att_host = std::move(slot->att_host);
+  out->segs = std::move(slot->segs);
+  *err_text = out->error_text;
+  return out->error_code;
+}
+
+// ====================================================================
 // handle registries.  shared_ptr ownership: a stop/close erases the map
 // entry, but callers that already resolved the handle keep the object
 // alive until they return — no free-under-caller (the registry is the
@@ -1244,6 +1746,273 @@ double brpc_tpu_native_rpc_qps(int threads, int duration_ms,
   return count.load() / secs;
 }
 
+// ---- ici:// plane ----
+
+void brpc_tpu_ici_set_hooks(nrpc::py_relocate_fn relocate,
+                            nrpc::py_release_fn release) {
+  nrpc::g_ici_relocate.store(relocate, std::memory_order_release);
+  nrpc::g_ici_release.store(release, std::memory_order_release);
+}
+
+// Returns a server handle; 0 when the device id is already listening.
+// The Python handler (may be null for echo-only servers) is installed
+// BEFORE the listener becomes visible — no half-initialized window.
+uint64_t brpc_tpu_ici_listen(int32_t dev, nrpc::py_ici_request_fn handler) {
+  auto s = std::make_shared<nrpc::IciServer>(dev, handler);
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+    if (nrpc::g_ici_listeners.count(dev)) return 0;
+    uint64_t h = nrpc::g_next_handle.fetch_add(1);
+    s->set_handle(h);
+    nrpc::g_ici_listeners[dev] = s;
+    nrpc::g_ici_servers[h] = s;
+  }
+  s->start();
+  return s->handle();
+}
+
+int brpc_tpu_ici_register_echo(uint64_t h, const char* full_method) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  auto it = nrpc::g_ici_servers.find(h);
+  if (it == nrpc::g_ici_servers.end()) return -1;
+  it->second->register_echo(full_method);
+  return 0;
+}
+
+int brpc_tpu_ici_set_handler(uint64_t h, nrpc::py_ici_request_fn fn) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  auto it = nrpc::g_ici_servers.find(h);
+  if (it == nrpc::g_ici_servers.end()) return -1;
+  it->second->set_handler(fn);
+  return 0;
+}
+
+uint64_t brpc_tpu_ici_requests(uint64_t h) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  auto it = nrpc::g_ici_servers.find(h);
+  return it == nrpc::g_ici_servers.end() ? 0 : it->second->requests();
+}
+
+// 1 when a native listener exists for this device id.
+int brpc_tpu_ici_has_listener(int32_t dev) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  return nrpc::g_ici_listeners.count(dev) ? 1 : 0;
+}
+
+void brpc_tpu_ici_unlisten(uint64_t h) {
+  nrpc::IciServerPtr s;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+    auto it = nrpc::g_ici_servers.find(h);
+    if (it == nrpc::g_ici_servers.end()) return;
+    s = it->second;
+    nrpc::g_ici_servers.erase(it);
+    nrpc::g_ici_listeners.erase(s->dev());
+  }
+  {
+    // purge this server's in-flight Python-handler tokens
+    std::lock_guard<std::mutex> g(nrpc::g_ici_tokens_mu);
+    for (auto it = nrpc::g_ici_tokens.begin();
+         it != nrpc::g_ici_tokens.end();) {
+      auto conn = it->second.conn.lock();
+      if (conn == nullptr || conn->server == s)
+        it = nrpc::g_ici_tokens.erase(it);
+      else
+        ++it;
+    }
+  }
+  s->stop();
+}
+
+// Connect local_dev → the native listener at remote_dev; returns a
+// channel handle (0 = no listener).
+uint64_t brpc_tpu_ici_connect(int32_t local_dev, int32_t remote_dev,
+                              int64_t window_bytes) {
+  nrpc::IciServerPtr srv;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+    auto it = nrpc::g_ici_listeners.find(remote_dev);
+    if (it == nrpc::g_ici_listeners.end()) return 0;
+    srv = it->second;
+  }
+  auto ch = std::make_shared<nrpc::IciChannel>(local_dev, remote_dev);
+  auto conn = srv->accept(ch, local_dev,
+                          window_bytes > 0 ? window_bytes : (4 << 20));
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  uint64_t h = nrpc::g_next_handle.fetch_add(1);
+  nrpc::g_ici_channels[h] = {ch, conn};
+  return h;
+}
+
+void brpc_tpu_ici_close(uint64_t h) {
+  std::pair<nrpc::IciChannelPtr, nrpc::IciConnPtr> entry;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+    auto it = nrpc::g_ici_channels.find(h);
+    if (it == nrpc::g_ici_channels.end()) return;
+    entry = it->second;
+    nrpc::g_ici_channels.erase(it);
+  }
+  entry.second->closed.store(true, std::memory_order_release);
+  entry.second->server->drop_conn(entry.second->id);
+  entry.first->fail_all(1009, "channel closed");
+}
+
+int64_t brpc_tpu_ici_window_left(uint64_t h) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  auto it = nrpc::g_ici_channels.find(h);
+  if (it == nrpc::g_ici_channels.end()) return -1;
+  std::lock_guard<std::mutex> wg(it->second.second->wmu);
+  return it->second.second->window_left;
+}
+
+// Unary call.  Outputs are malloc'd (brpc_tpu_buf_free); response device
+// refs land in *segs_out (caller takes their keys from the registry).
+uint64_t brpc_tpu_ici_call(uint64_t h, const char* method,
+                           const uint8_t* req, uint64_t req_len,
+                           const uint8_t* att_host, uint64_t att_host_len,
+                           const nrpc::IciSegC* segs, uint64_t nsegs,
+                           int64_t timeout_us, uint8_t** resp_out,
+                           uint64_t* resp_len, uint8_t** att_out,
+                           uint64_t* att_out_len,
+                           nrpc::IciSegC** segs_out, uint64_t* nsegs_out,
+                           char** err_text_out) {
+  *resp_out = nullptr; *resp_len = 0;
+  *att_out = nullptr; *att_out_len = 0;
+  *segs_out = nullptr; *nsegs_out = 0;
+  *err_text_out = nullptr;
+  std::pair<nrpc::IciChannelPtr, nrpc::IciConnPtr> entry;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+    auto it = nrpc::g_ici_channels.find(h);
+    if (it != nrpc::g_ici_channels.end()) entry = it->second;
+  }
+  std::vector<nrpc::IciSegC> seg_vec(segs, segs + nsegs);
+  if (entry.first == nullptr) {
+    nrpc::ici_release_segs(seg_vec);
+    return 1009;
+  }
+  nrpc::IciSlot out;
+  std::string err_text;
+  uint64_t rc = nrpc::ici_do_call(entry.first, entry.second, method, req,
+                                  req_len, att_host, att_host_len,
+                                  std::move(seg_vec), timeout_us, &out,
+                                  &err_text);
+  if (!out.payload.empty()) {
+    *resp_out = (uint8_t*)malloc(out.payload.size());
+    memcpy(*resp_out, out.payload.data(), out.payload.size());
+    *resp_len = out.payload.size();
+  }
+  if (!out.att_host.empty()) {
+    *att_out = (uint8_t*)malloc(out.att_host.size());
+    memcpy(*att_out, out.att_host.data(), out.att_host.size());
+    *att_out_len = out.att_host.size();
+  }
+  if (!out.segs.empty()) {
+    *segs_out = (nrpc::IciSegC*)malloc(out.segs.size() *
+                                       sizeof(nrpc::IciSegC));
+    memcpy(*segs_out, out.segs.data(),
+           out.segs.size() * sizeof(nrpc::IciSegC));
+    *nsegs_out = out.segs.size();
+  }
+  if (!err_text.empty()) {
+    *err_text_out = (char*)malloc(err_text.size() + 1);
+    memcpy(*err_text_out, err_text.c_str(), err_text.size() + 1);
+  }
+  return rc;
+}
+
+// Respond to a Python-handled ici request.  Custody of `segs` keys
+// transfers to native here; they exit into the client's take (or are
+// released on drop paths).
+int brpc_tpu_ici_respond(uint64_t token, uint64_t err, const char* err_text,
+                         const uint8_t* data, uint64_t len,
+                         const uint8_t* att_host, uint64_t att_host_len,
+                         const nrpc::IciSegC* segs, uint64_t nsegs) {
+  nrpc::IciPending pr;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_ici_tokens_mu);
+    auto it = nrpc::g_ici_tokens.find(token);
+    if (it == nrpc::g_ici_tokens.end()) return -1;
+    pr = it->second;
+    nrpc::g_ici_tokens.erase(it);
+  }
+  std::vector<nrpc::IciSegC> seg_vec(segs, segs + nsegs);
+  auto conn = pr.conn.lock();
+  if (conn == nullptr) {
+    nrpc::ici_release_segs(seg_vec);
+    return -2;
+  }
+  if (!nrpc::ici_relocate_segs(seg_vec, conn->client_dev)) {
+    nrpc::ici_release_segs(seg_vec);
+    if (auto ch = conn->client.lock())
+      ch->deliver(pr.cid, 1009, "ici relocation failed", "", "", {});
+    return -3;
+  }
+  auto ch = conn->client.lock();
+  if (ch == nullptr) {
+    nrpc::ici_release_segs(seg_vec);
+    return -2;
+  }
+  ch->deliver(pr.cid, err, err_text ? err_text : "",
+              std::string((const char*)data, len),
+              std::string((const char*)att_host, att_host_len),
+              std::move(seg_vec));
+  return 0;
+}
+
+// Native-loop ici echo benchmark: the C++ client loop of the reference's
+// rdma_performance client.  dev_key names a pre-registered device array
+// (borrowed for the duration — never released here); dev_nbytes 0 runs
+// the host-only frame.  Returns p50 ns (-1 on failure).
+int64_t brpc_tpu_ici_echo_p50_ns(int iters, int payload_len,
+                                 uint64_t dev_key, uint64_t dev_nbytes,
+                                 int32_t dev) {
+  uint64_t sh = brpc_tpu_ici_listen(dev, nullptr);
+  if (sh == 0) return -1;
+  brpc_tpu_ici_register_echo(sh, "EchoService.Echo");
+  uint64_t ch = brpc_tpu_ici_connect(dev, dev, 0);
+  if (ch == 0) {
+    brpc_tpu_ici_unlisten(sh);
+    return -1;
+  }
+  std::pair<nrpc::IciChannelPtr, nrpc::IciConnPtr> entry;
+  {
+    std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+    entry = nrpc::g_ici_channels[ch];
+  }
+  std::string payload(payload_len, 'x');
+  std::vector<int64_t> lat;
+  lat.reserve(iters);
+  auto now_ns = [] {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  };
+  bool ok = true;
+  for (int i = 0; i < iters + 50 && ok; ++i) {
+    std::vector<nrpc::IciSegC> segs;
+    if (dev_nbytes > 0)
+      segs.push_back(nrpc::IciSegC{dev_key, dev_nbytes, dev, 1});
+    nrpc::IciSlot out;
+    std::string err;
+    int64_t t0 = now_ns();
+    uint64_t rc = nrpc::ici_do_call(
+        entry.first, entry.second, "EchoService.Echo",
+        (const uint8_t*)payload.data(), payload.size(), nullptr, 0,
+        std::move(segs), 5 * 1000 * 1000, &out, &err);
+    int64_t t1 = now_ns();
+    ok = (rc == 0 && out.payload.size() == payload.size() &&
+          out.segs.size() == (dev_nbytes > 0 ? 1u : 0u));
+    if (ok && i >= 50) lat.push_back(t1 - t0);
+  }
+  brpc_tpu_ici_close(ch);
+  brpc_tpu_ici_unlisten(sh);
+  if (!ok || lat.empty()) return -1;
+  std::sort(lat.begin(), lat.end());
+  return lat[lat.size() / 2];
+}
+
 // Large-request throughput, 1 client → 1 server (the reference's headline
 // "2.3 GB/s pooled large messages" config, docs/cn/benchmark.md:104).
 // `threads` concurrent callers on separate connections keep the pipe
@@ -1314,6 +2083,28 @@ void brpc_tpu_nchannel_close(uint64_t) {}
 int64_t brpc_tpu_native_rpc_echo_p50_ns(int, int) { return -1; }
 double brpc_tpu_native_rpc_qps(int, int, int) { return -1.0; }
 double brpc_tpu_native_rpc_throughput_gbps(int, int, int) { return -1.0; }
+void brpc_tpu_ici_set_hooks(void*, void*) {}
+uint64_t brpc_tpu_ici_listen(int32_t, void*) { return 0; }
+int brpc_tpu_ici_register_echo(uint64_t, const char*) { return -1; }
+int brpc_tpu_ici_set_handler(uint64_t, void*) { return -1; }
+uint64_t brpc_tpu_ici_requests(uint64_t) { return 0; }
+int brpc_tpu_ici_has_listener(int32_t) { return 0; }
+void brpc_tpu_ici_unlisten(uint64_t) {}
+uint64_t brpc_tpu_ici_connect(int32_t, int32_t, int64_t) { return 0; }
+void brpc_tpu_ici_close(uint64_t) {}
+int64_t brpc_tpu_ici_window_left(uint64_t) { return -1; }
+uint64_t brpc_tpu_ici_call(uint64_t, const char*, const uint8_t*, uint64_t,
+                           const uint8_t*, uint64_t, const void*, uint64_t,
+                           int64_t, uint8_t**, uint64_t*, uint8_t**,
+                           uint64_t*, void**, uint64_t*, char**) {
+  return 1009;
+}
+int brpc_tpu_ici_respond(uint64_t, uint64_t, const char*, const uint8_t*,
+                         uint64_t, const uint8_t*, uint64_t, const void*,
+                         uint64_t) { return -1; }
+int64_t brpc_tpu_ici_echo_p50_ns(int, int, uint64_t, uint64_t, int32_t) {
+  return -1;
+}
 }
 
 #endif

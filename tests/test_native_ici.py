@@ -1,0 +1,562 @@
+"""Native ici:// datapath (native/rpc.cpp ici plane + ici/native_plane.py).
+
+The fusion VERDICT r3 #1 demanded: framing, window accounting, dispatch and
+correlation in C++, with Python upcalled only for device-ref relocation.
+These tests pin down the custody discipline (no registry leaks on ANY
+path), the credit window, cross-device relocation on the 8-device CPU
+mesh, and interop with the rpc.Server/Channel front doors.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import brpc_tpu.policy  # noqa: F401  (registers protocols)
+from brpc_tpu import rpc, ici
+from brpc_tpu.ici import native_plane
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+pytestmark = pytest.mark.skipif(not native_plane.available(),
+                                reason="native core unavailable")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+    m = ici.IciMesh(jax.devices())
+    ici.IciMesh.set_default(m)
+    return m
+
+
+def _device_payload(mesh, dev=0, n=4096):
+    import jax
+    import jax.numpy as jnp
+    arr = jax.device_put(jnp.arange(n, dtype=jnp.uint8), mesh.device(dev))
+    jax.block_until_ready(arr)
+    return arr
+
+
+class EchoService(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        if len(cntl.request_attachment):
+            cntl.response_attachment.append(cntl.request_attachment)
+        done()
+
+
+class TestNativeDatapath:
+    def test_channel_rides_native_plane(self, mesh):
+        """rpc.Channel → ici:// routes through the C++ plane: the native
+        request counter moves, and the registry never leaks."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://2") == 0
+        try:
+            binding = getattr(server, "_native_ici", None)
+            assert binding is not None, "native ici plane not attached"
+            ch = rpc.Channel()
+            ch.init("ici://2")
+            payload = _device_payload(mesh)
+            before = binding.requests()
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="native"),
+                                  EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "native"
+            assert cntl.response_attachment.to_bytes() == bytes(
+                np.arange(4096, dtype=np.uint8))
+            assert binding.requests() == before + 1
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_native_echo_tier_and_relocation(self, mesh):
+        """Compiled echo tier: zero Python dispatch; a payload resident on
+        another mesh device is relocated toward the CLIENT device on the
+        way back (the rdma zero-copy SGE pass-through)."""
+        if mesh.size < 2:
+            pytest.skip("needs >=2 devices")
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://3") == 0
+        try:
+            server._native_ici.register_native_echo("EchoService.Echo")
+            ch = rpc.Channel()
+            ch.init("ici://3")
+            payload = _device_payload(mesh, dev=1)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="m"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            refs = cntl.response_attachment.device_refs()
+            assert len(refs) == 1
+            # echoed ref was relocated to the channel's local device
+            # (ici_connect default: the neighbor of ici://3 → device 4)
+            local_dev = ch._native_ici.local_dev
+            assert {str(d) for d in refs[0].block.data.devices()} == \
+                {str(mesh.device(local_dev))}
+            assert cntl.response_attachment.to_bytes() == bytes(
+                np.arange(4096, dtype=np.uint8))
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_handler_sees_resident_attachment(self, mesh):
+        """Python-tier handler observes its device refs already resident
+        on the SERVER device (relocation happened before the upcall)."""
+        if mesh.size < 3:
+            pytest.skip("needs >=3 devices")
+        seen = {}
+
+        class Probe(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def P(self, cntl, request, response, done):
+                refs = cntl.request_attachment.device_refs()
+                seen["devs"] = {str(d) for r in refs
+                                for d in r.block.data.devices()}
+                response.message = "ok"
+                done()
+
+        server = rpc.Server()
+        server.add_service(Probe())
+        assert server.start("ici://4") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://4")
+            payload = _device_payload(mesh, dev=2)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("Probe.P", cntl, EchoRequest(message="x"),
+                           EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert seen["devs"] == {str(mesh.device(4))}
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_mixed_host_device_attachment_order(self, mesh):
+        """Interleaved host/device attachment segments keep their order
+        across the plane (the segment-descriptor sidecar)."""
+        got = {}
+
+        class Mix(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def M(self, cntl, request, response, done):
+                got["bytes"] = cntl.request_attachment.to_bytes()
+                got["blocks"] = [
+                    cntl.request_attachment.backing_block(i).block.kind
+                    for i in range(
+                        cntl.request_attachment.backing_block_num())]
+                response.message = "ok"
+                done()
+
+        server = rpc.Server()
+        server.add_service(Mix())
+        assert server.start("ici://5") == 0
+        try:
+            from brpc_tpu.butil.iobuf import DEVICE, HOST
+            ch = rpc.Channel()
+            ch.init("ici://5")
+            payload = _device_payload(mesh, n=16)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append(b"head-")
+            cntl.request_attachment.append_device_array(payload)
+            cntl.request_attachment.append(b"-tail")
+            ch.call_method("Mix.M", cntl, EchoRequest(message="x"),
+                           EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert got["bytes"] == b"head-" + bytes(range(16)) + b"-tail"
+            assert got["blocks"][0] == HOST
+            assert DEVICE in got["blocks"]
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_error_paths_release_custody(self, mesh):
+        """ENOMETHOD with a device attachment must release the refs (the
+        drop-path release upcall), not leak them pinned forever."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://6") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://6")
+            payload = _device_payload(mesh)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("NoSuch.Method", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code_ == rpc.errors.ENOMETHOD
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_timeout_drops_late_response_and_releases(self, mesh):
+        """A handler answering after the client deadline: the client gets
+        ERPCTIMEDOUT, the late response is dropped, custody released."""
+        release = threading.Event()
+        responded = threading.Event()
+
+        class Slow(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def S(self, cntl, request, response, done):
+                def later():
+                    release.wait(5)
+                    if len(cntl.request_attachment):
+                        cntl.response_attachment.append(
+                            cntl.request_attachment)
+                    response.message = "late"
+                    done()
+                    responded.set()
+                threading.Thread(target=later, daemon=True).start()
+
+        server = rpc.Server()
+        server.add_service(Slow())
+        assert server.start("ici://7") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://7",
+                    options=rpc.ChannelOptions(timeout_ms=150, max_retry=0))
+            payload = _device_payload(mesh)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("Slow.S", cntl, EchoRequest(message="x"),
+                           EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code_ == rpc.errors.ERPCTIMEDOUT
+            release.set()
+            assert responded.wait(5)
+            deadline = time.monotonic() + 5
+            while native_plane.registry().live() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert native_plane.registry().live() == 0
+        finally:
+            release.set()
+            server.stop()
+
+    def test_oversize_frame_fails_fast(self, mesh):
+        """A frame that can never fit the send window fails EOVERCROWDED
+        immediately instead of burning the whole deadline."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://8") == 0
+        try:
+            binding = native_plane.ChannelBinding(8, window_bytes=1024)
+            try:
+                cntl = rpc.Controller()
+                cntl.timeout_ms = 10000
+                cntl.request_attachment.append(b"x" * 8192)
+                t0 = time.monotonic()
+                binding.call("EchoService.Echo", cntl,
+                             EchoRequest(message="x"), EchoResponse)
+                assert cntl.failed()
+                assert cntl.error_code_ == rpc.errors.EOVERCROWDED
+                assert time.monotonic() - t0 < 2.0   # did NOT wait 10 s
+            finally:
+                binding.close()
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_concurrent_callers(self, mesh):
+        """Many threads over one channel: correlation never crosses wires
+        and nothing leaks."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://9") == 0
+        errs = []
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://9")
+
+            def worker(wid):
+                try:
+                    for i in range(25):
+                        cntl = rpc.Controller()
+                        msg = f"w{wid}-{i}"
+                        resp = ch.call_method("EchoService.Echo", cntl,
+                                              EchoRequest(message=msg),
+                                              EchoResponse)
+                        assert not cntl.failed(), cntl.error_text
+                        assert resp.message == msg
+                except Exception as e:   # pragma: no cover
+                    errs.append(e)
+
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_server_stop_fails_inflight_cleanly(self, mesh):
+        """Channel outliving its server gets EFAILEDSOCKET, and a fresh
+        server on the same device id serves a fresh channel."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://10") == 0
+        ch = rpc.Channel()
+        ch.init("ici://10")
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Echo", cntl,
+                       EchoRequest(message="a"), EchoResponse)
+        assert not cntl.failed()
+        server.stop()
+        cntl = rpc.Controller()
+        ch.call_method("EchoService.Echo", cntl,
+                       EchoRequest(message="b"), EchoResponse)
+        assert cntl.failed()
+        # fresh server, fresh channel: the device id is reusable
+        server2 = rpc.Server()
+        server2.add_service(EchoService())
+        assert server2.start("ici://10") == 0
+        try:
+            ch2 = rpc.Channel()
+            ch2.init("ici://10")
+            cntl = rpc.Controller()
+            resp = ch2.call_method("EchoService.Echo", cntl,
+                                   EchoRequest(message="c"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "c"
+        finally:
+            server2.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_async_done_callback(self, mesh):
+        """done= callbacks run off the caller thread and see the filled
+        controller (the ParallelChannel composition contract)."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://11") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://11")
+            ev = threading.Event()
+            out = {}
+
+            def done(cntl):
+                out["failed"] = cntl.failed()
+                out["resp"] = cntl.response
+                ev.set()
+
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="async"), EchoResponse,
+                           done=done)
+            assert ev.wait(10)
+            assert out["failed"] is False
+            assert out["resp"].message == "async"
+        finally:
+            server.stop()
+
+
+class TestReviewFindings:
+    """Regression pins for the r4 code-review findings."""
+
+    def test_channel_survives_server_restart(self, mesh):
+        """A long-lived Channel must keep working across a server restart
+        (the cached native conn is invalidated and the call re-routes)."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://12") == 0
+        ch = rpc.Channel()
+        ch.init("ici://12")
+        cntl = rpc.Controller()
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="one"), EchoResponse)
+        assert not cntl.failed() and resp.message == "one"
+        server.stop()
+        server2 = rpc.Server()
+        server2.add_service(EchoService())
+        assert server2.start("ici://12") == 0
+        try:
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="two"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "two"
+        finally:
+            server2.stop()
+        assert native_plane.registry().live() == 0
+
+    def test_oversize_attachment_falls_back_to_python_plane(self, mesh):
+        """An attachment bigger than the native send window rides the
+        Python plane (which drains it chunkwise) instead of failing."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://13") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://13",
+                    options=rpc.ChannelOptions(timeout_ms=60000,
+                                               max_retry=0))
+            big = b"z" * (6 * 1024 * 1024)      # > the 4MB native window
+            cntl = rpc.Controller()
+            cntl.request_attachment.append(big)
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="big"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "big"
+            assert cntl.response_attachment.to_bytes() == big
+        finally:
+            server.stop()
+
+    def test_no_deadline_means_no_deadline(self, mesh):
+        """timeout_ms=0 over the native plane waits, matching the Python
+        plane's no-deadline semantics (not a silent 5s default)."""
+        gate = threading.Event()
+
+        class Slowish(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def S(self, cntl, request, response, done):
+                def later():
+                    gate.wait(10)
+                    response.message = "eventually"
+                    done()
+                threading.Thread(target=later, daemon=True).start()
+
+        server = rpc.Server()
+        server.add_service(Slowish())
+        assert server.start("ici://14") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://14", options=rpc.ChannelOptions(timeout_ms=0))
+            out = {}
+            def call():
+                cntl = rpc.Controller()
+                cntl.timeout_ms = 0
+                out["resp"] = ch.call_method(
+                    "Slowish.S", cntl, EchoRequest(message="x"),
+                    EchoResponse)
+                out["failed"] = cntl.failed()
+            t = threading.Thread(target=call, daemon=True)
+            t.start()
+            time.sleep(0.3)
+            assert t.is_alive()          # still waiting, not timed out
+            gate.set()
+            t.join(10)
+            assert not t.is_alive()
+            assert out["failed"] is False
+            assert out["resp"].message == "eventually"
+        finally:
+            gate.set()
+            server.stop()
+
+    def test_out_of_mesh_array_still_relocates(self, mesh):
+        """An attachment on a device OUTSIDE the mesh gets dev=-1 and is
+        relocated via the upcall (never silently passed through)."""
+        import jax
+        if len(jax.devices()) == mesh.size:
+            # build a smaller mesh so an out-of-mesh device exists
+            if mesh.size < 2:
+                pytest.skip("needs >=2 devices")
+            small = ici.IciMesh(jax.devices()[:1])
+            old = mesh
+            ici.IciMesh.set_default(small)
+            try:
+                seen = {}
+
+                class Probe(rpc.Service):
+                    @rpc.method(EchoRequest, EchoResponse)
+                    def P(self, cntl, request, response, done):
+                        refs = cntl.request_attachment.device_refs()
+                        seen["devs"] = {str(d) for r in refs
+                                        for d in r.block.data.devices()}
+                        response.message = "ok"
+                        done()
+
+                server = rpc.Server()
+                server.add_service(Probe())
+                assert server.start("ici://0") == 0
+                try:
+                    import jax.numpy as jnp
+                    outside = jax.device_put(
+                        jnp.arange(64, dtype=jnp.uint8), jax.devices()[1])
+                    jax.block_until_ready(outside)
+                    ch = rpc.Channel()
+                    ch.init("ici://0")
+                    cntl = rpc.Controller()
+                    cntl.request_attachment.append_device_array(outside)
+                    ch.call_method("Probe.P", cntl,
+                                   EchoRequest(message="x"), EchoResponse)
+                    assert not cntl.failed(), cntl.error_text
+                    # resident on the SERVER's mesh device, not the
+                    # out-of-mesh source
+                    assert seen["devs"] == {str(small.device(0))}
+                finally:
+                    server.stop()
+            finally:
+                ici.IciMesh.set_default(old)
+        assert native_plane.registry().live() == 0
+
+
+class TestAsyncPoolSafety:
+    def test_async_calls_beyond_pool_size_complete(self, mesh):
+        """More concurrent async (done=) calls than bthread workers, each
+        parking in the native condvar while its Python-tier handler needs
+        a tasklet: blocked-worker compensation must keep the pool live
+        (review finding r4: without note_worker_blocked this deadlocks
+        until timeout)."""
+        class Nap(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def N(self, cntl, request, response, done):
+                time.sleep(0.05)
+                response.message = request.message
+                done()
+
+        server = rpc.Server()
+        server.add_service(Nap())
+        assert server.start("ici://15") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://15",
+                    options=rpc.ChannelOptions(timeout_ms=10000))
+            n = 8                       # > bthread_concurrency default (4)
+            evs = [threading.Event() for _ in range(n)]
+            outs = [None] * n
+
+            def make_done(i):
+                def done(cntl):
+                    outs[i] = (cntl.failed(), cntl.response)
+                    evs[i].set()
+                return done
+
+            t0 = time.monotonic()
+            for i in range(n):
+                cntl = rpc.Controller()
+                ch.call_method("Nap.N", cntl,
+                               EchoRequest(message=f"m{i}"), EchoResponse,
+                               done=make_done(i))
+            for i, ev in enumerate(evs):
+                assert ev.wait(8), f"call {i} never completed (deadlock?)"
+            assert time.monotonic() - t0 < 8
+            for i, (failed, resp) in enumerate(outs):
+                assert failed is False
+                assert resp.message == f"m{i}"
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
+
+class TestNativeLoopBench:
+    def test_cpp_loop_echo_runs(self, mesh):
+        p50 = native_plane.native_ici_echo_p50_us(200, 64)
+        assert p50 > 0
+        arr = _device_payload(mesh, n=1024)
+        p50d = native_plane.native_ici_echo_p50_us(200, 64,
+                                                   device_array=arr)
+        assert p50d > 0
+        assert native_plane.registry().live() == 0
