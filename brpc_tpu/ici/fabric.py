@@ -115,11 +115,15 @@ class FabricNode:
     def initialize(cls, coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
                    process_id: Optional[int] = None,
-                   host_ip: str = "127.0.0.1") -> "FabricNode":
+                   host_ip: Optional[str] = None) -> "FabricNode":
         """Join the fabric.  Calls jax.distributed.initialize when the
         coordination service isn't up yet (the reference's equivalent is
         whatever launched the processes); then performs the handshake
-        publication.  Idempotent per process."""
+        publication.  Idempotent per process.
+
+        ``host_ip`` is the address PUBLISHED to peers; None (default)
+        derives it from the route to the coordinator, so multi-host
+        fabrics don't hand out 127.0.0.1 (ADVICE r2 finding)."""
         with cls._lock:
             if cls._instance is not None:
                 return cls._instance
@@ -140,6 +144,11 @@ class FabricNode:
         self._kv = distributed.global_state.client
         self.process_id = distributed.global_state.process_id
         self.num_processes = distributed.global_state.num_processes
+        if host_ip is None:
+            host_ip = self._derive_host_ip(
+                coordinator_address
+                or getattr(distributed.global_state, "coordinator_address",
+                           None))
         # data plane: transfer server (explicit TCP transport addresses —
         # the same-host "local" bulk transport is not usable in sandboxed
         # containers, and TCP is the portable choice; on real pods the
@@ -170,6 +179,24 @@ class FabricNode:
         log.info("fabric: process %d/%d up ctrl=%s xfer=%s devices=%s",
                  self.process_id, self.num_processes, info["ctrl"],
                  info["xfer"], info["devices"])
+
+    @staticmethod
+    def _derive_host_ip(coordinator_address: Optional[str]) -> str:
+        """The IP this host uses to reach the coordinator — the address
+        peers can reach US on (every fabric member reaches the
+        coordinator by construction).  A UDP connect never sends a
+        packet; it just resolves the route."""
+        if coordinator_address:
+            host, _, port = coordinator_address.rpartition(":")
+            s = _pysocket.socket(_pysocket.AF_INET, _pysocket.SOCK_DGRAM)
+            try:
+                s.connect((host, int(port) if port else 1))
+                return s.getsockname()[0]
+            except OSError:
+                pass
+            finally:
+                s.close()
+        return "127.0.0.1"
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -295,7 +322,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._conn_wlock = threading.Lock()
         self._inbox = IOBuf()
         self._inbox_lock = threading.Lock()
-        self._peer_closed = False
+        self._peer_closed = False      # reader-visible EOF (ordered)
+        self._conn_dead = False        # writer-visible death (immediate)
         self._init_window(window_bytes)
         self._init_delivery()
         self._staged: Dict[int, Tuple] = {}    # uuid -> (src_block, array)
@@ -312,7 +340,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             return len(self._staged)
 
     def _peer_gone(self) -> bool:
-        return self._peer_closed
+        return self._peer_closed or self._conn_dead
 
     # ---- write path ----------------------------------------------------
     def _do_write(self, data: IOBuf) -> int:
@@ -393,15 +421,26 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             # a malformed frame or failed pull must not strand the socket
             # with a silently-dead reader — surface it as a failure
             log.error("fabric read loop died on %s: %s", self.remote_side, e)
-        # connection over: wake readers (EOF), writers (window), and
-        # release every pinned send block — their transfers will never be
-        # acknowledged now (the reference completes _sbuf refs with an
-        # error on QP teardown)
-        with self._inbox_lock:
-            self._peer_closed = True
-        self.start_input_event()
+        self._on_connection_over()
+
+    def _on_connection_over(self) -> None:
+        """Connection teardown.  EOF must ride the ORDERED delivery
+        queue: a graceful FIN can arrive while an earlier device-bearing
+        frame is still awaiting its transfer-server pull — committing
+        EOF first would make the reader see end-of-stream and drop the
+        tail (ADVICE r2 finding; the reference's teardown completes in
+        CQ order, rdma_endpoint.cpp:926).  Writers and pinned send
+        blocks are released immediately — their acks can never arrive."""
+        self._conn_dead = True
         self._wake_window()
         self._flush_staged()
+
+        def commit_eof():
+            with self._inbox_lock:
+                self._peer_closed = True
+            self.start_input_event()
+
+        self._enqueue_delivery([], commit_eof)
 
     def _flush_staged(self) -> None:
         with self._staged_lock:

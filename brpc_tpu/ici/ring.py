@@ -89,6 +89,11 @@ class RingStream:
         import time
         from ..bthread import scheduler
         deadline = time.monotonic() + timeout
+        # check-and-RESERVE under one lock (the stream.cpp:274
+        # AppendIfNotFull discipline): two concurrent writers must not
+        # both pass the check before either counts itself, or the window
+        # overshoots and a racing flush() reports drained while a chunk
+        # is mid-dispatch (ADVICE r2 finding, fixed r4)
         with self._cv:
             while self._produced - self._consumed >= self.window:
                 left = deadline - time.monotonic()
@@ -99,15 +104,20 @@ class RingStream:
                     self._cv.wait(left)
                 finally:
                     scheduler.note_worker_unblocked()
-        # dispatch BEFORE counting as produced: if ppermute raises, no
-        # window credit is consumed and flush() stays consistent
-        moved = chunk
-        for _ in range(self.hops):
-            moved = self.coll.ppermute(moved, 1)
-        with self._cv:
-            self._produced += 1
-        DeviceEventDispatcher.instance().on_ready(
-            moved, lambda m=moved: self._delivered(m))
+            self._produced += 1          # reservation
+        try:
+            moved = chunk
+            for _ in range(self.hops):
+                moved = self.coll.ppermute(moved, 1)
+            DeviceEventDispatcher.instance().on_ready(
+                moved, lambda m=moved: self._delivered(m))
+        except BaseException:
+            # failed dispatch returns its reserved credit and wakes both
+            # blocked writers and flush()ers
+            with self._cv:
+                self._produced -= 1
+                self._cv.notify_all()
+            raise
         return True
 
     def _delivered(self, chunk) -> None:

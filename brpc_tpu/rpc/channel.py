@@ -173,26 +173,16 @@ class Channel:
 
     def _native_ici_call(self, nch, method_full_name: str,
                          cntl: Controller, request, response_cls):
-        """One fast-path RPC with the Python plane's client semantics:
-        rpcz span, and max_retry honored for the retryable error codes
-        (controller.py _retryable) — scheme choice must not silently
-        change retry behavior (review finding r4)."""
+        """One fast-path RPC with the Python plane's client tracing
+        (rpcz span).  No retry loop: the only retryable error an
+        in-process transport can produce is EFAILEDSOCKET (our conn died
+        with the server), which _native_ici_fallback re-routes; every
+        other failure here is deterministic (ENOMETHOD, ELIMIT, parse,
+        timeout) and would fail identically on a retry."""
         if cntl.span is None:
             from .span import maybe_start_client_span
             maybe_start_client_span(cntl, method_full_name)
-        result = None
-        for attempt in range(max(0, self.options.max_retry) + 1):
-            if attempt:
-                cntl.error_code_ = 0
-                cntl.error_text_ = ""
-                if cntl.span is not None:
-                    cntl.span.annotate(f"ici retry try={attempt}")
-            result = nch.call(method_full_name, cntl, request, response_cls)
-            if not cntl.failed() or \
-                    not Controller._retryable(cntl.error_code_) or \
-                    cntl.error_code_ == errors.EFAILEDSOCKET:
-                break                  # EFAILEDSOCKET → reroute, not spin
-        return result
+        return nch.call(method_full_name, cntl, request, response_cls)
 
     def _native_ici_fallback(self, cntl: Controller) -> bool:
         """After a fast-path failure, decide whether to re-route the call

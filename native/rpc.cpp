@@ -1083,6 +1083,7 @@ struct IciSlot {
   // before the caller ever reaches its wait, so `done` is usually
   // already true and the mutex/condvar is skipped entirely
   std::atomic<bool> done{false};
+  bool abandoned = false;   // waiter timed out; deliver() must release
   uint64_t error_code = 0;
   std::string error_text;
   std::string payload, att_host;
@@ -1113,8 +1114,12 @@ class IciChannel {
     slots_.erase(cid);
   }
 
-  // Response delivery from the server worker (or respond()).  A missing
-  // slot (timeout/close) drops the payload and releases ref custody.
+  // Response delivery from the server worker (or respond()).  The slot
+  // stays in the map — the WAITER erases it after consuming, so a
+  // deliver/timeout race can never strand segs in a slot nobody reads
+  // (review finding r4: erase-before-fill leaked device-ref custody and
+  // turned an arrived response into a spurious timeout).  A missing or
+  // abandoned slot drops the payload and releases ref custody.
   void deliver(uint64_t cid, uint64_t err, std::string err_text,
                std::string payload, std::string att_host,
                std::vector<IciSegC> segs) {
@@ -1122,10 +1127,7 @@ class IciChannel {
     {
       std::lock_guard<std::mutex> g(slots_mu_);
       auto it = slots_.find(cid);
-      if (it != slots_.end()) {
-        slot = it->second;
-        slots_.erase(it);
-      }
+      if (it != slots_.end()) slot = it->second;
     }
     if (slot == nullptr) {
       ici_release_segs(segs);
@@ -1133,6 +1135,10 @@ class IciChannel {
     }
     {
       std::lock_guard<std::mutex> g(slot->mu);
+      if (slot->abandoned) {
+        ici_release_segs(segs);
+        return;
+      }
       slot->error_code = err;
       slot->error_text = std::move(err_text);
       slot->payload = std::move(payload);
@@ -1424,8 +1430,8 @@ static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
   meta.attachment_size = att_host_len;
   if (timeout_us > 0) meta.request.timeout_ms = (uint64_t)(timeout_us / 1000);
   std::string frame = pack_head(meta, req_len + att_host_len);
-  frame.append((const char*)req, req_len);
-  frame.append((const char*)att_host, att_host_len);
+  if (req_len) frame.append((const char*)req, req_len);
+  if (att_host_len) frame.append((const char*)att_host, att_host_len);
   int64_t dev_bytes = 0;
   for (const auto& s : segs)
     if (s.is_dev) dev_bytes += (int64_t)s.nbytes;
@@ -1491,19 +1497,27 @@ static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
     std::unique_lock<std::mutex> g(slot->mu);
     while (!slot->done.load(std::memory_order_acquire)) {
       if (slot->cv.wait_until(g, deadline) == std::cv_status::timeout) {
+        // the deadline and the response can race: `done` is the truth,
+        // re-checked under the lock.  Abandoning under the SAME lock
+        // guarantees a later deliver() sees it and releases custody.
+        if (slot->done.load(std::memory_order_acquire)) break;
+        slot->abandoned = true;
         g.unlock();
-        ch->erase_slot(cid);   // late response finds no slot → dropped
+        ch->erase_slot(cid);
         *err_text = "rpc timeout";
         return 1008;
       }
     }
   }
-  std::lock_guard<std::mutex> g(slot->mu);
-  out->error_code = slot->error_code;
-  out->error_text = std::move(slot->error_text);
-  out->payload = std::move(slot->payload);
-  out->att_host = std::move(slot->att_host);
-  out->segs = std::move(slot->segs);
+  {
+    std::lock_guard<std::mutex> g(slot->mu);
+    out->error_code = slot->error_code;
+    out->error_text = std::move(slot->error_text);
+    out->payload = std::move(slot->payload);
+    out->att_host = std::move(slot->att_host);
+    out->segs = std::move(slot->segs);
+  }
+  ch->erase_slot(cid);       // waiter owns slot lifetime (see deliver)
   *err_text = out->error_text;
   return out->error_code;
 }
@@ -1954,9 +1968,13 @@ int brpc_tpu_ici_respond(uint64_t token, uint64_t err, const char* err_text,
     nrpc::ici_release_segs(seg_vec);
     return -2;
   }
+  // empty buffers arrive as NULL pointers from ctypes; std::string(ptr,
+  // n) requires a valid pointer even for n==0
   ch->deliver(pr.cid, err, err_text ? err_text : "",
-              std::string((const char*)data, len),
-              std::string((const char*)att_host, att_host_len),
+              len ? std::string((const char*)data, len) : std::string(),
+              att_host_len
+                  ? std::string((const char*)att_host, att_host_len)
+                  : std::string(),
               std::move(seg_vec));
   return 0;
 }

@@ -99,13 +99,98 @@ def _free_port():
     return port
 
 
-def test_two_process_echo_over_ici_fabric():
+STRESS_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+pid = int(sys.argv[1])
+coord = sys.argv[2]
+
+from brpc_tpu.ici.fabric import FabricNode
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from echo_pb2 import EchoRequest, EchoResponse
+
+mesh = ici.IciMesh()
+ici.IciMesh.set_default(mesh)
+
+CHUNK = 2 * 1024 * 1024      # 2MB payloads vs the 4MB window: 3 threads
+THREADS, CALLS = 3, 3        # saturate it (9 x 2MB each way)
+
+if pid == 0:
+    total = [0]
+    lock = threading.Lock()
+
+    class Sink(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Push(self, cntl, request, response, done):
+            n = len(cntl.request_attachment)
+            with lock:
+                total[0] += n
+            # bounce it back: the response direction saturates too
+            cntl.response_attachment.append(cntl.request_attachment)
+            response.message = str(total[0])
+            done()
+
+    server = rpc.Server()
+    server.add_service(Sink())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("stress_srv_up", "1")
+    kv.wait_at_barrier("stress_done", 300000)
+    expect = THREADS * CALLS * CHUNK
+    assert total[0] == expect, (total[0], expect)
+    server.stop()
+    print("STRESS0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("stress_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    payload = jax.device_put(jnp.arange(CHUNK, dtype=jnp.uint8),
+                             jax.devices()[local_dev])
+    jax.block_until_ready(payload)
+    expect_bytes = bytes(np.asarray(payload))
+    errs = []
+
+    def worker():
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://0", options=rpc.ChannelOptions(
+                timeout_ms=240000, max_retry=0))
+            for _ in range(CALLS):
+                cntl = rpc.Controller()
+                cntl.request_attachment.append_device_array(payload)
+                resp = ch.call_method("Sink.Push", cntl,
+                                      EchoRequest(message="p"),
+                                      EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                got = cntl.response_attachment.to_bytes()
+                assert got == expect_bytes, "bounced payload corrupted"
+        except Exception as e:
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=worker) for _ in range(THREADS)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    assert not errs, errs
+    kv.wait_at_barrier("stress_done", 300000)
+    print("STRESS1_OK", flush=True)
+"""
+
+
+def _run_pair(script: str, timeout: int = 240):
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     env.pop("JAX_NUM_PROCESSES", None)
-    script = CHILD % {"repo": REPO}
     procs = [subprocess.Popen(
         [sys.executable, "-c", script, str(i), coord],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
@@ -114,7 +199,7 @@ def test_two_process_echo_over_ici_fabric():
     rcs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
@@ -122,5 +207,76 @@ def test_two_process_echo_over_ici_fabric():
         rcs.append(p.returncode)
     assert rcs == [0, 0], (
         f"--- child0 ---\n{outs[0]}\n--- child1 ---\n{outs[1]}")
+    return outs
+
+
+def test_two_process_echo_over_ici_fabric():
+    outs = _run_pair(CHILD % {"repo": REPO})
     assert "CHILD0_OK" in outs[0]
     assert "CHILD1_OK" in outs[1]
+
+
+def test_two_process_window_saturation_stress():
+    """Concurrent bulk device transfers past the send window, both
+    directions, with byte-exact verification (VERDICT r3 #6: the fabric
+    must survive window saturation, and a graceful close must not drop
+    the in-flight tail)."""
+    outs = _run_pair(STRESS_CHILD % {"repo": REPO}, timeout=300)
+    assert "STRESS0_OK" in outs[0]
+    assert "STRESS1_OK" in outs[1]
+
+
+class TestFabricUnits:
+    def test_derive_host_ip(self):
+        from brpc_tpu.ici.fabric import FabricNode
+        # loopback coordinator → loopback self (route resolution)
+        assert FabricNode._derive_host_ip("127.0.0.1:1234") == "127.0.0.1"
+        # no coordinator → safe default, never an exception
+        assert FabricNode._derive_host_ip(None) == "127.0.0.1"
+        assert FabricNode._derive_host_ip("") == "127.0.0.1"
+        # unroutable/garbage host falls back instead of raising
+        assert isinstance(
+            FabricNode._derive_host_ip("nonexistent.invalid:1"), str)
+
+    def test_graceful_fin_waits_for_inflight_device_frame(self, monkeypatch):
+        """EOF rides the ordered delivery queue: a FIN arriving while a
+        device frame still awaits its pull must not surface EOF first
+        (the stream tail would be dropped)."""
+        from brpc_tpu.ici import transport as T
+        from brpc_tpu.ici.fabric import FabricSocket
+
+        sock = FabricSocket.__new__(FabricSocket)
+        import threading as _threading
+        from brpc_tpu.butil.iobuf import IOBuf
+        sock._inbox = IOBuf()
+        sock._inbox_lock = _threading.Lock()
+        sock._peer_closed = False
+        sock._conn_dead = False
+        sock._staged = {}
+        sock._staged_lock = _threading.Lock()
+        sock._init_delivery()
+        events = []
+        sock.start_input_event = lambda *a, **k: events.append("input")
+        sock._wake_window = lambda: None
+        sock._flush_staged = lambda: None
+
+        pending = []
+
+        class FakeDisp:
+            def on_ready(self, arrays, cb):
+                pending.append(cb)
+
+        monkeypatch.setattr(T, "_all_ready", lambda arrays: False)
+        monkeypatch.setattr(T.DeviceEventDispatcher, "instance",
+                            classmethod(lambda cls: FakeDisp()))
+        # a device-bearing frame is in flight...
+        committed = []
+        sock._enqueue_delivery([object()], lambda: committed.append(1))
+        # ...when the connection ends
+        sock._on_connection_over()
+        assert sock._conn_dead is True       # writers fail immediately
+        assert sock._peer_closed is False    # but EOF has NOT jumped ahead
+        pending[0]()                         # the pull completes
+        assert committed == [1]
+        assert sock._peer_closed is True     # now EOF commits, in order
+        assert "input" in events

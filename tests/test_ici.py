@@ -288,6 +288,106 @@ class TestRing:
         for row in np.asarray(ring_out):
             np.testing.assert_allclose(row, expect)
 
+    def test_two_writers_never_overshoot_window(self, monkeypatch):
+        """Concurrent writers racing the window check must not both pass
+        before either reserves its credit (VERDICT r3 #2: the reference's
+        AppendIfNotFull is check-and-reserve atomically, stream.cpp:274).
+        The pre-fix code reserved AFTER dispatch, so two writers could
+        dispatch with window=1."""
+        import brpc_tpu.ici.ring as ring_mod
+
+        lock = threading.Lock()
+        state = {"active": 0, "peak": 0}
+        pending = []
+
+        class FakeColl:
+            def ppermute(self, x, shift):
+                with lock:
+                    state["active"] += 1
+                    state["peak"] = max(state["peak"], state["active"])
+                time.sleep(0.03)         # widen the race window
+                with lock:
+                    state["active"] -= 1
+                return x
+
+        class FakeDisp:
+            def on_ready(self, arrays, cb):
+                # consume asynchronously, like the device poller
+                t = threading.Timer(0.01, cb)
+                t.daemon = True
+                t.start()
+                pending.append(t)
+
+        monkeypatch.setattr(ring_mod.DeviceEventDispatcher, "instance",
+                            classmethod(lambda cls: FakeDisp()))
+        stream = ring_mod.RingStream.__new__(ring_mod.RingStream)
+        stream.mesh = None
+        stream.coll = FakeColl()
+        stream.hops = 1
+        stream.window = 1
+        stream.on_chunk = None
+        stream._cv = threading.Condition()
+        stream._produced = 0
+        stream._consumed = 0
+
+        errs = []
+
+        def writer():
+            try:
+                for _ in range(5):
+                    assert stream.write(object(), timeout=10)
+            except Exception as e:       # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert stream.flush(10)
+        # with window=1, at most ONE chunk may ever be mid-dispatch
+        assert state["peak"] == 1, \
+            f"window overshoot: {state['peak']} concurrent dispatches"
+        assert stream.in_flight == 0
+
+    def test_failed_dispatch_returns_reserved_credit(self, monkeypatch):
+        """A raising ppermute must roll back its reservation so later
+        writes and flush() are not wedged by a phantom in-flight chunk."""
+        import brpc_tpu.ici.ring as ring_mod
+
+        class BoomColl:
+            def __init__(self):
+                self.calls = 0
+
+            def ppermute(self, x, shift):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("transfer failed")
+                return x
+
+        class FakeDisp:
+            def on_ready(self, arrays, cb):
+                cb()
+
+        monkeypatch.setattr(ring_mod.DeviceEventDispatcher, "instance",
+                            classmethod(lambda cls: FakeDisp()))
+        stream = ring_mod.RingStream.__new__(ring_mod.RingStream)
+        stream.mesh = None
+        stream.coll = BoomColl()
+        stream.hops = 1
+        stream.window = 1
+        stream.on_chunk = None
+        stream._cv = threading.Condition()
+        stream._produced = 0
+        stream._consumed = 0
+
+        with pytest.raises(RuntimeError):
+            stream.write(object(), timeout=1)
+        assert stream.in_flight == 0     # credit rolled back
+        assert stream.write(object(), timeout=1)   # window not wedged
+        assert stream.flush(5)
+
     def test_ring_stream_window_and_order(self, mesh):
         import jax.numpy as jnp
         coll = ici.Collectives(mesh)
