@@ -40,7 +40,15 @@ FRAME_CONTINUATION = 0x9
 
 FLAG_END_STREAM = 0x1
 FLAG_END_HEADERS = 0x4
+FLAG_PADDED = 0x8
+FLAG_PRIORITY = 0x20
 FLAG_ACK = 0x1
+
+SETTINGS_INITIAL_WINDOW_SIZE = 0x4
+SETTINGS_MAX_FRAME_SIZE = 0x5
+
+DEFAULT_WINDOW = 65535
+DEFAULT_MAX_FRAME = 16384
 
 GRPC_OK = 0
 GRPC_UNKNOWN = 2
@@ -74,7 +82,7 @@ def split_grpc_messages(data: bytes) -> List[bytes]:
 
 class _H2Stream:
     __slots__ = ("stream_id", "headers", "trailers", "data", "ended",
-                 "headers_done")
+                 "headers_done", "hdr_frag", "end_after_headers")
 
     def __init__(self, stream_id: int):
         self.stream_id = stream_id
@@ -83,6 +91,11 @@ class _H2Stream:
         self.data = bytearray()
         self.ended = False
         self.headers_done = False
+        # header-block fragments accumulate here until END_HEADERS: an
+        # HPACK block is one unit — decoding per-fragment corrupts any
+        # string split across a CONTINUATION boundary (RFC 7540 §4.3)
+        self.hdr_frag = bytearray()
+        self.end_after_headers = False
 
     def header(self, name: bytes, default: bytes = b"") -> bytes:
         for k, v in self.headers + self.trailers:
@@ -92,7 +105,10 @@ class _H2Stream:
 
 
 class _H2Conn:
-    """Per-socket connection context (the reference's H2Context)."""
+    """Per-socket connection context (the reference's H2Context):
+    hpack tables, live streams, and BOTH flow-control directions —
+    the send windows here gate our DATA (RFC 7540 §5.2; reference
+    http2_rpc_protocol.cpp H2Context::_remote_window_left)."""
 
     def __init__(self, is_server: bool):
         self.is_server = is_server
@@ -104,7 +120,20 @@ class _H2Conn:
         self.streams: Dict[int, _H2Stream] = {}
         self.next_stream_id = 1          # client-initiated odd ids
         self.cid_by_stream: Dict[int, int] = {}
-        self.lock = threading.Lock()
+        # REENTRANT: with a stateful hpack encoder, header blocks must
+        # hit the wire in ENCODE order — every path that encodes a block
+        # holds this lock across encode AND write.  Reentrancy matters on
+        # the loopback transport, where a write can deliver inline and
+        # the peer's processing re-enters this side's conn.
+        self.lock = threading.RLock()
+        # peer-granted send windows (ours to spend)
+        self.send_window = DEFAULT_WINDOW
+        self.stream_send: Dict[int, int] = {}
+        self.initial_window = DEFAULT_WINDOW
+        self.max_frame_size = DEFAULT_MAX_FRAME
+        # DATA waiting for window: stream_id -> list of [bytes, end_flag]
+        self.pending: Dict[int, List] = {}
+        self.expect_continuation: Optional[int] = None
 
 
 def _conn(socket, is_server: bool) -> _H2Conn:
@@ -178,33 +207,82 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
                   completed: List[CompletedCall]) -> None:
     if ftype == FRAME_SETTINGS:
         if not (flags & FLAG_ACK):
+            _apply_settings(conn, socket, payload)
             socket.write(IOBuf(frame(FRAME_SETTINGS, FLAG_ACK, 0, b"")))
         return
     if ftype == FRAME_PING:
         if not (flags & FLAG_ACK):
             socket.write(IOBuf(frame(FRAME_PING, FLAG_ACK, 0, payload)))
         return
-    if ftype in (FRAME_WINDOW_UPDATE, FRAME_GOAWAY):
+    if ftype == FRAME_WINDOW_UPDATE:
+        if len(payload) >= 4:
+            inc = struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF
+            _on_window_update(conn, socket, stream_id, inc)
+        return
+    if ftype == FRAME_GOAWAY:
         return
     if ftype == FRAME_RST_STREAM:
-        conn.streams.pop(stream_id, None)
+        with conn.lock:
+            conn.streams.pop(stream_id, None)
+            conn.pending.pop(stream_id, None)
+            conn.stream_send.pop(stream_id, None)
+        return
+    # RFC 7540 §6.2: an unterminated header block admits ONLY
+    # CONTINUATION frames on the same stream; anything else is a
+    # connection error (the shared hpack decoder would desync)
+    if conn.expect_continuation is not None and (
+            ftype != FRAME_CONTINUATION
+            or stream_id != conn.expect_continuation):
+        fail = getattr(socket, "set_failed", None)
+        if fail is not None:
+            fail(errors.EREQUEST,
+                 "h2: frame interleaved inside a header block")
         return
     st = conn.streams.get(stream_id)
     if st is None:
         st = _H2Stream(stream_id)
         conn.streams[stream_id] = st
     if ftype in (FRAME_HEADERS, FRAME_CONTINUATION):
-        hdrs = conn.dec.decode(payload)
-        if st.headers_done:
-            st.trailers.extend(hdrs)      # trailers
-        else:
-            st.headers.extend(hdrs)
-            if flags & FLAG_END_HEADERS:
+        frag = payload
+        if ftype == FRAME_HEADERS:
+            # strip padding + priority per RFC 7540 §6.2
+            if flags & FLAG_PADDED:
+                pad = frag[0]
+                frag = frag[1:len(frag) - pad]
+            if flags & FLAG_PRIORITY:
+                frag = frag[5:]
+            st.end_after_headers = bool(flags & FLAG_END_STREAM)
+        st.hdr_frag.extend(frag)
+        if flags & FLAG_END_HEADERS:
+            # an HPACK block decodes as ONE unit, only now that every
+            # CONTINUATION fragment arrived (RFC 7540 §4.3)
+            hdrs = conn.dec.decode(bytes(st.hdr_frag))
+            st.hdr_frag.clear()
+            conn.expect_continuation = None
+            if st.headers_done:
+                st.trailers.extend(hdrs)      # trailers
+            else:
+                st.headers.extend(hdrs)
                 st.headers_done = True
+        else:
+            conn.expect_continuation = stream_id
+        if ftype == FRAME_CONTINUATION and \
+                not (flags & FLAG_END_HEADERS):
+            return
+        # END_STREAM on the HEADERS frame takes effect once the block
+        # completes (trailers case: HEADERS+END_STREAM after DATA)
+        flags = (flags & ~FLAG_END_STREAM) | (
+            FLAG_END_STREAM if (st.end_after_headers
+                                and not st.hdr_frag) else 0)
     elif ftype == FRAME_DATA:
-        st.data.extend(payload)
+        body = payload
+        if flags & FLAG_PADDED:
+            pad = body[0]
+            body = body[1:len(body) - pad]
+        st.data.extend(body)
         if payload:
-            # auto-replenish flow-control windows
+            # auto-replenish OUR receive windows (we buffer whole
+            # messages, so the window never back-pressures the peer)
             inc = struct.pack(">I", len(payload))
             socket.write(IOBuf(frame(FRAME_WINDOW_UPDATE, 0, 0, inc)
                                + frame(FRAME_WINDOW_UPDATE, 0, stream_id,
@@ -213,6 +291,100 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
         st.ended = True
         conn.streams.pop(stream_id, None)
         completed.append(CompletedCall(st, conn.is_server))
+
+
+def _apply_settings(conn: _H2Conn, socket, payload: bytes) -> None:
+    """Peer SETTINGS: INITIAL_WINDOW_SIZE retro-adjusts every open
+    stream's send window by the delta (RFC 7540 §6.9.2)."""
+    flush = False
+    with conn.lock:
+        for off in range(0, len(payload) - 5, 6):
+            ident, value = struct.unpack_from(">HI", payload, off)
+            if ident == SETTINGS_INITIAL_WINDOW_SIZE:
+                delta = value - conn.initial_window
+                conn.initial_window = value
+                for sid in conn.stream_send:
+                    conn.stream_send[sid] += delta
+                flush = delta > 0
+            elif ident == SETTINGS_MAX_FRAME_SIZE:
+                if 16384 <= value <= (1 << 24) - 1:
+                    conn.max_frame_size = value
+    if flush:
+        _flush_pending(conn, socket)
+
+
+def _on_window_update(conn: _H2Conn, socket, stream_id: int,
+                      inc: int) -> None:
+    with conn.lock:
+        if stream_id == 0:
+            conn.send_window += inc
+        elif stream_id in conn.stream_send:
+            conn.stream_send[stream_id] += inc
+    _flush_pending(conn, socket)
+
+
+def _send_data(conn: _H2Conn, out: IOBuf, stream_id: int, data: bytes,
+               end_stream: bool) -> None:
+    """Emit DATA within the peer's flow-control windows (both levels,
+    RFC 7540 §6.9: the lower of connection and stream window gates every
+    byte), splitting at max_frame_size; what doesn't fit queues on the
+    conn and drains when WINDOW_UPDATE/SETTINGS credit arrives.  Caller
+    holds conn.lock."""
+    conn.stream_send.setdefault(stream_id, conn.initial_window)
+    if not data:
+        if end_stream:                   # empty DATA costs no window
+            out.append(frame(FRAME_DATA, FLAG_END_STREAM, stream_id, b""))
+        return
+    pos = 0
+    n = len(data)
+    while pos < n:
+        left = min(conn.send_window, conn.stream_send[stream_id],
+                   conn.max_frame_size)
+        if left <= 0:
+            # window exhausted: park the tail (ordered per stream)
+            conn.pending.setdefault(stream_id, []).append(
+                [data[pos:], end_stream])
+            return
+        take = min(left, n - pos)
+        last = (pos + take == n)
+        out.append(frame(FRAME_DATA,
+                         FLAG_END_STREAM if (last and end_stream) else 0,
+                         stream_id, bytes(data[pos:pos + take])))
+        conn.send_window -= take
+        conn.stream_send[stream_id] -= take
+        pos += take
+    if end_stream:
+        # stream fully sent: retire its window entry (a long-lived conn
+        # must not accumulate one dict entry per finished stream)
+        conn.stream_send.pop(stream_id, None)
+
+
+def _flush_pending(conn: _H2Conn, socket) -> None:
+    """Drain parked DATA now that credit returned.  Every chunk either
+    emits into ``out`` or re-parks via _send_data — nothing is lost.
+    The write happens UNDER conn.lock: parked trailers are hpack-encoded
+    at emission time, and that block must reach the wire before any
+    block encoded after it."""
+    with conn.lock:
+        out = IOBuf()
+        parked, conn.pending = conn.pending, {}
+        for sid, chunks in parked.items():
+            for i, (data, end) in enumerate(chunks):
+                if data is None:
+                    # parked trailers ([None, header_list]): encode NOW —
+                    # encoding at park time would let later blocks refer
+                    # to table entries the peer hasn't seen yet
+                    block = conn.enc.encode(end)
+                    _append_header_block(conn, out, sid, block,
+                                         end_stream=True)
+                    conn.stream_send.pop(sid, None)
+                    continue
+                _send_data(conn, out, sid, data, end)
+                if sid in conn.pending:          # still blocked: keep the
+                    conn.pending[sid].extend(chunks[i + 1:])   # rest, in
+                    break                                      # order
+        if len(out):
+            socket.write(out)
 
 
 def _server_send_settings(socket, conn: _H2Conn) -> None:
@@ -271,6 +443,21 @@ def _process_one_request(st: _H2Stream, socket, server) -> None:
             done()
 
 
+def _append_header_block(conn: _H2Conn, out: IOBuf, stream_id: int,
+                         block: bytes, end_stream: bool) -> None:
+    """HEADERS (+CONTINUATIONs when the block exceeds max_frame_size,
+    RFC 7540 §6.10).  Caller holds conn.lock."""
+    mfs = conn.max_frame_size
+    first, rest = block[:mfs], block[mfs:]
+    flags = (FLAG_END_STREAM if end_stream else 0) | \
+        (0 if rest else FLAG_END_HEADERS)
+    out.append(frame(FRAME_HEADERS, flags, stream_id, first))
+    while rest:
+        frag, rest = rest[:mfs], rest[mfs:]
+        out.append(frame(FRAME_CONTINUATION,
+                         0 if rest else FLAG_END_HEADERS, stream_id, frag))
+
+
 def _send_grpc_response(socket, stream_id: int, pb_bytes: Optional[bytes],
                         status: int, message: str) -> None:
     conn = socket._h2_conn
@@ -278,16 +465,23 @@ def _send_grpc_response(socket, stream_id: int, pb_bytes: Optional[bytes],
         out = IOBuf()
         hdr = conn.enc.encode([(b":status", b"200"),
                                (b"content-type", b"application/grpc+proto")])
-        out.append(frame(FRAME_HEADERS, FLAG_END_HEADERS, stream_id, hdr))
+        _append_header_block(conn, out, stream_id, hdr, end_stream=False)
         if pb_bytes is not None:
-            out.append(frame(FRAME_DATA, 0, stream_id,
-                             grpc_message(pb_bytes)))
-        trailers = conn.enc.encode([
+            _send_data(conn, out, stream_id, grpc_message(pb_bytes),
+                       end_stream=False)
+        trailer_list = [
             (b"grpc-status", str(status).encode()),
-            (b"grpc-message", message.encode()[:512])])
-        out.append(frame(FRAME_HEADERS,
-                         FLAG_END_HEADERS | FLAG_END_STREAM, stream_id,
-                         trailers))
+            (b"grpc-message", message.encode()[:512])]
+        if stream_id in conn.pending:
+            # DATA is parked behind the window: the trailers must follow
+            # it, not jump ahead.  Park the header LIST — hpack encoding
+            # happens at emission so table references stay in wire order.
+            conn.pending[stream_id].append([None, trailer_list])
+        else:
+            _append_header_block(conn, out, stream_id,
+                                 conn.enc.encode(trailer_list),
+                                 end_stream=True)
+            conn.stream_send.pop(stream_id, None)
         socket.write(out)
 
 
@@ -306,6 +500,11 @@ def serialize_request(request: Any, cntl: Controller) -> IOBuf:
 
 def pack_request(payload: IOBuf, cid: int, cntl: Controller,
                  method_full_name: str) -> IOBuf:
+    """Builds AND writes the request frames under conn.lock, returning an
+    empty packet for the generic write path.  The direct write is what
+    makes hpack safe under concurrency: with a stateful encoder, a block
+    encoded first must reach the wire first, and a parked DATA tail must
+    never be flushed (by a racing WINDOW_UPDATE) ahead of its own head."""
     sock = cntl._pack_socket
     conn = _conn(sock, is_server=False)
     service, _, method = method_full_name.rpartition(".")
@@ -327,10 +526,13 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
             (b"content-type", b"application/grpc+proto"),
             (b"te", b"trailers"),
         ])
-        out.append(frame(FRAME_HEADERS, FLAG_END_HEADERS, stream_id, hdr))
-        out.append(frame(FRAME_DATA, FLAG_END_STREAM, stream_id,
-                         grpc_message(payload.to_bytes())))
-        return out
+        _append_header_block(conn, out, stream_id, hdr, end_stream=False)
+        _send_data(conn, out, stream_id,
+                   grpc_message(payload.to_bytes()), end_stream=True)
+        rc = sock.write(out)
+        if rc != 0:
+            raise ConnectionError(f"h2 write failed: {rc}")
+    return IOBuf()
 
 
 def process_response(calls: List[CompletedCall], socket) -> None:

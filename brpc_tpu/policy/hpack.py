@@ -1,11 +1,17 @@
 """HPACK (RFC 7541) — header compression for HTTP/2.
 
 Reference: src/brpc/details/hpack.{h,cpp}.  Full decoder (indexed fields,
-all literal forms, dynamic-table size updates, static + dynamic tables);
-conservative encoder (static-table indexed when possible, otherwise literal
-without indexing — always legal, never requires peer state).  Huffman
-decoding implements the RFC 7541 code table; our encoder never
-huffman-encodes.
+all literal forms, dynamic-table size updates, static + dynamic tables)
+and a full encoder: ``Encoder()`` defaults to incremental indexing with
+its own dynamic table (the RFC's example encoder, golden-pinned against
+Appendix C.3-C.6 in tests/test_grpc.py), with optional huffman coding
+both directions.
+
+INVARIANT the connection depends on: the default encoder is STATEFUL —
+its dynamic table must evolve in the same order the peer's decoder sees
+the blocks, so every header block must reach the wire in encode order
+(grpc.py holds the h2 conn lock across encode AND write for this reason).
+``Encoder(index=False)`` restores the stateless literal-only form.
 """
 from __future__ import annotations
 
@@ -169,24 +175,96 @@ def _decode_int(data: bytes, pos: int, prefix_bits: int) -> Tuple[int, int]:
             return value, pos
 
 
+def huffman_encode(data: bytes) -> bytes:
+    """RFC 7541 §5.2: huffman string, EOS-padded with 1-bits."""
+    bits = 0
+    nbits = 0
+    out = bytearray()
+    for b in data:
+        code, n = _HUFF[b]
+        bits = (bits << n) | code
+        nbits += n
+        while nbits >= 8:
+            nbits -= 8
+            out.append((bits >> nbits) & 0xFF)
+    if nbits:
+        pad = 8 - nbits
+        out.append(((bits << pad) | ((1 << pad) - 1)) & 0xFF)
+    return bytes(out)
+
+
 class Encoder:
-    """Conservative encoder: static-index hits, else literal w/o indexing."""
+    """RFC 7541 encoder with its own dynamic table.
+
+    ``index=True`` (default) emits literal-with-incremental-indexing for
+    non-static headers, so repeats on a connection compress to 1-2 bytes —
+    this is the RFC's own example encoder (Appendix C.3-C.6), and the
+    golden-vector tests pin its output byte-for-byte.  ``index=False``
+    restores the stateless literal-without-indexing form (never requires
+    peer state).  ``use_huffman`` huffman-codes every literal string (the
+    C.4/C.6 examples)."""
+
+    def __init__(self, index: bool = True, use_huffman: bool = False,
+                 max_table_size: int = 4096):
+        self.index = index
+        self.use_huffman = use_huffman
+        self.dynamic: List[Tuple[bytes, bytes]] = []
+        self.max_table_size = max_table_size
+        self._size = 0
+
+    # dynamic-table bookkeeping mirrors the Decoder exactly: both ends
+    # evolve the same table from the same header stream (RFC 7541 §2.3.2)
+    def _add(self, name: bytes, value: bytes) -> None:
+        self.dynamic.insert(0, (name, value))
+        self._size += len(name) + len(value) + 32
+        while self._size > self.max_table_size and self.dynamic:
+            n, v = self.dynamic.pop()
+            self._size -= len(n) + len(v) + 32
+
+    def table_size(self) -> int:
+        return self._size
+
+    def _find(self, name: bytes, value: bytes) -> Tuple[int, bool]:
+        """(index, full_match); index 0 = no name match anywhere."""
+        idx = _STATIC_LOOKUP.get((name, value))
+        if idx is not None:
+            return idx, True
+        for i, (n, v) in enumerate(self.dynamic):
+            if n == name and v == value:
+                return len(STATIC_TABLE) + 1 + i, True
+        name_idx = _STATIC_NAME_LOOKUP.get(name, 0)
+        if name_idx == 0:
+            for i, (n, _v) in enumerate(self.dynamic):
+                if n == name:
+                    name_idx = len(STATIC_TABLE) + 1 + i
+                    break
+        return name_idx, False
+
+    def _string(self, s: bytes) -> bytes:
+        if self.use_huffman:
+            enc = huffman_encode(s)
+            return _encode_int(len(enc), 7, 0x80) + enc
+        return _encode_int(len(s), 7, 0x00) + s
 
     def encode(self, headers: List[Tuple[bytes, bytes]]) -> bytes:
         out = bytearray()
         for name, value in headers:
             name = name.lower()
-            idx = _STATIC_LOOKUP.get((name, value))
-            if idx is not None:
+            idx, full = self._find(name, value)
+            if full:
                 out += _encode_int(idx, 7, 0x80)       # indexed field
                 continue
-            name_idx = _STATIC_NAME_LOOKUP.get(name, 0)
-            out += _encode_int(name_idx, 4, 0x00)      # literal, no indexing
-            if name_idx == 0:
-                out += _encode_int(len(name), 7, 0x00)
-                out += name
-            out += _encode_int(len(value), 7, 0x00)
-            out += value
+            if self.index:
+                out += _encode_int(idx, 6, 0x40)       # incremental indexing
+                if idx == 0:
+                    out += self._string(name)
+                out += self._string(value)
+                self._add(name, value)
+            else:
+                out += _encode_int(idx, 4, 0x00)       # literal, no indexing
+                if idx == 0:
+                    out += self._string(name)
+                out += self._string(value)
         return bytes(out)
 
 
