@@ -17,7 +17,7 @@ from typing import Callable, Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_ici_echo_p50_ns"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_native_async_throughput_gbps"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -52,6 +52,14 @@ _ICI_RELOCATE_FN = ctypes.CFUNCTYPE(ctypes.c_uint64, ctypes.c_uint64,
                                     ctypes.c_int32)
 # release upcall: native custody of a key ends on a drop path
 _ICI_RELEASE_FN = ctypes.CFUNCTYPE(None, ctypes.c_uint64)
+# async completion: (user, error_code, err_text, resp, resp_len, att,
+# att_len) — fires once from the channel's reader thread
+_ASYNC_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_uint64,
+                             ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_uint8),
+                             ctypes.c_uint64,
+                             ctypes.POINTER(ctypes.c_uint8),
+                             ctypes.c_uint64)
 # ici request hook: (token, method, payload, payload_len, att_host,
 # att_host_len, segs, nsegs, log_id, peer_dev)
 _ICI_REQ_FN = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_char_p,
@@ -188,6 +196,26 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_char_p)]
     lib.brpc_tpu_buf_free.argtypes = [ctypes.c_void_p]
     lib.brpc_tpu_nchannel_close.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_nchannel_call_async.restype = ctypes.c_uint64
+    lib.brpc_tpu_nchannel_call_async.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, u8p, ctypes.c_uint64, u8p,
+        ctypes.c_uint64, ctypes.c_int64, _ASYNC_CB, ctypes.c_void_p]
+    lib.brpc_tpu_npool_connect.restype = ctypes.c_uint64
+    lib.brpc_tpu_npool_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                           ctypes.c_int]
+    lib.brpc_tpu_npool_call.restype = ctypes.c_uint64
+    lib.brpc_tpu_npool_call.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, u8p, ctypes.c_uint64, u8p,
+        ctypes.c_uint64, ctypes.c_int64, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_char_p)]
+    lib.brpc_tpu_npool_close.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_native_pooled_throughput_gbps.restype = ctypes.c_double
+    lib.brpc_tpu_native_pooled_throughput_gbps.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.brpc_tpu_native_async_throughput_gbps.restype = ctypes.c_double
+    lib.brpc_tpu_native_async_throughput_gbps.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.brpc_tpu_native_rpc_echo_p50_ns.restype = ctypes.c_int64
     lib.brpc_tpu_native_rpc_echo_p50_ns.argtypes = [ctypes.c_int,
                                                     ctypes.c_int]
@@ -303,3 +331,26 @@ def native_rpc_throughput_gbps(threads: int = 2, duration_ms: int = 1500,
         return -1.0
     return lib.brpc_tpu_native_rpc_throughput_gbps(threads, duration_ms,
                                                    payload)
+
+
+def native_pooled_throughput_gbps(nconns: int = 2, threads: int = 2,
+                                  duration_ms: int = 1500,
+                                  payload: int = 1 << 20) -> float:
+    """Pooled multi-connection large-request throughput (reference
+    socket.h:256-262 pooled sockets); -1 if unavailable."""
+    lib = load()
+    if lib is None:
+        return -1.0
+    return lib.brpc_tpu_native_pooled_throughput_gbps(
+        nconns, threads, duration_ms, payload)
+
+
+def native_async_throughput_gbps(depth: int = 4, duration_ms: int = 1500,
+                                 payload: int = 256 << 10) -> float:
+    """Pipelined (async, `depth` in flight) throughput on one connection
+    (the KeepWrite batching shape, socket.cpp:1685); -1 if unavailable."""
+    lib = load()
+    if lib is None:
+        return -1.0
+    return lib.brpc_tpu_native_async_throughput_gbps(depth, duration_ms,
+                                                     payload)

@@ -205,6 +205,16 @@ def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
 def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
                   stream_id: int, payload: bytes,
                   completed: List[CompletedCall]) -> None:
+    # RFC 7540 §6.2: an unterminated header block admits ONLY CONTINUATION
+    # frames on the same stream — ANY other frame (including control
+    # frames and RST_STREAM) is a connection error, checked before every
+    # early return below or the shared hpack decoder desyncs
+    if conn.expect_continuation is not None and (
+            ftype != FRAME_CONTINUATION
+            or stream_id != conn.expect_continuation):
+        _fail_h2_conn(socket,
+                      "h2: frame interleaved inside a header block")
+        return
     if ftype == FRAME_SETTINGS:
         if not (flags & FLAG_ACK):
             _apply_settings(conn, socket, payload)
@@ -226,17 +236,6 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
             conn.streams.pop(stream_id, None)
             conn.pending.pop(stream_id, None)
             conn.stream_send.pop(stream_id, None)
-        return
-    # RFC 7540 §6.2: an unterminated header block admits ONLY
-    # CONTINUATION frames on the same stream; anything else is a
-    # connection error (the shared hpack decoder would desync)
-    if conn.expect_continuation is not None and (
-            ftype != FRAME_CONTINUATION
-            or stream_id != conn.expect_continuation):
-        fail = getattr(socket, "set_failed", None)
-        if fail is not None:
-            fail(errors.EREQUEST,
-                 "h2: frame interleaved inside a header block")
         return
     st = conn.streams.get(stream_id)
     if st is None:
@@ -291,6 +290,25 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
         st.ended = True
         conn.streams.pop(stream_id, None)
         completed.append(CompletedCall(st, conn.is_server))
+
+
+def _fail_h2_conn(socket, why: str) -> None:
+    """Connection-fatal h2 condition (protocol violation or a write that
+    didn't reach the wire): with a stateful hpack encoder the connection
+    is unrecoverable — fail the socket so callers reconnect fresh."""
+    fail = getattr(socket, "set_failed", None)
+    if fail is not None:
+        fail(errors.EFAILEDSOCKET, why)
+
+
+def _h2_write(socket, out: IOBuf, why: str) -> int:
+    """Write h2 frames; a failed write after hpack encoding desyncs the
+    peer's dynamic table permanently, so the connection dies with it."""
+    rc = socket.write(out)
+    if rc != 0:
+        _fail_h2_conn(socket, f"h2: {why} write failed ({rc}) — "
+                              "hpack state unrecoverable")
+    return rc
 
 
 def _apply_settings(conn: _H2Conn, socket, payload: bytes) -> None:
@@ -384,7 +402,7 @@ def _flush_pending(conn: _H2Conn, socket) -> None:
                     conn.pending[sid].extend(chunks[i + 1:])   # rest, in
                     break                                      # order
         if len(out):
-            socket.write(out)
+            _h2_write(socket, out, "flush")
 
 
 def _server_send_settings(socket, conn: _H2Conn) -> None:
@@ -482,7 +500,7 @@ def _send_grpc_response(socket, stream_id: int, pb_bytes: Optional[bytes],
                                  conn.enc.encode(trailer_list),
                                  end_stream=True)
             conn.stream_send.pop(stream_id, None)
-        socket.write(out)
+        _h2_write(socket, out, "response")
 
 
 # ---- client side ------------------------------------------------------
@@ -529,7 +547,7 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
         _append_header_block(conn, out, stream_id, hdr, end_stream=False)
         _send_data(conn, out, stream_id,
                    grpc_message(payload.to_bytes()), end_stream=True)
-        rc = sock.write(out)
+        rc = _h2_write(sock, out, "request")
         if rc != 0:
             raise ConnectionError(f"h2 write failed: {rc}")
     return IOBuf()

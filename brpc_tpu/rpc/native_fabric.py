@@ -26,11 +26,11 @@ from __future__ import annotations
 
 import ctypes
 import threading
-from typing import Any, Dict, Optional, Type
+from typing import Any, Callable, Dict, Optional, Type
 
 from ..butil import logging as log
 from ..butil import native
-from ..butil.native import _NREQ_FN
+from ..butil.native import _ASYNC_CB, _NREQ_FN
 from . import errors
 from .controller import Controller
 from .service import MethodDescriptor, Service
@@ -206,6 +206,177 @@ class NativeChannel:
             timeout_us, ctypes.byref(resp_p), ctypes.byref(resp_len),
             ctypes.byref(ratt_p), ctypes.byref(ratt_len),
             ctypes.byref(err_text))
+        try:
+            if rc != 0:
+                text = err_text.value.decode() if err_text.value else \
+                    errors.berror(int(rc))
+                cntl.set_failed(int(rc), text)
+                return None
+            payload = ctypes.string_at(resp_p, resp_len.value) \
+                if resp_len.value else b""
+            if ratt_len.value:
+                cntl.response_attachment.append(
+                    ctypes.string_at(ratt_p, ratt_len.value))
+            if response_cls is None:
+                return payload
+            response = response_cls()
+            response.ParseFromString(payload)
+            return response
+        finally:
+            if resp_p:
+                self._lib.brpc_tpu_buf_free(resp_p)
+            if ratt_p:
+                self._lib.brpc_tpu_buf_free(ratt_p)
+            if err_text:
+                self._lib.brpc_tpu_buf_free(err_text)
+
+    # ---- async completion API (reference: CallMethod with done) -------
+
+    def call_method_async(self, full_name: str, cntl: Controller,
+                          request: Any,
+                          response_cls: Optional[Type] = None,
+                          done: Optional[Callable] = None
+                          ) -> "NativeCallFuture":
+        """Fire the call and return a future; `done(cntl)` (if given)
+        runs on the channel's native reader thread when the response,
+        timeout, or failure arrives.  The reference's async CallMethod
+        with a done closure."""
+        if hasattr(request, "SerializeToString"):
+            req = request.SerializeToString()
+        else:
+            req = bytes(request) if request is not None else b""
+        att = cntl.request_attachment.to_bytes() \
+            if len(cntl.request_attachment) else b""
+        fut = NativeCallFuture(cntl, response_cls, done)
+        _inflight_futures[id(fut)] = fut   # pinned until completion: the
+        # native side holds only the raw trampoline pointer
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        reqb = ctypes.cast(req, u8p) if req else None
+        attb = ctypes.cast(att, u8p) if att else None
+        timeout_us = int((cntl.timeout_ms or 5000) * 1000)
+        # the trampoline AND the request bytes must outlive the call:
+        # pinned on the future until completion
+        fut._pin = (req, att)
+        rc = self._lib.brpc_tpu_nchannel_call_async(
+            self._handle, full_name.encode(), reqb, len(req), attb,
+            len(att), timeout_us, fut._cb, None)
+        # rc != 0 means the failure completed synchronously — the
+        # callback already fired and the future is done; callers can
+        # check fut.done() to distinguish written-vs-failed-before-write
+        return fut
+
+
+_inflight_futures: Dict[int, "NativeCallFuture"] = {}
+
+
+class NativeCallFuture:
+    """Completion handle for call_method_async: wait() blocks; or poll
+    done(); the optional user callback runs on the reader thread."""
+
+    def __init__(self, cntl: Controller, response_cls: Optional[Type],
+                 user_done: Optional[Callable]):
+        self.cntl = cntl
+        self.response = None
+        self._response_cls = response_cls
+        self._user_done = user_done
+        self._event = threading.Event()
+        self._cb = _ASYNC_CB(self._on_complete)   # pinned for lifetime
+        self._pin = None
+        self._once = threading.Lock()
+        self._completed = False
+
+    def _on_complete(self, _user, err, err_text, resp_p, resp_len,
+                     att_p, att_len):
+        # one-shot: belt-and-braces against any native double-fire — the
+        # user's done must never run twice
+        with self._once:
+            if self._completed:
+                return
+            self._completed = True
+        try:
+            if err != 0:
+                text = err_text.decode() if err_text else \
+                    errors.berror(int(err))
+                self.cntl.set_failed(int(err), text)
+            else:
+                payload = ctypes.string_at(resp_p, resp_len) \
+                    if resp_len else b""
+                if att_len:
+                    self.cntl.response_attachment.append(
+                        ctypes.string_at(att_p, att_len))
+                if self._response_cls is not None:
+                    try:
+                        resp = self._response_cls()
+                        resp.ParseFromString(payload)
+                        self.response = self.cntl.response = resp
+                    except Exception as e:
+                        self.cntl.set_failed(
+                            errors.ERESPONSE, f"bad response: {e}")
+                else:
+                    self.response = self.cntl.response = payload
+        finally:
+            self._pin = None
+            _inflight_futures.pop(id(self), None)
+            self._event.set()
+            if self._user_done is not None:
+                try:
+                    self._user_done(self.cntl)
+                except Exception as e:     # never raise across ctypes
+                    log.error("async done callback raised: %s", e,
+                              exc_info=True)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+
+class NativePooledChannel:
+    """N native connections round-robined per call (reference pooled
+    sockets, socket.h:256-262): concurrent large requests overlap in the
+    kernel instead of serializing on one stream."""
+
+    def __init__(self):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError("native core unavailable")
+        self._handle = 0
+
+    def init(self, address: str, nconns: int = 4) -> None:
+        addr = address.split("://", 1)[-1]
+        host, _, port = addr.rpartition(":")
+        h = self._lib.brpc_tpu_npool_connect(
+            host.encode() or b"127.0.0.1", int(port), nconns)
+        if h == 0:
+            raise ConnectionError(f"cannot connect {address}")
+        self._handle = h
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.brpc_tpu_npool_close(self._handle)
+            self._handle = 0
+
+    def call_method(self, full_name: str, cntl: Controller, request: Any,
+                    response_cls: Optional[Type] = None):
+        if hasattr(request, "SerializeToString"):
+            req = request.SerializeToString()
+        else:
+            req = bytes(request) if request is not None else b""
+        att = cntl.request_attachment.to_bytes() \
+            if len(cntl.request_attachment) else b""
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        reqb = ctypes.cast(req, u8p) if req else None
+        attb = ctypes.cast(att, u8p) if att else None
+        resp_p, resp_len = u8p(), ctypes.c_uint64()
+        ratt_p, ratt_len = u8p(), ctypes.c_uint64()
+        err_text = ctypes.c_char_p()
+        timeout_us = int((cntl.timeout_ms or 5000) * 1000)
+        rc = self._lib.brpc_tpu_npool_call(
+            self._handle, full_name.encode(), reqb, len(req), attb,
+            len(att), timeout_us, ctypes.byref(resp_p),
+            ctypes.byref(resp_len), ctypes.byref(ratt_p),
+            ctypes.byref(ratt_len), ctypes.byref(err_text))
         try:
             if rc != 0:
                 text = err_text.value.decode() if err_text.value else \

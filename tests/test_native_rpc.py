@@ -264,3 +264,115 @@ def test_native_rpc_bench_entries():
     assert p50 < 2000  # generous CI bound; ~10us on quiet hardware
     qps = native.native_rpc_qps(threads=4, duration_ms=300, payload=64)
     assert qps > 1000
+
+
+def test_native_async_call():
+    """Async completion API (VERDICT r3 #5): the callback fires from the
+    channel's reader thread with the parsed response; wait() blocks."""
+    from brpc_tpu.rpc.native_fabric import NativeServer, NativeChannel
+    server = NativeServer()
+    server.add_service(EchoService())
+    port = server.start(0)
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    try:
+        import threading
+        seen = []
+        ev = threading.Event()
+
+        def done(cntl):
+            seen.append((cntl.failed(), cntl.response))
+            ev.set()
+
+        cntl = rpc.Controller()
+        cntl.timeout_ms = 5000
+        fut = ch.call_method_async("EchoService.Echo", cntl,
+                                   EchoRequest(message="async-hi"),
+                                   EchoResponse, done=done)
+        assert fut.wait(10)
+        assert fut.done()
+        assert ev.wait(5)
+        assert seen[0][0] is False
+        assert fut.response.message == "async-hi"
+        # several overlapping async calls on one channel
+        futs = []
+        for i in range(8):
+            c = rpc.Controller()
+            c.timeout_ms = 5000
+            futs.append((i, ch.call_method_async(
+                "EchoService.Echo", c, EchoRequest(message=f"a{i}"),
+                EchoResponse)))
+        for i, f in futs:
+            assert f.wait(10), f"async call {i} never completed"
+            assert not f.cntl.failed(), f.cntl.error_text
+            assert f.response.message == f"a{i}"
+    finally:
+        ch.close()
+        server.stop()
+
+
+def test_native_async_timeout():
+    """An async call against a Python-handled method that never responds
+    times out via the reader's deadline sweep."""
+    from brpc_tpu.rpc.native_fabric import NativeServer, NativeChannel
+
+    class BlackHole(rpc.Service):
+        SERVICE_NAME = "EchoService"
+
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            pass                        # never calls done()
+
+    server = NativeServer()
+    server.add_service(BlackHole())
+    port = server.start(0)
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    try:
+        cntl = rpc.Controller()
+        cntl.timeout_ms = 200
+        fut = ch.call_method_async("EchoService.Echo", cntl,
+                                   EchoRequest(message="x"), EchoResponse)
+        assert fut.wait(10)
+        assert fut.cntl.failed()
+        assert fut.cntl.error_code_ == errors.ERPCTIMEDOUT
+    finally:
+        ch.close()
+        server.stop()
+
+
+def test_native_pooled_channel():
+    """Pooled multi-connection channel: concurrent callers round-robin
+    over N native connections (reference pooled sockets)."""
+    import threading
+    from brpc_tpu.rpc.native_fabric import NativeServer, NativePooledChannel
+    server = NativeServer()
+    server.add_service(EchoService())
+    port = server.start(0)
+    pool = NativePooledChannel()
+    pool.init(f"127.0.0.1:{port}", nconns=3)
+    errs = []
+    try:
+        def worker(wid):
+            try:
+                for i in range(10):
+                    cntl = rpc.Controller()
+                    cntl.timeout_ms = 5000
+                    resp = pool.call_method(
+                        "EchoService.Echo", cntl,
+                        EchoRequest(message=f"p{wid}-{i}"), EchoResponse)
+                    assert not cntl.failed(), cntl.error_text
+                    assert resp.message == f"p{wid}-{i}"
+            except Exception as e:   # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+    finally:
+        pool.close()
+        server.stop()
