@@ -157,6 +157,55 @@ class NativeServer:
                 pass
 
 
+
+def _marshal_sync_call(lib, call_fn, handle, full_name: str,
+                       cntl: Controller, request: Any,
+                       response_cls: Optional[Type]):
+    """Shared ctypes marshalling for the sync native call ABIs (channel
+    and pool take identical argument/output shapes)."""
+    if hasattr(request, "SerializeToString"):
+        req = request.SerializeToString()
+    else:
+        req = bytes(request) if request is not None else b""
+    att = cntl.request_attachment.to_bytes() \
+        if len(cntl.request_attachment) else b""
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    reqb = ctypes.cast(req, u8p) if req else None
+    attb = ctypes.cast(att, u8p) if att else None
+    resp_p, resp_len = u8p(), ctypes.c_uint64()
+    ratt_p, ratt_len = u8p(), ctypes.c_uint64()
+    err_text = ctypes.c_char_p()
+    timeout_us = int((cntl.timeout_ms or 5000) * 1000)
+    rc = call_fn(
+        handle, full_name.encode(), reqb, len(req), attb, len(att),
+        timeout_us, ctypes.byref(resp_p), ctypes.byref(resp_len),
+        ctypes.byref(ratt_p), ctypes.byref(ratt_len),
+        ctypes.byref(err_text))
+    try:
+        if rc != 0:
+            text = err_text.value.decode() if err_text.value else \
+                errors.berror(int(rc))
+            cntl.set_failed(int(rc), text)
+            return None
+        payload = ctypes.string_at(resp_p, resp_len.value) \
+            if resp_len.value else b""
+        if ratt_len.value:
+            cntl.response_attachment.append(
+                ctypes.string_at(ratt_p, ratt_len.value))
+        if response_cls is None:
+            return payload
+        response = response_cls()
+        response.ParseFromString(payload)
+        return response
+    finally:
+        if resp_p:
+            lib.brpc_tpu_buf_free(resp_p)
+        if ratt_p:
+            lib.brpc_tpu_buf_free(ratt_p)
+        if err_text:
+            lib.brpc_tpu_buf_free(err_text)
+
+
 class NativeChannel:
     """Client whose datapath is native: serialize in Python once, then the
     frame/write/read/correlate cycle runs in C++ with the GIL released."""
@@ -186,49 +235,9 @@ class NativeChannel:
                     response_cls: Optional[Type] = None):
         """Synchronous call over the native datapath.  Fills cntl error
         state and response_attachment; returns the parsed response."""
-        if hasattr(request, "SerializeToString"):
-            req = request.SerializeToString()
-        else:
-            req = bytes(request) if request is not None else b""
-        att = cntl.request_attachment.to_bytes() \
-            if len(cntl.request_attachment) else b""
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        reqb = (ctypes.c_uint8 * len(req)).from_buffer_copy(req) if req \
-            else None
-        attb = (ctypes.c_uint8 * len(att)).from_buffer_copy(att) if att \
-            else None
-        resp_p, resp_len = u8p(), ctypes.c_uint64()
-        ratt_p, ratt_len = u8p(), ctypes.c_uint64()
-        err_text = ctypes.c_char_p()
-        timeout_us = int((cntl.timeout_ms or 5000) * 1000)
-        rc = self._lib.brpc_tpu_nchannel_call(
-            self._handle, full_name.encode(), reqb, len(req), attb, len(att),
-            timeout_us, ctypes.byref(resp_p), ctypes.byref(resp_len),
-            ctypes.byref(ratt_p), ctypes.byref(ratt_len),
-            ctypes.byref(err_text))
-        try:
-            if rc != 0:
-                text = err_text.value.decode() if err_text.value else \
-                    errors.berror(int(rc))
-                cntl.set_failed(int(rc), text)
-                return None
-            payload = ctypes.string_at(resp_p, resp_len.value) \
-                if resp_len.value else b""
-            if ratt_len.value:
-                cntl.response_attachment.append(
-                    ctypes.string_at(ratt_p, ratt_len.value))
-            if response_cls is None:
-                return payload
-            response = response_cls()
-            response.ParseFromString(payload)
-            return response
-        finally:
-            if resp_p:
-                self._lib.brpc_tpu_buf_free(resp_p)
-            if ratt_p:
-                self._lib.brpc_tpu_buf_free(ratt_p)
-            if err_text:
-                self._lib.brpc_tpu_buf_free(err_text)
+        return _marshal_sync_call(self._lib, self._lib.brpc_tpu_nchannel_call,
+                                  self._handle, full_name, cntl, request,
+                                  response_cls)
 
     # ---- async completion API (reference: CallMethod with done) -------
 
@@ -359,44 +368,6 @@ class NativePooledChannel:
 
     def call_method(self, full_name: str, cntl: Controller, request: Any,
                     response_cls: Optional[Type] = None):
-        if hasattr(request, "SerializeToString"):
-            req = request.SerializeToString()
-        else:
-            req = bytes(request) if request is not None else b""
-        att = cntl.request_attachment.to_bytes() \
-            if len(cntl.request_attachment) else b""
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        reqb = ctypes.cast(req, u8p) if req else None
-        attb = ctypes.cast(att, u8p) if att else None
-        resp_p, resp_len = u8p(), ctypes.c_uint64()
-        ratt_p, ratt_len = u8p(), ctypes.c_uint64()
-        err_text = ctypes.c_char_p()
-        timeout_us = int((cntl.timeout_ms or 5000) * 1000)
-        rc = self._lib.brpc_tpu_npool_call(
-            self._handle, full_name.encode(), reqb, len(req), attb,
-            len(att), timeout_us, ctypes.byref(resp_p),
-            ctypes.byref(resp_len), ctypes.byref(ratt_p),
-            ctypes.byref(ratt_len), ctypes.byref(err_text))
-        try:
-            if rc != 0:
-                text = err_text.value.decode() if err_text.value else \
-                    errors.berror(int(rc))
-                cntl.set_failed(int(rc), text)
-                return None
-            payload = ctypes.string_at(resp_p, resp_len.value) \
-                if resp_len.value else b""
-            if ratt_len.value:
-                cntl.response_attachment.append(
-                    ctypes.string_at(ratt_p, ratt_len.value))
-            if response_cls is None:
-                return payload
-            response = response_cls()
-            response.ParseFromString(payload)
-            return response
-        finally:
-            if resp_p:
-                self._lib.brpc_tpu_buf_free(resp_p)
-            if ratt_p:
-                self._lib.brpc_tpu_buf_free(ratt_p)
-            if err_text:
-                self._lib.brpc_tpu_buf_free(err_text)
+        return _marshal_sync_call(self._lib, self._lib.brpc_tpu_npool_call,
+                                  self._handle, full_name, cntl, request,
+                                  response_cls)

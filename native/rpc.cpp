@@ -983,6 +983,11 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
       std::lock_guard<std::mutex> g(slots_mu_);
       slots_[cid] = slot;
     }
+    // pull the sweep forward if this deadline is the nearest (benign
+    // race: worst case one 50ms-late sweep)
+    int64_t cur = next_sweep_ns_.load(std::memory_order_relaxed);
+    if (slot->deadline_ns < cur)
+      next_sweep_ns_.store(slot->deadline_ns, std::memory_order_relaxed);
     ensure_reader();
     if (!pack_and_write(service_dot_method, req, req_len, att, att_len,
                         timeout_us, cid)) {
@@ -1021,8 +1026,11 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
   // caller-becomes-reader; read_mu_ arbitrates.  Started on the first
   // async call, lives until close.
   void ensure_reader() {
-    bool expected = false;
-    if (!reader_started_.compare_exchange_strong(expected, true)) return;
+    // reader_ construction and join are serialized by reader_mu_ — a
+    // flag-then-assign publication would let a concurrent close_ch read
+    // the std::thread object mid-move (UB)
+    std::lock_guard<std::mutex> g(reader_mu_);
+    if (reader_.joinable()) return;
     // the loop holds a self-reference: the destructor can never run
     // while the reader is mid-iteration (an async callback may drop the
     // last external ref)
@@ -1033,40 +1041,54 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
           self->read_once(50);
           self->read_mu_.unlock();
         } else {
+          // a sync caller is the reader right now; it fills async slots
+          // too, so just yield briefly
           std::this_thread::sleep_for(std::chrono::milliseconds(1));
         }
-        self->sweep_async_deadlines();
+        // deadline sweep only when something can actually expire — a
+        // per-iteration full slot scan would contend the dispatch path
+        if (now_steady_ns() >=
+            self->next_sweep_ns_.load(std::memory_order_relaxed))
+          self->sweep_async_deadlines();
       }
     });
   }
 
   void join_reader() {
-    if (!reader_started_.load(std::memory_order_acquire) ||
-        !reader_.joinable())
-      return;
-    if (reader_.get_id() == std::this_thread::get_id()) {
+    std::thread t;
+    {
+      std::lock_guard<std::mutex> g(reader_mu_);
+      t = std::move(reader_);
+    }
+    if (!t.joinable()) return;
+    if (t.get_id() == std::this_thread::get_id()) {
       // close() called from inside an async completion callback (which
       // runs ON the reader thread): self-join would abort the process.
       // Detach — the loop exits right after the callback returns
       // (closing_ is set), and it holds its own shared_ptr, so no
       // use-after-free.
-      reader_.detach();
+      t.detach();
       return;
     }
-    reader_.join();
+    t.join();
   }
 
   void sweep_async_deadlines() {
     int64_t now = now_steady_ns();
+    int64_t next = now + 50 * 1000 * 1000;    // idle: re-check in 50ms
     std::vector<std::pair<uint64_t, SlotPtr>> expired;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
       for (auto& kv : slots_) {
-        if (kv.second->cb != nullptr && kv.second->deadline_ns <= now)
+        if (kv.second->cb == nullptr) continue;
+        if (kv.second->deadline_ns <= now)
           expired.push_back(kv);
+        else
+          next = std::min(next, kv.second->deadline_ns);
       }
       for (auto& kv : expired) slots_.erase(kv.first);
     }
+    next_sweep_ns_.store(next, std::memory_order_relaxed);
     for (auto& [cid, slot] : expired) {
       bool fire = false;
       {
@@ -1225,8 +1247,9 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
   std::string rbuf_;
   std::mutex slots_mu_;
   std::unordered_map<uint64_t, SlotPtr> slots_;
-  std::atomic<bool> reader_started_{false};
+  std::mutex reader_mu_;
   std::thread reader_;
+  std::atomic<int64_t> next_sweep_ns_{0};
 };
 
 // Pooled multi-connection channel (reference: pooled sockets,
@@ -1809,6 +1832,34 @@ static std::shared_ptr<NativePool> find_pool(uint64_t h) {
   return it == g_pools.end() ? nullptr : it->second;
 }
 
+// Shared sync-call → C-ABI-outputs marshalling (channel and pool paths).
+static uint64_t call_and_fill_outputs(
+    const std::shared_ptr<NativeChannel>& c, const char* method,
+    const uint8_t* req, uint64_t req_len, const uint8_t* att,
+    uint64_t att_len, int64_t timeout_us, uint8_t** resp_out,
+    uint64_t* resp_len, uint8_t** att_out, uint64_t* att_out_len,
+    char** err_text_out) {
+  CallResult out;
+  std::string err_text;
+  uint64_t rc = c->call(method, req, req_len, att, att_len, timeout_us,
+                        &out, &err_text);
+  if (out.p_len) {
+    *resp_out = (uint8_t*)malloc(out.p_len);
+    memcpy(*resp_out, out.payload(), out.p_len);
+    *resp_len = out.p_len;
+  }
+  if (out.a_len) {
+    *att_out = (uint8_t*)malloc(out.a_len);
+    memcpy(*att_out, out.attachment(), out.a_len);
+    *att_out_len = out.a_len;
+  }
+  if (!err_text.empty()) {
+    *err_text_out = (char*)malloc(err_text.size() + 1);
+    memcpy(*err_text_out, err_text.c_str(), err_text.size() + 1);
+  }
+  return rc;
+}
+
 }  // namespace nrpc
 
 // ====================================================================
@@ -1906,25 +1957,9 @@ uint64_t brpc_tpu_nchannel_call(uint64_t h, const char* method,
   *err_text_out = nullptr;
   auto c = nrpc::find_channel(h);    // shared ref: close can't free mid-call
   if (c == nullptr) return 1009;
-  nrpc::CallResult out;
-  std::string err_text;
-  uint64_t rc = c->call(method, req, req_len, att, att_len, timeout_us,
-                        &out, &err_text);
-  if (out.p_len) {
-    *resp_out = (uint8_t*)malloc(out.p_len);
-    memcpy(*resp_out, out.payload(), out.p_len);
-    *resp_len = out.p_len;
-  }
-  if (out.a_len) {
-    *att_out = (uint8_t*)malloc(out.a_len);
-    memcpy(*att_out, out.attachment(), out.a_len);
-    *att_out_len = out.a_len;
-  }
-  if (!err_text.empty()) {
-    *err_text_out = (char*)malloc(err_text.size() + 1);
-    memcpy(*err_text_out, err_text.c_str(), err_text.size() + 1);
-  }
-  return rc;
+  return nrpc::call_and_fill_outputs(c, method, req, req_len, att, att_len,
+                                     timeout_us, resp_out, resp_len,
+                                     att_out, att_out_len, err_text_out);
 }
 
 // Async call: `cb` fires exactly once from the channel's reader thread
@@ -1966,26 +2001,10 @@ uint64_t brpc_tpu_npool_call(uint64_t h, const char* method,
   *err_text_out = nullptr;
   auto p = nrpc::find_pool(h);
   if (p == nullptr) return 1009;
-  auto c = p->pick();
-  nrpc::CallResult out;
-  std::string err_text;
-  uint64_t rc = c->call(method, req, req_len, att, att_len, timeout_us,
-                        &out, &err_text);
-  if (out.p_len) {
-    *resp_out = (uint8_t*)malloc(out.p_len);
-    memcpy(*resp_out, out.payload(), out.p_len);
-    *resp_len = out.p_len;
-  }
-  if (out.a_len) {
-    *att_out = (uint8_t*)malloc(out.a_len);
-    memcpy(*att_out, out.attachment(), out.a_len);
-    *att_out_len = out.a_len;
-  }
-  if (!err_text.empty()) {
-    *err_text_out = (char*)malloc(err_text.size() + 1);
-    memcpy(*err_text_out, err_text.c_str(), err_text.size() + 1);
-  }
-  return rc;
+  return nrpc::call_and_fill_outputs(p->pick(), method, req, req_len, att,
+                                     att_len, timeout_us, resp_out,
+                                     resp_len, att_out, att_out_len,
+                                     err_text_out);
 }
 
 void brpc_tpu_npool_close(uint64_t h) {
