@@ -200,9 +200,12 @@ def bench_streaming_mbps(seconds: float = 1.5, chunk: int = 64 * 1024):
     return {"stream_mbps": received[0] / dt / 1e6, "chunk": chunk}
 
 
-def bench_parallel_fanout_us(subs: int = 8, iters: int = 60):
+def bench_parallel_fanout_us(subs: int = 8, iters: int = 60,
+                             transport: str = "mem"):
     """BASELINE config 4 (parallel_echo): ParallelChannel fan-out to N
-    sub-channels, p50 end-to-end."""
+    sub-channels, p50 end-to-end.  transport "ici" runs the sub-calls
+    over the native ici plane (composed channels on the fast datapath);
+    "mem" exercises the pure-Python stack."""
     import brpc_tpu.policy  # noqa: F401
     from brpc_tpu import rpc
     from brpc_tpu.channels.parallel_channel import ParallelChannel
@@ -222,10 +225,12 @@ def bench_parallel_fanout_us(subs: int = 8, iters: int = 60):
         opts.usercode_inline = True
         s = rpc.Server(opts)
         s.add_service(EchoService())
-        s.start(f"mem://bench-par-{i}")
+        addr = (f"ici://{40 + i}" if transport == "ici"
+                else f"mem://bench-par-{i}")
+        s.start(addr)
         servers.append(s)
         sub = rpc.Channel()
-        sub.init(f"mem://bench-par-{i}")
+        sub.init(addr)
         pc.add_channel(sub)
     lat = []
     for i in range(iters + 10):
@@ -240,10 +245,11 @@ def bench_parallel_fanout_us(subs: int = 8, iters: int = 60):
         s.stop()
     lat.sort()
     return {"fanout_p50_us": lat[len(lat) // 2] if lat else -1.0,
-            "subs": subs}
+            "subs": subs, "transport": transport}
 
 
-def bench_qps(seconds: float = 2.0, concurrency: int = 32):
+def bench_qps(seconds: float = 2.0, concurrency: int = 32,
+              transport: str = "mem"):
     import brpc_tpu.policy
     from brpc_tpu import rpc
     sys.path.insert(0, "tests")
@@ -260,9 +266,10 @@ def bench_qps(seconds: float = 2.0, concurrency: int = 32):
     opts.usercode_inline = True           # echo handler is non-blocking
     server = rpc.Server(opts)
     server.add_service(EchoService())
-    server.start("mem://bench-qps")
+    addr = "ici://50" if transport == "ici" else "mem://bench-qps"
+    server.start(addr)
     ch = rpc.Channel()
-    ch.init("mem://bench-qps", options=rpc.ChannelOptions(timeout_ms=10000))
+    ch.init(addr, options=rpc.ChannelOptions(timeout_ms=10000))
     count = [0]
     lock = threading.Lock()
     stop = time.monotonic() + seconds
@@ -597,6 +604,12 @@ def main() -> None:
         print(f"# qps failed: {e}", file=sys.stderr)
         qps = {}
     try:
+        iqps = bench_qps(transport="ici") if reachable else {}
+        print(f"# ici-native-plane qps: {iqps}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# ici qps failed: {e}", file=sys.stderr)
+        iqps = {}
+    try:
         strm = bench_streaming_mbps()
         print(f"# streaming: {strm}", file=sys.stderr)
     except Exception as e:  # pragma: no cover
@@ -604,10 +617,17 @@ def main() -> None:
         strm = {}
     try:
         fan = bench_parallel_fanout_us()
-        print(f"# parallel fanout: {fan}", file=sys.stderr)
+        print(f"# parallel fanout (mem): {fan}", file=sys.stderr)
     except Exception as e:  # pragma: no cover
         print(f"# fanout failed: {e}", file=sys.stderr)
         fan = {}
+    try:
+        ifan = bench_parallel_fanout_us(transport="ici") if reachable \
+            else {}
+        print(f"# parallel fanout (ici): {ifan}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# ici fanout failed: {e}", file=sys.stderr)
+        ifan = {}
     try:
         fb = bench_fabric_gbps()
         print(f"# fabric cross-process: {fb}", file=sys.stderr)
@@ -673,8 +693,11 @@ def main() -> None:
         "raw_epoll_echo_p50_us": round(raw_p50, 2),
         "fabric_xproc_gbps": round(fb.get("fabric_xproc_gbps", -1.0), 3),
         "python_stack_qps": round(qps.get("qps", 0.0), 0),
+        "ici_native_plane_qps": round(iqps.get("qps", -1.0), 0),
         "streaming_mbps": round(strm.get("stream_mbps", 0.0), 1),
         "parallel_fanout8_p50_us": round(fan.get("fanout_p50_us", 0.0), 1),
+        "parallel_fanout8_ici_p50_us": round(
+            ifan.get("fanout_p50_us", -1.0), 1),
         "tail_isolation_ratio": round(
             tail.get("tail_isolation_ratio", -1.0), 3),
         "tail_baseline_clean": tail.get("baseline_clean", False),

@@ -63,6 +63,32 @@ def _device_memory_bytes() -> int:
     return 0
 
 
+def _ici_bytes_moved() -> int:
+    try:
+        from ..ici.transport import ici_transport_stats
+        return ici_transport_stats()[0]
+    except Exception:
+        return 0
+
+
+def _ici_device_bytes_moved() -> int:
+    try:
+        from ..ici.transport import ici_transport_stats
+        return ici_transport_stats()[1]
+    except Exception:
+        return 0
+
+
+def _ici_refs_in_custody() -> int:
+    """Device refs pinned by the native ici plane (0 unless a transfer is
+    mid-flight — a steady nonzero value means a custody leak)."""
+    try:
+        from ..ici import native_plane
+        return native_plane.registry().live()
+    except Exception:
+        return 0
+
+
 def expose_default_variables() -> None:
     with _lock:
         if _exposed:
@@ -76,4 +102,7 @@ def expose_default_variables() -> None:
             PassiveStatus(_cpu_seconds, "process_cpu_seconds"),
             PassiveStatus(_device_count, "tpu_device_count"),
             PassiveStatus(_device_memory_bytes, "tpu_hbm_bytes_in_use"),
+            PassiveStatus(_ici_bytes_moved, "ici_bytes_moved"),
+            PassiveStatus(_ici_device_bytes_moved, "ici_device_bytes_moved"),
+            PassiveStatus(_ici_refs_in_custody, "ici_refs_in_custody"),
         ])

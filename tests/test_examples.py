@@ -22,6 +22,7 @@ EXAMPLES = [
     "examples.auto_concurrency_limiter",
     "examples.param_server",
     "examples.native_echo",
+    "examples.native_async_pool",
     "examples.mongo_service",
     "examples.cascade_echo",
     "examples.grpc_echo",

@@ -175,6 +175,15 @@ def generate_module_source(message_classes) -> str:
     for d in closure:
         _gen_dict_fn(d, out)
         _gen_fill_fn(d, out)
+    shorts = {}
+    for cls in message_classes:
+        prev = shorts.setdefault(cls.DESCRIPTOR.name, cls.DESCRIPTOR)
+        if prev is not cls.DESCRIPTOR:
+            # encode_X names use the short name — two same-named messages
+            # from different packages would silently shadow each other
+            raise ValueError(
+                f"duplicate short message name {cls.DESCRIPTOR.name!r}: "
+                f"{prev.full_name} vs {cls.DESCRIPTOR.full_name}")
     for cls in message_classes:
         d = cls.DESCRIPTOR
         short = d.name
