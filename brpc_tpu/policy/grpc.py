@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..butil.iobuf import IOBuf
@@ -52,11 +53,38 @@ DEFAULT_MAX_FRAME = 16384
 
 GRPC_OK = 0
 GRPC_UNKNOWN = 2
+GRPC_INVALID_ARGUMENT = 3
+GRPC_DEADLINE_EXCEEDED = 4
+GRPC_RESOURCE_EXHAUSTED = 8
 GRPC_UNIMPLEMENTED = 12
 GRPC_INTERNAL = 13
+GRPC_UNAVAILABLE = 14
 
-_GRPC_TO_RPC = {GRPC_UNIMPLEMENTED: errors.ENOMETHOD,
-                GRPC_INTERNAL: errors.EINTERNAL}
+# bidirectional status mapping (reference grpc.cpp ErrorCodeToGrpcStatus /
+# GrpcStatusToErrorCode)
+_GRPC_TO_RPC = {GRPC_INVALID_ARGUMENT: errors.EREQUEST,
+                GRPC_DEADLINE_EXCEEDED: errors.ERPCTIMEDOUT,
+                GRPC_RESOURCE_EXHAUSTED: errors.ELIMIT,
+                GRPC_UNIMPLEMENTED: errors.ENOMETHOD,
+                GRPC_INTERNAL: errors.EINTERNAL,
+                GRPC_UNAVAILABLE: errors.EFAILEDSOCKET}
+_RPC_TO_GRPC = {v: k for k, v in _GRPC_TO_RPC.items()}   # bijective
+
+# grpc-timeout header units (gRPC HTTP/2 spec): value is ASCII digits +
+# one unit char
+_TIMEOUT_UNITS_NS = {b"H": 3600 * 10**9, b"M": 60 * 10**9, b"S": 10**9,
+                     b"m": 10**6, b"u": 10**3, b"n": 1}
+
+
+def parse_grpc_timeout_ms(value: bytes) -> Optional[int]:
+    """"100m" → 100; None when absent/malformed."""
+    if not value or len(value) < 2:
+        return None
+    unit = value[-1:]
+    mult = _TIMEOUT_UNITS_NS.get(unit)
+    if mult is None or not value[:-1].isdigit():
+        return None
+    return max(1, int(value[:-1]) * mult // 10**6)
 
 
 def frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes:
@@ -422,22 +450,51 @@ def _process_one_request(st: _H2Stream, socket, server) -> None:
     path = st.header(b":path").decode()
     parts = [p for p in path.split("/") if p]
     full_name = ".".join(parts[-2:]) if len(parts) >= 2 else path
+    start_us = time.monotonic_ns() // 1000
+    cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = socket.remote_side
+    # grpc-timeout propagation (gRPC-over-HTTP/2 spec): the client's
+    # deadline lands on cntl.method_deadline — the SAME server-side field
+    # every other protocol uses (tpu_std.py:183), so handler code is
+    # transport-independent
+    deadline_ms = parse_grpc_timeout_ms(st.header(b"grpc-timeout"))
+    if deadline_ms is not None:
+        cntl.method_deadline = time.monotonic() + deadline_ms / 1000.0
     md = server.find_method(full_name)
-    if md is None:
+    status = server.method_status(full_name) if md is not None else None
+    server_counted = [False]
+
+    def reply_error(code: int, text: str) -> None:
         _send_grpc_response(socket, st.stream_id, None,
-                            GRPC_UNIMPLEMENTED, f"unknown method {path}")
+                            _RPC_TO_GRPC.get(code, GRPC_INTERNAL), text)
+        if server_counted[0]:
+            server.on_request_out()
+
+    # the same overload discipline as every other server protocol
+    # (tpu_std.py:227): without it a grpc server could never generate
+    # RESOURCE_EXHAUSTED itself
+    if not server.on_request_in():
+        reply_error(errors.ELIMIT, "server max_concurrency reached")
+        return
+    server_counted[0] = True
+    if md is None:
+        reply_error(errors.ENOMETHOD, f"unknown method {path}")
+        return
+    if status is not None and not status.on_requested():
+        status = None             # don't on_responded a rejected request
+        reply_error(errors.ELIMIT,
+                    f"method {full_name} max_concurrency reached")
         return
     msgs = split_grpc_messages(bytes(st.data))
     try:
         request = md.request_cls()
         request.ParseFromString(msgs[0] if msgs else b"")
     except Exception as e:
-        _send_grpc_response(socket, st.stream_id, None, GRPC_INTERNAL,
-                            f"bad request: {e}")
+        if status is not None:
+            status.on_responded(errors.EREQUEST, 0)
+        reply_error(errors.EREQUEST, f"bad request: {e}")
         return
-    cntl = Controller()
-    cntl.server = server
-    cntl.remote_side = socket.remote_side
     response = md.response_cls()
     done_called = [False]
 
@@ -446,11 +503,17 @@ def _process_one_request(st: _H2Stream, socket, server) -> None:
             return
         done_called[0] = True
         if cntl.failed():
-            _send_grpc_response(socket, st.stream_id, None, GRPC_INTERNAL,
-                                cntl.error_text_)
+            _send_grpc_response(
+                socket, st.stream_id, None,
+                _RPC_TO_GRPC.get(cntl.error_code_, GRPC_INTERNAL),
+                cntl.error_text_)
         else:
             _send_grpc_response(socket, st.stream_id,
                                 response.SerializeToString(), GRPC_OK, "")
+        if status is not None:
+            status.on_responded(cntl.error_code_,
+                                time.monotonic_ns() // 1000 - start_us)
+        server.on_request_out()
 
     cntl.set_server_done(done)
     try:
@@ -536,14 +599,28 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
         conn.next_stream_id += 2
         conn.cid_by_stream[stream_id] = cid
         authority = str(cntl.remote_side or "").encode() or b"fabric"
-        hdr = conn.enc.encode([
+        req_headers = [
             (b":method", b"POST"),
             (b":scheme", b"http"),
             (b":path", f"/{service}/{method}".encode()),
             (b":authority", authority),
             (b"content-type", b"application/grpc+proto"),
             (b"te", b"trailers"),
-        ])
+        ]
+        timeout_ms = getattr(cntl, "timeout_ms", None)
+        if timeout_ms and timeout_ms > 0:
+            # deadline crosses the wire (gRPC spec grpc-timeout header) as
+            # the REMAINING budget: a retry/hedge must not re-advertise
+            # the full original timeout (the server would over-budget
+            # work the client has already given up on)
+            start_us = getattr(cntl, "_start_us", 0)
+            if start_us:
+                elapsed_ms = (time.monotonic_ns() // 1000
+                              - start_us) / 1000.0
+                timeout_ms = max(1, int(timeout_ms - elapsed_ms))
+            req_headers.append(
+                (b"grpc-timeout", b"%dm" % int(timeout_ms)))
+        hdr = conn.enc.encode(req_headers)
         _append_header_block(conn, out, stream_id, hdr, end_stream=False)
         _send_data(conn, out, stream_id,
                    grpc_message(payload.to_bytes()), end_stream=True)

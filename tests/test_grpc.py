@@ -161,6 +161,137 @@ class TestGrpcEndToEnd:
         finally:
             server.stop()
 
+    def test_grpc_timeout_header_propagates_deadline(self):
+        """The gRPC spec's grpc-timeout header crosses the wire onto
+        cntl.method_deadline — the SAME server-side field tpu_std sets,
+        so handler code is transport-independent."""
+        import time as _time
+        seen = {}
+
+        class DeadlineProbe(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                seen["deadline"] = cntl.method_deadline
+                seen["now"] = _time.monotonic()
+                response.message = "ok"
+                done()
+
+        server = rpc.Server()
+        server.add_service(DeadlineProbe())
+        name = unique("grpc-deadline")
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}",
+                    options=rpc.ChannelOptions(protocol="grpc",
+                                               timeout_ms=2345))
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "ok"
+            assert seen["deadline"] is not None
+            left = seen["deadline"] - seen["now"]
+            # remaining budget: positive, and no more than the client's
+            # 2345ms total
+            assert 0 < left <= 2.345 + 0.05, left
+        finally:
+            server.stop()
+
+    def test_grpc_server_enforces_max_concurrency(self):
+        """ServerOptions(max_concurrency) must produce RESOURCE_EXHAUSTED
+        over grpc like every other protocol (overload protection)."""
+        import threading
+        gate = threading.Event()
+        entered = threading.Event()
+
+        class Slow(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                entered.set()
+                gate.wait(10)
+                response.message = "done"
+                done()
+
+        opts = rpc.ServerOptions()
+        opts.max_concurrency = 1
+        server = rpc.Server(opts)
+        server.add_service(Slow())
+        name = unique("grpc-limit")
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}",
+                    options=rpc.ChannelOptions(protocol="grpc",
+                                               timeout_ms=15000))
+            first = {}
+
+            def occupy():
+                c = rpc.Controller()
+                r = ch.call_method("EchoService.Echo", c,
+                                   EchoRequest(message="a"), EchoResponse)
+                first["failed"] = c.failed()
+                first["resp"] = r
+
+            t = threading.Thread(target=occupy)
+            t.start()
+            assert entered.wait(10)          # slot occupied
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="b"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code == errors.ELIMIT
+            gate.set()
+            t.join(10)
+            assert first["failed"] is False
+            assert first["resp"].message == "done"
+        finally:
+            gate.set()
+            server.stop()
+
+    def test_grpc_timeout_unit_parsing(self):
+        from brpc_tpu.policy.grpc import parse_grpc_timeout_ms
+        assert parse_grpc_timeout_ms(b"100m") == 100
+        assert parse_grpc_timeout_ms(b"2S") == 2000
+        assert parse_grpc_timeout_ms(b"1M") == 60000
+        assert parse_grpc_timeout_ms(b"500u") == 1   # rounds up to >=1ms
+        assert parse_grpc_timeout_ms(b"") is None
+        assert parse_grpc_timeout_ms(b"abcm") is None
+        assert parse_grpc_timeout_ms(b"100x") is None
+
+    def test_status_codes_map_both_directions(self):
+        """ELIMIT → RESOURCE_EXHAUSTED(8) on the wire → ELIMIT back at
+        the client (reference grpc.cpp ErrorCode↔GrpcStatus)."""
+        class Limited(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                cntl.set_failed(errors.ELIMIT, "too busy")
+                done()
+
+        server = rpc.Server()
+        server.add_service(Limited())
+        name = unique("grpc-status")
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}",
+                    options=rpc.ChannelOptions(protocol="grpc",
+                                               timeout_ms=5000))
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code == errors.ELIMIT
+            assert "too busy" in cntl.error_text
+        finally:
+            server.stop()
+
     def test_large_message_crosses_flow_control_window(self):
         """A message several times the 65535-byte default window only
         completes if WINDOW_UPDATE credit is honored both directions
