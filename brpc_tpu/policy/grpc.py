@@ -60,6 +60,8 @@ GRPC_UNIMPLEMENTED = 12
 GRPC_INTERNAL = 13
 GRPC_UNAVAILABLE = 14
 
+GRPC_UNAUTHENTICATED = 16
+
 # bidirectional status mapping (reference grpc.cpp ErrorCodeToGrpcStatus /
 # GrpcStatusToErrorCode)
 _GRPC_TO_RPC = {GRPC_INVALID_ARGUMENT: errors.EREQUEST,
@@ -67,7 +69,8 @@ _GRPC_TO_RPC = {GRPC_INVALID_ARGUMENT: errors.EREQUEST,
                 GRPC_RESOURCE_EXHAUSTED: errors.ELIMIT,
                 GRPC_UNIMPLEMENTED: errors.ENOMETHOD,
                 GRPC_INTERNAL: errors.EINTERNAL,
-                GRPC_UNAVAILABLE: errors.EFAILEDSOCKET}
+                GRPC_UNAVAILABLE: errors.EFAILEDSOCKET,
+                GRPC_UNAUTHENTICATED: errors.ERPCAUTH}
 _RPC_TO_GRPC = {v: k for k, v in _GRPC_TO_RPC.items()}   # bijective
 
 # grpc-timeout header units (gRPC HTTP/2 spec): value is ASCII digits +
@@ -461,23 +464,62 @@ def _process_one_request(st: _H2Stream, socket, server) -> None:
     deadline_ms = parse_grpc_timeout_ms(st.header(b"grpc-timeout"))
     if deadline_ms is not None:
         cntl.method_deadline = time.monotonic() + deadline_ms / 1000.0
+    # one request discipline for BOTH content types on h2 — switching
+    # content-type must bypass neither the authenticator nor the
+    # server-level overload guard (review finding r4)
+    is_grpc = st.header(b"content-type").startswith(b"application/grpc")
+
+    def reject_early(code: int, text: str, http_code: int) -> None:
+        if is_grpc:
+            _send_grpc_response(socket, st.stream_id, None,
+                                _RPC_TO_GRPC.get(code, GRPC_INTERNAL), text)
+        else:
+            import json as _json
+            _send_h2_http_response(socket, st.stream_id, http_code,
+                                   _json.dumps({"error": text}).encode())
+
+    if not server.on_request_in():
+        reject_early(errors.ELIMIT, "server max_concurrency reached", 503)
+        return
+    # counted from here on: every exit path must on_request_out
+    if server.options.auth is not None:
+        cntl.auth_token = st.header(b"authorization").decode(
+            "utf-8", "replace")
+        if not server.options.auth.verify(cntl.auth_token, socket):
+            server.on_request_out()
+            reject_early(errors.ERPCAUTH, "authentication failed", 401)
+            return
+    if not is_grpc:
+        # the REST side of the reference's h2 protocol
+        # (http2_rpc_protocol.cpp serves both): JSON in, JSON out, plain
+        # HTTP response semantics (no grpc trailers); dispatch shared
+        # with policy/http.py so the two REST planes cannot drift
+        from .http import json_rpc_dispatch
+        md = server.find_method(full_name)
+        if md is None:
+            server.on_request_out()
+            import json as _json
+            _send_h2_http_response(
+                socket, st.stream_id, 404,
+                _json.dumps({"error": f"no handler for {path}"}).encode())
+            return
+
+        def send(code: int, body_bytes: bytes) -> None:
+            _send_h2_http_response(socket, st.stream_id, code, body_bytes)
+            server.on_request_out()
+
+        body = bytes(st.data).decode("utf-8", "replace") or "{}"
+        json_rpc_dispatch(server, md, full_name, body, send, start_us,
+                          cntl)
+        return
     md = server.find_method(full_name)
     status = server.method_status(full_name) if md is not None else None
-    server_counted = [False]
 
     def reply_error(code: int, text: str) -> None:
         _send_grpc_response(socket, st.stream_id, None,
                             _RPC_TO_GRPC.get(code, GRPC_INTERNAL), text)
-        if server_counted[0]:
-            server.on_request_out()
+        server.on_request_out()
 
-    # the same overload discipline as every other server protocol
-    # (tpu_std.py:227): without it a grpc server could never generate
-    # RESOURCE_EXHAUSTED itself
-    if not server.on_request_in():
-        reply_error(errors.ELIMIT, "server max_concurrency reached")
-        return
-    server_counted[0] = True
     if md is None:
         reply_error(errors.ENOMETHOD, f"unknown method {path}")
         return
@@ -522,6 +564,26 @@ def _process_one_request(st: _H2Stream, socket, server) -> None:
         if not done_called[0]:
             cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
             done()
+
+
+def _send_h2_http_response(socket, stream_id: int, status_code: int,
+                           body: bytes,
+                           content_type: bytes = b"application/json"
+                           ) -> None:
+    """Plain HTTP semantics over h2 (REST responses): :status + body,
+    END_STREAM on the last frame, no grpc trailers."""
+    conn = socket._h2_conn
+    with conn.lock:
+        out = IOBuf()
+        hdr = conn.enc.encode([
+            (b":status", str(status_code).encode()),
+            (b"content-type", content_type),
+            (b"content-length", str(len(body)).encode())])
+        _append_header_block(conn, out, stream_id, hdr,
+                             end_stream=not body)
+        if body:
+            _send_data(conn, out, stream_id, body, end_stream=True)
+        _h2_write(socket, out, "h2 rest response")
 
 
 def _append_header_block(conn: _H2Conn, out: IOBuf, stream_id: int,
@@ -607,6 +669,11 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
             (b"content-type", b"application/grpc+proto"),
             (b"te", b"trailers"),
         ]
+        auth_token = getattr(cntl, "auth_token", "")
+        if auth_token:
+            req_headers.append((b"authorization",
+                                auth_token if isinstance(auth_token, bytes)
+                                else auth_token.encode()))
         timeout_ms = getattr(cntl, "timeout_ms", None)
         if timeout_ms and timeout_ms > 0:
             # deadline crosses the wire (gRPC spec grpc-timeout header) as

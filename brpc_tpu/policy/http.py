@@ -141,26 +141,27 @@ def process_request(msg: HttpMessage, socket, server) -> None:
         "application/json"))
 
 
-def _process_json_rpc(msg: HttpMessage, socket, server, md, full_name,
-                      start_us) -> None:
-    cntl = Controller()
+def json_rpc_dispatch(server, md, full_name: str, body: str, send,
+                      start_us: int, cntl: Optional[Controller] = None
+                      ) -> None:
+    """JSON-RPC dispatch shared by HTTP/1 and h2 REST (policy/grpc.py):
+    method-status accounting, json2pb both directions, and the error-JSON
+    shapes, with ``send(code, body_bytes)`` as the transport-specific
+    responder.  ``send`` is called exactly once."""
+    if cntl is None:
+        cntl = Controller()
     cntl.server = server
-    cntl.remote_side = socket.remote_side
     status = server.method_status(full_name)
     if status is not None and not status.on_requested():
-        socket.write(_render_response(
-            503, b'{"error":"concurrency limit"}', "application/json"))
+        send(503, b'{"error":"concurrency limit"}')
         return
 
-    def finish(code: int, body: bytes) -> None:
-        socket.write(_render_response(code, body, "application/json"))
+    def finish(code: int, body_bytes: bytes) -> None:
+        send(code, body_bytes)
         if status is not None:
             status.on_responded(0 if code == 200 else code,
                                 time.monotonic_ns() // 1000 - start_us)
 
-    body = msg.body.decode("utf-8", "replace") if msg.body else "{}"
-    if msg.is_request and msg.method == "GET" and msg.query:
-        body = json.dumps(msg.query)
     ok, request, err = json2pb.json_to_pb(body, md.request_cls)
     if not ok:
         finish(400, json.dumps({"error": f"bad request JSON: {err}"}).encode())
@@ -186,6 +187,20 @@ def _process_json_rpc(msg: HttpMessage, socket, server, md, full_name,
         if not done_called[0]:
             cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
             done()
+
+
+def _process_json_rpc(msg: HttpMessage, socket, server, md, full_name,
+                      start_us) -> None:
+    cntl = Controller()
+    cntl.remote_side = socket.remote_side
+    body = msg.body.decode("utf-8", "replace") if msg.body else "{}"
+    if msg.is_request and msg.method == "GET" and msg.query:
+        body = json.dumps(msg.query)
+
+    def send(code: int, body_bytes: bytes) -> None:
+        socket.write(_render_response(code, body_bytes, "application/json"))
+
+    json_rpc_dispatch(server, md, full_name, body, send, start_us, cntl)
 
 
 # ---- client side ------------------------------------------------------

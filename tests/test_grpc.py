@@ -492,6 +492,156 @@ class TestH2Continuation:
         assert conn.streams[1].headers == [(b":path", b"/x")]
 
 
+class TestH2Rest:
+    """Non-gRPC content on HTTP/2 — the REST half of the reference's h2
+    protocol: JSON request in, plain HTTP response (no trailers)."""
+
+    def _roundtrip(self, path: str, body: bytes,
+                   content_type: bytes = b"application/json",
+                   server=None, extra_headers=()):
+        from brpc_tpu.policy import grpc as g
+        from brpc_tpu.butil.iobuf import IOBuf
+        if server is None:
+            server = rpc.Server()
+            server.add_service(GrpcEchoService())
+        sock = _FakeH2Socket()
+
+        class _Arg:
+            pass
+        arg = _Arg()
+        arg.server = server
+        enc = hpack.Encoder(index=False)
+        block = enc.encode([(b":method", b"POST"), (b":path", path.encode()),
+                            (b":scheme", b"http"),
+                            (b"content-type", content_type),
+                            *extra_headers])
+        wire = (g.PREFACE
+                + g.frame(g.FRAME_SETTINGS, 0, 0, b"")
+                + g.frame(g.FRAME_HEADERS, g.FLAG_END_HEADERS, 1, block)
+                + g.frame(g.FRAME_DATA, g.FLAG_END_STREAM, 1, body))
+        source = IOBuf(wire)
+        result = g.parse(source, sock, False, arg)
+        sock.sent.clear()                 # drop server SETTINGS/acks
+        g.process_request(result.message, sock, server)
+        frames = sock.drain_frames()
+        dec = hpack.Decoder()
+        headers = []
+        data = bytearray()
+        for ftype, flags, sid, payload in frames:
+            if ftype == g.FRAME_HEADERS:
+                headers.extend(dec.decode(payload))
+            elif ftype == g.FRAME_DATA:
+                data.extend(payload)
+        return dict(headers), bytes(data), frames
+
+    def test_json_request_gets_http_response(self):
+        import json
+        headers, data, frames = self._roundtrip(
+            "/EchoService/Echo", b'{"message":"rest"}')
+        assert headers[b":status"] == b"200"
+        assert headers[b"content-type"] == b"application/json"
+        assert json.loads(data)["message"] == "grpc:rest"
+        # plain HTTP shape: END_STREAM on the last DATA, NO trailers
+        from brpc_tpu.policy import grpc as g
+        assert frames[-1][0] == g.FRAME_DATA
+        assert frames[-1][1] & g.FLAG_END_STREAM
+        assert sum(1 for f in frames if f[0] == g.FRAME_HEADERS) == 1
+
+    def test_unknown_path_is_404(self):
+        headers, data, _ = self._roundtrip("/No/Such", b"{}")
+        assert headers[b":status"] == b"404"
+
+    def test_bad_json_is_400(self):
+        headers, data, _ = self._roundtrip("/EchoService/Echo",
+                                           b"not-json{")
+        assert headers[b":status"] == b"400"
+
+    def test_rest_cannot_bypass_authenticator(self):
+        """Switching content-type away from application/grpc must NOT
+        skip the server authenticator (review finding r4: an
+        unauthenticated entry point to every method)."""
+        class Auth:
+            def verify(self, token, socket):
+                return token == "Bearer ok"
+
+        sopts = rpc.ServerOptions()
+        sopts.auth = Auth()
+        server = rpc.Server(sopts)
+        server.add_service(GrpcEchoService())
+        headers, _, _ = self._roundtrip("/EchoService/Echo",
+                                        b'{"message":"x"}', server=server)
+        assert headers[b":status"] == b"401"
+        headers, data, _ = self._roundtrip(
+            "/EchoService/Echo", b'{"message":"x"}', server=server,
+            extra_headers=[(b"authorization", b"Bearer ok")])
+        assert headers[b":status"] == b"200"
+
+    def test_rest_counts_against_server_concurrency(self):
+        """h2 REST traffic participates in server max_concurrency — the
+        overload guard cannot be bypassed by content-type."""
+        sopts = rpc.ServerOptions()
+        sopts.max_concurrency = 1
+        server = rpc.Server(sopts)
+        server.add_service(GrpcEchoService())
+        # artificially occupy the only slot
+        assert server.on_request_in()
+        headers, _, _ = self._roundtrip("/EchoService/Echo",
+                                        b'{"message":"x"}', server=server)
+        assert headers[b":status"] == b"503"
+        server.on_request_out()
+        headers, _, _ = self._roundtrip("/EchoService/Echo",
+                                        b'{"message":"x"}', server=server)
+        assert headers[b":status"] == b"200"
+        # the REST path released its slot (send decrements)
+        assert server._server_concurrency == 0
+
+
+class TestGrpcAuth:
+    def test_authorization_header_round_trip(self):
+        """Channel auth credential rides the h2 authorization header; the
+        server authenticator verifies it (UNAUTHENTICATED on mismatch)."""
+        class TokenAuth:
+            def generate_credential(self, cntl):
+                return "Bearer sesame"
+
+            def verify(self, token, socket):
+                return token == "Bearer sesame"
+
+        class BadAuth(TokenAuth):
+            def generate_credential(self, cntl):
+                return "Bearer wrong"
+
+        sopts = rpc.ServerOptions()
+        sopts.auth = TokenAuth()
+        server = rpc.Server(sopts)
+        server.add_service(GrpcEchoService())
+        name = unique("grpc-auth")
+        assert server.start(f"mem://{name}") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"mem://{name}",
+                    options=rpc.ChannelOptions(protocol="grpc",
+                                               timeout_ms=5000,
+                                               auth=TokenAuth()))
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="a"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "grpc:a"
+            bad = rpc.Channel()
+            bad.init(f"mem://{name}",
+                     options=rpc.ChannelOptions(protocol="grpc",
+                                                timeout_ms=5000,
+                                                auth=BadAuth()))
+            cntl = rpc.Controller()
+            bad.call_method("EchoService.Echo", cntl,
+                            EchoRequest(message="b"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code == errors.ERPCAUTH
+        finally:
+            server.stop()
+
+
 class TestGrpcWireFixture:
     """Fixed golden bytes for a unary gRPC request — catches any drift in
     the frame layout, hpack encoding, or gRPC message framing (the
