@@ -261,12 +261,39 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
             _on_window_update(conn, socket, stream_id, inc)
         return
     if ftype == FRAME_GOAWAY:
+        # Streams ABOVE last_stream_id were never processed by the peer:
+        # fail their calls now — through the retry machinery, they are
+        # safe to re-issue (RFC 7540 §6.8/§8.1.4) — and evict the
+        # connection so no NEW stream is packed onto a going-away peer
+        # (it would just burn its deadline).
+        if not conn.is_server and len(payload) >= 8:
+            last_sid = struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF
+            with conn.lock:
+                victims = [sid for sid in conn.cid_by_stream
+                           if sid > last_sid]
+                for sid in victims:
+                    conn.streams.pop(sid, None)
+                    conn.pending.pop(sid, None)
+                    conn.stream_send.pop(sid, None)
+            for sid in victims:
+                _fail_client_stream(conn, sid, errors.EFAILEDSOCKET)
+            _fail_h2_conn(socket, "h2 GOAWAY received")
         return
     if ftype == FRAME_RST_STREAM:
+        err = struct.unpack(">I", payload[:4])[0] if len(payload) >= 4 \
+            else 0
         with conn.lock:
             conn.streams.pop(stream_id, None)
             conn.pending.pop(stream_id, None)
             conn.stream_send.pop(stream_id, None)
+        # a reset stream will never carry a response: complete the call
+        # now instead of letting it burn its whole deadline.
+        # REFUSED_STREAM (0x7) guarantees the request was NOT processed
+        # (§8.1.4) → a retryable code; anything else → canceled.
+        if not conn.is_server:
+            _fail_client_stream(
+                conn, stream_id,
+                errors.EAGAIN if err == 0x7 else errors.ECANCELED)
         return
     st = conn.streams.get(stream_id)
     if st is None:
@@ -321,6 +348,19 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
         st.ended = True
         conn.streams.pop(stream_id, None)
         completed.append(CompletedCall(st, conn.is_server))
+
+
+def _fail_client_stream(conn: _H2Conn, stream_id: int, code: int) -> None:
+    """Deliver a dead-stream failure through the correlation machinery
+    (bthread_id.error → Controller._on_rpc_event — the socket.py:218
+    discipline): retryable codes actually retry, and a straggler try
+    under hedging cannot destroy the live hedge's correlation id."""
+    from ..bthread import id as bthread_id
+    with conn.lock:
+        cid = conn.cid_by_stream.pop(stream_id, None)
+    if cid is None:
+        return
+    bthread_id.error(cid, code)
 
 
 def _fail_h2_conn(socket, why: str) -> None:

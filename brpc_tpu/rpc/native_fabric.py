@@ -44,7 +44,7 @@ class NativeServer:
     per-connection writes).
     """
 
-    def __init__(self):
+    def __init__(self, usercode_inline: bool = True):
         self._lib = native.load()
         if self._lib is None:
             raise RuntimeError("native core unavailable")
@@ -54,6 +54,12 @@ class NativeServer:
         # keep the callback object alive for the server's lifetime
         self._cb = _NREQ_FN(self._on_request)
         self._lock = threading.Lock()
+        # True (default): handlers run on the upcalling epoll-loop thread
+        # (minimal latency; handlers must be fast).  False: handlers park
+        # on bthread tasklets — a blocking handler then stalls only its
+        # tasklet, not the connection loop (the tail-isolation doctrine;
+        # the Python Server's default).
+        self.usercode_inline = usercode_inline
 
     # ---- control plane ------------------------------------------------
 
@@ -106,9 +112,33 @@ class NativeServer:
                     att_p, att_len, log_id):
         try:
             full = method.decode()
+            # copies happen HERE, inside the upcall — the native buffers
+            # are only valid until we return
             payload = ctypes.string_at(payload_p, payload_len) \
                 if payload_len else b""
             att = ctypes.string_at(att_p, att_len) if att_len else b""
+            if not self.usercode_inline:
+                from ..bthread import scheduler
+                scheduler.start_background(
+                    self._handle_request, token, full, payload, att,
+                    log_id, name=f"nreq:{full}")
+                return
+            self._handle_request(token, full, payload, att, log_id)
+        except Exception as e:          # never let an exception cross ctypes
+            self._last_resort_error(token, e)
+
+    def _last_resort_error(self, token, e) -> None:
+        """Catch-all for request processing: the token must be answered
+        (or at least attempted) no matter what blew up — on the upcall
+        thread this also keeps the exception from crossing ctypes."""
+        log.error("native-server request failed: %s", e, exc_info=True)
+        try:
+            self._respond(token, errors.EINTERNAL, str(e), b"", b"")
+        except Exception:
+            pass
+
+    def _handle_request(self, token, full, payload, att, log_id):
+        try:
             md = self._methods.get(full)
             if md is None:
                 self._respond(token, errors.ENOMETHOD,
@@ -149,13 +179,8 @@ class NativeServer:
                     cntl.set_failed(errors.EINTERNAL,
                                     f"{type(e).__name__}: {e}")
                     done()
-        except Exception as e:          # never let an exception cross ctypes
-            log.error("native-server upcall failed: %s", e, exc_info=True)
-            try:
-                self._respond(token, errors.EINTERNAL, str(e), b"", b"")
-            except Exception:
-                pass
-
+        except Exception as e:
+            self._last_resort_error(token, e)
 
 
 def _marshal_sync_call(lib, call_fn, handle, full_name: str,

@@ -376,3 +376,37 @@ def test_native_pooled_channel():
     finally:
         pool.close()
         server.stop()
+
+
+def test_native_server_tasklet_dispatch():
+    """usercode_inline=False parks handlers on bthread tasklets (the
+    Python Server's tail-isolation default) instead of the epoll loop."""
+    from brpc_tpu.rpc.native_fabric import NativeServer, NativeChannel
+    from brpc_tpu.bthread import scheduler
+    where = {}
+
+    class Probe(rpc.Service):
+        SERVICE_NAME = "EchoService"
+
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            where["tasklet"] = scheduler.current_tasklet() is not None
+            response.message = request.message
+            done()
+
+    server = NativeServer(usercode_inline=False)
+    server.add_service(Probe())
+    port = server.start(0)
+    ch = NativeChannel()
+    ch.init(f"127.0.0.1:{port}")
+    try:
+        cntl = rpc.Controller()
+        cntl.timeout_ms = 5000
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="t"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "t"
+        assert where["tasklet"] is True
+    finally:
+        ch.close()
+        server.stop()
