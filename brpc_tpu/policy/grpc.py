@@ -165,6 +165,7 @@ class _H2Conn:
         # DATA waiting for window: stream_id -> list of [bytes, end_flag]
         self.pending: Dict[int, List] = {}
         self.expect_continuation: Optional[int] = None
+        self.last_processed_sid = 0      # server: for GOAWAY on stop
 
 
 def _conn(socket, is_server: bool) -> _H2Conn:
@@ -172,7 +173,26 @@ def _conn(socket, is_server: bool) -> _H2Conn:
     if c is None:
         c = _H2Conn(is_server)
         socket._h2_conn = c
+        if not is_server:
+            # a dead h2 connection can never deliver its responses: fail
+            # every outstanding stream's call (retryably) the moment the
+            # socket fails, whatever killed it — GOAWAY, TCP reset,
+            # server stop.  Without this, every in-flight h2 call burns
+            # its full deadline on any connection death.
+            cbs = getattr(socket, "on_failed_callbacks", None)
+            if cbs is not None:
+                cbs.append(lambda _s, conn=c: _fail_all_client_streams(conn))
     return c
+
+
+def _fail_all_client_streams(conn: "_H2Conn") -> None:
+    from ..bthread import id as bthread_id
+    with conn.lock:
+        cids = list(conn.cid_by_stream.values())
+        conn.cid_by_stream.clear()
+        conn.pending.clear()
+    for cid in cids:
+        bthread_id.error(cid, errors.EFAILEDSOCKET)
 
 
 class CompletedCall:
@@ -261,22 +281,14 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
             _on_window_update(conn, socket, stream_id, inc)
         return
     if ftype == FRAME_GOAWAY:
-        # Streams ABOVE last_stream_id were never processed by the peer:
-        # fail their calls now — through the retry machinery, they are
-        # safe to re-issue (RFC 7540 §6.8/§8.1.4) — and evict the
-        # connection so no NEW stream is packed onto a going-away peer
-        # (it would just burn its deadline).
-        if not conn.is_server and len(payload) >= 8:
-            last_sid = struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF
-            with conn.lock:
-                victims = [sid for sid in conn.cid_by_stream
-                           if sid > last_sid]
-                for sid in victims:
-                    conn.streams.pop(sid, None)
-                    conn.pending.pop(sid, None)
-                    conn.stream_send.pop(sid, None)
-            for sid in victims:
-                _fail_client_stream(conn, sid, errors.EFAILEDSOCKET)
+        # Evict the connection: no new stream may be packed onto a
+        # going-away peer (RFC 7540 §6.8), and since our transport then
+        # closes, no in-flight response can arrive either — the socket's
+        # failure hook fails EVERY outstanding call retryably in one
+        # sweep (set_failed marks the socket before running hooks, so a
+        # racing pack_request's write fails rather than slipping a fresh
+        # stream past the sweep).
+        if not conn.is_server:
             _fail_h2_conn(socket, "h2 GOAWAY received")
         return
     if ftype == FRAME_RST_STREAM:
@@ -489,7 +501,24 @@ def process_request(calls: List[CompletedCall], socket, server) -> None:
         _process_one_request(call.stream, socket, server)
 
 
+def send_goaway(socket) -> None:
+    """Graceful-shutdown courtesy (RFC 7540 §6.8): tell the peer which
+    streams were processed.  Called by Server.stop() on h2 connections
+    just before failing them — best-effort: a backpressured transport
+    may drop it with the rest of the write queue, and correctness does
+    not depend on it (the client's socket-failure hook fails all
+    outstanding calls retryably on any connection death)."""
+    conn = getattr(socket, "_h2_conn", None)
+    if conn is None:
+        return
+    payload = struct.pack(">II", conn.last_processed_sid & 0x7FFFFFFF, 0)
+    socket.write(IOBuf(frame(FRAME_GOAWAY, 0, 0, payload)))
+
+
 def _process_one_request(st: _H2Stream, socket, server) -> None:
+    conn = getattr(socket, "_h2_conn", None)
+    if conn is not None and st.stream_id > conn.last_processed_sid:
+        conn.last_processed_sid = st.stream_id
     path = st.header(b":path").decode()
     parts = [p for p in path.split("/") if p]
     full_name = ".".join(parts[-2:]) if len(parts) >= 2 else path

@@ -291,6 +291,14 @@ class Server:
         with self._conn_lock:
             conns = list(self._connections)
         for s in conns:
+            # graceful h2 shutdown: GOAWAY first so the peer knows which
+            # streams were processed and retries the rest safely
+            if getattr(s, "_h2_conn", None) is not None:
+                try:
+                    from ..policy.grpc import send_goaway
+                    send_goaway(s)
+                except Exception:
+                    pass
             s.set_failed(errors.ELOGOFF, "server stopping")
         self._stopped.set()
         self._started = False

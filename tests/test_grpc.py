@@ -340,15 +340,26 @@ class TestGrpcEndToEnd:
 
 
 class _FakeH2Socket:
-    """Capture-only socket for frame-layer unit tests."""
+    """Capture-only socket for frame-layer unit tests (mimics the real
+    Socket's failure-hook contract)."""
 
     def __init__(self):
         self.sent = bytearray()
         self.remote_side = "fake"
+        self.on_failed_callbacks = []
+        self.failed_with = None
 
     def write(self, buf, **kw):
         self.sent.extend(buf.to_bytes())
         return 0
+
+    def set_failed(self, code, text=""):
+        if self.failed_with is not None:
+            return False
+        self.failed_with = (code, text)
+        for cb in list(self.on_failed_callbacks):
+            cb(self)
+        return True
 
     def drain_frames(self):
         """Parse what the code under test wrote: [(type, flags, sid,
@@ -604,13 +615,7 @@ class TestH2StreamFailure:
         from brpc_tpu.policy import grpc as g
         from brpc_tpu.bthread import id as bthread_id
         sock = _FakeH2Socket()
-        sock.failed_with = None
-
-        def set_failed(code, text):
-            sock.failed_with = (code, text)
-        sock.set_failed = set_failed
-        conn = g._H2Conn(is_server=False)
-        sock._h2_conn = conn
+        conn = g._conn(sock, is_server=False)   # registers failure hook
         results = {}
 
         def on_error(_data, cid, code):
@@ -642,29 +647,53 @@ class TestH2StreamFailure:
         assert results.get("code") == errors.EAGAIN
         assert Controller._retryable(results["code"])
 
-    def test_goaway_fails_unprocessed_streams_and_evicts_conn(self):
+    def test_goaway_fails_outstanding_calls_and_evicts_conn(self):
+        """GOAWAY evicts the connection; since the transport then closes
+        (no response can arrive), EVERY outstanding call fails retryably
+        via the socket-failure hook — and parked DATA is dropped."""
         from brpc_tpu.rpc.controller import Controller
         g, sock, conn, results = self._client_conn_with_call()
         conn.pending[1] = [[b"parked", True]]    # window-parked DATA
-        # last_stream_id=0: stream 1 was never processed → retryable
-        # failure, parked DATA dropped, connection evicted so no new
-        # stream lands on a going-away peer
         g._handle_frame(conn, sock, g.FRAME_GOAWAY, 0, 0,
                         (0).to_bytes(4, "big") + b"\x00" * 4, [])
         assert results.get("code") == errors.EFAILEDSOCKET
         assert Controller._retryable(results["code"])
         assert 1 not in conn.pending
+        assert not conn.cid_by_stream
         assert sock.failed_with is not None
         assert "GOAWAY" in sock.failed_with[1]
 
-    def test_goaway_leaves_processed_streams_alone(self):
+    def test_any_socket_death_fails_outstanding_calls(self):
+        """Not just GOAWAY: a TCP reset (set_failed from anywhere) must
+        complete in-flight h2 calls instead of burning their deadlines."""
         g, sock, conn, results = self._client_conn_with_call()
-        # stream 1 was processed (last_stream_id=1): its response may
-        # still arrive — the call must NOT be failed by GOAWAY
+        sock.set_failed(errors.EFAILEDSOCKET, "connection reset by peer")
+        assert results.get("code") == errors.EFAILEDSOCKET
+
+    def test_server_stop_sends_goaway(self):
+        """Graceful Server.stop emits GOAWAY naming the last processed
+        stream before failing the connection."""
+        from brpc_tpu.policy import grpc as g
+        sock = _FakeH2Socket()
+        conn = g._H2Conn(is_server=True)
+        conn.last_processed_sid = 5
+        sock._h2_conn = conn
+        g.send_goaway(sock)
+        frames = sock.drain_frames()
+        assert frames[0][0] == g.FRAME_GOAWAY
+        last_sid, err = __import__("struct").unpack(">II", frames[0][3])
+        assert last_sid == 5 and err == 0
+
+    def test_goaway_is_idempotent(self):
+        g, sock, conn, results = self._client_conn_with_call()
+        g._handle_frame(conn, sock, g.FRAME_GOAWAY, 0, 0,
+                        (1).to_bytes(4, "big") + b"\x00" * 4, [])
+        assert results.get("code") == errors.EFAILEDSOCKET
+        # a second GOAWAY (or failure) must not double-deliver
+        results.clear()
         g._handle_frame(conn, sock, g.FRAME_GOAWAY, 0, 0,
                         (1).to_bytes(4, "big") + b"\x00" * 4, [])
         assert "code" not in results
-        assert 1 in conn.cid_by_stream
 
 
 class TestGrpcAuth:
