@@ -140,6 +140,17 @@ def reset_version(cid: int, new_version: int) -> int:
     return 0
 
 
+def is_live(cid: int) -> bool:
+    """True while this exact cid version could still receive an event
+    (used to prune completed ids from per-socket in-flight sets)."""
+    st = _state(cid)
+    if st is None:
+        return False
+    _, ver = _split(cid)
+    with st.cond:
+        return not st.destroyed and st.cur_version <= ver < st.range
+
+
 def error(cid: int, error_code: int) -> int:
     """Lock the id and run on_error (the RPC completion/timeout entry point).
     If the id is currently locked, queue the error; the unlocker drains it."""

@@ -91,8 +91,15 @@ class Socket:
         self.on_failed_callbacks: List[Callable[["Socket"], None]] = []
         self.pipelined_contexts: List[Any] = []   # redis/memcache pipelining
         self._pipeline_lock = threading.Lock()
+        # correlation ids written on this socket and possibly awaiting a
+        # response: failed with the socket so a connection death completes
+        # in-flight calls NOW instead of letting them burn their full
+        # deadlines (the reference fails a Socket's waiters in SetFailed).
+        # Completed cids linger until pruned — bthread_id's version guard
+        # makes erroring a stale id a no-op.
+        self._inflight_cids: set = set()
+        self._inflight_prune_at = 256    # high-water mark (see write())
         self.health_check_interval_s = 0
-        self.correlation_map: Dict[int, Any] = {}  # cid -> waiting call ctx
         self.is_server_side = False
         _g_socket_count << 1
 
@@ -121,6 +128,20 @@ class Socket:
                 cb(self)
             except Exception:
                 pass
+        # complete every call still awaiting a response on this socket:
+        # its reply can never arrive now.  bthread_id's version guard
+        # makes already-completed ids no-ops, and _retryable codes
+        # (EFAILEDSOCKET/ELOGOFF/...) re-issue on a fresh connection.
+        with self._pipeline_lock:
+            inflight, self._inflight_cids = self._inflight_cids, set()
+        if inflight:
+            from ..bthread import id as bthread_id
+            code = error_code or errors.EFAILEDSOCKET
+            for cid in inflight:
+                try:
+                    bthread_id.error(cid, code)
+                except Exception:
+                    pass
         self._transport_close()
         return True
 
@@ -145,6 +166,20 @@ class Socket:
                 self.set_failed(errors.EFAILEDSOCKET, "injected fault")
                 return errors.EFAILEDSOCKET
         req = WriteRequest(data, notify_cid, on_done)
+        if notify_cid:
+            with self._pipeline_lock:
+                self._inflight_cids.add(notify_cid)
+                if len(self._inflight_cids) > self._inflight_prune_at:
+                    # prune completed calls' ids, then move the
+                    # high-water mark past the LIVE population so a
+                    # steady state of many genuinely-concurrent calls
+                    # doesn't rescan on every write (O(N) each time)
+                    from ..bthread import id as bthread_id
+                    self._inflight_cids = {
+                        c for c in self._inflight_cids
+                        if bthread_id.is_live(c)}
+                    self._inflight_prune_at = max(
+                        256, 2 * len(self._inflight_cids))
         with self._write_lock:
             if self.failed:
                 err = self.failed_error or errors.EFAILEDSOCKET

@@ -1,0 +1,141 @@
+"""Connection death completes in-flight calls NOW (reference:
+Socket::SetFailed fails its waiters) — not after the full deadline.
+
+Before round 4 every protocol burned the whole client timeout when the
+connection died while a response was pending; the socket now errors its
+in-flight correlation ids on failure, for correlated (tpu_std) and
+pipelined cid-less (redis) protocols alike.
+"""
+import socket as pysock
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+
+def _dying_server(delay_s: float = 0.2) -> int:
+    """Raw TCP peer: reads the request, then closes without replying."""
+    lsock = pysock.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+
+    def srv():
+        conn, _ = lsock.accept()
+        conn.recv(65536)
+        time.sleep(delay_s)
+        conn.close()
+        lsock.close()
+
+    threading.Thread(target=srv, daemon=True).start()
+    return lsock.getsockname()[1]
+
+
+class TestSocketDeathCompletesCalls:
+    def test_tpu_std_completes_early_with_retryable_code(self):
+        port = _dying_server()
+        ch = rpc.Channel()
+        ch.init(f"127.0.0.1:{port}",
+                options=rpc.ChannelOptions(timeout_ms=8000, max_retry=0))
+        cntl = rpc.Controller()
+        t0 = time.monotonic()
+        ch.call_method("EchoService.Echo", cntl,
+                       EchoRequest(message="x"), EchoResponse)
+        dt = time.monotonic() - t0
+        assert cntl.failed()
+        assert dt < 4, f"call burned its deadline: {dt:.2f}s"
+        # EEOF/EFAILEDSOCKET: the retry machinery can act on it
+        assert Controller._retryable(cntl.error_code_), cntl.error_code_
+
+    def test_pipelined_redis_completes_early(self):
+        from brpc_tpu.policy.redis import RedisRequest, RedisResponse
+        port = _dying_server()
+        ch = rpc.Channel()
+        ch.init(f"127.0.0.1:{port}",
+                options=rpc.ChannelOptions(protocol="redis",
+                                           timeout_ms=8000, max_retry=0))
+        req = RedisRequest()
+        req.add_command("GET", "k")
+        cntl = rpc.Controller()
+        t0 = time.monotonic()
+        ch.call_method("redis", cntl, req, RedisResponse)
+        dt = time.monotonic() - t0
+        assert cntl.failed()
+        assert dt < 4, f"call burned its deadline: {dt:.2f}s"
+        assert Controller._retryable(cntl.error_code_), cntl.error_code_
+
+    def test_retry_recovers_on_live_server(self):
+        """With max_retry, a died-then-revived endpoint succeeds inside
+        one call: the early failure leaves budget for the retry."""
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                response.message = request.message
+                done()
+
+        # a server whose FIRST connection dies after the request, but
+        # which keeps serving later connections
+        lsock = pysock.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+        port = lsock.getsockname()[1]
+        real = rpc.Server()
+        real.add_service(Echo())
+        assert real.start("127.0.0.1:0") == 0
+
+        def broker():
+            first, _ = lsock.accept()
+            first.recv(65536)
+            first.close()                 # kill try #1 mid-call
+            while True:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                up = pysock.create_connection(("127.0.0.1",
+                                               real.listen_port))
+
+                def pump(a, b):
+                    try:
+                        while True:
+                            d = a.recv(65536)
+                            if not d:
+                                break
+                            b.sendall(d)
+                    except OSError:
+                        pass
+                    finally:
+                        try:
+                            b.shutdown(pysock.SHUT_WR)
+                        except OSError:
+                            pass
+                threading.Thread(target=pump, args=(conn, up),
+                                 daemon=True).start()
+                threading.Thread(target=pump, args=(up, conn),
+                                 daemon=True).start()
+
+        threading.Thread(target=broker, daemon=True).start()
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{port}",
+                    options=rpc.ChannelOptions(timeout_ms=8000,
+                                               max_retry=2))
+            cntl = rpc.Controller()
+            t0 = time.monotonic()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="revive"),
+                                  EchoResponse)
+            dt = time.monotonic() - t0
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "revive"
+            assert dt < 6, dt
+        finally:
+            real.stop()
+            lsock.close()
