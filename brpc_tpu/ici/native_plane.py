@@ -397,7 +397,7 @@ class ChannelBinding:
         self.local_dev = local_dev
         self.remote_dev = remote_dev
         self.window_bytes = window_bytes if window_bytes > 0 else (4 << 20)
-        self._remote_ep = mesh.endpoint(remote_dev)
+        self.remote_side = mesh.endpoint(remote_dev)
         h = lib.brpc_tpu_ici_connect(local_dev, remote_dev, window_bytes)
         if h == 0:
             raise ConnectionRefusedError(
@@ -424,6 +424,28 @@ class ChannelBinding:
         parsed response (or raw payload bytes when response_cls is None)."""
         import time as _time
         from . import transport as _t
+        # fault injection covers the fast plane too, with the SAME
+        # semantics as the Python plane's Socket.write boundary: DROP =
+        # bytes vanish, the call waits out its deadline; ERROR = the
+        # connection is severed (every later call on this binding fails
+        # until the channel re-routes/reconnects).
+        from ..rpc import fault_injection as _fi
+        injector = _fi.active()
+        if injector is not None:
+            action = injector.decide(self)
+            if action == _fi.DROP:
+                tms = cntl.timeout_ms
+                # no deadline = a genuine hang; bound it so a
+                # misconfigured test fails instead of wedging forever
+                _time.sleep((tms / 1000.0) if tms and tms > 0 else 60.0)
+                cntl.set_failed(errors.ERPCTIMEDOUT
+                                if tms and tms > 0 else errors.EFAILEDSOCKET,
+                                "rpc timeout (injected drop)")
+                return None
+            if action == _fi.ERROR:
+                cntl.set_failed(errors.EFAILEDSOCKET, "injected fault")
+                self.close()             # severed, like Socket.set_failed
+                return None
         t0 = _time.monotonic_ns()
         if hasattr(request, "SerializeToString"):
             req = request.SerializeToString()
@@ -466,7 +488,7 @@ class ChannelBinding:
             if blocked:
                 scheduler.note_worker_unblocked()
         try:
-            cntl.remote_side = self._remote_ep
+            cntl.remote_side = self.remote_side
             if rc != 0:
                 text = err_text.value.decode() if err_text.value else \
                     errors.berror(int(rc))

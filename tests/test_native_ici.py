@@ -560,3 +560,33 @@ class TestNativeLoopBench:
                                                    device_array=arr)
         assert p50d > 0
         assert native_plane.registry().live() == 0
+
+
+class TestFaultInjectionOnFastPlane:
+    def test_injected_fault_reaches_native_ici_calls(self, mesh):
+        """Fault injection covers the native plane (the Python plane
+        injects at Socket.write; the binding is the equivalent edge)."""
+        from brpc_tpu.rpc import fault_injection as fi
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://16") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://16",
+                    options=rpc.ChannelOptions(timeout_ms=2000,
+                                               max_retry=0))
+            with fi.inject(fi.FaultInjector(error_ratio=1.0)):
+                cntl = rpc.Controller()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="x"), EchoResponse)
+                assert cntl.failed()
+                assert cntl.error_code_ == rpc.errors.EFAILEDSOCKET
+            # injector uninstalled: the plane works again
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="y"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "y"
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
