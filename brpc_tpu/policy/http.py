@@ -94,7 +94,8 @@ def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
 
 def _render_response(status: int, body: bytes, content_type: str,
                      extra_headers: Optional[Dict[str, str]] = None) -> IOBuf:
-    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+    reason = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
+              403: "Forbidden", 404: "Not Found",
               500: "Internal Server Error", 503: "Service Unavailable"}.get(
                   status, "OK")
     out = IOBuf()
@@ -113,14 +114,33 @@ def _render_response(status: int, body: bytes, content_type: str,
 def process_request(msg: HttpMessage, socket, server) -> None:
     start_us = time.monotonic_ns() // 1000
     path = msg.path.strip("/")
-    # 1) builtin pages
+    internal_conn = getattr(socket, "internal_only", False)
+    # 1) builtin pages.  With ServerOptions.internal_port set, admin
+    # pages move to THAT port exclusively (reference server.h
+    # internal_port: "only accessible from internal_port") — the public
+    # port refuses them, and the internal port serves nothing else.
     builtin = getattr(server, "_builtin", None)
     if builtin is not None:
-        hit = builtin.dispatch(path or "index", dict(msg.query))
-        if hit is not None:
-            ctype, body = hit
-            socket.write(_render_response(200, body.encode(), ctype))
+        admin_here = internal_conn or server.options.internal_port < 0
+        if admin_here:
+            hit = builtin.dispatch(path or "index", dict(msg.query))
+            if hit is not None:
+                ctype, body = hit
+                socket.write(_render_response(200, body.encode(), ctype))
+                return
+        elif (path or "index") in builtin.handlers:
+            # dispatch() can have side effects (/flags, /vlog): refuse by
+            # path membership, never by probing
+            socket.write(_render_response(
+                403, b'{"error":"builtin services are only served on '
+                     b'the internal port"}', "application/json"))
             return
+    if internal_conn:
+        # the admin port serves ONLY builtin pages
+        socket.write(_render_response(
+            403, b'{"error":"user services are not served on the '
+                 b'internal port"}', "application/json"))
+        return
     # 2) restful mappings (reference restful.{h,cpp})
     mapped = server.options.restful_mappings.get("/" + path)
     if mapped is not None:

@@ -84,6 +84,7 @@ def _status(server, q):
     bvar.expose_default_variables()
     return "application/json", json.dumps({
         "server": str(server.listen_endpoint),
+        "name": server.options.server_info_name or "",
         "uptime_s": round(time.time() - _start_time, 1),
         "services": sorted(server.services()),
         "methods": [ms.describe() for ms in server.method_statuses()],

@@ -111,6 +111,17 @@ class InputMessenger:
     def _process_message(self, proto: Protocol, msg: Any, socket) -> None:
         try:
             if self.server is not None and proto.process_request is not None:
+                # the admin port (ServerOptions.internal_port) serves ONLY
+                # the http builtin pages: any other protocol on it would
+                # bypass the service/admin separation — enforced HERE, the
+                # one dispatch point every server protocol passes through
+                if getattr(socket, "internal_only", False) and \
+                        proto.name != "http":
+                    socket.set_failed(
+                        errors.EREQUEST,
+                        f"protocol {proto.name!r} refused on the "
+                        "internal admin port")
+                    return
                 proto.process_request(msg, socket, self.server)
             elif proto.process_response is not None:
                 proto.process_response(msg, socket)

@@ -28,8 +28,16 @@ class ServerOptions:
     method_max_concurrency: Dict[str, Any] = field(default_factory=dict)
     auth: Any = None                    # Authenticator
     enable_builtin_services: bool = True
+    # display name on /status (reference server.h server_info_name)
     server_info_name: str = ""
+    # close connections with no READ/WRITE activity for this many
+    # seconds (reference server.h idle_timeout_sec semantics: a handler
+    # still computing counts as idle — size this above your slowest
+    # handler); -1 = never
     idle_timeout_s: int = -1
+    # when >= 0: builtin/admin pages are served ONLY on this extra TCP
+    # port, and the public port refuses them (reference server.h
+    # internal_port — keeps /flags, /pprof etc. off the service VIP)
     internal_port: int = -1
     concurrency_limiter: str = ""       # "", "constant", "auto", "timeout"
     # Run user handlers directly on the delivering thread for loopback/ici
@@ -242,6 +250,25 @@ class Server:
                         "Python datapath only", e)
         else:
             raise ValueError(f"cannot listen on scheme {ep.scheme}")
+        try:
+            if self.options.internal_port >= 0:
+                from .tcp_transport import Acceptor
+                # same bind address and TLS posture as the main listener:
+                # a loopback-restricted service must not grow a
+                # world-reachable plaintext admin port
+                self._internal_acceptor = Acceptor(
+                    self._on_accept_internal,
+                    ssl_context=self.options.ssl_context)
+                host = ep.host if getattr(ep, "host", None) else "0.0.0.0"
+                self._internal_port = self._internal_acceptor.start(
+                    host, self.options.internal_port)
+            if self.options.idle_timeout_s > 0:
+                self._start_idle_reaper()
+        except Exception:
+            # a half-started server must not leak its live listeners: a
+            # retry of start() would otherwise double-bind
+            self._teardown_listeners()
+            raise
         self._listen_endpoints.append(ep)
         self._started = True
         log.info("Server started on %s with %d services", ep,
@@ -259,6 +286,31 @@ class Server:
             self._connections = [s for s in self._connections if not s.failed]
             self._connections.append(sock)
 
+    def _on_accept_internal(self, sock) -> None:
+        sock.internal_only = True       # admin pages only (http checks)
+        self._on_accept(sock)
+
+    @property
+    def internal_port(self) -> int:
+        return getattr(self, "_internal_port", -1)
+
+    def _start_idle_reaper(self) -> None:
+        import time as _time
+
+        def reap() -> None:
+            period = max(0.5, self.options.idle_timeout_s / 2.0)
+            while not self._stopped.wait(period):
+                cutoff = _time.monotonic() - self.options.idle_timeout_s
+                with self._conn_lock:
+                    conns = list(self._connections)
+                for s in conns:
+                    if getattr(s, "last_active", cutoff + 1) <= cutoff:
+                        s.set_failed(errors.ECLOSE,
+                                     f"idle > {self.options.idle_timeout_s}s")
+
+        t = threading.Thread(target=reap, name="idle_reaper", daemon=True)
+        t.start()
+
     @property
     def listen_endpoint(self) -> Optional[EndPoint]:
         return self._listen_endpoints[0] if self._listen_endpoints else None
@@ -271,6 +323,25 @@ class Server:
     def is_running(self) -> bool:
         return self._started and not self._stopped.is_set()
 
+    def _teardown_listeners(self) -> None:
+        if self._mem_listener is not None:
+            from .mem_transport import mem_unlisten
+            mem_unlisten(self._mem_listener.name)
+            self._mem_listener = None
+        if self._acceptor is not None:
+            self._acceptor.stop()
+            self._acceptor = None
+        if getattr(self, "_internal_acceptor", None) is not None:
+            self._internal_acceptor.stop()
+            self._internal_acceptor = None
+        if getattr(self, "_ici_listener", None) is not None:
+            from ..ici.transport import ici_unlisten
+            ici_unlisten(self._ici_listener.device_id)
+            self._ici_listener = None
+        if getattr(self, "_native_ici", None) is not None:
+            self._native_ici.stop()
+            self._native_ici = None
+
     def stop(self) -> int:
         if not self._started:
             return 0
@@ -281,6 +352,9 @@ class Server:
         if self._acceptor is not None:
             self._acceptor.stop()
             self._acceptor = None
+        if getattr(self, "_internal_acceptor", None) is not None:
+            self._internal_acceptor.stop()
+            self._internal_acceptor = None
         if getattr(self, "_ici_listener", None) is not None:
             from ..ici.transport import ici_unlisten
             ici_unlisten(self._ici_listener.device_id)

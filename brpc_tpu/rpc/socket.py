@@ -88,6 +88,7 @@ class Socket:
         self._selected_protocol_index = -1  # protocol memory per socket
         self.stat = SocketStat()
         self.create_time = time.time()
+        self.last_active = time.monotonic()   # idle-timeout reaping
         self.on_failed_callbacks: List[Callable[["Socket"], None]] = []
         self.pipelined_contexts: List[Any] = []   # redis/memcache pipelining
         self._pipeline_lock = threading.Lock()
@@ -166,6 +167,7 @@ class Socket:
                 self.set_failed(errors.EFAILEDSOCKET, "injected fault")
                 return errors.EFAILEDSOCKET
         req = WriteRequest(data, notify_cid, on_done)
+        self.last_active = time.monotonic()
         if notify_cid:
             with self._pipeline_lock:
                 self._inflight_cids.add(notify_cid)
@@ -260,6 +262,10 @@ class Socket:
 
     # ---- input path ---------------------------------------------------
     def start_input_event(self, inline: bool = False) -> None:
+        self.last_active = time.monotonic()
+        return self._start_input_event(inline)
+
+    def _start_input_event(self, inline: bool = False) -> None:
         """Readiness notification; guarantees a single reader no matter how
         many events fire.  ``inline=True`` (loopback/device transports on
         the delivering thread) runs the reader directly instead of spawning

@@ -137,3 +137,131 @@ class TestConnectionTypes:
             assert stats.get(ep, 0) <= 2
         finally:
             server.stop()
+
+
+class TestServerOptionsLifecycle:
+    """idle_timeout_s / internal_port / server_info_name (server.h parity:
+    these options must DO something, not just exist)."""
+
+    def test_idle_timeout_reaps_stale_connections(self):
+        import time
+        from tests.echo_pb2 import EchoRequest, EchoResponse
+
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                response.message = request.message
+                done()
+
+        opts = rpc.ServerOptions()
+        opts.idle_timeout_s = 1
+        server = rpc.Server(opts)
+        server.add_service(Echo())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}",
+                    options=rpc.ChannelOptions(timeout_ms=5000))
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="a"), EchoResponse)
+            assert not cntl.failed() and resp.message == "a"
+            assert len(server.connections()) == 1
+            deadline = time.monotonic() + 6
+            while server.connections() and time.monotonic() < deadline:
+                time.sleep(0.2)
+            assert not server.connections(), "idle connection not reaped"
+            # a fresh call reconnects and succeeds
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="b"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "b"
+        finally:
+            server.stop()
+
+    def test_internal_port_separates_admin_pages(self):
+        import json
+        import urllib.request
+        from tests.echo_pb2 import EchoRequest, EchoResponse
+
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                response.message = "ok"
+                done()
+
+        opts = rpc.ServerOptions()
+        opts.internal_port = 0          # ephemeral
+        opts.server_info_name = "unit-fixture"
+        server = rpc.Server(opts)
+        server.add_service(Echo())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            pub, adm = server.listen_port, server.internal_port
+            assert adm > 0 and adm != pub
+            # admin page on the internal port, with the display name
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{adm}/status", timeout=10).read()
+            assert json.loads(body)["name"] == "unit-fixture"
+            # admin page REFUSED on the public port
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{pub}/status", timeout=10)
+                assert False, "public port served an admin page"
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+            # user method REFUSED on the internal port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{adm}/EchoService/Echo",
+                data=b'{"message":"x"}',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, "internal port served a user method"
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+            # user method SERVED on the public port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{pub}/EchoService/Echo",
+                data=b'{"message":"x"}',
+                headers={"Content-Type": "application/json"})
+            body = urllib.request.urlopen(req, timeout=10).read()
+            assert json.loads(body)["message"] == "ok"
+        finally:
+            server.stop()
+
+    def test_internal_port_refuses_non_http_protocols(self):
+        """The admin/service separation must hold for EVERY protocol: a
+        tpu_std client speaking to the internal port is refused at the
+        dispatch point, not served."""
+        from tests.echo_pb2 import EchoRequest, EchoResponse
+
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                response.message = "leak!"
+                done()
+
+        opts = rpc.ServerOptions()
+        opts.internal_port = 0
+        server = rpc.Server(opts)
+        server.add_service(Echo())
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.internal_port}",
+                    options=rpc.ChannelOptions(timeout_ms=3000,
+                                               max_retry=0))
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed(), "tpu_std served on the internal port"
+        finally:
+            server.stop()
