@@ -268,6 +268,10 @@ class Channel:
                                 & CONNECTION_TYPE_SINGLE):
             ctype = "pooled"
         smap = SocketMap.instance()
+        # reference semantics: < 0 waits indefinitely; 0 takes the
+        # default (1s); > 0 is the timeout
+        cto_ms = self.options.connect_timeout_ms
+        cto = None if cto_ms < 0 else (cto_ms or 1000) / 1000.0
         if self._lb is not None:
             ep = self._lb.select_server(cntl)
             if ep is None:
@@ -279,15 +283,18 @@ class Channel:
         ssl_ctx = self.options.ssl_context
         if ctype == "pooled":
             sock = smap.get_pooled_socket(ep, self.messenger, group=group,
-                                          ssl_context=ssl_ctx)
+                                          ssl_context=ssl_ctx,
+                                          connect_timeout=cto)
             cntl._pooled_from = ep
         elif ctype == "short":
             sock = smap.get_short_socket(ep, self.messenger,
-                                         ssl_context=ssl_ctx)
+                                         ssl_context=ssl_ctx,
+                                         connect_timeout=cto)
             cntl._short_socket = sock
         else:
             sock = smap.get_socket(ep, self.messenger,
-                                   ssl_context=ssl_ctx, group=group)
+                                   ssl_context=ssl_ctx, group=group,
+                                   connect_timeout=cto)
         return sock
 
     def _channel_signature(self) -> tuple:

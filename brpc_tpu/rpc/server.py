@@ -208,6 +208,10 @@ class Server:
             self.options = options
         if self._started:
             return errors.EINVAL
+        self._stopped.clear()           # restartable after stop():
+        self._listen_endpoints = []     # fresh run, fresh addresses
+        with self._conn_lock:
+            self._connections = []
         if self.options.enable_builtin_services:
             from .builtin import register_builtin_services
             register_builtin_services(self)
@@ -259,7 +263,11 @@ class Server:
                 self._internal_acceptor = Acceptor(
                     self._on_accept_internal,
                     ssl_context=self.options.ssl_context)
-                host = ep.host if getattr(ep, "host", None) else "0.0.0.0"
+                # same bind host as a TCP main listener; for mem://
+                # and ici:// servers (no network host) the admin port
+                # stays on loopback — never a surprise 0.0.0.0 listener
+                host = ep.host if ep.scheme == SCHEME_TCP and ep.host \
+                    else "127.0.0.1"
                 self._internal_port = self._internal_acceptor.start(
                     host, self.options.internal_port)
             if self.options.idle_timeout_s > 0:
@@ -345,23 +353,7 @@ class Server:
     def stop(self) -> int:
         if not self._started:
             return 0
-        if self._mem_listener is not None:
-            from .mem_transport import mem_unlisten
-            mem_unlisten(self._mem_listener.name)
-            self._mem_listener = None
-        if self._acceptor is not None:
-            self._acceptor.stop()
-            self._acceptor = None
-        if getattr(self, "_internal_acceptor", None) is not None:
-            self._internal_acceptor.stop()
-            self._internal_acceptor = None
-        if getattr(self, "_ici_listener", None) is not None:
-            from ..ici.transport import ici_unlisten
-            ici_unlisten(self._ici_listener.device_id)
-            self._ici_listener = None
-        if getattr(self, "_native_ici", None) is not None:
-            self._native_ici.stop()
-            self._native_ici = None
+        self._teardown_listeners()
         with self._conn_lock:
             conns = list(self._connections)
         for s in conns:

@@ -53,19 +53,21 @@ class SocketMap:
             return e
 
     def get_socket(self, ep: EndPoint, messenger=None,
-                   ssl_context=None, group: Any = "") -> Socket:
+                   ssl_context=None, group: Any = "",
+                   connect_timeout: float = 5.0) -> Socket:
         """The shared 'single' connection to ep (creates/replaces lazily)."""
         e = self._entry(ep, group)
         with e.lock:
             if e.socket is not None and not e.socket.failed:
                 return e.socket
-            s = self._connect(ep, ssl_context)
+            s = self._connect(ep, ssl_context, connect_timeout)
             s.messenger = messenger
             e.socket = s
             return s
 
     def get_pooled_socket(self, ep: EndPoint, messenger=None,
-                          group: Any = "", ssl_context=None) -> Socket:
+                          group: Any = "", ssl_context=None,
+                          connect_timeout: float = 5.0) -> Socket:
         """An exclusive connection from the pool (reference
         GetPooledSocket); return it with return_pooled_socket."""
         e = self._entry(ep, group)
@@ -74,7 +76,7 @@ class SocketMap:
                 s = e.pooled.pop()
                 if not s.failed:
                     return s
-        s = self._connect(ep, ssl_context)
+        s = self._connect(ep, ssl_context, connect_timeout)
         s.messenger = messenger
         return s
 
@@ -87,19 +89,22 @@ class SocketMap:
             e.pooled.append(s)
 
     def get_short_socket(self, ep: EndPoint, messenger=None,
-                         ssl_context=None) -> Socket:
-        s = self._connect(ep, ssl_context)
+                         ssl_context=None,
+                         connect_timeout: float = 5.0) -> Socket:
+        s = self._connect(ep, ssl_context, connect_timeout)
         s.messenger = messenger
         return s
 
     @staticmethod
-    def _connect(ep: EndPoint, ssl_context=None) -> Socket:
+    def _connect(ep: EndPoint, ssl_context=None,
+                 connect_timeout: float = 5.0) -> Socket:
         if ep.scheme == SCHEME_MEM:
             from .mem_transport import mem_connect
             return mem_connect(ep.host)
         if ep.scheme == SCHEME_TCP:
             from .tcp_transport import tcp_connect
-            return tcp_connect(ep, ssl_context=ssl_context)
+            return tcp_connect(ep, timeout=connect_timeout,
+                               ssl_context=ssl_context)
         if ep.scheme == SCHEME_ICI:
             # routes in-process targets through the zero-copy IciSocket,
             # remote (other-controller) ones through the fabric

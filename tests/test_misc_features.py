@@ -265,3 +265,99 @@ class TestServerOptionsLifecycle:
             assert cntl.failed(), "tpu_std served on the internal port"
         finally:
             server.stop()
+
+    def test_connect_timeout_ms_reaches_tcp_connect(self, monkeypatch):
+        """ChannelOptions.connect_timeout_ms must flow into the TCP
+        connect (it was declared but hardcoded to 5s)."""
+        from brpc_tpu.rpc import socket_map as smod
+        from brpc_tpu.rpc import tcp_transport as tmod
+        from tests.echo_pb2 import EchoRequest, EchoResponse
+
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                response.message = "ok"
+                done()
+
+        server = rpc.Server()
+        server.add_service(Echo())
+        assert server.start("127.0.0.1:0") == 0
+        seen = {}
+        real = tmod.tcp_connect
+
+        def spy(ep, timeout=5.0, ssl_context=None):
+            seen["timeout"] = timeout
+            return real(ep, timeout=timeout, ssl_context=ssl_context)
+
+        monkeypatch.setattr(tmod, "tcp_connect", spy)
+        try:
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}",
+                    options=rpc.ChannelOptions(timeout_ms=5000,
+                                               connect_timeout_ms=1234))
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "ok"
+            assert abs(seen["timeout"] - 1.234) < 1e-9
+        finally:
+            server.stop()
+
+    def test_internal_port_with_mem_listener_stays_loopback(self):
+        """internal_port on a non-TCP main listener must neither crash
+        (mem:// host is not a network name) nor bind 0.0.0.0."""
+        opts = rpc.ServerOptions()
+        opts.internal_port = 0
+        server = rpc.Server(opts)
+        assert server.start("mem://internal-port-probe") == 0
+        try:
+            import json
+            import urllib.request
+            adm = server.internal_port
+            assert adm > 0
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{adm}/health", timeout=10).read()
+            assert body
+        finally:
+            server.stop()
+
+    def test_server_restart_keeps_idle_reaper_alive(self):
+        import time
+        from tests.echo_pb2 import EchoRequest, EchoResponse
+
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                response.message = "ok"
+                done()
+
+        opts = rpc.ServerOptions()
+        opts.idle_timeout_s = 1
+        server = rpc.Server(opts)
+        server.add_service(Echo())
+        assert server.start("127.0.0.1:0") == 0
+        server.stop()
+        # second run: the stopped-event must have been cleared, or the
+        # reaper exits instantly and idle conns are never collected
+        assert server.start("127.0.0.1:0") == 0
+        try:
+            assert server.is_running()
+            ch = rpc.Channel()
+            ch.init(f"127.0.0.1:{server.listen_port}",
+                    options=rpc.ChannelOptions(timeout_ms=5000))
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            deadline = time.monotonic() + 6
+            while server.connections() and time.monotonic() < deadline:
+                time.sleep(0.2)
+            assert not server.connections(), \
+                "reaper dead after server restart"
+        finally:
+            server.stop()
