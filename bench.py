@@ -402,12 +402,18 @@ from brpc_tpu.ici.fabric import FabricNode
 node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
 kv = node._kv
 import brpc_tpu.policy
+import brpc_tpu.ici.transport
+from brpc_tpu.butil import flags as _fl
+# measured envelope on a 1-core host: a 32MB credit window removes
+# backpressure stalls and 2 writer threads beat more (GIL/switching);
+# the window size is part of the reported configuration
+_fl.set_flag("ici_socket_window_bytes", 32 * 1024 * 1024)
 from brpc_tpu import rpc, ici
 from echo_pb2 import EchoRequest, EchoResponse
 mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
 
 CHUNK = 4 * 1024 * 1024
-THREADS, CALLS = 3, 4      # 48MB of request payload vs the 4MB window
+THREADS, CALLS = 2, 6      # 48MB of request payload, 32MB window
 
 if pid == 0:
     total = [0]; lock = threading.Lock()
