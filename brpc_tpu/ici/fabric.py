@@ -187,12 +187,17 @@ class FabricNode:
         coordinator by construction).  A UDP connect never sends a
         packet; it just resolves the route."""
         if coordinator_address:
-            host, _, port = coordinator_address.rpartition(":")
+            host, sep, port = coordinator_address.rpartition(":")
+            if not sep:                    # no port at all: 'hostname'
+                host, port = coordinator_address, ""
+            host = host.strip("[]")        # IPv6 '[::1]:1234' form
             s = _pysocket.socket(_pysocket.AF_INET, _pysocket.SOCK_DGRAM)
             try:
-                s.connect((host, int(port) if port else 1))
+                # ValueError too: '[::]' or a port-less 'host:path' form
+                # must fall back, not crash FabricNode.initialize
+                s.connect((host, int(port) if port.isdigit() else 1))
                 return s.getsockname()[0]
-            except OSError:
+            except (OSError, ValueError):
                 pass
             finally:
                 s.close()

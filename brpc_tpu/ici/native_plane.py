@@ -490,6 +490,13 @@ class ChannelBinding:
         try:
             cntl.remote_side = self.remote_side
             if rc != 0:
+                # native copies response segs to segs_out even when the
+                # handler responded with an error: release their device
+                # keys or they strand in the registry forever (the
+                # exactly-one-exit custody invariant)
+                for i in range(rnsegs.value):
+                    if rsegs_p[i].is_dev and rsegs_p[i].key:
+                        _registry.release(rsegs_p[i].key)
                 text = err_text.value.decode() if err_text.value else \
                     errors.berror(int(rc))
                 cntl.set_failed(int(rc), text)

@@ -164,8 +164,21 @@ class _H2Conn:
         self.max_frame_size = DEFAULT_MAX_FRAME
         # DATA waiting for window: stream_id -> list of [bytes, end_flag]
         self.pending: Dict[int, List] = {}
+        # server: streams whose request completed but whose response
+        # hasn't finished sending — the window between conn.streams pop
+        # and the first response DATA, where early credit must be kept
+        self.serving: set = set()
+        # WINDOW_UPDATE credit granted before our first DATA on a
+        # stream (a peer funding a large response upfront).  Kept OUT of
+        # stream_send — booking it there would leak one entry per
+        # completed call (review finding r5) — and consumed at the
+        # stream's first _send_data
+        self.early_credit: Dict[int, int] = {}
         self.expect_continuation: Optional[int] = None
         self.last_processed_sid = 0      # server: for GOAWAY on stop
+        # client: peer's GOAWAY last_stream_id (None = no GOAWAY seen);
+        # once set, no new stream may be packed on this connection
+        self.goaway_last_sid: Optional[int] = None
 
 
 def _conn(socket, is_server: bool) -> _H2Conn:
@@ -281,15 +294,10 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
             _on_window_update(conn, socket, stream_id, inc)
         return
     if ftype == FRAME_GOAWAY:
-        # Evict the connection: no new stream may be packed onto a
-        # going-away peer (RFC 7540 §6.8), and since our transport then
-        # closes, no in-flight response can arrive either — the socket's
-        # failure hook fails EVERY outstanding call retryably in one
-        # sweep (set_failed marks the socket before running hooks, so a
-        # racing pack_request's write fails rather than slipping a fresh
-        # stream past the sweep).
         if not conn.is_server:
-            _fail_h2_conn(socket, "h2 GOAWAY received")
+            last_sid = (struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF) \
+                if len(payload) >= 4 else 0
+            _on_goaway(conn, socket, last_sid)
         return
     if ftype == FRAME_RST_STREAM:
         err = struct.unpack(">I", payload[:4])[0] if len(payload) >= 4 \
@@ -297,7 +305,7 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
         with conn.lock:
             conn.streams.pop(stream_id, None)
             conn.pending.pop(stream_id, None)
-            conn.stream_send.pop(stream_id, None)
+            _retire_stream_send(conn, stream_id)
         # a reset stream will never carry a response: complete the call
         # now instead of letting it burn its whole deadline.
         # REFUSED_STREAM (0x7) guarantees the request was NOT processed
@@ -306,6 +314,7 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
             _fail_client_stream(
                 conn, stream_id,
                 errors.EAGAIN if err == 0x7 else errors.ECANCELED)
+            _close_if_drained(conn, socket)
         return
     st = conn.streams.get(stream_id)
     if st is None:
@@ -314,8 +323,17 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
     if ftype in (FRAME_HEADERS, FRAME_CONTINUATION):
         frag = payload
         if ftype == FRAME_HEADERS:
-            # strip padding + priority per RFC 7540 §6.2
+            # strip padding + priority per RFC 7540 §6.2; a pad length
+            # that meets or exceeds the remaining payload is a
+            # connection-level PROTOCOL_ERROR (§6.1/§6.2)
             if flags & FLAG_PADDED:
+                # the 5-byte PRIORITY field also lives inside the
+                # payload: pad + padlen byte + priority must all fit
+                prio = 5 if flags & FLAG_PRIORITY else 0
+                if not frag or frag[0] + 1 + prio > len(frag):
+                    _fail_h2_conn(socket,
+                                  "h2: HEADERS pad exceeds payload")
+                    return
                 pad = frag[0]
                 frag = frag[1:len(frag) - pad]
             if flags & FLAG_PRIORITY:
@@ -346,6 +364,9 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
     elif ftype == FRAME_DATA:
         body = payload
         if flags & FLAG_PADDED:
+            if not body or body[0] >= len(body):
+                _fail_h2_conn(socket, "h2: DATA pad exceeds payload")
+                return
             pad = body[0]
             body = body[1:len(body) - pad]
         st.data.extend(body)
@@ -359,7 +380,53 @@ def _handle_frame(conn: _H2Conn, socket, ftype: int, flags: int,
     if flags & FLAG_END_STREAM:
         st.ended = True
         conn.streams.pop(stream_id, None)
+        if conn.is_server:
+            # request complete, response pending: keep accepting the
+            # peer's upfront response credit until the response sends
+            conn.serving.add(stream_id)
+        else:
+            # response complete: we will never send on this stream again
+            conn.early_credit.pop(stream_id, None)
         completed.append(CompletedCall(st, conn.is_server))
+
+
+def _on_goaway(conn: _H2Conn, socket, last_sid: int) -> None:
+    """Graceful GOAWAY (RFC 7540 §6.8).  Streams with id > last_stream_id
+    were NOT processed by the peer — fail them retryably (§8.1.4) so they
+    re-run on a fresh connection.  Streams ≤ last_stream_id may still get
+    their responses: they keep waiting, and the socket-failure hook
+    completes them if the transport actually closes.  The connection is
+    logged off — no NEW stream packs onto it (pack_request refuses, the
+    SocketMap replaces it on next use) — NOT set_failed: failing the whole
+    conn here would discard in-flight responses the server already
+    executed and auto-retry non-idempotent RPCs (reference
+    http2_rpc_protocol.cpp OnGoAway/RemoveGoAwayStreams + SetLogOff)."""
+    from ..bthread import id as bthread_id
+    with conn.lock:
+        conn.goaway_last_sid = last_sid
+        refused = [(sid, cid) for sid, cid in conn.cid_by_stream.items()
+                   if sid > last_sid]
+        for sid, _cid in refused:
+            conn.cid_by_stream.pop(sid, None)
+            conn.streams.pop(sid, None)
+            conn.pending.pop(sid, None)
+            _retire_stream_send(conn, sid)
+    socket.logoff = True
+    for _sid, cid in refused:
+        bthread_id.error(cid, errors.EAGAIN)
+    _close_if_drained(conn, socket)
+
+
+def _close_if_drained(conn: _H2Conn, socket) -> None:
+    """A logged-off connection whose last awaited response has arrived
+    has no further use — close it, or one orphaned fd (plus hpack state)
+    accumulates per GOAWAY cycle, e.g. per rolling server deploy, on a
+    long-lived client (review finding r5).  The peer may legally hold
+    the conn open forever after GOAWAY (RFC 7540 §6.8), so WE close."""
+    if getattr(socket, "logoff", False) and not conn.cid_by_stream:
+        fail = getattr(socket, "set_failed", None)
+        if fail is not None:
+            fail(errors.EFAILEDSOCKET, "h2 GOAWAY drained")
 
 
 def _fail_client_stream(conn: _H2Conn, stream_id: int, code: int) -> None:
@@ -421,6 +488,14 @@ def _on_window_update(conn: _H2Conn, socket, stream_id: int,
             conn.send_window += inc
         elif stream_id in conn.stream_send:
             conn.stream_send[stream_id] += inc
+        elif stream_id in conn.streams or stream_id in conn.serving:
+            # credit granted before our first DATA on this stream
+            # (receiving the request, or serving it and not yet
+            # responding): book it aside — _send_data's
+            # setdefault(initial_window) would forget the grant and
+            # under-credit the stream, parking DATA the peer had funded
+            conn.early_credit[stream_id] = \
+                conn.early_credit.get(stream_id, 0) + inc
     _flush_pending(conn, socket)
 
 
@@ -431,10 +506,15 @@ def _send_data(conn: _H2Conn, out: IOBuf, stream_id: int, data: bytes,
     byte), splitting at max_frame_size; what doesn't fit queues on the
     conn and drains when WINDOW_UPDATE/SETTINGS credit arrives.  Caller
     holds conn.lock."""
-    conn.stream_send.setdefault(stream_id, conn.initial_window)
+    if stream_id not in conn.stream_send:
+        # first send on this stream: base window + any credit the peer
+        # granted before we started sending
+        conn.stream_send[stream_id] = conn.initial_window + \
+            conn.early_credit.pop(stream_id, 0)
     if not data:
         if end_stream:                   # empty DATA costs no window
             out.append(frame(FRAME_DATA, FLAG_END_STREAM, stream_id, b""))
+            _retire_stream_send(conn, stream_id)
         return
     pos = 0
     n = len(data)
@@ -457,7 +537,15 @@ def _send_data(conn: _H2Conn, out: IOBuf, stream_id: int, data: bytes,
     if end_stream:
         # stream fully sent: retire its window entry (a long-lived conn
         # must not accumulate one dict entry per finished stream)
-        conn.stream_send.pop(stream_id, None)
+        _retire_stream_send(conn, stream_id)
+
+
+def _retire_stream_send(conn: _H2Conn, stream_id: int) -> None:
+    """Our side of the stream is done sending: drop every per-stream
+    send-side record (caller holds conn.lock)."""
+    conn.stream_send.pop(stream_id, None)
+    conn.early_credit.pop(stream_id, None)
+    conn.serving.discard(stream_id)
 
 
 def _flush_pending(conn: _H2Conn, socket) -> None:
@@ -478,7 +566,7 @@ def _flush_pending(conn: _H2Conn, socket) -> None:
                     block = conn.enc.encode(end)
                     _append_header_block(conn, out, sid, block,
                                          end_stream=True)
-                    conn.stream_send.pop(sid, None)
+                    _retire_stream_send(conn, sid)
                     continue
                 _send_data(conn, out, sid, data, end)
                 if sid in conn.pending:          # still blocked: keep the
@@ -652,6 +740,8 @@ def _send_h2_http_response(socket, stream_id: int, status_code: int,
                              end_stream=not body)
         if body:
             _send_data(conn, out, stream_id, body, end_stream=True)
+        else:
+            _retire_stream_send(conn, stream_id)
         _h2_write(socket, out, "h2 rest response")
 
 
@@ -693,7 +783,7 @@ def _send_grpc_response(socket, stream_id: int, pb_bytes: Optional[bytes],
             _append_header_block(conn, out, stream_id,
                                  conn.enc.encode(trailer_list),
                                  end_stream=True)
-            conn.stream_send.pop(stream_id, None)
+            _retire_stream_send(conn, stream_id)
         _h2_write(socket, out, "response")
 
 
@@ -721,6 +811,12 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
     conn = _conn(sock, is_server=False)
     service, _, method = method_full_name.rpartition(".")
     with conn.lock:
+        if conn.goaway_last_sid is not None:
+            # peer is going away: this conn takes no new streams.  The
+            # raise maps to a retryable EFAILEDSOCKET (controller.py:192)
+            # and the retry's _select_socket sees socket.logoff and
+            # connects fresh.
+            raise ConnectionError("h2 connection going away (GOAWAY)")
         out = IOBuf()
         if not conn.preface_sent:
             conn.preface_sent = True
@@ -798,6 +894,7 @@ def process_response(calls: List[CompletedCall], socket) -> None:
         except Exception as e:
             cntl.set_failed(errors.ERESPONSE, f"bad grpc response: {e}")
         cntl.finish_parsed_response(cid)
+    _close_if_drained(conn, socket)
 
 
 PROTOCOL = Protocol(

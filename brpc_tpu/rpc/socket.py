@@ -77,6 +77,10 @@ class Socket:
         self.user = user                    # owner (Acceptor / SocketMap)
         self.failed = False
         self.failed_error = 0
+        # logged-off (reference Socket::SetLogOff): the connection still
+        # drains in-flight responses but accepts no NEW calls — SocketMap
+        # replaces it on next use.  Set by h2 graceful GOAWAY.
+        self.logoff = False
         self._write_queue: List[WriteRequest] = []
         self._unwritten = 0          # queued-but-unwritten bytes (EOVERCROWDED)
         self._write_lock = threading.Lock()

@@ -58,7 +58,8 @@ class SocketMap:
         """The shared 'single' connection to ep (creates/replaces lazily)."""
         e = self._entry(ep, group)
         with e.lock:
-            if e.socket is not None and not e.socket.failed:
+            if e.socket is not None and not e.socket.failed \
+                    and not e.socket.logoff:
                 return e.socket
             s = self._connect(ep, ssl_context, connect_timeout)
             s.messenger = messenger
@@ -74,7 +75,7 @@ class SocketMap:
         with e.lock:
             while e.pooled:
                 s = e.pooled.pop()
-                if not s.failed:
+                if not s.failed and not s.logoff:
                     return s
         s = self._connect(ep, ssl_context, connect_timeout)
         s.messenger = messenger
@@ -82,7 +83,7 @@ class SocketMap:
 
     def return_pooled_socket(self, ep: EndPoint, s: Socket,
                              group: Any = "") -> None:
-        if s.failed:
+        if s.failed or s.logoff:
             return
         e = self._entry(ep, group)
         with e.lock:

@@ -237,6 +237,14 @@ class TestFabricUnits:
         # unroutable/garbage host falls back instead of raising
         assert isinstance(
             FabricNode._derive_host_ip("nonexistent.invalid:1"), str)
+        # port-less address: rpartition used to yield host='' and
+        # port=<hostname>, so int(port) raised ValueError straight
+        # through initialize() — must fall back/resolve, never raise
+        assert FabricNode._derive_host_ip("127.0.0.1") == "127.0.0.1"
+        assert FabricNode._derive_host_ip("somehost.invalid") == "127.0.0.1"
+        # IPv6 forms misparse under AF_INET → clean fallback
+        assert FabricNode._derive_host_ip("[::1]:1234") == "127.0.0.1"
+        assert FabricNode._derive_host_ip("[::]") == "127.0.0.1"
 
     def test_graceful_fin_waits_for_inflight_device_frame(self, monkeypatch):
         """EOF rides the ordered delivery queue: a FIN arriving while a

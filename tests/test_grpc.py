@@ -348,6 +348,7 @@ class _FakeH2Socket:
         self.remote_side = "fake"
         self.on_failed_callbacks = []
         self.failed_with = None
+        self.logoff = False
 
     def write(self, buf, **kw):
         self.sent.extend(buf.to_bytes())
@@ -427,6 +428,110 @@ class TestH2FlowControl:
         payload = _st.pack(">HI", g.SETTINGS_MAX_FRAME_SIZE, 32768)
         g._apply_settings(conn, sock, payload)
         assert conn.max_frame_size == 32768
+
+    def test_window_update_before_first_data_keeps_credit(self):
+        """A peer funding a large response upfront sends WINDOW_UPDATE
+        before our first response DATA — the grant must survive until
+        _send_data (whose bare setdefault(initial_window) used to forget
+        it and park DATA the peer had already funded), whether it lands
+        while the request is still arriving (conn.streams) or between
+        request-complete and response-send (conn.serving)."""
+        from brpc_tpu.policy import grpc as g
+        from brpc_tpu.butil.iobuf import IOBuf
+        for known_via in ("streams", "serving"):
+            sock = _FakeH2Socket()
+            conn = g._H2Conn(is_server=True)
+            sock._h2_conn = conn
+            if known_via == "streams":
+                conn.streams[1] = g._H2Stream(1)
+            else:
+                conn.serving.add(1)
+            g._on_window_update(conn, sock, 1, 10_000)
+            assert 1 not in conn.stream_send      # booked aside, no entry
+            conn.send_window = 1 << 20            # isolate stream window
+            out = IOBuf()
+            payload = b"y" * (g.DEFAULT_WINDOW + 10_000)
+            with conn.lock:
+                g._send_data(conn, out, 1, payload, end_stream=True)
+            sock.write(out)
+            frames = sock.drain_frames()
+            assert sum(len(p) for _t, _f, _s, p in frames) == len(payload)
+            assert frames[-1][1] & g.FLAG_END_STREAM
+            assert 1 not in conn.pending          # nothing parked
+            # everything retired: long-lived conns must not accumulate
+            assert not conn.stream_send and not conn.early_credit \
+                and not conn.serving
+        # an update for a stream the conn has never seen is ignored
+        g._on_window_update(conn, sock, 99, 5_000)
+        assert 99 not in conn.stream_send and 99 not in conn.early_credit
+
+    def test_client_conn_does_not_leak_per_call_state(self):
+        """Review finding r5: the peer's auto-replenish WINDOW_UPDATE
+        arriving after our request's END_STREAM must not re-create a
+        stream_send entry — one leaked entry per completed call grows
+        forever on a long-lived client conn."""
+        from brpc_tpu.policy import grpc as g
+        from brpc_tpu.butil.iobuf import IOBuf
+        sock = _FakeH2Socket()
+        conn = g._H2Conn(is_server=False)
+        sock._h2_conn = conn
+        for call in range(5):
+            sid = 1 + 2 * call
+            conn.cid_by_stream[sid] = 100 + sid
+            out = IOBuf()
+            with conn.lock:
+                g._send_data(conn, out, sid, b"req", end_stream=True)
+            # server's per-DATA auto-replenish lands post-END_STREAM
+            g._on_window_update(conn, sock, sid, 3)
+            # response arrives and completes the stream
+            conn.streams[sid] = g._H2Stream(sid)
+            g._handle_frame(conn, sock, g.FRAME_DATA, g.FLAG_END_STREAM,
+                            sid, b"", [])
+            conn.cid_by_stream.pop(sid, None)     # process_response does
+        assert conn.stream_send == {}
+        assert conn.early_credit == {}
+        assert conn.streams == {} and conn.serving == set()
+
+    def test_padded_frame_validation(self):
+        """RFC 7540 §6.1: pad length ≥ remaining payload is a
+        connection-level PROTOCOL_ERROR, and an empty PADDED frame must
+        not crash the parser."""
+        from brpc_tpu.policy import grpc as g
+        for payload in (b"", bytes([5]) + b"abc"):   # empty; pad 5 > 3
+            sock = _FakeH2Socket()
+            conn = g._H2Conn(is_server=True)
+            sock._h2_conn = conn
+            g._handle_frame(conn, sock, g.FRAME_DATA, g.FLAG_PADDED, 1,
+                            payload, [])
+            assert sock.failed_with is not None
+            sock2 = _FakeH2Socket()
+            conn2 = g._H2Conn(is_server=True)
+            sock2._h2_conn = conn2
+            g._handle_frame(conn2, sock2, g.FRAME_HEADERS,
+                            g.FLAG_PADDED | g.FLAG_END_HEADERS, 1,
+                            payload, [])
+            assert sock2.failed_with is not None
+        # pad exactly len-1 (all-padding, empty fragment) is legal
+        sock = _FakeH2Socket()
+        conn = g._H2Conn(is_server=True)
+        sock._h2_conn = conn
+        g._handle_frame(conn, sock, g.FRAME_DATA, g.FLAG_PADDED, 1,
+                        bytes([3]) + b"\0\0\0", [])
+        assert sock.failed_with is None
+        assert bytes(conn.streams[1].data) == b""
+        # PADDED|PRIORITY: the 5 priority bytes count against the
+        # payload too — pad=2 in an 8-byte payload (1+5+2=8) is legal,
+        # pad=3 is not
+        good = bytes([2]) + b"\x00\x00\x00\x00\x10" + b"\0\0"  # 1+5+2=8
+        bad = bytes([3]) + b"\x00\x00\x00\x00\x10" + b"\0\0"   # pad 3, room 2
+        for payload, ok in ((good, True), (bad, False)):
+            sock = _FakeH2Socket()
+            conn = g._H2Conn(is_server=True)
+            sock._h2_conn = conn
+            g._handle_frame(conn, sock, g.FRAME_HEADERS,
+                            g.FLAG_PADDED | g.FLAG_PRIORITY |
+                            g.FLAG_END_HEADERS, 1, payload, [])
+            assert (sock.failed_with is None) == ok, (payload, ok)
 
     def test_trailers_never_jump_parked_data(self):
         """A response whose DATA is parked behind the window must hold
@@ -647,21 +752,71 @@ class TestH2StreamFailure:
         assert results.get("code") == errors.EAGAIN
         assert Controller._retryable(results["code"])
 
-    def test_goaway_fails_outstanding_calls_and_evicts_conn(self):
-        """GOAWAY evicts the connection; since the transport then closes
-        (no response can arrive), EVERY outstanding call fails retryably
-        via the socket-failure hook — and parked DATA is dropped."""
+    def test_goaway_refuses_unprocessed_streams_retryably(self):
+        """GOAWAY last_stream_id=0: our stream 1 was NOT processed
+        (RFC 7540 §8.1.4) — it fails retryably NOW, its parked DATA is
+        dropped, and the connection is logged off (no set_failed: a
+        graceful peer may still be draining other streams)."""
         from brpc_tpu.rpc.controller import Controller
         g, sock, conn, results = self._client_conn_with_call()
         conn.pending[1] = [[b"parked", True]]    # window-parked DATA
         g._handle_frame(conn, sock, g.FRAME_GOAWAY, 0, 0,
                         (0).to_bytes(4, "big") + b"\x00" * 4, [])
-        assert results.get("code") == errors.EFAILEDSOCKET
+        assert results.get("code") == errors.EAGAIN
         assert Controller._retryable(results["code"])
         assert 1 not in conn.pending
         assert not conn.cid_by_stream
+        assert sock.logoff                       # no new streams
+        # nothing left to drain → the useless conn closes immediately
         assert sock.failed_with is not None
-        assert "GOAWAY" in sock.failed_with[1]
+        assert "drained" in sock.failed_with[1]
+
+    def test_goaway_honors_last_stream_id(self):
+        """Graceful GOAWAY-and-drain (nginx, grpc servers): streams the
+        server already accepted (id ≤ last_stream_id) keep waiting for
+        their responses — auto-retrying them would double-execute
+        non-idempotent RPCs; only streams above the watermark fail
+        (retryably).  New packs on the conn are refused."""
+        from brpc_tpu.policy import grpc as g
+        from brpc_tpu.bthread import id as bthread_id
+        import pytest
+        sock = _FakeH2Socket()
+        conn = g._conn(sock, is_server=False)
+        results = {}
+
+        def on_error(sid):
+            def cb(_data, cid, code):
+                results[sid] = code
+                bthread_id.unlock_and_destroy(cid)
+            return cb
+
+        conn.cid_by_stream[1] = bthread_id.create(None, on_error(1))
+        conn.cid_by_stream[3] = bthread_id.create(None, on_error(3))
+        g._handle_frame(conn, sock, g.FRAME_GOAWAY, 0, 0,
+                        (1).to_bytes(4, "big") + b"\x00" * 4, [])
+        assert results == {3: errors.EAGAIN}     # 3 refused, 1 drains
+        assert 1 in conn.cid_by_stream           # still awaiting response
+        assert sock.logoff and sock.failed_with is None
+        # no NEW stream may be packed onto a going-away connection
+        class _Cntl:
+            pass
+        cntl = _Cntl()
+        cntl._pack_socket = sock
+        from brpc_tpu.butil.iobuf import IOBuf
+        with pytest.raises(ConnectionError):
+            g.pack_request(IOBuf(), 7, cntl, "Svc.Method")
+        # the drain stream's response arrives → the call completes AND
+        # the now-useless logged-off conn is closed by US (the peer may
+        # legally hold it open forever): no orphaned fd per GOAWAY cycle
+        conn.streams[1] = g._H2Stream(1)
+        g._handle_frame(conn, sock, g.FRAME_DATA, g.FLAG_END_STREAM, 1,
+                        b"", [])
+        # simulate process_response completing the call
+        with conn.lock:
+            conn.cid_by_stream.pop(1, None)
+        g._close_if_drained(conn, sock)
+        assert sock.failed_with is not None
+        assert "drained" in sock.failed_with[1]
 
     def test_any_socket_death_fails_outstanding_calls(self):
         """Not just GOAWAY: a TCP reset (set_failed from anywhere) must
@@ -685,14 +840,14 @@ class TestH2StreamFailure:
         assert last_sid == 5 and err == 0
 
     def test_goaway_is_idempotent(self):
+        """Repeated GOAWAY must not double-deliver a refusal."""
         g, sock, conn, results = self._client_conn_with_call()
         g._handle_frame(conn, sock, g.FRAME_GOAWAY, 0, 0,
-                        (1).to_bytes(4, "big") + b"\x00" * 4, [])
-        assert results.get("code") == errors.EFAILEDSOCKET
-        # a second GOAWAY (or failure) must not double-deliver
+                        (0).to_bytes(4, "big") + b"\x00" * 4, [])
+        assert results.get("code") == errors.EAGAIN
         results.clear()
         g._handle_frame(conn, sock, g.FRAME_GOAWAY, 0, 0,
-                        (1).to_bytes(4, "big") + b"\x00" * 4, [])
+                        (0).to_bytes(4, "big") + b"\x00" * 4, [])
         assert "code" not in results
 
 

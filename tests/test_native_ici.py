@@ -199,6 +199,47 @@ class TestNativeDatapath:
             server.stop()
         assert native_plane.registry().live() == 0
 
+    def test_error_response_with_segs_releases_on_client(self, mesh,
+                                                         monkeypatch):
+        """An ABI server may respond err != 0 AND device segs (the Python
+        server never does, but brpc_tpu_ici_respond allows it); native
+        copies segs_out regardless of rc, so the CLIENT must release the
+        keys on its rc != 0 path or they strand in the registry forever
+        (exactly-one-exit custody)."""
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.ici.native_plane import split_attachment
+
+        class Failing(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def F(self, cntl, request, response, done):
+                cntl.set_failed(rpc.errors.EINTERNAL, "deliberate")
+                done()
+
+        server = rpc.Server()
+        server.add_service(Failing())
+        assert server.start("ici://5") == 0
+        try:
+            binding = server._native_ici
+            arr = _device_payload(mesh)
+
+            def err_with_segs(token, err, text):
+                att = IOBuf()
+                att.append_device_array(arr)
+                att_host, segs = split_attachment(att)
+                binding._respond(token, err, text, b"", att_host, segs)
+
+            monkeypatch.setattr(binding, "_respond_err", err_with_segs)
+            ch = rpc.Channel()
+            ch.init("ici://5")
+            cntl = rpc.Controller()
+            ch.call_method("Failing.F", cntl, EchoRequest(message="x"),
+                           EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code_ == rpc.errors.EINTERNAL
+        finally:
+            server.stop()
+        assert native_plane.registry().live() == 0
+
     def test_timeout_drops_late_response_and_releases(self, mesh):
         """A handler answering after the client deadline: the client gets
         ERPCTIMEDOUT, the late response is dropped, custody released."""
