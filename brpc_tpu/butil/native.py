@@ -17,7 +17,7 @@ from typing import Callable, Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_native_async_throughput_gbps"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_buf_release"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -262,6 +262,34 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_ici_echo_p50_ns.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
         ctypes.c_int32]
+    # fabric bulk data plane (native/fabric.cpp): uuid-tagged bulk frames
+    # over a dedicated per-socket-pair TCP connection
+    lib.brpc_tpu_fab_listen.restype = ctypes.c_uint64
+    lib.brpc_tpu_fab_listen.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_int),
+                                        ctypes.c_char_p, ctypes.c_int]
+    lib.brpc_tpu_fab_connect_uds.restype = ctypes.c_uint64
+    lib.brpc_tpu_fab_connect_uds.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_char_p]
+    lib.brpc_tpu_fab_accept.restype = ctypes.c_uint64
+    lib.brpc_tpu_fab_accept.argtypes = [ctypes.c_uint64, ctypes.c_char_p,
+                                        ctypes.c_int64]
+    lib.brpc_tpu_fab_connect.restype = ctypes.c_uint64
+    lib.brpc_tpu_fab_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.c_char_p]
+    lib.brpc_tpu_fab_send.restype = ctypes.c_int
+    lib.brpc_tpu_fab_send.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
+                                      u8p, ctypes.c_uint64]
+    lib.brpc_tpu_fab_recv.restype = ctypes.c_int
+    lib.brpc_tpu_fab_recv.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.brpc_tpu_fab_bytes.restype = ctypes.c_uint64
+    lib.brpc_tpu_fab_bytes.argtypes = [ctypes.c_uint64, ctypes.c_int]
+    lib.brpc_tpu_fab_buf_release.argtypes = [ctypes.c_uint64, u8p,
+                                             ctypes.c_uint64]
+    lib.brpc_tpu_fab_conn_close.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_fab_listener_close.argtypes = [ctypes.c_uint64]
     _lib = lib
     return _lib
 

@@ -195,6 +195,7 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
         self.peer: Optional["IciSocket"] = None
         self._inbox = IOBuf()
         self._inbox_lock = threading.Lock()
+        self.read_chunk_hint = 1 << 26    # _do_read cuts, never allocates
         self._peer_closed = False
         self._init_window(window_bytes)
         self._init_delivery()
@@ -244,10 +245,19 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
                 arr = r.block.data
                 if r.offset or r.length != len(arr):
                     arr = arr[r.offset:r.offset + r.length]
-                try:
-                    resident = target in arr.devices()
-                except Exception:
+                if not hasattr(arr, "devices"):
+                    # host-resident numpy delivered by the fabric bulk
+                    # plane, now being forwarded in-process: detach into
+                    # an owned copy before device_put — jax zero-copy
+                    # ALIASES ctypes-backed views without retaining them
+                    import numpy as _np
+                    arr = _np.array(arr, copy=True)
                     resident = False
+                else:
+                    try:
+                        resident = target in arr.devices()
+                    except Exception:
+                        resident = False
                 # already in the target chip's HBM: pure ref pass — the
                 # zero-copy case the block_pool discipline exists for
                 if resident:

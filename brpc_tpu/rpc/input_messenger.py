@@ -40,8 +40,15 @@ class InputMessenger:
         doctrine of docs/en/io.md."""
         read_eof = False
         last = None
+        # per-socket read granularity: TCP keeps 64KB (append_from_socket
+        # allocates max_count per read, so big reads waste allocation on
+        # small-message traffic); inbox-backed transports (ici/fabric)
+        # advertise a large hint because their _do_read only CUTS already
+        # -resident bytes — 8MB bulk frames used to take 128 read+parse
+        # cycles each at 64KB
+        read_max = getattr(socket, "read_chunk_hint", 1 << 16)
         while not read_eof and not socket.failed:
-            nr = socket._do_read(socket._read_portal, 1 << 16)
+            nr = socket._do_read(socket._read_portal, read_max)
             if nr < 0:
                 break                         # EAGAIN: wait for next event
             if nr == 0:

@@ -262,6 +262,8 @@ class TestFabricUnits:
         sock._conn_dead = False
         sock._staged = {}
         sock._staged_lock = _threading.Lock()
+        sock._bulk = 0
+        sock._blib = None
         sock._init_delivery()
         events = []
         sock.start_input_event = lambda *a, **k: events.append("input")
@@ -288,3 +290,138 @@ class TestFabricUnits:
         assert committed == [1]
         assert sock._peer_closed is True     # now EOF commits, in order
         assert "input" in events
+
+
+class TestNativeBulkPlane:
+    """The native bulk data plane alone (native/fabric.cpp): uuid-tagged
+    frames over a dedicated connection, exercised single-process over
+    both transports.  The 2-process tests above exercise it end-to-end
+    under the RPC stack; these pin the ABI contract."""
+
+    @pytest.fixture()
+    def lib(self):
+        from brpc_tpu.butil import native
+        lib = native.load()
+        if lib is None:
+            pytest.skip("native core unavailable")
+        return lib
+
+    def _pair(self, lib, key=b"t", uds=False):
+        import ctypes
+        port = ctypes.c_int()
+        uds_out = ctypes.create_string_buffer(108)
+        lh = lib.brpc_tpu_fab_listen(b"127.0.0.1", ctypes.byref(port),
+                                     uds_out, 108)
+        assert lh
+        if uds:
+            assert uds_out.value, "abstract unix listener did not bind"
+            ch = lib.brpc_tpu_fab_connect_uds(uds_out.value, key)
+        else:
+            ch = lib.brpc_tpu_fab_connect(b"127.0.0.1", port.value, key)
+        assert ch
+        sh = lib.brpc_tpu_fab_accept(lh, key, 10_000_000)
+        assert sh
+        return lh, ch, sh
+
+    @pytest.mark.parametrize("uds", [False, True])
+    def test_out_of_order_claim_both_transports(self, lib, uds):
+        """Frames are claimed BY UUID, not arrival order — the control
+        descriptor and the bulk bytes ride different connections, so the
+        receiver must tolerate either order."""
+        import ctypes
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lh, ch, sh = self._pair(lib, b"ooo", uds=uds)
+        try:
+            for uuid, fill in ((7, 0x11), (8, 0x22), (9, 0x33)):
+                data = (ctypes.c_uint8 * 1000)(*([fill] * 1000))
+                assert lib.brpc_tpu_fab_send(ch, uuid, data, 1000) == 0
+            for uuid, fill in ((9, 0x33), (7, 0x11), (8, 0x22)):
+                out, olen = u8p(), ctypes.c_uint64()
+                rc = lib.brpc_tpu_fab_recv(sh, uuid, 10_000_000,
+                                           ctypes.byref(out),
+                                           ctypes.byref(olen))
+                assert rc == 0 and olen.value == 1000
+                assert out[0] == fill and out[999] == fill
+                lib.brpc_tpu_fab_buf_release(sh, out, olen.value)
+        finally:
+            lib.brpc_tpu_fab_conn_close(ch)
+            lib.brpc_tpu_fab_conn_close(sh)
+            lib.brpc_tpu_fab_listener_close(lh)
+
+    def test_claim_timeout_and_dead_conn(self, lib):
+        import ctypes
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lh, ch, sh = self._pair(lib, b"to")
+        try:
+            out, olen = u8p(), ctypes.c_uint64()
+            # absent uuid: bounded timeout, rc -1
+            rc = lib.brpc_tpu_fab_recv(sh, 404, 50_000, ctypes.byref(out),
+                                       ctypes.byref(olen))
+            assert rc == -1
+            # a frame sent BEFORE the peer closes is claimable AFTER the
+            # close (control descriptor may lag the bulk bytes)
+            data = (ctypes.c_uint8 * 16)(*([5] * 16))
+            assert lib.brpc_tpu_fab_send(ch, 42, data, 16) == 0
+            import time
+            time.sleep(0.2)              # let the reader park the frame
+            lib.brpc_tpu_fab_conn_close(ch)
+            rc = lib.brpc_tpu_fab_recv(sh, 42, 5_000_000,
+                                       ctypes.byref(out),
+                                       ctypes.byref(olen))
+            assert rc == 0 and olen.value == 16 and out[3] == 5
+            lib.brpc_tpu_fab_buf_release(sh, out, olen.value)
+            # now the conn is dead and drained: missing uuids fail fast
+            rc = lib.brpc_tpu_fab_recv(sh, 505, 10_000_000,
+                                       ctypes.byref(out),
+                                       ctypes.byref(olen))
+            assert rc == -2
+            # send on the closed side fails cleanly
+            assert lib.brpc_tpu_fab_send(ch, 1, data, 16) == -1
+        finally:
+            lib.brpc_tpu_fab_conn_close(sh)
+            lib.brpc_tpu_fab_listener_close(lh)
+
+    def test_buffer_pool_reuses_exact_size(self, lib):
+        """Released buffers recycle for same-size frames (the page-fault
+        economy the pool exists for): the second claim of an equal-size
+        frame returns the SAME address."""
+        import ctypes
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lh, ch, sh = self._pair(lib, b"pool")
+        try:
+            data = (ctypes.c_uint8 * 4096)(*([1] * 4096))
+            assert lib.brpc_tpu_fab_send(ch, 1, data, 4096) == 0
+            out, olen = u8p(), ctypes.c_uint64()
+            assert lib.brpc_tpu_fab_recv(sh, 1, 5_000_000,
+                                         ctypes.byref(out),
+                                         ctypes.byref(olen)) == 0
+            first_addr = ctypes.addressof(out.contents)
+            lib.brpc_tpu_fab_buf_release(sh, out, olen.value)
+            assert lib.brpc_tpu_fab_send(ch, 2, data, 4096) == 0
+            out2, olen2 = u8p(), ctypes.c_uint64()
+            assert lib.brpc_tpu_fab_recv(sh, 2, 5_000_000,
+                                         ctypes.byref(out2),
+                                         ctypes.byref(olen2)) == 0
+            assert ctypes.addressof(out2.contents) == first_addr
+            lib.brpc_tpu_fab_buf_release(sh, out2, olen2.value)
+        finally:
+            lib.brpc_tpu_fab_conn_close(ch)
+            lib.brpc_tpu_fab_conn_close(sh)
+            lib.brpc_tpu_fab_listener_close(lh)
+
+    def test_accept_key_mismatch_times_out(self, lib):
+        import ctypes
+        port = ctypes.c_int()
+        uds_out = ctypes.create_string_buffer(108)
+        lh = lib.brpc_tpu_fab_listen(b"127.0.0.1", ctypes.byref(port),
+                                     uds_out, 108)
+        try:
+            ch = lib.brpc_tpu_fab_connect(b"127.0.0.1", port.value, b"A")
+            assert ch
+            assert lib.brpc_tpu_fab_accept(lh, b"B", 100_000) == 0
+            sh = lib.brpc_tpu_fab_accept(lh, b"A", 5_000_000)
+            assert sh
+            lib.brpc_tpu_fab_conn_close(ch)
+            lib.brpc_tpu_fab_conn_close(sh)
+        finally:
+            lib.brpc_tpu_fab_listener_close(lh)
