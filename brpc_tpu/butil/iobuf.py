@@ -188,6 +188,15 @@ class IOBuf:
         self._refs.append(BlockRef(blk, 0, n))
         self._size += n
 
+    def append_device_array_unchecked(self, arr, nbytes: int) -> None:
+        """append_device_array for arrays ALREADY validated as flat
+        uint8 (e.g. re-emerging from the native-plane registry): skips
+        the dtype/ndim checks and the shape read — the fast-plane
+        response path calls this once per RPC."""
+        blk = Block(DEVICE, arr, meta=0)
+        self._refs.append(BlockRef(blk, 0, nbytes))
+        self._size += nbytes
+
     def push_back(self, byte: int) -> None:
         self.append(bytes([byte]))
 

@@ -17,7 +17,7 @@ from typing import Callable, Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_buf_release"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_ici_call2"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -45,6 +45,20 @@ class IciSegC(ctypes.Structure):
                 ("nbytes", ctypes.c_uint64),
                 ("dev", ctypes.c_int32),
                 ("is_dev", ctypes.c_int32)]
+
+
+class IciCallOut(ctypes.Structure):
+    """One out-block for the unary ici call (native/rpc.cpp IciCallOut):
+    replaces seven per-call byref temporaries with a single pointer.
+    err_text is a raw pointer (c_void_p, NOT c_char_p — the automatic
+    bytes conversion would lose the pointer the caller must buf_free)."""
+    _fields_ = [("resp", ctypes.POINTER(ctypes.c_uint8)),
+                ("resp_len", ctypes.c_uint64),
+                ("att", ctypes.POINTER(ctypes.c_uint8)),
+                ("att_len", ctypes.c_uint64),
+                ("segs", ctypes.POINTER(IciSegC)),
+                ("nsegs", ctypes.c_uint64),
+                ("err_text", ctypes.c_void_p)]
 
 
 # relocation upcall: (key, target_dev) -> new key (0 = failure)
@@ -254,6 +268,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(segp), ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_char_p)]
+    lib.brpc_tpu_ici_call2.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_call2.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, u8p, ctypes.c_uint64, u8p,
+        ctypes.c_uint64, segp, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.POINTER(IciCallOut)]
     lib.brpc_tpu_ici_respond.restype = ctypes.c_int
     lib.brpc_tpu_ici_respond.argtypes = [
         ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p, u8p,

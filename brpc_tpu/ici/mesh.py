@@ -24,6 +24,12 @@ AXIS = "ici"
 class IciMesh:
     _default: Optional["IciMesh"] = None
     _lock = threading.Lock()
+    # bumped whenever the default mesh is (re)bound: consumers caching
+    # mesh-relative facts (e.g. native_plane's array->logical-id cache)
+    # key their entries on this and recompute after a swap — a stale
+    # logical id would silently skip relocation for a wrongly-"resident"
+    # array (review finding r5)
+    generation: int = 0
 
     def __init__(self, devices: Optional[Sequence] = None,
                  axis_name: str = AXIS):
@@ -52,6 +58,7 @@ class IciMesh:
     def set_default(cls, mesh: "IciMesh") -> None:
         with cls._lock:
             cls._default = mesh
+            cls.generation += 1
 
     # ---- endpoints -----------------------------------------------------
     def endpoint(self, device_id: int) -> EndPoint:

@@ -18,6 +18,7 @@ from .controller import Controller
 from .input_messenger import InputMessenger
 from .protocol import find_protocol
 from .socket_map import SocketMap
+from .span import end_client_span, maybe_start_client_span
 
 
 @dataclass
@@ -110,16 +111,16 @@ class Channel:
         # and frames too large for the native send window ride the Python
         # plane (which drains big payloads chunkwise through its credit
         # window).
-        nch = self._native_ici_binding(cntl)
-        if nch is not None:
-            try:                        # payload + attachment vs window
-                req_sz = request.ByteSize() \
-                    if hasattr(request, "ByteSize") else 0
-            except Exception:
-                req_sz = 0
-            if len(cntl.request_attachment) + req_sz + 65536 > \
-                    nch.window_bytes or self.options.backup_request_ms > 0:
-                nch = None
+        nch = self._native_ici
+        if nch is None:
+            nch = self._native_ici_binding(cntl)
+        elif cntl.stream_creator is not None:
+            # the cached-binding fast path must re-screen the ONE
+            # eligibility input that varies per call; the channel-level
+            # ones (protocol, auth, endpoint) were screened at cache time
+            nch = None
+        if nch is not None and not self._fast_call_fits(nch, cntl, request):
+            nch = None
         if nch is not None:
             if cntl.timeout_ms is None:
                 cntl.timeout_ms = self.options.timeout_ms
@@ -128,7 +129,6 @@ class Channel:
                                                request, response_cls)
                 if not self._native_ici_fallback(cntl):
                     if cntl.span is not None:
-                        from .span import end_client_span
                         end_client_span(cntl)
                     return result
             else:
@@ -151,7 +151,6 @@ class Channel:
                                          response_cls, done=done)
                     else:
                         if cntl.span is not None:
-                            from .span import end_client_span
                             end_client_span(cntl)
                         done(cntl)
 
@@ -162,7 +161,6 @@ class Channel:
             cntl.auth_token = self.options.auth.generate_credential(cntl)
         payload = self._protocol.serialize_request(request, cntl)
         if cntl.span is None:
-            from .span import maybe_start_client_span
             maybe_start_client_span(cntl, method_full_name)
         cntl._start_call(self, method_full_name, payload, response_cls, done)
         if done is None:
@@ -170,6 +168,37 @@ class Channel:
             cntl.join(timeout)
             return cntl.response
         return None
+
+    def _fast_call_fits(self, nch, cntl: Controller, request) -> bool:
+        """Per-call screen for the native fast plane: the frame (payload
+        + attachment + headroom) must fit the native send window, and
+        backup-request hedging rides the Python plane."""
+        try:                            # non-proto requests have no size
+            req_sz = request.ByteSize()
+        except Exception:
+            req_sz = 0
+        return (len(cntl.request_attachment) + req_sz + 65536
+                <= nch.window_bytes
+                and self.options.backup_request_ms <= 0)
+
+    def inline_fast_call_ok(self, cntl: Controller, request,
+                            method_full_name: str) -> bool:
+        """True when THIS call would take the native in-process fast
+        path AND the listener answers it inline on the caller's thread —
+        i.e. issuing it synchronously from a fan-out loop costs nothing
+        over a tasklet (the handler runs in the caller's stack either
+        way).  Used by ParallelChannel's inline-issue optimization; must
+        mirror call_method's routing screens exactly, or a fan-out
+        commits to inline issue and then serializes on the Python plane
+        (review finding r5)."""
+        nch = self._native_ici
+        if nch is None or cntl.stream_creator is not None:
+            return False
+        if not self._fast_call_fits(nch, cntl, request):
+            return False
+        from ..ici import native_plane
+        return native_plane.listener_dispatch_inline(
+            nch.remote_dev, method_full_name) is True
 
     def _native_ici_call(self, nch, method_full_name: str,
                          cntl: Controller, request, response_cls):
@@ -180,7 +209,6 @@ class Channel:
         other failure here is deterministic (ENOMETHOD, ELIMIT, parse,
         timeout) and would fail identically on a retry."""
         if cntl.span is None:
-            from .span import maybe_start_client_span
             maybe_start_client_span(cntl, method_full_name)
         return nch.call(method_full_name, cntl, request, response_cls)
 

@@ -65,7 +65,9 @@ class Controller:
         self._method_full_name: str = ""
         self._request_buf: Optional[IOBuf] = None
         self._start_us: int = 0
-        self._ended = threading.Event()
+        # lazy: ~3 µs of threading.Event construction per call that the
+        # native ici fast path (sync, never joins) would pay for nothing
+        self._ended_ev: Optional[threading.Event] = None
         self._excluded_servers: set = set()
         self.request_protocol: str = ""
         self.stream_creator = None      # set by stream.create on host RPC
@@ -90,6 +92,23 @@ class Controller:
         if self._session_data is not None and self.server is not None:
             self.server._return_session_data(self._session_data)
             self._session_data = None
+
+    _ended_create_lock = threading.Lock()
+
+    @property
+    def _ended(self) -> threading.Event:
+        """Completion event, created on first touch (double-checked under
+        a class lock: a completer's set() and a joiner's wait() may both
+        be the first toucher, and each building its own Event would park
+        the joiner forever).  The native ici fast path completes calls
+        without ever touching this."""
+        ev = self._ended_ev
+        if ev is None:
+            with Controller._ended_create_lock:
+                ev = self._ended_ev
+                if ev is None:
+                    ev = self._ended_ev = threading.Event()
+        return ev
 
     # ---- error surface (reference Controller::SetFailed/Failed) -------
     def set_failed(self, code: int, text: str = "") -> None:
