@@ -1,9 +1,12 @@
 // Self-test for the native core (assert-based; run via `make test`).
+#include "flat_map.h"
+
 #include <atomic>
 #include <cassert>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <memory>
 #include <thread>
 #include <unistd.h>
 #include <vector>
@@ -124,6 +127,68 @@ int main() {
   usleep(120000);
   assert(g_counter.load() == 7);
   printf("timer ok\n");
+
+  // FlatMap64: the open-addressing map under the correlation tables
+  {
+    nbase::FlatMap64<uint64_t> m(4);
+    assert(m.seek(0) == nullptr);
+    m[0] = 42;                       // 0 is a VALID key (cids start at 0)
+    assert(*m.seek(0) == 42 && m.size() == 1);
+    // growth + survival of every entry across rehashes
+    for (uint64_t k = 1; k <= 5000; ++k) m[k] = k * 3;
+    assert(m.size() == 5001);
+    for (uint64_t k = 1; k <= 5000; ++k) assert(*m.seek(k) == k * 3);
+    // erase half; the rest stay reachable through the tombstones
+    for (uint64_t k = 1; k <= 5000; k += 2) assert(m.erase(k) == 1);
+    assert(m.erase(1) == 0);
+    assert(m.size() == 2501);
+    for (uint64_t k = 2; k <= 5000; k += 2) assert(*m.seek(k) == k * 3);
+    for (uint64_t k = 1; k <= 5000; k += 2) assert(m.seek(k) == nullptr);
+    // take = find+erase in one step
+    uint64_t out = 0;
+    assert(m.take(4, &out) && out == 12 && m.seek(4) == nullptr);
+    assert(!m.take(4, &out));
+    // tombstone churn at one slot must not degrade into a full-table
+    // probe (rehash on combined live+tombstone load)
+    for (uint64_t k = 10000; k < 30000; ++k) {
+      m[k] = 1;
+      assert(m.erase(k) == 1);
+    }
+    assert(*m.seek(0) == 42);
+  }
+  // correlation-table churn (unique keys, insert-then-take, live ~1)
+  // must keep CAPACITY bounded: tombstone-driven rehashes reclaim in
+  // place instead of doubling (review finding: capacity used to grow
+  // linearly with total call count)
+  {
+    nbase::FlatMap64<uint64_t> m;
+    for (uint64_t cid = 0; cid < 1000000; ++cid) {
+      m[cid] = cid;
+      uint64_t out;
+      assert(m.take(cid, &out) && out == cid);
+    }
+    assert(m.size() == 0);
+    assert(m.capacity() <= 64);  // stayed near its initial 16 slots
+    // for_each visits exactly the live population
+    size_t seen = 0;
+    m.for_each([&](uint64_t, uint64_t) { ++seen; });
+    assert(seen == m.size());
+    m.clear();
+    assert(m.size() == 0 && m.seek(0) == nullptr);
+  }
+  // shared_ptr values: erase/clear must release the references
+  {
+    auto sp = std::make_shared<int>(5);
+    nbase::FlatMap64<std::shared_ptr<int>> m;
+    m[7] = sp;
+    assert(sp.use_count() == 2);
+    assert(m.erase(7) == 1);
+    assert(sp.use_count() == 1);
+    m[8] = sp;
+    m.clear();
+    assert(sp.use_count() == 1);
+  }
+  printf("flat_map ok\n");
 
   printf("ALL NATIVE TESTS PASSED\n");
   return 0;

@@ -54,6 +54,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include "flat_map.h"
+
 namespace nfab {
 
 // Frames larger than this are a protocol error (fat-finger guard; the
@@ -132,7 +134,7 @@ struct BulkConn {
   std::mutex wmu;  // serializes writers (frames must not interleave)
   std::mutex mu;   // guards frames / dead
   std::condition_variable cv;
-  std::unordered_map<uint64_t, Frame> frames;
+  nbase::FlatMap64<Frame> frames;   // parked bulk frames by uuid
   bool dead = false;
   std::thread reader;
   std::atomic<uint64_t> bytes_in{0}, bytes_out{0};
@@ -185,7 +187,7 @@ struct BulkConn {
       reader.join();
     }
     if (fd >= 0) ::close(fd);
-    for (auto& kv : frames) free(kv.second.data);
+    frames.for_each([](uint64_t, Frame& f) { free(f.data); });
     drain_pool();
   }
 
@@ -210,8 +212,8 @@ struct BulkConn {
       bytes_in.fetch_add(len, std::memory_order_relaxed);
       std::lock_guard<std::mutex> g(mu);
       // duplicate uuid would leak the old buffer — replace defensively
-      auto it = frames.find(uuid);
-      if (it != frames.end()) free(it->second.data);
+      Frame* old = frames.seek(uuid);
+      if (old != nullptr) free(old->data);
       frames[uuid] = Frame{buf, len};
       cv.notify_all();
     }
@@ -251,17 +253,16 @@ struct BulkConn {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::microseconds(timeout_us);
     for (;;) {
-      auto it = frames.find(uuid);
-      if (it != frames.end()) {
-        *out = it->second.data;
-        *out_len = it->second.len;
-        frames.erase(it);
+      Frame f;
+      if (frames.take(uuid, &f)) {
+        *out = f.data;
+        *out_len = f.len;
         return 0;
       }
       if (dead) return -2;
       if (timeout_us >= 0) {
         if (cv.wait_until(lk, deadline) == std::cv_status::timeout &&
-            frames.find(uuid) == frames.end() && !dead)
+            frames.seek(uuid) == nullptr && !dead)
           return -1;
       } else {
         cv.wait(lk);

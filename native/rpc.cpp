@@ -29,6 +29,8 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+
+#include "flat_map.h"
 #include <vector>
 
 #ifdef __linux__
@@ -642,7 +644,7 @@ struct PendingReply {
 };
 
 static std::mutex g_tokens_mu;
-static std::unordered_map<uint64_t, PendingReply> g_tokens;
+static nbase::FlatMap64<PendingReply> g_tokens;
 static std::atomic<uint64_t> g_next_token{1};
 
 void NativeServer::stop() {
@@ -654,10 +656,11 @@ void NativeServer::stop() {
     // drop replies parked in Python for this server: their tokens must not
     // resolve once we're gone
     std::lock_guard<std::mutex> g(g_tokens_mu);
-    for (auto it = g_tokens.begin(); it != g_tokens.end();) {
-      if (it->second.server_handle == handle_) it = g_tokens.erase(it);
-      else ++it;
-    }
+    std::vector<uint64_t> purge;
+    g_tokens.for_each([&](uint64_t t, PendingReply& pr) {
+      if (pr.server_handle == handle_) purge.push_back(t);
+    });
+    for (uint64_t t : purge) g_tokens.erase(t);
   }
   std::vector<ConnPtr> conns;
   {
@@ -852,16 +855,15 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
     std::vector<std::pair<SlotPtr, uint64_t>> async_victims;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
-      for (auto& kv : slots_) {
-        std::lock_guard<std::mutex> sg(kv.second->mu);
-        if (kv.second->done) continue;  // delivered result stays delivered
-        kv.second->done = true;
-        kv.second->error_code = 1009;  // EFAILEDSOCKET (rpc/errors.py)
-        kv.second->error_text = "channel closed";
-        kv.second->cv.notify_all();
-        if (kv.second->cb != nullptr)
-          async_victims.push_back({kv.second, kv.first});
-      }
+      slots_.for_each([&](uint64_t cid, SlotPtr& sp) {
+        std::lock_guard<std::mutex> sg(sp->mu);
+        if (sp->done) return;           // delivered result stays delivered
+        sp->done = true;
+        sp->error_code = 1009;  // EFAILEDSOCKET (rpc/errors.py)
+        sp->error_text = "channel closed";
+        sp->cv.notify_all();
+        if (sp->cb != nullptr) async_victims.push_back({sp, cid});
+      });
       slots_.clear();
     }
     for (auto& [slot, cid] : async_victims)   // callbacks outside locks
@@ -1079,13 +1081,13 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
     std::vector<std::pair<uint64_t, SlotPtr>> expired;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
-      for (auto& kv : slots_) {
-        if (kv.second->cb == nullptr) continue;
-        if (kv.second->deadline_ns <= now)
-          expired.push_back(kv);
+      slots_.for_each([&](uint64_t cid, SlotPtr& sp) {
+        if (sp->cb == nullptr) return;
+        if (sp->deadline_ns <= now)
+          expired.push_back({cid, sp});
         else
-          next = std::min(next, kv.second->deadline_ns);
-      }
+          next = std::min(next, sp->deadline_ns);
+      });
       for (auto& kv : expired) slots_.erase(kv.first);
     }
     next_sweep_ns_.store(next, std::memory_order_relaxed);
@@ -1201,10 +1203,11 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
     SlotPtr slot;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
-      auto it = slots_.find(meta.correlation_id);
-      if (it != slots_.end()) {
-        slot = it->second;            // shared ref held past mu
-        if (slot->cb != nullptr) slots_.erase(it);   // async: done here
+      SlotPtr* p = slots_.seek(meta.correlation_id);
+      if (p != nullptr) {
+        slot = *p;                    // shared ref held past mu
+        if (slot->cb != nullptr)
+          slots_.erase(meta.correlation_id);         // async: done here
       }
     }
     if (slot == nullptr) return;  // timed out / stale: drop
@@ -1246,7 +1249,7 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
   std::mutex read_mu_;
   std::string rbuf_;
   std::mutex slots_mu_;
-  std::unordered_map<uint64_t, SlotPtr> slots_;
+  nbase::FlatMap64<SlotPtr> slots_;   // correlation hot path (flat_map.h)
   std::mutex reader_mu_;
   std::thread reader_;
   std::atomic<int64_t> next_sweep_ns_{0};
@@ -1404,8 +1407,8 @@ class IciChannel {
     IciSlotPtr slot;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
-      auto it = slots_.find(cid);
-      if (it != slots_.end()) slot = it->second;
+      IciSlotPtr* p = slots_.seek(cid);
+      if (p != nullptr) slot = *p;
     }
     if (slot == nullptr) {
       ici_release_segs(segs);
@@ -1428,10 +1431,14 @@ class IciChannel {
   }
 
   void fail_all(uint64_t err, const char* text) {
-    std::unordered_map<uint64_t, IciSlotPtr> victims;
+    std::vector<std::pair<uint64_t, IciSlotPtr>> victims;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
-      victims.swap(slots_);
+      victims.reserve(slots_.size());
+      slots_.for_each([&](uint64_t cid, IciSlotPtr& sp) {
+        victims.emplace_back(cid, sp);
+      });
+      slots_.clear();
     }
     for (auto& kv : victims) {
       {
@@ -1449,7 +1456,9 @@ class IciChannel {
   int32_t local_dev_, remote_dev_;
   std::atomic<uint64_t> next_cid_{0};
   std::mutex slots_mu_;
-  std::unordered_map<uint64_t, IciSlotPtr> slots_;
+  // correlation table on the sub-microsecond path: contiguous
+  // open-addressing slots, no per-node allocation (see flat_map.h)
+  nbase::FlatMap64<IciSlotPtr> slots_;
 };
 using IciChannelPtr = std::shared_ptr<IciChannel>;
 
@@ -1669,7 +1678,7 @@ static std::unordered_map<uint64_t, IciServerPtr> g_ici_servers;  // by handle
 static std::unordered_map<uint64_t, std::pair<IciChannelPtr, IciConnPtr>>
     g_ici_channels;
 static std::mutex g_ici_tokens_mu;
-static std::unordered_map<uint64_t, IciPending> g_ici_tokens;
+static nbase::FlatMap64<IciPending> g_ici_tokens;
 static std::atomic<uint64_t> g_ici_next_token{1};
 
 uint64_t IciServer::register_token(const IciConnPtr& conn, uint64_t cid) {
@@ -1909,10 +1918,7 @@ int brpc_tpu_nserver_respond(uint64_t token, uint64_t err,
   nrpc::PendingReply pr;
   {
     std::lock_guard<std::mutex> g(nrpc::g_tokens_mu);
-    auto it = nrpc::g_tokens.find(token);
-    if (it == nrpc::g_tokens.end()) return -1;
-    pr = it->second;
-    nrpc::g_tokens.erase(it);
+    if (!nrpc::g_tokens.take(token, &pr)) return -1;
   }
   // resolve by handle: a stopped server no longer resolves (its tokens
   // were purged too; this is belt-and-braces for the in-between window)
@@ -2182,14 +2188,12 @@ void brpc_tpu_ici_unlisten(uint64_t h) {
   {
     // purge this server's in-flight Python-handler tokens
     std::lock_guard<std::mutex> g(nrpc::g_ici_tokens_mu);
-    for (auto it = nrpc::g_ici_tokens.begin();
-         it != nrpc::g_ici_tokens.end();) {
-      auto conn = it->second.conn.lock();
-      if (conn == nullptr || conn->server == s)
-        it = nrpc::g_ici_tokens.erase(it);
-      else
-        ++it;
-    }
+    std::vector<uint64_t> purge;
+    nrpc::g_ici_tokens.for_each([&](uint64_t t, nrpc::IciPending& pr) {
+      auto conn = pr.conn.lock();
+      if (conn == nullptr || conn->server == s) purge.push_back(t);
+    });
+    for (uint64_t t : purge) nrpc::g_ici_tokens.erase(t);
   }
   s->stop();
 }
@@ -2326,10 +2330,7 @@ int brpc_tpu_ici_respond(uint64_t token, uint64_t err, const char* err_text,
   nrpc::IciPending pr;
   {
     std::lock_guard<std::mutex> g(nrpc::g_ici_tokens_mu);
-    auto it = nrpc::g_ici_tokens.find(token);
-    if (it == nrpc::g_ici_tokens.end()) return -1;
-    pr = it->second;
-    nrpc::g_ici_tokens.erase(it);
+    if (!nrpc::g_ici_tokens.take(token, &pr)) return -1;
   }
   std::vector<nrpc::IciSegC> seg_vec(segs, segs + nsegs);
   auto conn = pr.conn.lock();
