@@ -1,0 +1,82 @@
+"""gRPC foreign-implementation interop — the migration path for services
+moving off stock gRPC: the SAME port serves this framework's clients
+(tpu_std) AND unmodified grpcio clients simultaneously, and our
+``rpc.Channel(protocol="grpc")`` can call an unmodified ``grpc.server()``
+— so a fleet can migrate one process at a time in either direction.
+
+Requires grpcio (skipped cleanly when absent).  Reference analogue:
+example/grpc_c++ interoperating with grpc's own stacks."""
+from __future__ import annotations
+
+from examples.common import (EchoRequest, EchoResponse, EchoService,
+                             rpc)
+
+
+def main() -> None:
+    try:
+        import grpc
+    except ImportError:
+        print("grpc interop: grpcio not installed, skipping")
+        return
+
+    # --- direction 1: a stock grpcio client calls OUR server ----------
+    server = rpc.Server()
+    server.add_service(EchoService(tag="ours"))
+    assert server.start("127.0.0.1:0") == 0
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{server.listen_port}")
+        stub = ch.unary_unary(
+            "/EchoService/Echo",
+            request_serializer=EchoRequest.SerializeToString,
+            response_deserializer=EchoResponse.FromString)
+        resp = stub(EchoRequest(message="hello"), timeout=10)
+        print(f"grpcio client -> our server: {resp.message!r}")
+        ch.close()
+        # the SAME port still answers our own protocol clients
+        own = rpc.Channel()
+        own.init(f"127.0.0.1:{server.listen_port}",
+                 options=rpc.ChannelOptions(timeout_ms=2000))
+        cntl = rpc.Controller()
+        resp = own.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="native"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        print(f"tpu_std client -> same port:  {resp.message!r}")
+    finally:
+        server.stop()
+
+    # --- direction 2: OUR channel calls a stock grpc.server() ---------
+    from concurrent import futures
+
+    class Handler(grpc.GenericRpcHandler):
+        def service(self, hcd):
+            if hcd.method == "/EchoService/Echo":
+                def unary(req, ctx):
+                    out = EchoResponse()
+                    out.message = "grpcio:" + req.message
+                    return out
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=EchoRequest.FromString,
+                    response_serializer=EchoResponse.SerializeToString)
+            return None
+
+    gs = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    gs.add_generic_rpc_handlers((Handler(),))
+    port = gs.add_insecure_port("127.0.0.1:0")
+    gs.start()
+    try:
+        ch = rpc.Channel()
+        ch.init(f"tcp://127.0.0.1:{port}",
+                options=rpc.ChannelOptions(protocol="grpc",
+                                           timeout_ms=5000))
+        cntl = rpc.Controller()
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="out"), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        print(f"our channel  -> grpc.server: {resp.message!r}")
+    finally:
+        gs.stop(None)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,14 @@ class FlatMap64 {
     size_ = used_ = 0;
   }
 
+  // O(1) content exchange — fail_all-style paths take the whole table
+  // out under a hot lock and process it outside.
+  void swap(FlatMap64& other) {
+    slots_.swap(other.slots_);
+    std::swap(size_, other.size_);
+    std::swap(used_, other.used_);
+  }
+
  private:
   Slot* find_slot(uint64_t key) {
     size_t mask = slots_.size() - 1;

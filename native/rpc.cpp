@@ -1431,16 +1431,20 @@ class IciChannel {
   }
 
   void fail_all(uint64_t err, const char* text) {
-    std::vector<std::pair<uint64_t, IciSlotPtr>> victims;
+    // O(1) under the hot lock (review finding: per-entry shared_ptr
+    // copies stalled concurrent make_slot/deliver for the copy's
+    // duration); the table is processed outside it
+    nbase::FlatMap64<IciSlotPtr> victims;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
-      victims.reserve(slots_.size());
-      slots_.for_each([&](uint64_t cid, IciSlotPtr& sp) {
-        victims.emplace_back(cid, sp);
-      });
-      slots_.clear();
+      victims.swap(slots_);
     }
-    for (auto& kv : victims) {
+    std::vector<std::pair<uint64_t, IciSlotPtr>> entries;
+    entries.reserve(victims.size());
+    victims.for_each([&](uint64_t cid, IciSlotPtr& sp) {
+      entries.emplace_back(cid, sp);
+    });
+    for (auto& kv : entries) {
       {
         std::lock_guard<std::mutex> g(kv.second->mu);
         if (kv.second->done.load(std::memory_order_acquire)) continue;

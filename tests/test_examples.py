@@ -26,6 +26,7 @@ EXAMPLES = [
     "examples.mongo_service",
     "examples.cascade_echo",
     "examples.grpc_echo",
+    "examples.grpc_interop",
     "examples.redis_kv",
     "examples.memcache_client",
     "examples.thrift_echo",
