@@ -852,20 +852,25 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
   }
 
   void fail_all_pending() {
-    std::vector<std::pair<SlotPtr, uint64_t>> async_victims;
+    // O(1) under the hot lock (same discipline as IciChannel::fail_all,
+    // review finding: per-slot lock/notify sweeps under slots_mu_
+    // stalled concurrent slot registration); the table is processed
+    // outside it
+    nbase::FlatMap64<SlotPtr> victims;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
-      slots_.for_each([&](uint64_t cid, SlotPtr& sp) {
-        std::lock_guard<std::mutex> sg(sp->mu);
-        if (sp->done) return;           // delivered result stays delivered
-        sp->done = true;
-        sp->error_code = 1009;  // EFAILEDSOCKET (rpc/errors.py)
-        sp->error_text = "channel closed";
-        sp->cv.notify_all();
-        if (sp->cb != nullptr) async_victims.push_back({sp, cid});
-      });
-      slots_.clear();
+      victims.swap(slots_);
     }
+    std::vector<std::pair<SlotPtr, uint64_t>> async_victims;
+    victims.for_each([&](uint64_t cid, SlotPtr& sp) {
+      std::lock_guard<std::mutex> sg(sp->mu);
+      if (sp->done) return;             // delivered result stays delivered
+      sp->done = true;
+      sp->error_code = 1009;  // EFAILEDSOCKET (rpc/errors.py)
+      sp->error_text = "channel closed";
+      sp->cv.notify_all();
+      if (sp->cb != nullptr) async_victims.push_back({sp, cid});
+    });
     for (auto& [slot, cid] : async_victims)   // callbacks outside locks
       slot->cb(slot->cb_user, 1009, "channel closed", nullptr, 0, nullptr,
                0);
@@ -1439,21 +1444,17 @@ class IciChannel {
       std::lock_guard<std::mutex> g(slots_mu_);
       victims.swap(slots_);
     }
-    std::vector<std::pair<uint64_t, IciSlotPtr>> entries;
-    entries.reserve(victims.size());
-    victims.for_each([&](uint64_t cid, IciSlotPtr& sp) {
-      entries.emplace_back(cid, sp);
-    });
-    for (auto& kv : entries) {
+    // victims is private to this frame: process in place, no staging
+    victims.for_each([&](uint64_t, IciSlotPtr& sp) {
       {
-        std::lock_guard<std::mutex> g(kv.second->mu);
-        if (kv.second->done.load(std::memory_order_acquire)) continue;
-        kv.second->error_code = err;
-        kv.second->error_text = text;
-        kv.second->done.store(true, std::memory_order_release);
+        std::lock_guard<std::mutex> g(sp->mu);
+        if (sp->done.load(std::memory_order_acquire)) return;
+        sp->error_code = err;
+        sp->error_text = text;
+        sp->done.store(true, std::memory_order_release);
       }
-      kv.second->cv.notify_all();
-    }
+      sp->cv.notify_all();
+    });
   }
 
  private:

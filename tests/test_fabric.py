@@ -425,3 +425,57 @@ class TestNativeBulkPlane:
             lib.brpc_tpu_fab_conn_close(sh)
         finally:
             lib.brpc_tpu_fab_listener_close(lh)
+
+    def test_concurrent_send_recv_close_hammer(self, lib):
+        """Teardown vs traffic: concurrent senders, claimers, and an
+        asynchronous close must end in clean failures (rc -1/-2), never
+        a hang, crash, or double free.  Pins the close_join/wmu
+        exclusion (a closing fd must not be recycled under a writer)."""
+        import ctypes
+        import threading
+        import time
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        for round_ in range(6):
+            lh, ch, sh = self._pair(lib, b"hammer%d" % round_)
+            stop = threading.Event()
+            errs = []
+
+            def sender():
+                # stop is only a wedge-breaker: the sender may be
+                # descheduled across the close+stop window and exit via
+                # the flag without ever observing a failed send — that
+                # is a scheduling outcome, not a product failure
+                data = (ctypes.c_uint8 * 8192)(*([3] * 8192))
+                uuid = round_ * 1_000_000
+                while not stop.is_set():
+                    uuid += 1
+                    if lib.brpc_tpu_fab_send(ch, uuid, data, 8192) != 0:
+                        return      # conn died under us: expected
+
+            def claimer():
+                out, olen = u8p(), ctypes.c_uint64()
+                uuid = round_ * 1_000_000
+                while True:
+                    uuid += 1
+                    rc = lib.brpc_tpu_fab_recv(sh, uuid, 2_000_000,
+                                               ctypes.byref(out),
+                                               ctypes.byref(olen))
+                    if rc == 0:
+                        lib.brpc_tpu_fab_buf_release(sh, out, olen.value)
+                    else:
+                        return      # timeout (-1) or dead (-2): expected
+
+            ts = [threading.Thread(target=sender, daemon=True),
+                  threading.Thread(target=claimer, daemon=True)]
+            for t in ts:
+                t.start()
+            time.sleep(0.05)
+            # close BOTH ends while traffic is in flight
+            lib.brpc_tpu_fab_conn_close(ch)
+            lib.brpc_tpu_fab_conn_close(sh)
+            stop.set()
+            for t in ts:
+                t.join(timeout=10)
+                assert not t.is_alive(), "hammer thread wedged"
+            assert not errs, errs
+            lib.brpc_tpu_fab_listener_close(lh)
