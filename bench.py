@@ -633,6 +633,28 @@ def bench_fabric_gbps(timeout_s: int = 300) -> dict:
     return {}
 
 
+def bench_fabric_streaming_mbps(timeout_s: int = 240) -> dict:
+    """Streaming RPC across a real process boundary (r5): handshake and
+    frames on the fabric control channel, each 256KB chunk on the native
+    bulk plane (kind-3 host blobs) — the multi-host leg of the
+    sequence-parallel substrate.  Server verifies every chunk's bytes."""
+    import os
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    from test_fabric import STREAM_CHILD, _run_pair
+    child = STREAM_CHILD % {"repo": repo, "n": 160}   # 40MB measured
+    try:
+        outs = _run_pair(child, timeout=timeout_s)
+    except AssertionError as e:
+        print(f"# fabric streaming bench failed: {str(e)[-300:]}",
+              file=sys.stderr)
+        return {}
+    for line in outs[1].splitlines():
+        if line.startswith("FABRIC_STREAM_MBPS"):
+            return {"stream_mbps": float(line.split()[1])}
+    return {}
+
+
 def device_backend_reachable() -> bool:
     """Fast-fail probe for the device backend (VERDICT r1 #1): under the
     axon tunnel, jax backend init dials the terminal's stateless port —
@@ -808,6 +830,12 @@ def main() -> None:
         print(f"# fabric bench failed: {e}", file=sys.stderr)
         fb = {}
     try:
+        fstrm = bench_fabric_streaming_mbps()
+        print(f"# fabric streaming: {fstrm}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# fabric streaming failed: {e}", file=sys.stderr)
+        fstrm = {}
+    try:
         tail = bench_tail_isolation(allow_ici=reachable)
         print(f"# tail isolation: {tail}", file=sys.stderr)
     except Exception as e:  # pragma: no cover
@@ -884,6 +912,8 @@ def main() -> None:
         "streaming_mbps": round(strm.get("stream_mbps", 0.0), 1),
         "streaming_mbps_tcp": round(strm_tcp.get("stream_mbps", -1.0), 1),
         "streaming_mbps_ici": round(strm_ici.get("stream_mbps", -1.0), 1),
+        "streaming_mbps_fabric_xproc": round(
+            fstrm.get("stream_mbps", -1.0), 1),
         "parallel_fanout8_p50_us": round(fan.get("fanout_p50_us", 0.0), 1),
         "parallel_fanout8_ici_p50_us": round(
             ifan.get("fanout_p50_us", -1.0), 1),
