@@ -240,6 +240,8 @@ def test_two_process_stress_over_transfer_server():
     path's coverage."""
     child = STRESS_CHILD % {"repo": REPO}
     marker = "from brpc_tpu.ici.fabric import FabricNode"
+    assert marker in child    # a silent no-op here would re-test the
+    # bulk plane and leave the pod-DMA path uncovered again
     # the flag is defined at fabric-module import: inject AFTER it
     child = child.replace(marker, marker + _XFER_FLAG)
     outs = _run_pair(child, timeout=300)
