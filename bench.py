@@ -114,6 +114,36 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
     return out
 
 
+def _pin_cpu_mesh_if_requested() -> None:
+    """Virtual-CPU-mesh fallback guard shared by the mesh subbenches:
+    pin the platform BEFORE backend init or the axon TPU plugin wins
+    selection despite JAX_PLATFORMS=cpu (same guard
+    __graft_entry__.dryrun_multichip needs)."""
+    import os
+
+    import jax
+
+    if ("xla_force_host_platform_device_count"
+            in os.environ.get("XLA_FLAGS", "")):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def _run_mesh_subbench(name: str) -> dict:
+    """Run a >=2-device subbench; on a 1-chip host re-run it on an
+    8-virtual-device CPU mesh, labeling the platform accordingly."""
+    out = _run_subbench(name)
+    if not out.get("devices"):
+        out = _run_subbench(name, env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+        if out.get("devices"):
+            out["platform"] = "cpu_mesh_virtual"
+    return out
+
+
 def bench_relocation(iters: int = 300):
     """The transfer leg itself (VERDICT r4 weak #1b): echo where the
     request payload is NOT resident on the server's chip, so every call
@@ -127,19 +157,9 @@ def bench_relocation(iters: int = 300):
     on an 8-virtual-device CPU mesh (relocation PATH is the real code;
     the byte-move is host memory, and the label says so); on real
     multi-chip hardware the same code measures the real hop."""
-    import os
-
     import jax
 
-    # virtual-CPU-mesh fallback: pin the platform before backend init or
-    # the axon TPU plugin wins selection despite JAX_PLATFORMS=cpu (the
-    # same guard __graft_entry__.dryrun_multichip needs)
-    if ("xla_force_host_platform_device_count"
-            in os.environ.get("XLA_FLAGS", "")):
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+    _pin_cpu_mesh_if_requested()
     import jax.numpy as jnp
 
     import brpc_tpu.policy  # registers protocols
@@ -213,6 +233,65 @@ def bench_relocation(iters: int = 300):
         out[f"{label}_gbps_4m"] = n_big * big / dt / 1e9
     server.stop()
     return out
+
+
+def bench_ring_attention(seq: int = 4096, dim: int = 128, heads: int = 8):
+    """Long-context leg (SURVEY §5.7): sequence-parallel ring attention
+    over the mesh vs the dense single-device reference, same math.
+    Reports tokens/s for both and the memory story that is the point:
+    each chip holds O(seq/n) of K/V while the ring rotates shards.  On
+    >= 2 real chips the ppermute rides the real ICI; main() re-runs on
+    the 8-virtual-device CPU mesh on a 1-chip host (labeled)."""
+    import jax
+
+    _pin_cpu_mesh_if_requested()
+    import jax.numpy as jnp
+
+    from brpc_tpu.ici.mesh import IciMesh
+    from brpc_tpu.ici.ring_attention import ring_attention
+
+    from brpc_tpu.ici.collective import Collectives
+    from brpc_tpu.ici.ring_attention import reference_attention
+
+    mesh = IciMesh.default()
+    n = mesh.size
+    if n < 2 or seq % n:
+        return {}
+    block = seq // n
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (seq, heads, dim), jnp.float32)
+    k = jax.random.normal(kk, (seq, heads, dim), jnp.float32)
+    v = jax.random.normal(kv, (seq, heads, dim), jnp.float32)
+    coll = Collectives(mesh)
+    shard = lambda x: coll.shard(x.reshape(n, block, heads, dim))
+    qs, ks, vs = shard(q), shard(k), shard(v)
+
+    dense_j = jax.jit(reference_attention)
+    out_ring = ring_attention(qs, ks, vs, mesh)       # compile + warm
+    out_dense = dense_j(q, k, v)
+    jax.block_until_ready((out_ring, out_dense))
+    import numpy as np
+    err = float(np.max(np.abs(np.asarray(out_ring).reshape(q.shape)
+                              - np.asarray(out_dense))))
+    assert err < 1e-3, f"ring attention diverged from dense: {err}"
+
+    def time_it(fn, reps=8):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out)
+        return seq * reps / (time.perf_counter() - t0)
+
+    return {"devices": n,
+            "platform": jax.devices()[0].platform,
+            "seq": seq,
+            "ring_tokens_per_s": time_it(
+                lambda: ring_attention(qs, ks, vs, mesh)),
+            "dense_tokens_per_s": time_it(lambda: dense_j(q, k, v)),
+            "max_abs_err_vs_dense": err,
+            "kv_bytes_per_chip_ring": 2 * block * heads * dim * 4,
+            "kv_bytes_per_chip_dense": 2 * seq * heads * dim * 4}
 
 
 def bench_allreduce_gbps(size_mb: int = 64):
@@ -786,14 +865,11 @@ def main() -> None:
     # real chips this measures the real ICI hop; a 1-chip host falls
     # back to an 8-virtual-device CPU mesh — same relocation code path,
     # host-memory byte-move, labeled as such.
-    reloc = _run_subbench("relocation") if device_ok else {}
-    if not reloc.get("devices"):
-        reloc = _run_subbench("relocation", env={
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
-        if reloc.get("devices"):
-            reloc["platform"] = "cpu_mesh_virtual"
+    reloc = _run_mesh_subbench("relocation") if device_ok else {}
     print(f"# relocation tier: {reloc}", file=sys.stderr)
+    # long-context leg: sequence-parallel ring attention vs dense
+    ring = _run_mesh_subbench("ring_attention") if device_ok else {}
+    print(f"# ring attention: {ring}", file=sys.stderr)
     try:
         qps = bench_qps()
         print(f"# python-stack qps: {qps}", file=sys.stderr)
@@ -922,6 +998,15 @@ def main() -> None:
             reloc.get("nonresident_gbps_4m", -1.0), 3),
         "reloc_resident_gbps_4m": round(
             reloc.get("resident_gbps_4m", -1.0), 3),
+        "ring_attn_platform": ring.get("platform", "unavailable"),
+        "ring_attn_tokens_per_s": round(
+            ring.get("ring_tokens_per_s", -1.0), 0),
+        "ring_attn_dense_tokens_per_s": round(
+            ring.get("dense_tokens_per_s", -1.0), 0),
+        "ring_attn_kv_frac_per_chip": (round(
+            ring["kv_bytes_per_chip_ring"]
+            / ring["kv_bytes_per_chip_dense"], 3)
+            if ring.get("devices") else -1.0),
         "python_stack_qps": round(qps.get("qps", 0.0), 0),
         "ici_native_plane_qps": round(iqps.get("qps", -1.0), 0),
         "streaming_mbps": round(strm.get("stream_mbps", 0.0), 1),
@@ -963,7 +1048,8 @@ if __name__ == "__main__":
         import json as _json
         fn = {"echo": bench_echo_p50,
               "allreduce": bench_allreduce_gbps,
-              "relocation": bench_relocation}[sys.argv[2]]
+              "relocation": bench_relocation,
+              "ring_attention": bench_ring_attention}[sys.argv[2]]
         print(_json.dumps(fn()))
     else:
         main()
