@@ -46,13 +46,21 @@ class TaskIterator(Generic[T]):
 
 class ExecutionQueue(Generic[T]):
     def __init__(self, handler: Callable[[TaskIterator[T]], None],
-                 in_place_if_possible: bool = False):
+                 in_place_if_possible: bool = False,
+                 linger_s: float = 0.0):
         self._handler = handler
         self._queue: Deque[Any] = collections.deque()
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._consuming = False
         self._stopped = False
         self._joined = threading.Event()
+        # linger_s > 0: a drained consumer waits this long for more work
+        # before retiring.  Steady serial producers (stream delivery: one
+        # frame per claim) otherwise pay a tasklet spawn + park/wake per
+        # task; the linger batches them at the cost of occupying one pool
+        # worker while traffic is flowing.
+        self._linger = linger_s
 
     def execute(self, task: T) -> int:
         return self._push(task)
@@ -73,6 +81,7 @@ class ExecutionQueue(Generic[T]):
             if is_stop:
                 self._stopped = True
             self._queue.append(item)
+            self._cv.notify()
             if not self._consuming:
                 self._consuming = True
                 become_consumer = True
@@ -83,6 +92,8 @@ class ExecutionQueue(Generic[T]):
     def _consume(self) -> None:
         while True:
             with self._lock:
+                if not self._queue and self._linger and not self._stopped:
+                    self._cv.wait(self._linger)
                 if not self._queue:
                     self._consuming = False
                     if self._stopped:

@@ -28,6 +28,13 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 DEFAULT_BLOCK_SIZE = 8192
 
+# append() wraps immutable ``bytes`` at least this large as a USER block
+# instead of copying into 8 KiB host slabs: a 256 KiB streaming chunk
+# used to become 32 slab copies on every hop (sender pack + receiver
+# inbox).  Only exact ``bytes`` qualify — bytearray/memoryview callers
+# may mutate after append, and a shared ref would corrupt the buffer.
+ZERO_COPY_BYTES_MIN = 16 * 1024
+
 HOST = 0
 USER = 1
 DEVICE = 2
@@ -144,6 +151,9 @@ class IOBuf:
             return
         if isinstance(data, str):
             data = data.encode("utf-8")
+        if type(data) is bytes and len(data) >= ZERO_COPY_BYTES_MIN:
+            self.append_user_data(data)
+            return
         mv = memoryview(data)
         n = len(mv)
         if n == 0:

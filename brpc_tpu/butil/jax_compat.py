@@ -1,0 +1,45 @@
+"""Version shims for jax APIs the fabric depends on.
+
+The compute-path modules are written against current jax (``jax.shard_map``
+with ``check_vma=``); older builds in some images ship the same function as
+``jax.experimental.shard_map.shard_map`` with the flag under its old name
+``check_rep=``.  Routing every call site through here keeps them
+source-identical to the modern API while still running on 0.4.x images.
+"""
+from __future__ import annotations
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` on current jax; its pre-rename spelling
+    ``TPUCompilerParams`` on older builds, with any fields that class
+    does not know yet (e.g. ``has_side_effects``) dropped — the kernels
+    here all produce consumed outputs, so losing the side-effect hint
+    cannot DCE them."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        import inspect
+        cls = pltpu.TPUCompilerParams
+        allowed = set(inspect.signature(cls).parameters)
+        kwargs = {k: v for k, v in kwargs.items() if k in allowed}
+    return cls(**kwargs)
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` on current jax; the ``jax.experimental`` spelling
+    on older builds.  ``check_vma`` translates to its pre-rename spelling
+    ``check_rep`` by SIGNATURE, not import location — intermediate builds
+    exposed top-level ``jax.shard_map`` while still using the old name."""
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    if "check_vma" in kwargs:
+        import inspect
+        try:
+            params = inspect.signature(_sm).parameters
+        except (TypeError, ValueError):
+            params = {}
+        if "check_vma" not in params and "check_rep" in params:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _sm(f, **kwargs)

@@ -17,7 +17,7 @@ from typing import Callable, Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_ici_call2"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_sendv"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -299,6 +299,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_fab_send.restype = ctypes.c_int
     lib.brpc_tpu_fab_send.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
                                       u8p, ctypes.c_uint64]
+    lib.brpc_tpu_fab_sendv.restype = ctypes.c_int
+    lib.brpc_tpu_fab_sendv.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
     lib.brpc_tpu_fab_recv.restype = ctypes.c_int
     lib.brpc_tpu_fab_recv.argtypes = [
         ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int64,

@@ -218,11 +218,19 @@ class FabricNode:
         # data plane: transfer server (explicit TCP transport addresses —
         # the same-host "local" bulk transport is not usable in sandboxed
         # containers, and TCP is the portable choice; on real pods the
-        # premapped DMA path takes over)
-        from jax.experimental import transfer
-        backend = jax.local_devices()[0].client
-        self._xfer_server = transfer.start_transfer_server(
-            backend, f"{host_ip}:0", [f"{host_ip}:0"])
+        # premapped DMA path takes over).  OPTIONAL: older jax builds
+        # ship no jax.experimental.transfer at all — the fabric then
+        # rides the native bulk plane for every payload (device refs
+        # included), or inlines d2h bytes on the control channel when
+        # that is missing too, and publishes no "xfer" contact.
+        try:
+            from jax.experimental import transfer
+        except ImportError:
+            transfer = None
+        if transfer is not None:
+            backend = jax.local_devices()[0].client
+            self._xfer_server = transfer.start_transfer_server(
+                backend, f"{host_ip}:0", [f"{host_ip}:0"])
         # control plane listener
         self._ctrl_listener = _pysocket.socket()
         self._ctrl_listener.setsockopt(_pysocket.SOL_SOCKET,
@@ -251,10 +259,11 @@ class FabricNode:
         # the handshake publication (GID/QPN analogue)
         info = {
             "ctrl": self.ctrl_addr,
-            "xfer": self._xfer_server.address(),
             "devices": [i for i, d in enumerate(jax.devices())
                         if d.process_index == self.process_id],
         }
+        if self._xfer_server is not None:
+            info["xfer"] = self._xfer_server.address()
         if self.bulk_addr:
             info["bulk"] = self.bulk_addr
             if self.bulk_uds:
@@ -267,7 +276,7 @@ class FabricNode:
                                json.dumps(info))
         log.info("fabric: process %d/%d up ctrl=%s xfer=%s devices=%s",
                  self.process_id, self.num_processes, info["ctrl"],
-                 info["xfer"], info["devices"])
+                 info.get("xfer", "<unavailable>"), info["devices"])
 
     @staticmethod
     def _derive_host_ip(coordinator_address: Optional[str]) -> str:
@@ -322,6 +331,10 @@ class FabricNode:
         with self._xfer_lock:
             conn = self._xfer_conns.get(pid)
             if conn is None:
+                if self._xfer_server is None:
+                    raise ConnectionError(
+                        "transfer server unavailable in this jax build "
+                        "(jax.experimental.transfer missing)")
                 conn = self._xfer_server.connect(self.peer_info(pid)["xfer"])
                 self._xfer_conns[pid] = conn
             return conn
@@ -409,15 +422,23 @@ class FabricNode:
             else:
                 self._reap_parked_bulk(bulk_key)
 
+    # A refused handshake's parked bulk conn is reaped with a short
+    # NONZERO claim wait: the client dialed the bulk plane before sending
+    # HELLO, but the acceptor thread may not have read the <klen><key>
+    # binding header yet — a zero-timeout claim would miss that conn and
+    # leak its fd + reader thread in Listener::pending forever (ADVICE r5).
+    # 2 s comfortably covers the header race; the reap runs on the
+    # per-handshake daemon thread, so the wait blocks no one else.
+    _REAP_CLAIM_US = 2_000_000
+
     def _reap_parked_bulk(self, bulk_key: Optional[str]) -> None:
         """Claim-and-close a bulk conn the client parked for a handshake
-        that is now being refused (zero wait: it either arrived already
-        or the client is gone and its connect will fail on its own)."""
+        that is now being refused."""
         if not bulk_key or not self._bulk_listener \
                 or self._bulk_lib is None:
             return
         h = self._bulk_lib.brpc_tpu_fab_accept(
-            self._bulk_listener, bulk_key.encode(), 0)
+            self._bulk_listener, bulk_key.encode(), self._REAP_CLAIM_US)
         if h:
             self._bulk_lib.brpc_tpu_fab_conn_close(h)
 
@@ -495,6 +516,14 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._inbox = IOBuf()
         self._inbox_lock = threading.Lock()
         self.read_chunk_hint = 1 << 26    # _do_read cuts, never allocates
+        # input events run the parse loop INLINE on the delivering thread
+        # (the control read loop for host frames): a tasklet spawn +
+        # park/wake per frame measured ~1/3 of the per-frame fixed cost
+        # on the streaming tier.  Order-sensitive stream frames are
+        # consumed inside the parse loop as always; full RPC messages
+        # are queued to tasklets (queue_last_message) so user handlers
+        # can never stall the control channel's CREDIT/PULLED processing.
+        self.queue_last_message = True
         self._consumed_unacked = 0     # credits not yet returned (batched)
         self._peer_closed = False      # reader-visible EOF (ordered)
         self._conn_dead = False        # writer-visible death (immediate)
@@ -505,6 +534,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._reader: Optional[threading.Thread] = None
         self._bulk = 0                         # native bulk conn handle
         self._blib = None
+        # kind-1 transfer-server staging needs the module on BOTH ends:
+        # ours to stage, the peer's to pull.  A peer whose jax build
+        # lacks jax.experimental.transfer publishes no "xfer" contact —
+        # staging to it would fail its first pull, so such pairs use the
+        # inline d2h fallback instead (review finding)
+        self._xfer_usable = (node._xfer_server is not None
+                             and "xfer" in node.peer_info(peer_pid))
 
     def _attach_bulk(self, lib, handle: int) -> None:
         """Bind the native bulk data-plane connection (both ends hold one
@@ -566,6 +602,15 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
 
         for i in range(frame.backing_block_num()):
             r = frame.backing_block(i)
+            if (r.block.kind == DEVICE and not self._bulk
+                    and not self._xfer_usable):
+                # neither fast plane exists for this socket pair: the
+                # device payload crosses as plain host bytes on the
+                # control channel (d2h here, h2d on first use at the
+                # peer — the same residency contract as host delivery)
+                pending_host.append(
+                    bytes(r.block.host_view(r.offset, r.length)))
+                continue
             if r.block.kind == DEVICE:
                 flush_host()
                 arr = r.block.data
@@ -632,6 +677,78 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         rc = self._blib.brpc_tpu_fab_send(self._bulk, uuid, ptr, n)
         if rc != 0:
             raise ConnectionError("fabric bulk channel closed")
+
+    # ---- stream fast plane ---------------------------------------------
+    # Stream DATA frames above ici_stream_bulk_threshold post their
+    # payload here (rpc/stream.py): bytes ride the dedicated bulk
+    # connection under a reserved uuid, only a 16-byte descriptor rides
+    # the control channel.  Custody is synchronous-send (the kernel owns
+    # a copy when sendv returns) and delivery is zero-copy host-resident
+    # (the claimed IOBuf wraps the native receive buffer) — the same
+    # contract as the kind-2/3 attachment path above.
+
+    def stream_bulk_begin(self) -> int:
+        """Reserve a bulk uuid for one stream DATA frame; 0 when no bulk
+        plane is bound (the caller keeps the inline path)."""
+        if not self._bulk:
+            return 0
+        return self.node.next_uuid()
+
+    def stream_bulk_send(self, uuid: int, frame: IOBuf) -> None:
+        """Gather-send the frame's blocks as ONE uuid-tagged bulk frame,
+        zero-copy: block buffers are handed to writev as-is (fab_sendv
+        drops the GIL; synchronous-send custody)."""
+        import numpy as np
+        nblocks = frame.backing_block_num()
+        ptrs = (ctypes.c_void_p * nblocks)()
+        lens = (ctypes.c_uint64 * nblocks)()
+        keep = []                      # buffers must outlive the write
+        n = 0
+        for i in range(nblocks):
+            r = frame.backing_block(i)
+            if not r.length:
+                continue
+            a = np.frombuffer(r.block.host_view(r.offset, r.length),
+                              dtype=np.uint8)
+            keep.append(a)
+            ptrs[n] = a.ctypes.data
+            lens[n] = r.length
+            n += 1
+        rc = self._blib.brpc_tpu_fab_sendv(self._bulk, uuid, ptrs, lens, n)
+        if rc != 0:
+            raise ConnectionError("fabric bulk channel closed")
+
+    def stream_bulk_abort(self) -> None:
+        """Sever the bulk plane after a descriptor went out whose payload
+        never will (sender-side Python failure): the peer's pending claim
+        must fail promptly, not sit out the full claim timeout.  Bulk
+        death is socket death on the peer, matching the claim-failure
+        contract."""
+        self._close_bulk()
+
+    def stream_bulk_claim(self, uuid: int, length: int) -> IOBuf:
+        """Claim a stream DATA frame's bulk bytes as a zero-copy IOBuf:
+        the USER block wraps the native receive buffer, released back to
+        the conn's pool when the last ref dies (_NativeBufOwner)."""
+        buf = IOBuf()
+        buf.append_user_data(memoryview(self._claim_zero_copy(uuid, length)))
+        return buf
+
+    def _claim_zero_copy(self, uuid: int, expect_len: int):
+        """Claim a bulk frame of exactly ``expect_len`` bytes as a ctypes
+        array WRAPPING the native receive buffer, with the exactly-once
+        release chained through ``._owner`` — the one custody-critical
+        sequence shared by stream claims and kind-2 host delivery."""
+        ptr, n = self._bulk_claim(uuid)
+        if n != expect_len:
+            self._blib.brpc_tpu_fab_buf_release(self._bulk, ptr, n)
+            raise ConnectionError(
+                f"bulk frame {uuid:#x}: {n} bytes, descriptor "
+                f"said {expect_len}")
+        ca = (ctypes.c_uint8 * n).from_address(
+            ctypes.addressof(ptr.contents))
+        ca._owner = _NativeBufOwner(self._blib, self._bulk, ptr, n)
+        return ca
 
     # ---- read path -----------------------------------------------------
     def _read_loop(self) -> None:
@@ -752,7 +869,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     pass
             with self._inbox_lock:
                 self._inbox.append(buf)
-            self.start_input_event()
+            self.start_input_event(inline=True)
 
         # ordered per-socket commit — a host-only frame must not jump
         # ahead of an earlier device-bearing frame still in flight
@@ -804,24 +921,14 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         manually when device_put consumed an array it cannot alias
         unsafely (an owned copy)."""
         import numpy as np
-        ptr, n = self._bulk_claim(uuid)
-        if n != length:
-            self._blib.brpc_tpu_fab_buf_release(self._bulk, ptr, n)
-            raise ConnectionError(
-                f"bulk frame {uuid:#x}: {n} bytes, descriptor "
-                f"said {length}")
+        ca = self._claim_zero_copy(uuid, length)
+        host = np.frombuffer(ca, dtype=np.uint8).view(
+            np.dtype(dt)).reshape(shape)
         if _flags.get_flag("ici_fabric_host_delivery"):
-            ca = (ctypes.c_uint8 * n).from_address(
-                ctypes.addressof(ptr.contents))
-            ca._owner = _NativeBufOwner(self._blib, self._bulk, ptr, n)
-            return np.frombuffer(ca, dtype=np.uint8).view(
-                np.dtype(dt)).reshape(shape)
+            return host
         import jax
-        try:
-            view = np.ctypeslib.as_array(ptr, shape=(n,))
-            np_arr = view.view(np.dtype(dt)).reshape(shape).copy()
-        finally:
-            self._blib.brpc_tpu_fab_buf_release(self._bulk, ptr, n)
+        np_arr = host.copy()          # the owned copy device_put may alias
+        del host, ca                  # last refs: owner releases the buffer
         return jax.device_put(np_arr, local_device)
 
     def _on_pulled(self, uuid: int) -> None:

@@ -113,11 +113,21 @@ def _relocate(key: int, target_dev: int) -> int:
         if arr is None:
             return 0
         target = IciMesh.default().device(target_dev)
-        try:
-            if target in arr.devices():
-                return key                       # resident: pure ref pass
-        except Exception:
-            pass
+        if not hasattr(arr, "devices"):
+            # host-delivered fabric bulk payload (a ctypes-backed numpy
+            # view over the native receive buffer) being forwarded into
+            # an in-process call: detach into an owned copy first —
+            # device_put zero-copy ALIASES such views WITHOUT retaining
+            # them, and the native pool recycles the buffer under the
+            # alias (same discipline as transport.py _relocate)
+            import numpy as np
+            arr = np.array(arr, copy=True)
+        else:
+            try:
+                if target in arr.devices():
+                    return key                   # resident: pure ref pass
+            except Exception:
+                pass
         moved = jax.device_put(arr, target)      # HBM→HBM over ICI
         return _registry.put(moved)
     except Exception as e:                       # never raise across ctypes

@@ -39,7 +39,8 @@ def _interpret_default() -> bool:
 def _build_all_gather(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+    from ..butil.jax_compat import shard_map, tpu_compiler_params
     from jax.sharding import PartitionSpec as P
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
@@ -83,8 +84,8 @@ def _build_all_gather(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
             ],
-            compiler_params=pltpu.CompilerParams(has_side_effects=True,
-                                                 collective_id=0),
+            compiler_params=tpu_compiler_params(has_side_effects=True,
+                                                collective_id=0),
             interpret=interpret,
         )(x_local[0])
         return out[None]
@@ -96,7 +97,8 @@ def _build_all_gather(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
 def _build_all_reduce(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+    from ..butil.jax_compat import shard_map, tpu_compiler_params
     from jax.sharding import PartitionSpec as P
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
@@ -143,8 +145,8 @@ def _build_all_reduce(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
             ],
-            compiler_params=pltpu.CompilerParams(has_side_effects=True,
-                                                 collective_id=1),
+            compiler_params=tpu_compiler_params(has_side_effects=True,
+                                                collective_id=1),
             interpret=interpret,
         )(x_local[0])
         return out[None]

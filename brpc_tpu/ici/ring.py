@@ -33,7 +33,7 @@ def ring_all_reduce(x, mesh: Optional[IciMesh] = None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..butil.jax_compat import shard_map
 
     mesh = mesh or IciMesh.default()
     n = mesh.size

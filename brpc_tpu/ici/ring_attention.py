@@ -54,7 +54,8 @@ def ring_attention(q, k, v, mesh: Optional[IciMesh] = None, causal: bool = False
 def _build_ring_attention(mesh: IciMesh, block_shape, dtype, causal: bool):
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+    from ..butil.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.size
@@ -135,7 +136,8 @@ def ulysses_attention(q, k, v, mesh: Optional[IciMesh] = None):
 def _build_ulysses(mesh: IciMesh, block_shape, dtype):
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+    from ..butil.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.size

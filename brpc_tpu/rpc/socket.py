@@ -305,9 +305,16 @@ class Socket:
             if not more:
                 # readership released: the last message runs in this tasklet
                 # for cache locality, but a slow handler now only blocks
-                # itself — new readiness spawns a fresh reader
+                # itself — new readiness spawns a fresh reader.  Sockets
+                # that parse INLINE on their delivering thread (fabric:
+                # the control read loop) must never run a handler there —
+                # a slow handler would stall CREDIT/PULLED processing for
+                # the whole connection — so they queue it instead
                 if last is not None and self.messenger is not None:
-                    self.messenger.process_in_place(last, self)
+                    if getattr(self, "queue_last_message", False):
+                        self.messenger._queue_message(*last, self)
+                    else:
+                        self.messenger.process_in_place(last, self)
                 return
             # more events pending: keep readership, hand the holdover to its
             # own tasklet and loop back to read

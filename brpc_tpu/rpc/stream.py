@@ -19,10 +19,12 @@ client parse paths.
 """
 from __future__ import annotations
 
+import struct
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..butil import flags as _flags
 from ..butil.iobuf import IOBuf
 from ..butil.resource_pool import ResourcePool
 from ..bthread.butex import Butex
@@ -33,8 +35,24 @@ FRAME_DATA = 0
 FRAME_FEEDBACK = 1
 FRAME_RST = 2
 FRAME_CLOSE = 3
+# DATA whose payload rode the fabric BULK plane: the control frame body
+# is a 16-byte <u64 bulk uuid><u64 byte length> descriptor, the payload
+# bytes move out-of-band on the dedicated bulk connection
+# (native/fabric.cpp).  frame_type 4 is the tpu_std stream handshake.
+FRAME_DATA_BULK = 5
+
+_BULK_DESC = struct.Struct("<QQ")
 
 DEFAULT_MAX_BUF_SIZE = 2 * 1024 * 1024
+
+# DATA frames at least this large ride the bulk fast plane when the
+# socket binds one (ici:// cross-process FabricSocket); below it the
+# descriptor + claim round trip costs more than the inline copy.  The
+# stream's credit window and seq-ordered delivery are unchanged either
+# way — only the byte transport differs.
+_flags.define_flag("ici_stream_bulk_threshold", 64 * 1024,
+                   "min stream DATA frame bytes routed over the fabric "
+                   "bulk plane", _flags.positive_integer)
 
 
 class StreamOptions:
@@ -79,6 +97,19 @@ class Stream:
         self._last_feedback = 0
         self.closed = False
         self._seq = 0
+        self._sock_failed_cb = None     # registered at mark_connected
+        # guards the connected/closed transitions and the lazy _exec
+        # creation: on_remote_close is runnable from ANY thread (socket
+        # on_failed callbacks), and mark_connected has two concurrent
+        # callers (the RPC response tasklet and a racing first stream
+        # frame on the parse path) — unsynchronized check-then-act on
+        # either flag double-registers callbacks or double-fires
+        # on_closed (review findings)
+        self._state_lock = threading.Lock()
+        # serializes frame emission: seq assignment, the out-of-band bulk
+        # post, and the control write must stay one atomic step so frame
+        # k's bulk bytes can never trail frame k+1's descriptor
+        self._wire_lock = threading.Lock()
         self._exec: Optional[ExecutionQueue] = None
 
     # -- sender ---------------------------------------------------------
@@ -130,9 +161,19 @@ class Stream:
     _CLOSE_MARKER = object()
 
     def on_data(self, data: IOBuf) -> None:
-        if self._exec is None:
-            self._exec = ExecutionQueue(self._consume_batch)
-        self._exec.execute(data)
+        with self._state_lock:
+            if self.closed:
+                return              # frame raced a cross-thread close:
+                # on_closed already fired (or is firing), so delivering
+                # now would violate the no-messages-after-closed contract
+            if self._exec is None:
+                # the linger keeps one consumer hot while frames stream
+                # in serially (one per claim on the fabric path) —
+                # without it every frame pays a tasklet spawn + park/wake
+                self._exec = ExecutionQueue(self._consume_batch,
+                                            linger_s=0.005)
+            ex = self._exec
+        ex.execute(data)
 
     def _consume_batch(self, it) -> None:
         msgs = []
@@ -175,15 +216,39 @@ class Stream:
         return self.connected
 
     def mark_connected(self, remote_sid: int, socket) -> None:
-        self.remote_sid = remote_sid
-        self.socket = socket
-        self.connected = True
+        with self._state_lock:
+            if self.connected or self.closed:
+                # connected: both the RPC-response path and a racing
+                # first stream frame call this — a second registration
+                # would append a duplicate on_failed callback that
+                # close() can never remove.  closed: the user closed the
+                # stream before the handshake response landed — a
+                # registration now would never be removed (review
+                # findings)
+                return
+            self.remote_sid = remote_sid
+            self.socket = socket
+            self.connected = True
+            # a dying host connection must close every stream riding it —
+            # without this, a socket failure (EOF, bulk-plane death,
+            # parse error) would strand the stream's consumer waiting
+            # forever for data or on_closed.  The callback is REMOVED
+            # again when the stream closes; registration happens INSIDE
+            # the state lock so a racing close cannot null the slot
+            # between it and the append (review findings)
+            self._sock_failed_cb = lambda _s: self.on_remote_close()
+            socket.on_failed_callbacks.append(self._sock_failed_cb)
+        if socket.failed:                # lost the race with set_failed
+            self.on_remote_close()
         self._conn_butex.wake_all_and_set(1)
 
     def close(self) -> None:
-        if self.closed:
-            return
-        self.closed = True
+        with self._state_lock:
+            if self.closed:
+                return
+            self.closed = True           # exactly-once transition: the
+            # losing on_remote_close/close caller returns above instead
+            # of double-firing _on_closed_local (review finding)
         if self.connected:
             try:
                 self._send_frame(FRAME_CLOSE, None)
@@ -192,11 +257,24 @@ class Stream:
         self._on_closed_local()
 
     def _on_closed_local(self) -> None:
+        with self._state_lock:
+            cb, self._sock_failed_cb = self._sock_failed_cb, None
+            sock = self.socket
+        if cb is not None and sock is not None:
+            try:
+                sock.on_failed_callbacks.remove(cb)
+            except ValueError:
+                pass                     # set_failed already consumed it
         self._writable_butex.wake_all_and_set(1)
-        if self._exec is not None:
+        with self._state_lock:
+            # self.closed is already True (set by every caller), so no
+            # NEW queue can appear after this read — on_data drops
+            # late frames instead
+            ex = self._exec
+        if ex is not None:
             # ordered after every queued data batch, then the queue stops
-            self._exec.execute(Stream._CLOSE_MARKER)
-            self._exec.stop()
+            ex.execute(Stream._CLOSE_MARKER)
+            ex.stop()
         else:
             h = self.options.handler
             if h is not None:
@@ -207,29 +285,93 @@ class Stream:
         _pool_remove(self.sid)
 
     def on_remote_close(self) -> None:
-        if not self.closed:
+        with self._state_lock:
+            if self.closed:
+                return
             self.closed = True
-            self._on_closed_local()
+        self._on_closed_local()
 
     # -- wire -----------------------------------------------------------
     def _send_frame(self, frame_type: int, data: Optional[IOBuf],
                     consumed_bytes: int = 0) -> None:
         from ..proto import rpc_meta_pb2 as meta_pb
         from ..policy.tpu_std import pack_frame
-        if self.socket is None:
+        sock = self.socket
+        if sock is None:
             raise ConnectionError("stream not connected")
+        payload = data if data is not None else IOBuf()
+        # large DATA payloads ride the bulk fast plane when the socket
+        # binds one: the bytes go out-of-band under a reserved uuid and
+        # only a 16-byte descriptor rides the control channel.  Sockets
+        # without a bulk plane (mem://, tcp://, in-process ici, or a
+        # fabric peer that lacks the native core) return uuid 0 and the
+        # frame stays inline — byte-identical to the pre-bulk wire.
+        bulk_uuid = 0
+        if (frame_type == FRAME_DATA and len(payload)
+                >= _flags.get_flag("ici_stream_bulk_threshold")):
+            begin = getattr(sock, "stream_bulk_begin", None)
+            if begin is not None:
+                bulk_uuid = begin()
         meta = meta_pb.RpcMeta()
         ss = meta.stream_settings
         ss.stream_id = self.remote_sid       # addressed to receiver's id
         ss.remote_stream_id = self.sid
-        ss.frame_type = frame_type
-        self._seq += 1
-        ss.frame_seq = self._seq
         if consumed_bytes:
             ss.consumed_bytes = consumed_bytes
-        payload = data if data is not None else IOBuf()
-        rc = self.socket.write(pack_frame(meta, payload))
+        bulk_exc = None
+        with self._wire_lock:
+            self._seq += 1
+            ss.frame_seq = self._seq
+            if bulk_uuid:
+                # descriptor FIRST, bulk bytes second: the receiver then
+                # parses the frame and parks in the claim while the bulk
+                # writev is still draining, overlapping its per-frame
+                # Python work with the transfer.  A bulk send that fails
+                # after the descriptor went out kills the bulk conn,
+                # which fails the peer's claim (-2) and with it the
+                # socket — no silent gap in the byte stream.
+                ss.frame_type = FRAME_DATA_BULK
+                desc = IOBuf(_BULK_DESC.pack(bulk_uuid, len(payload)))
+                rc = sock.write(pack_frame(meta, desc))
+                if rc == 0:
+                    try:
+                        sock.stream_bulk_send(bulk_uuid, payload)
+                    except Exception as e:
+                        # descriptor went out but the payload never will:
+                        # the peer's claim fails when the dead bulk conn
+                        # cascades, but THIS end must not stay open with
+                        # the frame's phantom bytes held against the
+                        # window.  Handled OUTSIDE the wire lock —
+                        # close() re-enters _send_frame for FRAME_CLOSE
+                        # and the lock is not reentrant (review finding)
+                        bulk_exc = e
+            else:
+                ss.frame_type = frame_type
+                rc = sock.write(pack_frame(meta, payload))
+        if bulk_exc is not None:
+            # the descriptor is on the wire but the payload never went.
+            # A native write error already killed the bulk conn, but a
+            # PYTHON-side failure (e.g. materializing a device block)
+            # leaves it alive — sever it explicitly so the peer's pending
+            # claim fails promptly (-2) instead of stalling its control
+            # loop for the full claim timeout (review finding)
+            abort = getattr(sock, "stream_bulk_abort", None)
+            if abort is not None:
+                try:
+                    abort()
+                except Exception:
+                    pass
+            self.close()
+            raise bulk_exc
         if rc != 0:
+            if frame_type == FRAME_DATA:
+                # a refused DATA frame breaks the stream's byte sequence
+                # (and on the bulk path would orphan a parked frame
+                # through endless retries): fail the stream.  FEEDBACK is
+                # cumulative — a transiently overcrowded socket just
+                # re-reports with the next watermark, so it must NOT kill
+                # a healthy stream (review finding).
+                self.close()
             raise ConnectionError(f"stream write failed: {rc}")
 
 
@@ -266,16 +408,54 @@ def find_stream(sid: int) -> Optional[Stream]:
 
 
 def on_stream_frame(meta, body: IOBuf, socket) -> None:
-    """Entry from tpu_std for frames carrying stream_settings."""
+    """Entry from tpu_std for frames carrying stream_settings.  Runs in
+    the socket's reader-order consumption path (process_inline), so
+    frames — including bulk claims — are resolved in cut order, which IS
+    the stream's seq/byte order."""
     ss = meta.stream_settings
     s = find_stream(ss.stream_id)
     if s is None:
+        if ss.frame_type == FRAME_DATA_BULK:
+            _discard_bulk_frame(body, socket)
         return                           # stale frame for a closed stream
     if not s.connected:
         s.mark_connected(ss.remote_stream_id, socket)
     if ss.frame_type == FRAME_DATA:
         s.on_data(body)
+    elif ss.frame_type == FRAME_DATA_BULK:
+        uuid, blen = _BULK_DESC.unpack(body.to_bytes())
+        try:
+            data = socket.stream_bulk_claim(uuid, blen)
+        except Exception as e:
+            # the bulk plane died under the stream: dropping the frame
+            # would silently corrupt the byte stream, so the socket (the
+            # fabric contract: bulk death == socket death) and the
+            # stream both fail
+            from ..butil import logging as log
+            log.error("stream %d bulk frame %#x unclaimable: %s",
+                      s.sid, uuid, e)
+            try:
+                socket.set_failed(errors.EFAILEDSOCKET,
+                                  f"stream bulk claim failed: {e}")
+            finally:
+                s.on_remote_close()
+            return
+        s.on_data(data)
     elif ss.frame_type == FRAME_FEEDBACK:
         s.set_remote_consumed(ss.consumed_bytes)
     elif ss.frame_type in (FRAME_CLOSE, FRAME_RST):
         s.on_remote_close()
+
+
+def _discard_bulk_frame(body: IOBuf, socket) -> None:
+    """A bulk descriptor addressed to a closed stream still has its
+    payload parked in the native frame map — claim and drop it, or it
+    would pin a window's worth of receive buffers until the conn dies."""
+    claim = getattr(socket, "stream_bulk_claim", None)
+    if claim is None or len(body) != _BULK_DESC.size:
+        return
+    uuid, blen = _BULK_DESC.unpack(body.to_bytes())
+    try:
+        claim(uuid, blen)
+    except Exception:
+        pass

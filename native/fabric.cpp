@@ -19,11 +19,14 @@
 //   uuid-keyed map; Python claims each with brpc_tpu_fab_recv (blocking,
 //   timed) when the control-channel descriptor for that uuid arrives —
 //   the two channels race, so claim-by-uuid tolerates either order.
-// * Memory bound: receiver-side parked frames are bounded by the CONTROL
-//   channel's credit window — every bulk byte is counted against the
-//   fabric socket window (ici_socket_window_bytes) before the sender may
-//   transmit its descriptor, so at most one window of frames can be in
-//   flight per socket.
+// * Memory bound: receiver-side parked frames are bounded by credit
+//   windows.  Attachment frames count every bulk byte against the fabric
+//   socket window (ici_socket_window_bytes) before the sender may
+//   transmit its descriptor — at most one socket window in flight.
+//   Stream DATA frames (rpc/stream.py FRAME_DATA_BULK) are bounded by
+//   each stream's own sliding window (max_buf_size, consumed-bytes
+//   feedback), so the aggregate stream bound is PER-STREAM times the
+//   number of streams multiplexed on the socket, not a single cap.
 //
 // Setup handshake: the connector sends <u32 keylen><key> immediately
 // after connect; the acceptor parks the connection under that key and
@@ -41,6 +44,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <chrono>
@@ -82,11 +86,26 @@ static bool resolve_ipv4(const char* host, struct in_addr* out) {
   return true;
 }
 
-// Socket buffer sizes stay kernel-autotuned: explicit 8 MB bulk buffers
-// measured ~10% SLOWER end-to-end here (same cache-cold-slab effect the
-// TCP plane hit — see rpc.cpp set_nodelay) despite decoupling the
-// writer from the reader's drain pace.
-static void set_bulk_buffers(int) {}
+// Explicit 768 KB socket buffers on the UNIX-domain bulk plane, both
+// directions.  UDS buffers do NOT autotune (they sit at
+// net.core.*mem_default, ~208 KB here), so a 256 KB streaming frame
+// could never leave the sender's writev without the receiver draining
+// in lock-step — two forced context switches per frame on a shared
+// core (measured 494 MB/s on the stream tier).  768 KB decouples
+// writer from reader (682-715 MB/s) while keeping the in-flight
+// cold-data footprint small enough not to regress the 8 MB-chunk tier
+// (1.89-1.97 GB/s vs 1.72 autotuned; 8 MB explicit buffers measured
+// ~10% SLOWER there — the cache-cold-slab effect the TCP plane hit,
+// see rpc.cpp set_nodelay).  TCP conns (the cross-host path) keep
+// kernel autotuning: a fixed SO_RCVBUF would cap the receive window at
+// ~rcvbuf/RTT, a regression on any link whose BDP exceeds it (review
+// finding).
+static void set_bulk_buffers(int fd, bool uds) {
+  if (!uds) return;
+  int sz = 768 * 1024;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+}
 
 static bool read_full(int fd, uint8_t* p, uint64_t n) {
   while (n > 0) {
@@ -104,9 +123,12 @@ static bool read_full(int fd, uint8_t* p, uint64_t n) {
 }
 
 static bool write_full_iov(int fd, struct iovec* iov, int iovcnt) {
+  // writev rejects more than IOV_MAX segments per call (EINVAL) — the
+  // gather send path can exceed it with a many-block IOBuf frame
+  static constexpr int kIovBatch = 1024;  // <= IOV_MAX everywhere
   int cur = 0;
   while (cur < iovcnt) {
-    ssize_t w = ::writev(fd, iov + cur, iovcnt - cur);
+    ssize_t w = ::writev(fd, iov + cur, std::min(iovcnt - cur, kIovBatch));
     if (w < 0) {
       if (errno == EINTR) continue;
       return false;
@@ -243,6 +265,38 @@ struct BulkConn {
     return 0;
   }
 
+  // Gather variant of send(): one uuid frame assembled from n segments
+  // without a caller-side join — the streaming fast path hands the
+  // payload's IOBuf blocks over as-is (zero-copy all the way to the
+  // kernel).  Same custody contract as send().
+  int sendv(uint64_t uuid, const uint8_t* const* ptrs, const uint64_t* lens,
+            int n) {
+    uint64_t total = 0;
+    for (int i = 0; i < n; ++i) total += lens[i];
+    if (total > kMaxFrame) return -1;
+    uint8_t hdr[16];
+    memcpy(hdr, &uuid, 8);
+    memcpy(hdr + 8, &total, 8);
+    std::vector<struct iovec> iov;
+    iov.reserve((size_t)n + 1);
+    iov.push_back({hdr, 16});
+    for (int i = 0; i < n; ++i)
+      if (lens[i]) iov.push_back({(void*)ptrs[i], (size_t)lens[i]});
+    std::lock_guard<std::mutex> g(wmu);
+    {
+      std::lock_guard<std::mutex> g2(mu);
+      if (dead) return -1;
+    }
+    if (!write_full_iov(fd, iov.data(), (int)iov.size())) {
+      std::lock_guard<std::mutex> g2(mu);
+      dead = true;
+      cv.notify_all();
+      return -1;
+    }
+    bytes_out.fetch_add(total, std::memory_order_relaxed);
+    return 0;
+  }
+
   // 0 ok (ownership of *out transfers to caller — free with
   // brpc_tpu_buf_free); -1 timeout; -2 connection dead and the frame
   // never arrived.  A frame that arrived BEFORE death is still claimable
@@ -318,7 +372,7 @@ struct Listener {
         break;  // listener closed
       }
       if (tcp) set_nodelay(cfd);
-      set_bulk_buffers(cfd);
+      set_bulk_buffers(cfd, !tcp);
       // key handshake with a bound (a wedged connector must not stall
       // the acceptor forever; fabric peers are trusted, so inline with
       // a 15 s receive timeout is enough)
@@ -414,7 +468,7 @@ static std::shared_ptr<Listener> find_listener(uint64_t h) {
 
 // Sends the <u32 keylen><key> binding header on a fresh client fd and
 // registers the connection; 0 on failure.
-static uint64_t finish_connect(int fd, const char* key) {
+static uint64_t finish_connect(int fd, const char* key, bool uds) {
   uint32_t klen = (uint32_t)strlen(key);
   uint8_t hdr[4];
   memcpy(hdr, &klen, 4);
@@ -423,7 +477,7 @@ static uint64_t finish_connect(int fd, const char* key) {
     ::close(fd);
     return 0;
   }
-  set_bulk_buffers(fd);
+  set_bulk_buffers(fd, uds);
   auto c = std::make_shared<BulkConn>();
   c->fd = fd;
   c->start_reader();
@@ -513,7 +567,7 @@ uint64_t brpc_tpu_fab_connect_uds(const char* name, const char* key) {
     ::close(fd);
     return 0;
   }
-  return nfab::finish_connect(fd, key);
+  return nfab::finish_connect(fd, key, /*uds=*/true);
 }
 
 uint64_t brpc_tpu_fab_accept(uint64_t lh, const char* key,
@@ -543,7 +597,7 @@ uint64_t brpc_tpu_fab_connect(const char* host, int port, const char* key) {
     return 0;
   }
   nfab::set_nodelay(fd);
-  return nfab::finish_connect(fd, key);
+  return nfab::finish_connect(fd, key, /*uds=*/false);
 }
 
 int brpc_tpu_fab_send(uint64_t h, uint64_t uuid, const uint8_t* data,
@@ -551,6 +605,15 @@ int brpc_tpu_fab_send(uint64_t h, uint64_t uuid, const uint8_t* data,
   auto c = nfab::find_conn(h);
   if (c == nullptr) return -1;
   return c->send(uuid, data, len);
+}
+
+// Gather send: one uuid frame from n (ptr, len) segments — the stream
+// DATA fast path posts an IOBuf's blocks without joining them first.
+int brpc_tpu_fab_sendv(uint64_t h, uint64_t uuid, const uint8_t* const* ptrs,
+                       const uint64_t* lens, int n) {
+  auto c = nfab::find_conn(h);
+  if (c == nullptr) return -1;
+  return c->sendv(uuid, ptrs, lens, n);
 }
 
 int brpc_tpu_fab_recv(uint64_t h, uint64_t uuid, int64_t timeout_us,

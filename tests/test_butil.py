@@ -28,10 +28,32 @@ class TestIOBuf:
 
     def test_multiblock_spill(self):
         b = butil.IOBuf()
+        # mutable input MUST be copied into slabs (the caller may mutate
+        # after append) and spills across blocks
         payload = bytes(range(256)) * 100   # 25600 > 8192
-        b.append(payload)
+        mutable = bytearray(payload)
+        b.append(mutable)
         assert b.backing_block_num() >= 3
+        mutable[:] = b"\0" * len(mutable)
         assert b.to_bytes() == payload
+
+    def test_large_immutable_bytes_wrap_zero_copy(self):
+        from brpc_tpu.butil.iobuf import USER, ZERO_COPY_BYTES_MIN
+        payload = bytes(range(256)) * (ZERO_COPY_BYTES_MIN // 256)
+        b = butil.IOBuf()
+        b.append(payload)
+        # one USER block aliasing the bytes object — no slab copies
+        assert b.backing_block_num() == 1
+        r = b.backing_block(0)
+        assert r.block.kind == USER
+        assert r.block.data.obj is payload
+        assert b.to_bytes() == payload
+        # below the threshold stays on the slab path (merge-friendly)
+        small = butil.IOBuf()
+        small.append(b"x" * 100)
+        small.append(b"y" * 100)
+        assert small.backing_block_num() == 1
+        assert small.to_bytes() == b"x" * 100 + b"y" * 100
 
     def test_cut_and_pop(self):
         b = butil.IOBuf(b"0123456789")

@@ -631,3 +631,43 @@ class TestFaultInjectionOnFastPlane:
         finally:
             server.stop()
         assert native_plane.registry().live() == 0
+
+
+class TestRelocateCustody:
+    def test_relocate_detaches_ctypes_backed_views(self, mesh):
+        """ADVICE r5: _relocate used to jax.device_put ctypes-backed
+        numpy views (host-delivered fabric bulk payloads forwarded into
+        an in-process native-plane call) directly — device_put zero-copy
+        ALIASES such buffers without retaining them, so recycling the
+        native receive buffer corrupted the relocated payload.  The fix
+        detaches into an owned copy first (transport.py discipline)."""
+        import ctypes
+
+        import jax
+
+        n = 4096
+        # 64-byte-aligned backing memory, like the native plane's malloc'd
+        # receive buffers: XLA only zero-copy-aliases sufficiently aligned
+        # hosts, so an unaligned buffer would mask the bug
+        raw = (ctypes.c_uint8 * (n + 64))()
+        addr = ctypes.addressof(raw)
+        buf = (ctypes.c_uint8 * n).from_address(addr + (-addr) % 64)
+        np.ctypeslib.as_array(buf)[:] = np.arange(n, dtype=np.uint8) % 251
+        view = np.frombuffer(buf, dtype=np.uint8)   # what _bulk_claim_array
+        expect = view.copy()                        # hands to host delivery
+        reg = native_plane.registry()
+        key = reg.put(view)
+        new_key = 0
+        try:
+            new_key = native_plane._relocate(key, 0)
+            assert new_key != 0, "relocate failed"
+            assert new_key != key, "numpy view cannot be 'resident'"
+            moved = reg.peek(new_key)
+            jax.block_until_ready(moved)
+            # the native pool recycles the receive buffer under the view
+            ctypes.memset(buf, 0, n)
+            np.testing.assert_array_equal(np.asarray(moved), expect)
+        finally:
+            reg.release(key)
+            if new_key and new_key != key:
+                reg.release(new_key)

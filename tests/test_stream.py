@@ -256,3 +256,205 @@ class TestStreamingRealTransports:
         server.add_service(StreamingEchoService())
         assert server.start("ici://61") == 0
         self._run_roundtrip(server, "ici://61")
+
+
+class _FakeBulkWire:
+    """The shared uuid->bytes frame map of a bulk connection pair.  The
+    real claim BLOCKS until the frame is parked (descriptors are sent
+    before the bulk bytes); this synchronous fake emulates that by
+    deferring descriptor delivery until the matching park."""
+
+    def __init__(self):
+        self.parked = {}
+        self.deferred = []      # (meta, body, target_sock) FIFO
+
+
+class _FakeBulkSocket:
+    """One end of an in-memory socket pair exposing the fabric bulk
+    stream API (stream_bulk_begin/send/claim) — pins the rpc/stream.py
+    routing contract without spawning a 2-process fabric."""
+
+    def __init__(self, wire):
+        self.wire = wire
+        self.peer_sock = None        # frames written here are parsed and
+        self.bulk_sends = 0          # delivered to the peer's streams
+        self.inline_data_frames = 0
+        self._next_uuid = 0
+        self.failed = False
+        self.on_failed_callbacks = []
+
+    def stream_bulk_begin(self):
+        self._next_uuid += 1
+        return self._next_uuid
+
+    def stream_bulk_send(self, uuid, frame):
+        from brpc_tpu.rpc import stream as stream_mod
+        from brpc_tpu.rpc.stream import on_stream_frame
+        self.bulk_sends += 1
+        self.wire.parked[uuid] = frame.to_bytes()
+        # deliver deferred descriptors whose bytes are now parked, in
+        # arrival order (stop at the first still-unparked one)
+        while self.wire.deferred:
+            meta, body, target = self.wire.deferred[0]
+            uuid2, _ = stream_mod._BULK_DESC.unpack(body.to_bytes())
+            if uuid2 not in self.wire.parked:
+                break
+            self.wire.deferred.pop(0)
+            on_stream_frame(meta, body, target)
+
+    def stream_bulk_claim(self, uuid, length):
+        data = self.wire.parked.pop(uuid)
+        assert len(data) == length, (len(data), length)
+        return IOBuf(data)
+
+    def set_failed(self, *a):
+        self.failed = True
+
+    def write(self, buf):
+        from brpc_tpu.policy import tpu_std
+        from brpc_tpu.rpc import stream as stream_mod
+        from brpc_tpu.rpc.stream import on_stream_frame
+        src = IOBuf()
+        src.append(buf)
+        while len(src):
+            res = tpu_std.parse(src, self, False, None)
+            msg = res.message
+            ss = msg.meta.stream_settings
+            if ss.frame_type == 0 and len(msg.body):
+                self.inline_data_frames += 1
+            if (ss.frame_type == stream_mod.FRAME_DATA_BULK
+                    and len(msg.body) == stream_mod._BULK_DESC.size):
+                uuid, _ = stream_mod._BULK_DESC.unpack(msg.body.to_bytes())
+                if uuid not in self.wire.parked:
+                    # bytes not parked yet (descriptor-first wire order):
+                    # the real claim would block; defer delivery
+                    self.wire.deferred.append(
+                        (msg.meta, msg.body, self.peer_sock))
+                    continue
+            on_stream_frame(msg.meta, msg.body, self.peer_sock)
+        return 0
+
+
+class TestStreamBulkRouting:
+    """DATA frames split by ici_stream_bulk_threshold: at-or-above rides
+    the bulk plane as a descriptor frame, below stays inline — with seq
+    order, feedback, and close untouched by the split."""
+
+    def _pair(self, recv_handler, recv_max_buf=64 * 1024):
+        from brpc_tpu.rpc import stream as stream_mod
+        wire = _FakeBulkWire()
+        a, b = _FakeBulkSocket(wire), _FakeBulkSocket(wire)
+        a.peer_sock, b.peer_sock = b, a
+        send = stream_mod.Stream(
+            rpc.StreamOptions(max_buf_size=64 << 20), is_client=True)
+        send.sid = stream_mod._streams.get_resource(send)
+        recv = stream_mod.Stream(
+            rpc.StreamOptions(handler=recv_handler,
+                              max_buf_size=recv_max_buf), is_client=False)
+        recv.sid = stream_mod._streams.get_resource(recv)
+        send.mark_connected(recv.sid, a)
+        recv.mark_connected(send.sid, b)
+        return send, recv, a, b, wire
+
+    def test_routes_by_threshold_and_preserves_order(self):
+        from brpc_tpu.butil import flags
+        threshold = flags.get_flag("ici_stream_bulk_threshold")
+        collector = Collector()
+        send, recv, a, b, wire = self._pair(collector)
+        small = b"s" * 512
+        big = bytes(range(256)) * (threshold // 256 + 1)
+        try:
+            assert send.write(IOBuf(small)) == 0
+            assert send.write(IOBuf(big)) == 0
+            assert send.write(IOBuf(small)) == 0
+            deadline = time.time() + 10
+            while len(collector.messages) < 3 and time.time() < deadline:
+                time.sleep(0.01)
+            # byte-exact, in write order, regardless of which plane
+            # carried each frame
+            assert collector.messages == [small, big, small]
+            assert a.bulk_sends == 1                 # only the big frame
+            assert a.inline_data_frames == 2         # both small frames
+            assert not wire.parked                   # claimed, not leaked
+            # the feedback loop crossed the fake wire too: the receiver
+            # consumed past max_buf_size//2, so the sender's watermark
+            # advanced through set_remote_consumed
+            assert send._remote_consumed > 0
+        finally:
+            send.close()
+            deadline = time.time() + 5
+            while not recv.closed and time.time() < deadline:
+                time.sleep(0.01)
+            assert recv.closed
+            recv.close()
+
+    def test_stale_bulk_descriptor_is_claimed_and_dropped(self):
+        """A descriptor addressed to a closed stream must still claim its
+        parked bulk frame (or the native receive buffer leaks)."""
+        from brpc_tpu.proto import rpc_meta_pb2 as meta_pb
+        from brpc_tpu.rpc import stream as stream_mod
+        from brpc_tpu.rpc.stream import on_stream_frame
+        wire = _FakeBulkWire()
+        sock = _FakeBulkSocket(wire)
+        wire.parked[77] = b"q" * 1000
+        meta = meta_pb.RpcMeta()
+        ss = meta.stream_settings
+        ss.stream_id = (1 << 40) + 12345     # no such stream
+        ss.frame_type = stream_mod.FRAME_DATA_BULK
+        body = IOBuf(stream_mod._BULK_DESC.pack(77, 1000))
+        on_stream_frame(meta, body, sock)
+        assert not wire.parked
+
+    def test_bulk_send_failure_closes_stream_without_deadlock(self):
+        """A bulk send that dies after the descriptor went out must raise
+        AND close the stream — from OUTSIDE the wire lock (close sends
+        FRAME_CLOSE through the same non-reentrant lock; a close inside
+        the failure handler used to deadlock the writer forever)."""
+        from brpc_tpu.butil import flags
+        threshold = flags.get_flag("ici_stream_bulk_threshold")
+        send, recv, a, b, wire = self._pair(Collector())
+
+        def broken_send(uuid, frame):
+            raise ConnectionError("bulk conn died")
+
+        a.stream_bulk_send = broken_send
+        result = []
+
+        def writer():
+            try:
+                send.write(IOBuf(b"x" * threshold), timeout=5)
+                result.append("no-error")
+            except ConnectionError:
+                result.append("raised")
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        t.join(5)
+        assert not t.is_alive(), "writer deadlocked in close-under-lock"
+        assert result == ["raised"]
+        assert send.closed
+        recv.close()
+
+    def test_claim_failure_fails_socket_and_stream(self):
+        """A dead bulk plane under a live stream must fail the socket
+        (the fabric contract) and close the stream — never silently drop
+        the frame and corrupt the byte stream."""
+        from brpc_tpu.butil import flags
+        threshold = flags.get_flag("ici_stream_bulk_threshold")
+        collector = Collector()
+        send, recv, a, b, wire = self._pair(collector)
+
+        def broken_claim(uuid, length):
+            raise ConnectionError("bulk conn died")
+
+        b.stream_bulk_claim = broken_claim
+        try:
+            assert send.write(IOBuf(b"x" * threshold)) == 0
+            assert b.failed                  # receiving socket severed
+            deadline = time.time() + 5
+            while not recv.closed and time.time() < deadline:
+                time.sleep(0.01)
+            assert recv.closed
+        finally:
+            send.close()
+            recv.close()
