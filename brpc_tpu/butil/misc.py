@@ -1,15 +1,18 @@
 """Misc platform utilities (reference: src/butil/ fast_rand, crc32c, time).
 
 fast_rand mirrors the reference's per-thread xorshift generator
-(src/butil/fast_rand.cpp); crc32c uses zlib's crc32 engine with the crc32c
-polynomial unavailable in stdlib, so we expose crc32 under the same API (the
-wire protocol defines its own checksum, so only self-consistency matters).
+(src/butil/fast_rand.cpp); crc32c is a REAL Castagnoli CRC
+(reflected polynomial 0x82F63B78, the iSCSI/RFC 3720 checksum — the
+same family the reference's src/butil/crc32c.cc computes), table-driven
+with 8 slice tables so Python pays one table walk per byte instead of a
+bit loop.  Verified against the RFC 3720 known-answer vectors in
+tests/test_butil.py, so anything claiming crc32c compatibility on the
+wire now actually is.
 """
 from __future__ import annotations
 
 import threading
 import time
-import zlib
 
 _tls = threading.local()
 
@@ -42,8 +45,46 @@ def fast_rand_in(lo: int, hi: int) -> int:
     return lo + fast_rand_less_than(hi - lo + 1)
 
 
+def _crc32c_tables():
+    """8 slicing tables for the reflected Castagnoli polynomial."""
+    poly = 0x82F63B78
+    t0 = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        t0.append(c)
+    tables = [t0]
+    for k in range(1, 8):
+        prev = tables[k - 1]
+        tables.append([t0[prev[i] & 0xFF] ^ (prev[i] >> 8)
+                       for i in range(256)])
+    return tables
+
+
+_CRC32C_T = _crc32c_tables()
+
+
 def crc32c(data, init: int = 0) -> int:
-    return zlib.crc32(bytes(data), init) & 0xFFFFFFFF
+    """CRC-32C (Castagnoli, reflected 0x82F63B78 — iSCSI / RFC 3720).
+    ``init`` is a previous crc32c() result, so checksums stream across
+    chunk boundaries like zlib.crc32's running form."""
+    t0, t1, t2, t3, t4, t5, t6, t7 = _CRC32C_T
+    buf = bytes(data)
+    crc = init ^ 0xFFFFFFFF
+    n = len(buf)
+    i = 0
+    # slice-by-8: one combined table step per 8 bytes
+    for i in range(0, n - 7, 8):
+        crc ^= int.from_bytes(buf[i:i + 4], "little")
+        hi = int.from_bytes(buf[i + 4:i + 8], "little")
+        crc = (t7[crc & 0xFF] ^ t6[(crc >> 8) & 0xFF]
+               ^ t5[(crc >> 16) & 0xFF] ^ t4[(crc >> 24) & 0xFF]
+               ^ t3[hi & 0xFF] ^ t2[(hi >> 8) & 0xFF]
+               ^ t1[(hi >> 16) & 0xFF] ^ t0[(hi >> 24) & 0xFF])
+    for j in range(n - (n % 8), n):
+        crc = t0[(crc ^ buf[j]) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
 
 
 def gettimeofday_us() -> int:

@@ -17,7 +17,7 @@ from typing import Callable, Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_sendv"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_chaos_listener"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -313,6 +313,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                              ctypes.c_uint64]
     lib.brpc_tpu_fab_conn_close.argtypes = [ctypes.c_uint64]
     lib.brpc_tpu_fab_listener_close.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_fab_alive.restype = ctypes.c_int
+    lib.brpc_tpu_fab_alive.argtypes = [ctypes.c_uint64]
+    # deterministic chaos hooks (fault injection for the chaos harness)
+    lib.brpc_tpu_fab_chaos.restype = ctypes.c_int
+    lib.brpc_tpu_fab_chaos.argtypes = [ctypes.c_uint64, ctypes.c_int,
+                                       ctypes.c_int64]
+    lib.brpc_tpu_fab_chaos_listener.restype = ctypes.c_int
+    lib.brpc_tpu_fab_chaos_listener.argtypes = [ctypes.c_uint64,
+                                                ctypes.c_int64]
     _lib = lib
     return _lib
 

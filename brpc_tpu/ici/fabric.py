@@ -34,15 +34,18 @@ from __future__ import annotations
 
 import ctypes
 import json
+import random
 import socket as _pysocket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..butil import flags as _flags
 from ..butil import logging as log
 from ..butil.iobuf import IOBuf, IOPortal, DEVICE
 from ..rpc import errors
+from ..rpc import fault_injection as _fi
 from ..rpc.socket import Socket
 from .transport import CreditWindow, OrderedDelivery
 
@@ -75,6 +78,20 @@ _flags.define_flag("ici_fabric_bulk_host_min", 64 * 1024,
 _flags.define_flag("ici_fabric_host_delivery", True,
                    "deliver fabric bulk payloads host-resident (False: "
                    "eager device_put before the read event)")
+# Failure semantics.  A fabric socket is NOT terminal (the reference's
+# resilience doctrine, src/brpc/socket.cpp SetFailed/HealthCheck): when
+# its control channel dies, in-flight correlation ids fail fast and the
+# endpoint is handed to rpc/health_check.py, which probes with
+# exponential backoff + jitter until a reconnect (a fresh HELLO/bulk
+# handshake under a NEW versioned socket id) can succeed.
+_flags.define_flag("ici_fabric_health_check", True,
+                   "hand failed fabric endpoints to the health checker "
+                   "for backoff-probed revival")
+# How long a bulk claim tolerates descriptor/payload skew between the
+# control and bulk connections before declaring the bytes lost.  Chaos
+# tests shrink this so a dropped bulk frame resolves quickly.
+_flags.define_flag("ici_bulk_claim_timeout_s", 60.0,
+                   "max seconds a bulk claim waits for its frame")
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 
@@ -115,6 +132,18 @@ _F_DATA = 4        # chunk list: host bytes + device descriptors
 _F_CREDIT = 5      # u64 consumed bytes
 _F_PULLED = 6      # u64 uuid — receiver finished pulling (CQ completion)
 _F_FIN = 7
+# bulk-plane degradation + revival (self-healing; the control channel
+# stays the source of truth so every transition is ORDERED relative to
+# the descriptors that reference the bulk plane)
+_F_BULK_DOWN = 8          # sender observed bulk death; peer degrades too
+_F_BULK_REESTABLISH = 9   # json: {bulk_key} — client re-parked a conn
+_F_BULK_OK = 10           # server claimed + attached the re-parked conn
+_F_BULK_ERR = 11          # claim failed/refused; client backs off, retries
+# connectionless liveness probe (rpc/health_check.py): answers whether a
+# server is listening at ici://target WITHOUT creating a fabric socket
+_F_PING = 12              # u32 target_dev
+_F_PING_OK = 13
+_F_PING_ERR = 14
 
 _HDR = struct.Struct("<BI")          # type, body length
 
@@ -369,12 +398,27 @@ class FabricNode:
         try:
             conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
             fr = _recv_frame(conn)
+            if fr is not None and fr[0] == _F_PING:
+                # liveness probe: reply and close, no socket is created
+                (target,) = struct.unpack("<I", fr[1])
+                from .transport import _listeners, _listeners_lock
+                with _listeners_lock:
+                    up = target in _listeners
+                _send_frame(conn, _F_PING_OK if up else _F_PING_ERR, b"")
+                conn.close()
+                return
             if fr is None or fr[0] != _F_HELLO:
                 conn.close()
                 return
             hello = json.loads(fr[1])
             bulk_key = hello.get("bulk_key")
             target = hello["target_dev"]
+            plan = _fi.fabric_active()
+            if plan is not None and plan.on_hello():
+                _send_frame(conn, _F_HELLO_ERR, b"injected hello refusal")
+                conn.close()
+                self._reap_parked_bulk(bulk_key)
+                return
             from .transport import _listeners, _listeners_lock
             with _listeners_lock:
                 listener = _listeners.get(target)
@@ -443,17 +487,14 @@ class FabricNode:
             self._bulk_lib.brpc_tpu_fab_conn_close(h)
 
     # ---- client side ---------------------------------------------------
-    def connect(self, target_dev: int, client_dev: int) -> "FabricSocket":
-        owner = self.device_owner(target_dev)
-        info = self.peer_info(owner)
-        host, _, port = info["ctrl"].rpartition(":")
-        conn = _pysocket.create_connection((host, int(port)), timeout=30)
-        conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
-        # bulk plane: dial the peer's bulk listener FIRST so the key is
-        # already parked when the control HELLO names it (both ends must
-        # have the native core; either missing -> transfer-server path)
+    def dial_bulk(self, peer_pid: int) -> Tuple[int, Optional[str], object]:
+        """Dial the peer's bulk listener and park a fresh conn under a
+        unique key: (handle, key, lib).  (0, None, lib) when either end
+        lacks the native plane.  Shared by the initial connect and the
+        degradation-recovery re-establishment path."""
         lib = _bulk_lib()
         bulk_h, bulk_key = 0, None
+        info = self.peer_info(peer_pid)
         if lib is not None and info.get("bulk"):
             bhost, _, bport = info["bulk"].rpartition(":")
             bulk_key = f"{self.process_id}:{self.next_uuid():x}"
@@ -467,6 +508,35 @@ class FabricNode:
                     bhost.encode(), int(bport), bulk_key.encode())
             if not bulk_h:
                 bulk_key = None
+        return bulk_h, bulk_key, lib
+
+    def ping(self, target_dev: int, timeout: float = 1.0) -> bool:
+        """Probe whether ici://target_dev is served by its owner process,
+        without creating a fabric socket — the health checker's
+        reachability test for cross-process endpoints."""
+        try:
+            owner = self.device_owner(target_dev)
+            info = self.peer_info(owner, timeout_ms=int(timeout * 1000))
+            host, _, port = info["ctrl"].rpartition(":")
+            with _pysocket.create_connection((host, int(port)),
+                                             timeout=timeout) as conn:
+                conn.settimeout(timeout)
+                _send_frame(conn, _F_PING, struct.pack("<I", target_dev))
+                fr = _recv_frame(conn)
+                return fr is not None and fr[0] == _F_PING_OK
+        except (OSError, ValueError, KeyError):
+            return False
+
+    def connect(self, target_dev: int, client_dev: int) -> "FabricSocket":
+        owner = self.device_owner(target_dev)
+        info = self.peer_info(owner)
+        host, _, port = info["ctrl"].rpartition(":")
+        conn = _pysocket.create_connection((host, int(port)), timeout=30)
+        conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
+        # bulk plane: dial the peer's bulk listener FIRST so the key is
+        # already parked when the control HELLO names it (both ends must
+        # have the native core; either missing -> transfer-server path)
+        bulk_h, bulk_key, lib = self.dial_bulk(owner)
         hello = {"target_dev": target_dev, "client_dev": client_dev,
                  "pid": self.process_id}
         if bulk_key:
@@ -534,6 +604,24 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._reader: Optional[threading.Thread] = None
         self._bulk = 0                         # native bulk conn handle
         self._blib = None
+        # bulk-plane self-healing state.  _bulk_lock guards the handle
+        # swap (degrade/re-attach race writers and the read loop);
+        # the cumulative counters survive re-attachment so tests can
+        # assert threshold routing was actually restored.
+        self._bulk_lock = threading.Lock()
+        self._bulk_epoch = 0                   # attachments so far
+        self.bulk_bytes_sent = 0               # cumulative, across epochs
+        self.bulk_bytes_claimed = 0
+        self._reestab_pending: Optional[Tuple] = None   # (lib, handle)
+        self._reestab_ok = False
+        self._reestab_evt = threading.Event()
+        # revival-loop ownership, both guarded by _bulk_lock: `running`
+        # is cleared by the loop ATOMICALLY with its decision to exit
+        # (is_alive() would race the thread's last instructions), and
+        # `wanted` records a degrade that arrived while a loop was
+        # already up so it keeps going instead of exiting
+        self._reestab_running = False
+        self._reestab_wanted = False
         # kind-1 transfer-server staging needs the module on BOTH ends:
         # ours to stage, the peer's to pull.  A peer whose jax build
         # lacks jax.experimental.transfer publishes no "xfer" contact —
@@ -544,9 +632,182 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
 
     def _attach_bulk(self, lib, handle: int) -> None:
         """Bind the native bulk data-plane connection (both ends hold one
-        fab conn per fabric socket pair; 0 = transfer-server fallback)."""
-        self._bulk = handle
-        self._blib = lib
+        fab conn per fabric socket pair; 0 = transfer-server fallback).
+        Re-attachment (bulk revival) closes any stale handle and bumps
+        the epoch; chaos plans get to poison the fresh conn here."""
+        old = 0
+        with self._bulk_lock:
+            old, self._bulk = self._bulk, handle
+            self._blib = lib
+            if handle:
+                self._bulk_epoch += 1
+        if old and lib is not None:
+            lib.brpc_tpu_fab_conn_close(old)
+        if handle:
+            plan = _fi.fabric_active()
+            if plan is not None:
+                plan.on_bulk_attach(self, lib, handle)
+
+    # ---- bulk-plane degradation + revival ------------------------------
+    # Bulk death with a LIVE control channel no longer kills the socket:
+    # the handle is dropped (writers route inline / via the transfer
+    # server from the next frame on), the peer is told via _F_BULK_DOWN,
+    # and the client side re-establishes in the background with
+    # exponential backoff + jitter — a fresh parked conn bound through
+    # the _F_BULK_REESTABLISH handshake on the control channel, whose
+    # serial ordering guarantees no descriptor can reference the new
+    # conn before both ends attached it.
+
+    def bulk_epoch(self) -> int:
+        with self._bulk_lock:
+            return self._bulk_epoch
+
+    def _bulk_alive(self) -> int:
+        """The bulk handle when usable, else 0.  A handle whose native
+        conn died is degraded HERE — at a frame boundary, before any
+        descriptor references it, which is what lets an in-progress
+        stream fall back inline instead of stranding a descriptor whose
+        bytes can never arrive."""
+        with self._bulk_lock:
+            h, lib = self._bulk, self._blib
+        if not h:
+            return 0
+        if lib.brpc_tpu_fab_alive(h):
+            return h
+        self._bulk_plane_down("bulk conn dead at frame boundary")
+        return 0
+
+    def bulk_plane_failed(self) -> None:
+        """Receiver-side hook (rpc/stream.py): a bulk claim failed.  The
+        affected stream is failed by the caller; the SOCKET survives —
+        only the bulk plane degrades and revival begins."""
+        self._bulk_plane_down("bulk claim failed")
+
+    def _bulk_plane_down(self, reason: str, notify: bool = True) -> None:
+        with self._bulk_lock:
+            h, self._bulk = self._bulk, 0
+            lib = self._blib
+        if not h:
+            return                      # already degraded / never bound
+        if lib is not None:
+            lib.brpc_tpu_fab_conn_close(h)
+        log.warning("fabric %s: bulk plane down (%s) — inline fallback "
+                    "engaged", self.remote_side, reason)
+        if notify and not self._peer_gone():
+            try:
+                self._ctrl_send(_F_BULK_DOWN, b"")
+            except OSError:
+                pass
+        self._kick_bulk_reestablish()
+
+    def _kick_bulk_reestablish(self) -> None:
+        """Client side only (the end that dialed originally): ensure a
+        re-dial loop is running, at most one at a time.  `wanted` and
+        `running` are decided under ONE lock hold so a kick can never
+        land in the gap where a finishing loop has decided to exit but
+        is_alive() would still read True — that gap used to suppress
+        revival forever when a freshly attached conn died instantly."""
+        if self.is_server_side or self.failed or self._peer_gone():
+            return
+        with self._bulk_lock:
+            self._reestab_wanted = True
+            if self._reestab_running:
+                return           # the live loop will observe `wanted`
+            self._reestab_running = True
+        threading.Thread(target=self._bulk_reestablish_loop,
+                         name="fabric_bulk_revive", daemon=True).start()
+
+    def _bulk_reestablish_loop(self) -> None:
+        rng = random.Random(self.id ^ 0x5DEECE66D)
+        delay = 0.05
+        while True:
+            if self.failed or self._peer_gone():
+                with self._bulk_lock:
+                    self._reestab_running = False
+                return
+            with self._bulk_lock:
+                if self._bulk or not self._reestab_wanted:
+                    # attached (or request consumed): exit — atomically
+                    # with clearing `running`, so a racing kick either
+                    # saw running=True before this point (and set
+                    # `wanted`, keeping us looping) or spawns a new loop
+                    self._reestab_wanted = False
+                    self._reestab_running = False
+                    return
+            # backoff BEFORE each attempt (first one included): the plane
+            # just died, and frames sent in the gap ride the inline path
+            # anyway — dialing in the same instant the peer is tearing
+            # down mostly burns a connection
+            time.sleep(delay * (1.0 + 0.25 * rng.random()))
+            delay = min(delay * 2, 1.0)
+            with self._bulk_lock:
+                if self._bulk:
+                    continue            # re-attached while we slept
+            if self.failed or self._peer_gone():
+                continue                # exit via the top-of-loop path
+            h, key, lib = self.node.dial_bulk(self.peer_pid)
+            if h:
+                self._reestab_evt.clear()
+                self._reestab_ok = False
+                with self._bulk_lock:
+                    self._reestab_pending = (lib, h)
+                try:
+                    self._ctrl_send(_F_BULK_REESTABLISH,
+                                    json.dumps({"bulk_key": key}).encode())
+                    ok = self._reestab_evt.wait(5.0) and self._reestab_ok
+                except OSError:
+                    ok = False
+                if ok:
+                    log.info("fabric %s: bulk plane re-established "
+                             "(epoch %d)", self.remote_side,
+                             self.bulk_epoch())
+                    continue    # exit via the top-of-loop check, which
+                    # clears `running` atomically — and keeps looping
+                    # instead if the fresh conn already died (a degrade
+                    # re-set `wanted` in the meantime)
+                # timed out / refused: reclaim the pending handle unless
+                # the read loop already attached it
+                with self._bulk_lock:
+                    pending, self._reestab_pending = \
+                        self._reestab_pending, None
+                if pending is not None:
+                    lib.brpc_tpu_fab_conn_close(h)
+
+    def _on_bulk_reestablish(self, req: dict) -> None:
+        """Server side: claim the conn the client re-parked on our bulk
+        listener and attach it; runs on the control read loop so the
+        attach is ordered BEFORE any descriptor that will use it."""
+        key = req.get("bulk_key")
+        node = self.node
+        ok = False
+        plan = _fi.fabric_active()
+        if plan is not None and plan.on_bulk_handshake(self):
+            node._reap_parked_bulk(key)          # refuse deterministically
+        elif key and node._bulk_listener and node._bulk_lib is not None:
+            h = node._bulk_lib.brpc_tpu_fab_accept(
+                node._bulk_listener, key.encode(), 2_000_000)
+            if h:
+                self._attach_bulk(node._bulk_lib, h)
+                ok = True
+        try:
+            self._ctrl_send(_F_BULK_OK if ok else _F_BULK_ERR, b"")
+        except OSError:
+            pass
+
+    def _on_bulk_reply(self, ok: bool) -> None:
+        """Client side: _F_BULK_OK/_F_BULK_ERR from the server.  The
+        attach happens HERE on the read loop — a descriptor following
+        BULK_OK on the serial control channel then always finds the new
+        handle bound."""
+        with self._bulk_lock:
+            pending, self._reestab_pending = self._reestab_pending, None
+        if ok and pending is not None:
+            self._attach_bulk(*pending)
+        elif pending is not None:
+            pending[0].brpc_tpu_fab_conn_close(pending[1])
+            ok = False
+        self._reestab_ok = ok and pending is not None
+        self._reestab_evt.set()
 
     def start_io(self) -> None:
         self._reader = threading.Thread(target=self._read_loop,
@@ -561,6 +822,28 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         return self._peer_closed or self._conn_dead
 
     # ---- write path ----------------------------------------------------
+    def _ctrl_send(self, ftype: int, body: bytes) -> None:
+        """Every outbound control frame funnels through here: the one
+        place the chaos harness can drop a frame (lossy link) or sever
+        the control TCP (peer reset) deterministically."""
+        plan = _fi.fabric_active()
+        if plan is not None:
+            action = plan.on_control_send(self)
+            if action == _fi.DROP:
+                return                   # bytes vanish
+            if action == _fi.ERROR:
+                # sever both directions: our read loop observes the
+                # reset and runs the connection-over path, exactly as a
+                # mid-conversation RST would
+                try:
+                    self._conn.shutdown(_pysocket.SHUT_RDWR)
+                except OSError:
+                    pass
+                raise ConnectionError("fabric control channel: "
+                                      "injected sever")
+        with self._conn_wlock:
+            _send_frame(self._conn, ftype, body)
+
     def _do_write(self, data: IOBuf) -> int:
         n = self._consume_window(len(data))
         if n < 0:
@@ -568,8 +851,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         frame = data.cut(n)
         body = self._encode_data(frame)
         try:
-            with self._conn_wlock:
-                _send_frame(self._conn, _F_DATA, body)
+            self._ctrl_send(_F_DATA, body)
         except OSError as e:
             raise ConnectionError(f"fabric control channel: {e}")
         return n
@@ -580,7 +862,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         custody: the source block is reusable the moment fab_send
         returns), else staged on the transfer server for a peer pull
         (kind 1; pinned until the PULLED ack).  Large host blobs also
-        ride the bulk plane (kind 3) to skip the inline join+copy."""
+        ride the bulk plane (kind 3) to skip the inline join+copy.
+
+        Degradation: every bulk use is gated on _bulk_alive() and a
+        failed bulk send falls back to the inline/transfer-server path
+        WITHIN the same frame — nothing bulk-bound is committed to the
+        control stream until its bytes are already on the bulk conn, so
+        a dying bulk plane can never strand an attachment descriptor."""
         out = [b""]
         nchunks = 0
         pending_host: List[bytes] = []
@@ -588,77 +876,86 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
 
         def flush_host():
             nonlocal nchunks
-            if pending_host:
-                blob = b"".join(pending_host)
-                if self._bulk and len(blob) >= bulk_host_min:
-                    uuid = self.node.next_uuid()
+            if not pending_host:
+                return
+            blob = b"".join(pending_host)
+            pending_host.clear()
+            nchunks += 1
+            if len(blob) >= bulk_host_min and self._bulk_alive():
+                uuid = self.node.next_uuid()
+                try:
                     self._bulk_send(uuid, blob)
                     out.append(struct.pack("<BQQ", 3, uuid, len(blob)))
-                else:
-                    out.append(struct.pack("<BI", 0, len(blob)))
-                    out.append(blob)
-                pending_host.clear()
-                nchunks += 1
+                    return
+                except ConnectionError:
+                    self._bulk_plane_down("bulk send failed mid-encode")
+            out.append(struct.pack("<BI", 0, len(blob)))
+            out.append(blob)
 
         for i in range(frame.backing_block_num()):
             r = frame.backing_block(i)
-            if (r.block.kind == DEVICE and not self._bulk
-                    and not self._xfer_usable):
-                # neither fast plane exists for this socket pair: the
-                # device payload crosses as plain host bytes on the
-                # control channel (d2h here, h2d on first use at the
-                # peer — the same residency contract as host delivery)
+            if r.block.kind != DEVICE:
                 pending_host.append(
                     bytes(r.block.host_view(r.offset, r.length)))
                 continue
-            if r.block.kind == DEVICE:
-                flush_host()
-                arr = r.block.data
-                if r.offset or r.length != len(arr):
-                    arr = arr[r.offset:r.offset + r.length]
+            arr = r.block.data
+            if r.offset or r.length != len(arr):
+                arr = arr[r.offset:r.offset + r.length]
+            kind = 0
+            if self._bulk_alive():
+                # device -> host staging (on CPU backends a zero-copy
+                # view; on TPU the D2H leg of a host-staged fabric)
+                import numpy as np
+                np_arr = np.asarray(arr)
+                if not np_arr.flags["C_CONTIGUOUS"]:
+                    np_arr = np.ascontiguousarray(np_arr)
                 uuid = self.node.next_uuid()
-                if self._bulk:
-                    # device -> host staging (on CPU backends a zero-copy
-                    # view; on TPU the D2H leg of a host-staged fabric)
-                    import numpy as np
-                    np_arr = np.asarray(arr)
-                    if not np_arr.flags["C_CONTIGUOUS"]:
-                        np_arr = np.ascontiguousarray(np_arr)
+                try:
                     self._bulk_send(uuid, np_arr)
+                    kind = 2
+                except ConnectionError:
+                    self._bulk_plane_down("bulk send failed mid-encode")
+                if kind == 2:
                     cb = getattr(r.block, "on_send_complete", None)
                     if cb is not None:
                         try:
                             cb()
                         except Exception:
                             pass
-                    kind = 2
-                else:
-                    if not hasattr(arr, "devices"):
-                        # forwarding a host-delivered numpy over an
-                        # xfer-mode socket: the transfer server stages
-                        # jax arrays only — detach into an owned copy
-                        # (aliasing a ctypes-backed view is unsafe)
-                        import jax
-                        import numpy as np
-                        arr = jax.device_put(
-                            np.array(arr, copy=True),
-                            jax.devices()[self.local_dev])
-                    self.node.stage(uuid, [arr])
-                    with self._staged_lock:
-                        self._staged[uuid] = (r.block, arr)
-                    kind = 1
-                dt = str(arr.dtype).encode()
-                shape = arr.shape
-                out.append(struct.pack("<BQH", kind, uuid, len(dt)))
-                out.append(dt)
-                out.append(struct.pack("<B", len(shape)))
-                out.append(struct.pack("<%dQ" % len(shape), *shape)
-                           if shape else b"")
-                out.append(struct.pack("<Q", r.length))
-                nchunks += 1
-            else:
+            if kind == 0 and self._xfer_usable:
+                if not hasattr(arr, "devices"):
+                    # forwarding a host-delivered numpy over an
+                    # xfer-mode socket: the transfer server stages
+                    # jax arrays only — detach into an owned copy
+                    # (aliasing a ctypes-backed view is unsafe)
+                    import jax
+                    import numpy as np
+                    arr = jax.device_put(
+                        np.array(arr, copy=True),
+                        jax.devices()[self.local_dev])
+                uuid = self.node.next_uuid()
+                self.node.stage(uuid, [arr])
+                with self._staged_lock:
+                    self._staged[uuid] = (r.block, arr)
+                kind = 1
+            if kind == 0:
+                # neither fast plane: the device payload crosses as plain
+                # host bytes on the control channel (d2h here, h2d on
+                # first use at the peer — the same residency contract as
+                # host delivery)
                 pending_host.append(
                     bytes(r.block.host_view(r.offset, r.length)))
+                continue
+            flush_host()
+            dt = str(arr.dtype).encode()
+            shape = arr.shape
+            out.append(struct.pack("<BQH", kind, uuid, len(dt)))
+            out.append(dt)
+            out.append(struct.pack("<B", len(shape)))
+            out.append(struct.pack("<%dQ" % len(shape), *shape)
+                       if shape else b"")
+            out.append(struct.pack("<Q", r.length))
+            nchunks += 1
         flush_host()
         out[0] = struct.pack("<I", nchunks)
         return b"".join(out)
@@ -674,9 +971,12 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         else:
             ptr = data.ctypes.data_as(_u8p)
             n = data.nbytes
-        rc = self._blib.brpc_tpu_fab_send(self._bulk, uuid, ptr, n)
+        with self._bulk_lock:
+            h, lib = self._bulk, self._blib
+        rc = lib.brpc_tpu_fab_send(h, uuid, ptr, n) if h else -1
         if rc != 0:
             raise ConnectionError("fabric bulk channel closed")
+        self.bulk_bytes_sent += n
 
     # ---- stream fast plane ---------------------------------------------
     # Stream DATA frames above ici_stream_bulk_threshold post their
@@ -688,9 +988,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
     # contract as the kind-2/3 attachment path above.
 
     def stream_bulk_begin(self) -> int:
-        """Reserve a bulk uuid for one stream DATA frame; 0 when no bulk
-        plane is bound (the caller keeps the inline path)."""
-        if not self._bulk:
+        """Reserve a bulk uuid for one stream DATA frame; 0 when no
+        usable bulk plane is bound (the caller keeps the inline path).
+        The liveness check here is what lets a stream survive bulk
+        death: a dead plane is detected BEFORE the descriptor goes out,
+        so the frame — and every later one until revival — rides the
+        inline wire path instead."""
+        if not self._bulk_alive():
             return 0
         return self.node.next_uuid()
 
@@ -704,6 +1008,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         lens = (ctypes.c_uint64 * nblocks)()
         keep = []                      # buffers must outlive the write
         n = 0
+        total = 0
         for i in range(nblocks):
             r = frame.backing_block(i)
             if not r.length:
@@ -713,18 +1018,26 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             keep.append(a)
             ptrs[n] = a.ctypes.data
             lens[n] = r.length
+            total += r.length
             n += 1
-        rc = self._blib.brpc_tpu_fab_sendv(self._bulk, uuid, ptrs, lens, n)
+        with self._bulk_lock:
+            h, lib = self._bulk, self._blib
+        rc = lib.brpc_tpu_fab_sendv(h, uuid, ptrs, lens, n) if h else -1
         if rc != 0:
+            # the descriptor for this frame is already on the control
+            # channel: the peer's claim will fail and close THAT stream
+            # (descriptor-consistency rule); this socket only degrades
+            self._bulk_plane_down("bulk sendv failed")
             raise ConnectionError("fabric bulk channel closed")
+        self.bulk_bytes_sent += total
 
     def stream_bulk_abort(self) -> None:
         """Sever the bulk plane after a descriptor went out whose payload
         never will (sender-side Python failure): the peer's pending claim
-        must fail promptly, not sit out the full claim timeout.  Bulk
-        death is socket death on the peer, matching the claim-failure
-        contract."""
-        self._close_bulk()
+        must fail promptly, not sit out the full claim timeout.  The
+        failed claim closes the affected STREAM on the peer; the socket
+        survives and the bulk plane re-establishes in the background."""
+        self._bulk_plane_down("stream bulk abort")
 
     def stream_bulk_claim(self, uuid: int, length: int) -> IOBuf:
         """Claim a stream DATA frame's bulk bytes as a zero-copy IOBuf:
@@ -732,6 +1045,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         the conn's pool when the last ref dies (_NativeBufOwner)."""
         buf = IOBuf()
         buf.append_user_data(memoryview(self._claim_zero_copy(uuid, length)))
+        self.bulk_bytes_claimed += length
         return buf
 
     def _claim_zero_copy(self, uuid: int, expect_len: int):
@@ -739,15 +1053,18 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         array WRAPPING the native receive buffer, with the exactly-once
         release chained through ``._owner`` — the one custody-critical
         sequence shared by stream claims and kind-2 host delivery."""
-        ptr, n = self._bulk_claim(uuid)
+        ptr, n, h, lib = self._bulk_claim(uuid)
         if n != expect_len:
-            self._blib.brpc_tpu_fab_buf_release(self._bulk, ptr, n)
+            lib.brpc_tpu_fab_buf_release(h, ptr, n)
             raise ConnectionError(
                 f"bulk frame {uuid:#x}: {n} bytes, descriptor "
                 f"said {expect_len}")
         ca = (ctypes.c_uint8 * n).from_address(
             ctypes.addressof(ptr.contents))
-        ca._owner = _NativeBufOwner(self._blib, self._bulk, ptr, n)
+        # the owner pins the HANDLE the claim was served from: after a
+        # degrade/re-attach, releasing against a closed handle falls
+        # back to free() in the native layer — never a leak
+        ca._owner = _NativeBufOwner(lib, h, ptr, n)
         return ca
 
     # ---- read path -----------------------------------------------------
@@ -757,6 +1074,9 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 fr = _recv_frame(self._conn)
                 if fr is None:
                     break
+                plan = _fi.fabric_active()
+                if plan is not None:
+                    plan.on_control_recv(self)    # peer-crash chaos hook
                 ftype, body = fr
                 if ftype == _F_DATA:
                     self._on_data(body)
@@ -764,6 +1084,18 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     self._on_credits(struct.unpack("<Q", body)[0])
                 elif ftype == _F_PULLED:
                     self._on_pulled(struct.unpack("<Q", body)[0])
+                elif ftype == _F_BULK_DOWN:
+                    # peer observed bulk death first: degrade without
+                    # echoing (no notify ping-pong); the client side
+                    # starts revival
+                    self._bulk_plane_down("peer reported bulk death",
+                                          notify=False)
+                elif ftype == _F_BULK_REESTABLISH:
+                    self._on_bulk_reestablish(json.loads(body))
+                elif ftype == _F_BULK_OK:
+                    self._on_bulk_reply(True)
+                elif ftype == _F_BULK_ERR:
+                    self._on_bulk_reply(False)
                 elif ftype == _F_FIN:
                     break
         except OSError:
@@ -862,9 +1194,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             # sender may reuse its source blocks
             for u in pulled_uuids:
                 try:
-                    with self._conn_wlock:
-                        _send_frame(self._conn, _F_PULLED,
-                                    struct.pack("<Q", u))
+                    self._ctrl_send(_F_PULLED, struct.pack("<Q", u))
                 except OSError:
                     pass
             with self._inbox_lock:
@@ -875,32 +1205,45 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         # ahead of an earlier device-bearing frame still in flight
         self._enqueue_delivery(device_arrays, commit)
 
-    # Bulk frames can trail their control descriptor (separate TCP
-    # connections have no cross-ordering); the claim tolerates 60 s of
-    # skew before declaring the socket broken.
-    _BULK_CLAIM_US = 60_000_000
-
-    def _bulk_claim(self, uuid: int) -> Tuple[ctypes.POINTER, int]:
+    def _bulk_claim(self, uuid: int):
+        # Bulk frames can trail their control descriptor (separate TCP
+        # connections have no cross-ordering); the claim tolerates
+        # ici_bulk_claim_timeout_s of skew before declaring the bytes
+        # lost.  A frame parked BEFORE the conn died is still claimable
+        # after it; a missing frame on a dead conn fails fast (-2).
+        # Returns (ptr, len, handle, lib): callers MUST release against
+        # the returned handle — their own snapshot could postdate a
+        # degrade/re-attach and name a different conn than the one the
+        # claim was served from (the buffer would then be free()d
+        # instead of recycled into the owning conn's pool).
+        with self._bulk_lock:
+            h, lib = self._bulk, self._blib
         out, olen = _u8p(), ctypes.c_uint64()
-        rc = self._blib.brpc_tpu_fab_recv(
-            self._bulk, uuid, self._BULK_CLAIM_US,
-            ctypes.byref(out), ctypes.byref(olen))
+        timeout_us = int(
+            _flags.get_flag("ici_bulk_claim_timeout_s") * 1e6)
+        rc = lib.brpc_tpu_fab_recv(
+            h, uuid, timeout_us,
+            ctypes.byref(out), ctypes.byref(olen)) if h else -2
         if rc != 0:
-            # surfaces in _read_loop's catch-all -> socket failure
+            # attachment frames surface this in _read_loop's catch-all ->
+            # socket failure (the control byte stream cannot be repaired);
+            # stream frames catch it in on_stream_frame and fail only the
+            # stream (descriptor-consistency rule)
             raise ConnectionError(
                 f"fabric bulk frame {uuid:#x} unclaimable (rc {rc})")
-        return out, olen.value
+        return out, olen.value, h, lib
 
     def _bulk_claim_bytes(self, uuid: int, expect_len: int) -> bytes:
-        ptr, n = self._bulk_claim(uuid)
+        ptr, n, h, lib = self._bulk_claim(uuid)
         try:
             if n != expect_len:
                 raise ConnectionError(
                     f"bulk frame {uuid:#x}: {n} bytes, descriptor "
                     f"said {expect_len}")
+            self.bulk_bytes_claimed += n
             return ctypes.string_at(ptr, n)
         finally:
-            self._blib.brpc_tpu_fab_buf_release(self._bulk, ptr, n)
+            lib.brpc_tpu_fab_buf_release(h, ptr, n)
 
     def _bulk_claim_array(self, uuid: int, dt: str, shape, length: int,
                           local_device):
@@ -965,17 +1308,35 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 self._consumed_unacked = 0
         if flush:
             try:
-                with self._conn_wlock:
-                    _send_frame(self._conn, _F_CREDIT,
-                                struct.pack("<Q", flush))
+                self._ctrl_send(_F_CREDIT, struct.pack("<Q", flush))
             except OSError:
                 pass
         return n
 
+    def set_failed(self, error_code: int = errors.EFAILEDSOCKET,
+                   reason: str = "") -> bool:
+        """Socket death is no longer the end of the endpoint: the first
+        transport-level failure hands the remote endpoint to the health
+        checker, which probes with exponential backoff + jitter until a
+        reconnect (fresh HELLO/bulk handshake, NEW versioned socket id —
+        this id was already revoked by the base set_failed, so stale
+        writes fail cleanly) can succeed; Channel retry / backup-request
+        then recovers RPCs issued during the outage, and the endpoint's
+        circuit breaker is reset on revival (ramp-up gating)."""
+        first = super().set_failed(error_code, reason)
+        if (first and not self.is_server_side
+                and error_code != errors.ECLOSE
+                and _flags.get_flag("ici_fabric_health_check")):
+            try:
+                from ..rpc.health_check import start_health_check
+                start_health_check(self.remote_side)
+            except Exception:
+                pass
+        return first
+
     def _transport_close(self) -> None:
         try:
-            with self._conn_wlock:
-                _send_frame(self._conn, _F_FIN, b"")
+            self._ctrl_send(_F_FIN, b"")
         except OSError:
             pass
         try:
@@ -987,13 +1348,19 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._close_bulk()
 
     def _close_bulk(self) -> None:
-        """Tear down the bulk conn.  Safe while writers race: fab_send on
-        a closed handle fails cleanly (shared-ptr registry), and the
-        serial read loop has already claimed every pending frame by the
-        time teardown runs."""
-        h, self._bulk = self._bulk, 0
-        if h and self._blib is not None:
-            self._blib.brpc_tpu_fab_conn_close(h)
+        """Tear down the bulk conn WITHOUT starting revival (socket-level
+        teardown).  Safe while writers race: fab_send on a closed handle
+        fails cleanly (shared-ptr registry), and the serial read loop has
+        already claimed every pending frame by the time teardown runs."""
+        with self._bulk_lock:
+            h, self._bulk = self._bulk, 0
+            pending, self._reestab_pending = self._reestab_pending, None
+            lib = self._blib
+        if h and lib is not None:
+            lib.brpc_tpu_fab_conn_close(h)
+        if pending is not None:
+            pending[0].brpc_tpu_fab_conn_close(pending[1])
+        self._reestab_evt.set()        # unblock a parked revival thread
 
 
 def connect_any(ep, local_dev: Optional[int] = None):

@@ -46,6 +46,11 @@ class NamingService:
     def supports_watch(self) -> bool:
         return False
 
+    def watch(self) -> List[ServerEntry]:
+        """One blocking watch round (sources with supports_watch()):
+        returns when membership changed or the source's hold elapsed."""
+        return self.get_servers()
+
 
 def _parse_line(line: str) -> Optional[ServerEntry]:
     line = line.split("#", 1)[0].strip()
@@ -135,27 +140,62 @@ class MeshNamingService(NamingService):
 
 
 class ConsulNamingService(NamingService):
-    """GET http://host:port/v1/health/service/<name> (consul-compatible
-    JSON: [{"Service": {"Address": ..., "Port": ...}}, ...]); also accepts a
-    plain JSON list of "host:port" strings for generic HTTP discovery."""
+    """Consul health API with the BLOCKING long-poll watch (reference
+    policy/consul_naming_service.cpp:99-114): the first GET primes the
+    membership index from the ``X-Consul-Index`` response header, and
+    every subsequent round long-polls
+    ``.../v1/health/service/<name>?index=<last>&wait=60s`` — the server
+    holds the request open until membership moves past <last> (or the
+    wait elapses), so changes propagate in one round trip instead of one
+    polling period.  Also accepts a plain JSON list of "host:port"
+    strings for generic HTTP discovery (no index header → degrades to
+    plain periodic GETs through the same code path)."""
+
+    WAIT = "60s"            # consul-side hold; client timeout adds slack
 
     def __init__(self, rest: str):
         hostport, _, name = rest.partition("/")
         self.url = f"http://{hostport}/v1/health/service/{name}"
+        self.last_index: Optional[str] = None
 
-    def get_servers(self) -> List[ServerEntry]:
-        with urllib.request.urlopen(self.url, timeout=5) as r:
-            data = json.loads(r.read().decode())
+    def supports_watch(self) -> bool:
+        return True
+
+    def _fetch(self, url: str, timeout: float):
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return (r.headers.get("X-Consul-Index"),
+                    json.loads(r.read().decode()))
+
+    @staticmethod
+    def parse_health_response(data) -> List[ServerEntry]:
         out = []
         for item in data:
             if isinstance(item, str):
                 out.append(ServerEntry(parse_endpoint(item)))
             else:
                 svc = item.get("Service", {})
-                out.append(ServerEntry(EndPoint(
-                    scheme="tcp", host=svc.get("Address", ""),
-                    port=int(svc.get("Port", 0)))))
+                out.append(ServerEntry(
+                    EndPoint(scheme="tcp", host=svc.get("Address", ""),
+                             port=int(svc.get("Port", 0))),
+                    tag=",".join(svc.get("Tags") or [])))
         return out
+
+    def get_servers(self) -> List[ServerEntry]:
+        idx, data = self._fetch(self.url, timeout=5)
+        if idx:
+            self.last_index = idx
+        return self.parse_health_response(data)
+
+    def watch(self) -> List[ServerEntry]:
+        """One blocking watch round; returns the (possibly unchanged)
+        membership when the server releases the poll."""
+        if self.last_index is None:
+            return self.get_servers()        # prime the index first
+        url = f"{self.url}?index={self.last_index}&wait={self.WAIT}"
+        idx, data = self._fetch(url, timeout=75.0)
+        if idx:
+            self.last_index = idx
+        return self.parse_health_response(data)
 
 
 class RemoteFileNamingService(NamingService):
@@ -299,6 +339,9 @@ class NamingServiceThread:
             log.log_every_n(log.WARNING, 60, "naming %s failed: %s",
                             self.url, e)
             return
+        self._publish(entries)
+
+    def _publish(self, entries: List[ServerEntry]) -> None:
         if self.filter_fn is not None:
             entries = [e for e in entries if self.filter_fn(e)]
         with self._lock:
@@ -316,6 +359,31 @@ class NamingServiceThread:
                     pass
 
     def _run(self) -> None:
+        if self.ns.supports_watch():
+            # blocking watch loop: each round holds a long poll at the
+            # source (consul index=/wait=) and publishes the moment it
+            # releases — membership changes propagate in one round trip,
+            # not one polling period.  Errors degrade to the polling
+            # cadence so a down registry isn't hammered.
+            while not self._stop.is_set():
+                try:
+                    entries = self.ns.watch()
+                except Exception as e:
+                    log.log_every_n(log.WARNING, 60,
+                                    "naming watch %s failed: %s",
+                                    self.url, e)
+                    if self._stop.wait(_flags.get_flag("ns_poll_interval_s")):
+                        return
+                    continue
+                self._publish(entries)
+                if getattr(self.ns, "last_index", "armed") is None:
+                    # the source answered without a blocking index (a
+                    # plain-JSON discovery endpoint): degrade to the
+                    # polling cadence instead of hot-looping GETs
+                    if self._stop.wait(
+                            _flags.get_flag("ns_poll_interval_s")):
+                        return
+            return
         while not self._stop.wait(_flags.get_flag("ns_poll_interval_s")):
             self._poll_once()
 

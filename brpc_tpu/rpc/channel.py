@@ -38,6 +38,13 @@ class ChannelOptions:
     # final.  Ignored when backup_request_ms is set (that is already the
     # user's explicit hedging schedule).
     retry_on_timeout: bool = False
+    # Base delay before a retry after a connection-class failure
+    # (EFAILEDSOCKET/ECONNREFUSED/...), doubling per retry with ±25%
+    # seeded jitter.  0 (default) retries immediately — the historical
+    # behavior.  Spaced retries are what let one generously-budgeted
+    # call issued DURING an endpoint outage survive until health-check
+    # revival brings the peer back (docs/PARITY.md failure semantics).
+    retry_backoff_ms: int = 0
     connect_timeout_ms: int = 1000
     auth: object = None                 # Authenticator
     ssl_context: object = None          # ssl.SSLContext for TLS channels
@@ -306,6 +313,16 @@ class Channel:
                 raise ConnectionError("no available server")
         else:
             ep = self._endpoint
+            # circuit breaker gating for single-endpoint channels: while
+            # the endpoint is isolated (tripped by consecutive failures),
+            # fail fast instead of stampeding reconnects at a recovering
+            # peer — the health checker alone probes it, and its revival
+            # (mark_recovered) lifts the isolation (cluster_recover
+            # ramp-up discipline applied to one endpoint)
+            from .circuit_breaker import BreakerRegistry
+            if BreakerRegistry.instance().breaker(ep).is_isolated():
+                raise ConnectionError(
+                    f"{ep} isolated by circuit breaker")
         cntl._selected_endpoint = ep
         group = self._channel_signature()
         ssl_ctx = self.options.ssl_context
@@ -363,8 +380,8 @@ class Channel:
         short = getattr(cntl, "_short_socket", None)
         if short is not None:
             short.set_failed(errors.ECLOSE, "short connection done")
+        sel = getattr(cntl, "_selected_endpoint", None)
         if self._lb is not None:
-            sel = getattr(cntl, "_selected_endpoint", None)
             if sel is not None:
                 self._lb.feedback(sel, cntl.error_code_, cntl.latency_us)
                 # circuit breaker + health-check revival (SURVEY.md §5.3)
@@ -376,6 +393,16 @@ class Channel:
                     lb.exclude(sel, breaker.isolated_until())
                     start_health_check(
                         sel, on_revived=lambda ep: lb.exclude(ep, 0.0))
+        elif sel is not None:
+            # single-endpoint channels feed the same breaker: repeated
+            # failures trip isolation (gating reconnect stampedes in
+            # _select_socket) and hand the endpoint to the health
+            # checker, whose successful probe resets the breaker
+            from .circuit_breaker import BreakerRegistry
+            if not BreakerRegistry.instance().breaker(sel).on_call_end(
+                    cntl.error_code_):
+                from .health_check import start_health_check
+                start_health_check(sel)
 
 
 class _FilteredWatcher:

@@ -19,6 +19,7 @@ response sender closure.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -52,6 +53,7 @@ class Controller:
         self.max_retry: Optional[int] = None
         self.backup_request_ms: Optional[int] = None
         self.retry_on_timeout: Optional[bool] = None
+        self.retry_backoff_ms: Optional[int] = None
         self.retried_count: int = 0
         self.current_try: int = 0
         self.latency_us: int = 0
@@ -147,6 +149,8 @@ class Controller:
             self.backup_request_ms = opts.backup_request_ms
         if self.retry_on_timeout is None:
             self.retry_on_timeout = opts.retry_on_timeout
+        if self.retry_backoff_ms is None:
+            self.retry_backoff_ms = getattr(opts, "retry_backoff_ms", 0)
         # +1: versions are try indices 0..max_retry
         self._cid = bthread_id.create_ranged(
             self, self._on_rpc_event, self.max_retry + 1)
@@ -282,11 +286,37 @@ class Controller:
             self.retried_count += 1
             bthread_id.reset_version(self._cid, self.current_try)  # stale old tries
             self._schedule_try_timer()
-            self._issue_rpc()
+            delay_s = self._retry_backoff_s()
+            if delay_s > 0:
+                # spaced retry: the endpoint may be DOWN rather than
+                # flaky — immediate re-connects would burn the whole
+                # retry budget in microseconds, while spaced ones ride
+                # out an outage until health-check revival brings the
+                # peer back.  The deadline timer armed above still
+                # bounds the call; a delay past it just loses to
+                # ERPCTIMEDOUT, which is correct.
+                from ..bthread import scheduler as _sched
+                TimerThread.instance().schedule_after(
+                    lambda: _sched.start_background(
+                        self._issue_rpc, name="retry_backoff"),
+                    delay_s)
+            else:
+                self._issue_rpc()
             bthread_id.unlock(cid)
             return
         self.set_failed(error_code)
         self._end_rpc(cid)
+
+    def _retry_backoff_s(self) -> float:
+        """Exponential backoff with deterministic per-call jitter for
+        connection-failure retries; 0 when the channel didn't opt in."""
+        base_ms = self.retry_backoff_ms or 0
+        if base_ms <= 0:
+            return 0.0
+        delay_ms = min(base_ms * (2 ** (self.retried_count - 1)),
+                       1000.0)
+        rng = random.Random((self._cid << 8) ^ self.retried_count)
+        return delay_ms * (1.0 + 0.25 * rng.random()) / 1000.0
 
     @staticmethod
     def _retryable(error_code: int) -> bool:

@@ -12,6 +12,22 @@ the same place a lossy or partitioned network would.
         ...   # every write toward ep silently vanishes
 
 Deterministic given a seed; thread-safe; uninstalls on context exit.
+
+Beyond the Socket.write boundary, ``FabricFaultPlan`` reaches the two
+planes of a cross-process ici:// fabric socket (ici/fabric.py):
+
+  * the CONTROL channel (sever after the Nth outbound frame, count
+    inbound frames and kill the process — "peer crash"),
+  * the native BULK plane (sever now / after a payload-byte watermark
+    lands mid-``writev``, drop or delay parked frames — wired through
+    ``native/fabric.cpp``'s ``brpc_tpu_fab_chaos``), and
+  * the HELLO / bulk re-establishment handshakes (refuse the next N).
+
+Every knob is a count, byte watermark, or seeded ratio — a plan with a
+fixed seed injects the identical fault sequence on every run, which is
+what lets the chaos tests drive recovery paths deterministically in
+tier-1.  Plans are scoped with ``inject_fabric`` (or ``install_fabric``)
+and leak no state once uninstalled.
 """
 from __future__ import annotations
 
@@ -81,3 +97,185 @@ class inject:
 
     def __exit__(self, *exc) -> None:
         install(self._prev)
+
+
+# ---- fabric chaos plans -------------------------------------------------
+
+# native chaos modes (native/fabric.cpp brpc_tpu_fab_chaos)
+CHAOS_CLEAR = 0
+CHAOS_SEVER_AFTER_OUT_BYTES = 1
+CHAOS_DROP_FRAMES = 2
+CHAOS_DELAY_PARK_MS = 3
+CHAOS_SEVER_NOW = 4
+
+
+class FabricFaultPlan:
+    """A deterministic fault plan for ici:// fabric sockets.
+
+    All knobs are counts/watermarks (exact) or ratios drawn from a
+    seeded RNG (reproducible), and apply only to sockets accepted by
+    ``match`` (default: every fabric socket).  Consulted by
+    ``ici/fabric.py`` at well-defined points:
+
+      control_sever_after_frames  sever the control TCP after this many
+                                  outbound control frames (0/None = off)
+      control_drop_ratio          seeded per-frame drop of outbound
+                                  control frames (a lossy control link)
+      die_after_control_frames    os._exit(137) after this many INBOUND
+                                  control frames — the "peer process
+                                  killed" fault, installed in the victim
+      bulk_sever_now              sever the bulk conn the moment it is
+                                  (re)attached — bulk-plane death with a
+                                  live control channel
+      bulk_sever_after_bytes      native watermark: the write that
+                                  crosses it is truncated mid-writev
+      bulk_drop_frames            native: next N received bulk frames
+                                  vanish before parking (descriptor
+                                  arrives, claim never satisfied)
+      bulk_delay_park_ms          native: park received bulk frames only
+                                  after this many ms (descriptor/claim
+                                  skew)
+      refuse_bulk_handshakes      refuse the next N bulk-plane
+                                  (re)establishment handshakes
+      refuse_hellos               server refuses the next N control
+                                  HELLOs with HELLO_ERR
+
+    ``injected`` counts what actually fired, keyed by knob name."""
+
+    def __init__(self, seed: int = 0,
+                 match: Optional[Callable] = None,
+                 control_sever_after_frames: int = 0,
+                 control_drop_ratio: float = 0.0,
+                 die_after_control_frames: int = 0,
+                 bulk_sever_now: bool = False,
+                 bulk_sever_after_bytes: int = 0,
+                 bulk_drop_frames: int = 0,
+                 bulk_delay_park_ms: int = 0,
+                 refuse_bulk_handshakes: int = 0,
+                 refuse_hellos: int = 0):
+        self.match = match
+        self.control_sever_after_frames = control_sever_after_frames
+        self.control_drop_ratio = control_drop_ratio
+        self.die_after_control_frames = die_after_control_frames
+        self.bulk_sever_now = bulk_sever_now
+        self.bulk_sever_after_bytes = bulk_sever_after_bytes
+        self.bulk_drop_frames = bulk_drop_frames
+        self.bulk_delay_park_ms = bulk_delay_park_ms
+        self._refuse_bulk = refuse_bulk_handshakes
+        self._refuse_hellos = refuse_hellos
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._ctrl_out = 0           # outbound control frames seen
+        self._ctrl_in = 0            # inbound control frames seen
+        self.injected = {"control_sever": 0, "control_drop": 0,
+                         "bulk_chaos": 0, "refuse_bulk": 0,
+                         "refuse_hello": 0, "die": 0}
+
+    def _matches(self, socket) -> bool:
+        return self.match is None or bool(self.match(socket))
+
+    # -- control channel hooks (called from FabricSocket) ----------------
+    def on_control_send(self, socket) -> str:
+        """PASS / DROP / ERROR for one outbound control frame."""
+        if not self._matches(socket):
+            return PASS
+        with self._lock:
+            self._ctrl_out += 1
+            if (self.control_sever_after_frames
+                    and self._ctrl_out >= self.control_sever_after_frames):
+                self.control_sever_after_frames = 0   # fire once
+                self.injected["control_sever"] += 1
+                return ERROR
+            if (self.control_drop_ratio
+                    and self._rng.random() < self.control_drop_ratio):
+                self.injected["control_drop"] += 1
+                return DROP
+        return PASS
+
+    def on_control_recv(self, socket) -> None:
+        """Counts inbound control frames; kills the process at the
+        configured count (the deterministic "peer crash" fault)."""
+        if not self.die_after_control_frames or not self._matches(socket):
+            return
+        with self._lock:
+            self._ctrl_in += 1
+            if self._ctrl_in < self.die_after_control_frames:
+                return
+            self.injected["die"] += 1
+        import os
+        os._exit(137)
+
+    # -- bulk plane hooks ------------------------------------------------
+    def on_bulk_attach(self, socket, lib, handle: int) -> None:
+        """Applies the native chaos knobs to a just-attached bulk conn."""
+        if not handle or lib is None or not self._matches(socket):
+            return
+        fired = False
+        if self.bulk_sever_after_bytes:
+            lib.brpc_tpu_fab_chaos(handle, CHAOS_SEVER_AFTER_OUT_BYTES,
+                                   self.bulk_sever_after_bytes)
+            fired = True
+        if self.bulk_drop_frames:
+            lib.brpc_tpu_fab_chaos(handle, CHAOS_DROP_FRAMES,
+                                   self.bulk_drop_frames)
+            fired = True
+        if self.bulk_delay_park_ms:
+            lib.brpc_tpu_fab_chaos(handle, CHAOS_DELAY_PARK_MS,
+                                   self.bulk_delay_park_ms)
+            fired = True
+        if self.bulk_sever_now:
+            lib.brpc_tpu_fab_chaos(handle, CHAOS_SEVER_NOW, 0)
+            fired = True
+        if fired:
+            with self._lock:
+                self.injected["bulk_chaos"] += 1
+
+    # -- handshake hooks -------------------------------------------------
+    def on_bulk_handshake(self, socket=None) -> bool:
+        """True → refuse this bulk (re)establishment handshake."""
+        if socket is not None and not self._matches(socket):
+            return False
+        with self._lock:
+            if self._refuse_bulk > 0:
+                self._refuse_bulk -= 1
+                self.injected["refuse_bulk"] += 1
+                return True
+        return False
+
+    def on_hello(self) -> bool:
+        """True → the server refuses this control HELLO."""
+        with self._lock:
+            if self._refuse_hellos > 0:
+                self._refuse_hellos -= 1
+                self.injected["refuse_hello"] += 1
+                return True
+        return False
+
+
+_fabric_active: Optional[FabricFaultPlan] = None
+
+
+def install_fabric(plan: Optional[FabricFaultPlan]) -> None:
+    global _fabric_active
+    _fabric_active = plan
+
+
+def fabric_active() -> Optional[FabricFaultPlan]:
+    return _fabric_active
+
+
+class inject_fabric:
+    """Context manager: install a fabric fault plan for the with-block,
+    restore the previous plan after — no state leaks between tests."""
+
+    def __init__(self, plan: FabricFaultPlan):
+        self.plan = plan
+        self._prev: Optional[FabricFaultPlan] = None
+
+    def __enter__(self) -> FabricFaultPlan:
+        self._prev = _fabric_active
+        install_fabric(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        install_fabric(self._prev)

@@ -7,6 +7,7 @@ and its circuit breaker is reset.  Probing runs on the shared TimerThread.
 """
 from __future__ import annotations
 
+import random
 import threading
 from typing import Callable, Dict, Optional
 
@@ -17,7 +18,13 @@ from ..bthread.timer_thread import TimerThread
 from .circuit_breaker import BreakerRegistry
 
 _flags.define_flag("health_check_interval_s", 0.1,
-                   "period between health probes of a failed endpoint")
+                   "first probe delay for a failed endpoint (doubles per "
+                   "failed probe up to health_check_max_interval_s)")
+_flags.define_flag("health_check_max_interval_s", 2.0,
+                   "cap on the exponential probe backoff")
+_flags.define_flag("health_check_jitter", 0.2,
+                   "fraction of the probe interval added as seeded "
+                   "random jitter (de-synchronizes probers)")
 
 
 def probe_endpoint(ep: EndPoint, timeout: float = 1.0) -> bool:
@@ -35,30 +42,52 @@ def probe_endpoint(ep: EndPoint, timeout: float = 1.0) -> bool:
         if ep.scheme == SCHEME_ICI:
             from ..ici.transport import _listeners as il, _listeners_lock as ill
             with ill:
-                return ep.device_id in il
+                if ep.device_id in il:
+                    return True
+            # cross-process fabric endpoint: ask the owner process over
+            # its control listener (a connectionless _F_PING — no fabric
+            # socket is created by the probe)
+            from ..ici.fabric import FabricNode
+            node = FabricNode.instance()
+            if node is not None and \
+                    FabricNode.device_owner(ep.device_id) != node.process_id:
+                return node.ping(ep.device_id, timeout=timeout)
+            return False
     except OSError:
         return False
     return False
 
 
 class HealthCheckTask:
-    """Repeating probe for one endpoint until it revives."""
+    """Repeating probe for one endpoint until it revives.  Probe delays
+    back off exponentially (base health_check_interval_s, doubling to
+    health_check_max_interval_s) with seeded jitter so a fleet of
+    checkers never stampedes a recovering peer."""
 
     def __init__(self, ep: EndPoint,
                  on_revived: Optional[Callable[[EndPoint], None]] = None,
                  app_check: Optional[Callable[[EndPoint], bool]] = None,
-                 max_probes: int = 0):
+                 max_probes: int = 0, seed: Optional[int] = None):
         self.ep = ep
         self.on_revived = on_revived
         self.app_check = app_check          # app-level RPC probe
         self.probe_count = 0
         self.max_probes = max_probes        # 0 = unlimited
+        self._rng = random.Random(
+            seed if seed is not None else hash(ep) & 0xFFFFFFFF)
         self._cancelled = threading.Event()
         self._schedule()
 
+    def next_delay_s(self) -> float:
+        base = _flags.get_flag("health_check_interval_s")
+        cap = _flags.get_flag("health_check_max_interval_s")
+        d = min(base * (2 ** min(self.probe_count, 16)), cap)
+        return d * (1.0 + _flags.get_flag("health_check_jitter")
+                    * self._rng.random())
+
     def _schedule(self) -> None:
-        TimerThread.instance().schedule_after(
-            self._probe, _flags.get_flag("health_check_interval_s"))
+        TimerThread.instance().schedule_after(self._probe,
+                                              self.next_delay_s())
 
     def _probe(self) -> None:
         if self._cancelled.is_set():

@@ -45,6 +45,15 @@ class ServerOptions:
     # its usercode_in_pthread flag is the inverse).  Minimal latency; only
     # safe when handlers are fast/non-blocking.
     usercode_inline: bool = False
+    # The reference's usercode_in_pthread analogue: run user handlers on
+    # a dedicated backup THREAD pool instead of scheduler workers.  The
+    # scheduler compensates for workers parked in butexes, but a
+    # CPU-BOUND (GIL-holding) handler never parks — enough of them
+    # occupy every worker and stall unrelated sockets' reads (the
+    # docs/en/io.md hazard).  With the pool, scheduler workers only
+    # parse/dispatch and stay available no matter what usercode does.
+    usercode_in_pthread: bool = False
+    usercode_backup_threads: int = 8
     ssl_context: Any = None             # ssl.SSLContext for TLS listeners
     # per-RPC session data: factory() -> object, pooled across requests
     # (reference server.h:146-150 session_local_data_factory; reached via
@@ -83,6 +92,7 @@ class Server:
         self._session_data_pool: List[Any] = []
         self._session_data_lock = threading.Lock()
         self._thread_local = threading.local()
+        self.usercode_pool = None        # usercode_in_pthread backup pool
 
     # ---- registry -----------------------------------------------------
     def add_service(self, svc) -> int:
@@ -212,6 +222,11 @@ class Server:
         self._listen_endpoints = []     # fresh run, fresh addresses
         with self._conn_lock:
             self._connections = []
+        if self.options.usercode_in_pthread and self.usercode_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self.usercode_pool = ThreadPoolExecutor(
+                max_workers=max(self.options.usercode_backup_threads, 1),
+                thread_name_prefix="usercode")
         if self.options.enable_builtin_services:
             from .builtin import register_builtin_services
             register_builtin_services(self)
@@ -366,6 +381,9 @@ class Server:
                 except Exception:
                     pass
             s.set_failed(errors.ELOGOFF, "server stopping")
+        pool, self.usercode_pool = self.usercode_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         self._stopped.set()
         self._started = False
         return 0

@@ -61,7 +61,7 @@ class SocketMap:
             if e.socket is not None and not e.socket.failed \
                     and not e.socket.logoff:
                 return e.socket
-            s = self._connect(ep, ssl_context, connect_timeout)
+            s = self._checked_connect(ep, ssl_context, connect_timeout)
             s.messenger = messenger
             e.socket = s
             return s
@@ -77,9 +77,28 @@ class SocketMap:
                 s = e.pooled.pop()
                 if not s.failed and not s.logoff:
                     return s
-        s = self._connect(ep, ssl_context, connect_timeout)
+        s = self._checked_connect(ep, ssl_context, connect_timeout)
         s.messenger = messenger
         return s
+
+    @classmethod
+    def _checked_connect(cls, ep: EndPoint, ssl_context=None,
+                         connect_timeout: float = 5.0) -> Socket:
+        """_connect, but an unreachable endpoint is handed to the health
+        checker before the error propagates: the reference starts a
+        health check whenever a connect fails, which keeps a DOWN
+        endpoint under backoff probing across the whole outage (a failed
+        connect creates no socket, so the socket-failure hand-off alone
+        would miss retries issued while the peer is gone)."""
+        try:
+            return cls._connect(ep, ssl_context, connect_timeout)
+        except Exception:
+            try:
+                from .health_check import start_health_check
+                start_health_check(ep)
+            except Exception:
+                pass
+            raise
 
     def return_pooled_socket(self, ep: EndPoint, s: Socket,
                              group: Any = "") -> None:
@@ -92,7 +111,7 @@ class SocketMap:
     def get_short_socket(self, ep: EndPoint, messenger=None,
                          ssl_context=None,
                          connect_timeout: float = 5.0) -> Socket:
-        s = self._connect(ep, ssl_context, connect_timeout)
+        s = self._checked_connect(ep, ssl_context, connect_timeout)
         s.messenger = messenger
         return s
 

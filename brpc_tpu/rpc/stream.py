@@ -327,9 +327,11 @@ class Stream:
                 # parses the frame and parks in the claim while the bulk
                 # writev is still draining, overlapping its per-frame
                 # Python work with the transfer.  A bulk send that fails
-                # after the descriptor went out kills the bulk conn,
-                # which fails the peer's claim (-2) and with it the
-                # socket — no silent gap in the byte stream.
+                # after the descriptor went out degrades the bulk plane,
+                # which fails the peer's claim (-2) and with it THIS
+                # stream (descriptor-consistency: no silent gap in the
+                # stream's byte sequence) — the socket itself survives
+                # and later frames ride the inline path until revival.
                 ss.frame_type = FRAME_DATA_BULK
                 desc = IOBuf(_BULK_DESC.pack(bulk_uuid, len(payload)))
                 rc = sock.write(pack_frame(meta, desc))
@@ -350,11 +352,12 @@ class Stream:
                 rc = sock.write(pack_frame(meta, payload))
         if bulk_exc is not None:
             # the descriptor is on the wire but the payload never went.
-            # A native write error already killed the bulk conn, but a
-            # PYTHON-side failure (e.g. materializing a device block)
+            # A native write error already degraded the bulk plane, but
+            # a PYTHON-side failure (e.g. materializing a device block)
             # leaves it alive — sever it explicitly so the peer's pending
-            # claim fails promptly (-2) instead of stalling its control
-            # loop for the full claim timeout (review finding)
+            # claim fails promptly (-2) and closes the peer's stream,
+            # instead of stalling its control loop for the full claim
+            # timeout (review finding)
             abort = getattr(sock, "stream_bulk_abort", None)
             if abort is not None:
                 try:
@@ -427,16 +430,32 @@ def on_stream_frame(meta, body: IOBuf, socket) -> None:
         try:
             data = socket.stream_bulk_claim(uuid, blen)
         except Exception as e:
-            # the bulk plane died under the stream: dropping the frame
-            # would silently corrupt the byte stream, so the socket (the
-            # fabric contract: bulk death == socket death) and the
-            # stream both fail
+            # the bulk plane died under the stream: this descriptor's
+            # bytes will never arrive, and dropping the frame would
+            # silently corrupt the byte stream — so THIS stream fails
+            # (descriptor-consistency rule).  The socket survives: the
+            # control channel is intact, later/other streams fall back
+            # to the inline wire path, and the bulk plane re-establishes
+            # in the background (bulk_plane_failed).  Sockets without a
+            # degradation hook keep the old bulk-death==socket-death
+            # contract.
             from ..butil import logging as log
             log.error("stream %d bulk frame %#x unclaimable: %s",
                       s.sid, uuid, e)
+            degrade = getattr(socket, "bulk_plane_failed", None)
             try:
-                socket.set_failed(errors.EFAILEDSOCKET,
-                                  f"stream bulk claim failed: {e}")
+                if degrade is not None:
+                    degrade()
+                    # the socket survives, so the WRITER must be told its
+                    # stream died (its bytes are gone) — otherwise it
+                    # keeps writing into the void until its window wedges
+                    try:
+                        s._send_frame(FRAME_RST, None)
+                    except Exception:
+                        pass
+                else:
+                    socket.set_failed(errors.EFAILEDSOCKET,
+                                      f"stream bulk claim failed: {e}")
             finally:
                 s.on_remote_close()
             return

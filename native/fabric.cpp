@@ -160,6 +160,14 @@ struct BulkConn {
   bool dead = false;
   std::thread reader;
   std::atomic<uint64_t> bytes_in{0}, bytes_out{0};
+  // ---- deterministic chaos knobs (brpc_tpu_fab_chaos) ----
+  // payload-byte watermark after which the NEXT write severs the conn
+  // mid-writev (truncated frame on the wire); -1 = off
+  std::atomic<int64_t> chaos_sever_after{-1};
+  // drop the next N fully-received frames (bytes vanish before parking)
+  std::atomic<int64_t> chaos_drop_frames{0};
+  // park each received frame only after this many milliseconds
+  std::atomic<int64_t> chaos_delay_park_ms{0};
   // Receive-buffer pool: steady-state bulk traffic is uniform-sized
   // multi-MB frames, and a fresh malloc per frame costs ~2k page faults
   // per 8 MB — measurable against the send pump on a shared core.
@@ -232,6 +240,16 @@ struct BulkConn {
         break;
       }
       bytes_in.fetch_add(len, std::memory_order_relaxed);
+      if (chaos_drop_frames.load(std::memory_order_relaxed) > 0) {
+        // chaos: the frame vanishes after full receipt — its descriptor
+        // will arrive on the control channel but the claim never finds it
+        chaos_drop_frames.fetch_sub(1, std::memory_order_relaxed);
+        free(buf);
+        continue;
+      }
+      int64_t delay = chaos_delay_park_ms.load(std::memory_order_relaxed);
+      if (delay > 0)
+        std::this_thread::sleep_for(std::chrono::milliseconds(delay));
       std::lock_guard<std::mutex> g(mu);
       // duplicate uuid would leak the old buffer — replace defensively
       Frame* old = frames.seek(uuid);
@@ -242,6 +260,38 @@ struct BulkConn {
     std::lock_guard<std::mutex> g(mu);
     dead = true;
     cv.notify_all();
+  }
+
+  // Chaos sever-mid-write: when the configured payload-byte watermark
+  // lands inside this frame, write the header plus only the allowed
+  // prefix, then sever — the peer's reader sees a truncated frame and
+  // marks the conn dead, exactly the kernel-reset shape.  Caller holds
+  // wmu.  Returns true when the chaos path consumed the write.
+  bool chaos_truncate_write(uint64_t uuid, uint64_t len,
+                            const struct iovec* payload, int pcount) {
+    int64_t watermark = chaos_sever_after.load(std::memory_order_relaxed);
+    if (watermark < 0) return false;
+    int64_t out = (int64_t)bytes_out.load(std::memory_order_relaxed);
+    uint64_t allowed =
+        out >= watermark ? 0 : (uint64_t)(watermark - out);
+    if (allowed >= len) return false;  // frame fits under the watermark
+    uint8_t hdr[16];
+    memcpy(hdr, &uuid, 8);
+    memcpy(hdr + 8, &len, 8);
+    std::vector<struct iovec> iov;
+    iov.push_back({hdr, 16});
+    uint64_t left = allowed;
+    for (int i = 0; i < pcount && left > 0; ++i) {
+      size_t take = std::min<uint64_t>(left, payload[i].iov_len);
+      if (take) iov.push_back({payload[i].iov_base, take});
+      left -= take;
+    }
+    write_full_iov(fd, iov.data(), (int)iov.size());
+    ::shutdown(fd, SHUT_RDWR);
+    std::lock_guard<std::mutex> g2(mu);
+    dead = true;
+    cv.notify_all();
+    return true;
   }
 
   // 0 ok; -1 connection dead/failed.
@@ -255,6 +305,7 @@ struct BulkConn {
       std::lock_guard<std::mutex> g2(mu);
       if (dead) return -1;
     }
+    if (chaos_truncate_write(uuid, len, iov + 1, len ? 1 : 0)) return -1;
     if (!write_full_iov(fd, iov, len ? 2 : 1)) {
       std::lock_guard<std::mutex> g2(mu);
       dead = true;
@@ -287,6 +338,9 @@ struct BulkConn {
       std::lock_guard<std::mutex> g2(mu);
       if (dead) return -1;
     }
+    if (chaos_truncate_write(uuid, total, iov.data() + 1,
+                             (int)iov.size() - 1))
+      return -1;
     if (!write_full_iov(fd, iov.data(), (int)iov.size())) {
       std::lock_guard<std::mutex> g2(mu);
       dead = true;
@@ -363,6 +417,9 @@ struct Listener {
   std::condition_variable cv;
   std::unordered_map<std::string, std::shared_ptr<BulkConn>> pending;
   bool stopped = false;
+  // chaos: refuse the next N key handshakes (the parked conn is closed
+  // right after its binding header, so the claim never finds it)
+  std::atomic<int64_t> chaos_refuse{0};
 
   void accept_loop(int afd, bool tcp) {
     for (;;) {
@@ -396,6 +453,11 @@ struct Listener {
       }
       tv = {0, 0};  // back to blocking for the data phase
       setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      if (chaos_refuse.load(std::memory_order_relaxed) > 0) {
+        chaos_refuse.fetch_sub(1, std::memory_order_relaxed);
+        ::close(cfd);
+        continue;
+      }
       auto conn = std::make_shared<BulkConn>();
       conn->fd = cfd;
       conn->start_reader();
@@ -451,8 +513,16 @@ struct Listener {
 
 static std::mutex g_mu;
 static std::atomic<uint64_t> g_next{1};
-static std::unordered_map<uint64_t, std::shared_ptr<BulkConn>> g_conns;
-static std::unordered_map<uint64_t, std::shared_ptr<Listener>> g_listeners;
+// Heap-allocated and intentionally never freed: running these maps'
+// static destructors at process exit would destruct BulkConn/Listener
+// objects — joining (or terminating on) reader/acceptor threads that
+// may be mid-read — concurrently with whatever other threads exit()
+// left running.  Leaking the registry sidesteps the static-destruction
+// race entirely; the OS reclaims the fds and memory.
+static auto& g_conns =
+    *new std::unordered_map<uint64_t, std::shared_ptr<BulkConn>>();
+static auto& g_listeners =
+    *new std::unordered_map<uint64_t, std::shared_ptr<Listener>>();
 
 static std::shared_ptr<BulkConn> find_conn(uint64_t h) {
   std::lock_guard<std::mutex> g(g_mu);
@@ -640,6 +710,63 @@ uint64_t brpc_tpu_fab_bytes(uint64_t h, int dir) {
   if (c == nullptr) return 0;
   return dir == 0 ? c->bytes_in.load(std::memory_order_relaxed)
                   : c->bytes_out.load(std::memory_order_relaxed);
+}
+
+// 1 while the connection can still move frames, 0 once its reader or a
+// writer observed death.  The degradation path polls this BEFORE posting
+// a descriptor so a dead bulk plane is detected at a frame boundary —
+// the frame then falls back inline instead of stranding a descriptor
+// whose bytes can never arrive.
+int brpc_tpu_fab_alive(uint64_t h) {
+  auto c = nfab::find_conn(h);
+  if (c == nullptr) return 0;
+  std::lock_guard<std::mutex> g(c->mu);
+  return c->dead ? 0 : 1;
+}
+
+// Deterministic fault injection on one bulk connection (the chaos
+// harness behind rpc/fault_injection.py).  Modes:
+//   0 clear all knobs
+//   1 sever after `arg` total payload bytes written (mid-writev when the
+//     watermark lands inside a frame — the truncated-frame shape)
+//   2 drop the next `arg` fully-received frames before parking
+//   3 delay parking every received frame by `arg` ms
+//   4 sever now (shutdown both directions; reader marks dead)
+int brpc_tpu_fab_chaos(uint64_t h, int mode, int64_t arg) {
+  auto c = nfab::find_conn(h);
+  if (c == nullptr) return -1;
+  switch (mode) {
+    case 0:
+      c->chaos_sever_after.store(-1, std::memory_order_relaxed);
+      c->chaos_drop_frames.store(0, std::memory_order_relaxed);
+      c->chaos_delay_park_ms.store(0, std::memory_order_relaxed);
+      return 0;
+    case 1:
+      c->chaos_sever_after.store(arg, std::memory_order_relaxed);
+      return 0;
+    case 2:
+      c->chaos_drop_frames.store(arg, std::memory_order_relaxed);
+      return 0;
+    case 3:
+      c->chaos_delay_park_ms.store(arg, std::memory_order_relaxed);
+      return 0;
+    case 4:
+      c->shutdown_fd();
+      return 0;
+    default:
+      return -1;
+  }
+}
+
+// Refuse the next `refuse_n` key handshakes on the listener: the fresh
+// conn is closed right after its <klen><key> header, so the matching
+// claim (initial HELLO binding or a BULK_REESTABLISH) times out — the
+// deterministic "refuse a handshake" chaos hook.
+int brpc_tpu_fab_chaos_listener(uint64_t lh, int64_t refuse_n) {
+  auto l = nfab::find_listener(lh);
+  if (l == nullptr) return -1;
+  l->chaos_refuse.store(refuse_n, std::memory_order_relaxed);
+  return 0;
 }
 
 void brpc_tpu_fab_conn_close(uint64_t h) {

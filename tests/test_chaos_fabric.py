@@ -1,0 +1,944 @@
+"""Deterministic chaos harness for the self-healing ici:// fabric.
+
+Every test here drives a recovery path with an exact, seeded fault —
+native bulk-plane severs (including mid-``writev`` truncation), dropped
+frames, refused handshakes, control-channel severs, and a killed peer
+process — and asserts the documented failure/revival semantics:
+
+  * bulk-plane death with a live control channel degrades to the inline
+    wire path and re-establishes in the background (never socket death),
+  * a descriptor whose bytes will never arrive fails THAT stream, not
+    the socket,
+  * control-channel death fails in-flight RPCs promptly, hands the
+    endpoint to the health checker, and a spaced-retry RPC issued during
+    the outage succeeds once the peer returns — under a NEW versioned
+    socket id.
+
+Faults are counts/byte-watermarks (exact) or seeded ratios; plans are
+scoped with context managers (or per-child-process installs), so no
+fault state leaks between tests.
+"""
+import ctypes
+import os
+import socket as pysock
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc import fault_injection as fi
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.chaos
+
+
+def _free_port():
+    s = pysock.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pair(script: str, timeout: int = 240, expect_rc=(0, 0)):
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_NUM_PROCESSES", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, str(i), coord],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for i in range(2)]
+    outs, rcs = [], []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+        rcs.append(p.returncode)
+    assert list(rcs) == list(expect_rc), (
+        f"rcs={rcs} want={expect_rc}\n--- child0 ---\n{outs[0]}\n"
+        f"--- child1 ---\n{outs[1]}")
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Native chaos ABI (single process): the hooks behind FabricFaultPlan.
+# ---------------------------------------------------------------------------
+
+class TestNativeChaosABI:
+    @pytest.fixture()
+    def lib(self):
+        from brpc_tpu.butil import native
+        lib = native.load()
+        if lib is None:
+            pytest.skip("native core unavailable")
+        return lib
+
+    def _pair(self, lib, key):
+        port = ctypes.c_int()
+        uds = ctypes.create_string_buffer(108)
+        lh = lib.brpc_tpu_fab_listen(b"127.0.0.1", ctypes.byref(port),
+                                     uds, 108)
+        assert lh
+        ch = lib.brpc_tpu_fab_connect(b"127.0.0.1", port.value, key)
+        sh = lib.brpc_tpu_fab_accept(lh, key, 10_000_000)
+        assert ch and sh
+        return lh, ch, sh
+
+    def test_sever_after_bytes_truncates_mid_writev(self, lib):
+        """The write that crosses the watermark puts a TRUNCATED frame on
+        the wire: the peer's reader marks the conn dead and the claim
+        fails fast (-2), while frames fully sent before the watermark
+        stay claimable."""
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lh, ch, sh = self._pair(lib, b"sev")
+        try:
+            data = (ctypes.c_uint8 * 1000)(*([9] * 1000))
+            assert lib.brpc_tpu_fab_send(ch, 1, data, 1000) == 0
+            # watermark lands inside the NEXT frame
+            assert lib.brpc_tpu_fab_chaos(
+                ch, fi.CHAOS_SEVER_AFTER_OUT_BYTES, 1500) == 0
+            assert lib.brpc_tpu_fab_send(ch, 2, data, 1000) == -1
+            assert lib.brpc_tpu_fab_alive(ch) == 0
+            out, olen = u8p(), ctypes.c_uint64()
+            # frame 1 was parked before death: still claimable
+            assert lib.brpc_tpu_fab_recv(sh, 1, 5_000_000,
+                                         ctypes.byref(out),
+                                         ctypes.byref(olen)) == 0
+            assert olen.value == 1000
+            lib.brpc_tpu_fab_buf_release(sh, out, olen.value)
+            # frame 2 was truncated: dead conn, claim fails fast
+            t0 = time.monotonic()
+            rc = lib.brpc_tpu_fab_recv(sh, 2, 30_000_000,
+                                       ctypes.byref(out),
+                                       ctypes.byref(olen))
+            assert rc == -2
+            assert time.monotonic() - t0 < 5
+        finally:
+            lib.brpc_tpu_fab_conn_close(ch)
+            lib.brpc_tpu_fab_conn_close(sh)
+            lib.brpc_tpu_fab_listener_close(lh)
+
+    def test_drop_and_delay_frames(self, lib):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lh, ch, sh = self._pair(lib, b"drop")
+        try:
+            data = (ctypes.c_uint8 * 64)(*([4] * 64))
+            # drop exactly one frame; the conn stays alive
+            assert lib.brpc_tpu_fab_chaos(sh, fi.CHAOS_DROP_FRAMES, 1) == 0
+            assert lib.brpc_tpu_fab_send(ch, 10, data, 64) == 0
+            out, olen = u8p(), ctypes.c_uint64()
+            assert lib.brpc_tpu_fab_recv(sh, 10, 200_000,
+                                         ctypes.byref(out),
+                                         ctypes.byref(olen)) == -1
+            assert lib.brpc_tpu_fab_alive(sh) == 1
+            # the next frame parks normally
+            assert lib.brpc_tpu_fab_send(ch, 11, data, 64) == 0
+            assert lib.brpc_tpu_fab_recv(sh, 11, 5_000_000,
+                                         ctypes.byref(out),
+                                         ctypes.byref(olen)) == 0
+            lib.brpc_tpu_fab_buf_release(sh, out, olen.value)
+            # delay: the frame parks only after the configured latency
+            assert lib.brpc_tpu_fab_chaos(sh, fi.CHAOS_DELAY_PARK_MS,
+                                          150) == 0
+            assert lib.brpc_tpu_fab_send(ch, 12, data, 64) == 0
+            t0 = time.monotonic()
+            assert lib.brpc_tpu_fab_recv(sh, 12, 5_000_000,
+                                         ctypes.byref(out),
+                                         ctypes.byref(olen)) == 0
+            assert time.monotonic() - t0 >= 0.1
+            lib.brpc_tpu_fab_buf_release(sh, out, olen.value)
+            lib.brpc_tpu_fab_chaos(sh, fi.CHAOS_CLEAR, 0)
+        finally:
+            lib.brpc_tpu_fab_conn_close(ch)
+            lib.brpc_tpu_fab_conn_close(sh)
+            lib.brpc_tpu_fab_listener_close(lh)
+
+    def test_listener_refuses_next_handshake(self, lib):
+        port = ctypes.c_int()
+        uds = ctypes.create_string_buffer(108)
+        lh = lib.brpc_tpu_fab_listen(b"127.0.0.1", ctypes.byref(port),
+                                     uds, 108)
+        try:
+            assert lib.brpc_tpu_fab_chaos_listener(lh, 1) == 0
+            ch = lib.brpc_tpu_fab_connect(b"127.0.0.1", port.value, b"x")
+            assert ch                      # TCP connect itself succeeds
+            assert lib.brpc_tpu_fab_accept(lh, b"x", 300_000) == 0
+            # refusal budget spent: the next handshake binds normally
+            ch2 = lib.brpc_tpu_fab_connect(b"127.0.0.1", port.value, b"y")
+            sh2 = lib.brpc_tpu_fab_accept(lh, b"y", 5_000_000)
+            assert sh2
+            lib.brpc_tpu_fab_conn_close(ch)
+            lib.brpc_tpu_fab_conn_close(ch2)
+            lib.brpc_tpu_fab_conn_close(sh2)
+        finally:
+            lib.brpc_tpu_fab_listener_close(lh)
+
+
+# ---------------------------------------------------------------------------
+# Fault-plan semantics (single process): determinism + scoping.
+# ---------------------------------------------------------------------------
+
+class _FakeSock:
+    is_server_side = False
+    remote_side = None
+
+
+class TestFaultPlanSemantics:
+    def test_seeded_plans_reproduce_identical_decisions(self):
+        def run(seed):
+            plan = fi.FabricFaultPlan(seed=seed, control_drop_ratio=0.3)
+            s = _FakeSock()
+            return [plan.on_control_send(s) for _ in range(200)]
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)          # and the seed actually matters
+
+    def test_inject_fabric_scopes_and_restores(self):
+        outer = fi.FabricFaultPlan(seed=1)
+        inner = fi.FabricFaultPlan(seed=2)
+        assert fi.fabric_active() is None
+        with fi.inject_fabric(outer):
+            assert fi.fabric_active() is outer
+            with fi.inject_fabric(inner):
+                assert fi.fabric_active() is inner
+            assert fi.fabric_active() is outer
+        assert fi.fabric_active() is None
+
+    def test_match_scopes_plan_to_sockets(self):
+        hit = _FakeSock()
+        miss = _FakeSock()
+        plan = fi.FabricFaultPlan(control_sever_after_frames=1,
+                                  match=lambda s: s is hit)
+        assert plan.on_control_send(miss) == fi.PASS
+        assert plan.on_control_send(hit) == fi.ERROR
+        assert plan.injected["control_sever"] == 1
+
+    def test_refusal_budgets_are_exact(self):
+        plan = fi.FabricFaultPlan(refuse_bulk_handshakes=2, refuse_hellos=1)
+        assert plan.on_bulk_handshake() and plan.on_bulk_handshake()
+        assert not plan.on_bulk_handshake()
+        assert plan.on_hello() and not plan.on_hello()
+        assert plan.injected["refuse_bulk"] == 2
+        assert plan.injected["refuse_hello"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Stream claim failure fails the STREAM, not the socket (receiver side).
+# ---------------------------------------------------------------------------
+
+class TestStreamClaimFailure:
+    def test_claim_failure_fails_stream_and_degrades_not_socket(self):
+        from types import SimpleNamespace
+        from brpc_tpu.rpc import stream as stream_mod
+
+        events = {"degraded": 0, "set_failed": 0, "closed": []}
+
+        class Handler(rpc.StreamInputHandler):
+            def on_received_messages(self, sid, msgs):
+                pass
+
+            def on_closed(self, sid):
+                events["closed"].append(sid)
+
+        class Sock:
+            failed = False
+            is_server_side = True
+            on_failed_callbacks = []
+
+            def stream_bulk_claim(self, uuid, blen):
+                raise ConnectionError("bulk conn dead")
+
+            def bulk_plane_failed(self):
+                events["degraded"] += 1
+
+            def set_failed(self, *a, **k):
+                events["set_failed"] += 1
+
+        cntl = SimpleNamespace(accepted_stream_id=0)
+        s = stream_mod.stream_accept(cntl, rpc.StreamOptions(
+            handler=Handler()))
+        sock = Sock()
+        s.mark_connected(77, sock)
+
+        from brpc_tpu.proto import rpc_meta_pb2 as meta_pb
+        from brpc_tpu.butil.iobuf import IOBuf
+        meta = meta_pb.RpcMeta()
+        ss = meta.stream_settings
+        ss.stream_id = s.sid
+        ss.remote_stream_id = 77
+        ss.frame_type = stream_mod.FRAME_DATA_BULK
+        body = IOBuf(stream_mod._BULK_DESC.pack(0xDEAD, 4096))
+        stream_mod.on_stream_frame(meta, body, sock)
+
+        assert events["degraded"] == 1          # bulk plane degraded...
+        assert events["set_failed"] == 0        # ...but the socket lives
+        deadline = time.monotonic() + 5
+        while not events["closed"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert events["closed"] == [s.sid]      # the stream failed cleanly
+
+
+# ---------------------------------------------------------------------------
+# Revival machinery units: health-check backoff, breaker gating, retry
+# backoff spacing.
+# ---------------------------------------------------------------------------
+
+class TestRevivalUnits:
+    def test_health_check_backoff_doubles_with_bounded_jitter(self):
+        from brpc_tpu.butil.endpoint import parse_endpoint
+        from brpc_tpu.rpc.health_check import HealthCheckTask
+        t = HealthCheckTask(parse_endpoint("mem://chaos-hc-unit"),
+                            max_probes=1, seed=42)
+        try:
+            base = []
+            for count in (0, 1, 2, 3, 10):
+                t.probe_count = count
+                base.append(t.next_delay_s())
+            # doubling up to the cap, jitter within [1, 1+jitter)
+            assert 0.1 <= base[0] < 0.1 * 1.25
+            assert 0.2 <= base[1] < 0.2 * 1.25
+            assert 0.4 <= base[2] < 0.4 * 1.25
+            assert 0.8 <= base[3] < 0.8 * 1.25
+            assert 2.0 <= base[4] < 2.0 * 1.25   # capped
+            # seeded determinism: same seed -> identical jitter sequence
+            # (two FRESH tasks; each constructor consumes exactly one
+            # draw scheduling the first probe)
+            t2 = HealthCheckTask(parse_endpoint("mem://chaos-hc-unit2"),
+                                 max_probes=1, seed=99)
+            t3 = HealthCheckTask(parse_endpoint("mem://chaos-hc-unit3"),
+                                 max_probes=1, seed=99)
+            try:
+                t2.probe_count = t3.probe_count = 3
+                assert [t2.next_delay_s() for _ in range(3)] == \
+                       [t3.next_delay_s() for _ in range(3)]
+            finally:
+                t2.cancel()
+                t3.cancel()
+        finally:
+            t.cancel()
+
+    def test_breaker_isolation_gates_single_endpoint_channel(self):
+        """A tripped breaker makes the channel fail fast (no reconnect
+        stampede); mark_recovered (the health checker's revival) lifts
+        the gate."""
+        from brpc_tpu.rpc.circuit_breaker import BreakerRegistry
+        from tests.echo_pb2 import EchoRequest, EchoResponse
+
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                response.message = request.message
+                done()
+
+        server = rpc.Server()
+        server.add_service(Echo())
+        target = "mem://chaos-breaker-gate"
+        assert server.start(target) == 0
+        try:
+            ch = rpc.Channel()
+            ch.init(target, options=rpc.ChannelOptions(timeout_ms=2000,
+                                                       max_retry=0))
+            from brpc_tpu.butil.endpoint import parse_endpoint
+            ep = parse_endpoint(target)
+            breaker = BreakerRegistry.instance().breaker(ep)
+            for _ in range(30):          # trip it: consecutive failures
+                breaker.on_call_end(errors.EFAILEDSOCKET)
+            assert breaker.is_isolated()
+            cntl = rpc.Controller()
+            t0 = time.monotonic()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+            assert time.monotonic() - t0 < 1.0   # failed fast, no connect
+            breaker.mark_recovered()             # revival resets the gate
+            cntl = rpc.Controller()
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="back"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "back"
+        finally:
+            server.stop()
+
+    def test_retry_backoff_is_exponential_capped_and_deterministic(self):
+        c = rpc.Controller()
+        c.retry_backoff_ms = 50
+        c._cid = 12345 << 32
+        delays = []
+        for c.retried_count in (1, 2, 3, 10):
+            delays.append(c._retry_backoff_s())
+        assert 0.050 <= delays[0] <= 0.050 * 1.25
+        assert 0.100 <= delays[1] <= 0.100 * 1.25
+        assert 0.200 <= delays[2] <= 0.200 * 1.25
+        assert 1.000 <= delays[3] <= 1.000 * 1.25   # capped at 1s
+        c2 = rpc.Controller()
+        c2.retry_backoff_ms = 50
+        c2._cid = 12345 << 32
+        c2.retried_count = 2
+        c.retried_count = 2
+        assert c._retry_backoff_s() == c2._retry_backoff_s()
+        c.retry_backoff_ms = 0
+        assert c._retry_backoff_s() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# 2-process chaos: the real fabric under injected faults.
+# ---------------------------------------------------------------------------
+
+_CHILD_PRELUDE = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.rpc import fault_injection as fi
+from brpc_tpu.rpc.socket import list_sockets, Socket
+from brpc_tpu.butil.iobuf import IOBuf
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+def fabric_socks():
+    return [s for s in list_sockets() if isinstance(s, FabricSocket)]
+"""
+
+# Kill the bulk plane mid-stream with a LIVE control channel: frames sent
+# while degraded ride the inline path (stream completes, in order), the
+# plane re-establishes in the background, and threshold routing returns —
+# asserted via the cumulative bulk-byte counters.
+_BULK_DEATH_MIDSTREAM = _CHILD_PRELUDE + r"""
+CHUNK = 256 * 1024
+PHASE = 8        # frames per phase
+
+def body_for(seq):
+    return b"%%08d" %% seq + bytes([(seq * 11 + 5) %% 251]) * (CHUNK - 8)
+
+if pid == 0:
+    state = {"next": 0, "bad": []}
+    done_evt = threading.Event()
+
+    class Sink:
+        def on_received_messages(self, sid, msgs):
+            for m in msgs:
+                if m.to_bytes() != body_for(state["next"]):
+                    state["bad"].append(state["next"])
+                state["next"] += 1
+        def on_closed(self, sid):
+            done_evt.set()
+
+    class StreamSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Start(self, cntl, request, response, done):
+            rpc.stream_accept(cntl, rpc.StreamOptions(handler=Sink()))
+            response.message = "ok"
+            done()
+
+    server = rpc.Server(); server.add_service(StreamSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("bd_srv_up", "1")
+    assert done_evt.wait(180), ("stream never closed", state["next"])
+    assert state["next"] == 3 * PHASE, state
+    assert not state["bad"], state["bad"][:5]
+    srv_socks = fabric_socks()
+    assert srv_socks and not srv_socks[0].failed, "server socket died"
+    assert srv_socks[0].bulk_epoch() >= 2, srv_socks[0].bulk_epoch()
+    kv.wait_at_barrier("bd_done", 120000)
+    server.stop()
+    print("BD0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("bd_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    stream = rpc.stream_create(cntl, rpc.StreamOptions(max_buf_size=8 << 20))
+    resp = ch.call_method("StreamSvc.Start", cntl,
+                          EchoRequest(message="s"), EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(10)
+    socks = fabric_socks()
+    assert socks and socks[0]._bulk, "no bulk plane bound"
+    s = socks[0]
+    seq = 0
+    # phase 1: healthy — frames ride the bulk plane
+    for _ in range(PHASE):
+        assert stream.write(IOBuf(body_for(seq)), timeout=30) == 0
+        seq += 1
+    sent_healthy = s.bulk_bytes_sent
+    assert sent_healthy >= PHASE * CHUNK, (sent_healthy, PHASE * CHUNK)
+    assert s.bulk_epoch() == 1
+    # CHAOS: kill the bulk conn under the live control channel, at a
+    # frame boundary (between writes)
+    s._blib.brpc_tpu_fab_chaos(s._bulk, fi.CHAOS_SEVER_NOW, 0)
+    time.sleep(0.3)              # the native readers observe the sever
+    # phase 2: degraded — frames fall back INLINE; the stream survives
+    for _ in range(PHASE):
+        assert stream.write(IOBuf(body_for(seq)), timeout=30) == 0
+        seq += 1
+    assert not s.failed, "socket must survive bulk-plane death"
+    sent_degraded = s.bulk_bytes_sent
+    assert sent_degraded == sent_healthy, (sent_degraded, sent_healthy)
+    # background revival restores the plane (epoch bumps)
+    deadline = time.time() + 30
+    while s.bulk_epoch() < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert s.bulk_epoch() >= 2, "bulk plane never re-established"
+    # phase 3: threshold routing restored — bytes ride bulk again
+    for _ in range(PHASE):
+        assert stream.write(IOBuf(body_for(seq)), timeout=30) == 0
+        seq += 1
+    deadline = time.time() + 30
+    while s.bulk_bytes_sent < sent_degraded + PHASE * CHUNK \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert s.bulk_bytes_sent >= sent_degraded + PHASE * CHUNK, (
+        s.bulk_bytes_sent, sent_degraded, PHASE * CHUNK)
+    stream.close()
+    assert not s.failed
+    kv.wait_at_barrier("bd_done", 120000)
+    print("BD1_OK", flush=True)
+"""
+
+
+def test_chaos_bulk_death_midstream_inline_fallback_then_revival():
+    outs = _run_pair(_BULK_DEATH_MIDSTREAM % {"repo": REPO}, timeout=240)
+    assert "BD0_OK" in outs[0]
+    assert "BD1_OK" in outs[1]
+
+
+# Mid-writev sever: the descriptor is already on the control channel when
+# the payload write truncates — the descriptor-consistency rule says THAT
+# stream fails cleanly (both ends), the socket survives, and a NEW stream
+# works over the re-established plane.
+_MID_WRITEV_SEVER = _CHILD_PRELUDE + r"""
+CHUNK = 256 * 1024
+
+def body_for(seq):
+    return b"%%08d" %% seq + bytes([(seq * 3 + 1) %% 251]) * (CHUNK - 8)
+
+if pid == 0:
+    state = {"n": 0, "bad": 0, "closed": 0}
+    closed_evt = threading.Event()
+    done_evt = threading.Event()
+
+    class Sink:
+        def on_received_messages(self, sid, msgs):
+            for m in msgs:
+                b = m.to_bytes()
+                if len(b) != CHUNK:
+                    state["bad"] += 1
+                state["n"] += 1
+        def on_closed(self, sid):
+            state["closed"] += 1
+            closed_evt.set()
+            if state["closed"] == 2:
+                done_evt.set()
+
+    class StreamSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Start(self, cntl, request, response, done):
+            rpc.stream_accept(cntl, rpc.StreamOptions(handler=Sink()))
+            response.message = "ok"
+            done()
+
+    server = rpc.Server(); server.add_service(StreamSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("mw_srv_up", "1")
+    assert done_evt.wait(180), ("second stream never closed", state)
+    assert state["bad"] == 0, state
+    srv_socks = fabric_socks()
+    assert srv_socks and not srv_socks[0].failed, "server socket died"
+    kv.wait_at_barrier("mw_done", 120000)
+    server.stop()
+    print("MW0_OK", flush=True)
+else:
+    # arm BEFORE the fabric socket exists: the plan poisons the bulk
+    # conn at attach with a watermark inside frame 2's payload
+    plan = fi.FabricFaultPlan(seed=3,
+                              bulk_sever_after_bytes=CHUNK + CHUNK // 2)
+    fi.install_fabric(plan)
+    kv.blocking_key_value_get("mw_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    stream = rpc.stream_create(cntl, rpc.StreamOptions(max_buf_size=8 << 20))
+    resp = ch.call_method("StreamSvc.Start", cntl,
+                          EchoRequest(message="s"), EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(10)
+    socks = fabric_socks()
+    assert socks and socks[0]._bulk
+    s = socks[0]
+    assert plan.injected["bulk_chaos"] >= 1
+    fi.install_fabric(None)      # scope: only the first conn is poisoned
+    # frame 1 fits under the watermark; frame 2 truncates mid-writev
+    assert stream.write(IOBuf(body_for(0)), timeout=30) == 0
+    failed_cleanly = False
+    try:
+        for seq in range(1, 6):
+            stream.write(IOBuf(body_for(seq)), timeout=30)
+    except (ConnectionError, OSError):
+        failed_cleanly = True
+    assert failed_cleanly or stream.closed, \
+        "descriptor-consistency: the stream must fail"
+    assert not s.failed, "socket must survive mid-writev bulk sever"
+    # revival, then a NEW stream completes over the fresh plane
+    deadline = time.time() + 30
+    while s.bulk_epoch() < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert s.bulk_epoch() >= 2, "bulk plane never re-established"
+    cntl2 = rpc.Controller()
+    stream2 = rpc.stream_create(cntl2,
+                                rpc.StreamOptions(max_buf_size=8 << 20))
+    ch.call_method("StreamSvc.Start", cntl2, EchoRequest(message="s2"),
+                   EchoResponse)
+    assert not cntl2.failed(), cntl2.error_text
+    assert stream2.wait_connected(10)
+    before = s.bulk_bytes_sent
+    for seq in range(4):
+        assert stream2.write(IOBuf(body_for(seq)), timeout=30) == 0
+    assert s.bulk_bytes_sent >= before + 4 * CHUNK
+    stream2.close()
+    assert not s.failed
+    kv.wait_at_barrier("mw_done", 120000)
+    print("MW1_OK", flush=True)
+"""
+
+
+def test_chaos_mid_writev_sever_fails_stream_cleanly_socket_survives():
+    outs = _run_pair(_MID_WRITEV_SEVER % {"repo": REPO}, timeout=240)
+    assert "MW0_OK" in outs[0]
+    assert "MW1_OK" in outs[1]
+
+
+# A dropped bulk frame (descriptor arrives, bytes never park): the claim
+# times out, THAT stream fails, the socket survives and the plane cycles.
+_DROPPED_FRAME = _CHILD_PRELUDE + r"""
+from brpc_tpu.butil import flags as _fl
+_fl.set_flag("ici_bulk_claim_timeout_s", 1.0)
+CHUNK = 128 * 1024
+
+if pid == 0:
+    state = {"n": 0, "closed": 0}
+    closed_evt = threading.Event()
+
+    class Sink:
+        def on_received_messages(self, sid, msgs):
+            state["n"] += len(msgs)
+        def on_closed(self, sid):
+            state["closed"] += 1
+            closed_evt.set()
+
+    class StreamSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Start(self, cntl, request, response, done):
+            rpc.stream_accept(cntl, rpc.StreamOptions(handler=Sink()))
+            response.message = "ok"
+            done()
+
+    server = rpc.Server(); server.add_service(StreamSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("df_srv_up", "1")
+    assert closed_evt.wait(120), "stream never closed"
+    srv = fabric_socks()
+    assert srv and not srv[0].failed, "server socket died on dropped frame"
+    kv.wait_at_barrier("df_done", 120000)
+    server.stop()
+    print("DF0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("df_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    stream = rpc.stream_create(cntl, rpc.StreamOptions(max_buf_size=8 << 20))
+    ch.call_method("StreamSvc.Start", cntl, EchoRequest(message="s"),
+                   EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(10)
+    socks = fabric_socks()
+    s = socks[0]
+    assert s._bulk
+    # lost-bytes fault: the descriptor reaches the peer but the payload
+    # silently never does — the peer's claim times out
+    # (ici_bulk_claim_timeout_s=1), fails THAT stream, RSTs the writer,
+    # and degrades only the bulk plane
+    orig = s.stream_bulk_send
+    s.stream_bulk_send = lambda uuid, frame: None    # bytes vanish
+    body = b"x" * CHUNK
+    try:
+        stream.write(IOBuf(body), timeout=30)
+    except (ConnectionError, OSError):
+        pass
+    s.stream_bulk_send = orig
+    # the peer's RST closes OUR stream; the socket survives
+    deadline = time.time() + 20
+    while not stream.closed and time.time() < deadline:
+        time.sleep(0.02)
+    assert stream.closed, "stream with lost bytes must fail"
+    assert not s.failed, "socket must survive"
+    deadline = time.time() + 30
+    while s.bulk_epoch() < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert s.bulk_epoch() >= 2, "bulk plane never re-established"
+    kv.wait_at_barrier("df_done", 120000)
+    print("DF1_OK", flush=True)
+"""
+
+
+def test_chaos_lost_bulk_bytes_fail_stream_only():
+    outs = _run_pair(_DROPPED_FRAME % {"repo": REPO}, timeout=240)
+    assert "DF0_OK" in outs[0]
+    assert "DF1_OK" in outs[1]
+
+
+# Refused re-establishment handshake: the first revival attempt gets
+# BULK_ERR, the backoff loop retries, the second succeeds.
+_REFUSED_REESTABLISH = _CHILD_PRELUDE + r"""
+CHUNK = 128 * 1024
+
+if pid == 0:
+    plan = fi.FabricFaultPlan(seed=11, refuse_bulk_handshakes=1)
+    fi.install_fabric(plan)
+
+    class EchoSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "srv:" + request.message
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+            done()
+
+    server = rpc.Server(); server.add_service(EchoSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("rr_srv_up", "1")
+    kv.wait_at_barrier("rr_done", 120000)
+    assert plan.injected["refuse_bulk"] == 1, plan.injected
+    fi.install_fabric(None)
+    server.stop()
+    print("RR0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("rr_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    resp = ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message="a"),
+                          EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    socks = fabric_socks()
+    s = socks[0]
+    assert s._bulk and s.bulk_epoch() == 1
+    s._blib.brpc_tpu_fab_chaos(s._bulk, fi.CHAOS_SEVER_NOW, 0)
+    time.sleep(0.2)
+    # big attachment while degraded: rides inline, RPC still works
+    import numpy as np
+    payload = np.arange(CHUNK, dtype=np.uint8).tobytes()
+    cntl = rpc.Controller()
+    cntl.request_attachment.append(payload)
+    resp = ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message="b"),
+                          EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert cntl.response_attachment.to_bytes() == payload
+    # attempt 1 refused (BULK_ERR), attempt 2 lands after backoff
+    deadline = time.time() + 30
+    while s.bulk_epoch() < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert s.bulk_epoch() >= 2, "revival never survived the refusal"
+    before = s.bulk_bytes_sent
+    cntl = rpc.Controller()
+    cntl.request_attachment.append(payload)
+    resp = ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message="c"),
+                          EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert cntl.response_attachment.to_bytes() == payload
+    assert s.bulk_bytes_sent >= before + CHUNK, (s.bulk_bytes_sent, before)
+    assert not s.failed
+    kv.wait_at_barrier("rr_done", 120000)
+    print("RR1_OK", flush=True)
+"""
+
+
+def test_chaos_refused_bulk_reestablish_retries_with_backoff():
+    outs = _run_pair(_REFUSED_REESTABLISH % {"repo": REPO}, timeout=240)
+    assert "RR0_OK" in outs[0]
+    assert "RR1_OK" in outs[1]
+
+
+# Sever the control channel mid-call: the in-flight RPC fails promptly
+# with a retryable code, the endpoint goes to the health checker, and an
+# RPC issued DURING the outage (spaced retries) succeeds once the server
+# returns — under a NEW versioned socket id.
+_CONTROL_SEVER_REVIVAL = _CHILD_PRELUDE + r"""
+if pid == 0:
+    class EchoSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "srv:" + request.message
+            done()
+
+    server = rpc.Server(); server.add_service(EchoSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("cs_srv_up", "1")
+    kv.blocking_key_value_get("cs_rpc1_done", 60000)
+    # arm: the NEXT control frame this server writes (RPC 2's response)
+    # severs the control TCP instead — the client sees a reset mid-call
+    plan = fi.FabricFaultPlan(seed=5, control_sever_after_frames=1,
+                              match=lambda s: s.is_server_side)
+    fi.install_fabric(plan)
+    kv.key_value_set("cs_armed", "1")
+    kv.blocking_key_value_get("cs_rpc2_failed", 60000)
+    fi.install_fabric(None)
+    assert plan.injected["control_sever"] == 1, plan.injected
+    server.stop()                     # the outage
+    kv.key_value_set("cs_srv_down", "1")
+    time.sleep(2.0)
+    server2 = rpc.Server(); server2.add_service(EchoSvc())
+    assert server2.start("ici://0") == 0   # the peer returns
+    kv.wait_at_barrier("cs_done", 180000)
+    server2.stop()
+    print("CS0_OK", flush=True)
+else:
+    from brpc_tpu.rpc import health_check
+    kv.blocking_key_value_get("cs_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=20000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    resp = ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message="one"),
+                          EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    socks = fabric_socks()
+    assert socks
+    old_sid = socks[0].id
+    ep = socks[0].remote_side
+    kv.key_value_set("cs_rpc1_done", "1")
+    kv.blocking_key_value_get("cs_armed", 60000)
+    # in-flight RPC: the response write severs the conn server-side
+    from brpc_tpu.rpc.controller import Controller
+    cntl = rpc.Controller()
+    t0 = time.monotonic()
+    ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message="two"),
+                   EchoResponse)
+    dt = time.monotonic() - t0
+    assert cntl.failed(), "in-flight RPC must fail when control severs"
+    assert dt < 8, f"burned the deadline instead of failing fast: {dt:.1f}s"
+    assert Controller._retryable(cntl.error_code_), cntl.error_code_
+    kv.key_value_set("cs_rpc2_failed", "1")
+    kv.blocking_key_value_get("cs_srv_down", 60000)
+    # outage: probe reports down, the health checker is on the case
+    assert node.ping(0) is False, "ping must fail during the outage"
+    deadline = time.time() + 5
+    while not health_check.checking(ep) and time.time() < deadline:
+        time.sleep(0.02)
+    assert health_check.checking(ep), \
+        "failed fabric endpoint must be under health check"
+    # an RPC issued DURING the outage, with spaced retries, succeeds
+    # once the peer returns
+    cntl = rpc.Controller()
+    cntl.timeout_ms = 15000
+    cntl.max_retry = 40
+    cntl.retry_backoff_ms = 50
+    resp = ch.call_method("EchoSvc.Echo", cntl,
+                          EchoRequest(message="during-outage"),
+                          EchoResponse)
+    assert not cntl.failed(), (cntl.error_code_, cntl.error_text)
+    assert resp.message == "srv:during-outage"
+    assert cntl.retried_count > 0, "must have retried through the outage"
+    # revived under a NEW versioned socket id; the old id is revoked
+    new_socks = [s for s in fabric_socks() if not s.failed]
+    assert new_socks, "no live fabric socket after revival"
+    assert all(s.id != old_sid for s in new_socks)
+    assert Socket.address(old_sid) is None, \
+        "stale socket id must not resolve after revival"
+    assert node.ping(0) is True
+    deadline = time.time() + 10
+    while health_check.checking(ep) and time.time() < deadline:
+        time.sleep(0.05)
+    assert not health_check.checking(ep), \
+        "health check must retire after revival"
+    kv.wait_at_barrier("cs_done", 180000)
+    print("CS1_OK", flush=True)
+"""
+
+
+def test_chaos_control_sever_fails_fast_then_revival_during_outage():
+    outs = _run_pair(_CONTROL_SEVER_REVIVAL % {"repo": REPO}, timeout=300)
+    assert "CS0_OK" in outs[0]
+    assert "CS1_OK" in outs[1]
+
+
+# Kill the peer PROCESS mid-call (os._exit via the die-after-frames
+# hook): the client's in-flight RPC fails promptly with a retryable
+# code, not after its 30s deadline.  The server child is pid 1 so the
+# jax coordination service (hosted by pid 0) survives the kill.
+_PEER_KILL = _CHILD_PRELUDE + r"""
+SRV_DEV = 2      # pid 1 owns global devices 2..3
+
+if pid == 1:
+    class EchoSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "srv:" + request.message
+            done()
+
+    # control frame 1 = RPC 1's request (served); frame 2 = RPC 2's
+    # request -> the process dies before answering
+    fi.install_fabric(fi.FabricFaultPlan(seed=9,
+                                         die_after_control_frames=2))
+    server = rpc.Server(); server.add_service(EchoSvc())
+    assert server.start("ici://%%d" %% SRV_DEV) == 0
+    kv.key_value_set("pk_srv_up", "1")
+    time.sleep(300)      # killed long before this returns
+    print("PK1_UNREACHABLE", flush=True)
+else:
+    kv.blocking_key_value_get("pk_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("ici://%%d" %% SRV_DEV,
+            options=rpc.ChannelOptions(timeout_ms=30000, max_retry=0))
+    cntl = rpc.Controller()
+    resp = ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message="one"),
+                          EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == "srv:one"
+    from brpc_tpu.rpc.controller import Controller
+    cntl = rpc.Controller()
+    t0 = time.monotonic()
+    ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message="two"),
+                   EchoResponse)
+    dt = time.monotonic() - t0
+    assert cntl.failed(), "RPC against a killed peer must fail"
+    assert dt < 10, f"burned the 30s deadline: {dt:.1f}s"
+    assert Controller._retryable(cntl.error_code_), cntl.error_code_
+    print("PK0_OK", flush=True)
+    # the coordination service peer is gone: skip jax's atexit shutdown
+    # barrier (it would wait on the killed process)
+    sys.stdout.flush()
+    os._exit(0)
+"""
+
+
+def test_chaos_peer_process_kill_fails_inflight_promptly():
+    outs = _run_pair(_PEER_KILL % {"repo": REPO}, timeout=240,
+                     expect_rc=(0, 137))
+    assert "PK0_OK" in outs[0]
+    assert "PK1_UNREACHABLE" not in outs[1]

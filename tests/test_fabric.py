@@ -319,6 +319,9 @@ class TestFabricUnits:
         sock._staged_lock = _threading.Lock()
         sock._bulk = 0
         sock._blib = None
+        sock._bulk_lock = _threading.Lock()
+        sock._reestab_pending = None
+        sock._reestab_evt = _threading.Event()
         sock._init_delivery()
         events = []
         sock.start_input_event = lambda *a, **k: events.append("input")

@@ -1,0 +1,215 @@
+"""Naming-service coverage the reference holds us to:
+
+* golden-payload parser tests for the consul / nacos / discovery JSON
+  formats (fixtures under tests/fixtures/ mirror real registry
+  responses — the mocked-payload coverage of
+  test/brpc_naming_service_unittest.cpp), and
+* the consul BLOCKING long-poll watch (index=/wait= round trip against
+  a mocked consul that actually holds the poll open), asserting
+  sub-second membership propagation that periodic polling could not
+  explain.
+"""
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from brpc_tpu.butil import flags as _flags
+from brpc_tpu.policy import naming
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+
+def _fixture(name: str) -> bytes:
+    with open(os.path.join(FIXTURES, name), "rb") as f:
+        return f.read()
+
+
+class _Resp:
+    """Stand-in for urllib's addinfourl: context manager + read() +
+    headers."""
+
+    def __init__(self, body: bytes, headers=None):
+        self._body = body
+        self.headers = headers or {}
+
+    def read(self):
+        return self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class TestGoldenPayloads:
+    def test_consul_health_service(self, monkeypatch):
+        body = _fixture("consul_health_service.json")
+        seen = {}
+
+        def fake_urlopen(url, timeout=None):
+            seen["url"] = url
+            return _Resp(body, {"X-Consul-Index": "1042"})
+
+        monkeypatch.setattr("urllib.request.urlopen", fake_urlopen)
+        ns = naming.ConsulNamingService("127.0.0.1:8500/web")
+        entries = ns.get_servers()
+        assert seen["url"] == \
+            "http://127.0.0.1:8500/v1/health/service/web"
+        assert [str(e.endpoint) for e in entries] == \
+            ["10.1.10.12:8000", "10.1.10.13:8001"]
+        assert entries[0].tag == "primary,v1"
+        assert entries[1].tag == ""
+        assert ns.last_index == "1042"       # header primed the index
+
+    def test_nacos_instance_list(self, monkeypatch):
+        body = _fixture("nacos_instance_list.json")
+        seen = {}
+
+        def fake_urlopen(url, timeout=None):
+            seen["url"] = url
+            return _Resp(body)
+
+        monkeypatch.setattr("urllib.request.urlopen", fake_urlopen)
+        ns = naming.NacosNamingService("127.0.0.1:8848/demo.service")
+        entries = ns.get_servers()
+        assert "serviceName=demo.service" in seen["url"]
+        # unhealthy (10.2.0.7) and disabled (10.2.0.8) are filtered out
+        assert [str(e.endpoint) for e in entries] == \
+            ["10.2.0.5:8848", "10.2.0.6:8848"]
+        # nacos float weights scale the default 100
+        assert [e.weight for e in entries] == [100, 250]
+        assert entries[0].tag == "DEFAULT"
+
+    def test_discovery_fetchs(self, monkeypatch):
+        body = _fixture("discovery_fetchs.json")
+
+        def fake_urlopen(url, timeout=None):
+            return _Resp(body)
+
+        monkeypatch.setattr("urllib.request.urlopen", fake_urlopen)
+        ns = naming.DiscoveryNamingService("127.0.0.1:7171/demo.service")
+        entries = ns.get_servers()
+        # status!=1 (host-2) is filtered; every addr of a live instance
+        # is an entry, zone rides the tag
+        assert [str(e.endpoint) for e in entries] == \
+            ["10.3.1.1:9000", "10.3.1.1:8080",
+             "10.3.1.3:9000"]
+        assert [e.tag for e in entries] == ["sh001", "sh001", "sh003"]
+
+
+# ---------------------------------------------------------------------------
+# The blocking watch against a mocked consul.
+# ---------------------------------------------------------------------------
+
+class _MockConsulHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        srv = self.server
+        parsed = urlparse(self.path)
+        idx = parse_qs(parsed.query).get("index", [None])[0]
+        with srv.state_lock:
+            srv.queries.append((parsed.path, idx))
+            gen_event = srv.change
+            current = str(srv.index)
+        if idx == current:
+            # a real consul HOLDS the poll open until membership moves
+            # past the presented index (or the wait elapses)
+            gen_event.wait(5.0)
+        self._respond()
+
+    def _respond(self):
+        srv = self.server
+        with srv.state_lock:
+            body = json.dumps(srv.payload).encode()
+            index = str(srv.index)
+        self.send_response(200)
+        self.send_header("X-Consul-Index", index)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+def _consul_item(addr: str, port: int):
+    return {"Service": {"Service": "web", "Tags": [], "Address": addr,
+                        "Port": port}}
+
+
+class TestConsulBlockingWatch:
+    def test_index_round_trip_and_subsecond_propagation(self):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _MockConsulHandler)
+        srv.daemon_threads = True
+        srv.state_lock = threading.Lock()
+        srv.index = 7
+        srv.payload = [_consul_item("10.9.0.1", 80)]
+        srv.change = threading.Event()
+        srv.queries = []
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+        # a 30s polling period: any sub-second propagation below must
+        # come from the long poll, not from a lucky poll tick
+        old_poll = _flags.get_flag("ns_poll_interval_s")
+        _flags.set_flag("ns_poll_interval_s", 30.0)
+        got = []
+
+        class Watcher:
+            def reset_servers(self, entries):
+                got.append((time.monotonic(), [str(e.endpoint)
+                                               for e in entries]))
+
+        t = None
+        try:
+            t = naming.NamingServiceThread(
+                f"consul://127.0.0.1:{port}/web")
+            t.add_watcher(Watcher())
+            assert got and got[-1][1] == ["10.9.0.1:80"]
+            # the watch loop must be PARKED in a blocking poll carrying
+            # the primed index before we flip membership
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with srv.state_lock:
+                    if any(q[1] == "7" for q in srv.queries):
+                        break
+                time.sleep(0.01)
+            with srv.state_lock:
+                assert any(q[1] == "7" for q in srv.queries), srv.queries
+                # membership flips: bump the index and release the poll
+                srv.payload = [_consul_item("10.9.0.1", 80),
+                               _consul_item("10.9.0.2", 81)]
+                srv.index = 8
+                released, srv.change = srv.change, threading.Event()
+                t0 = time.monotonic()
+                released.set()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if got and len(got[-1][1]) == 2:
+                    break
+                time.sleep(0.01)
+            assert got[-1][1] == ["10.9.0.1:80",
+                                  "10.9.0.2:81"]
+            dt = got[-1][0] - t0
+            assert dt < 1.0, \
+                f"long poll should propagate sub-second, took {dt:.2f}s"
+            # and the next round re-issued with the NEW index
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with srv.state_lock:
+                    if any(q[1] == "8" for q in srv.queries):
+                        break
+                time.sleep(0.01)
+            with srv.state_lock:
+                assert any(q[1] == "8" for q in srv.queries), srv.queries
+        finally:
+            _flags.set_flag("ns_poll_interval_s", old_poll)
+            if t is not None:
+                t.stop()
+            srv.shutdown()

@@ -194,16 +194,71 @@ class TestLimiters:
         assert lim.on_requested(0) and lim.on_requested(1)
         assert not lim.on_requested(2)
 
-    def test_auto_adapts_down_under_overload(self):
-        lim = limiters.AutoConcurrencyLimiter(initial=100,
-                                              sample_window_s=0.0,
-                                              min_sample_count=1)
-        for _ in range(50):
-            lim.on_responded(0, 100)        # establish fast baseline
-        base = lim.max_concurrency()
+    @staticmethod
+    def _drive(lim, windows, concurrency_fn, base_us=10_000, knee=20,
+               now_us=1_000_000):
+        """Simulated server with an explicit knee: latency is flat at
+        base_us up to `knee` concurrent requests, then grows linearly
+        (queueing).  Drives the limiter with an injected clock — fully
+        deterministic.  Returns the advanced clock so multi-phase tests
+        keep time monotonic."""
+        for _ in range(windows):
+            c = max(1, min(concurrency_fn(lim.max_concurrency()), 200))
+            lat = base_us if c <= knee else int(base_us * c / knee)
+            # steady state: `c` in flight, each taking `lat` us
+            qps = c / (lat / 1e6)
+            span_us = 200_000
+            n = max(int(qps * span_us / 1e6), 1)
+            step = span_us // n
+            for _ in range(n):
+                now_us += step
+                lim.add_sample(0, lat, now_us)
+        return now_us
+
+    def test_auto_gradient_converges_near_the_knee(self):
+        """Simulated-load convergence: with a capacity knee at 20
+        concurrent requests, the gradient limit must settle in the
+        Little's-law band around knee×(1+alpha) — neither collapsing to
+        MIN_LIMIT nor running away with offered load of 150."""
+        lim = limiters.AutoConcurrencyLimiter(initial=40)
+        self._drive(lim, windows=300, concurrency_fn=lambda m: min(m, 150))
+        got = lim.max_concurrency()
+        assert 14 <= got <= 45, got
+        # the periodic exploration actually ran (noise-filtered floor
+        # was re-measured under reduced load)
+        assert lim.remeasure_count >= 1
+
+    def test_auto_gradient_tracks_a_capacity_collapse(self):
+        """Closed loop: after converging against a knee of 20, the
+        server's capacity collapses to a knee of 3 — the gradient must
+        walk the limit down into the small-knee band instead of holding
+        the stale one."""
+        lim = limiters.AutoConcurrencyLimiter(
+            initial=40, remeasure_interval_us=60_000_000)
+        now = self._drive(lim, windows=150,
+                          concurrency_fn=lambda m: min(m, 150))
+        assert lim.max_concurrency() >= 14
+        self._drive(lim, windows=300, concurrency_fn=lambda m: min(m, 150),
+                    knee=3, now_us=now)
+        assert lim.max_concurrency() <= 10, lim.max_concurrency()
+
+    def test_auto_gradient_failures_punish_the_window(self):
+        """Failed responses drag the window's punished latency up (the
+        fail_punish_ratio term), shrinking the limit even when successes
+        stay fast."""
+        healthy = limiters.AutoConcurrencyLimiter(
+            initial=40, remeasure_interval_us=60_000_000)
+        degraded = limiters.AutoConcurrencyLimiter(
+            initial=40, remeasure_interval_us=60_000_000)
+        now_h = self._drive(healthy, 30, lambda m: min(m, 10))
+        now_d = self._drive(degraded, 30, lambda m: min(m, 10))
         for _ in range(200):
-            lim.on_responded(0, 50000)      # massive latency inflation
-        assert lim.max_concurrency() < max(base, 100)
+            now_h += 5_000
+            now_d += 5_000
+            healthy.add_sample(0, 10_000, now_h)
+            degraded.add_sample(0, 10_000, now_d)
+            degraded.add_sample(1, 80_000, now_d)   # timeouts punished
+        assert degraded.max_concurrency() < healthy.max_concurrency()
 
     def test_timeout_limiter(self):
         lim = limiters.TimeoutConcurrencyLimiter(timeout_ms=10)
