@@ -118,3 +118,67 @@ def device_wait(arrays: Any, timeout: Optional[float] = None) -> int:
 
 def device_on_ready(arrays: Any, callback: Callable[[], None]) -> None:
     DeviceEventDispatcher.instance().on_ready(arrays, callback)
+
+
+class DeviceCompletion:
+    """One-shot completion record — the CQ-entry of the device plane.
+
+    An RDMA work request completes exactly once, with a status; waiters
+    either block (``wait``, butex-parked so an M:N worker yields instead
+    of spinning) or register callbacks (``add_done_callback``, the
+    CQ-polling analogue).  Used by ici/device_plane.py transfers; generic
+    enough for any post/poll device-side operation."""
+
+    __slots__ = ("_butex", "_lock", "_cbs", "_done", "error")
+
+    def __init__(self):
+        self._butex = Butex(0)
+        self._lock = threading.Lock()
+        self._cbs: list = []
+        self._done = False
+        self.error = 0
+
+    def signal(self, error: int = 0) -> bool:
+        """Complete with ``error`` (0 = success).  Exactly-once: a second
+        signal is a no-op returning False.  Callbacks run on the signaling
+        thread (the device poller), like CQ callbacks run on the CQ
+        thread — they must not block."""
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+            self.error = error
+            cbs, self._cbs = self._cbs, []
+        self._butex.wake_all_and_set(1)
+        for cb in cbs:
+            try:
+                cb(error)
+            except Exception:
+                from ..butil import logging as log
+                log.error("device completion callback raised", exc_info=True)
+        return True
+
+    def poll(self) -> bool:
+        with self._lock:
+            return self._done
+
+    def add_done_callback(self, cb: Callable[[int], None]) -> None:
+        """cb(error) once complete; fires immediately (on the caller's
+        thread) when already done."""
+        with self._lock:
+            if not self._done:
+                self._cbs.append(cb)
+                return
+            err = self.error
+        cb(err)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """Block until complete.  Returns the completion's error code, or
+        ETIMEDOUT (110) when the timeout expires first."""
+        while True:
+            with self._lock:
+                if self._done:
+                    return self.error
+            if self._butex.wait(0, timeout) == 110:   # ETIMEDOUT
+                with self._lock:
+                    return self.error if self._done else 110

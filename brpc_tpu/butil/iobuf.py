@@ -318,6 +318,12 @@ class IOBuf:
     def has_device_blocks(self) -> bool:
         return any(r.block.kind == DEVICE for r in self._refs)
 
+    def device_bytes(self) -> int:
+        """Total bytes referenced from DEVICE blocks — the volume a
+        transport's device plane is responsible for moving (host/USER
+        bytes ride the wire paths)."""
+        return sum(r.length for r in self._refs if r.block.kind == DEVICE)
+
     # ---- fd IO (reference cut_into_file_descriptor iobuf.h:160) ------
     def cut_into_file_descriptor(self, fd: int, size_hint: int = 1 << 20) -> int:
         """writev the leading refs into fd; pops what was written."""

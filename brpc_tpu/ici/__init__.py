@@ -5,5 +5,7 @@ from .transport import (IciSocket, ici_listen, ici_unlisten, ici_connect,
                         ici_transport_stats)
 from .collective import Collectives, default_collectives
 from .ring import ring_all_reduce, RingStream
+from . import device_plane
+from .device_plane import DevicePlane, DeviceTransfer, DevicePlaneError
 from . import pallas_ring
 from . import ring_attention

@@ -47,6 +47,7 @@ from ..butil.iobuf import IOBuf, IOPortal, DEVICE
 from ..rpc import errors
 from ..rpc import fault_injection as _fi
 from ..rpc.socket import Socket
+from . import device_plane as _dp
 from .transport import CreditWindow, OrderedDelivery
 
 _KV_PREFIX = "brpc_tpu/fabric/"
@@ -92,6 +93,22 @@ _flags.define_flag("ici_fabric_health_check", True,
 # tests shrink this so a dropped bulk frame resolves quickly.
 _flags.define_flag("ici_bulk_claim_timeout_s", 60.0,
                    "max seconds a bulk claim waits for its frame")
+# Cross-process device plane: device payloads cross through a compiled
+# XLA transfer program that BOTH processes enter (shard_map + ppermute /
+# Pallas remote DMA over the 2-device submesh — the multi-controller
+# SPMD contract; see ici/device_plane.py).  Requires an XLA backend with
+# cross-process collectives: TPU pods have them; this repo's CPU fabric
+# raises "Multiprocess computations aren't implemented on the CPU
+# backend", so the flag defaults off and device payloads keep the bulk
+# plane there.  A failed/refused post degrades to bulk/inline in the
+# same frame and the plane re-probes after ici_device_plane_retry_s.
+_flags.define_flag("ici_device_plane_xproc", False,
+                   "route cross-process device payloads through compiled "
+                   "XLA transfer programs (needs multi-controller "
+                   "collectives: TPU pods)")
+_flags.define_flag("ici_device_plane_retry_s", 2.0,
+                   "seconds a degraded fabric device plane waits before "
+                   "re-probing")
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 
@@ -301,6 +318,10 @@ class FabricNode:
                 # same-host from same-address-on-another-host
                 info["bulk_uds"] = self.bulk_uds
                 info["host"] = self.host_ip
+        if _flags.get_flag("ici_device_plane"):
+            # device-plane capability advert (both ends must hold it:
+            # one-sided entry into an SPMD program would hang forever)
+            info["dplane"] = 1
         self._kv.key_value_set(_KV_PREFIX + str(self.process_id),
                                json.dumps(info))
         log.info("fabric: process %d/%d up ctrl=%s xfer=%s devices=%s",
@@ -629,6 +650,18 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         # inline d2h fallback instead (review finding)
         self._xfer_usable = (node._xfer_server is not None
                              and "xfer" in node.peer_info(peer_pid))
+        # cross-process device plane (kind-4): compiled-program transfers
+        # both processes enter.  Down-latched on failure with a timed
+        # re-probe; the executor thread enters collectives in control
+        # order (= the peer's order — the SPMD ordering contract).
+        self._dplane_peer = "dplane" in node.peer_info(peer_pid)
+        self._dplane_lock = threading.Lock()
+        self._dplane_down_until = 0.0      # 0 = up; else re-probe deadline
+        self._dplane_qs = {}               # direction -> lazy executor queue
+        self._dplane_closed = False
+        self.dplane_bytes_sent = 0         # cumulative, for tests/builtin
+        self.dplane_bytes_recv = 0
+        self.dplane_fallbacks = 0
 
     def _attach_bulk(self, lib, handle: int) -> None:
         """Bind the native bulk data-plane connection (both ends hold one
@@ -809,6 +842,102 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._reestab_ok = ok and pending is not None
         self._reestab_evt.set()
 
+    # ---- device plane (kind-4 compiled-program transfers) --------------
+    def _dplane_usable(self, nbytes: int) -> bool:
+        """Route this device payload through a compiled cross-process
+        transfer program?  Needs the master+xproc flags, a peer that
+        advertised the capability, an eligible size/platform, and a
+        plane that is not down-latched (a lapsed latch re-probes)."""
+        if not _flags.get_flag("ici_device_plane_xproc"):
+            return False
+        if not self._dplane_peer or not _dp.eligible(nbytes):
+            return False
+        with self._dplane_lock:
+            if self._dplane_down_until:
+                if time.monotonic() < self._dplane_down_until:
+                    return False
+                self._dplane_down_until = 0.0     # re-probe window
+                log.info("fabric %s: device plane re-probing",
+                         self.remote_side)
+        return True
+
+    def _device_plane_down(self, reason: str) -> None:
+        """Degrade: device payloads ride the PR-2 bulk/inline machinery
+        from the next frame until the re-probe deadline lapses."""
+        retry = _flags.get_flag("ici_device_plane_retry_s")
+        with self._dplane_lock:
+            already = self._dplane_down_until > time.monotonic()
+            self._dplane_down_until = time.monotonic() + retry
+        self.dplane_fallbacks += 1
+        if not already:
+            log.warning("fabric %s: device plane down (%s) — bulk/inline "
+                        "fallback engaged, re-probe in %.1fs",
+                        self.remote_side, reason, retry)
+
+    def _dplane_submit(self, t, direction: str) -> None:
+        """Enqueue a transfer for an executor thread.  One FIFO per
+        socket per DIRECTION: our "send" queue pairs with the peer's
+        "recv" queue through the serial control channel (descriptors
+        commit in encode order, arrive in the same order), so each
+        direction's collectives are entered in matching order on both
+        processes.  Mixing directions in one FIFO would interleave them
+        differently on each side — a guaranteed cross-process ordering
+        mismatch under bidirectional load.  (Concurrent collectives from
+        the two direction threads remain subject to the backend's
+        device-stream ordering; the pod-scale sequencer is future work —
+        see PARITY.md.)  A submit after teardown fails the transfer
+        instead of resurrecting an executor for a dead socket."""
+        import queue
+        with self._dplane_lock:
+            if self._dplane_closed:
+                q = None
+            else:
+                q = self._dplane_qs.get(direction)
+                if q is None:
+                    q = self._dplane_qs[direction] = queue.Queue()
+                    threading.Thread(
+                        target=self._dplane_exec_loop, args=(q,),
+                        name=f"fabric_dplane_{direction}",
+                        daemon=True).start()
+        if q is None:
+            _dp.plane().fail_transfer(t, "socket torn down before "
+                                         "execution")
+            return
+        q.put(t)
+
+    def _dplane_exec_loop(self, q) -> None:
+        while True:
+            t = q.get()
+            if t is None:
+                # teardown: everything still queued can never execute —
+                # fail it so completions fire and source pins release
+                while True:
+                    try:
+                        t2 = q.get_nowait()
+                    except Exception:
+                        return
+                    if t2 is not None:
+                        _dp.plane().fail_transfer(
+                            t2, "socket torn down before execution")
+            if self.failed or self._peer_gone():
+                _dp.plane().fail_transfer(t, "socket failed before "
+                                             "execution")
+                continue
+            try:
+                _dp.plane().execute_remote(t)
+            except Exception as e:
+                # the transfer is already failed (completion signaled
+                # with an error — delivery/claim paths observe it);
+                # latch the plane so later frames keep bulk/inline
+                self._device_plane_down(f"execution failed: {e}")
+
+    def _close_dplane(self) -> None:
+        with self._dplane_lock:
+            self._dplane_closed = True
+            qs, self._dplane_qs = self._dplane_qs, {}
+        for q in qs.values():
+            q.put(None)
+
     def start_io(self) -> None:
         self._reader = threading.Thread(target=self._read_loop,
                                         name="fabric_read", daemon=True)
@@ -902,7 +1031,36 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             if r.offset or r.length != len(arr):
                 arr = arr[r.offset:r.offset + r.length]
             kind = 0
-            if self._bulk_alive():
+            # device plane first (kind 4): the payload crosses through a
+            # compiled XLA program both processes enter — no host bytes
+            # anywhere in the datapath.  A refused post degrades to the
+            # bulk/inline machinery below WITHIN this same frame (the
+            # descriptor-consistency rule: nothing is committed to the
+            # control stream until its transport is decided).
+            dplane_src = -1
+            if (hasattr(arr, "devices")
+                    and self._dplane_usable(r.length)):
+                # the route's true source is wherever the array LIVES —
+                # a process owns several devices and the receiver must
+                # compile the identical (src, dst) submesh program, so
+                # src rides the descriptor
+                src_idx = _dp.mesh_index_of(arr)
+                if src_idx >= 0 and src_idx != self.remote_dev:
+                    try:
+                        t = _dp.plane().post_send(
+                            arr, src_idx, self.remote_dev,
+                            socket=self, uuid=self.node.next_uuid(),
+                            remote=True)
+                        t.add_source_release(
+                            getattr(r.block, "on_send_complete", None))
+                        self._dplane_submit(t, "send")
+                        uuid = t.uuid
+                        dplane_src = src_idx
+                        kind = 4
+                        self.dplane_bytes_sent += r.length
+                    except _dp.DevicePlaneError as e:
+                        self._device_plane_down(str(e))
+            if kind == 0 and self._bulk_alive():
                 # device -> host staging (on CPU backends a zero-copy
                 # view; on TPU the D2H leg of a host-staged fabric)
                 import numpy as np
@@ -955,6 +1113,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             out.append(struct.pack("<%dQ" % len(shape), *shape)
                        if shape else b"")
             out.append(struct.pack("<Q", r.length))
+            if kind == 4:
+                out.append(struct.pack("<I", dplane_src))
             nchunks += 1
         flush_host()
         out[0] = struct.pack("<I", nchunks)
@@ -1118,6 +1278,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._wake_window()
         self._flush_staged()
         self._close_bulk()
+        self._close_dplane()
 
         def commit_eof():
             with self._inbox_lock:
@@ -1143,9 +1304,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         from jax.sharding import SingleDeviceSharding
         (nchunks,) = struct.unpack_from("<I", body, 0)
         off = 4
-        buf = IOBuf()
+        # parts assemble into the delivered IOBuf at commit time: kind-4
+        # outputs (device-plane transfers) do not exist until their
+        # compiled program has run on the executor, so the buffer cannot
+        # be built inline the way pure claim/pull kinds could
+        parts: List = []
         pulled_uuids: List[int] = []
-        device_arrays: List = []
+        waits: List = []
         local_device = jax.devices()[self.local_dev]
         for _ in range(nchunks):
             kind, = struct.unpack_from("<B", body, off)
@@ -1153,12 +1318,12 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             if kind == 0:
                 (blen,) = struct.unpack_from("<I", body, off)
                 off += 4
-                buf.append(body[off:off + blen])
+                parts.append(body[off:off + blen])
                 off += blen
             elif kind == 3:
                 uuid, blen = struct.unpack_from("<QQ", body, off)
                 off += 16
-                buf.append(self._bulk_claim_bytes(uuid, blen))
+                parts.append(self._bulk_claim_bytes(uuid, blen))
             else:
                 uuid, dtlen = struct.unpack_from("<QH", body, off)
                 off += 10
@@ -1171,6 +1336,19 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 off += 8 * ndim
                 (length,) = struct.unpack_from("<Q", body, off)
                 off += 8
+                if kind == 4:
+                    (src_dev,) = struct.unpack_from("<I", body, off)
+                    off += 4
+                    # device-plane descriptor: enqueue the matching recv;
+                    # the executor joins the sender's compiled program in
+                    # control order (the rendezvous)
+                    t = _dp.plane().post_recv_remote(
+                        uuid, length, src_dev=src_dev,
+                        dst_dev=self.local_dev, socket=self)
+                    self._dplane_submit(t, "recv")
+                    parts.append(t)
+                    waits.append(t)
+                    continue
                 if kind == 2:
                     arr = self._bulk_claim_array(uuid, dt, shape, length,
                                                  local_device)
@@ -1178,7 +1356,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     # only genuine device arrays gate ordered delivery
                     # on the device waiter
                     if hasattr(arr, "is_ready"):
-                        device_arrays.append(arr)
+                        waits.append(arr)
                 else:
                     sds = jax.ShapeDtypeStruct(
                         shape, jnp.dtype(dt),
@@ -1186,10 +1364,29 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     arr = self.node.xfer_connection(self.peer_pid).pull(
                         uuid, [sds])[0]
                     pulled_uuids.append(uuid)
-                    device_arrays.append(arr)
-                buf.append_device_array(arr)
+                    waits.append(arr)
+                parts.append(("dev", arr))
 
         def commit():
+            from . import device_plane as _dpl
+            buf = IOBuf()
+            for p in parts:
+                if isinstance(p, _dpl.DeviceTransfer):
+                    if p.out is None or p.state == _dpl.FAILED:
+                        # the payload can never be delivered and the
+                        # control byte stream cannot be repaired — same
+                        # terminal rule as a failed kind-2 claim
+                        self.set_failed(
+                            errors.EFAILEDSOCKET,
+                            f"device-plane transfer {p.uuid:#x} failed: "
+                            f"{p.error}")
+                        return
+                    self.dplane_bytes_recv += p.nbytes
+                    buf.append_device_array(p.out)
+                elif isinstance(p, tuple):
+                    buf.append_device_array(p[1])
+                else:
+                    buf.append(p)
             # the PULLED ack (CQ completion): data is resident locally,
             # sender may reuse its source blocks
             for u in pulled_uuids:
@@ -1203,7 +1400,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
 
         # ordered per-socket commit — a host-only frame must not jump
         # ahead of an earlier device-bearing frame still in flight
-        self._enqueue_delivery(device_arrays, commit)
+        self._enqueue_delivery(waits, commit)
 
     def _bulk_claim(self, uuid: int):
         # Bulk frames can trail their control descriptor (separate TCP
@@ -1346,6 +1543,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._wake_window()
         self._flush_staged()
         self._close_bulk()
+        self._close_dplane()
 
     def _close_bulk(self) -> None:
         """Tear down the bulk conn WITHOUT starting revival (socket-level

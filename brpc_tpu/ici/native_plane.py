@@ -105,14 +105,19 @@ def registry() -> _DevRegistry:
 def _relocate(key: int, target_dev: int) -> int:
     """Move the array behind ``key`` to mesh device ``target_dev``; returns
     a NEW key for the moved array (native releases the old one) or the same
-    key when already resident.  0 = failure (native fails the RPC)."""
+    key when already resident.  0 = failure (native fails the RPC).
+
+    Payloads at/above ``ici_device_plane_threshold`` cross through the
+    device plane's compiled transfer program (post_send + rendezvous —
+    the no-host datapath); smaller or refused ones keep device_put."""
     try:
         import jax
         from .mesh import IciMesh
         arr = _registry.peek(key)
         if arr is None:
             return 0
-        target = IciMesh.default().device(target_dev)
+        mesh = IciMesh.default()
+        target = mesh.device(target_dev)
         if not hasattr(arr, "devices"):
             # host-delivered fabric bulk payload (a ctypes-backed numpy
             # view over the native receive buffer) being forwarded into
@@ -128,6 +133,17 @@ def _relocate(key: int, target_dev: int) -> int:
                     return key                   # resident: pure ref pass
             except Exception:
                 pass
+            from . import device_plane as _dp
+            nbytes = int(arr.shape[0]) if arr.ndim == 1 else 0
+            if nbytes and _dp.eligible(nbytes):
+                src_idx = _dp.mesh_index_of(arr, mesh)
+                if src_idx >= 0 and src_idx != target_dev:
+                    try:
+                        t = _dp.plane().transfer_local(arr, src_idx,
+                                                       target_dev)
+                        return _registry.put(t.out)
+                    except _dp.DevicePlaneError:
+                        pass       # counted by the plane; device_put path
         moved = jax.device_put(arr, target)      # HBM→HBM over ICI
         return _registry.put(moved)
     except Exception as e:                       # never raise across ctypes

@@ -145,7 +145,11 @@ class OrderedDelivery:
     """Mixin: per-socket in-order commit of received frames whose device
     payloads become ready asynchronously.  A host-only frame arriving
     after a device-bearing one must not jump the queue (byte-stream
-    ordering is the transport contract the parsers rely on)."""
+    ordering is the transport contract the parsers rely on).
+
+    Waits may be plain device arrays (gated through the per-device
+    completion poller) or device-plane transfers / any object exposing
+    ``add_done_callback`` (gated on its completion — the CQ entry)."""
 
     def _init_delivery(self) -> None:
         import collections
@@ -153,20 +157,36 @@ class OrderedDelivery:
         self._dq_lock = threading.Lock()
         self._dq_draining = False
 
-    def _enqueue_delivery(self, device_arrays: List,
+    def _enqueue_delivery(self, waits: List,
                           commit_fn: Callable[[], None]) -> None:
         entry = [False, commit_fn]
         with self._dq_lock:
             self._dq.append(entry)
 
-        def mark():
+        arrays = [w for w in waits if not hasattr(w, "add_done_callback")]
+        handles = [w for w in waits if hasattr(w, "add_done_callback")]
+        gates = len(handles) + (1 if arrays and not _all_ready(arrays)
+                                else 0)
+        if gates == 0:
+            entry[0] = True
+            self._drain_deliveries()
+            return
+
+        left = [gates]
+        left_lock = threading.Lock()
+
+        def one_gate(_err=None):
+            with left_lock:
+                left[0] -= 1
+                if left[0] > 0:
+                    return
             entry[0] = True
             self._drain_deliveries()
 
-        if device_arrays and not _all_ready(device_arrays):
-            DeviceEventDispatcher.instance().on_ready(device_arrays, mark)
-        else:
-            mark()
+        if arrays and not _all_ready(arrays):
+            DeviceEventDispatcher.instance().on_ready(arrays, one_gate)
+        for h in handles:
+            h.add_done_callback(one_gate)
 
     def _drain_deliveries(self) -> None:
         while True:
@@ -230,8 +250,16 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
 
     def _relocate(self, frame: IOBuf) -> List:
         """Move DEVICE refs to the peer's chip (HBM→HBM over ICI); host
-        refs pass through as bytes."""
+        refs pass through as bytes.  Device-resident payloads at/above
+        ``ici_device_plane_threshold`` post a send WR on the device plane
+        instead — the payload then crosses through a COMPILED transfer
+        program (shard_map + ppermute / Pallas remote DMA) with only a
+        descriptor riding the delivery path; the matching recv is
+        enqueued by ``_deliver`` (the QP rendezvous).  A refused post
+        (chaos, unbuildable program) degrades to device_put in the same
+        frame."""
         import jax
+        from . import device_plane as _dp
         target = self.mesh.device(self.remote_dev)
         chunks: List = []
         pending_host: List[bytes] = []
@@ -261,10 +289,26 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
                 # already in the target chip's HBM: pure ref pass — the
                 # zero-copy case the block_pool discipline exists for
                 if resident:
-                    moved = arr
-                else:
-                    moved = jax.device_put(arr, target)
-                    self._pin_until_sent(r.block, moved)
+                    chunks.append((arr, r.length))
+                    with _ici_stats_lock:
+                        _ici_device_bytes_moved += r.length
+                    continue
+                if _dp.eligible(r.length):
+                    src_idx = _dp.mesh_index_of(arr, self.mesh)
+                    if src_idx >= 0 and src_idx != self.remote_dev:
+                        try:
+                            t = _dp.plane().post_send(
+                                arr, src_idx, self.remote_dev, socket=self)
+                            t.add_source_release(
+                                getattr(r.block, "on_send_complete", None))
+                            chunks.append(_PlaneDesc(t, r.length))
+                            with _ici_stats_lock:
+                                _ici_device_bytes_moved += r.length
+                            continue
+                        except _dp.DevicePlaneError:
+                            pass         # counted by the plane; fall back
+                moved = jax.device_put(arr, target)
+                self._pin_until_sent(r.block, moved)
                 chunks.append((moved, r.length))
                 with _ici_stats_lock:
                     _ici_device_bytes_moved += r.length
@@ -275,12 +319,23 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
         return chunks
 
     def _deliver(self, peer: "IciSocket", chunks: List) -> None:
-        device_arrays = [c[0] for c in chunks if isinstance(c, tuple)]
+        from . import device_plane as _dp
+        waits: List = []
+        for c in chunks:
+            if isinstance(c, _PlaneDesc):
+                # the matching recv: rendezvous with the posted send —
+                # both sides join the same compiled transfer program
+                c.transfer = _dp.plane().post_recv(c.transfer.uuid)
+                waits.append(c.transfer)
+            elif isinstance(c, tuple):
+                waits.append(c[0])
 
         def commit() -> None:
             buf = IOBuf()
             for c in chunks:
-                if isinstance(c, tuple):
+                if isinstance(c, _PlaneDesc):
+                    buf.append_device_array(c.transfer.out)
+                elif isinstance(c, tuple):
                     buf.append_device_array(c[0])
                 else:
                     buf.append(c)
@@ -292,7 +347,7 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
 
         # ordered per-socket commit: the read event fires only after the
         # payload landed in peer HBM, and never out of arrival order
-        peer._enqueue_delivery(device_arrays, commit)
+        peer._enqueue_delivery(waits, commit)
 
     def _pin_until_sent(self, src_block, moved) -> None:
         """Hold the SOURCE device block (and the moved array) until the
@@ -349,6 +404,18 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
             peer._wake_window()
         # release our own writers blocked on the (now dead) window
         self._wake_window()
+
+
+class _PlaneDesc:
+    """A device-plane descriptor riding the in-process delivery path: the
+    posted send's WR handle plus the payload length — the peer's
+    ``post_recv`` fills in the dst-resident output at rendezvous."""
+
+    __slots__ = ("transfer", "length")
+
+    def __init__(self, transfer, length: int):
+        self.transfer = transfer
+        self.length = length
 
 
 def _all_ready(arrays) -> bool:

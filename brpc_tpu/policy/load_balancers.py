@@ -284,56 +284,147 @@ class ConsistentHashingLB(_ListLB):
             return ring[i % len(ring)][1]
 
 
+class _LaWeight:
+    """Per-server divided-weight state (the reference's Weight class,
+    locality_aware_load_balancer.h:80-120 / docs/cn/lalb.md).
+
+    * ``base weight`` = WEIGHT_SCALE / avg_latency over a sliding window
+      of the last RECV_QUEUE_SIZE samples — weight is proportional to
+      the server's observed QPS capacity.
+    * **error punishment**: a failed call contributes a PUNISHED sample
+      (``avg_latency × PUNISH_RATIO``) instead of its real latency, so a
+      flapping server's window fills with inflated latencies and its
+      weight collapses multiplicatively; successful calls wash the
+      punishment out of the window — the recovery half.
+    * **in-flight extrapolation** (the "divided weight"): at selection
+      time, a server whose oldest in-flight requests have ALREADY waited
+      longer than its average latency is predicted slower than its
+      window says — its weight is divided by elapsed/avg on the spot.
+      This is what reroutes traffic within ONE request time of a server
+      freezing, long before any timeout feedback arrives.
+    """
+
+    __slots__ = ("samples", "latency_sum", "begin_time_sum",
+                 "begin_time_count")
+
+    QUEUE_SIZE = 128            # reference RECV_QUEUE_SIZE
+    PUNISH_RATIO = 4.0          # error sample = avg * ratio
+
+    def __init__(self):
+        import collections
+        self.samples = collections.deque(maxlen=self.QUEUE_SIZE)
+        self.latency_sum = 0.0
+        self.begin_time_sum = 0.0    # sum of in-flight begin times (us)
+        self.begin_time_count = 0
+
+    def avg_latency(self) -> float:
+        return (self.latency_sum / len(self.samples)
+                if self.samples else 0.0)
+
+    def push(self, latency_us: float) -> None:
+        if len(self.samples) == self.samples.maxlen:
+            self.latency_sum -= self.samples[0]
+        self.samples.append(latency_us)
+        self.latency_sum += latency_us
+
+
 class LocalityAwareLB(_ListLB):
-    """LALB (locality_aware_load_balancer.{h,cpp}, docs/cn/lalb.md): server
-    weight ∝ 1/latency with error punishment; selection is weighted random
-    over dynamic weights (the reference's weight tree is an O(log n)
-    optimization of exactly this distribution)."""
+    """LALB — the reference's divided-weight algorithm
+    (locality_aware_load_balancer.{h,cpp}, docs/cn/lalb.md): weight ∝
+    WEIGHT_SCALE/avg_latency over a sample window, errors punished as
+    inflated-latency samples (recovery = real samples washing them out),
+    and in-flight latency extrapolation dividing a stuck server's weight
+    at selection time.  Selection is weighted-random over the effective
+    weights — the reference's weight tree is an O(log n) index over
+    exactly this distribution; O(n) keeps the same distribution
+    (acceptable per the rewrite brief) and MIN_WEIGHT keeps every
+    usable server reachable (starvation-freedom: a punished server must
+    keep receiving probe traffic or it could never recover)."""
 
     name = "la"
-    INITIAL_WEIGHT = 1000.0
+    WEIGHT_SCALE = 1e7
+    INITIAL_WEIGHT = 1000.0     # until the first sample lands
     MIN_WEIGHT = 1.0
 
     def __init__(self):
         super().__init__()
         self._w_lock = threading.Lock()
-        self._weights: Dict[EndPoint, float] = {}
-        self._avg_latency: Dict[EndPoint, float] = {}
+        self._servers: Dict[EndPoint, _LaWeight] = {}
+
+    def _weight_for(self, ep: EndPoint) -> _LaWeight:
+        w = self._servers.get(ep)
+        if w is None:
+            w = self._servers[ep] = _LaWeight()
+        return w
+
+    def _effective_weight(self, w: _LaWeight, now_us: float) -> float:
+        avg = w.avg_latency()
+        if avg <= 0:
+            return self.INITIAL_WEIGHT
+        base = self.WEIGHT_SCALE / avg
+        # in-flight extrapolation: requests outstanding longer than the
+        # average latency predict a slower server than the window shows
+        if w.begin_time_count > 0:
+            avg_begin = w.begin_time_sum / w.begin_time_count
+            elapsed = now_us - avg_begin
+            if elapsed > avg:
+                base = base * avg / elapsed         # the divided weight
+        return max(base, self.MIN_WEIGHT)
 
     def select_server(self, cntl=None):
+        import time as _time
         with self._dbd.read() as lst:
             usable = self._usable(lst, cntl)
         if not usable:
             return None
+        now_us = _time.monotonic() * 1e6
         with self._w_lock:
-            ws = [max(self._weights.get(e.endpoint, self.INITIAL_WEIGHT),
-                      self.MIN_WEIGHT) for e in usable]
-        total = sum(ws)
-        r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
-        acc = 0.0
-        for e, w in zip(usable, ws):
-            acc += w
-            if r < acc:
-                return e.endpoint
-        return usable[-1].endpoint
+            ws = [self._effective_weight(self._weight_for(e.endpoint),
+                                         now_us) for e in usable]
+            total = sum(ws)
+            r = (fast_rand_less_than(1 << 30) / float(1 << 30)) * total
+            acc = 0.0
+            chosen = usable[-1].endpoint
+            for e, w in zip(usable, ws):
+                acc += w
+                if r < acc:
+                    chosen = e.endpoint
+                    break
+            # note the in-flight begin (reference Weight::AddInflight):
+            # feedback() subtracts it back out
+            cw = self._weight_for(chosen)
+            cw.begin_time_sum += now_us
+            cw.begin_time_count += 1
+            return chosen
 
     def feedback(self, ep, error_code, latency_us) -> None:
+        import time as _time
+        now_us = _time.monotonic() * 1e6
         with self._w_lock:
+            w = self._weight_for(ep)
+            # retire one in-flight entry: remove this request's begin
+            # time (≈ now - latency; the reference stores it exactly,
+            # the approximation only skews extrapolation by queueing
+            # delay).  Tolerates feedback without a matching select —
+            # combo channels feed sub-call results directly.
+            if w.begin_time_count > 0:
+                w.begin_time_sum -= now_us - latency_us
+                w.begin_time_count -= 1
+                if w.begin_time_count == 0:
+                    w.begin_time_sum = 0.0
             if error_code != 0:
-                # punish: halve weight (reference punishes via inflated
-                # latency; halving has the same direction and is bounded)
-                self._weights[ep] = max(
-                    self._weights.get(ep, self.INITIAL_WEIGHT) * 0.5,
-                    self.MIN_WEIGHT)
-                return
-            avg = self._avg_latency.get(ep)
-            avg = latency_us if avg is None else avg * 0.9 + latency_us * 0.1
-            self._avg_latency[ep] = max(avg, 1.0)
-            self._weights[ep] = 1e7 / self._avg_latency[ep]
+                avg = w.avg_latency()
+                punished = max(avg, float(latency_us), 1.0) \
+                    * _LaWeight.PUNISH_RATIO
+                w.push(punished)
+            else:
+                w.push(max(float(latency_us), 1.0))
 
     def weight_of(self, ep) -> float:
+        import time as _time
         with self._w_lock:
-            return self._weights.get(ep, self.INITIAL_WEIGHT)
+            return self._effective_weight(self._weight_for(ep),
+                                          _time.monotonic() * 1e6)
 
 
 class DynPartLB(_ListLB):

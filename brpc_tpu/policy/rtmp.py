@@ -15,9 +15,14 @@ ExecutionQueue exactly like Streaming RPC, and waits use tasklet-aware
 countdown events.
 
 Wire format per Adobe's public RTMP specification: simple (non-digest)
-handshake C0C1C2/S0S1S2, chunk basic+message headers fmt 0-3 with
-extended timestamps, protocol control messages 1-6, AMF0 command/data
-messages, aggregate message splitting.
+AND digest ("complex") handshake C0C1C2/S0S1S2 — the server auto-detects
+a digest-mode C1 (HMAC-SHA256 with the Genuine-FP key, schemes 0 and 1)
+and answers with a digest-mode S1/S2 (rtmp_protocol.cpp's
+"simple_handshake/complex handshake" split; FMS rejects H.264 publishes
+from non-digest peers, which is why the complex form exists at all);
+clients opt in via the ``rtmp_client_digest`` flag — chunk basic+message
+headers fmt 0-3 with extended timestamps, protocol control messages 1-6,
+AMF0 command/data messages, aggregate message splitting.
 """
 from __future__ import annotations
 
@@ -33,9 +38,15 @@ from ..butil import logging as log
 from ..bthread.countdown import CountdownEvent
 from ..bthread.execution_queue import ExecutionQueue
 from ..rpc import errors
+from ..butil import flags as _flags
 from ..rpc.protocol import (CONNECTION_TYPE_SINGLE, ParseResult, Protocol,
                             register_protocol)
 from . import amf
+
+_flags.define_flag("rtmp_client_digest", False,
+                   "RTMP clients perform the digest (complex) handshake "
+                   "instead of the simple one (required by FMS for "
+                   "H.264 publishes)")
 
 # ---- message type ids (rtmp_protocol.cpp message dispatch) -------------
 
@@ -80,6 +91,98 @@ DEFAULT_WINDOW_ACK_SIZE = 2500000
 _MAX_MESSAGE_SIZE = 64 << 20
 
 _TIMESTAMP_MASK = 0xFFFFFF
+
+# ---- digest ("complex") handshake -------------------------------------
+# rtmp_protocol.cpp (RtmpUnsentHandshakeC/S + ComputeDigestBase): C1/S1
+# embed an HMAC-SHA256 digest at an offset derived from 4 offset bytes;
+# scheme 0 puts the offset field right after time+version (bytes 8-12),
+# scheme 1 after the 764-byte key block (bytes 772-776).  The published
+# Genuine-Adobe constants (the same tables the reference carries):
+
+_FP_KEY = (b"Genuine Adobe Flash Player 001"
+           b"\xF0\xEE\xC2\x4A\x80\x68\xBE\xE8\x2E\x00\xD0\xD1\x02\x9E"
+           b"\x7E\x57\x6E\xEC\x5D\x2D\x29\x80\x6F\xAB\x93\xB8\xE6\x36"
+           b"\xCF\xEB\x31\xAE")                       # 62 bytes
+_FMS_KEY = (b"Genuine Adobe Flash Media Server 001"
+            b"\xF0\xEE\xC2\x4A\x80\x68\xBE\xE8\x2E\x00\xD0\xD1\x02\x9E"
+            b"\x7E\x57\x6E\xEC\x5D\x2D\x29\x80\x6F\xAB\x93\xB8\xE6\x36"
+            b"\xCF\xEB\x31\xAE")                      # 68 bytes
+_DIGEST_SIZE = 32
+# digest-mode C1/S1 advertise a nonzero "version" field (flash/FMS
+# version); zero means the peer speaks the simple handshake only
+_C1_VERSION = b"\x80\x00\x07\x02"
+_S1_VERSION = b"\x04\x05\x00\x01"
+
+
+def _hmac_sha256(key: bytes, msg: bytes) -> bytes:
+    import hashlib
+    import hmac as _hmac
+    return _hmac.new(key, msg, hashlib.sha256).digest()
+
+
+def _digest_offset(block: bytes, scheme: int) -> int:
+    """Digest offset within the 1536-byte block for the given scheme."""
+    if scheme == 0:
+        base, field = 12, block[8:12]
+    else:
+        base, field = 776, block[772:776]
+    return base + sum(field) % 728
+
+
+def _embedded_digest(block: bytes, scheme: int):
+    """(digest, joined-rest) at the scheme's offset; the digest is
+    valid iff HMAC(key, rest) reproduces it."""
+    off = _digest_offset(block, scheme)
+    digest = block[off:off + _DIGEST_SIZE]
+    rest = block[:off] + block[off + _DIGEST_SIZE:]
+    return digest, rest
+
+
+def find_handshake_digest(block: bytes, key: bytes = _FP_KEY[:30]):
+    """Locate + validate a digest-mode C1/S1.  Returns the 32-byte
+    digest, or None when neither scheme validates (a simple-handshake
+    peer)."""
+    for scheme in (0, 1):
+        digest, rest = _embedded_digest(block, scheme)
+        if _hmac_sha256(key, rest) == digest:
+            return digest
+    return None
+
+
+def make_digest_block(version: bytes, key: bytes,
+                      rand: Optional[bytes] = None) -> bytes:
+    """Build a digest-mode C1/S1 (scheme 0): time + version + 1528
+    random bytes with the HMAC digest embedded at the derived offset.
+    ``rand`` pins the randomness for fixture recording."""
+    if rand is None:
+        rand = os.urandom(HANDSHAKE_SIZE - 8)
+    assert len(rand) == HANDSHAKE_SIZE - 8
+    block = bytearray(struct.pack(">I", int(time.monotonic()) & 0xFFFFFFFF)
+                      + version + rand)
+    off = _digest_offset(bytes(block), 0)
+    digest = _hmac_sha256(key, bytes(block[:off])
+                          + bytes(block[off + _DIGEST_SIZE:]))
+    block[off:off + _DIGEST_SIZE] = digest
+    return bytes(block)
+
+
+def make_handshake_response2(peer_digest: bytes, full_key: bytes,
+                             rand: Optional[bytes] = None) -> bytes:
+    """Digest-mode C2/S2: 1504 random bytes + HMAC over them, keyed with
+    HMAC(full_key, peer's C1/S1 digest) — each side proves it read the
+    other's digest.  S2 uses the full FMS key, C2 the full FP key."""
+    if rand is None:
+        rand = os.urandom(HANDSHAKE_SIZE - _DIGEST_SIZE)
+    assert len(rand) == HANDSHAKE_SIZE - _DIGEST_SIZE
+    key = _hmac_sha256(full_key, peer_digest)
+    return rand + _hmac_sha256(key, rand)
+
+
+def validate_handshake_response2(block: bytes, own_digest: bytes,
+                                 full_key: bytes) -> bool:
+    rand, mac = block[:-_DIGEST_SIZE], block[-_DIGEST_SIZE:]
+    key = _hmac_sha256(full_key, own_digest)
+    return _hmac_sha256(key, rand) == mac
 
 
 class RtmpMessage:
@@ -345,14 +448,20 @@ class RtmpConnection:
         self._pending_lock = threading.Lock()
         self._out_lock = threading.RLock()
         self._c1_sent = b""
+        self._c1_digest: Optional[bytes] = None
         self._connect_request: Dict[str, Any] = {}
         socket.on_failed_callbacks.append(self._on_socket_failed)
 
     # ---- outbound ------------------------------------------------------
 
     def _start_client_handshake(self) -> None:
-        c1 = struct.pack(">II", int(time.monotonic()) & 0xFFFFFFFF, 0) \
-            + os.urandom(HANDSHAKE_SIZE - 8)
+        if _flags.get_flag("rtmp_client_digest"):
+            c1 = make_digest_block(_C1_VERSION, _FP_KEY[:30])
+            self._c1_digest = find_handshake_digest(c1)
+        else:
+            c1 = struct.pack(">II", int(time.monotonic()) & 0xFFFFFFFF, 0) \
+                + os.urandom(HANDSHAKE_SIZE - 8)
+            self._c1_digest = None
         self._c1_sent = c1
         self.socket.write(IOBuf(bytes([RTMP_VERSION]) + c1))
 
@@ -472,8 +581,19 @@ class RtmpConnection:
                 raise ValueError(f"bad RTMP version {data[0]}")
             source.pop_front(1 + HANDSHAKE_SIZE)
             c1 = data[1:]
-            s1 = struct.pack(">II", 0, 0) + os.urandom(HANDSHAKE_SIZE - 8)
-            self.socket.write(IOBuf(bytes([RTMP_VERSION]) + s1 + c1))
+            # digest auto-detection (rtmp_protocol.cpp: try the complex
+            # handshake, fall back to simple): a C1 whose HMAC validates
+            # under either scheme gets a digest-mode S1 + keyed S2; a
+            # plain C1 gets the simple echo
+            c1_digest = find_handshake_digest(c1)
+            if c1_digest is not None:
+                s1 = make_digest_block(_S1_VERSION, _FMS_KEY[:36])
+                s2 = make_handshake_response2(c1_digest, _FMS_KEY)
+            else:
+                s1 = struct.pack(">II", 0, 0) \
+                    + os.urandom(HANDSHAKE_SIZE - 8)
+                s2 = c1
+            self.socket.write(IOBuf(bytes([RTMP_VERSION]) + s1 + s2))
             self.state = _HS_WAIT_C2
             return True
         if self.state == _HS_WAIT_C2:
@@ -490,7 +610,24 @@ class RtmpConnection:
                 raise ValueError(f"bad RTMP version {data[0]}")
             source.pop_front(1 + 2 * HANDSHAKE_SIZE)
             s1 = data[1:1 + HANDSHAKE_SIZE]
-            self.socket.write(IOBuf(s1))        # C2 echoes S1
+            s2 = data[1 + HANDSHAKE_SIZE:]
+            c2 = s1                             # simple: C2 echoes S1
+            if self._c1_digest is not None:
+                # digest mode: validate the server's proof-of-read,
+                # then key C2 on ITS digest.  A simple-handshake server
+                # (no valid S1 digest) downgrades us gracefully — the
+                # reference proceeds the same way
+                s1_digest = find_handshake_digest(s1, _FMS_KEY[:36])
+                if s1_digest is not None:
+                    if not validate_handshake_response2(
+                            s2, self._c1_digest, _FMS_KEY):
+                        raise ValueError("rtmp digest handshake: S2 "
+                                         "proof-of-read invalid")
+                    c2 = make_handshake_response2(s1_digest, _FP_KEY)
+                else:
+                    log.warning("rtmp: digest C1 answered by a "
+                                "simple-handshake server; downgrading")
+            self.socket.write(IOBuf(c2))
             self.state = _ESTABLISHED
             self._on_client_established()
             return True

@@ -64,6 +64,7 @@ class BuiltinDispatcher:
         self.add("contention", _contention)
         self.add("threads", _threads)
         self.add("list_services", _list_services)
+        self.add("ici", _ici)
         self.add("vlog", _vlog)
         self.add("dir", _dir)
         self.add("pprof/cmdline", _pprof_cmdline)
@@ -235,6 +236,28 @@ def _list_services(server, q):
              "response": md.response_cls.__name__
              if md.response_cls else ""}
             for m, md in svc.methods().items()]
+    return "application/json", json.dumps(out, indent=1)
+
+
+def _ici(server, q):
+    """The ici:// fabric's data planes: transport byte totals and the
+    device plane (compiled-program transfers — program cache, counters,
+    and the recent posted→matched→complete timelines)."""
+    out = {}
+    try:
+        from ...ici.transport import ici_transport_stats
+        moved, device_moved = ici_transport_stats()
+        out["transport"] = {"bytes_moved": moved,
+                            "device_bytes_moved": device_moved}
+    except Exception:
+        out["transport"] = {}
+    try:
+        from ...ici.device_plane import DevicePlane
+        plane = DevicePlane.instance()
+        out["device_plane"] = plane.stats()
+        out["device_plane_recent"] = plane.recent_transfers()
+    except Exception:
+        out["device_plane"] = {}
     return "application/json", json.dumps(out, indent=1)
 
 

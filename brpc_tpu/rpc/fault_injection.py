@@ -139,6 +139,10 @@ class FabricFaultPlan:
                                   (re)establishment handshakes
       refuse_hellos               server refuses the next N control
                                   HELLOs with HELLO_ERR
+      device_plane_fail_posts     refuse the next N device-plane
+                                  post_send WRs (before any descriptor
+                                  exists) — forces the device plane to
+                                  degrade to the bulk/inline fallback
 
     ``injected`` counts what actually fired, keyed by knob name."""
 
@@ -152,7 +156,8 @@ class FabricFaultPlan:
                  bulk_drop_frames: int = 0,
                  bulk_delay_park_ms: int = 0,
                  refuse_bulk_handshakes: int = 0,
-                 refuse_hellos: int = 0):
+                 refuse_hellos: int = 0,
+                 device_plane_fail_posts: int = 0):
         self.match = match
         self.control_sever_after_frames = control_sever_after_frames
         self.control_drop_ratio = control_drop_ratio
@@ -163,13 +168,14 @@ class FabricFaultPlan:
         self.bulk_delay_park_ms = bulk_delay_park_ms
         self._refuse_bulk = refuse_bulk_handshakes
         self._refuse_hellos = refuse_hellos
+        self._fail_device_posts = device_plane_fail_posts
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._ctrl_out = 0           # outbound control frames seen
         self._ctrl_in = 0            # inbound control frames seen
         self.injected = {"control_sever": 0, "control_drop": 0,
                          "bulk_chaos": 0, "refuse_bulk": 0,
-                         "refuse_hello": 0, "die": 0}
+                         "refuse_hello": 0, "die": 0, "device_plane": 0}
 
     def _matches(self, socket) -> bool:
         return self.match is None or bool(self.match(socket))
@@ -239,6 +245,18 @@ class FabricFaultPlan:
             if self._refuse_bulk > 0:
                 self._refuse_bulk -= 1
                 self.injected["refuse_bulk"] += 1
+                return True
+        return False
+
+    def on_device_post(self, socket=None) -> bool:
+        """True → refuse this device-plane post_send (the WR fails before
+        any descriptor exists, so the caller degrades in-frame)."""
+        if socket is not None and not self._matches(socket):
+            return False
+        with self._lock:
+            if self._fail_device_posts > 0:
+                self._fail_device_posts -= 1
+                self.injected["device_plane"] += 1
                 return True
         return False
 

@@ -94,6 +94,19 @@ def start_server_span(cntl, method: str, trace_id: int, parent_span_id: int) -> 
     scheduler.local_set("rpcz_span", span)
 
 
+def annotate_current(text: str) -> None:
+    """Annotate the bthread-local server span, if one is active and
+    sampling kept it.  Deep subsystems (the device plane's
+    posted→matched→complete lifecycle) use this to stamp their timeline
+    onto whatever RPC is being served without threading a Controller
+    down the datapath."""
+    if not rpcz_enabled():
+        return
+    span: Optional[Span] = scheduler.local_get("rpcz_span")
+    if span is not None:
+        span.annotate(text)
+
+
 def end_client_span(cntl) -> None:
     _finish(cntl)
 

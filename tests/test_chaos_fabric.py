@@ -942,3 +942,93 @@ def test_chaos_peer_process_kill_fails_inflight_promptly():
                      expect_rc=(0, 137))
     assert "PK0_OK" in outs[0]
     assert "PK1_UNREACHABLE" not in outs[1]
+
+
+# Chaos-forced DEVICE-PLANE death: the client enables the cross-process
+# device plane (kind-4 compiled-program transfers) but every post is
+# refused by the plan — the payload must degrade to the PR-2 bulk/inline
+# machinery WITHIN the same frame (descriptor-consistency: nothing
+# reaches the control stream for a plane that refused), byte-exact, with
+# the socket alive; the down-latch then routes later frames straight to
+# bulk without re-consulting the plan until the re-probe deadline.
+_DEVICE_PLANE_DEGRADE = _CHILD_PRELUDE + r"""
+import numpy as np
+import jax.numpy as jnp
+from brpc_tpu.butil import flags as _fl
+from brpc_tpu.ici import device_plane as _dp
+
+N = 128 * 1024
+
+if pid == 0:
+    got = []
+
+    class Sink(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Push(self, cntl, request, response, done):
+            got.append(cntl.request_attachment.to_bytes())
+            response.message = str(len(got))
+            done()
+
+    server = rpc.Server(); server.add_service(Sink())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("dp_srv_up", "1")
+    kv.wait_at_barrier("dp_done", 180000)
+    assert len(got) == 2, len(got)
+    expect = bytes(np.arange(N, dtype=np.uint8) %% 249)
+    assert got[0] == expect and got[1] == expect, "payload corrupted"
+    srv = fabric_socks()
+    assert srv and not srv[0].failed, "server socket died"
+    server.stop()
+    print("DP0_OK", flush=True)
+else:
+    # engage the cross-process device plane, with every post refused and
+    # a re-probe deadline far beyond the test (the latch path)
+    _fl.set_flag("ici_device_plane", True)
+    _fl.set_flag("ici_device_plane_host_mesh", True)
+    _fl.set_flag("ici_device_plane_threshold", 4096)
+    _fl.set_flag("ici_device_plane_xproc", True)
+    _fl.set_flag("ici_device_plane_retry_s", 600.0)
+    plan = fi.FabricFaultPlan(device_plane_fail_posts=999)
+    fi.install_fabric(plan)
+    kv.blocking_key_value_get("dp_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    payload = jax.device_put(jnp.arange(N, dtype=jnp.uint8) %% 249,
+                             jax.devices()[local_dev])
+    jax.block_until_ready(payload)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    cntl.request_attachment.append_device_array(payload)
+    resp = ch.call_method("Sink.Push", cntl, EchoRequest(message="a"),
+                          EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    socks = fabric_socks()
+    assert socks and not socks[0].failed, "socket must survive the refusal"
+    s = socks[0]
+    assert s._dplane_peer, "server must advertise the plane capability"
+    assert plan.injected["device_plane"] == 1, plan.injected
+    assert s.dplane_fallbacks >= 1
+    assert s.dplane_bytes_sent == 0          # nothing crossed kind-4
+    assert s.bulk_bytes_sent >= N            # ...the bulk plane carried it
+    # the down-latch: the second frame skips the plane WITHOUT another
+    # chaos consult (still latched), rides bulk, socket stays up
+    cntl2 = rpc.Controller()
+    cntl2.request_attachment.append_device_array(payload)
+    ch.call_method("Sink.Push", cntl2, EchoRequest(message="b"),
+                   EchoResponse)
+    assert not cntl2.failed(), cntl2.error_text
+    assert plan.injected["device_plane"] == 1, plan.injected
+    assert s.bulk_bytes_sent >= 2 * N
+    assert not s.failed
+    fi.install_fabric(None)
+    kv.wait_at_barrier("dp_done", 180000)
+    print("DP1_OK", flush=True)
+"""
+
+
+def test_chaos_device_plane_refusal_degrades_to_bulk_socket_survives():
+    outs = _run_pair(_DEVICE_PLANE_DEGRADE % {"repo": REPO}, timeout=240)
+    assert "DP0_OK" in outs[0]
+    assert "DP1_OK" in outs[1]

@@ -322,6 +322,9 @@ class TestFabricUnits:
         sock._bulk_lock = _threading.Lock()
         sock._reestab_pending = None
         sock._reestab_evt = _threading.Event()
+        sock._dplane_lock = _threading.Lock()
+        sock._dplane_qs = {}
+        sock._dplane_closed = False
         sock._init_delivery()
         events = []
         sock.start_input_event = lambda *a, **k: events.append("input")

@@ -4,6 +4,7 @@ Reference test strategy: in-process loopback server + real client over
 localhost TCP (SURVEY.md §4), plus codec golden-byte checks (the pattern
 of brpc_http_rpc_protocol_unittest etc. for wire formats).
 """
+import os
 import struct
 import threading
 import time
@@ -12,7 +13,8 @@ import pytest
 
 import brpc_tpu.policy  # noqa: F401  (registers protocols)
 from brpc_tpu import rpc
-from brpc_tpu.policy import amf, flv, ts
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.policy import amf, flv, rtmp, ts
 from brpc_tpu.policy.rtmp import (
     CSID_AUDIO, MSG_AUDIO, MSG_COMMAND_AMF0, MSG_SET_CHUNK_SIZE,
     RtmpClient, RtmpClientOptions, RtmpClientStream, RtmpConnection,
@@ -440,3 +442,116 @@ class TestTsMuxer:
             ccs.append(p[3] & 0xF)
         for a, b in zip(ccs, ccs[1:]):
             assert b == (a + 1) & 0xF
+
+
+class TestDigestHandshake:
+    """The digest ("complex") handshake (rtmp_protocol.cpp's
+    complex-handshake path): HMAC-SHA256 digests embedded in C1/S1 at
+    scheme-derived offsets, proof-of-read S2/C2 keyed on the peer's
+    digest, server-side auto-detection, and a recorded digest-mode C1
+    fixture pinning the byte layout."""
+
+    FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "rtmp_digest_c1.bin")
+
+    def _c1_fixture(self):
+        with open(self.FIXTURE, "rb") as f:
+            c1 = f.read()
+        assert len(c1) == rtmp.HANDSHAKE_SIZE
+        return c1
+
+    def test_recorded_c1_fixture_digest_validates(self):
+        c1 = self._c1_fixture()
+        digest = rtmp.find_handshake_digest(c1)
+        assert digest is not None
+        # pinned layout: scheme-0 offset field → digest at a known spot,
+        # regenerating the HMAC over the joined remainder reproduces it
+        off = rtmp._digest_offset(c1, 0)
+        assert off == 365
+        assert c1[off:off + 32] == digest
+        assert digest == rtmp._hmac_sha256(
+            rtmp._FP_KEY[:30], c1[:off] + c1[off + 32:])
+        # a corrupted byte anywhere under the HMAC kills validation
+        bad = bytearray(c1)
+        bad[100] ^= 0xFF
+        assert rtmp.find_handshake_digest(bytes(bad)) is None
+
+    def test_server_answers_digest_c1_with_digest_s1_and_keyed_s2(self):
+        sock = _FakeSocket()
+        conn = rtmp.RtmpConnection(sock, is_server=True)
+        c1 = self._c1_fixture()
+        src = IOBuf(bytes([rtmp.RTMP_VERSION]) + c1)
+        assert conn.consume(src)
+        assert conn.state == rtmp._HS_WAIT_C2
+        out = sock.sent[0]
+        assert out[0] == rtmp.RTMP_VERSION
+        s1 = out[1:1 + rtmp.HANDSHAKE_SIZE]
+        s2 = out[1 + rtmp.HANDSHAKE_SIZE:]
+        # S1 carries a VALID digest under the FMS key (not an echo)
+        assert rtmp.find_handshake_digest(s1, rtmp._FMS_KEY[:36]) \
+            is not None
+        # S2 proves the server READ our C1 digest: HMAC keyed on it
+        c1_digest = rtmp.find_handshake_digest(c1)
+        assert rtmp.validate_handshake_response2(s2, c1_digest,
+                                                 rtmp._FMS_KEY)
+        # ...and is NOT keyed on anything else
+        assert not rtmp.validate_handshake_response2(s2, b"\0" * 32,
+                                                     rtmp._FMS_KEY)
+
+    def test_server_still_answers_simple_c1_with_echo(self):
+        sock = _FakeSocket()
+        conn = rtmp.RtmpConnection(sock, is_server=True)
+        c1 = struct.pack(">II", 7, 0) + bytes(rtmp.HANDSHAKE_SIZE - 8)
+        assert conn.consume(IOBuf(bytes([rtmp.RTMP_VERSION]) + c1))
+        out = sock.sent[0]
+        assert out[1 + rtmp.HANDSHAKE_SIZE:] == c1    # S2 echoes C1
+
+    def test_digest_client_against_digest_server_end_to_end(self):
+        """Two RtmpConnections wired back to back complete the digest
+        handshake: client validates S2, server's C2 arrives, both sides
+        reach ESTABLISHED's handshake edge."""
+        from brpc_tpu.butil import flags as fl
+        csock, ssock = _FakeSocket(), _FakeSocket()
+        saved = fl.get_flag("rtmp_client_digest")
+        fl.set_flag("rtmp_client_digest", True)
+        try:
+            client = rtmp.RtmpConnection(csock, is_server=False)
+            server = rtmp.RtmpConnection(ssock, is_server=True)
+            client._on_client_established = lambda: None
+            client._start_client_handshake()
+            assert client._c1_digest is not None
+            # server consumes C0+C1, emits S0S1S2
+            assert server.consume(IOBuf(csock.sent[0]))
+            # client consumes S0S1S2, emits digest-mode C2
+            assert client.consume(IOBuf(b"".join(ssock.sent)))
+            assert client.state == rtmp._ESTABLISHED
+            c2 = csock.sent[1]
+            s1 = ssock.sent[0][1:1 + rtmp.HANDSHAKE_SIZE]
+            s1_digest = rtmp.find_handshake_digest(s1, rtmp._FMS_KEY[:36])
+            assert rtmp.validate_handshake_response2(c2, s1_digest,
+                                                     rtmp._FP_KEY)
+            # server consumes C2 → established
+            assert server.consume(IOBuf(c2))
+            assert server.state == rtmp._ESTABLISHED
+        finally:
+            fl.set_flag("rtmp_client_digest", saved)
+
+    def test_corrupt_s2_is_a_protocol_error_for_digest_client(self):
+        from brpc_tpu.butil import flags as fl
+        csock = _FakeSocket()
+        saved = fl.get_flag("rtmp_client_digest")
+        fl.set_flag("rtmp_client_digest", True)
+        try:
+            client = rtmp.RtmpConnection(csock, is_server=False)
+            client._start_client_handshake()
+            c1_digest = client._c1_digest
+            s1 = rtmp.make_digest_block(rtmp._S1_VERSION,
+                                        rtmp._FMS_KEY[:36])
+            s2 = bytearray(rtmp.make_handshake_response2(
+                c1_digest, rtmp._FMS_KEY))
+            s2[-1] ^= 0xFF                          # break the proof
+            ok = client.consume(IOBuf(bytes([rtmp.RTMP_VERSION]) + s1
+                                      + bytes(s2)))
+            assert ok is False                      # protocol error
+        finally:
+            fl.set_flag("rtmp_client_digest", saved)
