@@ -17,7 +17,7 @@ from typing import Callable, Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_chaos_listener"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_quiesce"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -319,6 +319,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_fab_chaos.restype = ctypes.c_int
     lib.brpc_tpu_fab_chaos.argtypes = [ctypes.c_uint64, ctypes.c_int,
                                        ctypes.c_int64]
+    lib.brpc_tpu_fab_quiesce.restype = None
+    lib.brpc_tpu_fab_quiesce.argtypes = []
     lib.brpc_tpu_fab_chaos_listener.restype = ctypes.c_int
     lib.brpc_tpu_fab_chaos_listener.argtypes = [ctypes.c_uint64,
                                                 ctypes.c_int64]

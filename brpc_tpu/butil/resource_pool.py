@@ -81,3 +81,10 @@ class ResourcePool(Generic[T]):
     def size(self) -> int:
         with self._lock:
             return len(self._slots) - len(self._free)
+
+    def live_payloads(self) -> List[T]:
+        """Snapshot of every in-use payload, taken under the pool lock —
+        the supported enumeration (debug pages, drain gates) instead of
+        callers walking ``_slots`` racily against slot recycling."""
+        with self._lock:
+            return [entry[1] for entry in self._slots if entry[2]]

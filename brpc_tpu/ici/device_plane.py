@@ -250,6 +250,7 @@ class DevicePlane:
         self._programs: "collections.OrderedDict" = collections.OrderedDict()
         self._zeros: "collections.OrderedDict" = collections.OrderedDict()
         self._pending: Dict[int, DeviceTransfer] = {}   # posted sends
+        self._active: set = set()      # posted-but-incomplete (drain gate)
         self._next_uuid = 1
         self._recent: collections.deque = collections.deque(maxlen=64)
         # local running totals (the bvar Adders are process-global and
@@ -428,6 +429,7 @@ class DevicePlane:
         if not remote:
             with self._lock:
                 self._pending[t.uuid] = t
+        self._track(t)
         self._recent.append(t)
         self._annotate(t, "posted")
         self._sweep_stale()
@@ -469,6 +471,7 @@ class DevicePlane:
         order = execution order on both sides, the SPMD ordering
         contract)."""
         t = DeviceTransfer(uuid, src_dev, dst_dev, nbytes)
+        self._track(t)
         self._recent.append(t)
         self._annotate(t, "recv enqueued")
         return t
@@ -539,6 +542,7 @@ class DevicePlane:
                 self.bytes_recv += t.nbytes
                 _g_bytes_recv << t.nbytes
             t._release_source()
+            self._untrack(t)
             self._annotate(t, "complete")
             t.completion.signal(0)
 
@@ -553,6 +557,7 @@ class DevicePlane:
         t.state = FAILED
         t.error = reason
         t._release_source()
+        self._untrack(t)
         self._annotate(t, f"failed: {reason}")
         t.completion.signal(1)
 
@@ -561,6 +566,40 @@ class DevicePlane:
         it sat in an executor queue): completion fires with an error and
         the source pin releases."""
         self._fail(t, reason)
+
+    # ---- drain barrier (lame-duck server stop) -------------------------
+    def _track(self, t: DeviceTransfer) -> None:
+        with self._lock:
+            self._active.add(t)
+
+    def _untrack(self, t: DeviceTransfer) -> None:
+        with self._lock:
+            self._active.discard(t)
+
+    def active_transfers(self) -> int:
+        """Posted-but-incomplete transfers — the server drain gate waits
+        for this to reach zero inside the grace window (completion fires,
+        pins release — never a leaked HBM pin)."""
+        with self._lock:
+            return len(self._active)
+
+    def fail_pending(self, reason: str,
+                     posted_before_ns: Optional[int] = None) -> None:
+        """Fail posted sends whose rendezvous never came (lame-duck
+        grace expired): completions fire with an error and the source
+        pins release NOW instead of at the 30s match-timeout sweep.
+        ``posted_before_ns`` scopes the reap to sends already posted at
+        that instant — the plane is process-global, and a transfer some
+        OTHER server/channel posted mid-drain (healthy traffic matches
+        in microseconds) must not be collateral."""
+        stale = []
+        with self._lock:
+            for uuid, t in list(self._pending.items()):
+                if posted_before_ns is None \
+                        or t.posted_ns < posted_before_ns:
+                    stale.append(self._pending.pop(uuid))
+        for t in stale:
+            self._fail(t, reason)
 
     def _sweep_stale(self) -> None:
         """Reap posted sends whose recv never matched (peer died between

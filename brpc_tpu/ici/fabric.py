@@ -161,6 +161,12 @@ _F_BULK_ERR = 11          # claim failed/refused; client backs off, retries
 _F_PING = 12              # u32 target_dev
 _F_PING_OK = 13
 _F_PING_ERR = 14
+# lame-duck announcement (rpc/server.py drain): the sender is draining —
+# the receiver pulls the endpoint from its LBs NOW (no probe-timeout
+# wait), stops issuing new work on this socket (logoff), and hands the
+# endpoint to the health checker for revival after the restart.  Older
+# peers ignore unknown frame types, so GOODBYE is compatible both ways.
+_F_GOODBYE = 15
 
 _HDR = struct.Struct("<BI")          # type, body length
 
@@ -243,7 +249,23 @@ class FabricNode:
             node._start(coordinator_address, num_processes, process_id,
                         host_ip)
             cls._instance = node
+            # deterministic pre-exit shutdown ordering: quiesce every
+            # fabric reader thread (Python control readers AND native
+            # bulk readers) before interpreter/static teardown can race
+            # them — the exit-abort class of flake
+            import atexit
+            atexit.register(cls._atexit_quiesce)
             return node
+
+    @classmethod
+    def _atexit_quiesce(cls) -> None:
+        with cls._lock:
+            node = cls._instance
+        if node is not None:
+            try:
+                node.quiesce()
+            except Exception:
+                pass
 
     def _start(self, coordinator_address, num_processes, process_id,
                host_ip) -> None:
@@ -361,6 +383,32 @@ class FabricNode:
         if self._bulk_listener and self._bulk_lib is not None:
             self._bulk_lib.brpc_tpu_fab_listener_close(self._bulk_listener)
             self._bulk_listener = 0
+
+    def quiesce(self) -> None:
+        """Close the listeners, sever every live fabric socket's control
+        conn and JOIN its reader, then close+join every native bulk
+        conn/listener reader (brpc_tpu_fab_quiesce).  After this returns
+        no fabric thread is running, so exit-time teardown (CPython
+        finalization, C++ static destructors) has nothing to race."""
+        self.shutdown()
+        try:
+            from ..rpc.socket import list_sockets
+            for s in list(list_sockets()):
+                if isinstance(s, FabricSocket):
+                    s.quiesce_reader()
+        except Exception:
+            pass
+        lib = self._bulk_lib
+        if lib is None:
+            try:
+                lib = _bulk_lib()
+            except Exception:
+                lib = None
+        if lib is not None and hasattr(lib, "brpc_tpu_fab_quiesce"):
+            try:
+                lib.brpc_tpu_fab_quiesce()
+            except Exception:
+                pass
 
     # ---- registry ------------------------------------------------------
     def peer_info(self, pid: int, timeout_ms: int = 60000) -> dict:
@@ -618,6 +666,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._consumed_unacked = 0     # credits not yet returned (batched)
         self._peer_closed = False      # reader-visible EOF (ordered)
         self._conn_dead = False        # writer-visible death (immediate)
+        self._fin_code = 0             # peer's close code (FIN body)
         self._init_window(window_bytes)
         self._init_delivery()
         self._staged: Dict[int, Tuple] = {}    # uuid -> (src_block, array)
@@ -943,6 +992,45 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                                         name="fabric_read", daemon=True)
         self._reader.start()
 
+    def quiesce_reader(self, timeout: float = 2.0) -> None:
+        """Deterministic teardown ordering: sever the control conn and
+        JOIN the reader thread, so no fabric thread can race interpreter
+        or C++ static teardown (the exit-abort class of flake).  Called
+        from Server.stop after the socket failed, and from the process
+        atexit quiesce."""
+        try:
+            self._conn.shutdown(_pysocket.SHUT_RDWR)
+        except OSError:
+            pass
+        r = self._reader
+        if r is not None and r.is_alive() \
+                and r is not threading.current_thread():
+            r.join(timeout)
+
+    # ---- lame-duck (GOODBYE) -------------------------------------------
+    def send_goodbye(self) -> None:
+        """Server drain: tell the peer this endpoint is going lame-duck
+        so it pulls it from LBs proactively instead of discovering the
+        drain at the next health-check probe."""
+        if self._peer_gone():
+            return
+        try:
+            self._ctrl_send(_F_GOODBYE, b"")
+        except OSError:
+            pass
+
+    def _on_goodbye(self) -> None:
+        # runs on the control read loop of the RECEIVING side: stop
+        # handing this socket out for new calls (SocketMap replaces
+        # logoff sockets on next use) while in-flight responses and
+        # stream frames keep flowing, and register the peer's drain
+        self.logoff = True
+        try:
+            from ..rpc import lameduck
+            lameduck.notify_peer_draining(self.remote_side)
+        except Exception:
+            pass
+
     def inflight_send_blocks(self) -> int:
         with self._staged_lock:
             return len(self._staged)
@@ -1256,7 +1344,14 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     self._on_bulk_reply(True)
                 elif ftype == _F_BULK_ERR:
                     self._on_bulk_reply(False)
+                elif ftype == _F_GOODBYE:
+                    self._on_goodbye()
                 elif ftype == _F_FIN:
+                    if len(body) >= 4:
+                        # the peer closed with an explicit code (lame-duck
+                        # ELOGOFF): fail in-flight calls with IT, not the
+                        # generic socket-death code
+                        self._fin_code = struct.unpack("<I", body[:4])[0]
                     break
         except OSError:
             pass
@@ -1283,6 +1378,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         def commit_eof():
             with self._inbox_lock:
                 self._peer_closed = True
+            if self._fin_code:
+                # ordered behind every delivered frame: fail in-flight
+                # calls with the peer's explicit close code (lame-duck
+                # ELOGOFF) instead of the generic EOF
+                self.set_failed(self._fin_code,
+                                "peer server logged off (lame duck)")
+                return
             self.start_input_event()
 
         self._enqueue_delivery([], commit_eof)
@@ -1533,7 +1635,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
 
     def _transport_close(self) -> None:
         try:
-            self._ctrl_send(_F_FIN, b"")
+            # FIN carries the closer's error code (empty body = old
+            # peers / clean close): a lame-duck hard stop propagates
+            # ELOGOFF so the peer's in-flight calls fail over without
+            # burning their connection-failure backoff budget
+            body = struct.pack("<I", self.failed_error) \
+                if self.failed_error == errors.ELOGOFF else b""
+            self._ctrl_send(_F_FIN, body)
         except OSError:
             pass
         try:

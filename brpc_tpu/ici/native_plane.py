@@ -410,6 +410,13 @@ class ServerBinding:
     def _process(self, token, full, payload, attachment, log_id, peer_dev):
         from ..rpc.controller import Controller
         server = self._server
+        if server.is_draining():
+            # lame-duck: the native front door stays open through the
+            # grace window so in-flight calls finish, but new ones bounce
+            # with retryable ELOGOFF (mirrors tpu_std.process_request)
+            self._respond_err(token, errors.ELOGOFF,
+                              "server is draining (lame duck)")
+            return
         md = server.find_method(full)
         if md is None:
             self._respond_err(token, errors.ENOMETHOD, f"no method {full}")

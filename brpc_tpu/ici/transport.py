@@ -393,9 +393,36 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
         peer = self.peer
         return peer is None or peer.failed or self._peer_closed
 
+    # ---- lame-duck (GOODBYE) -------------------------------------------
+    def send_goodbye(self) -> None:
+        """Server drain: the in-process flavor of the fabric GOODBYE
+        control frame — notify the peer socket directly (same process,
+        no wire needed)."""
+        peer = self.peer
+        if peer is not None and not peer.failed:
+            peer.on_peer_goodbye()
+
+    def on_peer_goodbye(self) -> None:
+        # the peer endpoint is draining: no new calls ride this socket
+        # (SocketMap replaces logoff sockets on next use) and every live
+        # LB pulls the endpoint now — before any health-check probe
+        self.logoff = True
+        try:
+            from ..rpc import lameduck
+            lameduck.notify_peer_draining(self.remote_side)
+        except Exception:
+            pass
+
     def _transport_close(self) -> None:
         peer = self.peer
         if peer is not None and not peer.failed:
+            if self.failed_error == errors.ELOGOFF:
+                # lame-duck hard stop: the peer's in-flight calls fail
+                # with the retryable server code, applied on the EOF
+                # path AFTER queued responses drain (see mem_transport —
+                # failing immediately would retry already-executed
+                # calls)
+                peer._eof_error_code = errors.ELOGOFF
             with peer._inbox_lock:
                 peer._peer_closed = True
             peer.start_input_event()

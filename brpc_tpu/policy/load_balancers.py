@@ -12,6 +12,7 @@ from __future__ import annotations
 import bisect
 import hashlib
 import threading
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..butil.doubly_buffered import DoublyBufferedData
@@ -53,6 +54,16 @@ class LoadBalancer:
         raise NotImplementedError
 
 
+# Every live LB, weakly held: the lame-duck registry uses this to pull a
+# draining endpoint (GOODBYE) from ALL balancers at once — proactive
+# removal, not per-channel discovery (rpc/lameduck.py).
+_live_lbs: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_load_balancers() -> List["LoadBalancer"]:
+    return list(_live_lbs)
+
+
 class _ListLB(LoadBalancer):
     """Shared base: DoublyBufferedData<list[ServerEntry]>."""
 
@@ -60,6 +71,7 @@ class _ListLB(LoadBalancer):
         self._dbd: DoublyBufferedData[List[ServerEntry]] = DoublyBufferedData(list)
         self._excluded: Dict[EndPoint, float] = {}   # circuit-broken until ts
         self._excl_lock = threading.Lock()
+        _live_lbs.add(self)
 
     def add_server(self, ep, weight=100, tag="") -> bool:
         def doit(lst):

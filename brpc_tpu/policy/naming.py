@@ -133,10 +133,18 @@ class MeshNamingService(NamingService):
 
     def get_servers(self) -> List[ServerEntry]:
         from ..ici.mesh import IciMesh
+        from ..rpc import lameduck
         mesh = IciMesh.default()
-        return [ServerEntry(mesh.endpoint(i), 100,
-                            tag=str(mesh.device(i)))
-                for i in range(mesh.size)]
+        out = []
+        for i in range(mesh.size):
+            ep = mesh.endpoint(i)
+            # lame-duck: a draining member (local server in drain, or a
+            # peer that sent GOODBYE) is pulled from topology-derived
+            # membership until its restart revives it
+            if lameduck.is_draining(ep):
+                continue
+            out.append(ServerEntry(ep, 100, tag=str(mesh.device(i))))
+        return out
 
 
 class ConsulNamingService(NamingService):

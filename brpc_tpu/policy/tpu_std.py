@@ -224,6 +224,16 @@ def process_request(msg: StdMessage, socket, server) -> None:
         if server_counted[0]:
             server.on_request_out()
 
+    if server.is_draining():
+        # lame-duck: a draining server rejects NEW requests with
+        # retryable ELOGOFF so clients fail over to another replica
+        # instantly; work admitted before the drain flipped keeps running
+        # inside the grace window (stream frames never reach here — they
+        # ride process_inline)
+        cntl.set_failed(errors.ELOGOFF, "server is draining (lame duck)")
+        status = None       # don't on_responded a rejected request
+        send_response()
+        return
     if not server.on_request_in():
         cntl.set_failed(errors.ELIMIT, "server max_concurrency reached")
         send_response()

@@ -21,7 +21,10 @@ from ...butil import flags as _flags
 
 
 class BuiltinDispatcher:
-    """path → handler(server, query: dict) -> (content_type, body_str)."""
+    """path → handler(server, query: dict) -> (content_type, body_str),
+    or (http_status, content_type, body_str) for pages whose HTTP status
+    must carry signal (/health while draining → 503: status-code-keyed
+    checkers pull the endpoint too, not just body-readers)."""
 
     def __init__(self, server):
         self.server = server
@@ -73,7 +76,20 @@ class BuiltinDispatcher:
 
 
 def _health(server, q):
+    # lame-duck: a draining server stops reporting healthy BEFORE its
+    # hard stop, so HTTP health checkers and naming watchers pull the
+    # endpoint while in-flight work is still completing.  503 + body:
+    # checkers keyed on the status CODE (k8s readiness, LB HTTP checks)
+    # must see the drain too, not only body-readers.
+    if getattr(server, "is_draining", lambda: False)():
+        return 503, "text/plain", "draining"
     return "text/plain", "OK"
+
+
+def _lifecycle(server) -> str:
+    if getattr(server, "is_draining", lambda: False)():
+        return "draining"
+    return "running" if server.is_running() else "stopped"
 
 
 def _version(server, q):
@@ -86,6 +102,9 @@ def _status(server, q):
     return "application/json", json.dumps({
         "server": str(server.listen_endpoint),
         "name": server.options.server_info_name or "",
+        "state": _lifecycle(server),
+        "inflight_requests": server.inflight_requests()
+        if hasattr(server, "inflight_requests") else 0,
         "uptime_s": round(time.time() - _start_time, 1),
         "services": sorted(server.services()),
         "methods": [ms.describe() for ms in server.method_statuses()],

@@ -391,8 +391,13 @@ class Channel:
                     from .health_check import start_health_check
                     lb = self._lb
                     lb.exclude(sel, breaker.isolated_until())
+                    # revive_key=the LB: repeated trips re-register the
+                    # same (replaced) callback instead of accumulating
+                    # one per trip, while distinct LBs watching the same
+                    # endpoint each keep theirs
                     start_health_check(
-                        sel, on_revived=lambda ep: lb.exclude(ep, 0.0))
+                        sel, on_revived=lambda ep: lb.exclude(ep, 0.0),
+                        revive_key=id(lb))
         elif sel is not None:
             # single-endpoint channels feed the same breaker: repeated
             # failures trip isolation (gating reconnect stampedes in

@@ -286,7 +286,11 @@ class Controller:
             self.retried_count += 1
             bthread_id.reset_version(self._cid, self.current_try)  # stale old tries
             self._schedule_try_timer()
-            delay_s = self._retry_backoff_s()
+            # a lame-duck rejection (ELOGOFF) is the peer explicitly
+            # saying "go elsewhere" — an instant failover, not an outage:
+            # it must not consume the connection-failure backoff budget
+            delay_s = 0.0 if error_code == errors.ELOGOFF \
+                else self._retry_backoff_s()
             if delay_s > 0:
                 # spaced retry: the endpoint may be DOWN rather than
                 # flaky — immediate re-connects would burn the whole
@@ -340,6 +344,13 @@ class Controller:
             err = rmeta.error_code
             self.set_failed(err, rmeta.error_text)
             if self._retryable(err) and self.current_try < self.max_retry:
+                # the retry must land on a DIFFERENT replica: a server
+                # that pushed a retryable error (lame-duck ELOGOFF most
+                # of all) will push it again — the reference's per-call
+                # blacklist applies to server-pushed errors too
+                sel = getattr(self, "_selected_endpoint", None)
+                if sel is not None:
+                    self._excluded_servers.add(sel)
                 self.error_code_ = 0
                 self.error_text_ = ""
                 self.current_try += 1

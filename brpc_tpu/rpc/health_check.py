@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from ..butil.endpoint import EndPoint, SCHEME_MEM, SCHEME_TCP, SCHEME_ICI
 from ..butil import flags as _flags
@@ -70,6 +70,13 @@ class HealthCheckTask:
                  max_probes: int = 0, seed: Optional[int] = None):
         self.ep = ep
         self.on_revived = on_revived
+        # keyed revival callbacks (add_revive_callback): several parties
+        # can care about one endpoint's revival (an LB lifting its
+        # exclusion, the lame-duck registry clearing a peer-drain mark);
+        # keying dedups re-registrations — a channel registers a fresh
+        # lambda per breaker trip, and a long outage must not accumulate
+        # one callback per trip
+        self._revive_cbs: Dict[Any, Callable[[EndPoint], None]] = {}
         self.app_check = app_check          # app-level RPC probe
         self.probe_count = 0
         self.max_probes = max_probes        # 0 = unlimited
@@ -102,9 +109,12 @@ class HealthCheckTask:
         if ok:
             BreakerRegistry.instance().breaker(self.ep).mark_recovered()
             _unregister(self.ep)
+            cbs = list(self._revive_cbs.values())
             if self.on_revived is not None:
+                cbs.insert(0, self.on_revived)
+            for cb in cbs:
                 try:
-                    self.on_revived(self.ep)
+                    cb(self.ep)
                 except Exception:
                     pass
             log.info("endpoint %s revived after %d probes", self.ep,
@@ -126,12 +136,21 @@ _tasks_lock = threading.Lock()
 
 def start_health_check(ep: EndPoint,
                        on_revived: Optional[Callable] = None,
-                       app_check: Optional[Callable] = None) -> HealthCheckTask:
+                       app_check: Optional[Callable] = None,
+                       revive_key: Any = None) -> HealthCheckTask:
+    """Ensure ``ep`` is under probing.  ``on_revived`` registers a
+    revival callback; ``revive_key`` (default: the callback's code
+    object, which dedups per-call-site lambdas) keys it so repeated
+    registrations from one caller REPLACE rather than accumulate."""
     with _tasks_lock:
         t = _tasks.get(ep)
         if t is None:
             t = HealthCheckTask(ep, on_revived, app_check)
             _tasks[ep] = t
+        elif on_revived is not None and t.on_revived is not on_revived:
+            key = revive_key if revive_key is not None \
+                else getattr(on_revived, "__code__", on_revived)
+            t._revive_cbs[key] = on_revived
         return t
 
 

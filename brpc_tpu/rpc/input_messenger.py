@@ -69,7 +69,12 @@ class InputMessenger:
             if last is not None:
                 self._queue_message(*last, socket)
                 last = None
-            socket.set_failed(errors.EEOF, "remote closed")
+            # a peer that closed with an explicit code (lame-duck ELOGOFF
+            # via the in-process transports) surfaces it here — AFTER the
+            # queued responses above were drained, so an already-executed
+            # call is completed, never retried elsewhere
+            code = getattr(socket, "_eof_error_code", 0) or errors.EEOF
+            socket.set_failed(code, "remote closed")
         return last
 
     def process_in_place(self, last, socket) -> None:
@@ -125,13 +130,23 @@ class InputMessenger:
         pool = getattr(self.server, "usercode_pool", None) \
             if self.server is not None else None
         if pool is not None and proto.process_request is not None:
+            # counted from submission: a request QUEUED behind a busy
+            # pool has not reached on_request_in yet, and the lame-duck
+            # drain gate must still wait for it
+            self.server.on_usercode_queued()
             try:
-                pool.submit(self._process_message_inline, proto, msg,
-                            socket)
+                pool.submit(self._run_usercode, proto, msg, socket)
                 return
             except RuntimeError:
+                self.server.on_usercode_done()
                 pass                 # pool shut down mid-stop: run here
         self._process_message_inline(proto, msg, socket)
+
+    def _run_usercode(self, proto: Protocol, msg: Any, socket) -> None:
+        try:
+            self._process_message_inline(proto, msg, socket)
+        finally:
+            self.server.on_usercode_done()
 
     def _process_message_inline(self, proto: Protocol, msg: Any,
                                 socket) -> None:

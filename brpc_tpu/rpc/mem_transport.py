@@ -55,6 +55,15 @@ class MemSocket(Socket):
     def _transport_close(self) -> None:
         peer = self.peer
         if peer is not None and not peer.failed:
+            if self.failed_error == errors.ELOGOFF:
+                # lame-duck hard stop: the peer's in-flight calls fail
+                # with the SERVER'S code (retryable ELOGOFF skips the
+                # client's connection-failure backoff) — but only AFTER
+                # the peer drained responses already in its inbox, or a
+                # completed non-idempotent call would be retried
+                # elsewhere (duplicate execution).  The EOF path applies
+                # the code (input_messenger).
+                peer._eof_error_code = errors.ELOGOFF
             with peer._inbox_lock:
                 peer._peer_closed = True
             peer.start_input_event()    # let it observe EOF

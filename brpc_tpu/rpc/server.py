@@ -10,6 +10,7 @@ service set.
 from __future__ import annotations
 
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -70,6 +71,19 @@ class ServerOptions:
     # IciListener stays registered either way — it serves fabric peers and
     # non-tpu_std protocols.  Disable to force the pure-Python plane.
     native_ici: bool = True
+    # Lame-duck drain window applied by stop() when no explicit grace is
+    # passed (reference Server::Stop(closewait_ms)): listeners close and
+    # the server flips to draining — /health reports it, GOODBYE goes out
+    # on fabric/ici sockets, new requests bounce with retryable ELOGOFF —
+    # then in-flight handlers, open streams, queued usercode, and posted
+    # device-plane transfers get this many seconds to complete before
+    # stragglers are failed.  0 = the historical immediate stop.
+    graceful_shutdown_s: float = 0.0
+    # Install a process-wide SIGTERM hook that drains this server with
+    # graceful_shutdown_s before the process exits (reference
+    # -graceful_quit_on_sigterm): a deploy's TERM becomes invisible to
+    # callers.  A second TERM during the drain kills immediately.
+    graceful_quit_on_sigterm: bool = False
 
 
 class Server:
@@ -84,8 +98,14 @@ class Server:
         self._acceptor = None
         self.messenger = InputMessenger(server=self)
         self._server_concurrency = 0
+        self._usercode_queued = 0        # queued/running backup-pool work
         self._conc_lock = threading.Lock()
         self._stopped = threading.Event()
+        self._draining = False
+        self._stop_lock = threading.Lock()
+        self._stop_in_progress = False
+        self._stopping_thread: Optional[threading.Thread] = None
+        self._reaper_thread: Optional[threading.Thread] = None
         self.version = ""
         self._connections: List[Any] = []
         self._conn_lock = threading.Lock()
@@ -185,6 +205,32 @@ class Server:
         with self._conc_lock:
             self._server_concurrency -= 1
 
+    # usercode_in_pthread backlog accounting (InputMessenger): a request
+    # QUEUED on the backup pool has not yet passed on_request_in, so the
+    # drain gate needs its own counter to see it
+    def on_usercode_queued(self) -> None:
+        with self._conc_lock:
+            self._usercode_queued += 1
+
+    def on_usercode_done(self) -> None:
+        with self._conc_lock:
+            self._usercode_queued -= 1
+
+    def inflight_requests(self) -> int:
+        """Requests currently admitted or queued — the drain/join gate
+        and the /status count.  One request can appear in several
+        counters (tpu_std increments both the server and its method's
+        concurrency; a pooled request is also in the usercode backlog
+        while running), so the counters are combined with max(): still
+        zero exactly when everything finished, without double-counting a
+        single request as 2-3 on /status."""
+        with self._conc_lock:
+            server_n = self._server_concurrency
+            queued_n = self._usercode_queued
+        method_n = sum(ms.concurrency
+                       for ms in self._method_status.values())
+        return max(server_n, method_n, queued_n)
+
     # ---- per-RPC / per-thread user data (server.h:126-150) ------------
     def _get_session_data(self) -> Any:
         if self.options.session_local_data_factory is None:
@@ -218,7 +264,13 @@ class Server:
             self.options = options
         if self._started:
             return errors.EINVAL
-        self._stopped.clear()           # restartable after stop():
+        # restartable after stop(): a FRESH event per run is the idle
+        # reaper's generation guard — the old reaper holds the prior
+        # run's (set) event and exits, instead of surviving a fast
+        # stop()->start() cycle that cleared the shared flag before it
+        # woke (which left two reapers running)
+        self._stopped = threading.Event()
+        self._draining = False
         self._listen_endpoints = []     # fresh run, fresh addresses
         with self._conn_lock:
             self._connections = []
@@ -294,6 +346,16 @@ class Server:
             raise
         self._listen_endpoints.append(ep)
         self._started = True
+        from . import lameduck
+        lameduck.clear_local_draining(ep)   # restart lifts the drain mark
+        if self.options.graceful_quit_on_sigterm:
+            if not lameduck.enable_graceful_quit(self):
+                # the hook only installs from the main thread — the
+                # operator must know deploys will NOT drain
+                log.warning(
+                    "graceful_quit_on_sigterm requested but the SIGTERM "
+                    "hook could not be installed (server started off "
+                    "the main thread): TERM will not drain this server")
         log.info("Server started on %s with %d services", ep,
                  len(self._services))
         # version ping, off unless the trackme_server flag is set
@@ -318,11 +380,15 @@ class Server:
         return getattr(self, "_internal_port", -1)
 
     def _start_idle_reaper(self) -> None:
-        import time as _time
+        # bind THIS run's stop event: the reaper's generation guard (see
+        # start() — each run gets a fresh event, so a reaper from a
+        # previous run observes its own set event and exits even when a
+        # new run is already up)
+        stopped = self._stopped
 
         def reap() -> None:
             period = max(0.5, self.options.idle_timeout_s / 2.0)
-            while not self._stopped.wait(period):
+            while not stopped.wait(period):
                 cutoff = _time.monotonic() - self.options.idle_timeout_s
                 with self._conn_lock:
                     conns = list(self._connections)
@@ -332,6 +398,7 @@ class Server:
                                      f"idle > {self.options.idle_timeout_s}s")
 
         t = threading.Thread(target=reap, name="idle_reaper", daemon=True)
+        self._reaper_thread = t
         t.start()
 
     @property
@@ -346,7 +413,12 @@ class Server:
     def is_running(self) -> bool:
         return self._started and not self._stopped.is_set()
 
-    def _teardown_listeners(self) -> None:
+    def is_draining(self) -> bool:
+        """Lame-duck state: listeners are closed and new requests bounce
+        with retryable ELOGOFF while in-flight work completes."""
+        return self._draining
+
+    def _teardown_listeners(self, keep_native: bool = False) -> None:
         if self._mem_listener is not None:
             from .mem_transport import mem_unlisten
             mem_unlisten(self._mem_listener.name)
@@ -361,16 +433,95 @@ class Server:
             from ..ici.transport import ici_unlisten
             ici_unlisten(self._ici_listener.device_id)
             self._ici_listener = None
-        if getattr(self, "_native_ici", None) is not None:
+        if not keep_native and getattr(self, "_native_ici", None) is not None:
+            # during a lame-duck drain the native front door stays up so
+            # in-flight native calls complete (new ones bounce ELOGOFF in
+            # ServerBinding._process); phase-2 teardown closes it
             self._native_ici.stop()
             self._native_ici = None
 
-    def stop(self) -> int:
-        if not self._started:
+    def stop(self, grace_s: Optional[float] = None) -> int:
+        """Stop the server.  ``grace_s > 0`` (default: ``ServerOptions.
+        graceful_shutdown_s``) drains first — lame-duck mode (reference
+        Server::Stop(closewait_ms)):
+
+          1. listeners close and the server flips to *draining*: /health
+             reports it, the mesh:// naming source drops the endpoint,
+             fabric/ici sockets send GOODBYE so peers pull the endpoint
+             from their LBs proactively, and NEW requests on still-open
+             connections bounce with retryable ELOGOFF;
+          2. in-flight handlers, queued usercode, open streams, and
+             posted device-plane transfers complete inside the grace
+             window (pins release at completion — never leaked);
+          3. only stragglers past the window are failed: streams get a
+             flush + orderly CLOSE instead of a RST, connections fail
+             with ELOGOFF, and unmatched device-plane sends are failed
+             so their pins release.
+        """
+        if grace_s is None:
+            grace_s = self.options.graceful_shutdown_s or 0.0
+        with self._stop_lock:
+            if not self._started:
+                return 0
+            if self._stop_in_progress:
+                # another thread is mid-drain: WAIT for it rather than
+                # return success on a server that is still half-up (the
+                # caller would rebind the port / exit the process under
+                # the live drain).  Reentrancy (stop from a thread the
+                # drain itself runs) just returns.
+                stopping, stopped = self._stopping_thread, self._stopped
+            else:
+                self._stop_in_progress = True
+                self._stopping_thread = threading.current_thread()
+                stopping = None
+        if stopping is not None:
+            if stopping is not threading.current_thread():
+                stopped.wait()
             return 0
+        try:
+            self._stop_locked(grace_s)
+        finally:
+            with self._stop_lock:
+                self._stop_in_progress = False
+                self._stopping_thread = None
+            if not self._stopped.is_set():
+                # _stop_locked raised midway: the error propagates to
+                # THIS caller, but concurrent stop() callers parked on
+                # the event and join() must still unblock — a failed
+                # stop may leave debris, never a wedged process
+                self._draining = False
+                self._started = False
+                self._stopped.set()
+        return 0
+
+    def _stop_locked(self, grace_s: float) -> None:
+        from . import lameduck
+        drained = True
+        if grace_s > 0:
+            # the local drain mark lives ONLY for the drain window: it
+            # pulls the endpoint from mesh:// membership while in-flight
+            # work completes.  Once the server is fully stopped, liveness
+            # is the health checker's concern again (and the GOODBYE
+            # peer-side mark persists until revival) — a lasting local
+            # mark would make topology-derived membership lie forever
+            # about an endpoint nothing is draining.
+            self._draining = True
+            drain_start_ns = _time.monotonic_ns()
+            for ep in self._listen_endpoints:
+                lameduck.mark_local_draining(ep)
+            self._teardown_listeners(keep_native=True)
+            self._send_goodbyes()
+            drained = self._drain_until(_time.monotonic() + grace_s)
         self._teardown_listeners()
         with self._conn_lock:
             conns = list(self._connections)
+        if grace_s > 0:
+            # stragglers past the window: an orderly CLOSE (flushed on
+            # the still-live connection) instead of the RST the socket
+            # failure below would imply
+            self._close_server_streams(conns)
+            if not drained:
+                self._fail_pending_device_transfers(drain_start_ns)
         for s in conns:
             # graceful h2 shutdown: GOAWAY first so the peer knows which
             # streams were processed and retries the rest safely
@@ -381,15 +532,122 @@ class Server:
                 except Exception:
                     pass
             s.set_failed(errors.ELOGOFF, "server stopping")
+        # deterministic shutdown ordering: fabric reader threads are
+        # quiesced here, not left to race interpreter/static teardown
+        for s in conns:
+            q = getattr(s, "quiesce_reader", None)
+            if q is not None:
+                try:
+                    q(0.5)
+                except Exception:
+                    pass
         pool, self.usercode_pool = self.usercode_pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+        reaper, self._reaper_thread = self._reaper_thread, None
         self._stopped.set()
+        if reaper is not None and reaper is not threading.current_thread():
+            reaper.join(1.0)         # woken by the event: prompt exit
         self._started = False
-        return 0
+        self._draining = False
+        for ep in self._listen_endpoints:
+            lameduck.clear_local_draining(ep)
+
+    # ---- drain machinery ----------------------------------------------
+    def _send_goodbyes(self) -> None:
+        """Proactive lame-duck notification on every connection whose
+        transport supports it (fabric control frame / in-process ici):
+        peers pull this endpoint from their LBs NOW instead of at the
+        next health-check probe."""
+        with self._conn_lock:
+            conns = list(self._connections)
+        for s in conns:
+            fn = getattr(s, "send_goodbye", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:
+                    pass
+
+    def _drain_until(self, deadline: float) -> bool:
+        """Block until in-flight handlers, queued usercode, open streams,
+        and posted device-plane transfers are all done, or the deadline
+        passes.  Returns True when fully drained."""
+        while True:
+            if (self.inflight_requests() == 0
+                    and not self._open_server_streams()
+                    and self._device_plane_active() == 0):
+                return True
+            if _time.monotonic() >= deadline:
+                return False
+            _time.sleep(0.005)
+
+    def _open_server_streams(self) -> List[Any]:
+        try:
+            from .stream import live_streams
+        except Exception:
+            return []
+        with self._conn_lock:
+            conns = {id(s) for s in self._connections if not s.failed}
+        return [st for st in live_streams()
+                if not st.closed and st.socket is not None
+                and id(st.socket) in conns]
+
+    def _close_server_streams(self, conns: List[Any]) -> None:
+        conn_ids = {id(s) for s in conns if not s.failed}
+        try:
+            from .stream import live_streams
+        except Exception:
+            return
+        for st in live_streams():
+            if not st.closed and st.socket is not None \
+                    and id(st.socket) in conn_ids:
+                try:
+                    st.close()
+                except Exception:
+                    pass
+
+    @staticmethod
+    def _device_plane_active() -> int:
+        """Posted-but-incomplete device-plane transfers in this process;
+        0 when the plane was never instantiated (no import side effects
+        for pure-TCP servers)."""
+        try:
+            from ..ici.device_plane import DevicePlane
+        except Exception:
+            return 0
+        plane = DevicePlane._instance
+        return plane.active_transfers() if plane is not None else 0
+
+    @staticmethod
+    def _fail_pending_device_transfers(posted_before_ns: int) -> None:
+        """Grace expired with transfers still posted: fail the ones that
+        were already posted when the drain began (and so sat unmatched
+        through the whole window) so completions fire and source pins
+        release — a lame-duck stop may strand a straggler RPC, never an
+        HBM pin.  Newer posts belong to other live traffic in this
+        process and are left to their own lifecycle."""
+        try:
+            from ..ici.device_plane import DevicePlane
+        except Exception:
+            return
+        plane = DevicePlane._instance
+        if plane is not None:
+            plane.fail_pending("server stopped before rendezvous "
+                               "(lame-duck grace expired)",
+                               posted_before_ns=posted_before_ns)
 
     def join(self, timeout: Optional[float] = None) -> None:
-        self._stopped.wait(timeout)
+        """Block until the server has stopped AND its in-flight handlers
+        have finished — not just until the stop flag flipped (reference
+        Server::Join runs after Stop's close-wait)."""
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        if not self._stopped.wait(timeout):
+            return
+        while self.inflight_requests() > 0:
+            if deadline is not None and _time.monotonic() >= deadline:
+                return
+            _time.sleep(0.002)
 
     def connections(self) -> List[Any]:
         with self._conn_lock:

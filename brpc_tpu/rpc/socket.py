@@ -106,6 +106,10 @@ class Socket:
         self._inflight_prune_at = 256    # high-water mark (see write())
         self.health_check_interval_s = 0
         self.is_server_side = False
+        # set by in-process transports when the peer closed with an
+        # explicit code (lame-duck ELOGOFF): the EOF path fails the
+        # socket with it instead of the generic EEOF
+        self._eof_error_code = 0
         _g_socket_count << 1
 
     # ---- id management ----------------------------------------------
@@ -349,9 +353,5 @@ class Socket:
 
 def list_sockets() -> List[Socket]:
     """Debug enumeration for the /sockets builtin service."""
-    out = []
-    for slot in range(len(_socket_pool._slots)):
-        entry = _socket_pool._slots[slot]
-        if entry[2] and isinstance(entry[1], Socket):
-            out.append(entry[1])
-    return out
+    return [s for s in _socket_pool.live_payloads()
+            if isinstance(s, Socket)]

@@ -410,6 +410,12 @@ def find_stream(sid: int) -> Optional[Stream]:
     return _streams.address(sid)
 
 
+def live_streams() -> List[Stream]:
+    """Every registered (not yet closed-and-removed) stream — the server
+    drain gate filters these down to the ones riding its connections."""
+    return [s for s in _streams.live_payloads() if isinstance(s, Stream)]
+
+
 def on_stream_frame(meta, body: IOBuf, socket) -> None:
     """Entry from tpu_std for frames carrying stream_settings.  Runs in
     the socket's reader-order consumption path (process_inline), so

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import importlib
 import json
+import signal
 import sys
 import threading
 import time
@@ -49,10 +50,22 @@ def run_press(server: str, method: str, request_json: str,
     lock = threading.Lock()
     deadline = time.monotonic() + duration
     interval = concurrency / qps if qps > 0 else 0.0
+    # graceful SIGINT (reference tools/rpc_press): ^C stops ISSUING, the
+    # in-flight calls run to completion, and the final latency/QPS
+    # summary still prints — instead of a KeyboardInterrupt mid-run that
+    # loses the whole measurement.  Installable only from the main
+    # thread; elsewhere the default (hard) behavior is kept.
+    stop_evt = threading.Event()
+    prev_sigint = None
+    try:
+        prev_sigint = signal.signal(signal.SIGINT,
+                                    lambda *_: stop_evt.set())
+    except ValueError:
+        pass
 
     def worker():
         next_fire = time.monotonic()
-        while time.monotonic() < deadline:
+        while not stop_evt.is_set() and time.monotonic() < deadline:
             if interval:
                 now = time.monotonic()
                 if now < next_fire:
@@ -73,8 +86,13 @@ def run_press(server: str, method: str, request_json: str,
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
     t_start = time.monotonic()
     for t in threads: t.start()
-    for t in threads: t.join()
+    for t in threads: t.join()      # interrupted workers drain in-flight
     elapsed = time.monotonic() - t_start
+    if prev_sigint is not None:
+        try:
+            signal.signal(signal.SIGINT, prev_sigint)
+        except ValueError:
+            pass
     from brpc_tpu.bvar import SamplerCollector
     SamplerCollector.instance().sample_once()
     result = {
@@ -85,6 +103,7 @@ def run_press(server: str, method: str, request_json: str,
         "max_latency_us": recorder.max_latency(),
         "p99_latency_us": recorder.latency_percentile(0.99),
         "elapsed_s": round(elapsed, 2),
+        "interrupted": stop_evt.is_set(),
     }
     print(json.dumps(result), file=out)
     return result

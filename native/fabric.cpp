@@ -793,4 +793,24 @@ void brpc_tpu_fab_listener_close(uint64_t lh) {
   l->stop();
 }
 
+// Deterministic pre-exit quiesce: close and JOIN every live bulk conn
+// and listener (acceptors first, so no fresh conn can appear behind the
+// snapshot).  The leaked registries keep static teardown race-free by
+// never destructing; THIS is the ordered shutdown path — after it
+// returns, no nfab thread is running, so interpreter exit cannot race
+// one.  Called from Python's fabric atexit hook.
+void brpc_tpu_fab_quiesce() {
+  std::vector<std::shared_ptr<nfab::Listener>> listeners;
+  std::vector<std::shared_ptr<nfab::BulkConn>> conns;
+  {
+    std::lock_guard<std::mutex> g(nfab::g_mu);
+    for (auto& kv : nfab::g_listeners) listeners.push_back(kv.second);
+    nfab::g_listeners.clear();
+    for (auto& kv : nfab::g_conns) conns.push_back(kv.second);
+    nfab::g_conns.clear();
+  }
+  for (auto& l : listeners) l->stop();
+  for (auto& c : conns) c->close_join();
+}
+
 }  // extern "C"

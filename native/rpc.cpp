@@ -644,7 +644,12 @@ struct PendingReply {
 };
 
 static std::mutex g_tokens_mu;
-static nbase::FlatMap64<PendingReply> g_tokens;
+// Heap-allocated and intentionally never freed (same discipline as
+// fabric.cpp's conn registries): a static destructor would destroy this
+// map — and the objects it pins — while server/channel reader threads
+// another exiting thread left running may still be mid-access, which is
+// the std::terminate-at-exit flake.  The OS reclaims everything.
+static auto& g_tokens = *new nbase::FlatMap64<PendingReply>();
 static std::atomic<uint64_t> g_next_token{1};
 
 void NativeServer::stop() {
@@ -1678,12 +1683,20 @@ struct IciPending {
 };
 
 static std::mutex g_ici_mu;
-static std::unordered_map<int32_t, IciServerPtr> g_ici_listeners;
-static std::unordered_map<uint64_t, IciServerPtr> g_ici_servers;  // by handle
-static std::unordered_map<uint64_t, std::pair<IciChannelPtr, IciConnPtr>>
-    g_ici_channels;
+// Leaked on purpose: these registries own IciServer/IciChannel objects
+// whose destructors join (or abort on) live dispatcher threads — running
+// them from static teardown races whatever threads exit() left alive
+// (the abort-at-exit flake in the cross-process streaming test).  See
+// fabric.cpp's g_conns note; brpc_tpu_fab_quiesce / Python's atexit
+// quiesce provide the DETERMINISTIC shutdown path instead.
+static auto& g_ici_listeners =
+    *new std::unordered_map<int32_t, IciServerPtr>();
+static auto& g_ici_servers =
+    *new std::unordered_map<uint64_t, IciServerPtr>();  // by handle
+static auto& g_ici_channels =
+    *new std::unordered_map<uint64_t, std::pair<IciChannelPtr, IciConnPtr>>();
 static std::mutex g_ici_tokens_mu;
-static nbase::FlatMap64<IciPending> g_ici_tokens;
+static auto& g_ici_tokens = *new nbase::FlatMap64<IciPending>();
 static std::atomic<uint64_t> g_ici_next_token{1};
 
 uint64_t IciServer::register_token(const IciConnPtr& conn, uint64_t cid) {
@@ -1823,9 +1836,15 @@ static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
 // ====================================================================
 
 static std::mutex g_handles_mu;
-static std::unordered_map<uint64_t, std::shared_ptr<NativeServer>> g_servers;
-static std::unordered_map<uint64_t, std::shared_ptr<NativeChannel>> g_channels;
-static std::unordered_map<uint64_t, std::shared_ptr<NativePool>> g_pools;
+// Leaked on purpose — see the g_ici_listeners note above: destructing
+// NativeServer/NativeChannel from static teardown joins epoll/reader
+// threads concurrently with process exit, the abort-at-exit flake.
+static auto& g_servers =
+    *new std::unordered_map<uint64_t, std::shared_ptr<NativeServer>>();
+static auto& g_channels =
+    *new std::unordered_map<uint64_t, std::shared_ptr<NativeChannel>>();
+static auto& g_pools =
+    *new std::unordered_map<uint64_t, std::shared_ptr<NativePool>>();
 static std::atomic<uint64_t> g_next_handle{1};
 
 static std::shared_ptr<NativeServer> find_server(uint64_t h) {

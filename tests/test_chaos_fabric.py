@@ -1032,3 +1032,215 @@ def test_chaos_device_plane_refusal_degrades_to_bulk_socket_survives():
     outs = _run_pair(_DEVICE_PLANE_DEGRADE % {"repo": REPO}, timeout=240)
     assert "DP0_OK" in outs[0]
     assert "DP1_OK" in outs[1]
+
+
+# Lame-duck drain under load (the zero-downtime-restart contract):
+# continuous LB traffic over TWO servers while one drains and restarts —
+# ZERO client-visible failures.  During the drain window an in-flight
+# >=64KB stream completes over the bulk plane (asserted on the bulk byte
+# counter) and a posted device-plane transfer completes (pin released,
+# asserted on plane counters); GOODBYE pulls the endpoint from the
+# client's LB proactively; the restarted server is revived by the PR-2
+# health checker and serves again.  The post-grace device-plane
+# straggler leg asserts an unmatched posted send is FAILED at stop so
+# its pin releases (client-visible post-grace ELOGOFF is covered in
+# tier-1 test_server_lifecycle).
+_DRAIN_UNDER_LOAD = _CHILD_PRELUDE + r"""
+import jax.numpy as jnp
+import numpy as np
+from brpc_tpu.rpc import lameduck
+from brpc_tpu.ici import device_plane as dp
+
+CHUNK = 128 * 1024
+NFRAMES = 12
+
+def frame_for(seq):
+    return b"%%08d" %% seq + bytes([(seq * 13 + 7) %% 251]) * (CHUNK - 8)
+
+if pid == 0:
+    # ---- two servers, one to be drained under load ----
+    def make_server(tag, dev, with_stream=False, state=None):
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                response.message = tag + ":" + request.message
+                done()
+        s = rpc.Server()
+        s.add_service(Echo())
+        if with_stream:
+            class Sink:
+                def on_received_messages(self, sid, msgs):
+                    for m in msgs:
+                        if m.to_bytes() != frame_for(state["next"]):
+                            state["bad"].append(state["next"])
+                        state["next"] += 1
+                def on_closed(self, sid):
+                    state["closed"].set()
+            class StreamSvc(rpc.Service):
+                @rpc.method(EchoRequest, EchoResponse)
+                def Start(self, cntl, request, response, done):
+                    rpc.stream_accept(cntl,
+                                      rpc.StreamOptions(handler=Sink()))
+                    response.message = "ok"
+                    done()
+            s.add_service(StreamSvc())
+        assert s.start("ici://%%d" %% dev) == 0
+        return s
+
+    state = {"next": 0, "bad": [], "closed": threading.Event()}
+    server_a = make_server("a", 0, with_stream=True, state=state)
+    server_b = make_server("b", 1)
+    kv.key_value_set("dl_srv_up", "1")
+    kv.blocking_key_value_get("dl_traffic_on", 60000)
+
+    # posted device-plane transfer whose rendezvous lands INSIDE the
+    # grace window: the drain gate must hold the stop for it
+    plane = dp.DevicePlane.instance()
+    arr = jax.device_put(jnp.zeros(256 * 1024, jnp.uint8), mesh.device(0))
+    jax.block_until_ready(arr)
+    released = []
+    t = plane.post_send(arr, 0, 1)
+    t.add_source_release(lambda: released.append(1))
+    threading.Timer(0.6, lambda: plane.post_recv(t.uuid)).start()
+
+    t0 = time.monotonic()
+    server_a.stop(15.0)                      # lame-duck drain
+    dt = time.monotonic() - t0
+    # in-window completions: the device transfer (pin released) and the
+    # client's stream (all frames byte-exact, orderly close)
+    assert t.state == dp.COMPLETE, t.state
+    assert released == [1], "pin must release at completion"
+    assert plane.active_transfers() == 0 and plane.pending_sends() == 0
+    assert state["closed"].wait(5), "stream never closed"
+    assert state["next"] == NFRAMES, state["next"]
+    assert not state["bad"], state["bad"][:5]
+    assert dt < 12.0, ("drain should converge well before grace", dt)
+    kv.key_value_set("dl_drained", "1")
+
+    # post-grace straggler: a posted send with no recv is FAILED at stop
+    # so its HBM pin releases (never leaked).  A throwaway mem:// server
+    # drives the stop — the drain gate is process-global — so the
+    # client's health checker can't glimpse a transient ici listener.
+    released2 = []
+    t2 = plane.post_send(arr, 0, 1)
+    t2.add_source_release(lambda: released2.append(1))
+    straggle = rpc.Server()
+    assert straggle.start("mem://dl-straggle") == 0
+    straggle.stop(0.3)
+    assert t2.state == dp.FAILED, t2.state
+    assert released2 == [1], "grace expiry must release the pin"
+    assert plane.pending_sends() == 0
+
+    time.sleep(0.5)
+    server_a2 = make_server("a2", 0)         # the zero-downtime restart
+    kv.key_value_set("dl_restarted", "1")
+    kv.wait_at_barrier("dl_done", 180000)
+    # the revived endpoint actually served traffic again
+    ms = list(server_a2._method_status.values())
+    assert any(m.latency_rec.count() > 0 for m in ms), \
+        "restarted server saw no traffic"
+    server_a2.stop()
+    server_b.stop()
+    print("DL0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("dl_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("list://ici://0,ici://1", "rr",
+            options=rpc.ChannelOptions(timeout_ms=10000, max_retry=3))
+
+    failures = []
+    seen = set()
+    stop_traffic = threading.Event()
+
+    def fire(i):
+        cntl = rpc.Controller()
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message=str(i)), EchoResponse)
+        if cntl.failed():
+            failures.append((cntl.error_code_, cntl.error_text_))
+        else:
+            seen.add(resp.message.split(":")[0])
+
+    def traffic():
+        i = 0
+        while not stop_traffic.is_set():
+            fire(i)
+            i += 1
+            time.sleep(0.01)
+
+    # warm up: both servers answering through the LB
+    for i in range(12):
+        fire(i)
+    assert not failures, failures
+    assert seen == {"a", "b"}, seen
+
+    # in-flight stream to the server that will drain
+    sch = rpc.Channel()
+    sch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                   max_retry=0))
+    cntl = rpc.Controller()
+    stream = rpc.stream_create(cntl,
+                               rpc.StreamOptions(max_buf_size=8 << 20))
+    resp = sch.call_method("StreamSvc.Start", cntl,
+                           EchoRequest(message="s"), EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(10)
+    socks = [s for s in fabric_socks() if s.remote_dev == 0]
+    assert socks and socks[0]._bulk, "no bulk plane to the drain target"
+    s0 = socks[0]
+
+    threads = [threading.Thread(target=traffic) for _ in range(2)]
+    for th in threads:
+        th.start()
+
+    def stream_writer():
+        for seq in range(NFRAMES):
+            assert stream.write(IOBuf(frame_for(seq)), timeout=30) == 0
+            time.sleep(0.25)        # spans the whole drain window
+        stream.close()
+
+    sw = threading.Thread(target=stream_writer)
+    sw.start()
+    time.sleep(0.3)                  # frames flowing before the drain
+    kv.key_value_set("dl_traffic_on", "1")
+
+    # GOODBYE lands: the endpoint is pulled from the LB proactively
+    ep0 = mesh.endpoint(0)
+    deadline = time.time() + 20
+    while not lameduck.is_draining(ep0) and time.time() < deadline:
+        time.sleep(0.02)
+    assert lameduck.is_draining(ep0), "GOODBYE never registered"
+
+    sw.join(60)
+    assert not sw.is_alive(), "stream writer wedged"
+    # the >=64KB frames rode the bulk plane while the server drained
+    assert s0.bulk_bytes_sent >= NFRAMES * CHUNK, (
+        s0.bulk_bytes_sent, NFRAMES * CHUNK)
+
+    kv.blocking_key_value_get("dl_drained", 60000)
+    kv.blocking_key_value_get("dl_restarted", 60000)
+    # revival: the health checker probes the restarted endpoint, clears
+    # the drain mark, and the LB serves it again
+    deadline = time.time() + 30
+    seen.clear()
+    while "a2" not in seen and time.time() < deadline:
+        time.sleep(0.05)
+    stop_traffic.set()
+    for th in threads:
+        th.join(30)
+    assert "a2" in seen, ("drained endpoint never revived into the LB",
+                          seen)
+    assert not lameduck.is_draining(ep0)
+    # THE contract: a drain + restart under continuous load was
+    # invisible — zero client-visible failures
+    assert not failures, failures[:5]
+    kv.wait_at_barrier("dl_done", 180000)
+    print("DL1_OK", flush=True)
+"""
+
+
+def test_chaos_drain_under_load_zero_client_failures():
+    outs = _run_pair(_DRAIN_UNDER_LOAD % {"repo": REPO}, timeout=300)
+    assert "DL0_OK" in outs[0]
+    assert "DL1_OK" in outs[1]

@@ -315,6 +315,7 @@ class TestFabricUnits:
         sock._inbox_lock = _threading.Lock()
         sock._peer_closed = False
         sock._conn_dead = False
+        sock._fin_code = 0
         sock._staged = {}
         sock._staged_lock = _threading.Lock()
         sock._bulk = 0

@@ -151,6 +151,30 @@ class TestCurlH2Interop:
         finally:
             server.stop()
 
+    @pytest.mark.skipif(shutil.which("curl") is None, reason="no curl")
+    def test_curl_chunked_body_round_trip_both_directions(self):
+        """curl sends the REQUEST body chunked (nghttp2-independent
+        HTTP/1.1 path) and our server answers chunked (the echo rule);
+        curl's decoder reassembles it — one exchange proves parse AND
+        emit against a foreign implementation.  `Expect:` is cleared so
+        curl doesn't stall a second waiting for a 100-continue."""
+        server, addr = _start_our_server()
+        try:
+            proc = subprocess.run(
+                ["curl", "-sS", "-D", "-", "--http1.1",
+                 "-H", "Content-Type: application/json",
+                 "-H", "Transfer-Encoding: chunked",
+                 "-H", "Expect:",
+                 "--data-binary", json.dumps({"message": "chunky"}),
+                 f"http://{addr}/test.EchoService/Echo"],
+                capture_output=True, timeout=30)
+            assert proc.returncode == 0, proc.stderr
+            head, _, body = proc.stdout.partition(b"\r\n\r\n")
+            assert b"transfer-encoding: chunked" in head.lower(), head
+            assert json.loads(body)["message"] == "ours:chunky"
+        finally:
+            server.stop()
+
 
 def _frames(data: bytes, off: int = 0):
     out = []

@@ -187,3 +187,133 @@ class TestHttpClient:
             assert cntl.failed()
         finally:
             server.stop()
+
+
+class TestChunkedTransferEncoding:
+    """RFC 7230 §4.1 chunked coding, parse + emit (the last VERDICT
+    Content-Length-only gap).  A chunked request is answered chunked (the
+    echo rule), so one round trip exercises both directions."""
+
+    @staticmethod
+    def _chunk(body: bytes, sizes, trailer: bytes = b"") -> bytes:
+        out, off = [], 0
+        for n in sizes:
+            piece = body[off:off + n]
+            out.append(b"%x\r\n%s\r\n" % (len(piece), piece))
+            off += n
+        assert off == len(body)
+        out.append(b"0\r\n" + trailer + b"\r\n")
+        return b"".join(out)
+
+    @staticmethod
+    def _recv_chunked(port, request: bytes) -> bytes:
+        with pysocket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.sendall(request)
+            s.settimeout(5)
+            data = b""
+            while not data.endswith(b"0\r\n\r\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            return data
+
+    def test_chunked_request_round_trip_chunked_response(self):
+        server = start_tcp_server()
+        try:
+            body = json.dumps({"message": "chunky"}).encode()
+            framed = self._chunk(body, [7, len(body) - 7],
+                                 trailer=b"X-Trailer: ignored\r\n")
+            req = (b"POST /EchoService/Echo HTTP/1.1\r\nHost: t\r\n"
+                   b"Content-Type: application/json\r\n"
+                   b"Transfer-Encoding: chunked\r\n\r\n" + framed)
+            resp = self._recv_chunked(server.listen_port, req)
+            head, _, rest = resp.partition(b"\r\n\r\n")
+            assert b"200" in head.split(b"\r\n")[0]
+            assert b"transfer-encoding: chunked" in head.lower()
+            assert b"content-length" not in head.lower()
+            from brpc_tpu.policy.http import _parse_chunked_body
+            decoded, consumed = _parse_chunked_body(resp, len(head) + 4)
+            assert decoded is not None and consumed == len(resp)
+            assert json.loads(decoded)["message"] == "http:chunky"
+        finally:
+            server.stop()
+
+    def test_parser_reassembles_split_chunked_delivery(self):
+        """The parser must report NOT_ENOUGH_DATA for a partial chunked
+        body and succeed once the tail arrives — the streamed-arrival
+        path a real socket produces."""
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.policy.http import _parse_http
+        from brpc_tpu.rpc.protocol import ParseResultType
+        body = b"0123456789abcdef"
+        framed = self._chunk(body, [4, 12])
+        wire = (b"POST /x HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+                + framed)
+        for cut in (len(wire) - 1, len(wire) - 8, len(wire) - len(framed)):
+            partial = IOBuf(wire[:cut])
+            assert _parse_http(partial).type == \
+                ParseResultType.NOT_ENOUGH_DATA
+        buf = IOBuf(wire)
+        pr = _parse_http(buf)
+        assert pr.type == ParseResultType.OK
+        assert pr.message.body == body
+        assert len(buf) == 0
+
+    def test_chunked_response_parsed_by_client_parser(self):
+        """Client direction: a chunked RESPONSE decodes through the same
+        parser (HTTP/1.1 servers stream bodies of unknown length)."""
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.policy.http import _parse_http
+        from brpc_tpu.rpc.protocol import ParseResultType
+        payload = json.dumps({"message": "streamed"}).encode()
+        wire = (b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                + self._chunk(payload, [3, len(payload) - 3]))
+        pr = _parse_http(IOBuf(wire))
+        assert pr.type == ParseResultType.OK
+        assert not pr.message.is_request
+        assert json.loads(pr.message.body)["message"] == "streamed"
+
+    def test_malformed_chunk_size_is_a_parse_error(self):
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.policy.http import _parse_http
+        from brpc_tpu.rpc.protocol import ParseResultType
+        # int(x, 16) would accept the -2/+5/0x10/1_0 shapes — a strict
+        # RFC 7230 peer disagrees about framing on them, the
+        # request-smuggling setup — so only pure hex digits parse
+        for bad in (b"zz", b"-2", b"+5", b"0x10", b"1_0", b""):
+            wire = (b"POST /x HTTP/1.1\r\nTransfer-Encoding: chunked"
+                    b"\r\n\r\n" + bad + b"\r\nbody\r\n0\r\n\r\n")
+            assert _parse_http(IOBuf(wire)).type == ParseResultType.ERROR, \
+                bad
+
+    def test_transfer_encoding_must_be_a_lone_chunked_token(self):
+        """'gzip, chunked' (a coding we cannot decode) and bogus tokens
+        containing 'chunked' are ambiguous-framing shapes RFC 7230
+        §3.3.3 says to reject — substring matching would de-chunk and
+        hand garbage (or smuggled bytes) to dispatch."""
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.policy.http import _parse_http
+        from brpc_tpu.rpc.protocol import ParseResultType
+        body = self._chunk(b"hello", [5])
+        for te in (b"gzip, chunked", b"xchunked", b"chunked, gzip",
+                   b"chunkedx"):
+            wire = (b"POST /x HTTP/1.1\r\nTransfer-Encoding: " + te
+                    + b"\r\n\r\n" + body)
+            assert _parse_http(IOBuf(wire)).type == ParseResultType.ERROR, te
+        # whitespace/case variants of the lone token still parse
+        wire = (b"POST /x HTTP/1.1\r\nTransfer-Encoding:  Chunked \r\n\r\n"
+                + body)
+        pr = _parse_http(IOBuf(wire))
+        assert pr.type == ParseResultType.OK and pr.message.body == b"hello"
+
+    def test_chunk_extension_is_ignored(self):
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.policy.http import _parse_http
+        from brpc_tpu.rpc.protocol import ParseResultType
+        wire = (b"POST /x HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+                b"5;ext=1\r\nhello\r\n0\r\n\r\n")
+        pr = _parse_http(IOBuf(wire))
+        assert pr.type == ParseResultType.OK
+        assert pr.message.body == b"hello"

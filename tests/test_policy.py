@@ -84,7 +84,17 @@ class TestLoadBalancers:
         for _ in range(50):
             lb.feedback(EPS[0], 0, 100)       # fast
             lb.feedback(EPS[1], 0, 10000)     # 100x slower
-        counts = collections.Counter(lb.select_server() for _ in range(500))
+        # pair every selection with immediate feedback at the server's
+        # characteristic latency: selections without feedback accumulate
+        # IN-FLIGHT entries, and the divided-weight extrapolation then
+        # collapses the fast server's weight by wall-clock elapsed — a
+        # loaded CI host made the old feedback-less loop flaky
+        counts = collections.Counter()
+        lat = {EPS[0]: 100, EPS[1]: 10000}
+        for _ in range(500):
+            ep = lb.select_server()
+            counts[ep] += 1
+            lb.feedback(ep, 0, lat[ep])
         assert counts[EPS[0]] > counts[EPS[1]] * 5
 
     def test_locality_aware_punishes_errors(self):

@@ -1,0 +1,630 @@
+"""Graceful drain & zero-downtime restart: lame-duck mode end to end.
+
+Reference: Server::Stop(closewait_ms)/Join + -graceful_quit_on_sigterm
+(src/brpc/server.cpp, docs/cn/server.md "优雅退出").  Covered here:
+
+  * stop(grace_s) flips the server to draining: /health reports it, new
+    requests on still-open connections bounce with retryable ELOGOFF,
+    in-flight handlers complete inside the grace window, and stop
+    returns as soon as the drain converges (not at grace expiry).
+  * GOODBYE pulls the endpoint from a peer's load balancers BEFORE the
+    first health-check probe would have run (probe-counter assertion
+    under an injected 30s first-probe delay).
+  * mesh:// naming drops a draining member and re-lists it on restart.
+  * The drain gate waits on posted device-plane transfers (pins release
+    at completion), and a grace expiry fails stragglers so a pin is
+    NEVER leaked.
+  * Lifecycle hygiene: stop→start→stop cycles rebind the same port with
+    no thread leak, the idle reaper is generation-bound (a fast
+    stop→start cycle cannot leave two reapers), join() waits for
+    in-flight handlers, and a drained+restarted endpoint is revived by
+    the PR-2 health checker.
+  * graceful_quit_on_sigterm drains registered servers on TERM
+    (subprocess).
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401 — registers protocols
+from brpc_tpu import ici, rpc
+from brpc_tpu.butil import flags as _fl
+from brpc_tpu.butil.endpoint import parse_endpoint
+from brpc_tpu.rpc import errors, health_check, lameduck
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _echo_service(tag="srv", slow_messages=(), slow_s=0.0, finished=None):
+    class Echo(rpc.Service):
+        SERVICE_NAME = "EchoService"
+
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            if request.message in slow_messages:
+                time.sleep(slow_s)
+                if finished is not None:
+                    finished.set()
+            response.message = f"{tag}:{request.message}"
+            done()
+
+    return Echo()
+
+
+def _call(ch, msg, **cntl_attrs):
+    cntl = rpc.Controller()
+    for k, v in cntl_attrs.items():
+        setattr(cntl, k, v)
+    resp = ch.call_method("EchoService.Echo", cntl,
+                          EchoRequest(message=msg), EchoResponse)
+    return cntl, resp
+
+
+class TestDrain:
+    def test_drain_completes_inflight_and_rejects_new_with_elogoff(self):
+        finished = threading.Event()
+        server = rpc.Server()
+        server.add_service(_echo_service(slow_messages=("slow",),
+                                         slow_s=0.8, finished=finished))
+        assert server.start("mem://drain-basic") == 0
+        ch = rpc.Channel()
+        ch.init("mem://drain-basic",
+                options=rpc.ChannelOptions(timeout_ms=5000, max_retry=0))
+        results = {}
+        c1 = rpc.Controller()
+        ch.call_method("EchoService.Echo", c1, EchoRequest(message="slow"),
+                       EchoResponse,
+                       done=lambda c: results.update(slow=(
+                           c.error_code_,
+                           getattr(c.response, "message", None))))
+        time.sleep(0.1)
+
+        stop_dt = {}
+
+        def stopper():
+            t0 = time.monotonic()
+            server.stop(5.0)
+            stop_dt["dt"] = time.monotonic() - t0
+
+        t = threading.Thread(target=stopper)
+        t.start()
+        deadline = time.monotonic() + 2
+        while not server.is_draining() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert server.is_draining()
+        # /health flips while draining — 503 + body, so both
+        # status-code-keyed and body-reading checkers pull the endpoint
+        assert server._builtin.dispatch("health", {}) == \
+            (503, "text/plain", "draining")
+        # new request on the still-open connection: retryable ELOGOFF
+        c2, _ = _call(ch, "new")
+        assert c2.error_code_ == errors.ELOGOFF, (c2.error_code_,
+                                                  c2.error_text_)
+        t.join(10)
+        assert finished.is_set(), "in-flight handler must complete"
+        time.sleep(0.2)
+        assert results["slow"] == (0, "srv:slow"), results
+        # stop returned when the drain converged, not at grace expiry
+        assert stop_dt["dt"] < 3.0, stop_dt
+        assert server._builtin.dispatch("health", {}) == \
+            ("text/plain", "OK") or not server.is_running()
+
+    def test_post_grace_straggler_fails_elogoff(self):
+        finished = threading.Event()
+        server = rpc.Server()
+        server.add_service(_echo_service(slow_messages=("veryslow",),
+                                         slow_s=2.0, finished=finished))
+        assert server.start("mem://drain-straggler") == 0
+        ch = rpc.Channel()
+        ch.init("mem://drain-straggler",
+                options=rpc.ChannelOptions(timeout_ms=8000, max_retry=0))
+        results = {}
+        done_evt = threading.Event()
+        c1 = rpc.Controller()
+
+        def adone(c):
+            results["code"] = c.error_code_
+            done_evt.set()
+
+        ch.call_method("EchoService.Echo", c1,
+                       EchoRequest(message="veryslow"), EchoResponse,
+                       done=adone)
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        server.stop(0.3)
+        dt = time.monotonic() - t0
+        assert 0.25 <= dt < 1.5, dt
+        assert done_evt.wait(5), "straggler call never completed"
+        # the handler outlived the grace: its connection failed ELOGOFF
+        assert results["code"] == errors.ELOGOFF, results
+        server.join(5.0)
+        assert finished.is_set()
+
+    def test_health_returns_503_on_keepalive_connection_while_draining(self):
+        """A status-code-keyed checker (k8s readiness, LB HTTP check)
+        holding a keep-alive connection must see the drain as 503, not a
+        200 with a body it never reads."""
+        import socket as pysock
+        finished = threading.Event()
+        server = rpc.Server()
+        server.add_service(_echo_service(slow_messages=("slow",),
+                                         slow_s=0.6, finished=finished))
+        assert server.start("tcp://127.0.0.1:0") == 0
+        port = server.listen_port
+        hc = pysock.create_connection(("127.0.0.1", port), timeout=5)
+        try:
+            hc.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+            resp = hc.recv(65536)
+            assert b"200" in resp.split(b"\r\n")[0] and \
+                resp.endswith(b"OK"), resp
+            ch = rpc.Channel()
+            ch.init(f"tcp://127.0.0.1:{port}",
+                    options=rpc.ChannelOptions(timeout_ms=8000, max_retry=0))
+            c = rpc.Controller()
+            ch.call_method("EchoService.Echo", c,
+                           EchoRequest(message="slow"), EchoResponse,
+                           done=lambda _c: None)
+            time.sleep(0.1)
+            stopper = threading.Thread(target=lambda: server.stop(5.0))
+            stopper.start()
+            deadline = time.monotonic() + 2
+            while not server.is_draining() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert server.is_draining()
+            hc.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+            resp = hc.recv(65536)
+            assert b"503" in resp.split(b"\r\n")[0], resp
+            assert resp.endswith(b"draining"), resp
+            stopper.join(10)
+            assert finished.is_set()
+        finally:
+            hc.close()
+            server.stop()
+
+    def test_http_json_rpc_rejected_with_elogoff_while_draining(self):
+        from brpc_tpu.policy import http as http_mod
+        server = rpc.Server()
+        server.add_service(_echo_service())
+        assert server.start("mem://drain-http") == 0
+        server._draining = True          # flip without tearing down
+        try:
+            sent = []
+            msg = http_mod.HttpMessage()
+            msg.method = "POST"
+            msg.path = "/EchoService/Echo"
+            msg.body = b'{"message":"x"}'
+
+            class Sock:
+                internal_only = False
+                remote_side = None
+
+                def write(self, buf):
+                    sent.append(buf.to_bytes())
+
+            http_mod.process_request(msg, Sock(), server)
+            assert sent and b"503" in sent[0].split(b"\r\n")[0]
+            assert str(errors.ELOGOFF).encode() in sent[0]
+        finally:
+            server._draining = False
+            server.stop()
+
+    def test_drain_waits_for_usercode_pool_backlog(self):
+        """A request QUEUED on the usercode_in_pthread backup pool (not
+        yet admitted) must still hold the drain gate."""
+        release = threading.Event()
+        done_msgs = []
+
+        class Echo(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                release.wait(5)
+                done_msgs.append(request.message)
+                response.message = "srv:" + request.message
+                done()
+
+        server = rpc.Server(rpc.ServerOptions(usercode_in_pthread=True,
+                                              usercode_backup_threads=1))
+        server.add_service(Echo())
+        assert server.start("mem://drain-pool") == 0
+        ch = rpc.Channel()
+        ch.init("mem://drain-pool",
+                options=rpc.ChannelOptions(timeout_ms=8000, max_retry=0))
+        codes = []
+        evts = [threading.Event() for _ in range(2)]
+        for i, evt in enumerate(evts):
+            c = rpc.Controller()
+            ch.call_method(
+                "EchoService.Echo", c, EchoRequest(message=f"m{i}"),
+                EchoResponse,
+                done=lambda c, e=evt: (codes.append(c.error_code_), e.set()))
+        deadline = time.monotonic() + 2
+        while server._usercode_queued < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert server._usercode_queued >= 2
+        threading.Timer(0.3, release.set).start()
+        server.stop(5.0)
+        for evt in evts:
+            assert evt.wait(5)
+        # the STARTED request (m0, holding the single backup thread)
+        # completes; the queued-not-yet-started one is answered with
+        # retryable ELOGOFF — either way the drain gate held the stop
+        # until both had their response, instead of failing the
+        # connection under them
+        assert sorted(codes) == [0, errors.ELOGOFF], codes
+        assert done_msgs == ["m0"], done_msgs
+
+
+class TestGoodbye:
+    def test_goodbye_pulls_endpoint_before_first_probe(self):
+        """GOODBYE removes the endpoint from a peer's LB while the first
+        health-check probe is still 30 injected seconds away — the
+        probe counter stays at zero."""
+        mesh = ici.IciMesh()
+        ici.IciMesh.set_default(mesh)
+        old = _fl.get_flag("health_check_interval_s")
+        _fl.set_flag("health_check_interval_s", 30.0)
+        servers = []
+        try:
+            for dev, tag in ((4, "a"), (5, "b")):
+                s = rpc.Server(rpc.ServerOptions(native_ici=False))
+                s.add_service(_echo_service(tag=tag))
+                assert s.start(f"ici://{dev}") == 0
+                servers.append(s)
+            ch = rpc.Channel()
+            ch.init("list://ici://4,ici://5", "rr",
+                    options=rpc.ChannelOptions(timeout_ms=5000, max_retry=2))
+            got = set()
+            for i in range(8):
+                c, r = _call(ch, str(i))
+                assert not c.failed(), (c.error_code_, c.error_text_)
+                got.add(r.message.split(":")[0])
+            assert got == {"a", "b"}, got
+
+            servers[0].stop(1.0)
+            ep4 = mesh.endpoint(4)
+            deadline = time.monotonic() + 3
+            while not lameduck.is_draining(ep4) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert lameduck.is_draining(ep4), "GOODBYE never registered"
+            task = health_check._tasks.get(ep4)
+            assert task is not None, "drained peer must be under check"
+            assert task.probe_count == 0, \
+                "LB pull must beat the first probe (GOODBYE, not timeout)"
+            for _ in range(50):
+                assert ch._lb.select_server() != ep4
+            # traffic continues, zero failures, all on the survivor
+            for i in range(10):
+                c, r = _call(ch, str(i))
+                assert not c.failed(), (c.error_code_, c.error_text_)
+                assert r.message.startswith("b:"), r.message
+        finally:
+            _fl.set_flag("health_check_interval_s", old)
+            for ep in (mesh.endpoint(4), mesh.endpoint(5)):
+                t = health_check._tasks.get(ep)
+                if t is not None:
+                    t.cancel()
+                lameduck.clear_peer_draining(ep)
+            for s in servers:
+                s.stop()
+
+    def test_drained_restart_revived_by_health_checker(self):
+        """The PR-2 revival loop closes the lame-duck cycle: drain →
+        GOODBYE → health check → restart → probe succeeds → endpoint
+        re-admitted (peer-drain mark cleared)."""
+        mesh = ici.IciMesh()
+        ici.IciMesh.set_default(mesh)
+        ep = mesh.endpoint(6)
+        server = rpc.Server(rpc.ServerOptions(native_ici=False))
+        server.add_service(_echo_service(tag="v1"))
+        assert server.start("ici://6") == 0
+        ch = rpc.Channel()
+        ch.init("ici://6",
+                options=rpc.ChannelOptions(timeout_ms=5000, max_retry=1))
+        c, r = _call(ch, "one")
+        assert not c.failed() and r.message == "v1:one"
+        server.stop(0.5)
+        deadline = time.monotonic() + 3
+        while not lameduck.is_draining(ep) and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert lameduck.is_draining(ep)
+        assert health_check.checking(ep)
+        # restart on the same endpoint: the checker's probe revives it
+        server2 = rpc.Server(rpc.ServerOptions(native_ici=False))
+        server2.add_service(_echo_service(tag="v2"))
+        assert server2.start("ici://6") == 0
+        try:
+            deadline = time.monotonic() + 10
+            while health_check.checking(ep) and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not health_check.checking(ep), "revival never fired"
+            assert not lameduck.is_draining(ep), \
+                "revival must clear the peer-drain mark"
+            c, r = _call(ch, "two")
+            assert not c.failed(), (c.error_code_, c.error_text_)
+            assert r.message == "v2:two"
+        finally:
+            server2.stop()
+
+    def test_mesh_naming_drops_draining_member(self):
+        """mesh:// membership excludes a member WHILE it drains; once
+        the stop completes, liveness is the health checker's concern
+        again (and the GOODBYE peer mark keeps protecting clients), so
+        topology-derived membership returns to the full mesh."""
+        from brpc_tpu.policy.naming import MeshNamingService
+        mesh = ici.IciMesh()
+        ici.IciMesh.set_default(mesh)
+        ns = MeshNamingService()
+        ep3 = mesh.endpoint(3)
+        assert ep3 in [e.endpoint for e in ns.get_servers()]
+        finished = threading.Event()
+        server = rpc.Server(rpc.ServerOptions(native_ici=False))
+        server.add_service(_echo_service(slow_messages=("slow",),
+                                         slow_s=0.6, finished=finished))
+        assert server.start("ici://3") == 0
+        ch = rpc.Channel()
+        ch.init("ici://3",
+                options=rpc.ChannelOptions(timeout_ms=5000, max_retry=0))
+        c = rpc.Controller()
+        ch.call_method("EchoService.Echo", c, EchoRequest(message="slow"),
+                       EchoResponse, done=lambda _c: None)
+        time.sleep(0.1)
+        stopper = threading.Thread(target=lambda: server.stop(5.0))
+        stopper.start()
+        deadline = time.monotonic() + 2
+        while not server.is_draining() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert server.is_draining()
+        assert ep3 not in [e.endpoint for e in ns.get_servers()], \
+            "draining member must leave mesh:// membership"
+        stopper.join(10)
+        assert finished.is_set()
+        # the GOODBYE peer mark outlives the stop: clients keep the dead
+        # endpoint excluded until revival re-admits it
+        assert lameduck.is_draining(ep3)
+        assert ep3 not in [e.endpoint for e in ns.get_servers()]
+        server2 = rpc.Server(rpc.ServerOptions(native_ici=False))
+        server2.add_service(_echo_service())
+        assert server2.start("ici://3") == 0
+        try:
+            deadline = time.monotonic() + 10
+            while lameduck.is_draining(ep3) and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not lameduck.is_draining(ep3), "revival never fired"
+            assert ep3 in [e.endpoint for e in ns.get_servers()], \
+                "restart must re-list the member"
+        finally:
+            server2.stop()
+            hc = health_check._tasks.get(ep3)
+            if hc is not None:
+                hc.cancel()
+            lameduck.clear_peer_draining(ep3)
+
+
+class TestDevicePlaneDrainBarrier:
+    @pytest.fixture(autouse=True)
+    def _host_mesh(self):
+        mesh = ici.IciMesh()
+        ici.IciMesh.set_default(mesh)
+        old = (_fl.get_flag("ici_device_plane_host_mesh"),
+               _fl.get_flag("ici_device_plane_threshold"))
+        _fl.set_flag("ici_device_plane_host_mesh", True)
+        _fl.set_flag("ici_device_plane_threshold", 4096)
+        yield mesh
+        _fl.set_flag("ici_device_plane_host_mesh", old[0])
+        _fl.set_flag("ici_device_plane_threshold", old[1])
+
+    def _posted(self, mesh):
+        import jax
+        import jax.numpy as jnp
+        from brpc_tpu.ici import device_plane as dp
+        plane = dp.DevicePlane.instance()
+        arr = jax.device_put(jnp.zeros(65536, jnp.uint8), mesh.device(0))
+        jax.block_until_ready(arr)
+        released = []
+        t = plane.post_send(arr, 0, 1)
+        t.add_source_release(lambda: released.append(1))
+        return plane, t, released
+
+    def test_drain_waits_for_posted_transfer(self, _host_mesh):
+        from brpc_tpu.ici import device_plane as dp
+        plane, t, released = self._posted(_host_mesh)
+        assert plane.active_transfers() >= 1
+        threading.Timer(0.4, lambda: plane.post_recv(t.uuid)).start()
+        server = rpc.Server(rpc.ServerOptions(native_ici=False))
+        server.add_service(_echo_service())
+        assert server.start("mem://dplane-drain") == 0
+        t0 = time.monotonic()
+        server.stop(5.0)
+        dt = time.monotonic() - t0
+        assert 0.3 <= dt < 3.0, dt
+        assert t.state == dp.COMPLETE
+        assert released == [1], "source pin must release at completion"
+        assert plane.active_transfers() == 0
+        assert plane.pending_sends() == 0
+
+    def test_grace_expiry_fails_unmatched_send_releasing_pin(self, _host_mesh):
+        from brpc_tpu.ici import device_plane as dp
+        plane, t, released = self._posted(_host_mesh)
+        server = rpc.Server(rpc.ServerOptions(native_ici=False))
+        server.add_service(_echo_service())
+        assert server.start("mem://dplane-straggle") == 0
+        server.stop(0.3)
+        assert t.state == dp.FAILED, t.state
+        assert released == [1], "a lame-duck stop must never leak a pin"
+        assert plane.pending_sends() == 0
+
+
+class TestLifecycleHygiene:
+    def test_stop_start_cycles_rebind_port_no_thread_leak(self):
+        def census():
+            return {t for t in threading.enumerate() if t.is_alive()}
+
+        server = rpc.Server(rpc.ServerOptions(idle_timeout_s=30))
+        server.add_service(_echo_service())
+        # warmup cycle WITH a call: spawns the process singletons (timer
+        # thread, scheduler workers, the tcp event dispatcher) that a
+        # naive census would misread as leaks
+        assert server.start("tcp://127.0.0.1:0") == 0
+        port = server.listen_port
+        assert port > 0
+        ch0 = rpc.Channel()
+        ch0.init(f"tcp://127.0.0.1:{port}",
+                 options=rpc.ChannelOptions(timeout_ms=5000, max_retry=0,
+                                            connection_type="short"))
+        c0, _ = _call(ch0, "warmup")
+        assert not c0.failed(), (c0.error_code_, c0.error_text_)
+        server.stop()
+        server.join(2.0)
+        time.sleep(0.2)
+        before = census()
+        for i in range(3):
+            assert server.start(f"tcp://127.0.0.1:{port}") == 0, i
+            assert server.listen_port == port
+            ch = rpc.Channel()
+            ch.init(f"tcp://127.0.0.1:{port}",
+                    options=rpc.ChannelOptions(timeout_ms=5000, max_retry=0,
+                                               connection_type="short"))
+            c, r = _call(ch, f"cycle{i}")
+            assert not c.failed(), (c.error_code_, c.error_text_)
+            assert r.message == f"srv:cycle{i}"
+            server.stop()
+            server.join(2.0)
+        deadline = time.monotonic() + 5
+        while len(census() - before) > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        leaked = [t.name for t in census() - before]
+        assert not leaked, f"threads leaked across cycles: {leaked}"
+
+    def test_idle_reaper_is_generation_bound(self):
+        server = rpc.Server(rpc.ServerOptions(idle_timeout_s=5))
+        server.add_service(_echo_service())
+        assert server.start("mem://reaper-gen") == 0
+        # fast stop -> start: the old reaper must observe ITS OWN stop
+        # event (set) and exit even though a new run is already up
+        server.stop()
+        assert server.start("mem://reaper-gen") == 0
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline:
+            reapers = [t for t in threading.enumerate()
+                       if t.name == "idle_reaper" and t.is_alive()]
+            if len(reapers) == 1:
+                break
+            time.sleep(0.02)
+        assert len(reapers) == 1, f"{len(reapers)} reapers alive"
+        server.stop()
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline:
+            if not any(t.name == "idle_reaper" and t.is_alive()
+                       for t in threading.enumerate()):
+                break
+            time.sleep(0.02)
+        assert not any(t.name == "idle_reaper" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_join_waits_for_inflight_handlers(self):
+        finished = threading.Event()
+        server = rpc.Server()
+        server.add_service(_echo_service(slow_messages=("slow",),
+                                         slow_s=0.6, finished=finished))
+        assert server.start("mem://join-inflight") == 0
+        ch = rpc.Channel()
+        ch.init("mem://join-inflight",
+                options=rpc.ChannelOptions(timeout_ms=5000, max_retry=0))
+        c = rpc.Controller()
+        ch.call_method("EchoService.Echo", c, EchoRequest(message="slow"),
+                       EchoResponse, done=lambda _c: None)
+        time.sleep(0.1)
+        server.stop()        # immediate stop: handler still running
+        server.join(5.0)
+        assert finished.is_set(), \
+            "join() must wait for in-flight handlers, not just the flag"
+        assert server.inflight_requests() == 0
+
+    def test_status_page_reports_lifecycle(self):
+        import json as _json
+        server = rpc.Server()
+        server.add_service(_echo_service())
+        assert server.start("mem://status-lifecycle") == 0
+        body = _json.loads(server._builtin.dispatch("status", {})[1])
+        assert body["state"] == "running"
+        server._draining = True
+        body = _json.loads(server._builtin.dispatch("status", {})[1])
+        assert body["state"] == "draining"
+        server._draining = False
+        server.stop()
+        body = _json.loads(server._builtin.dispatch("status", {})[1])
+        assert body["state"] == "stopped"
+
+
+_SIGTERM_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from echo_pb2 import EchoRequest, EchoResponse
+
+finished = []
+
+class Echo(rpc.Service):
+    SERVICE_NAME = "EchoService"
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        time.sleep(0.5)
+        finished.append(request.message)
+        response.message = "srv:" + request.message
+        done()
+
+server = rpc.Server(rpc.ServerOptions(graceful_shutdown_s=5.0,
+                                      graceful_quit_on_sigterm=True))
+server.add_service(Echo())
+assert server.start("mem://gq-child") == 0
+ch = rpc.Channel()
+ch.init("mem://gq-child", options=rpc.ChannelOptions(timeout_ms=8000,
+                                                     max_retry=0))
+results = {}
+evt = threading.Event()
+c = rpc.Controller()
+ch.call_method("EchoService.Echo", c, EchoRequest(message="inflight"),
+               EchoResponse,
+               done=lambda c: (results.update(code=c.error_code_), evt.set()))
+time.sleep(0.1)
+print("UP", flush=True)
+server.join()                      # unblocks when the TERM drain finishes
+assert evt.wait(5), "in-flight call never completed"
+assert results["code"] == 0, results
+assert finished == ["inflight"], finished
+print("DRAINED", flush=True)
+"""
+
+
+class TestGracefulQuitOnSigterm:
+    def test_sigterm_drains_inflight_then_process_exits_cleanly(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SIGTERM_CHILD % {"repo": REPO}],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        try:
+            line = proc.stdout.readline()
+            deadline = time.monotonic() + 60
+            while "UP" not in line and time.monotonic() < deadline and line:
+                line = proc.stdout.readline()
+            assert "UP" in line, line
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=60)
+        except Exception:
+            proc.kill()
+            raise
+        assert proc.returncode == 0, out
+        assert "DRAINED" in out, out

@@ -66,6 +66,55 @@ class TestRpcPress:
         finally:
             server.stop()
 
+    def test_press_sigint_stops_gracefully_with_final_summary(self):
+        """^C mid-run stops ISSUING, drains in-flight calls, and still
+        prints the final latency/QPS summary — run as a subprocess so
+        the SIGINT handler installs in a real main thread."""
+        import subprocess
+        import sys as _sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        child = r"""
+import json, os, signal, sys, threading, time
+sys.path.insert(0, %(repo)r)
+import brpc_tpu.policy
+from brpc_tpu import rpc
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+class Echo(rpc.Service):
+    SERVICE_NAME = "EchoService"
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+server = rpc.Server()
+server.add_service(Echo())
+assert server.start("mem://press-sigint") == 0
+threading.Timer(1.0, lambda: os.kill(os.getpid(), signal.SIGINT)).start()
+from brpc_tpu.tools.rpc_press import run_press
+t0 = time.monotonic()
+res = run_press("mem://press-sigint", "EchoService.Echo",
+                '{"message":"x"}', qps=200, duration=60, concurrency=4,
+                proto="tests.echo_pb2:EchoRequest,EchoResponse",
+                out=sys.stdout)
+dt = time.monotonic() - t0
+assert res["interrupted"] is True, res
+assert res["sent"] > 0 and res["errors"] == 0, res
+assert dt < 20, dt          # stopped at the ^C, not the 60s duration
+server.stop()
+print("SIGINT_OK", flush=True)
+""" % {"repo": repo}
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run([_sys.executable, "-c", child],
+                              capture_output=True, text=True, timeout=120,
+                              env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "SIGINT_OK" in proc.stdout
+        summary = [l for l in proc.stdout.splitlines()
+                   if l.startswith("{")]
+        assert summary and json.loads(summary[0])["interrupted"] is True
+
 
 class TestRpcDumpAndReplay:
     def test_dump_then_replay(self, tmp_path):
