@@ -27,6 +27,7 @@ class _DevicePoller:
         self.key = device_key
         self.queue: Deque[Tuple[Any, Callable[[], None]]] = collections.deque()
         self.cv = threading.Condition()
+        # fablint: thread-quiesced(process-lifetime CQ poller parked on its condvar; owns no native state at exit)
         self.thread = threading.Thread(
             target=self._run, name=f"device_poller_{device_key}", daemon=True)
         self.completed_count = 0

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, Callable, Deque, Generic, Iterator, List, Optional, TypeVar
+from typing import Any, Callable, Deque, Generic, List, Optional, TypeVar
 
 from . import scheduler
 

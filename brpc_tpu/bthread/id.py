@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Optional, Tuple
 
-from ..butil.resource_pool import ResourcePool, id_slot, id_version, make_id
+from ..butil.resource_pool import ResourcePool
 from .butex import Butex
 
 EINVAL = 22

@@ -22,9 +22,10 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from ..butil.resource_pool import ResourcePool
+from ..butil import debug_sync as _dbg
 from ..butil import flags as _flags
 from .butex import Butex
 
@@ -58,11 +59,15 @@ _tls = threading.local()
 class TaskGroup:
     """Per-worker run queue (work_stealing_queue.h + remote_task_queue.h)."""
 
+    # fablint guarded-state contract: the deque is popped by its owner
+    # and stolen from by every other worker
+    _GUARDED_BY = {"deque": "lock"}
+
     def __init__(self, control: "TaskControl", index: int):
         self.control = control
         self.index = index
         self.deque: Deque[Tasklet] = collections.deque()
-        self.lock = threading.Lock()
+        self.lock = _dbg.make_lock("TaskGroup.lock")
         self.steal_count = 0
 
     def push_urgent(self, t: Tasklet) -> None:
@@ -88,6 +93,14 @@ class TaskControl:
     _instance: Optional["TaskControl"] = None
     _instance_lock = threading.Lock()
 
+    # fablint guarded-state contract: the ParkingLot condvar doubles as
+    # the pending-signal lock (reference ParkingLot semantics)
+    _GUARDED_BY = {
+        "_blocked_workers": "_blocked_lock",
+        "tasklet_count": "_count_lock",
+        "_pending_signal": "_parking",
+    }
+
     def __init__(self, concurrency: Optional[int] = None):
         self.concurrency = concurrency or _flags.get_flag("bthread_concurrency")
         self.groups: List[TaskGroup] = []
@@ -96,11 +109,11 @@ class TaskControl:
         self._pending_signal = 0
         self._workers: List[threading.Thread] = []
         self._blocked_workers = 0
-        self._blocked_lock = threading.Lock()
+        self._blocked_lock = _dbg.make_lock("TaskControl._blocked_lock")
         self._stop = False
         self._next_victim = 0
         self.tasklet_count = 0
-        self._count_lock = threading.Lock()
+        self._count_lock = _dbg.make_lock("TaskControl._count_lock")
         for i in range(self.concurrency):
             self._add_worker(i)
 
@@ -115,6 +128,7 @@ class TaskControl:
     def _add_worker(self, index: int) -> None:
         g = TaskGroup(self, index)
         self.groups.append(g)
+        # fablint: thread-quiesced(process-lifetime M:N worker pool; parks on the ParkingLot condvar with a 0.5s timeout)
         t = threading.Thread(target=self._worker_main, args=(g,),
                              name=f"bthread_worker_{index}", daemon=True)
         self._workers.append(t)

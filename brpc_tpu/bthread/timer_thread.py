@@ -47,6 +47,7 @@ class TimerThread:
             self._entries[tid] = True
             self.scheduled_count += 1
             if self._thread is None:
+                # fablint: thread-quiesced(process-lifetime singleton; parks on its condvar between timers)
                 self._thread = threading.Thread(
                     target=self._run, name="brpc_timer", daemon=True)
                 self._thread.start()

@@ -18,7 +18,7 @@ from __future__ import annotations
 import re
 import socket as _socket
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Tuple
 
 SCHEME_TCP = "tcp"
 SCHEME_ICI = "ici"

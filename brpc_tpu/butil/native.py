@@ -13,7 +13,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Callable, Optional
+from typing import Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer

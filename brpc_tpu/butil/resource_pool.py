@@ -13,8 +13,9 @@ Id layout: ``id = (version << 32) | slot``.  Versions start at 1 and bump by
 """
 from __future__ import annotations
 
-import threading
-from typing import Any, Generic, List, Optional, Tuple, TypeVar
+from typing import Any, Generic, List, Optional, TypeVar
+
+from . import debug_sync as _dbg
 
 T = TypeVar("T")
 
@@ -36,10 +37,15 @@ def make_id(version: int, slot: int) -> int:
 class ResourcePool(Generic[T]):
     """Versioned-id pool.  get() -> (id, set_payload), address(id) -> payload."""
 
+    # fablint guarded-state contract: slot/free-list structure only
+    # mutates under the pool lock (address() is the one sanctioned
+    # wait-free reader, suppressed in-line below)
+    _GUARDED_BY = {"_slots": "_lock", "_free": "_lock"}
+
     def __init__(self):
         self._slots: List[List[Any]] = []   # each: [version, payload, in_use]
         self._free: List[int] = []
-        self._lock = threading.Lock()
+        self._lock = _dbg.make_lock("ResourcePool._lock")
 
     def get_resource(self, payload: T) -> int:
         with self._lock:
@@ -57,9 +63,9 @@ class ResourcePool(Generic[T]):
         """Wait-free in the reference; here a plain bounds+version check
         (no lock: slot list only ever grows, version mismatch is benign)."""
         slot = id_slot(rid)
-        if slot >= len(self._slots):
+        if slot >= len(self._slots):  # fablint: ignore[guarded-state] wait-free by design: the slot list only grows, so a stale length is a benign miss
             return None
-        entry = self._slots[slot]
+        entry = self._slots[slot]  # fablint: ignore[guarded-state] wait-free by design: the version check below rejects any entry recycled mid-read
         if entry[0] != id_version(rid) or not entry[2]:
             return None
         return entry[1]

@@ -74,6 +74,7 @@ class Collector:
         with self._cv:
             self._queue.append(sample)
             if self._thread is None:
+                # fablint: thread-quiesced(process-lifetime sampler parked on its condvar; _stop flag quiesces it in tests)
                 self._thread = threading.Thread(
                     target=self._run, name="bvar_collector", daemon=True)
                 self._thread.start()

@@ -10,7 +10,7 @@ import os
 import resource
 import threading
 import time
-from typing import List, Optional
+from typing import List
 
 from .variable import PassiveStatus, Variable
 

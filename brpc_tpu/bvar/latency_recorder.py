@@ -9,14 +9,12 @@ sliding window.
 """
 from __future__ import annotations
 
-import bisect
-import threading
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from ..butil.misc import fast_rand_less_than
 from .variable import Variable, PassiveStatus
 from .reducer import Adder, Maxer, Reducer
-from .window import Window, PerSecond, _ReducerSampler, SamplerCollector
+from .window import PerSecond, _ReducerSampler, SamplerCollector
 
 _SAMPLES_PER_AGENT = 254        # reference: PercentileInterval<254>
 

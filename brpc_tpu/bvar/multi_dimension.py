@@ -6,7 +6,7 @@ list; get_stats(label_values) lazily creates the per-combination variable.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Sequence, Tuple
 
 from .variable import Variable
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import fnmatch
 import threading
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 _registry: Dict[str, "Variable"] = {}
 _registry_lock = threading.Lock()

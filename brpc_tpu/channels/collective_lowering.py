@@ -25,7 +25,7 @@ Service methods register device-side handlers:
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from ..ici.mesh import IciMesh
 

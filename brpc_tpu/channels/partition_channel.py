@@ -17,7 +17,6 @@ scatter/all_gather.
 from __future__ import annotations
 
 import re
-import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..rpc import errors
@@ -25,8 +24,7 @@ from ..rpc.channel import Channel, ChannelOptions
 from ..rpc.controller import Controller
 from ..policy.load_balancers import ServerEntry, create_load_balancer
 from ..policy.naming import get_naming_service_thread
-from .parallel_channel import (ParallelChannel, CallMapper, ResponseMerger,
-                               SubCall)
+from .parallel_channel import ParallelChannel, CallMapper, ResponseMerger
 
 _PARTITION_RE = re.compile(r"^(\d+)/(\d+)$")
 

@@ -16,7 +16,6 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
-from ..butil.misc import fast_rand_less_than
 from ..rpc import errors
 from ..rpc.circuit_breaker import CircuitBreaker
 from ..rpc.controller import Controller

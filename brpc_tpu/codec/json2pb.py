@@ -5,7 +5,6 @@ google.protobuf.json_format with the reference's option surface
 json_format's flags)."""
 from __future__ import annotations
 
-import json
 from typing import Any, Optional, Tuple, Type
 
 from google.protobuf import json_format

@@ -14,7 +14,6 @@ All functions take/return global ``jax.Array``s sharded over the mesh axis
 from __future__ import annotations
 
 import threading
-from functools import partial
 from typing import Callable, Dict, Optional, Tuple
 
 from .mesh import IciMesh

@@ -54,10 +54,11 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 from .. import bvar
 from ..butil import flags as _flags
+from ..butil import debug_sync as _dbg
 from ..butil import logging as log
 from ..bthread.device_waiter import DeviceCompletion, device_on_ready
 from .mesh import IciMesh
@@ -137,7 +138,7 @@ class DeviceTransfer:
         self.complete_ns = 0
         self._src_arr = src_arr        # the pin (rdma_endpoint.cpp:926)
         self._releases: List[Callable[[], None]] = []
-        self._lock = threading.Lock()
+        self._lock = _dbg.make_lock("DeviceTransfer._lock")
 
     # -- source pin ------------------------------------------------------
     def add_source_release(self, cb: Optional[Callable[[], None]]) -> None:
@@ -237,6 +238,26 @@ class DevicePlane:
     _instance: Optional["DevicePlane"] = None
     _ilock = threading.Lock()
 
+    # fablint guarded-state contract: cache/WR-table structure AND the
+    # running stats counters — post_send/post_recv/execute_remote run
+    # on arbitrary caller + executor + poller threads, so unguarded
+    # `+= 1` counter updates were lost under contention (fablint
+    # finding; the per-direction executors alone make two writers)
+    _GUARDED_BY = {
+        "_programs": "_lock",
+        "_zeros": "_lock",
+        "_pending": "_lock",
+        "_active": "_lock",
+        "_next_uuid": "_lock",
+        "transfers": "_lock",
+        "bytes_sent": "_lock",
+        "bytes_recv": "_lock",
+        "fallbacks": "_lock",
+        "cache_hits": "_lock",
+        "cache_misses": "_lock",
+        "match_timeouts": "_lock",
+    }
+
     # cache bounds: steady workloads repost a handful of (size, route)
     # shapes, but arbitrary attachment sizes would otherwise compile and
     # pin one executable + one device-resident zeros row PER DISTINCT
@@ -246,7 +267,7 @@ class DevicePlane:
 
     def __init__(self, mesh: Optional[IciMesh] = None):
         self._mesh = mesh
-        self._lock = threading.Lock()
+        self._lock = _dbg.make_lock("DevicePlane._lock")
         self._programs: "collections.OrderedDict" = collections.OrderedDict()
         self._zeros: "collections.OrderedDict" = collections.OrderedDict()
         self._pending: Dict[int, DeviceTransfer] = {}   # posted sends
@@ -284,8 +305,8 @@ class DevicePlane:
             hit = self._programs.get(key)
             if hit is not None:
                 self._programs.move_to_end(key)
+                self.cache_hits += 1
         if hit is not None:
-            self.cache_hits += 1
             _g_cache_hits << 1
             return hit
         built = self._build(nbytes, src_dev, dst_dev, kernel)
@@ -295,7 +316,7 @@ class DevicePlane:
             self._programs.move_to_end(key)
             while len(self._programs) > self.MAX_PROGRAMS:
                 self._programs.popitem(last=False)
-        self.cache_misses += 1
+            self.cache_misses += 1
         _g_cache_misses << 1
         return entry
 
@@ -409,7 +430,8 @@ class DevicePlane:
         from ..rpc import fault_injection as _fi
         plan = _fi.fabric_active()
         if plan is not None and plan.on_device_post(socket):
-            self.fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
             _g_fallbacks << 1
             raise DevicePlaneError("injected device-plane post refusal")
         if src_dev == dst_dev:
@@ -423,7 +445,8 @@ class DevicePlane:
         try:
             self._program(nbytes, src_dev, dst_dev)
         except Exception as e:
-            self.fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
             _g_fallbacks << 1
             raise DevicePlaneError(f"transfer program build failed: {e}")
         if not remote:
@@ -456,7 +479,8 @@ class DevicePlane:
             import jax
             log.warning("device plane %s: compiled transfer failed (%s) — "
                         "device_put fallback", t.describe()["route"], e)
-            self.fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
             _g_fallbacks << 1
             out = jax.device_put(arr, self.mesh().device(t.dst_dev))
         self._matched(t, out)
@@ -526,20 +550,24 @@ class DevicePlane:
         t.matched_ns = time.monotonic_ns()
         t.out = out
         self._annotate(t, "matched")
-        self.transfers += 1
-        _g_transfers << 1
         # bytes_sent is a SENDER-side counter: a pure receiver (fabric
         # recv half, no source pinned) must not inflate it — in-process
         # transfers are both roles and count both directions
-        if t.source_array() is not None:
-            self.bytes_sent += t.nbytes
+        sender = t.source_array() is not None
+        with self._lock:
+            self.transfers += 1
+            if sender:
+                self.bytes_sent += t.nbytes
+        _g_transfers << 1
+        if sender:
             _g_bytes_sent << t.nbytes
 
         def done() -> None:
             t.state = COMPLETE
             t.complete_ns = time.monotonic_ns()
             if out is not None:
-                self.bytes_recv += t.nbytes
+                with self._lock:
+                    self.bytes_recv += t.nbytes
                 _g_bytes_recv << t.nbytes
             t._release_source()
             self._untrack(t)
@@ -613,7 +641,8 @@ class DevicePlane:
                 if t.posted_ns < cutoff:
                     stale.append(self._pending.pop(uuid))
         for t in stale:
-            self.match_timeouts += 1
+            with self._lock:
+                self.match_timeouts += 1
             _g_match_timeouts << 1
             self._fail(t, "no matching recv within "
                           f"{timeout}s (match timeout)")
@@ -633,16 +662,18 @@ class DevicePlane:
         return [t.describe() for t in list(self._recent)]
 
     def stats(self) -> Dict[str, int]:
-        return {
-            "transfers": self.transfers,
-            "bytes_sent": self.bytes_sent,
-            "bytes_recv": self.bytes_recv,
-            "fallbacks": self.fallbacks,
-            "program_cache_hits": self.cache_hits,
-            "program_cache_misses": self.cache_misses,
-            "match_timeouts": self.match_timeouts,
-            "pending_sends": self.pending_sends(),
-        }
+        with self._lock:
+            out = {
+                "transfers": self.transfers,
+                "bytes_sent": self.bytes_sent,
+                "bytes_recv": self.bytes_recv,
+                "fallbacks": self.fallbacks,
+                "program_cache_hits": self.cache_hits,
+                "program_cache_misses": self.cache_misses,
+                "match_timeouts": self.match_timeouts,
+            }
+        out["pending_sends"] = self.pending_sends()
+        return out
 
     # ---- one-call convenience (in-process transports) ------------------
     def transfer_local(self, arr, src_dev: int, dst_dev: int, socket=None):

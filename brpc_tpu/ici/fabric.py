@@ -42,6 +42,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..butil import flags as _flags
+from ..butil import debug_sync as _dbg
 from ..butil import logging as log
 from ..butil.iobuf import IOBuf, IOPortal, DEVICE
 from ..rpc import errors
@@ -203,16 +204,22 @@ class FabricNode:
     _instance: Optional["FabricNode"] = None
     _lock = threading.Lock()
 
+    # fablint guarded-state contract
+    _GUARDED_BY = {
+        "_xfer_conns": "_xfer_lock",
+        "_next_uuid": "_uuid_lock",
+    }
+
     def __init__(self):
         self.process_id = -1
         self.num_processes = 0
         self._kv = None
         self._xfer_server = None
         self._xfer_conns: Dict[int, object] = {}      # pid -> TransferConnection
-        self._xfer_lock = threading.Lock()
+        self._xfer_lock = _dbg.make_lock("FabricNode._xfer_lock")
         self._ctrl_listener: Optional[_pysocket.socket] = None
         self.ctrl_addr = ""
-        self._uuid_lock = threading.Lock()
+        self._uuid_lock = _dbg.make_lock("FabricNode._uuid_lock")
         self._next_uuid = 1
         self._peers: Dict[int, dict] = {}             # pid -> contact info
         self._accept_thread: Optional[threading.Thread] = None
@@ -391,6 +398,10 @@ class FabricNode:
         no fabric thread is running, so exit-time teardown (CPython
         finalization, C++ static destructors) has nothing to race."""
         self.shutdown()
+        t = self._accept_thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(2.0)     # accept() returns once the listener closed
         try:
             from ..rpc.socket import list_sockets
             for s in list(list_sockets()):
@@ -426,16 +437,34 @@ class FabricNode:
         return jax.devices()[device_id].process_index
 
     def xfer_connection(self, pid: int):
+        # the dial happens OUTSIDE _xfer_lock: peer_info is a blocking
+        # KV get (up to 60s on a slow-starting peer) and connect is a
+        # network round trip — holding the lock across either would
+        # stall every OTHER peer's transfer path behind one laggard
+        # (fablint blocking-under-lock finding).  Two racing dialers
+        # both connect; the loser's conn is dropped (same keep-first
+        # contract as the device-plane program cache).
         with self._xfer_lock:
             conn = self._xfer_conns.get(pid)
-            if conn is None:
-                if self._xfer_server is None:
-                    raise ConnectionError(
-                        "transfer server unavailable in this jax build "
-                        "(jax.experimental.transfer missing)")
-                conn = self._xfer_server.connect(self.peer_info(pid)["xfer"])
-                self._xfer_conns[pid] = conn
+        if conn is not None:
             return conn
+        if self._xfer_server is None:
+            raise ConnectionError(
+                "transfer server unavailable in this jax build "
+                "(jax.experimental.transfer missing)")
+        conn = self._xfer_server.connect(self.peer_info(pid)["xfer"])
+        with self._xfer_lock:
+            kept = self._xfer_conns.setdefault(pid, conn)
+        if kept is not conn:
+            # lost the dial race: release OUR conn, it is a live
+            # transfer-server resource, not a GC-able cache entry
+            closer = getattr(conn, "close", None)
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    pass
+        return kept
 
     def next_uuid(self) -> int:
         with self._uuid_lock:
@@ -453,6 +482,7 @@ class FabricNode:
                 conn, _ = self._ctrl_listener.accept()
             except OSError:
                 return
+            # fablint: thread-quiesced(per-connection; exits when the handshake completes or refuses and the conn closes)
             threading.Thread(target=self._handshake_server, args=(conn,),
                              name="fabric_handshake", daemon=True).start()
 
@@ -639,6 +669,30 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
     """Cross-process ici socket: control TCP + transfer-server pulls,
     with the same credit window as the in-process IciSocket."""
 
+    # fablint guarded-state contract: the bulk-plane handle swap and
+    # revival flags commute under _bulk_lock (the PR-2 review-finding
+    # class), staging under _staged_lock, inbox + credit batching under
+    # _inbox_lock, device-plane latch/executors under _dplane_lock.
+    # The cumulative bulk byte counters are written by concurrent
+    # writer threads (multiple streams share one socket) and so live
+    # under _bulk_lock too.
+    _GUARDED_BY = {
+        "_bulk": "_bulk_lock",
+        "_blib": "_bulk_lock",
+        "_bulk_epoch": "_bulk_lock",
+        "_reestab_pending": "_bulk_lock",
+        "_reestab_running": "_bulk_lock",
+        "_reestab_wanted": "_bulk_lock",
+        "bulk_bytes_sent": "_bulk_lock",
+        "bulk_bytes_claimed": "_bulk_lock",
+        "_staged": "_staged_lock",
+        "_inbox": "_inbox_lock",
+        "_consumed_unacked": "_inbox_lock",
+        "_dplane_qs": "_dplane_lock",
+        "_dplane_down_until": "_dplane_lock",
+        "_dplane_closed": "_dplane_lock",
+    }
+
     def __init__(self, conn: _pysocket.socket, local_dev: int,
                  remote_dev: int, peer_pid: int, node: FabricNode,
                  window_bytes: Optional[int] = None):
@@ -651,9 +705,9 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self.peer_pid = peer_pid
         self.node = node
         self._conn = conn
-        self._conn_wlock = threading.Lock()
+        self._conn_wlock = _dbg.make_lock("FabricSocket._conn_wlock")
         self._inbox = IOBuf()
-        self._inbox_lock = threading.Lock()
+        self._inbox_lock = _dbg.make_lock("FabricSocket._inbox_lock")
         self.read_chunk_hint = 1 << 26    # _do_read cuts, never allocates
         # input events run the parse loop INLINE on the delivering thread
         # (the control read loop for host frames): a tasklet spawn +
@@ -670,7 +724,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._init_window(window_bytes)
         self._init_delivery()
         self._staged: Dict[int, Tuple] = {}    # uuid -> (src_block, array)
-        self._staged_lock = threading.Lock()
+        self._staged_lock = _dbg.make_lock("FabricSocket._staged_lock")
         self._reader: Optional[threading.Thread] = None
         self._bulk = 0                         # native bulk conn handle
         self._blib = None
@@ -678,7 +732,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         # swap (degrade/re-attach race writers and the read loop);
         # the cumulative counters survive re-attachment so tests can
         # assert threshold routing was actually restored.
-        self._bulk_lock = threading.Lock()
+        self._bulk_lock = _dbg.make_lock("FabricSocket._bulk_lock")
         self._bulk_epoch = 0                   # attachments so far
         self.bulk_bytes_sent = 0               # cumulative, across epochs
         self.bulk_bytes_claimed = 0
@@ -704,7 +758,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         # re-probe; the executor thread enters collectives in control
         # order (= the peer's order — the SPMD ordering contract).
         self._dplane_peer = "dplane" in node.peer_info(peer_pid)
-        self._dplane_lock = threading.Lock()
+        self._dplane_lock = _dbg.make_lock("FabricSocket._dplane_lock")
         self._dplane_down_until = 0.0      # 0 = up; else re-probe deadline
         self._dplane_qs = {}               # direction -> lazy executor queue
         self._dplane_closed = False
@@ -796,6 +850,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             if self._reestab_running:
                 return           # the live loop will observe `wanted`
             self._reestab_running = True
+        # fablint: thread-quiesced(self-terminating: exits on attach, socket failure or peer gone; _close_bulk sets _reestab_evt to unblock a parked wait)
         threading.Thread(target=self._bulk_reestablish_loop,
                          name="fabric_bulk_revive", daemon=True).start()
 
@@ -944,6 +999,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 q = self._dplane_qs.get(direction)
                 if q is None:
                     q = self._dplane_qs[direction] = queue.Queue()
+                    # fablint: thread-quiesced(_close_dplane poison-pills the queue; the loop drains it failing transfers)
                     threading.Thread(
                         target=self._dplane_exec_loop, args=(q,),
                         name=f"fabric_dplane_{direction}",
@@ -1224,7 +1280,10 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         rc = lib.brpc_tpu_fab_send(h, uuid, ptr, n) if h else -1
         if rc != 0:
             raise ConnectionError("fabric bulk channel closed")
-        self.bulk_bytes_sent += n
+        with self._bulk_lock:
+            # concurrent writers (streams share the socket) race this
+            # cumulative counter; unguarded += lost updates (fablint)
+            self.bulk_bytes_sent += n
 
     # ---- stream fast plane ---------------------------------------------
     # Stream DATA frames above ici_stream_bulk_threshold post their
@@ -1277,7 +1336,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             # (descriptor-consistency rule); this socket only degrades
             self._bulk_plane_down("bulk sendv failed")
             raise ConnectionError("fabric bulk channel closed")
-        self.bulk_bytes_sent += total
+        with self._bulk_lock:
+            self.bulk_bytes_sent += total
 
     def stream_bulk_abort(self) -> None:
         """Sever the bulk plane after a descriptor went out whose payload
@@ -1293,7 +1353,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         the conn's pool when the last ref dies (_NativeBufOwner)."""
         buf = IOBuf()
         buf.append_user_data(memoryview(self._claim_zero_copy(uuid, length)))
-        self.bulk_bytes_claimed += length
+        with self._bulk_lock:
+            self.bulk_bytes_claimed += length
         return buf
 
     def _claim_zero_copy(self, uuid: int, expect_len: int):
@@ -1539,7 +1600,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 raise ConnectionError(
                     f"bulk frame {uuid:#x}: {n} bytes, descriptor "
                     f"said {expect_len}")
-            self.bulk_bytes_claimed += n
+            with self._bulk_lock:
+                self.bulk_bytes_claimed += n
             return ctypes.string_at(ptr, n)
         finally:
             lib.brpc_tpu_fab_buf_release(h, ptr, n)

@@ -38,7 +38,6 @@ def _interpret_default() -> bool:
 
 def _build_all_gather(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
     import jax
-    import jax.numpy as jnp
     from jax import lax
     from ..butil.jax_compat import shard_map, tpu_compiler_params
     from jax.sharding import PartitionSpec as P
@@ -96,7 +95,6 @@ def _build_all_gather(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
 
 def _build_all_reduce(mesh: IciMesh, chunk_shape, dtype, interpret: bool):
     import jax
-    import jax.numpy as jnp
     from jax import lax
     from ..butil.jax_compat import shard_map, tpu_compiler_params
     from jax.sharding import PartitionSpec as P

@@ -17,11 +17,11 @@ that machinery becomes what ring/context-parallel patterns are made of:
 from __future__ import annotations
 
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 from ..bthread.device_waiter import DeviceEventDispatcher
 from .mesh import IciMesh
-from .collective import Collectives, default_collectives
+from .collective import Collectives
 
 
 def ring_all_reduce(x, mesh: Optional[IciMesh] = None):
@@ -31,7 +31,6 @@ def ring_all_reduce(x, mesh: Optional[IciMesh] = None):
     summed value replicated as (n, chunk...) rows (row i = full sum of
     chunk i's shards … i.e. a reduce-scatter + all-gather pipeline)."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from ..butil.jax_compat import shard_map
 

@@ -33,8 +33,9 @@ import threading
 import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..butil.endpoint import EndPoint, SCHEME_ICI
+from ..butil.endpoint import EndPoint
 from ..butil import flags as _flags
+from ..butil import debug_sync as _dbg
 from ..butil.iobuf import IOBuf, IOPortal, DEVICE
 from ..bthread.butex import Butex
 from ..bthread.device_waiter import DeviceEventDispatcher
@@ -42,9 +43,16 @@ from ..rpc import errors
 from ..rpc.socket import Socket
 from .mesh import IciMesh
 
-_ici_stats_lock = threading.Lock()
+_ici_stats_lock = _dbg.make_lock("ici.transport._ici_stats_lock")
 _ici_bytes_moved = 0
 _ici_device_bytes_moved = 0
+
+# fablint guarded-state contract for the module-level registries
+_GUARDED_BY_GLOBALS = {
+    "_ici_bytes_moved": "_ici_stats_lock",
+    "_ici_device_bytes_moved": "_ici_stats_lock",
+    "_listeners": "_listeners_lock",
+}
 
 # Transport-level sliding window (reference: the RDMA explicit-ACK window,
 # rdma_endpoint.cpp:771 CutFromIOBufList checks _window_size before posting;
@@ -75,11 +83,14 @@ class CreditWindow:
     ``_wait_writable`` timeout FAILS the socket — pending writes complete
     with an error instead of silently wedging forever."""
 
+    _GUARDED_BY = {"_send_window": "_window_lock"}
+
+    # fablint: init
     def _init_window(self, window_bytes: Optional[int]) -> None:
         self.window_bytes = (window_bytes if window_bytes is not None
                              else _flags.get_flag("ici_socket_window_bytes"))
         self._send_window = self.window_bytes
-        self._window_lock = threading.Lock()
+        self._window_lock = _dbg.make_lock("CreditWindow._window_lock")
         self._window_gen = Butex(0)       # bumped whenever credits return
 
     def send_window_left(self) -> int:
@@ -151,10 +162,13 @@ class OrderedDelivery:
     completion poller) or device-plane transfers / any object exposing
     ``add_done_callback`` (gated on its completion — the CQ entry)."""
 
+    _GUARDED_BY = {"_dq": "_dq_lock", "_dq_draining": "_dq_lock"}
+
+    # fablint: init
     def _init_delivery(self) -> None:
         import collections
         self._dq = collections.deque()    # entries: [ready, commit_fn]
-        self._dq_lock = threading.Lock()
+        self._dq_lock = _dbg.make_lock("OrderedDelivery._dq_lock")
         self._dq_draining = False
 
     def _enqueue_delivery(self, waits: List,
@@ -204,6 +218,15 @@ class OrderedDelivery:
 
 
 class IciSocket(CreditWindow, OrderedDelivery, Socket):
+    # fablint guarded-state contract: the inbox and the pinned-send
+    # table are touched from the writer, the reader, and the device
+    # completion poller
+    _GUARDED_BY = {
+        "_inbox": "_inbox_lock",
+        "_inflight_sends": "_inflight_lock",
+        "_inflight_seq": "_inflight_lock",
+    }
+
     def __init__(self, local_dev: int, remote_dev: int,
                  mesh: Optional[IciMesh] = None,
                  window_bytes: Optional[int] = None):
@@ -214,7 +237,7 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
         self.local_side = self.mesh.endpoint(local_dev)
         self.peer: Optional["IciSocket"] = None
         self._inbox = IOBuf()
-        self._inbox_lock = threading.Lock()
+        self._inbox_lock = _dbg.make_lock("IciSocket._inbox_lock")
         self.read_chunk_hint = 1 << 26    # _do_read cuts, never allocates
         self._peer_closed = False
         self._init_window(window_bytes)
@@ -225,7 +248,7 @@ class IciSocket(CreditWindow, OrderedDelivery, Socket):
         # buffer donation reuses send blocks
         self._inflight_sends: Dict[int, Tuple] = {}
         self._inflight_seq = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = _dbg.make_lock("IciSocket._inflight_lock")
 
     def inflight_send_blocks(self) -> int:
         """Device source blocks pinned awaiting transfer completion."""
@@ -456,7 +479,7 @@ def _all_ready(arrays) -> bool:
 # ---- listener registry (ici "ports") ----------------------------------
 
 _listeners: Dict[int, "IciListener"] = {}
-_listeners_lock = threading.Lock()
+_listeners_lock = _dbg.make_lock("ici.transport._listeners_lock")
 
 
 class IciListener:

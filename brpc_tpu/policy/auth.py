@@ -10,7 +10,6 @@ from __future__ import annotations
 import hashlib
 import hmac
 import time
-from typing import Any, Optional
 
 
 class Authenticator:

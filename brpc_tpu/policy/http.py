@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 import time
 import urllib.parse
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional
 
 from ..butil.containers import CaseIgnoredFlatMap
 from ..butil.iobuf import IOBuf
@@ -28,8 +28,7 @@ from ..codec import json2pb
 from ..proto import rpc_meta_pb2 as meta_pb
 from ..rpc import errors
 from ..rpc.controller import Controller
-from ..rpc.protocol import (Protocol, ParseResult, ParseResultType,
-                            register_protocol)
+from ..rpc.protocol import Protocol, ParseResult, register_protocol
 
 _METHODS = (b"GET ", b"POST", b"PUT ", b"DELE", b"HEAD", b"OPTI", b"PATC",
             b"HTTP")

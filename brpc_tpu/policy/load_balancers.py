@@ -11,14 +11,13 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-import threading
 import weakref
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from ..butil import debug_sync as _dbg
 from ..butil.doubly_buffered import DoublyBufferedData
 from ..butil.endpoint import EndPoint
 from ..butil.misc import fast_rand_less_than
-from ..rpc import errors
 
 
 class ServerEntry:
@@ -67,10 +66,14 @@ def live_load_balancers() -> List["LoadBalancer"]:
 class _ListLB(LoadBalancer):
     """Shared base: DoublyBufferedData<list[ServerEntry]>."""
 
+    # fablint guarded-state contract (selection runs on every RPC
+    # thread; exclusions mutate from breaker/lame-duck callbacks)
+    _GUARDED_BY = {"_excluded": "_excl_lock"}
+
     def __init__(self):
         self._dbd: DoublyBufferedData[List[ServerEntry]] = DoublyBufferedData(list)
         self._excluded: Dict[EndPoint, float] = {}   # circuit-broken until ts
-        self._excl_lock = threading.Lock()
+        self._excl_lock = _dbg.make_lock("_ListLB._excl_lock")
         _live_lbs.add(self)
 
     def add_server(self, ep, weight=100, tag="") -> bool:
@@ -119,11 +122,12 @@ class _ListLB(LoadBalancer):
 
 class RoundRobinLB(_ListLB):
     name = "rr"
+    _GUARDED_BY = {"_index": "_ilock"}
 
     def __init__(self):
         super().__init__()
         self._index = 0
-        self._ilock = threading.Lock()
+        self._ilock = _dbg.make_lock("RoundRobinLB._ilock")
 
     def select_server(self, cntl=None):
         with self._dbd.read() as lst:
@@ -137,10 +141,11 @@ class RoundRobinLB(_ListLB):
 
 class WeightedRoundRobinLB(_ListLB):
     name = "wrr"
+    _GUARDED_BY = {"_current": "_lock"}
 
     def __init__(self):
         super().__init__()
-        self._lock = threading.Lock()
+        self._lock = _dbg.make_lock("WeightedRoundRobinLB._lock")
         self._current: Dict[EndPoint, int] = {}
 
     def select_server(self, cntl=None):
@@ -233,12 +238,14 @@ class ConsistentHashingLB(_ListLB):
     (consistent_hashing_load_balancer.cpp + hasher.cpp).  ``kind`` selects
     the hash: murmur | md5 | ketama (md5-based multi-point)."""
 
+    _GUARDED_BY = {"_ring": "_ring_lock"}
+
     def __init__(self, kind: str = "murmur", vnodes: int = 64):
         super().__init__()
         self.kind = kind
         self.name = "c_" + kind + "hash"
         self._vnodes = vnodes
-        self._ring_lock = threading.Lock()
+        self._ring_lock = _dbg.make_lock("ConsistentHashingLB._ring_lock")
         self._ring: List[Tuple[int, EndPoint]] = []
 
     def _hash(self, data: bytes) -> int:
@@ -354,15 +361,19 @@ class LocalityAwareLB(_ListLB):
     keep receiving probe traffic or it could never recover)."""
 
     name = "la"
+    # the per-server weight table AND each _LaWeight's interior state
+    # (samples window, in-flight begin sums) mutate only under _w_lock
+    _GUARDED_BY = {"_servers": "_w_lock"}
     WEIGHT_SCALE = 1e7
     INITIAL_WEIGHT = 1000.0     # until the first sample lands
     MIN_WEIGHT = 1.0
 
     def __init__(self):
         super().__init__()
-        self._w_lock = threading.Lock()
+        self._w_lock = _dbg.make_lock("LocalityAwareLB._w_lock")
         self._servers: Dict[EndPoint, _LaWeight] = {}
 
+    # fablint: lock-held(_w_lock)
     def _weight_for(self, ep: EndPoint) -> _LaWeight:
         w = self._servers.get(ep)
         if w is None:

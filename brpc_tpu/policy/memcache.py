@@ -8,7 +8,7 @@ memcached binary spec.
 from __future__ import annotations
 
 import struct
-from typing import Any, List, Optional, Tuple
+from typing import Any, List
 
 from ..butil.iobuf import IOBuf
 from ..rpc import errors

@@ -24,9 +24,7 @@ watchers (load balancers implement the watcher interface via
 from __future__ import annotations
 
 import json
-import os
 import threading
-import time
 import urllib.request
 from typing import Callable, Dict, List, Optional
 
@@ -317,6 +315,7 @@ class NamingServiceThread:
         self._last: List[ServerEntry] = []
         self._have_last = False
         self._stop = threading.Event()
+        # fablint: thread-quiesced(stop() sets _stop; the watch/poll loop checks it every iteration and exits promptly)
         self._thread = threading.Thread(target=self._run,
                                         name=f"ns:{url[:24]}", daemon=True)
         self._poll_once()

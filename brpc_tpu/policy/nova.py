@@ -10,7 +10,6 @@ is an NsheadPbServiceAdaptor (NovaServiceAdaptor).
 """
 from __future__ import annotations
 
-from typing import Any
 
 from ..butil.iobuf import IOBuf
 from ..bthread import id as bthread_id

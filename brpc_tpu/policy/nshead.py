@@ -17,8 +17,8 @@ on the Socket).
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass, field
-from typing import Any, Callable, List, Optional
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
 
 from ..butil.iobuf import IOBuf
 from ..butil import logging as log

@@ -12,7 +12,6 @@ NsheadPbServiceAdaptor registered like any nshead service.
 from __future__ import annotations
 
 import re
-from typing import Any
 
 from ..butil.iobuf import IOBuf
 from ..bthread import id as bthread_id

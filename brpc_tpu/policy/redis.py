@@ -20,14 +20,12 @@ Usage, server:
 """
 from __future__ import annotations
 
-import threading
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..butil.iobuf import IOBuf
 from ..rpc import errors
 from ..rpc.controller import Controller
-from ..rpc.protocol import (Protocol, ParseResult, ParseResultType,
-                            register_protocol)
+from ..rpc.protocol import Protocol, ParseResult, register_protocol
 
 # ---- RESP codec -------------------------------------------------------
 

@@ -14,7 +14,6 @@ Channel machinery with protocol="thrift".
 from __future__ import annotations
 
 import struct
-import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..butil.iobuf import IOBuf

@@ -11,16 +11,15 @@ same frames travel over mem://, tcp://, and the ici:// device fabric.
 from __future__ import annotations
 
 import time
-from typing import Any, Optional
+from typing import Any
 
-from ..butil.iobuf import IOBuf, IOBufCutter
+from ..butil.iobuf import IOBuf
 from ..butil import logging as log
 from ..bthread import id as bthread_id
 from ..proto import rpc_meta_pb2 as meta_pb
 from ..rpc import errors
 from ..rpc.controller import Controller
-from ..rpc.protocol import (Protocol, ParseResult, ParseResultType,
-                            register_protocol)
+from ..rpc.protocol import Protocol, ParseResult, register_protocol
 from ..rpc import compress as compress_mod
 
 MAGIC = b"TRPC"

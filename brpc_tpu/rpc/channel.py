@@ -12,7 +12,6 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from ..butil.endpoint import EndPoint, parse_endpoint
-from ..butil.iobuf import IOBuf
 from . import errors
 from .controller import Controller
 from .input_messenger import InputMessenger
@@ -100,6 +99,9 @@ class Channel:
             watcher = self._lb
             if self.options.ns_filter is not None:
                 watcher = _FilteredWatcher(self._lb, self.options.ns_filter)
+            # remembered so close() can detach THIS object — removing
+            # the raw LB would miss the filter wrapper (review finding)
+            self._ns_watcher = watcher
             self._ns_thread.add_watcher(watcher)
             return 0
         self._endpoint = parse_endpoint(target) if isinstance(target, str) else target
@@ -341,6 +343,46 @@ class Channel:
                                    ssl_context=ssl_ctx, group=group,
                                    connect_timeout=cto)
         return sock
+
+    def close(self) -> None:
+        """Tear down this channel's connections: every socket the map
+        holds for its endpoint is failed with ECLOSE (a deliberate
+        local close — no health-check revival) and the native ici
+        binding is released.  Idempotent; a later call on the channel
+        simply reconnects.  Without this, a dropped client channel
+        leaves its connection pair live in the socket pool until
+        process exit (the resource-census leak class)."""
+        if self._protocol is None:
+            return          # init() never completed: nothing to close
+        with self._native_ici_lock:
+            nb, self._native_ici = getattr(self, "_native_ici", None), None
+        if nb is not None:
+            try:
+                nb.close()
+            except Exception:
+                pass
+        sig = self._channel_signature()
+        smap = SocketMap.instance()
+        if self._endpoint is not None:
+            smap.close_endpoint(self._endpoint, sig)
+        lb = self._lb
+        if lb is not None:
+            # load-balanced channel: detach from the (shared) naming
+            # watcher and close every member's connections under this
+            # channel's signature — a single-endpoint-only close would
+            # silently leak the whole pool (review finding)
+            ns = self._ns_thread
+            if ns is not None:
+                try:
+                    ns.remove_watcher(getattr(self, "_ns_watcher", lb))
+                except Exception:
+                    pass
+            dbd = getattr(lb, "_dbd", None)
+            if dbd is not None:
+                with dbd.read() as lst:
+                    eps = [e.endpoint for e in lst]
+                for ep in eps:
+                    smap.close_endpoint(ep, sig)
 
     def _channel_signature(self) -> tuple:
         """Connection-compatibility key (reference channel.cpp

@@ -12,9 +12,8 @@ from __future__ import annotations
 
 import os
 import selectors
-import socket as pysocket
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from ..butil import flags as _flags
 from .socket import Socket
@@ -31,6 +30,7 @@ class EventDispatcher:
         self._wakeup_r, self._wakeup_w = os.pipe()
         os.set_blocking(self._wakeup_r, False)
         self._sel.register(self._wakeup_r, selectors.EVENT_READ, None)
+        # fablint: thread-quiesced(stop() sets _stop and pokes the wakeup pipe; the select loop observes it and exits)
         self._thread = threading.Thread(target=self._run, name="event_dispatcher",
                                         daemon=True)
         self._stop = False

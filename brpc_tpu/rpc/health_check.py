@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, Optional
 
 from ..butil.endpoint import EndPoint, SCHEME_MEM, SCHEME_TCP, SCHEME_ICI
 from ..butil import flags as _flags
+from ..butil import debug_sync as _dbg
 from ..butil import logging as log
 from ..bthread.timer_thread import TimerThread
 from .circuit_breaker import BreakerRegistry
@@ -64,6 +65,11 @@ class HealthCheckTask:
     health_check_max_interval_s) with seeded jitter so a fleet of
     checkers never stampedes a recovering peer."""
 
+    # the registry lock guards per-task callback registration too:
+    # start_health_check mutates _revive_cbs under it while the timer
+    # thread's _probe snapshots it (fablint guarded-state contract)
+    _GUARDED_BY = {"_revive_cbs": "_tasks_lock"}
+
     def __init__(self, ep: EndPoint,
                  on_revived: Optional[Callable[[EndPoint], None]] = None,
                  app_check: Optional[Callable[[EndPoint], bool]] = None,
@@ -109,7 +115,13 @@ class HealthCheckTask:
         if ok:
             BreakerRegistry.instance().breaker(self.ep).mark_recovered()
             _unregister(self.ep)
-            cbs = list(self._revive_cbs.values())
+            # snapshot under the registry lock: start_health_check
+            # inserts callbacks concurrently (channel breaker trips on
+            # other threads), and iterating the live dict here raced
+            # those inserts — a registration could be skipped or the
+            # iteration could die mid-revival (fablint finding)
+            with _tasks_lock:
+                cbs = list(self._revive_cbs.values())
             if self.on_revived is not None:
                 cbs.insert(0, self.on_revived)
             for cb in cbs:
@@ -131,7 +143,10 @@ class HealthCheckTask:
 
 
 _tasks: Dict[EndPoint, HealthCheckTask] = {}
-_tasks_lock = threading.Lock()
+_tasks_lock = _dbg.make_lock("health_check._tasks_lock")
+
+# fablint guarded-state contract for the module-level registry
+_GUARDED_BY_GLOBALS = {"_tasks": "_tasks_lock"}
 
 
 def start_health_check(ep: EndPoint,

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 from typing import Any, List, Optional
 
-from ..butil.iobuf import IOBuf
 from .. import bvar
 from ..bthread import scheduler
 from . import errors

@@ -7,10 +7,8 @@ OnResponded) and the /status builtin renders.
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 from .. import bvar
-from . import errors
 
 
 class MethodStatus:

@@ -38,7 +38,10 @@ def profile_for(seconds: float = 1.0, top: int = 40) -> str:
     with _profile_lock:
         pr = cProfile.Profile()
         pr.enable()
-        time.sleep(seconds)
+        # the sleep IS the sampled window; the lock exists precisely to
+        # serialize concurrent profilers over process-global cProfile
+        # state, so holding it across the window is the point
+        time.sleep(seconds)  # fablint: ignore[blocking-under-lock] the lock serializes the process-global profiler; the sleep is the sampling window itself
         pr.disable()
     out = io.StringIO()
     stats = pstats.Stats(pr, stream=out)

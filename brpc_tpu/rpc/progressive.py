@@ -21,7 +21,6 @@ import threading
 from typing import Callable, List, Optional
 
 from ..butil.iobuf import IOBuf
-from . import errors
 from .stream import (Stream, StreamOptions, StreamInputHandler,
                      stream_create, stream_accept)
 

@@ -16,7 +16,6 @@ from typing import Any, Dict, List, Optional
 
 from ..butil.endpoint import EndPoint, parse_endpoint, SCHEME_MEM, SCHEME_TCP
 from ..butil import logging as log
-from .. import bvar
 from . import errors
 from .input_messenger import InputMessenger
 from .method_status import MethodStatus
@@ -157,7 +156,6 @@ class Server:
         if name in self._services:
             return errors.EINVAL
         self._services[name] = svc
-        from ..butil import flags as _flags
         for mname, md in svc.methods().items():
             self._methods[md.full_name] = md
             limiter = self._make_limiter(md.full_name)

@@ -17,7 +17,7 @@ contract as the reference's google::protobuf::Closure.
 from __future__ import annotations
 
 import inspect
-from typing import Any, Callable, Dict, Optional, Type
+from typing import Callable, Dict, Optional, Type
 
 
 def method(request_cls: Type, response_cls: Type):

@@ -21,17 +21,16 @@ subclasses implementing ``_do_write``/``_do_read``.
 """
 from __future__ import annotations
 
-import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, List, Optional
 
 from ..butil.iobuf import IOBuf, IOPortal
 from ..butil import flags as _flags
 from ..butil.resource_pool import ResourcePool
+from ..butil import debug_sync as _dbg
 from ..butil.endpoint import EndPoint
 from .. import bvar
 from ..bthread import scheduler
-from ..bthread.butex import Butex
 from . import errors
 
 _socket_pool: ResourcePool = ResourcePool()
@@ -69,6 +68,18 @@ class WriteRequest:
 class Socket:
     """Base socket; see module docstring."""
 
+    # fablint guarded-state contract (the write path's single-writer
+    # discipline and the input-event dedup both live or die by these)
+    _GUARDED_BY = {
+        "_write_queue": "_write_lock",
+        "_writing": "_write_lock",
+        "_unwritten": "_write_lock",
+        "_nevent": "_nevent_lock",
+        "pipelined_contexts": "_pipeline_lock",
+        "_inflight_cids": "_pipeline_lock",
+        "_inflight_prune_at": "_pipeline_lock",
+    }
+
     def __init__(self, remote_side: Optional[EndPoint] = None,
                  user: Any = None):
         self.id: int = _socket_pool.get_resource(self)
@@ -83,10 +94,10 @@ class Socket:
         self.logoff = False
         self._write_queue: List[WriteRequest] = []
         self._unwritten = 0          # queued-but-unwritten bytes (EOVERCROWDED)
-        self._write_lock = threading.Lock()
+        self._write_lock = _dbg.make_lock("Socket._write_lock")
         self._writing = False
         self._nevent = 0                    # input-event dedup counter
-        self._nevent_lock = threading.Lock()
+        self._nevent_lock = _dbg.make_lock("Socket._nevent_lock")
         self.messenger = None               # InputMessenger set by owner
         self._read_portal = IOPortal()
         self._selected_protocol_index = -1  # protocol memory per socket
@@ -95,7 +106,7 @@ class Socket:
         self.last_active = time.monotonic()   # idle-timeout reaping
         self.on_failed_callbacks: List[Callable[["Socket"], None]] = []
         self.pipelined_contexts: List[Any] = []   # redis/memcache pipelining
-        self._pipeline_lock = threading.Lock()
+        self._pipeline_lock = _dbg.make_lock("Socket._pipeline_lock")
         # correlation ids written on this socket and possibly awaiting a
         # response: failed with the socket so a connection death completes
         # in-flight calls NOW instead of letting them burn their full
@@ -154,6 +165,7 @@ class Socket:
         self._transport_close()
         return True
 
+    # fablint: lock-held(_write_lock)
     def _unwritten_bytes(self) -> int:
         # running counter (maintained under _write_lock): the queue can hold
         # tens of thousands of requests under backlog, exactly when an

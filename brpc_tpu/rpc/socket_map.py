@@ -11,7 +11,6 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from ..butil.endpoint import EndPoint, SCHEME_MEM, SCHEME_TCP, SCHEME_ICI
-from . import errors
 from .socket import Socket
 
 
@@ -104,7 +103,15 @@ class SocketMap:
                              group: Any = "") -> None:
         if s.failed or s.logoff:
             return
-        e = self._entry(ep, group)
+        # do NOT auto-create the entry: close_endpoint() pops it, and a
+        # pooled socket checked out across the close must be failed on
+        # return, not resurrect the mapping (review finding)
+        with self._lock:
+            e = self._map.get((ep, group))
+        if e is None:
+            from . import errors
+            s.set_failed(errors.ECLOSE, "endpoint closed while checked out")
+            return
         with e.lock:
             e.pooled.append(s)
 
@@ -135,6 +142,28 @@ class SocketMap:
     def remove(self, ep: EndPoint, group: Any = "") -> None:
         with self._lock:
             self._map.pop((ep, group), None)
+
+    def close_endpoint(self, ep: EndPoint, group: Any = "") -> None:
+        """Fail and drop every connection held for (ep, group): client
+        teardown (Channel.close).  ECLOSE keeps the endpoint out of
+        health-check revival — this is a deliberate local close, not a
+        peer failure."""
+        with self._lock:
+            e = self._map.pop((ep, group), None)
+        if e is None:
+            return
+        with e.lock:
+            socks = list(e.pooled)
+            if e.socket is not None:
+                socks.append(e.socket)
+            e.socket = None
+            e.pooled = []
+        from . import errors
+        for s in socks:
+            try:
+                s.set_failed(errors.ECLOSE, "channel closed")
+            except Exception:
+                pass
 
     def stats(self) -> Dict[EndPoint, int]:
         with self._lock:

@@ -20,12 +20,12 @@ client parse paths.
 from __future__ import annotations
 
 import struct
-import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import List, Optional
 
 from ..butil import flags as _flags
 from ..butil.iobuf import IOBuf
+from ..butil import debug_sync as _dbg
 from ..butil.resource_pool import ResourcePool
 from ..bthread.butex import Butex
 from ..bthread.execution_queue import ExecutionQueue
@@ -79,6 +79,17 @@ class StreamInputHandler:
 
 
 class Stream:
+    # fablint guarded-state contract: flow-control counters under the
+    # flow lock, lifecycle transitions + lazy queue under the state
+    # lock, frame sequencing under the wire lock (see __init__ notes)
+    _GUARDED_BY = {
+        "_produced": "_flow_lock",
+        "_remote_consumed": "_flow_lock",
+        "_exec": "_state_lock",
+        "_sock_failed_cb": "_state_lock",
+        "_seq": "_wire_lock",
+    }
+
     def __init__(self, options: StreamOptions, is_client: bool):
         self.options = options
         self.is_client = is_client
@@ -90,7 +101,7 @@ class Stream:
         # flow control (sender side)
         self._produced = 0
         self._remote_consumed = 0
-        self._flow_lock = threading.Lock()
+        self._flow_lock = _dbg.make_lock("Stream._flow_lock")
         self._writable_butex = Butex(0)
         # receiver side
         self._local_consumed = 0
@@ -105,11 +116,11 @@ class Stream:
         # frame on the parse path) — unsynchronized check-then-act on
         # either flag double-registers callbacks or double-fires
         # on_closed (review findings)
-        self._state_lock = threading.Lock()
+        self._state_lock = _dbg.make_lock("Stream._state_lock")
         # serializes frame emission: seq assignment, the out-of-band bulk
         # post, and the control write must stay one atomic step so frame
         # k's bulk bytes can never trail frame k+1's descriptor
-        self._wire_lock = threading.Lock()
+        self._wire_lock = _dbg.make_lock("Stream._wire_lock")
         self._exec: Optional[ExecutionQueue] = None
 
     # -- sender ---------------------------------------------------------
@@ -381,7 +392,6 @@ class Stream:
 # ---- stream registry (versioned ids like SocketId) ---------------------
 
 _streams: ResourcePool = ResourcePool()
-_registry_lock = threading.Lock()
 
 
 def _pool_remove(sid: int) -> None:

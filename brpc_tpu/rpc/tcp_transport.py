@@ -10,7 +10,6 @@ connect/accept, then the wrapped socket joins the normal non-blocking loop
 """
 from __future__ import annotations
 
-import os
 import socket as pysocket
 import threading
 from typing import Callable, Optional

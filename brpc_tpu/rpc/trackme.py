@@ -33,6 +33,7 @@ class _Pinger:
         self.target = target
         self.server_addr = server_addr
         self._stop = threading.Event()
+        # fablint: thread-quiesced(stop() sets _stop; the ping loop waits on it between pings and exits promptly)
         self._thread = threading.Thread(target=self._run,
                                         name="trackme", daemon=True)
         self._thread.start()

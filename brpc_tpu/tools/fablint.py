@@ -1,0 +1,858 @@
+"""fablint: concurrency static analysis for the brpc_tpu package.
+
+The fabric is deeply concurrent (ici/fabric.py alone holds 8 locks) and
+every review pass of PRs 2-4 hand-caught the same bug classes: unguarded
+shared state, lock-order inversions, blocking calls under a held lock,
+and thread-owning objects with no quiesce path.  The reference ships
+this as doctrine plus sanitizer builds (docs/en/io.md, TSan/ASan in its
+CI); fablint is the machine-checkable half for the Python layer — the
+moral equivalent of clang's thread-safety annotations
+(``GUARDED_BY``/``EXCLUSIVE_LOCKS_REQUIRED``) for a codebase the clang
+analyzer cannot see.
+
+Passes (default command)
+------------------------
+
+``guarded-state``
+    Attributes declared in a per-class ``_GUARDED_BY = {"_attr":
+    "_lock"}`` map may only be read/written lexically inside ``with
+    <base>.<lock>:`` where ``<base>`` is the same receiver (``self``,
+    or e.g. ``peer`` for cross-object access), or inside a method
+    marked ``# fablint: lock-held(<lock>)`` (callers hold it).
+    ``__init__`` and methods marked ``# fablint: init`` are exempt
+    (object not yet shared).  Module-level names declared in
+    ``_GUARDED_BY_GLOBALS = {"_name": "_name_lock"}`` must be accessed
+    inside ``with <lock>:`` from any function in that module.
+
+``lock-order``
+    Nested ``with``-lock acquisitions are extracted per module into a
+    global acquisition graph; any cycle fails the lint.  Lock identity
+    is ``Class.attr`` for ``self``/``cls`` locks, ``module:name`` for
+    module-level locks (import aliases resolved), ``~attr`` for locks
+    reached through another object.
+
+``blocking-under-lock``
+    Calls that can block the calling thread — ``.join()``,
+    ``time.sleep``, socket ``recv``/``accept``/``connect``/
+    ``create_connection``, ``subprocess.*``, jax ``device_put``/``jit``
+    compilation, the coordination-service ``blocking_key_value_get`` —
+    are flagged when they appear lexically inside a held-lock region.
+
+``thread-hygiene``
+    Every ``threading.Thread(...)`` spawn must pass ``daemon=True``
+    AND have a quiesce path: either the thread handle is ``.join()``ed
+    somewhere in the module, or the spawn carries a ``# fablint:
+    thread-quiesced(<how>)`` marker naming its shutdown mechanism.
+    This is the exact class behind the PR 2/4 exit-race flakes (static
+    destructors racing live reader threads).
+
+Dead-code passes (``deadcode`` subcommand)
+------------------------------------------
+
+``dead-import``      imports never referenced in the module
+                     (``__init__.py`` re-export modules are skipped;
+                     ``# noqa`` honored).
+``unreachable``      statements after return/raise/break/continue, and
+                     ``if False:`` / ``while False:`` bodies.
+``dead-global``      private (``_``-prefixed) module-level assignments
+                     never read in their module and not in ``__all__``
+                     (public names may be imported elsewhere, so only
+                     private ones are provably dead).
+
+Suppressions and markers
+------------------------
+
+``# fablint: ignore[rule1,rule2] <reason>``
+    Suppresses those rules on that line.  The reason is REQUIRED —
+    a reason-less ignore is itself reported (``bad-suppression``), so
+    the accepted-findings baseline stays explicit and reviewed.
+``# fablint: lock-held(_lock)``      method runs with self._lock held
+``# fablint: init``                  constructor-path method, exempt
+``# fablint: thread-quiesced(how)``  thread has a shutdown path
+
+CLI
+---
+
+    python -m brpc_tpu.tools.fablint [paths...] [--json]
+    python -m brpc_tpu.tools.fablint deadcode [paths...] [--json]
+    python -m brpc_tpu.tools.fablint all [paths...] [--json]
+
+Exit status 1 when findings exist, 0 when clean.  Default path: the
+brpc_tpu package this module lives in.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+CONCURRENCY_RULES = ("guarded-state", "lock-order", "blocking-under-lock",
+                     "thread-hygiene", "bad-suppression")
+DEADCODE_RULES = ("dead-import", "unreachable", "dead-global")
+
+# terminal callee names that can block the calling thread (pass 3).
+# ``wait`` is deliberately absent: Condition.wait releases the lock it
+# is called under, and butex waits park the tasklet, not the lock.
+_BLOCKING_NAMES = {
+    "sleep", "recv", "recvfrom", "recv_into", "accept", "connect",
+    "create_connection", "device_put", "blocking_key_value_get",
+    "jit", "getaddrinfo", "gethostbyname",
+}
+_SUBPROCESS_NAMES = {"run", "Popen", "check_output", "check_call", "call"}
+
+_LOCKISH_RE = re.compile(r"lock|mutex", re.IGNORECASE)
+
+_DIRECTIVE_RE = re.compile(r"#\s*fablint:\s*(.*)$")
+_IGNORE_RE = re.compile(r"ignore\[([\w\-, ]+)\]\s*(.*)$")
+_LOCK_HELD_RE = re.compile(r"lock-held\(([\w, ]+)\)")
+_THREAD_QUIESCED_RE = re.compile(r"thread-quiesced\(([^)]*)\)")
+_INIT_RE = re.compile(r"\binit\b")
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class _Directives:
+    """Per-module comment directives, keyed by line number."""
+
+    def __init__(self, source: str, path: str):
+        self.ignores: Dict[int, Tuple[Set[str], str]] = {}
+        self.lock_held: Dict[int, List[str]] = {}
+        self.init_marks: Set[int] = set()
+        self.thread_quiesced: Dict[int, str] = {}
+        self.noqa: Set[int] = set()
+        self.bad: List[Tuple[int, str]] = []     # reason-less ignores etc.
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                line = tok.start[0]
+                text = tok.string
+                if "noqa" in text:
+                    self.noqa.add(line)
+                m = _DIRECTIVE_RE.search(text)
+                if not m:
+                    continue
+                body = m.group(1).strip()
+                im = _IGNORE_RE.match(body)
+                if im:
+                    rules = {r.strip() for r in im.group(1).split(",")
+                             if r.strip()}
+                    reason = im.group(2).strip()
+                    if not reason:
+                        self.bad.append(
+                            (line, "ignore[] without a reason — every "
+                                   "suppression must say why"))
+                    self.ignores[line] = (rules, reason)
+                    continue
+                lm = _LOCK_HELD_RE.match(body)
+                if lm:
+                    self.lock_held[line] = [x.strip() for x in
+                                            lm.group(1).split(",") if x.strip()]
+                    continue
+                tm = _THREAD_QUIESCED_RE.match(body)
+                if tm:
+                    self.thread_quiesced[line] = tm.group(1).strip()
+                    continue
+                if _INIT_RE.match(body):
+                    self.init_marks.add(line)
+                    continue
+                self.bad.append((line, f"unknown fablint directive: {body!r}"))
+        except tokenize.TokenError:
+            pass
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        ent = self.ignores.get(line)
+        return ent is not None and (rule in ent[0] or "all" in ent[0])
+
+    def _def_marker(self, table, node):
+        """A def-attached marker sits on the def line or the line above
+        (above a decorator counts too)."""
+        first = min([node.lineno] + [d.lineno for d in
+                    getattr(node, "decorator_list", [])])
+        for ln in (node.lineno, first - 1, node.lineno - 1):
+            if ln in table:
+                return table[ln]
+        return None
+
+    def fn_lock_held(self, node) -> List[str]:
+        return self._def_marker(self.lock_held, node) or []
+
+    def fn_is_init(self, node) -> bool:
+        first = min([node.lineno] + [d.lineno for d in
+                    getattr(node, "decorator_list", [])])
+        return bool({node.lineno, first - 1, node.lineno - 1}
+                    & self.init_marks)
+
+    def thread_marker(self, lineno: int) -> Optional[str]:
+        for ln in (lineno, lineno - 1):
+            if ln in self.thread_quiesced:
+                return self.thread_quiesced[ln]
+        return None
+
+
+def _literal_str_dict(node: ast.AST) -> Optional[Dict[str, str]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant) and isinstance(v.value, str)):
+            return None
+        out[k.value] = v.value
+    return out
+
+
+class _Held:
+    """One lexically-held lock: (receiver base name or None for a
+    module-level lock, lock name, canonical graph identity)."""
+
+    __slots__ = ("base", "name", "canonical")
+
+    def __init__(self, base: Optional[str], name: str, canonical: str):
+        self.base = base
+        self.name = name
+        self.canonical = canonical
+
+
+class ModuleLint:
+    """All passes over one module; lock-order edges are merged globally
+    by the driver."""
+
+    def __init__(self, path: str, source: str, modname: str):
+        self.path = path
+        self.source = source
+        self.modname = modname
+        self.tree = ast.parse(source, filename=path)
+        self.directives = _Directives(source, path)
+        self.findings: List[Finding] = []
+        # canonical lock id -> {canonical lock id -> (path, line)}
+        self.lock_edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        self.import_aliases = self._collect_import_aliases()
+        self.class_guards = self._collect_class_guards()
+        self.global_guards = self._collect_global_guards()
+        self._known_locks = set(self.global_guards.values())
+        for g in self.class_guards.values():
+            self._known_locks.update(g.values())
+
+    # ---- collection -----------------------------------------------------
+    def _collect_import_aliases(self) -> Dict[str, str]:
+        """Bound name -> 'resolved.module:orig' for from-imports, so a
+        module-level lock imported under an alias keeps one identity."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module is not None:
+                mod = node.module
+                if node.level:
+                    parts = self.modname.split(".")
+                    base = parts[:max(len(parts) - node.level, 0)]
+                    mod = ".".join(base + [node.module])
+                for alias in node.names:
+                    out[alias.asname or alias.name] = f"{mod}:{alias.name}"
+        return out
+
+    def _collect_class_guards(self) -> Dict[str, Dict[str, str]]:
+        out: Dict[str, Dict[str, str]] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == "_GUARDED_BY"):
+                    d = _literal_str_dict(stmt.value)
+                    if d is None:
+                        self._report("guarded-state", stmt.lineno,
+                                     "_GUARDED_BY must be a literal "
+                                     "{str: str} dict")
+                    else:
+                        out[node.name] = d
+        return out
+
+    def _collect_global_guards(self) -> Dict[str, str]:
+        for stmt in self.tree.body:
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "_GUARDED_BY_GLOBALS"):
+                d = _literal_str_dict(stmt.value)
+                if d is None:
+                    self._report("guarded-state", stmt.lineno,
+                                 "_GUARDED_BY_GLOBALS must be a literal "
+                                 "{str: str} dict")
+                    return {}
+                return d
+        return {}
+
+    # ---- reporting ------------------------------------------------------
+    def _report(self, rule: str, line: int, message: str) -> None:
+        if self.directives.suppressed(rule, line):
+            return
+        self.findings.append(Finding(rule, self.path, line, message))
+
+    # ---- lock identity --------------------------------------------------
+    def _lockish(self, expr: ast.AST) -> Optional[Tuple[Optional[str], str]]:
+        """(base name or None, lock name) when ``expr`` looks like a
+        lock; None otherwise.  Calls (``self._dbd.read()``) never are."""
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                            ast.Name):
+            name = expr.attr
+        else:
+            return None
+        if not (_LOCKISH_RE.search(name) or name in self._known_locks):
+            return None
+        if isinstance(expr, ast.Name):
+            return (None, name)
+        return (expr.value.id, name)
+
+    def _canonical(self, base: Optional[str], name: str,
+                   class_name: Optional[str]) -> str:
+        if base is None:
+            return self.import_aliases.get(name, f"{self.modname}:{name}")
+        if base in ("self", "cls") and class_name:
+            return f"{class_name}.{name}"
+        return f"~{name}"
+
+    # ---- the concurrency walk -------------------------------------------
+    def run_concurrency(self) -> None:
+        for line, msg in self.directives.bad:
+            self.findings.append(
+                Finding("bad-suppression", self.path, line, msg))
+        self._walk_body(self.tree.body, held=[], class_name=None,
+                        fn_node=None, guard_exempt=True)
+
+    def _walk_body(self, body, held, class_name, fn_node, guard_exempt):
+        for stmt in body:
+            self._walk_stmt(stmt, held, class_name, fn_node, guard_exempt)
+
+    def _walk_stmt(self, node, held, class_name, fn_node, guard_exempt):
+        if isinstance(node, ast.ClassDef):
+            # class body executes at import (single-threaded): exempt
+            self._walk_body(node.body, [], node.name, None, True)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs LATER: locks lexically held around it
+            # are not held when it executes — reset the held set
+            seeded: List[_Held] = []
+            for lock in self.directives.fn_lock_held(node):
+                seeded.append(_Held("self", lock,
+                                    self._canonical("self", lock,
+                                                    class_name)))
+            exempt = (class_name is not None and fn_node is None
+                      and node.name == "__init__") \
+                or self.directives.fn_is_init(node)
+            self._walk_body(node.body, seeded, class_name, node, exempt)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in node.items:
+                lk = self._lockish(item.context_expr)
+                if lk is None:
+                    self._visit_exprs(item.context_expr, held, class_name,
+                                      fn_node, guard_exempt)
+                    continue
+                base, name = lk
+                canon = self._canonical(base, name, class_name)
+                if held and not self.directives.suppressed(
+                        "lock-order", node.lineno):
+                    outer = held[-1].canonical
+                    if outer != canon:
+                        self.lock_edges.setdefault(outer, {}) \
+                            .setdefault(canon, (self.path, node.lineno))
+                held.append(_Held(base, name, canon))
+                pushed += 1
+            self._walk_body(node.body, held, class_name, fn_node,
+                            guard_exempt)
+            for _ in range(pushed):
+                held.pop()
+            return
+        # generic statement: visit expressions, recurse into sub-bodies
+        for field in ("test", "iter", "value", "targets", "target", "exc",
+                      "cause", "msg", "items", "subject"):
+            sub = getattr(node, field, None)
+            if sub is None:
+                continue
+            for expr in (sub if isinstance(sub, list) else [sub]):
+                if isinstance(expr, ast.AST):
+                    self._visit_exprs(expr, held, class_name, fn_node,
+                                      guard_exempt)
+        for field in ("body", "orelse", "finalbody", "handlers", "cases"):
+            sub = getattr(node, field, None)
+            if not sub:
+                continue
+            for child in sub:
+                if isinstance(child, (ast.ExceptHandler, ast.match_case)):
+                    self._walk_body(child.body, held, class_name, fn_node,
+                                    guard_exempt)
+                elif isinstance(child, ast.AST):
+                    self._walk_stmt(child, held, class_name, fn_node,
+                                    guard_exempt)
+
+    def _visit_exprs(self, expr, held, class_name, fn_node, guard_exempt):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute):
+                self._check_attr_access(node, held, class_name, fn_node,
+                                        guard_exempt)
+            elif isinstance(node, ast.Name):
+                self._check_global_access(node, held, fn_node, guard_exempt)
+            elif isinstance(node, ast.Call):
+                self._check_blocking(node, held)
+                self._check_thread_spawn(node)
+            elif isinstance(node, (ast.Lambda,)):
+                pass        # lambdas run later; their bodies are tiny and
+                # attribute checks inside would be against a reset held
+                # set — handled conservatively by not descending
+                # (ast.walk descends anyway; accesses in lambdas are
+                # checked against the ENCLOSING held set, a known
+                # imprecision kept for simplicity)
+
+    # ---- pass 1: guarded state -----------------------------------------
+    def _check_attr_access(self, node: ast.Attribute, held, class_name,
+                           fn_node, guard_exempt) -> None:
+        if guard_exempt or class_name is None or fn_node is None:
+            return
+        guards = self.class_guards.get(class_name)
+        if not guards or node.attr not in guards:
+            return
+        if not isinstance(node.value, ast.Name):
+            return
+        base = node.value.id
+        need = guards[node.attr]
+        for h in held:
+            # a held module-level lock of the declared name also
+            # satisfies (instance state guarded by a registry lock —
+            # the health-check pattern)
+            if h.name == need and (h.base == base or h.base is None):
+                return
+        if base == "self":
+            if need in self.directives.fn_lock_held(fn_node):
+                return
+        self._report(
+            "guarded-state", node.lineno,
+            f"{class_name}.{fn_node.name}: access to {base}.{node.attr} "
+            f"outside 'with {base}.{need}:' (declared in _GUARDED_BY)")
+
+    def _check_global_access(self, node: ast.Name, held, fn_node,
+                             guard_exempt) -> None:
+        if guard_exempt or fn_node is None:
+            return
+        need = self.global_guards.get(node.id)
+        if need is None:
+            return
+        for h in held:
+            if h.base is None and h.name == need:
+                return
+        if need in self.directives.fn_lock_held(fn_node):
+            return
+        self._report(
+            "guarded-state", node.lineno,
+            f"{fn_node.name}: access to module global {node.id} outside "
+            f"'with {need}:' (declared in _GUARDED_BY_GLOBALS)")
+
+    # ---- pass 3: blocking under lock -----------------------------------
+    def _check_blocking(self, node: ast.Call, held) -> None:
+        if not held:
+            return
+        func = node.func
+        name = None
+        base = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+            base = func.value
+        if name is None:
+            return
+        blocking = False
+        if name in _BLOCKING_NAMES:
+            blocking = True
+        elif name in _SUBPROCESS_NAMES and isinstance(base, ast.Name) \
+                and base.id == "subprocess":
+            blocking = True
+        elif name == "join":
+            # distinguish thread.join(timeout?) from str.join(iterable):
+            # a str/bytes receiver, or a single non-numeric argument,
+            # is string joining
+            if isinstance(base, ast.Constant) and isinstance(
+                    base.value, (str, bytes)):
+                blocking = False
+            elif len(node.args) == 0 and not node.keywords:
+                blocking = True
+            elif (len(node.args) == 1 and not node.keywords
+                  and isinstance(node.args[0], ast.Constant)
+                  and isinstance(node.args[0].value, (int, float))):
+                blocking = True
+            elif any(kw.arg == "timeout" for kw in node.keywords):
+                blocking = True
+        if not blocking:
+            return
+        locks = ", ".join(h.name for h in held)
+        self._report(
+            "blocking-under-lock", node.lineno,
+            f"call to blocking '{name}' while holding {locks}")
+
+    # ---- pass 4: thread hygiene ----------------------------------------
+    def _check_thread_spawn(self, node: ast.Call) -> None:
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name != "Thread":
+            return
+        daemon = None
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = kw.value.value
+        joined = self._thread_provably_joined(node)
+        if daemon is not True and not joined:
+            # a thread that is synchronously joined may be non-daemon
+            # (CLI worker fan-outs); anything else must not block exit
+            self._report(
+                "thread-hygiene", node.lineno,
+                "threading.Thread spawned without daemon=True and not "
+                "provably joined — a non-daemon thread blocks "
+                "interpreter exit and races static teardown")
+        if joined or self.directives.thread_marker(node.lineno):
+            return
+        self._report(
+            "thread-hygiene", node.lineno,
+            "thread has no visible quiesce path: no .join() on its "
+            "handle in this module and no '# fablint: "
+            "thread-quiesced(<how>)' marker")
+
+    def _thread_provably_joined(self, node: ast.Call) -> bool:
+        """Some name transitively holding the spawned thread is
+        .join()ed somewhere in this module.  Aliases are chased through
+        assignments (``t = Thread(...)``, ``self._r = t``, ``r, self._r
+        = self._r, None``) and for-loops over a holding list (``for t
+        in threads: t.join()``) — a weak but honest lexical proof."""
+
+        def tname(t):
+            if isinstance(t, ast.Name):
+                return t.id
+            if isinstance(t, ast.Attribute):
+                return t.attr
+            return None
+
+        assigns = [n for n in ast.walk(self.tree)
+                   if isinstance(n, ast.Assign)]
+        names: Set[str] = set()
+        for a in assigns:
+            if any(sub is node for sub in ast.walk(a.value)):
+                for t in a.targets:
+                    n = tname(t)
+                    if n:
+                        names.add(n)
+        if not names:
+            return False
+        changed = True
+        while changed:
+            changed = False
+            for a in assigns:
+                if (len(a.targets) == 1
+                        and isinstance(a.targets[0], ast.Tuple)
+                        and isinstance(a.value, ast.Tuple)
+                        and len(a.targets[0].elts) == len(a.value.elts)):
+                    pairs = list(zip(a.targets[0].elts, a.value.elts))
+                else:
+                    pairs = [(t, a.value) for t in a.targets]
+                for t, v in pairs:
+                    vn = tname(v) if isinstance(
+                        v, (ast.Name, ast.Attribute)) else None
+                    tn = tname(t)
+                    if vn in names and tn and tn not in names:
+                        names.add(tn)
+                        changed = True
+            for n in ast.walk(self.tree):
+                if isinstance(n, ast.For) and isinstance(n.iter, ast.Name) \
+                        and n.iter.id in names \
+                        and isinstance(n.target, ast.Name) \
+                        and n.target.id not in names:
+                    names.add(n.target.id)
+                    changed = True
+        return any(
+            re.search(r"\b%s\s*\.\s*join\s*\(" % re.escape(nm), self.source)
+            for nm in names)
+
+    # ---- dead-code passes ----------------------------------------------
+    def run_deadcode(self) -> None:
+        self._dead_imports()
+        self._unreachable()
+        self._dead_globals()
+
+    def _used_names(self) -> Set[str]:
+        used: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                # x.y.z — count the root name (handled by Name Load) and
+                # string re-exports via __all__ below
+                pass
+        for stmt in self.tree.body:
+            if (isinstance(stmt, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in stmt.targets)
+                    and isinstance(stmt.value, (ast.List, ast.Tuple))):
+                for elt in stmt.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str):
+                        used.add(elt.value)
+        return used
+
+    def _dead_imports(self) -> None:
+        if os.path.basename(self.path) == "__init__.py":
+            return              # re-export modules: imports ARE the API
+        used = self._used_names()
+        for node in ast.walk(self.tree):
+            aliases = []
+            if isinstance(node, ast.Import):
+                aliases = node.names
+            elif isinstance(node, ast.ImportFrom):
+                aliases = node.names
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "__future__":
+                continue
+            for alias in aliases:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name.split(".")[0]
+                if bound in used:
+                    continue
+                if node.lineno in self.directives.noqa:
+                    continue
+                self._report("dead-import", node.lineno,
+                             f"'{bound}' imported but never used")
+
+    def _unreachable(self) -> None:
+        for node in ast.walk(self.tree):
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(node, field, None)
+                if not isinstance(body, list):
+                    continue
+                terminated = False
+                for stmt in body:
+                    if terminated:
+                        self._report("unreachable", stmt.lineno,
+                                     "statement is unreachable (follows "
+                                     "return/raise/break/continue)")
+                        break
+                    if isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                                         ast.Continue)):
+                        terminated = True
+            if isinstance(node, (ast.If, ast.While)) and isinstance(
+                    node.test, ast.Constant) and node.test.value is False:
+                self._report("unreachable", node.lineno,
+                             "branch condition is literally False")
+
+    def _dead_globals(self) -> None:
+        used = self._used_names()
+        stores: Dict[str, int] = {}
+        for stmt in self.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    stores.setdefault(t.id, stmt.lineno)
+        for name, line in sorted(stores.items(), key=lambda kv: kv[1]):
+            if not name.startswith("_") or name.startswith("__"):
+                continue        # public names may be imported elsewhere
+            if name in used or name in ("_GUARDED_BY_GLOBALS",):
+                continue
+            if line in self.directives.noqa:
+                continue
+            self._report("dead-global", line,
+                         f"module-level private name '{name}' is written "
+                         f"but never read in this module")
+
+
+# ---- driver -------------------------------------------------------------
+
+def _iter_py_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for f in sorted(files):
+                    if f.endswith(".py") and not f.endswith("_pb2.py"):
+                        out.append(os.path.join(root, f))   # _pb2: generated
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def _modname_for(path: str) -> str:
+    """Dotted module name: walk up while __init__.py exists."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    name = ".".join(reversed(parts))
+    return name[:-len(".__init__")] if name.endswith(".__init__") else name
+
+
+def _find_cycles(graph: Dict[str, Dict[str, Tuple[str, int]]]
+                 ) -> List[List[str]]:
+    """Cycles in the acquisition digraph (one representative per SCC
+    with a cycle), via iterative Tarjan."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v0: str) -> None:
+        work = [(v0, iter(graph.get(v0, ())))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(graph.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1 or v in graph.get(v, ()):
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def run(paths: List[str], rules: Tuple[str, ...]) -> List[Finding]:
+    findings: List[Finding] = []
+    edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    want_conc = any(r in rules for r in CONCURRENCY_RULES)
+    want_dead = any(r in rules for r in DEADCODE_RULES)
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            lint = ModuleLint(path, source, _modname_for(path))
+        except SyntaxError as e:
+            findings.append(Finding("parse-error", path, e.lineno or 0,
+                                    str(e)))
+            continue
+        if want_conc:
+            lint.run_concurrency()
+        if want_dead:
+            lint.run_deadcode()
+        findings.extend(f for f in lint.findings if f.rule in rules
+                        or f.rule == "parse-error")
+        for src, dsts in lint.lock_edges.items():
+            for dst, loc in dsts.items():
+                edges.setdefault(src, {}).setdefault(dst, loc)
+    if "lock-order" in rules:
+        for comp in _find_cycles(edges):
+            locs = []
+            for a in comp:
+                for b, (p, ln) in edges.get(a, {}).items():
+                    if b in comp:
+                        locs.append(f"{a} -> {b} at {p}:{ln}")
+            first = edges[comp[0]]
+            path0, line0 = next(iter(first.values()))
+            findings.append(Finding(
+                "lock-order", path0, line0,
+                "lock acquisition cycle: " + "; ".join(sorted(locs))))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lock_order_edges(paths: List[str]
+                     ) -> Dict[str, Dict[str, Tuple[str, int]]]:
+    """The extracted acquisition graph (docs/CONCURRENCY.md generator)."""
+    edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            lint = ModuleLint(path, source, _modname_for(path))
+        except SyntaxError:
+            continue
+        lint.run_concurrency()
+        for src, dsts in lint.lock_edges.items():
+            for dst, loc in dsts.items():
+                edges.setdefault(src, {}).setdefault(dst, loc)
+    return edges
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    cmd = "check"
+    if argv and argv[0] in ("check", "deadcode", "all"):
+        cmd = argv.pop(0)
+    paths = argv or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    rules = {"check": CONCURRENCY_RULES,
+             "deadcode": DEADCODE_RULES,
+             "all": CONCURRENCY_RULES + DEADCODE_RULES}[cmd]
+    findings = run(paths, rules)
+    if as_json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        print(f"fablint: {len(findings)} finding(s) "
+              f"[{cmd}] over {len(_iter_py_files(paths))} file(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

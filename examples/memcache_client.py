@@ -84,6 +84,10 @@ def main() -> None:
         print("memcache -> set ok, get:", resp.op(1).value,
               "miss status:", resp.op(2).status)
     finally:
+        try:
+            ch.close()
+        except NameError:
+            pass
         mem_unlisten("memcache-example")
 
 

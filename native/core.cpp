@@ -35,6 +35,24 @@
 #include <queue>
 #include <thread>
 #include <ucontext.h>
+
+#include "tsan_compat.h"
+
+// ThreadSanitizer cannot follow ucontext stack switches: this image's
+// libtsan (GCC 10) SEGVs inside its swapcontext interceptor when a
+// fiber runs on a non-main thread, even through the documented
+// __tsan_switch_to_fiber API (probed with a 30-line repro).  Under
+// -fsanitize=thread fibers therefore run INLINE on their worker
+// thread: every lock TSan can actually check — run queues, stealing,
+// the parking lot, the resource pool, butex wake — is exercised
+// identically; only the stack switch itself is elided (and yield()
+// becomes a no-op, nothing in-tree uses it).  Production builds are
+// untouched.
+#if defined(__SANITIZE_THREAD__)
+#define NBASE_TSAN_INLINE_FIBERS 1
+#else
+#define NBASE_TSAN_INLINE_FIBERS 0
+#endif
 #include <unistd.h>
 #include <vector>
 
@@ -52,11 +70,38 @@ namespace core {
 
 struct PoolSlot {
   std::atomic<uint32_t> version{1};  // odd = free was never...: start 1 live? see get()
-  void* payload{nullptr};
+  // atomic: address() reads payload after its version check, and a
+  // concurrent put() can revoke between the check and the read (the
+  // sanctioned stale-read window of wait-free address); the value is
+  // then either the old payload or nullptr, never a torn pointer
+  std::atomic<void*> payload{nullptr};
 };
 
 class ResourcePool {
+  // Slot storage is CHUNKED with stable addresses: address() is
+  // wait-free (the whole point of versioned ids), so the backing store
+  // may never relocate under it.  The old flat std::vector reallocated
+  // on growth while concurrent address() calls walked it — a genuine
+  // use-after-free window, found by `make tsan` (TSan data race on the
+  // vector's data pointer) once the butex cv-wait false positive was
+  // routed around.  Chunks are allocated once, published with a
+  // release store, and never freed until the pool dies.
+  static constexpr uint32_t kChunkShift = 12;            // 4096 slots
+  static constexpr uint32_t kChunkSlots = 1u << kChunkShift;
+  static constexpr uint32_t kMaxChunks = 1u << 12;       // 16M slots cap
+
  public:
+  ResourcePool() {
+    for (auto& c : chunks_) c.store(nullptr, std::memory_order_relaxed);
+  }
+
+  ~ResourcePool() {
+    for (auto& c : chunks_) {
+      PoolSlot* chunk = c.load(std::memory_order_acquire);
+      delete[] chunk;
+    }
+  }
+
   uint64_t get(void* payload) {
     uint32_t slot;
     {
@@ -65,12 +110,20 @@ class ResourcePool {
         slot = free_.back();
         free_.pop_back();
       } else {
-        slot = (uint32_t)slots_.size();
-        slots_.push_back(new PoolSlot());
+        slot = size_.load(std::memory_order_relaxed);
+        uint32_t ci = slot >> kChunkShift;
+        if (ci >= kMaxChunks) return 0;      // pool exhausted
+        if (chunks_[ci].load(std::memory_order_relaxed) == nullptr) {
+          // publish a fully-constructed chunk before size_ can admit
+          // readers into it
+          chunks_[ci].store(new PoolSlot[kChunkSlots],
+                            std::memory_order_release);
+        }
+        size_.store(slot + 1, std::memory_order_release);
       }
     }
-    PoolSlot* s = slots_[slot];
-    s->payload = payload;
+    PoolSlot* s = slot_at(slot);
+    s->payload.store(payload, std::memory_order_relaxed);
     uint32_t v = s->version.load(std::memory_order_relaxed) | 1u;  // live
     s->version.store(v, std::memory_order_release);
     return ((uint64_t)v << 32) | slot;
@@ -79,22 +132,22 @@ class ResourcePool {
   void* address(uint64_t id) const {
     uint32_t slot = (uint32_t)id;
     uint32_t ver = (uint32_t)(id >> 32);
-    if (slot >= slots_.size()) return nullptr;
-    PoolSlot* s = slots_[slot];
+    if (slot >= size_.load(std::memory_order_acquire)) return nullptr;
+    PoolSlot* s = slot_at(slot);
     if (s->version.load(std::memory_order_acquire) != ver) return nullptr;
-    return s->payload;
+    return s->payload.load(std::memory_order_acquire);
   }
 
   bool put(uint64_t id) {
     uint32_t slot = (uint32_t)id;
     uint32_t ver = (uint32_t)(id >> 32);
-    if (slot >= slots_.size()) return false;
-    PoolSlot* s = slots_[slot];
+    if (slot >= size_.load(std::memory_order_acquire)) return false;
+    PoolSlot* s = slot_at(slot);
     uint32_t cur = s->version.load(std::memory_order_acquire);
     if (cur != ver) return false;
     // bump to even (revoked), then next get() re-odds it: old ids dead
     if (!s->version.compare_exchange_strong(cur, ver + 1)) return false;
-    s->payload = nullptr;
+    s->payload.store(nullptr, std::memory_order_release);
     std::lock_guard<std::mutex> g(mu_);
     free_.push_back(slot);
     return true;
@@ -102,12 +155,19 @@ class ResourcePool {
 
   size_t live() const {
     std::lock_guard<std::mutex> g(mu_);
-    return slots_.size() - free_.size();
+    return size_.load(std::memory_order_relaxed) - free_.size();
   }
 
  private:
+  PoolSlot* slot_at(uint32_t slot) const {
+    PoolSlot* chunk =
+        chunks_[slot >> kChunkShift].load(std::memory_order_acquire);
+    return &chunk[slot & (kChunkSlots - 1)];
+  }
+
   mutable std::mutex mu_;
-  std::vector<PoolSlot*> slots_;
+  std::atomic<uint32_t> size_{0};
+  std::atomic<PoolSlot*> chunks_[kMaxChunks];
   std::vector<uint32_t> free_;
 };
 
@@ -136,8 +196,9 @@ class Butex {
     if (timeout_us < 0) {
       cv_.wait(lk, [&] { return value_.load() != expected; });
     } else {
-      ok = cv_.wait_for(lk, std::chrono::microseconds(timeout_us),
-                        [&] { return value_.load() != expected; });
+      ok = nbase::cv_wait_for(cv_, lk,
+                              std::chrono::microseconds(timeout_us),
+                              [&] { return value_.load() != expected; });
     }
     --waiters_;
     return ok ? 0 : ETIMEDOUT;
@@ -166,7 +227,13 @@ class Butex {
 // Fiber scheduler: ucontext M:N over pthread workers.
 // ====================================================================
 
+#if defined(__SANITIZE_THREAD__) || defined(__SANITIZE_ADDRESS__)
+// sanitizer instrumentation fattens every frame (shadow slots, redzone
+// spills); the production stack size overflows under it
+constexpr size_t kFiberStackSize = 1024 * 1024;
+#else
 constexpr size_t kFiberStackSize = 256 * 1024;
+#endif
 
 // mmap'd stack with a PROT_NONE guard page at the low end (stacks grow
 // down), the reference's bthread/stack.cpp FLAGS_guard_page_size
@@ -200,6 +267,11 @@ struct Fiber {
   std::atomic<int> state{0};  // 0 ready, 1 running, 2 done
   Butex done{0};
   uint64_t id{0};
+  // false until the first dispatch builds the context; a YIELDED fiber
+  // must be resumed via its saved ucontext, not restarted from the
+  // trampoline (re-running makecontext on every pop silently restarted
+  // yielded fibers from the top — sanitizer-wiring review finding)
+  bool started{false};
 };
 
 class Scheduler {
@@ -247,6 +319,7 @@ class Scheduler {
     f->fn = fn;
     f->arg = arg;
     f->state.store(0, std::memory_order_relaxed);
+    f->started = false;
     f->done.set(0);
     f->id = pool_.get(f);
     fibers_spawned_.fetch_add(1, std::memory_order_relaxed);
@@ -355,7 +428,18 @@ void Scheduler::trampoline() {
   Fiber* f = g_tls_fiber;
   f->fn(f->arg);
   f->state.store(2, std::memory_order_release);
-  // return → uc_link (worker main context)
+#if NBASE_TSAN_INLINE_FIBERS
+  // inline mode: trampoline was a plain call — just return to the
+  // worker loop
+  return;
+#else
+  // do NOT fall through to uc_link: glibc bakes the uc_link POINTER
+  // into the fiber's stack at makecontext time, so a fiber that
+  // yielded on worker A and was STOLEN+resumed by worker B would
+  // return into A's main context while A is live on it (review
+  // finding).  Jump explicitly to whichever worker carries us NOW.
+  setcontext(&g_tls_worker->main_ctx);
+#endif
 }
 
 void Scheduler::worker_main(int index) {
@@ -369,15 +453,28 @@ void Scheduler::worker_main(int index) {
       park_.wait(seen, 10 * 1000);
       continue;
     }
-    // run fiber to completion or first yield-back
-    getcontext(&f->ctx);
-    f->ctx.uc_stack.ss_sp = f->stack;
-    f->ctx.uc_stack.ss_size = kFiberStackSize;
-    f->ctx.uc_link = &w->main_ctx;
     g_tls_fiber = f;
     w->current = f;
-    makecontext(&f->ctx, (void (*)())trampoline, 0);
+#if NBASE_TSAN_INLINE_FIBERS
+    // see the NBASE_TSAN_INLINE_FIBERS rationale at the top of file
+    f->started = true;
+    trampoline();
+#else
+    // run fiber to completion or first yield-back.  A fresh fiber gets
+    // its context built here; a yielded one resumes from the ucontext
+    // its yield() saved (rebuilding it would restart the body)
+    if (!f->started) {
+      f->started = true;
+      getcontext(&f->ctx);
+      f->ctx.uc_stack.ss_sp = f->stack;
+      f->ctx.uc_stack.ss_size = kFiberStackSize;
+      f->ctx.uc_link = &w->main_ctx;
+      makecontext(&f->ctx, (void (*)())trampoline, 0);
+    }
+    // no uc_link fixup on resume: completion returns via the explicit
+    // setcontext in trampoline(), which targets the CURRENT carrier
     swapcontext(&w->main_ctx, &f->ctx);
+#endif
     w->current = nullptr;
     g_tls_fiber = nullptr;
     if (f->state.load(std::memory_order_acquire) == 2) {
@@ -395,10 +492,14 @@ void Scheduler::worker_main(int index) {
 }
 
 void Scheduler::yield() {
+#if NBASE_TSAN_INLINE_FIBERS
+  return;        // inline fibers run to completion (see top of file)
+#else
   Worker* w = g_tls_worker;
   Fiber* f = g_tls_fiber;
   if (w == nullptr || f == nullptr) return;
   swapcontext(&f->ctx, &w->main_ctx);
+#endif
 }
 
 // ====================================================================
@@ -408,23 +509,49 @@ void Scheduler::yield() {
 // ====================================================================
 
 struct WriteNode {
-  WriteNode* next;
+  std::atomic<WriteNode*> next;
   void* data;
   size_t len;
 };
 
 class MpscWriteQueue {
+  // A node is PUBLISHED by head_.exchange before its backward link is
+  // written; consumers walking the chain in that window used to read
+  // next==nullptr and silently truncate everything older (dropped
+  // writes + leaked nodes — review finding; atomics-only lost-update,
+  // invisible to TSan).  The Vyukov-style fix: nodes publish with a
+  // sentinel next, and walkers SPIN the short store-buffer window
+  // until the producer links the real value (nullptr for the oldest).
+  static WriteNode* unlinked() { return reinterpret_cast<WriteNode*>(1); }
+
+  static WriteNode* next_of(WriteNode* n) {
+    WriteNode* nx;
+    while ((nx = n->next.load(std::memory_order_acquire)) == unlinked()) {
+      // producer between exchange and link: nanoseconds
+    }
+    return nx;
+  }
+
  public:
+  ~MpscWriteQueue() {
+    // free any nodes still chained (destroyed while non-empty)
+    WriteNode* chain = head_.exchange(nullptr, std::memory_order_acq_rel);
+    while (chain) {
+      WriteNode* nx = next_of(chain);
+      delete chain;
+      chain = nx;
+    }
+  }
+
   // returns true if the caller became the writer
   bool push(void* data, size_t len) {
-    WriteNode* n = new WriteNode{nullptr, data, len};
+    WriteNode* n = new WriteNode{{unlinked()}, data, len};
     WriteNode* prev = head_.exchange(n, std::memory_order_acq_rel);
-    if (prev == nullptr) {
-      return true;  // queue was empty: caller is now the writer
-    }
-    // link backward; drain() reverses
-    n->next = prev;
-    return false;
+    // link backward (nullptr when we are the oldest); drain() reverses.
+    // The store releases the sentinel AFTER publication, closing the
+    // truncation window.
+    n->next.store(prev, std::memory_order_release);
+    return prev == nullptr;  // queue was empty: caller is now the writer
   }
 
   // drain everything currently queued, FIFO; returns count.
@@ -437,14 +564,14 @@ class MpscWriteQueue {
       // reverse LIFO chain → FIFO
       WriteNode* fifo = nullptr;
       while (chain) {
-        WriteNode* nx = chain->next;
-        chain->next = fifo;
+        WriteNode* nx = next_of(chain);
+        chain->next.store(fifo, std::memory_order_relaxed);
         fifo = chain;
         chain = nx;
       }
       while (fifo) {
         sink(fifo->data, fifo->len, sink_arg);
-        WriteNode* nx = fifo->next;
+        WriteNode* nx = fifo->next.load(std::memory_order_relaxed);
         delete fifo;
         fifo = nx;
         ++count;
@@ -512,8 +639,12 @@ class BlockPool {
 class TimerThread {
  public:
   static TimerThread& inst() {
-    static TimerThread t;
-    return t;
+    // leaked singleton (same lifetime model as Scheduler::inst): the
+    // run() thread is detached, and a static destructor tearing down
+    // mu_/heap_ under it is exactly the exit-race class — caught as a
+    // real `make tsan` finding (destructor vs run() data race)
+    static TimerThread* t = new TimerThread();
+    return *t;
   }
 
   uint64_t schedule(void (*fn)(void*), void* arg, int64_t delay_us) {
@@ -571,13 +702,14 @@ class TimerThread {
     std::unique_lock<std::mutex> lk(mu_);
     for (;;) {
       if (heap_.empty()) {
-        cv_.wait_for(lk, std::chrono::milliseconds(100));
+        nbase::cv_wait_for(cv_, lk, std::chrono::milliseconds(100));
         continue;
       }
       Entry e = heap_.top();
       int64_t now = now_us();
       if (e.when > now) {
-        cv_.wait_for(lk, std::chrono::microseconds(e.when - now));
+        nbase::cv_wait_for(cv_, lk,
+                           std::chrono::microseconds(e.when - now));
         continue;
       }
       heap_.pop();

@@ -13,11 +13,13 @@
 
 extern "C" {
 void* brpc_tpu_pool_new();
+void brpc_tpu_pool_delete(void*);
 uint64_t brpc_tpu_pool_get(void*, void*);
 void* brpc_tpu_pool_address(void*, uint64_t);
 int brpc_tpu_pool_put(void*, uint64_t);
 uint64_t brpc_tpu_pool_live(void*);
 void* brpc_tpu_butex_new(int32_t);
+void brpc_tpu_butex_delete(void*);
 int brpc_tpu_butex_wait(void*, int32_t, int64_t);
 void brpc_tpu_butex_set_wake_all(void*, int32_t);
 int32_t brpc_tpu_butex_value(void*);
@@ -25,11 +27,14 @@ void brpc_tpu_sched_start(int);
 uint64_t brpc_tpu_sched_spawn(void (*)(void*), void*, int);
 int brpc_tpu_sched_join(uint64_t, int64_t);
 uint64_t brpc_tpu_sched_spawned();
+void brpc_tpu_sched_yield();
 uint64_t brpc_tpu_sched_completed();
 void* brpc_tpu_mpsc_new();
+void brpc_tpu_mpsc_delete(void*);
 int brpc_tpu_mpsc_push(void*, void*, uint64_t);
 uint64_t brpc_tpu_mpsc_drain(void*, void (*)(void*, size_t, void*), void*);
 void* brpc_tpu_blockpool_new(uint64_t, uint64_t);
+void brpc_tpu_blockpool_delete(void*);
 void* brpc_tpu_blockpool_alloc(void*);
 int brpc_tpu_blockpool_release(void*, void*);
 uint64_t brpc_tpu_blockpool_free_count(void*);
@@ -38,6 +43,13 @@ int brpc_tpu_timer_unschedule(uint64_t);
 }
 
 static std::atomic<int> g_counter{0};
+static std::atomic<int> g_yield_steps{0};
+
+static void yielding_fn(void*) {
+  g_yield_steps.fetch_add(1);
+  brpc_tpu_sched_yield();
+  g_yield_steps.fetch_add(1);
+}
 
 static void bump(void* arg) { g_counter.fetch_add((int)(intptr_t)arg); }
 
@@ -60,6 +72,7 @@ int main() {
   assert((uint32_t)id2 == (uint32_t)id);      // slot reused
   assert(id2 != id);                          // version differs
   assert(brpc_tpu_pool_address(pool, id) == nullptr);
+  brpc_tpu_pool_delete(pool);
   printf("pool ok\n");
 
   // butex
@@ -71,6 +84,7 @@ int main() {
   assert(brpc_tpu_butex_wait(bx, 0, 5000000) == 0);
   waker.join();
   assert(brpc_tpu_butex_wait(bx, 0, 1000) == EWOULDBLOCK);
+  brpc_tpu_butex_delete(bx);
   printf("butex ok\n");
 
   // scheduler: 4 workers, 200 fibers
@@ -88,6 +102,18 @@ int main() {
   printf("scheduler ok (spawned=%llu)\n",
          (unsigned long long)brpc_tpu_sched_spawned());
 
+  // yielded fibers RESUME from the yield point, never restart from the
+  // trampoline (the makecontext-on-every-pop bug found in the
+  // sanitizer-wiring sweep: a restarted fiber re-ran its first half and
+  // yielded forever).  Under TSan's inline-fiber mode yield is a no-op
+  // and the count is identical.
+  g_yield_steps.store(0);
+  uint64_t yid = brpc_tpu_sched_spawn(yielding_fn, nullptr, 0);
+  brpc_tpu_sched_join(yid, 5 * 1000 * 1000);
+  for (int i = 0; i < 2000 && g_yield_steps.load() < 2; ++i) usleep(1000);
+  assert(g_yield_steps.load() == 2);
+  printf("yield resume ok\n");
+
   // mpsc: concurrent producers, exactly-once FIFO-per-producer drain
   void* q = brpc_tpu_mpsc_new();
   std::atomic<int> writers{0};
@@ -104,6 +130,7 @@ int main() {
   uint64_t n = brpc_tpu_mpsc_drain(q, sink, &drained);
   assert(n == 400);
   assert(became_writer.load() >= 1);
+  brpc_tpu_mpsc_delete(q);
   printf("mpsc ok (writers=%d)\n", became_writer.load());
 
   // block pool
@@ -117,6 +144,7 @@ int main() {
   assert(brpc_tpu_blockpool_alloc(bp) == nullptr);  // exhausted
   for (int i = 0; i < 8; ++i) assert(brpc_tpu_blockpool_release(bp, blocks[i]));
   assert(brpc_tpu_blockpool_free_count(bp) == 8);
+  brpc_tpu_blockpool_delete(bp);
   printf("blockpool ok\n");
 
   // timer

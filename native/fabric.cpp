@@ -33,6 +33,8 @@
 // brpc_tpu_fab_accept(key) claims it — the fabric's control-channel
 // HELLO carries the same key, binding control and bulk planes together
 // (the GID/QPN exchange of rdma_endpoint.h:37).
+#include "tsan_compat.h"
+
 #include <arpa/inet.h>
 #include <netdb.h>
 #include <netinet/in.h>
@@ -369,7 +371,8 @@ struct BulkConn {
       }
       if (dead) return -2;
       if (timeout_us >= 0) {
-        if (cv.wait_until(lk, deadline) == std::cv_status::timeout &&
+        if (nbase::cv_wait_until(cv, lk, deadline)
+                == std::cv_status::timeout &&
             frames.seek(uuid) == nullptr && !dead)
           return -1;
       } else {
@@ -487,7 +490,8 @@ struct Listener {
         return c;
       }
       if (stopped) return nullptr;
-      if (cv.wait_until(lk, deadline) == std::cv_status::timeout)
+      if (nbase::cv_wait_until(cv, lk, deadline)
+              == std::cv_status::timeout)
         return nullptr;
     }
   }

@@ -18,6 +18,8 @@
 //
 // Build: compiled into libbrpc_tpu_core.so (see native/Makefile).
 
+#include "tsan_compat.h"
+
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -951,7 +953,7 @@ class NativeChannel : public std::enable_shared_from_this<NativeChannel> {
         }
       } else {
         std::unique_lock<std::mutex> sl(slot->mu);
-        slot->cv.wait_for(sl, std::chrono::milliseconds(1));
+        nbase::cv_wait_for(slot->cv, sl, std::chrono::milliseconds(1));
         if (slot->done) break;
       }
       if (std::chrono::steady_clock::now() >= deadline) {
@@ -1761,7 +1763,8 @@ static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
         *err_text = "ici peer closed while window full";
         return 1009;
       }
-      if (conn->wcv.wait_until(g, deadline) == std::cv_status::timeout) {
+      if (nbase::cv_wait_until(conn->wcv, g, deadline)
+              == std::cv_status::timeout) {
         g.unlock();
         ch->erase_slot(cid);
         ici_release_segs(segs);
@@ -1801,7 +1804,8 @@ static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
   if (!slot->done.load(std::memory_order_acquire)) {
     std::unique_lock<std::mutex> g(slot->mu);
     while (!slot->done.load(std::memory_order_acquire)) {
-      if (slot->cv.wait_until(g, deadline) == std::cv_status::timeout) {
+      if (nbase::cv_wait_until(slot->cv, g, deadline)
+              == std::cv_status::timeout) {
         // the deadline and the response can race: `done` is the truth,
         // re-checked under the lock.  Abandoning under the SAME lock
         // guarantees a later deliver() sees it and releases custody.
@@ -2517,8 +2521,8 @@ double brpc_tpu_native_async_throughput_gbps(int depth, int duration_ms,
   while (std::chrono::steady_clock::now() < stop_at) {
     {
       std::unique_lock<std::mutex> g(ctl.mu);
-      ctl.cv.wait_for(g, std::chrono::milliseconds(100),
-                      [&] { return ctl.inflight < depth; });
+      nbase::cv_wait_for(ctl.cv, g, std::chrono::milliseconds(100),
+                         [&] { return ctl.inflight < depth; });
       if (ctl.inflight >= depth) continue;
       ctl.inflight++;
     }
@@ -2527,8 +2531,8 @@ double brpc_tpu_native_async_throughput_gbps(int depth, int duration_ms,
   }
   {
     std::unique_lock<std::mutex> g(ctl.mu);
-    ctl.cv.wait_for(g, std::chrono::seconds(30),
-                    [&] { return ctl.inflight == 0; });
+    nbase::cv_wait_for(ctl.cv, g, std::chrono::seconds(30),
+                       [&] { return ctl.inflight == 0; });
   }
   double secs = std::chrono::duration<double>(
                     std::chrono::steady_clock::now() - t0)

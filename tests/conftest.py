@@ -25,3 +25,83 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) >= 8, (
     "tests require the 8-device virtual CPU mesh; got %d" % len(jax.devices()))
+
+
+# ---- resource-census plugin --------------------------------------------
+#
+# The LeakSanitizer-shaped leg of the concurrency tooling (see
+# docs/CONCURRENCY.md): every test must leave behind no net-new
+#
+#   * non-daemon thread (the PR 2/4 exit-race class: a live thread at
+#     interpreter/static teardown),
+#   * live Socket/Stream payload in the versioned-id pools (a leaked
+#     connection pins buffers and fds), or
+#   * device-plane pin (DevicePlane.active_transfers > 0 means an HBM
+#     source block is still pinned by an incomplete transfer).
+#
+# The census snapshots at fixture-setup time and compares at teardown,
+# so module/session-scoped servers (created before the snapshot) and
+# the test's own function-scoped fixtures (torn down before the
+# comparison) are both accounted.  Teardown is given a settle window:
+# socket death propagates through reader threads/tasklets, so a leak is
+# only failed after it survives ~2s of polling.  Opt out per test with
+# @pytest.mark.allow_leaks("<why>").
+
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+_SETTLE_S = 2.0
+
+
+def _census():
+    from brpc_tpu.rpc.socket import _socket_pool
+    from brpc_tpu.rpc.stream import _streams
+    from brpc_tpu.ici.device_plane import DevicePlane
+    threads = {t for t in threading.enumerate()
+               if t.is_alive() and not t.daemon
+               and t is not threading.main_thread()}
+    # keyed by the VERSIONED pool id, never id(obj): CPython recycles
+    # addresses, so a leaked object at a dead baseline object's address
+    # would otherwise mask the leak
+    sockets = {s.id: s for s in _socket_pool.live_payloads()}
+    streams = {s.sid: s for s in _streams.live_payloads()}
+    plane = DevicePlane._instance      # never CREATE one from the census
+    pins = plane.active_transfers() if plane is not None else 0
+    return threads, sockets, streams, pins
+
+
+def _leaks_vs(base):
+    threads0, sockets0, streams0, pins0 = base
+    threads1, sockets1, streams1, pins1 = _census()
+    leaks = []
+    for t in threads1 - threads0:
+        leaks.append(f"non-daemon thread {t.name!r}")
+    for k in set(sockets1) - set(sockets0):
+        leaks.append(f"live socket {sockets1[k].description()}")
+    for k in set(streams1) - set(streams0):
+        s = streams1[k]
+        leaks.append(f"live stream sid={s.sid} closed={s.closed}")
+    if pins1 > max(pins0, 0):
+        leaks.append(f"device-plane pins: {pins1} active transfers "
+                     f"(was {pins0})")
+    return leaks
+
+
+@pytest.fixture(autouse=True)
+def _resource_census(request):
+    base = _census()
+    yield
+    allow = request.node.get_closest_marker("allow_leaks")
+    if allow is not None:
+        return
+    import time as _time
+    deadline = _time.monotonic() + _SETTLE_S
+    leaks = _leaks_vs(base)
+    while leaks and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+        leaks = _leaks_vs(base)
+    if leaks:
+        pytest.fail(
+            "resource census: test %s leaked:\n  %s"
+            % (request.node.nodeid, "\n  ".join(leaks)), pytrace=False)

@@ -1,0 +1,17 @@
+"""Seeded 2-lock acquisition cycle: a_lock->b_lock and b_lock->a_lock."""
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def forward() -> None:
+    with a_lock:
+        with b_lock:              # line 10: edge a_lock -> b_lock
+            pass
+
+
+def backward() -> None:
+    with b_lock:
+        with a_lock:              # line 16: edge b_lock -> a_lock
+            pass

@@ -1,0 +1,17 @@
+"""Seeded guarded-state violation: counter touched outside its lock."""
+import threading
+
+
+class Counter:
+    _GUARDED_BY = {"_count": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump_locked(self) -> None:
+        with self._lock:
+            self._count += 1
+
+    def bump_racy(self) -> None:
+        self._count += 1          # line 17: the violation

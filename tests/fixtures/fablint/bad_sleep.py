@@ -1,0 +1,10 @@
+"""Seeded blocking-under-lock: time.sleep inside a held-lock region."""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def slow_section() -> None:
+    with _lock:
+        time.sleep(0.5)           # line 10: the violation

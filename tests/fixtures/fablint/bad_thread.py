@@ -1,0 +1,7 @@
+"""Seeded thread-hygiene violation: non-daemon thread, never joined."""
+import threading
+
+
+def fire_and_forget() -> None:
+    t = threading.Thread(target=lambda: None)   # line 6: the violation
+    t.start()

@@ -1,0 +1,44 @@
+"""Clean fixture: every pattern the analyzer checks, done right."""
+import threading
+
+_items_lock = threading.Lock()
+_items = []
+
+_GUARDED_BY_GLOBALS = {"_items": "_items_lock"}
+
+
+class Gadget:
+    _GUARDED_BY = {"_state": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._stop = threading.Event()
+        self._worker.start()
+
+    def poke(self) -> None:
+        with self._lock:
+            self._state += 1
+
+    # fablint: lock-held(_lock)
+    def _state_locked(self) -> int:
+        return self._state
+
+    def _run(self) -> None:
+        while not self._stop.wait(0.01):
+            self.poke()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._worker.join()
+
+
+def add_item(x) -> None:
+    with _items_lock:
+        _items.append(x)
+
+
+def snapshot() -> list:
+    with _items_lock:
+        return list(_items)

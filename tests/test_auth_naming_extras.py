@@ -89,31 +89,41 @@ class TestCouchbaseAuth:
         backend, target, listener = start_mini_memcached(
             sasl_expect=b"\x00bucket\x00pw")
         ch = rpc.Channel()
-        ch.init(target, options=rpc.ChannelOptions(
-            protocol="memcache", timeout_ms=5000,
-            auth=CouchbaseAuthenticator("bucket", "pw")))
-        req = mc.MemcacheRequest()
-        req.set("k", b"v")
-        req.get("k")
-        cntl = rpc.Controller()
-        resp = ch.call_method("memcache", cntl, req, None)
-        assert not cntl.failed(), cntl.error_text
-        assert backend.sasl_seen == 1
-        assert len(resp.ops) == 2                 # SASL reply consumed
-        assert resp.op(1).value == b"v"
+        try:
+            ch.init(target, options=rpc.ChannelOptions(
+                protocol="memcache", timeout_ms=5000,
+                auth=CouchbaseAuthenticator("bucket", "pw")))
+            req = mc.MemcacheRequest()
+            req.set("k", b"v")
+            req.get("k")
+            cntl = rpc.Controller()
+            resp = ch.call_method("memcache", cntl, req, None)
+            assert not cntl.failed(), cntl.error_text
+            assert backend.sasl_seen == 1
+            assert len(resp.ops) == 2                 # SASL reply consumed
+            assert resp.op(1).value == b"v"
+        finally:
+            ch.close()
+            from brpc_tpu.rpc.mem_transport import mem_unlisten
+            mem_unlisten(listener.name)
 
     def test_sasl_rejected(self):
         backend, target, listener = start_mini_memcached(
             sasl_expect=b"\x00bucket\x00right")
         ch = rpc.Channel()
-        ch.init(target, options=rpc.ChannelOptions(
-            protocol="memcache", timeout_ms=5000,
-            auth=CouchbaseAuthenticator("bucket", "wrong")))
-        req = mc.MemcacheRequest()
-        req.get("k")
-        cntl = rpc.Controller()
-        ch.call_method("memcache", cntl, req, None)
-        assert cntl.failed() and cntl.error_code == errors.ERPCAUTH
+        try:
+            ch.init(target, options=rpc.ChannelOptions(
+                protocol="memcache", timeout_ms=5000,
+                auth=CouchbaseAuthenticator("bucket", "wrong")))
+            req = mc.MemcacheRequest()
+            req.get("k")
+            cntl = rpc.Controller()
+            ch.call_method("memcache", cntl, req, None)
+            assert cntl.failed() and cntl.error_code == errors.ERPCAUTH
+        finally:
+            ch.close()
+            from brpc_tpu.rpc.mem_transport import mem_unlisten
+            mem_unlisten(listener.name)
 
     def test_esp_authenticator_magic(self):
         cred = EspAuthenticator().generate_credential(None)

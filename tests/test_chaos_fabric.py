@@ -47,15 +47,31 @@ def _free_port():
 
 
 def _run_pair(script: str, timeout: int = 240, expect_rc=(0, 0)):
+    """Run the 2-process scenario — under the debug_sync runtime
+    lock-order layer (butil/debug_sync.py): every chaos child executes
+    with instrumented locks (BRPC_TPU_DEBUG_LOCK_ORDER=1) and dumps its
+    runtime acquisition graph at exit; the parent asserts the graph
+    stayed ACYCLIC with zero long-hold warnings.  This is the
+    issue-mandated "chaos suite once under debug_lock_order" leg,
+    running in tier-1 on every scenario rather than once."""
+    import json
+    import tempfile
     coord = f"127.0.0.1:{_free_port()}"
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env.pop("JAX_NUM_PROCESSES", None)
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", script, str(i), coord],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
-        for i in range(2)]
+    tmpdir = tempfile.mkdtemp(prefix="chaos_debug_sync_")
+    procs, report_paths = [], []
+    for i in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env.pop("JAX_NUM_PROCESSES", None)
+        env["BRPC_TPU_DEBUG_LOCK_ORDER"] = "1"
+        report = os.path.join(tmpdir, f"debug_sync_{i}.json")
+        env["BRPC_TPU_DEBUG_SYNC_REPORT"] = report
+        report_paths.append(report)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script, str(i), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env))
     outs, rcs = [], []
     for p in procs:
         try:
@@ -68,6 +84,19 @@ def _run_pair(script: str, timeout: int = 240, expect_rc=(0, 0)):
     assert list(rcs) == list(expect_rc), (
         f"rcs={rcs} want={expect_rc}\n--- child0 ---\n{outs[0]}\n"
         f"--- child1 ---\n{outs[1]}")
+    for i, (path, want_rc) in enumerate(zip(report_paths, expect_rc)):
+        if want_rc != 0:
+            continue       # a deliberately-killed child dumps no report
+        assert os.path.exists(path), (
+            f"child {i} exited 0 but wrote no debug_sync report")
+        with open(path) as f:
+            rep = json.load(f)
+        assert not rep["cycles"], (
+            f"child {i}: runtime lock-order cycle under chaos:\n"
+            + json.dumps(rep["cycles"], indent=2))
+        assert not rep["long_holds"], (
+            f"child {i}: long lock holds under chaos:\n"
+            + json.dumps(rep["long_holds"], indent=2))
     return outs
 
 
@@ -931,7 +960,10 @@ else:
     assert Controller._retryable(cntl.error_code_), cntl.error_code_
     print("PK0_OK", flush=True)
     # the coordination service peer is gone: skip jax's atexit shutdown
-    # barrier (it would wait on the killed process)
+    # barrier (it would wait on the killed process) — but still hand
+    # the parent the debug_sync graph it asserts on
+    from brpc_tpu.butil import debug_sync as _dbg
+    _dbg.dump_report_now()
     sys.stdout.flush()
     os._exit(0)
 """

@@ -325,6 +325,9 @@ class TestSocketIntegration:
             np.testing.assert_array_equal(
                 np.frombuffer(got[4:], dtype=np.uint8), np.asarray(payload))
         finally:
+            from brpc_tpu.rpc import errors
+            for s in accepted + ([client] if "client" in locals() else []):
+                s.set_failed(errors.ECLOSE, "test teardown")
             ici_unlisten(7)
 
 

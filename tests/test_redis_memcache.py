@@ -254,6 +254,7 @@ class TestMemcacheClient:
             assert resp.op(3).ok()
             assert resp.op(4).status == mc.STATUS_KEY_NOT_FOUND
         finally:
+            ch.close()
             from brpc_tpu.rpc.mem_transport import mem_unlisten
             mem_unlisten(listener.name)
 
@@ -269,5 +270,6 @@ class TestMemcacheClient:
             resp = ch.call_method("memcache", cntl, req, None)
             assert resp.op(0).value == b"1.6.0-tpu"
         finally:
+            ch.close()
             from brpc_tpu.rpc.mem_transport import mem_unlisten
             mem_unlisten(listener.name)
