@@ -695,7 +695,19 @@ def bench_tail_isolation(seconds: float = 2.0, concurrency: int = 8,
         tails.append(p99_tail)
         if baseline_clean and p99_clean > 0 and p99_tail > 0:
             ratios.append(p99_tail / p99_clean)
-    ratio = statistics.median(ratios) if ratios else -1.0
+    ratio_raw = statistics.median(ratios) if ratios else -1.0
+    spread = (max(ratios) - min(ratios)) if ratios else -1.0
+    # A ratio under 1.0 would read as the tail IMPROVING normal p99 —
+    # physically meaningless; it's the same scheduling noise the
+    # median-of-5 exists for (BENCH_r05 reported 0.891).  When the
+    # with-tail p99 sits at-or-below the no-tail p99 WITHIN the observed
+    # spread, report exactly 1.0 (perfect isolation, the strongest
+    # defensible claim) and label the clamp; a sub-1.0 median that falls
+    # OUTSIDE the spread would be a methodology bug worth seeing, so it
+    # is passed through un-clamped.
+    clamped = bool(ratios) and ratio_raw < 1.0 \
+        and (1.0 - ratio_raw) <= max(spread, 0.0)
+    ratio = 1.0 if clamped else ratio_raw
     return {"normal_p99_us_no_tail": p99_clean,
             "normal_p99_us_with_tail": (statistics.median(tails)
                                         if tails else -1.0),
@@ -703,10 +715,11 @@ def bench_tail_isolation(seconds: float = 2.0, concurrency: int = 8,
             "baseline_clean": baseline_clean,
             "tail_experiments": experiments,
             "tail_isolation_ratio": ratio,
+            "tail_isolation_ratio_raw": ratio_raw,
+            "tail_isolation_clamped_noise": clamped,
             "tail_isolation_ratio_min": min(ratios) if ratios else -1.0,
             "tail_isolation_ratio_max": max(ratios) if ratios else -1.0,
-            "tail_isolation_spread": (max(ratios) - min(ratios)
-                                      if ratios else -1.0)}
+            "tail_isolation_spread": spread}
 
 
 _FABRIC_BENCH_CHILD = r"""
@@ -867,6 +880,165 @@ def bench_fabric_streaming_mbps(timeout_s: int = 240) -> dict:
                 if p.startswith("best_of="):
                     out["best_of"] = int(p.split("=", 1)[1])
             return out
+    return {}
+
+
+_POD_PD_CHILD = r"""
+import os, sys, threading, time, json
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=3, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.butil import flags as _fl
+import brpc_tpu.ici.device_plane
+from brpc_tpu.rpc.socket import list_sockets
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+# the KV handoff (512KB quantized blocks) rides the sequenced xproc
+# device plane on this host-memory mesh — the identical datapath a TPU
+# pod runs with compiled collectives as the byte mover
+_fl.set_flag("ici_device_plane_host_mesh", True)
+
+from examples.disagg_serving.model import reference_generate, kv_nbytes
+from examples.disagg_serving.workers import (PrefillService, DecodeService,
+                                             start_router)
+from examples.example_echo_pb2 import EchoRequest, EchoResponse
+
+SEQ, STEPS, PROMPTS, WARMUP = 512, 64, 20, 2
+
+if pid == 1:
+    svc = PrefillService(device=jax.devices()[2])
+    server = rpc.Server(); server.add_service(svc)
+    assert server.start("ici://2") == 0
+    kv.key_value_set("pd_up_1", "1")
+    kv.blocking_key_value_get("pd_clients_done", 600000)
+    kv.key_value_set("pd_handoff", json.dumps(
+        {"bytes": svc.handoff_bytes, "ns": svc.handoff_ns,
+         "prefills": svc.prefills}))
+    dp_bytes = sum(s.dplane_bytes_sent for s in list_sockets()
+                   if isinstance(s, FabricSocket))
+    kv.key_value_set("pd_dplane_bytes", str(dp_bytes))
+    kv.wait_at_barrier("pd_exit", 600000)
+    svc.close(); server.stop()
+    print("PD1_OK", flush=True)
+elif pid == 2:
+    svc = DecodeService(device=jax.devices()[4])
+    server = rpc.Server(); server.add_service(svc)
+    assert server.start("ici://4") == 0
+    kv.key_value_set("pd_up_2", "1")
+    kv.wait_at_barrier("pd_exit", 600000)
+    server.stop()
+    print("PD2_OK", flush=True)
+else:
+    kv.blocking_key_value_get("pd_up_1", 60000)
+    kv.blocking_key_value_get("pd_up_2", 60000)
+    router = start_router("mem://pd-router", "ici://2",
+                          {"ici://4": "ici://4"})
+    ch = rpc.Channel()
+    ch.init("mem://pd-router", options=rpc.ChannelOptions(
+        timeout_ms=120000, max_retry=0))
+    errs = []
+    def generate(i):
+        tokens = [(11 * i + j) %% 997 for j in range(SEQ)]
+        cntl = rpc.Controller()
+        resp = ch.call_method("Router.Generate", cntl,
+                              EchoRequest(message=json.dumps(
+                                  {"tokens": tokens, "steps": STEPS})),
+                              EchoResponse)
+        if cntl.failed():
+            errs.append((i, cntl.error_text))
+            return
+        out = json.loads(resp.message)
+        if out["tokens"] != reference_generate(tokens, STEPS):
+            errs.append((i, "token mismatch"))
+    for i in range(WARMUP):
+        generate(1000 + i)
+    assert not errs, errs
+    # two client threads: prompt k+1's prefill overlaps prompt k's
+    # decode — the pipelining disaggregation exists for
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=lambda lo=lo: [generate(i) for i
+                                                      in range(lo, lo + PROMPTS // 2)])
+               for lo in (0, PROMPTS // 2)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    dt = time.perf_counter() - t0
+    assert not errs, errs[:3]
+    kv.key_value_set("pd_clients_done", "1")
+    hand = json.loads(kv.blocking_key_value_get("pd_handoff", 60000))
+    dp_bytes = int(kv.blocking_key_value_get("pd_dplane_bytes", 60000))
+    expect = (PROMPTS + WARMUP) * kv_nbytes(SEQ)
+    assert hand["bytes"] == expect, (hand, expect)
+    assert dp_bytes >= expect, (
+        "KV handoff did not ride the device plane", dp_bytes, expect)
+    print("POD_PD " + json.dumps({
+        "pod_pd_tokens_per_s": PROMPTS * STEPS / dt,
+        "pod_pd_handoff_gbps": hand["bytes"] / max(hand["ns"], 1),
+        "pod_pd_kv_block_bytes": kv_nbytes(SEQ),
+        "pod_pd_prompts": PROMPTS,
+        "pod_pd_dplane_bytes": dp_bytes,
+        "processes": 3,
+    }), flush=True)
+    kv.wait_at_barrier("pd_exit", 600000)
+    router.stop()
+    print("PD0_OK", flush=True)
+"""
+
+
+def bench_pod_prefill_decode(timeout_s: int = 300) -> dict:
+    """The pod flagship scenario end to end: DISAGGREGATED
+    PREFILL/DECODE over a 3-process fabric — a router fans a Generate
+    into Prefill on worker process 1 (ici://2), whose 512KB quantized
+    KV-cache block crosses to the decode worker process 2 (ici://4) as
+    a DEVICE payload on the SEQUENCED xproc device plane
+    (examples/disagg_serving; the handoff is asserted to have ridden
+    kind-4, and every completion is verified bit-exact against the
+    single-process reference).  Reports the KV-block handoff bandwidth
+    (bytes over the LoadKv round trip, measured at the prefill worker)
+    and end-to-end tokens/s at the client (2 concurrent prompts —
+    prompt k+1's prefill overlaps prompt k's decode)."""
+    import os
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # the jax-free seeded allocator (NOT conftest, whose import asserts
+    # the 8-device mesh the bench parent lacks): deterministic,
+    # bind-verified coordinator port — no bind/close/reuse TOCTOU window
+    # for another process to steal the port before the children bind
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    from netalloc import alloc_port
+    coord = f"127.0.0.1:{alloc_port('bench_pod_prefill_decode')}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_NUM_PROCESSES", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _POD_PD_CHILD % {"repo": repo},
+         str(i), coord],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(3)]
+    outs, rcs = [], []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+        rcs.append(p.returncode)
+    if rcs != [0, 0, 0]:
+        print("# pod prefill/decode bench children failed: "
+              + " | ".join(o[-300:].replace("\n", " ") for o in outs),
+              file=sys.stderr)
+        return {}
+    for line in outs[0].splitlines():
+        if line.startswith("POD_PD "):
+            return json.loads(line[len("POD_PD "):])
     return {}
 
 
@@ -1052,6 +1224,12 @@ def main() -> None:
         print(f"# fabric streaming failed: {e}", file=sys.stderr)
         fstrm = {}
     try:
+        pdd = bench_pod_prefill_decode()
+        print(f"# pod prefill/decode: {pdd}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# pod prefill/decode failed: {e}", file=sys.stderr)
+        pdd = {}
+    try:
         tail = bench_tail_isolation(allow_ici=reachable)
         print(f"# tail isolation: {tail}", file=sys.stderr)
     except Exception as e:  # pragma: no cover
@@ -1158,11 +1336,21 @@ def main() -> None:
         "streaming_mbps_fabric_xproc": round(
             fstrm.get("stream_mbps", -1.0), 1),
         "streaming_fabric_best_of": fstrm.get("best_of", 1),
+        "pod_pd_tokens_per_s": round(
+            pdd.get("pod_pd_tokens_per_s", -1.0), 1),
+        "pod_pd_handoff_gbps": round(
+            pdd.get("pod_pd_handoff_gbps", -1.0), 3),
+        "pod_pd_kv_block_bytes": pdd.get("pod_pd_kv_block_bytes", -1),
+        "pod_pd_processes": pdd.get("processes", 0),
         "parallel_fanout8_p50_us": round(fan.get("fanout_p50_us", 0.0), 1),
         "parallel_fanout8_ici_p50_us": round(
             ifan.get("fanout_p50_us", -1.0), 1),
         "tail_isolation_ratio": round(
             tail.get("tail_isolation_ratio", -1.0), 3),
+        "tail_isolation_ratio_raw": round(
+            tail.get("tail_isolation_ratio_raw", -1.0), 3),
+        "tail_isolation_clamped_noise": tail.get(
+            "tail_isolation_clamped_noise", False),
         "tail_isolation_ratio_min": round(
             tail.get("tail_isolation_ratio_min", -1.0), 3),
         "tail_isolation_ratio_max": round(
@@ -1200,7 +1388,8 @@ if __name__ == "__main__":
               "allreduce": bench_allreduce_gbps,
               "relocation": bench_relocation,
               "device_plane": bench_device_plane,
-              "ring_attention": bench_ring_attention}[sys.argv[2]]
+              "ring_attention": bench_ring_attention,
+              "pod_prefill_decode": bench_pod_prefill_decode}[sys.argv[2]]
         print(_json.dumps(fn()))
     else:
         main()

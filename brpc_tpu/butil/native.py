@@ -17,7 +17,7 @@ from typing import Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_quiesce"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_pair_stats"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -324,6 +324,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_fab_chaos_listener.restype = ctypes.c_int
     lib.brpc_tpu_fab_chaos_listener.argtypes = [ctypes.c_uint64,
                                                 ctypes.c_int64]
+    # per-pair plane registry (pod observability): conns tagged with the
+    # peer pid, aggregated per pair
+    lib.brpc_tpu_fab_set_peer.restype = None
+    lib.brpc_tpu_fab_set_peer.argtypes = [ctypes.c_uint64, ctypes.c_int32]
+    lib.brpc_tpu_fab_pair_stats.restype = ctypes.c_int
+    lib.brpc_tpu_fab_pair_stats.argtypes = [
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    lib.brpc_tpu_fab_peer_list.restype = ctypes.c_int
+    lib.brpc_tpu_fab_peer_list.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                                           ctypes.c_int]
     _lib = lib
     return _lib
 

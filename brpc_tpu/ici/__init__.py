@@ -9,3 +9,4 @@ from . import device_plane
 from .device_plane import DevicePlane, DeviceTransfer, DevicePlaneError
 from . import pallas_ring
 from . import ring_attention
+from .pod import Pod, PodMember

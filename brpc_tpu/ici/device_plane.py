@@ -89,6 +89,18 @@ _flags.define_flag("ici_device_plane_kernel", "ppermute",
 _flags.define_flag("ici_device_plane_match_timeout_s", 30.0,
                    "seconds a posted send waits for its matching recv "
                    "before failing (peer died post-descriptor)")
+# Cross-process execution backend for the sequenced (xproc) plane.
+# "auto": enter the compiled multi-controller collective on backends
+# that have one (TPU pods), and carry the payload on the native bulk
+# plane under the SAME epoch-ordered sequencer everywhere else (this
+# container's CPU jaxlib raises "Multiprocess computations aren't
+# implemented on the CPU backend" — the sequencer, descriptors, pins,
+# and completions are identical either way, only the byte mover
+# differs).  "on"/"off" force one leg, for tests and TPU bring-up.
+_flags.define_flag("ici_device_plane_xproc_compiled", "auto",
+                   "xproc transfer execution: 'auto' (compiled "
+                   "collectives on TPU, bulk-carried elsewhere), 'on', "
+                   "or 'off'")
 
 _g_bytes_sent = bvar.Adder("ici_device_plane_bytes_sent")
 _g_bytes_recv = bvar.Adder("ici_device_plane_bytes_recv")
@@ -230,6 +242,21 @@ def eligible(nbytes: int) -> bool:
     return (bool(_flags.get_flag("ici_device_plane"))
             and nbytes >= _flags.get_flag("ici_device_plane_threshold")
             and platform_allows())
+
+
+def xproc_compiled_ok() -> bool:
+    """Does the cross-process plane enter COMPILED multi-controller
+    collectives, or carry bytes on the bulk plane under the same
+    sequencer?  See the ici_device_plane_xproc_compiled flag."""
+    mode = _flags.get_flag("ici_device_plane_xproc_compiled")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    try:
+        return _platform() == "tpu"
+    except Exception:
+        return False
 
 
 class DevicePlane:
@@ -594,6 +621,14 @@ class DevicePlane:
         it sat in an executor queue): completion fires with an error and
         the source pin releases."""
         self._fail(t, reason)
+
+    def finish_remote(self, t: DeviceTransfer, out) -> None:
+        """Complete a cross-process transfer whose bytes were moved by
+        the transport itself (the bulk-carried xproc leg): same CQ
+        semantics as the compiled path — completion signals when ``out``
+        is resident at dst (sender half passes None), and the source pin
+        releases exactly then."""
+        self._matched(t, out)
 
     # ---- drain barrier (lame-duck server stop) -------------------------
     def _track(self, t: DeviceTransfer) -> None:

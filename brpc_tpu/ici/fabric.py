@@ -94,19 +94,26 @@ _flags.define_flag("ici_fabric_health_check", True,
 # tests shrink this so a dropped bulk frame resolves quickly.
 _flags.define_flag("ici_bulk_claim_timeout_s", 60.0,
                    "max seconds a bulk claim waits for its frame")
-# Cross-process device plane: device payloads cross through a compiled
-# XLA transfer program that BOTH processes enter (shard_map + ppermute /
-# Pallas remote DMA over the 2-device submesh — the multi-controller
-# SPMD contract; see ici/device_plane.py).  Requires an XLA backend with
-# cross-process collectives: TPU pods have them; this repo's CPU fabric
-# raises "Multiprocess computations aren't implemented on the CPU
-# backend", so the flag defaults off and device payloads keep the bulk
-# plane there.  A failed/refused post degrades to bulk/inline in the
-# same frame and the plane re-probes after ici_device_plane_retry_s.
-_flags.define_flag("ici_device_plane_xproc", False,
-                   "route cross-process device payloads through compiled "
-                   "XLA transfer programs (needs multi-controller "
-                   "collectives: TPU pods)")
+# Cross-process device plane: device payloads cross through the
+# SEQUENCED xproc plane — every transfer (both directions) is assigned a
+# slot in one total order agreed over the control channel
+# (CollectiveSequencer), and each side's single executor enters it at
+# that slot.  On backends with multi-controller collectives (TPU pods)
+# the transfer is a compiled XLA program both processes enter (shard_map
+# + ppermute / Pallas remote DMA over the 2-device submesh — the SPMD
+# contract); elsewhere the bytes ride the native bulk plane under the
+# SAME sequencer (ici_device_plane_xproc_compiled=auto — this repo's CPU
+# jaxlib raises "Multiprocess computations aren't implemented on the CPU
+# backend").  Eligibility still requires the master ici_device_plane
+# flag and its platform gate (TPU by default; host meshes opt in via
+# ici_device_plane_host_mesh).  A failed/refused post degrades to
+# bulk/inline in the same frame and the plane re-probes after
+# ici_device_plane_retry_s.
+_flags.define_flag("ici_device_plane_xproc", True,
+                   "route cross-process device payloads through the "
+                   "sequenced device plane (compiled collectives on TPU "
+                   "pods, bulk-carried under the same total order "
+                   "elsewhere)")
 _flags.define_flag("ici_device_plane_retry_s", 2.0,
                    "seconds a degraded fabric device plane waits before "
                    "re-probing")
@@ -168,6 +175,11 @@ _F_PING_ERR = 14
 # endpoint to the health checker for revival after the restart.  Older
 # peers ignore unknown frame types, so GOODBYE is compatible both ways.
 _F_GOODBYE = 15
+# device-plane total order (CollectiveSequencer): the socket's order
+# master (server side) assigns every cross-process transfer a dense seq;
+# a client-side send goes out with seq -1 in its kind-4 descriptor and
+# receives its assignment in this frame (u64 uuid, i64 seq)
+_F_DPLANE_SEQ = 16
 
 _HDR = struct.Struct("<BI")          # type, body length
 
@@ -349,8 +361,14 @@ class FabricNode:
                 info["host"] = self.host_ip
         if _flags.get_flag("ici_device_plane"):
             # device-plane capability advert (both ends must hold it:
-            # one-sided entry into an SPMD program would hang forever)
-            info["dplane"] = 1
+            # one-sided entry into an SPMD program would hang forever).
+            # Version 2 = sequenced kind-4 descriptors (<Iq> src+seq and
+            # the _F_DPLANE_SEQ assignment frame), advertised under a
+            # NEW key so the treat-as-plane-less rule holds in BOTH
+            # directions: a v1 peer checks "dplane" (absent here — it
+            # never sends its 4-byte descriptors at us) and we check
+            # "dplane2" (absent on v1 — we never send <Iq> at it).
+            info["dplane2"] = 2
         self._kv.key_value_set(_KV_PREFIX + str(self.process_id),
                                json.dumps(info))
         log.info("fabric: process %d/%d up ctrl=%s xfer=%s devices=%s",
@@ -665,6 +683,181 @@ class FabricNode:
         return sock
 
 
+class CollectiveSequencer:
+    """Direction-spanning total order for one socket pair's device-plane
+    transfers — the pod-scale sequencer that closes the PR-3 open item
+    (docs/PARITY.md: per-direction executors ordered each direction's
+    collectives but let the two directions interleave differently on the
+    two processes, a guaranteed SPMD ordering mismatch under
+    bidirectional load).
+
+    One sequencer replaces both per-direction executors, agreed over the
+    serial control channel:
+
+      * the socket's SERVER side is the order master: it assigns a dense
+        sequence number to EVERY transfer, both directions — its own
+        sends at encode time, the client's sends the moment their
+        descriptor arrives on the control read loop (before anything
+        executes);
+      * a master-side send carries its seq inside the kind-4 descriptor;
+        a client-side send goes out with seq -1 and receives its
+        assignment via an ``_F_DPLANE_SEQ`` control frame;
+      * each side runs ONE executor thread admitting transfers strictly
+        in seq order, so both processes enter transfer k's collective
+        only after both executed 0..k-1 — the total order is the
+        master's assignment order, identical on both ends regardless of
+        how the directions interleaved.
+
+    Progress: at the lowest unexecuted seq, the sender half never waits
+    on executor progress of the peer (a compiled collective parks inside
+    the XLA runtime until the peer joins; the bulk-carried leg's send is
+    a plain write), so the receiver half's wait always resolves —
+    lockstep advance, no deadlock.
+
+    The assignment stream is valid for exactly one socket incarnation
+    (seqs restart at 0 with each fresh HELLO under a new socket id);
+    ``epoch`` records the pod epoch at creation for observability —
+    "epoch-ordered" means every incarnation's order is anchored to the
+    membership epoch it was created under."""
+
+    def __init__(self, sock: "FabricSocket", master: bool,
+                 epoch: int = 0):
+        import collections
+        self.sock = sock
+        self.master = master
+        self.epoch = epoch
+        self._cv = threading.Condition(
+            _dbg.make_lock("CollectiveSequencer._lock"))
+        self._next_assign = 0            # master's assignment counter
+        self._next_exec = 0              # both sides' execution cursor
+        self._ready: Dict[int, object] = {}        # seq -> transfer
+        self._unassigned: Dict[int, object] = {}   # uuid -> parked send
+        self._closed = False
+        # uuids in execution order (bounded; the cross-process order-
+        # equality assertions in tests/test_pod.py read this)
+        self.executed = collections.deque(maxlen=4096)
+        # fablint: thread-quiesced(close() sets _closed and notifies; the run loop fails leftovers and exits)
+        self._thread = threading.Thread(
+            target=self._run_loop,
+            name=f"fabric_dplane_seq_{sock.remote_dev}", daemon=True)
+        self._thread.start()
+
+    def submit_local(self, t) -> Optional[int]:
+        """Admit a transfer THIS side is sending.  Returns the seq to
+        encode into the descriptor — the assignment (master) or -1
+        (client, parked until the master's _F_DPLANE_SEQ) — or None when
+        the sequencer is closed (the caller fails the transfer and falls
+        back in-frame)."""
+        with self._cv:
+            if self._closed:
+                return None
+            if self.master:
+                seq = self._next_assign
+                self._next_assign += 1
+                self._ready[seq] = t
+                self._cv.notify_all()
+                return seq
+            self._unassigned[t.uuid] = t
+            return -1
+
+    def submit_remote(self, t, seq: int) -> None:
+        """Admit a transfer the PEER is sending (its kind-4 descriptor
+        just arrived on the control read loop).  The master assigns an
+        unassigned (-1) descriptor NOW and tells the peer — control-read
+        ordering makes the assignment deterministic."""
+        assign = None
+        with self._cv:
+            if self._closed:
+                _dp.plane().fail_transfer(
+                    t, "sequencer closed before execution")
+                return
+            if seq < 0:
+                if not self.master:
+                    # protocol violation: only the master assigns
+                    _dp.plane().fail_transfer(
+                        t, "unassigned descriptor at non-master")
+                    return
+                seq = assign = self._next_assign
+                self._next_assign += 1
+            self._ready[seq] = t
+            self._cv.notify_all()
+        if assign is not None:
+            try:
+                self.sock._ctrl_send(_F_DPLANE_SEQ,
+                                     struct.pack("<Qq", t.uuid, assign))
+            except OSError:
+                pass     # control death tears the whole socket down
+
+    def on_assignment(self, uuid: int, seq: int) -> None:
+        """Client side: the master's _F_DPLANE_SEQ for one of our parked
+        sends — the transfer becomes executable at ``seq``."""
+        with self._cv:
+            t = self._unassigned.pop(uuid, None)
+            if t is None:
+                return
+            if self._closed:
+                # close() already ran: the run loop's leftover sweep can
+                # no longer see this transfer (we just popped it), so
+                # fail it here or the source pin leaks forever
+                _dp.plane().fail_transfer(
+                    t, "sequencer closed before execution")
+                return
+            self._ready[seq] = t
+            self._cv.notify_all()
+
+    def _run_loop(self) -> None:
+        leftovers: List = []
+        while True:
+            with self._cv:
+                while not self._closed \
+                        and self._next_exec not in self._ready:
+                    self._cv.wait(0.5)
+                if self._closed:
+                    leftovers = (list(self._ready.values())
+                                 + list(self._unassigned.values()))
+                    self._ready.clear()
+                    self._unassigned.clear()
+                    break
+                t = self._ready.pop(self._next_exec)
+                self._next_exec += 1
+            self._execute(t)
+        for t in leftovers:
+            # teardown: everything still queued/parked can never execute
+            # — fail it so completions fire and source pins release
+            _dp.plane().fail_transfer(
+                t, "socket torn down before execution")
+
+    def _execute(self, t) -> None:
+        sock = self.sock
+        if sock.failed or sock._peer_gone():
+            _dp.plane().fail_transfer(t, "socket failed before execution")
+            return
+        try:
+            if _dp.xproc_compiled_ok():
+                _dp.plane().execute_remote(t)
+            else:
+                sock._dplane_execute_bulk(t)
+            self.executed.append(t.uuid)
+        except Exception as e:
+            # the transfer is already failed (completion signaled with
+            # an error — delivery/claim paths observe it); latch the
+            # plane so later frames keep bulk/inline
+            sock._device_plane_down(f"execution failed: {e}")
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def describe(self) -> dict:
+        with self._cv:
+            return {"master": self.master, "epoch": self.epoch,
+                    "assigned": self._next_assign,
+                    "executed": self._next_exec,
+                    "queued": len(self._ready),
+                    "awaiting_assignment": len(self._unassigned)}
+
+
 class FabricSocket(CreditWindow, OrderedDelivery, Socket):
     """Cross-process ici socket: control TCP + transfer-server pulls,
     with the same credit window as the in-process IciSocket."""
@@ -688,7 +881,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         "_staged": "_staged_lock",
         "_inbox": "_inbox_lock",
         "_consumed_unacked": "_inbox_lock",
-        "_dplane_qs": "_dplane_lock",
+        "_dplane_seq": "_dplane_lock",
         "_dplane_down_until": "_dplane_lock",
         "_dplane_closed": "_dplane_lock",
     }
@@ -753,14 +946,19 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         # inline d2h fallback instead (review finding)
         self._xfer_usable = (node._xfer_server is not None
                              and "xfer" in node.peer_info(peer_pid))
-        # cross-process device plane (kind-4): compiled-program transfers
-        # both processes enter.  Down-latched on failure with a timed
-        # re-probe; the executor thread enters collectives in control
-        # order (= the peer's order — the SPMD ordering contract).
-        self._dplane_peer = "dplane" in node.peer_info(peer_pid)
+        # cross-process device plane (kind-4): sequenced transfers both
+        # processes execute in ONE agreed total order (CollectiveSequencer
+        # — compiled collectives on capable backends, bulk-carried
+        # elsewhere).  Down-latched on failure with a timed re-probe.
+        # Capability advert version 2 = sequenced descriptors (<Iq>)
+        # under the "dplane2" key; a version-1 peer's unsequenced wire
+        # format is not spoken anymore, and v1 never sends at us either
+        # (it keys on "dplane", which v2 no longer publishes).
+        self._dplane_peer = \
+            node.peer_info(peer_pid).get("dplane2", 0) >= 2
         self._dplane_lock = _dbg.make_lock("FabricSocket._dplane_lock")
         self._dplane_down_until = 0.0      # 0 = up; else re-probe deadline
-        self._dplane_qs = {}               # direction -> lazy executor queue
+        self._dplane_seq: Optional[CollectiveSequencer] = None   # lazy
         self._dplane_closed = False
         self.dplane_bytes_sent = 0         # cumulative, for tests/builtin
         self.dplane_bytes_recv = 0
@@ -780,6 +978,10 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         if old and lib is not None:
             lib.brpc_tpu_fab_conn_close(old)
         if handle:
+            if hasattr(lib, "brpc_tpu_fab_set_peer"):
+                # per-pair plane registry: the /ici page and pod
+                # observability aggregate native planes by peer pid
+                lib.brpc_tpu_fab_set_peer(handle, self.peer_pid)
             plan = _fi.fabric_active()
             if plan is not None:
                 plan.on_bulk_attach(self, lib, handle)
@@ -946,16 +1148,20 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._reestab_ok = ok and pending is not None
         self._reestab_evt.set()
 
-    # ---- device plane (kind-4 compiled-program transfers) --------------
+    # ---- device plane (kind-4 sequenced transfers) ---------------------
     def _dplane_usable(self, nbytes: int) -> bool:
-        """Route this device payload through a compiled cross-process
-        transfer program?  Needs the master+xproc flags, a peer that
-        advertised the capability, an eligible size/platform, and a
-        plane that is not down-latched (a lapsed latch re-probes)."""
+        """Route this device payload through the sequenced cross-process
+        device plane?  Needs the master+xproc flags, a peer that
+        advertised the (v2, sequenced) capability, an eligible
+        size/platform, a byte mover (the bulk plane, when this backend
+        has no compiled multi-controller collectives), and a plane that
+        is not down-latched (a lapsed latch re-probes)."""
         if not _flags.get_flag("ici_device_plane_xproc"):
             return False
         if not self._dplane_peer or not _dp.eligible(nbytes):
             return False
+        if not _dp.xproc_compiled_ok() and not self._bulk_alive():
+            return False       # bulk-carried leg needs a live bulk plane
         with self._dplane_lock:
             if self._dplane_down_until:
                 if time.monotonic() < self._dplane_down_until:
@@ -964,6 +1170,27 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 log.info("fabric %s: device plane re-probing",
                          self.remote_side)
         return True
+
+    def _dplane_sequencer(self) -> Optional["CollectiveSequencer"]:
+        """The socket's (lazily created) collective sequencer; None after
+        teardown.  Master role = server side, so exactly one end of the
+        pair assigns."""
+        with self._dplane_lock:
+            if self._dplane_closed:
+                return None
+            seqr = self._dplane_seq
+            if seqr is None:
+                epoch = 0
+                try:
+                    from .pod import Pod
+                    pod = Pod.current()
+                    if pod is not None:
+                        epoch = pod.epoch()
+                except Exception:
+                    pass
+                seqr = self._dplane_seq = CollectiveSequencer(
+                    self, master=self.is_server_side, epoch=epoch)
+            return seqr
 
     def _device_plane_down(self, reason: str) -> None:
         """Degrade: device payloads ride the PR-2 bulk/inline machinery
@@ -978,70 +1205,57 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                         "fallback engaged, re-probe in %.1fs",
                         self.remote_side, reason, retry)
 
-    def _dplane_submit(self, t, direction: str) -> None:
-        """Enqueue a transfer for an executor thread.  One FIFO per
-        socket per DIRECTION: our "send" queue pairs with the peer's
-        "recv" queue through the serial control channel (descriptors
-        commit in encode order, arrive in the same order), so each
-        direction's collectives are entered in matching order on both
-        processes.  Mixing directions in one FIFO would interleave them
-        differently on each side — a guaranteed cross-process ordering
-        mismatch under bidirectional load.  (Concurrent collectives from
-        the two direction threads remain subject to the backend's
-        device-stream ordering; the pod-scale sequencer is future work —
-        see PARITY.md.)  A submit after teardown fails the transfer
-        instead of resurrecting an executor for a dead socket."""
-        import queue
-        with self._dplane_lock:
-            if self._dplane_closed:
-                q = None
-            else:
-                q = self._dplane_qs.get(direction)
-                if q is None:
-                    q = self._dplane_qs[direction] = queue.Queue()
-                    # fablint: thread-quiesced(_close_dplane poison-pills the queue; the loop drains it failing transfers)
-                    threading.Thread(
-                        target=self._dplane_exec_loop, args=(q,),
-                        name=f"fabric_dplane_{direction}",
-                        daemon=True).start()
-        if q is None:
-            _dp.plane().fail_transfer(t, "socket torn down before "
-                                         "execution")
-            return
-        q.put(t)
-
-    def _dplane_exec_loop(self, q) -> None:
-        while True:
-            t = q.get()
-            if t is None:
-                # teardown: everything still queued can never execute —
-                # fail it so completions fire and source pins release
-                while True:
-                    try:
-                        t2 = q.get_nowait()
-                    except Exception:
-                        return
-                    if t2 is not None:
-                        _dp.plane().fail_transfer(
-                            t2, "socket torn down before execution")
-            if self.failed or self._peer_gone():
-                _dp.plane().fail_transfer(t, "socket failed before "
-                                             "execution")
-                continue
-            try:
-                _dp.plane().execute_remote(t)
-            except Exception as e:
-                # the transfer is already failed (completion signaled
-                # with an error — delivery/claim paths observe it);
-                # latch the plane so later frames keep bulk/inline
-                self._device_plane_down(f"execution failed: {e}")
+    def _dplane_execute_bulk(self, t) -> None:
+        """The bulk-carried xproc leg: this backend has no compiled
+        multi-controller collectives (the CPU jaxlib raises on them), so
+        the payload's bytes cross on the native bulk plane under the
+        SEQUENCED uuid — identical descriptors, total order, source
+        pins, and CQ completions as the compiled leg; only the byte
+        mover differs.  Runs on the sequencer's executor at this
+        transfer's slot in the total order.  Failure fails the transfer
+        (completion fires, pin releases) and re-raises so the plane
+        latches down."""
+        import numpy as np
+        arr = t.source_array()
+        try:
+            if arr is not None:                    # sender half
+                np_arr = np.asarray(arr)
+                if not np_arr.flags["C_CONTIGUOUS"]:
+                    np_arr = np.ascontiguousarray(np_arr)
+                self._bulk_send(t.uuid, np_arr)
+                _dp.plane().finish_remote(t, None)
+            else:                                  # receiver half
+                ca = self._claim_zero_copy(t.uuid, t.nbytes)
+                with self._bulk_lock:
+                    self.bulk_bytes_claimed += t.nbytes
+                host = np.frombuffer(ca, dtype=np.uint8)
+                if _flags.get_flag("ici_fabric_host_delivery"):
+                    out = host                # zero-copy host delivery
+                else:
+                    import jax
+                    owned = host.copy()
+                    del host, ca              # owner releases the buffer
+                    out = jax.device_put(
+                        owned, _dp.plane().mesh().device(t.dst_dev))
+                _dp.plane().finish_remote(t, out)
+        except Exception as e:
+            _dp.plane().fail_transfer(
+                t, f"bulk-carried transfer failed: {e}")
+            raise
 
     def _close_dplane(self) -> None:
         with self._dplane_lock:
             self._dplane_closed = True
-            qs, self._dplane_qs = self._dplane_qs, {}
-        for q in qs.values():
-            q.put(None)
+            seqr = self._dplane_seq
+        if seqr is not None:
+            seqr.close()
+
+    def describe_dplane_sequencer(self) -> Optional[dict]:
+        """Locked snapshot of the sequencer state for the /ici builtin
+        page (honors the _dplane_seq guarded-state contract)."""
+        with self._dplane_lock:
+            seqr = self._dplane_seq
+        return None if seqr is None else seqr.describe()
 
     def start_io(self) -> None:
         self._reader = threading.Thread(target=self._read_loop,
@@ -1175,13 +1389,16 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             if r.offset or r.length != len(arr):
                 arr = arr[r.offset:r.offset + r.length]
             kind = 0
-            # device plane first (kind 4): the payload crosses through a
-            # compiled XLA program both processes enter — no host bytes
-            # anywhere in the datapath.  A refused post degrades to the
-            # bulk/inline machinery below WITHIN this same frame (the
+            # device plane first (kind 4): the payload crosses through
+            # the sequenced xproc plane — a compiled XLA program both
+            # processes enter in the agreed total order (or its
+            # bulk-carried leg on backends without multi-controller
+            # collectives).  A refused post degrades to the bulk/inline
+            # machinery below WITHIN this same frame (the
             # descriptor-consistency rule: nothing is committed to the
             # control stream until its transport is decided).
             dplane_src = -1
+            dplane_seq = -1
             if (hasattr(arr, "devices")
                     and self._dplane_usable(r.length)):
                 # the route's true source is wherever the array LIVES —
@@ -1197,7 +1414,18 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                             remote=True)
                         t.add_source_release(
                             getattr(r.block, "on_send_complete", None))
-                        self._dplane_submit(t, "send")
+                        seqr = self._dplane_sequencer()
+                        assigned = (seqr.submit_local(t)
+                                    if seqr is not None else None)
+                        if assigned is None:
+                            # torn down between usable-check and submit:
+                            # fail the posted WR (pin releases) and fall
+                            # back in this same frame
+                            _dp.plane().fail_transfer(
+                                t, "sequencer closed before submit")
+                            raise _dp.DevicePlaneError(
+                                "device-plane sequencer closed")
+                        dplane_seq = assigned
                         uuid = t.uuid
                         dplane_src = src_idx
                         kind = 4
@@ -1258,7 +1486,10 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                        if shape else b"")
             out.append(struct.pack("<Q", r.length))
             if kind == 4:
-                out.append(struct.pack("<I", dplane_src))
+                # src device + the sequencer's total-order slot (-1 when
+                # this side is the client: the master assigns on receipt
+                # and answers with _F_DPLANE_SEQ)
+                out.append(struct.pack("<Iq", dplane_src, dplane_seq))
             nchunks += 1
         flush_host()
         out[0] = struct.pack("<I", nchunks)
@@ -1407,6 +1638,11 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     self._on_bulk_reply(False)
                 elif ftype == _F_GOODBYE:
                     self._on_goodbye()
+                elif ftype == _F_DPLANE_SEQ:
+                    u, s = struct.unpack("<Qq", body)
+                    seqr = self._dplane_sequencer()
+                    if seqr is not None:
+                        seqr.on_assignment(u, s)
                 elif ftype == _F_FIN:
                     if len(body) >= 4:
                         # the peer closed with an explicit code (lame-duck
@@ -1500,15 +1736,23 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 (length,) = struct.unpack_from("<Q", body, off)
                 off += 8
                 if kind == 4:
-                    (src_dev,) = struct.unpack_from("<I", body, off)
-                    off += 4
-                    # device-plane descriptor: enqueue the matching recv;
-                    # the executor joins the sender's compiled program in
-                    # control order (the rendezvous)
+                    src_dev, dseq = struct.unpack_from("<Iq", body, off)
+                    off += 12
+                    # device-plane descriptor: enqueue the matching recv
+                    # at its slot in the total order (the rendezvous);
+                    # when we are the master and the peer sent -1, the
+                    # sequencer assigns here — on the control read loop,
+                    # so assignment order is deterministic — and answers
+                    # with _F_DPLANE_SEQ
                     t = _dp.plane().post_recv_remote(
                         uuid, length, src_dev=src_dev,
                         dst_dev=self.local_dev, socket=self)
-                    self._dplane_submit(t, "recv")
+                    seqr = self._dplane_sequencer()
+                    if seqr is None:
+                        _dp.plane().fail_transfer(
+                            t, "socket torn down before execution")
+                    else:
+                        seqr.submit_remote(t, dseq)
                     parts.append(t)
                     waits.append(t)
                     continue
@@ -1729,6 +1973,43 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         if pending is not None:
             pending[0].brpc_tpu_fab_conn_close(pending[1])
         self._reestab_evt.set()        # unblock a parked revival thread
+
+
+def pair_plane_stats() -> Dict[int, dict]:
+    """Live native bulk planes grouped by peer pid (the per-pair plane
+    registry, native/fabric.cpp): {peer_pid: {conns, bytes_in,
+    bytes_out}}.  Empty when the native core is absent."""
+    try:
+        from ..butil import native as _native
+        lib = _native.load()
+    except Exception:
+        lib = None
+    if lib is None or not hasattr(lib, "brpc_tpu_fab_peer_list"):
+        return {}
+    # a FULL buffer means the native list may have been truncated (the
+    # C call returns min(count, cap) with no overflow signal): grow and
+    # retry so a >64-process pod's /ici page never silently drops pairs
+    cap = 64
+    while True:
+        peers = (ctypes.c_int32 * cap)()
+        n = lib.brpc_tpu_fab_peer_list(peers, cap)
+        if n < cap or cap >= (1 << 16):
+            if n >= cap:
+                log.warning("pair_plane_stats: peer list truncated "
+                            "at %d entries", cap)
+            break
+        cap *= 2
+    out: Dict[int, dict] = {}
+    for i in range(n):
+        conns = ctypes.c_uint64()
+        bi = ctypes.c_uint64()
+        bo = ctypes.c_uint64()
+        lib.brpc_tpu_fab_pair_stats(peers[i], ctypes.byref(conns),
+                                    ctypes.byref(bi), ctypes.byref(bo))
+        out[int(peers[i])] = {"conns": int(conns.value),
+                              "bytes_in": int(bi.value),
+                              "bytes_out": int(bo.value)}
+    return out
 
 
 def connect_any(ep, local_dev: Optional[int] = None):

@@ -1,0 +1,407 @@
+"""Pod fabric membership: join/leave/epoch over the coordination KV.
+
+The reference's cluster is whatever its naming services return
+(src/brpc/policy/*naming_service.cpp); liveness is the health checker's
+and circuit breaker's concern, never the registry's.  The pod layer keeps
+that division of labor on a TPU pod:
+
+  * **Membership** — every process that joined the pod publishes a member
+    record under ``brpc_tpu/pod/<name>/<pid>`` in the jax coordination
+    KV (the same store the fabric handshake uses): its owned devices, the
+    device ids it is currently SERVING (a Server bound to ``ici://k``),
+    the ones draining (lame-duck), and a per-member generation counter
+    bumped on every transition.  ``key_value_dir_get`` lists the pod.
+  * **Epoch** — the pod epoch is the SUM of member generations: every
+    join / advertise / drain / withdraw / rejoin bumps exactly one gen,
+    so the epoch strictly increases on every membership transition and
+    every process computes the SAME epoch for the same set of records (a
+    convergent derived counter — the KV has no atomic increment, and the
+    fabric needs agreement, not linearizability).
+  * **Liveness** — deliberately NOT here.  A member that crashes cannot
+    update its record; its endpoints are discovered dead by the existing
+    machinery (connect failures and socket death hand the endpoint to
+    rpc/health_check.py, LBs exclude it, breakers gate it) and revived
+    the same way.  GOODBYE (PR-4) remains the *proactive* per-socket
+    drain signal; the pod record is the *membership* drain signal that
+    also reaches processes holding no socket to the drainer.
+
+``pod://<name>`` (policy/naming.py) turns the member table into a server
+list — every serving, non-draining device of every up member — so any LB
+channel (``Channel.init("pod://default", "rr")``) balances over the pod,
+and N per-pair control+bulk planes are established lazily by the existing
+``connect_any`` routing on first use, exactly like the 2-process fabric.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..butil import debug_sync as _dbg
+from ..butil import flags as _flags
+from ..butil import logging as log
+from ..butil.endpoint import EndPoint
+
+_flags.define_flag("ici_pod_watch_interval_s", 0.25,
+                   "pod membership watch poll period")
+
+_KV_POD_PREFIX = "brpc_tpu/pod/"
+
+UP = "up"
+DRAINING = "draining"
+DOWN = "down"
+
+
+class PodMember:
+    """One member record as read from the KV (immutable snapshot)."""
+
+    __slots__ = ("pid", "gen", "state", "devices", "serving", "draining",
+                 "ctrl", "ts")
+
+    def __init__(self, pid: int, gen: int, state: str,
+                 devices: List[int], serving: List[int],
+                 draining: List[int], ctrl: str = "", ts: float = 0.0):
+        self.pid = pid
+        self.gen = gen
+        self.state = state
+        self.devices = devices
+        self.serving = serving
+        self.draining = draining
+        self.ctrl = ctrl
+        self.ts = ts
+
+    @classmethod
+    def from_json(cls, raw: str) -> "PodMember":
+        d = json.loads(raw)
+        return cls(d["pid"], d["gen"], d.get("state", UP),
+                   d.get("devices", []), d.get("serving", []),
+                   d.get("draining", []), d.get("ctrl", ""),
+                   d.get("ts", 0.0))
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "pid": self.pid, "gen": self.gen, "state": self.state,
+            "devices": self.devices, "serving": self.serving,
+            "draining": self.draining, "ctrl": self.ctrl, "ts": self.ts,
+        })
+
+    def describe(self) -> dict:
+        return {"pid": self.pid, "gen": self.gen, "state": self.state,
+                "devices": self.devices, "serving": self.serving,
+                "draining": self.draining}
+
+
+def epoch_of(members: Dict[int, PodMember]) -> int:
+    """The convergent pod epoch for a membership snapshot: the sum of
+    member generations.  Each transition bumps exactly one gen, so the
+    epoch strictly increases across transitions and is identical on
+    every process that reads the same records."""
+    return sum(m.gen for m in members.values())
+
+
+class Pod:
+    """Per-process pod runtime: the local member record + a membership
+    watch.  One pod per process (the FabricNode discipline)."""
+
+    _instance: Optional["Pod"] = None
+    _ilock = threading.Lock()
+
+    # fablint guarded-state contract: the local record and the cached
+    # membership view are written from the watch thread, server
+    # start/stop paths, and user calls
+    _GUARDED_BY = {
+        "_members": "_lock",
+        "_gen": "_lock",
+        "_serving": "_lock",
+        "_draining_devs": "_lock",
+        "_state": "_lock",
+        "_watchers": "_lock",
+    }
+
+    def __init__(self, name: str, node) -> None:
+        self.name = name
+        self.node = node                    # FabricNode
+        self.pid = node.process_id
+        self._kv = node._kv
+        self._lock = _dbg.make_lock("Pod._lock")
+        self._publish_lock = _dbg.make_lock("Pod._publish_lock")
+        self._gen = 0
+        self._state = DOWN
+        self._serving: List[int] = []
+        self._draining_devs: List[int] = []
+        self._members: Dict[int, PodMember] = {}
+        self._watchers: List[Callable[[Dict[int, PodMember]], None]] = []
+        self._stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        import jax
+        self._devices = [i for i, d in enumerate(jax.devices())
+                         if d.process_index == self.pid]
+
+    # ---- lifecycle -----------------------------------------------------
+    @classmethod
+    def current(cls) -> Optional["Pod"]:
+        with cls._ilock:
+            return cls._instance
+
+    @classmethod
+    def join(cls, name: str = "default") -> "Pod":
+        """Join (or return the already-joined) pod.  Requires a live
+        FabricNode — the pod rides the same coordination service the
+        fabric handshake publishes through."""
+        from .fabric import FabricNode
+        node = FabricNode.instance()
+        if node is None:
+            raise RuntimeError("Pod.join requires FabricNode.initialize "
+                               "(the pod lives on the coordination KV)")
+        with cls._ilock:
+            if cls._instance is not None:
+                if cls._instance.name != name:
+                    raise RuntimeError(
+                        f"process already joined pod "
+                        f"{cls._instance.name!r}, cannot join {name!r}")
+                return cls._instance
+            pod = Pod(name, node)
+            cls._instance = pod
+        try:
+            pod._join()
+        except BaseException:
+            # a KV hiccup mid-join must not leave a half-joined
+            # singleton that every later join() returns as-is
+            with cls._ilock:
+                if cls._instance is pod:
+                    cls._instance = None
+            raise
+        return pod
+
+    def _join(self) -> None:
+        # Resume from a surviving record before bumping: a rejoin after
+        # leave() (tombstone) or a supervisor restart with the
+        # coordination KV still up must not overwrite a high gen with 1
+        # — the epoch is the sum of gens and may NEVER regress, or every
+        # peer's wait_epoch convergence primitive times out.
+        prior = self._refresh().get(self.pid)
+        with self._lock:
+            if prior is not None and prior.gen > self._gen:
+                self._gen = prior.gen
+            self._gen += 1
+            self._state = UP
+        self._publish()
+        self._refresh()
+        # fablint: thread-quiesced(leave() sets _stop and joins; the watch loop checks it every poll)
+        t = threading.Thread(target=self._watch_loop,
+                             name=f"pod_watch:{self.name}", daemon=True)
+        self._watch_thread = t
+        t.start()
+        log.info("pod %s: process %d joined (epoch %d)", self.name,
+                 self.pid, self.epoch())
+
+    def leave(self) -> None:
+        """Leave the pod: publish state=down (epoch bump) and stop the
+        watch thread.  The record stays in the KV as a tombstone so the
+        epoch never regresses for the remaining members."""
+        with self._lock:
+            if self._state == DOWN:
+                return
+            self._gen += 1
+            self._state = DOWN
+            self._serving = []
+            self._draining_devs = []
+        self._publish()
+        self._stop.set()
+        t = self._watch_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(2.0)
+        with Pod._ilock:
+            if Pod._instance is self:
+                Pod._instance = None
+
+    # ---- local record --------------------------------------------------
+    def _publish(self) -> None:
+        # _publish_lock covers snapshot AND KV write: two concurrent
+        # transitions (e.g. two servers advertising on one member) must
+        # commit their records in snapshot order, or the stale snapshot
+        # lands last and a gen bump is lost forever — the epoch would
+        # regress for every peer and wait_epoch could never converge.
+        # (Each snapshot reads the CURRENT state, so the later committer
+        # always carries the newer gen.)  Ordering: _publish_lock is
+        # taken before _lock, never the reverse.
+        with self._publish_lock:
+            with self._lock:
+                rec = PodMember(self.pid, self._gen, self._state,
+                                list(self._devices), list(self._serving),
+                                list(self._draining_devs),
+                                ctrl=self.node.ctrl_addr, ts=time.time())
+            self._kv.key_value_set(self._key(self.pid), rec.to_json(),
+                                   allow_overwrite=True)
+
+    def _key(self, pid: int) -> str:
+        return f"{_KV_POD_PREFIX}{self.name}/{pid}"
+
+    def advertise(self, device_id: int) -> None:
+        """A server came up on ``ici://device_id`` in this process: add
+        it to the serving set.  ALWAYS bumps the gen, even when the
+        device is already listed — a killed member whose record still
+        says "serving" re-advertises on revival, and the bump is what
+        lets every watcher observe the rejoin as an epoch transition."""
+        with self._lock:
+            if device_id not in self._serving:
+                self._serving.append(device_id)
+            if device_id in self._draining_devs:
+                self._draining_devs.remove(device_id)
+            self._gen += 1
+            self._state = UP
+        self._publish()
+
+    def withdraw(self, device_id: int) -> None:
+        """The server on ``ici://device_id`` stopped: drop it from the
+        serving set (epoch bump).  Idempotent."""
+        with self._lock:
+            if device_id not in self._serving \
+                    and device_id not in self._draining_devs:
+                return
+            if device_id in self._serving:
+                self._serving.remove(device_id)
+            if device_id in self._draining_devs:
+                self._draining_devs.remove(device_id)
+            self._gen += 1
+        self._publish()
+
+    def mark_draining(self, device_id: int) -> None:
+        """Lame-duck: the server on ``ici://device_id`` began its drain
+        window.  The device stays in the record (the member is up) but
+        pod:// membership stops listing it — the GOODBYE signal
+        generalized to processes holding no socket to the drainer."""
+        with self._lock:
+            if device_id in self._draining_devs:
+                return
+            self._draining_devs.append(device_id)
+            self._gen += 1
+        self._publish()
+
+    # ---- membership view -----------------------------------------------
+    def _refresh(self) -> Dict[int, PodMember]:
+        """Read every member record from the KV (one dir get)."""
+        try:
+            pairs = self._kv.key_value_dir_get(
+                f"{_KV_POD_PREFIX}{self.name}/")
+        except Exception as e:
+            log.log_every_n(log.WARNING, 60, "pod %s: dir get failed: %s",
+                            self.name, e)
+            with self._lock:
+                return dict(self._members)
+        fresh: Dict[int, PodMember] = {}
+        for _key, raw in pairs:
+            try:
+                m = PodMember.from_json(raw)
+            except Exception:
+                continue
+            fresh[m.pid] = m
+        with self._lock:
+            self._members = fresh
+            return dict(fresh)
+
+    def members(self, refresh: bool = False) -> Dict[int, PodMember]:
+        if refresh:
+            return self._refresh()
+        with self._lock:
+            return dict(self._members)
+
+    def epoch(self, refresh: bool = False) -> int:
+        return epoch_of(self.members(refresh=refresh))
+
+    def serving_endpoints(self) -> List[Tuple[EndPoint, int]]:
+        """(endpoint, owner pid) for every serving, non-draining device
+        of every up member — the pod:// naming source."""
+        from .mesh import IciMesh
+        mesh = IciMesh.default()
+        out: List[Tuple[EndPoint, int]] = []
+        for m in sorted(self.members().values(), key=lambda m: m.pid):
+            if m.state != UP:
+                continue
+            for dev in m.serving:
+                if dev in m.draining:
+                    continue
+                out.append((mesh.endpoint(dev), m.pid))
+        return out
+
+    def wait_epoch(self, at_least: int, timeout: float = 30.0) -> int:
+        """Block until the pod epoch reaches ``at_least`` (refreshing),
+        returning the epoch observed; raises TimeoutError past the
+        deadline.  The N-process tests' convergence primitive."""
+        deadline = time.monotonic() + timeout
+        while True:
+            e = self.epoch(refresh=True)
+            if e >= at_least:
+                return e
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"pod {self.name}: epoch {e} < {at_least} "
+                    f"after {timeout}s")
+            time.sleep(0.05)
+
+    def add_watcher(self,
+                    fn: Callable[[Dict[int, PodMember]], None]) -> None:
+        """``fn(members)`` runs on the watch thread after every observed
+        membership change (epoch moved)."""
+        with self._lock:
+            self._watchers.append(fn)
+
+    # ---- watch loop ----------------------------------------------------
+    def _watch_loop(self) -> None:
+        last_epoch = -1
+        while not self._stop.wait(
+                _flags.get_flag("ici_pod_watch_interval_s")):
+            members = self._refresh()
+            e = epoch_of(members)
+            if e == last_epoch:
+                continue
+            last_epoch = e
+            with self._lock:
+                watchers = list(self._watchers)
+            for fn in watchers:
+                try:
+                    fn(members)
+                except Exception:
+                    log.error("pod %s: watcher failed", self.name,
+                              exc_info=True)
+
+    # ---- observability -------------------------------------------------
+    def describe(self) -> dict:
+        members = self.members()
+        return {
+            "name": self.name,
+            "pid": self.pid,
+            "epoch": epoch_of(members),
+            "members": [members[p].describe()
+                        for p in sorted(members)],
+        }
+
+
+# ---- server lifecycle hooks (rpc/server.py) ----------------------------
+# Guarded no-ops when no pod is joined: a plain 2-process fabric (or a
+# mem://-only test) never touches the pod layer.
+
+def _pod_and_dev(ep: EndPoint) -> Tuple[Optional["Pod"], int]:
+    pod = Pod.current()
+    if pod is None or ep.scheme != "ici" or len(ep.coords) != 1:
+        return None, -1
+    return pod, ep.device_id
+
+
+def on_server_started(ep: EndPoint) -> None:
+    pod, dev = _pod_and_dev(ep)
+    if pod is not None:
+        pod.advertise(dev)
+
+
+def on_server_draining(ep: EndPoint) -> None:
+    pod, dev = _pod_and_dev(ep)
+    if pod is not None:
+        pod.mark_draining(dev)
+
+
+def on_server_stopped(ep: EndPoint) -> None:
+    pod, dev = _pod_and_dev(ep)
+    if pod is not None:
+        pod.withdraw(dev)

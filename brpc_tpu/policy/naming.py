@@ -14,6 +14,10 @@ sources:
   * ``mesh://``                 TPU-native: every device of the default ICI
                                 mesh — topology discovery IS the naming
                                 service on a pod
+  * ``pod://<name>``            pod membership (ici/pod.py): every serving,
+                                non-draining device of every up member —
+                                join/leave/drain transitions move the pod
+                                epoch and propagate within one watch poll
   * ``consul://host:port/name`` JSON HTTP discovery endpoint (consul-style
                                 watch; plain GET per period)
 
@@ -83,6 +87,15 @@ def _split_list(body: str) -> List[str]:
     return [x for x in out if x.strip()]
 
 
+def is_naming_url(target: str) -> bool:
+    """True when ``target`` is a naming-service url (mesh://, pod://,
+    list://, file://, http://, …) rather than a direct endpoint scheme —
+    the ONE predicate Channel.init, rpc_press, and the examples share,
+    so a new direct-endpoint scheme is added in exactly one place."""
+    return "://" in target and not target.startswith(
+        ("mem://", "ici://", "tcp://"))
+
+
 class ListNamingService(NamingService):
     def __init__(self, body: str):
         self._entries = []
@@ -142,6 +155,37 @@ class MeshNamingService(NamingService):
             if lameduck.is_draining(ep):
                 continue
             out.append(ServerEntry(ep, 100, tag=str(mesh.device(i))))
+        return out
+
+
+class PodNamingService(NamingService):
+    """``pod://<name>``: the pod membership table as a server list —
+    every serving, non-draining device of every up member (ici/pod.py).
+    Membership is the record; liveness stays with the health checker and
+    circuit breakers (the reference's naming+LB division of labor).  A
+    process that has not joined the pod gets an empty list (and a
+    warning once) rather than an error — membership may begin later."""
+
+    def __init__(self, name: str):
+        self.pod_name = name or "default"
+        self._warned = False
+
+    def get_servers(self) -> List[ServerEntry]:
+        from ..ici.pod import Pod
+        pod = Pod.current()
+        if pod is None or pod.name != self.pod_name:
+            if not self._warned:
+                self._warned = True
+                log.warning("pod://%s: this process has not joined the "
+                            "pod; membership is empty until Pod.join",
+                            self.pod_name)
+            return []
+        from ..rpc import lameduck
+        out = []
+        for ep, pid in pod.serving_endpoints():
+            if lameduck.is_draining(ep):
+                continue            # GOODBYE beat the membership record
+            out.append(ServerEntry(ep, 100, tag=f"pid={pid}"))
         return out
 
 
@@ -292,6 +336,8 @@ def create_naming_service(url: str) -> NamingService:
         return DnsNamingService(rest)
     if scheme == "mesh":
         return MeshNamingService()
+    if scheme == "pod":
+        return PodNamingService(rest)
     if scheme == "consul":
         return ConsulNamingService(rest)
     if scheme == "remotefile":

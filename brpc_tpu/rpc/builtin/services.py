@@ -277,6 +277,28 @@ def _ici(server, q):
         out["device_plane_recent"] = plane.recent_transfers()
     except Exception:
         out["device_plane"] = {}
+    try:
+        # pod membership + the per-pair native planes (N-member fabric)
+        from ...ici.pod import Pod
+        pod = Pod.current()
+        if pod is not None:
+            out["pod"] = pod.describe()
+        from ...ici.fabric import pair_plane_stats, FabricSocket
+        pairs = pair_plane_stats()
+        if pairs:
+            out["pair_planes"] = {str(pid): st
+                                  for pid, st in pairs.items()}
+        from ..socket import list_sockets
+        seqs = {}
+        for s in list_sockets():
+            if isinstance(s, FabricSocket):
+                d = s.describe_dplane_sequencer()
+                if d is not None:
+                    seqs[str(s.remote_side)] = d
+        if seqs:
+            out["dplane_sequencers"] = seqs
+    except Exception:
+        pass
     return "application/json", json.dumps(out, indent=1)
 
 

@@ -89,8 +89,8 @@ class Channel:
         if isinstance(target, EndPoint):
             self._endpoint = target
             return 0
-        if isinstance(target, str) and "://" in target and not (
-                target.startswith(("mem://", "ici://", "tcp://"))):
+        from ..policy.naming import is_naming_url
+        if isinstance(target, str) and is_naming_url(target):
             # naming-service url (file://, list://, http://, mesh://, …)
             from ..policy.naming import get_naming_service_thread
             from ..policy.load_balancers import create_load_balancer

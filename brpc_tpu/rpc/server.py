@@ -346,6 +346,13 @@ class Server:
         self._started = True
         from . import lameduck
         lameduck.clear_local_draining(ep)   # restart lifts the drain mark
+        try:
+            # pod membership: a joined pod advertises the serving device
+            # (epoch bump); no-op for non-ici servers / no pod
+            from ..ici import pod as _pod
+            _pod.on_server_started(ep)
+        except Exception:
+            pass
         if self.options.graceful_quit_on_sigterm:
             if not lameduck.enable_graceful_quit(self):
                 # the hook only installs from the main thread — the
@@ -507,6 +514,14 @@ class Server:
             drain_start_ns = _time.monotonic_ns()
             for ep in self._listen_endpoints:
                 lameduck.mark_local_draining(ep)
+                try:
+                    # pod membership drain mark: pod:// naming drops the
+                    # device even for processes holding no socket to us
+                    # (the GOODBYE signal generalized)
+                    from ..ici import pod as _pod
+                    _pod.on_server_draining(ep)
+                except Exception:
+                    pass
             self._teardown_listeners(keep_native=True)
             self._send_goodbyes()
             drained = self._drain_until(_time.monotonic() + grace_s)
@@ -550,6 +565,11 @@ class Server:
         self._draining = False
         for ep in self._listen_endpoints:
             lameduck.clear_local_draining(ep)
+            try:
+                from ..ici import pod as _pod
+                _pod.on_server_stopped(ep)
+            except Exception:
+                pass
 
     # ---- drain machinery ----------------------------------------------
     def _send_goodbyes(self) -> None:

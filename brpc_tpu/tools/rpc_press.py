@@ -6,6 +6,14 @@ JSON request body, reporting qps/latency through bvar.  Usage:
     python -m brpc_tpu.tools.rpc_press --server mem://echo \
         --method EchoService.Echo --request '{"message":"x"}' \
         --qps 1000 --duration 5 [--proto tests/echo_pb2:EchoRequest,EchoResponse]
+
+``--server`` also accepts a comma-separated endpoint list
+(``mem://a,mem://b`` / ``ici://0,ici://2``) or a naming url
+(``mesh://``, ``pod://name``, ``list://...``): one channel per resolved
+endpoint, workers spread round-robin, and the summary — including the
+graceful-SIGINT one — reports per-endpoint sent/error/qps counts, so a
+pod/overload bench can drive N servers from one process and see which
+member misbehaved.
 """
 from __future__ import annotations
 
@@ -16,7 +24,7 @@ import signal
 import sys
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 
 def _load_classes(spec: str):
@@ -24,6 +32,25 @@ def _load_classes(spec: str):
     req_name, _, resp_name = names.partition(",")
     mod = importlib.import_module(mod_name.replace("/", ".").rstrip(".py"))
     return getattr(mod, req_name), getattr(mod, resp_name)
+
+
+def resolve_targets(server: str) -> List[str]:
+    """One endpoint url per target channel.  A naming url (mesh://,
+    pod://, list://, file://, …) is resolved through the naming service;
+    a comma-separated list is split (ici mesh coords' parens respected);
+    a single endpoint passes through."""
+    from ..policy.naming import is_naming_url
+    if is_naming_url(server):
+        from ..policy.naming import create_naming_service
+        entries = create_naming_service(server).get_servers()
+        targets = [str(e.endpoint) for e in entries]
+        if not targets:
+            raise SystemExit(f"rpc_press: {server} resolved to no servers")
+        return targets
+    if "," in server:
+        from ..policy.naming import _split_list
+        return _split_list(server)
+    return [server]
 
 
 def run_press(server: str, method: str, request_json: str,
@@ -41,12 +68,17 @@ def run_press(server: str, method: str, request_json: str,
         req_cls = resp_cls = None
         request = (request_json or "").encode()
 
-    ch = rpc.Channel()
-    ch.init(server, options=rpc.ChannelOptions(protocol=protocol,
-                                               timeout_ms=10000))
+    targets = resolve_targets(server)
+    channels = []
+    for t in targets:
+        ch = rpc.Channel()
+        ch.init(t, options=rpc.ChannelOptions(protocol=protocol,
+                                              timeout_ms=10000))
+        channels.append(ch)
     recorder = bvar.LatencyRecorder()
     errors_count = [0]
     sent = [0]
+    per_ep = {t: {"sent": 0, "errors": 0} for t in targets}
     lock = threading.Lock()
     deadline = time.monotonic() + duration
     interval = concurrency / qps if qps > 0 else 0.0
@@ -63,8 +95,9 @@ def run_press(server: str, method: str, request_json: str,
     except ValueError:
         pass
 
-    def worker():
+    def worker(wid: int):
         next_fire = time.monotonic()
+        i = 0
         while not stop_evt.is_set() and time.monotonic() < deadline:
             if interval:
                 now = time.monotonic()
@@ -72,18 +105,25 @@ def run_press(server: str, method: str, request_json: str,
                     time.sleep(min(next_fire - now, 0.05))
                     continue
                 next_fire += interval
+            # workers spread across the endpoint list round-robin, each
+            # starting at its own offset so N workers cover N endpoints
+            # even with concurrency == len(targets)
+            idx = (wid + i) % len(targets)
+            i += 1
             cntl = rpc.Controller()
             t0 = time.perf_counter_ns()
-            ch.call_method(method, cntl, request, resp_cls)
+            channels[idx].call_method(method, cntl, request, resp_cls)
             lat_us = (time.perf_counter_ns() - t0) // 1000
             with lock:
                 sent[0] += 1
+                per_ep[targets[idx]]["sent"] += 1
                 if cntl.failed():
                     errors_count[0] += 1
+                    per_ep[targets[idx]]["errors"] += 1
                 else:
                     recorder << lat_us
-
-    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(concurrency)]
     t_start = time.monotonic()
     for t in threads: t.start()
     for t in threads: t.join()      # interrupted workers drain in-flight
@@ -105,13 +145,19 @@ def run_press(server: str, method: str, request_json: str,
         "elapsed_s": round(elapsed, 2),
         "interrupted": stop_evt.is_set(),
     }
+    if len(targets) > 1:
+        result["per_endpoint"] = {
+            t: {**c, "qps": round(c["sent"] / elapsed, 1)}
+            for t, c in per_ep.items()}
     print(json.dumps(result), file=out)
     return result
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--server", required=True)
+    ap.add_argument("--server", required=True,
+                    help="endpoint, comma-separated endpoint list, or "
+                         "naming url (mesh://, pod://name, list://…)")
     ap.add_argument("--method", required=True)
     ap.add_argument("--request", default="{}")
     ap.add_argument("--qps", type=int, default=0, help="0 = unthrottled")

@@ -1,0 +1,14 @@
+"""Disaggregated prefill/decode serving over the pod fabric.
+
+The scenario a million-user TPU serving fleet actually runs: prefill
+workers burn compute turning prompts into KV-cache blocks, decode
+workers burn memory bandwidth streaming tokens out of them, and the two
+scale independently — which only works if KV-cache blocks move between
+worker processes fast, as DEVICE payloads, without staging through the
+host.  See README.md for the walkthrough.
+"""
+from .model import (toy_kv_blocks, toy_decode, reference_generate,
+                    KV_LAYERS, KV_DMODEL)
+from .workers import (PrefillService, DecodeService, RouterService,
+                      start_prefill_worker, start_decode_worker,
+                      start_router)

@@ -1,0 +1,76 @@
+"""The toy model behind the disaggregated-serving example.
+
+Deliberately tiny but shaped like the real thing:
+
+  * **prefill** is compute-shaped: a whole prompt becomes a KV cache in
+    one pass — here a deterministic transform of the token ids into a
+    ``(layers, seq, d_model)`` tensor, QUANTIZED to uint8 blocks (KV
+    quantization is standard serving practice, and a flat uint8 device
+    array is exactly what the device plane moves);
+  * **decode** is memory-shaped: each step reads the whole cache and
+    emits one token — here a deterministic integer recurrence over the
+    cache statistics, so any process (including the test client) can
+    recompute the expected tokens bit-for-bit from the same prompt.
+
+Determinism is the test contract: prefill on worker A, a fabric hop, and
+decode on worker B must produce the exact tokens a single-process
+reference run produces — any corruption in the KV handoff path changes
+the output.
+"""
+from __future__ import annotations
+
+from typing import List
+
+KV_LAYERS = 4
+KV_DMODEL = 256
+VOCAB = 50257
+
+
+def toy_kv_blocks(tokens: List[int], device=None):
+    """Prefill: prompt token ids -> quantized KV-cache blocks, one flat
+    uint8 device array of shape (KV_LAYERS * len(tokens) * KV_DMODEL,).
+    Deterministic in the token ids."""
+    import jax
+    import jax.numpy as jnp
+    t = jnp.asarray(tokens, jnp.float32)                      # (seq,)
+    cols = jnp.arange(KV_DMODEL, dtype=jnp.float32) / KV_DMODEL
+    base = jnp.outer(t + 1.0, cols)                           # (seq, d)
+    layers = [jnp.sin(base * (l + 1)) + jnp.cumsum(base, axis=0) * 1e-3
+              for l in range(KV_LAYERS)]
+    kv = jnp.stack(layers)                                    # (L, seq, d)
+    kv_q = (jnp.clip(kv, -4.0, 4.0) * 16.0 + 128.0).astype(jnp.uint8)
+    flat = kv_q.reshape(-1)
+    if device is not None:
+        flat = jax.device_put(flat, device)
+    return flat
+
+
+def kv_nbytes(seq_len: int) -> int:
+    return KV_LAYERS * seq_len * KV_DMODEL
+
+
+def toy_decode(kv_u8, seq_len: int, last_token: int,
+               steps: int) -> List[int]:
+    """Decode: stream ``steps`` tokens out of the quantized cache.  Each
+    step folds the per-position cache sums (the "attention read") into an
+    integer recurrence — cheap, deterministic, and a function of every
+    cache byte, so a corrupted handoff changes the output."""
+    import numpy as np
+    arr = np.asarray(kv_u8, dtype=np.uint8)
+    kv = arr.reshape(KV_LAYERS, seq_len, KV_DMODEL)
+    pos_sums = kv.astype(np.int64).sum(axis=(0, 2))           # (seq,)
+    acc = int(pos_sums.sum())
+    toks: List[int] = []
+    prev = last_token
+    for i in range(steps):
+        read = int(pos_sums[(prev + i) % seq_len])
+        prev = (acc + read * (i + 1) + prev * 31) % VOCAB
+        toks.append(prev)
+    return toks
+
+
+def reference_generate(tokens: List[int], steps: int) -> List[int]:
+    """Single-process reference: what the disaggregated pipeline must
+    reproduce exactly."""
+    kv = toy_kv_blocks(tokens)
+    return toy_decode(kv, len(tokens), tokens[-1], steps)

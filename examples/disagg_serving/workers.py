@@ -1,0 +1,282 @@
+"""Disaggregated prefill/decode workers and the router front-end.
+
+Three roles, each an ordinary brpc_tpu server:
+
+  * **PrefillService** (``Prefill``): turns a prompt into quantized
+    KV-cache blocks on its own device, then HANDS THEM OFF to the chosen
+    decode worker — one ``DecodeService.LoadKv`` call whose request
+    attachment is the KV tensor as a DEVICE payload.  Cross-process this
+    rides the fabric's sequenced device plane (``ici_device_plane_xproc``;
+    compiled collectives on TPU pods, bulk-carried under the same total
+    order elsewhere); in-process it is a device-plane/ref-pass hop.  The
+    prefill worker never talks to the client again — the point of
+    disaggregation.
+  * **DecodeService** (``LoadKv`` / ``Decode``): parks sessions' KV
+    blocks and streams tokens out of them.  ``Decode`` releases the
+    session when ``release`` is set.
+  * **RouterService** (``Generate``): the front door — picks a prefill
+    worker and a decode worker through load-balanced channels (any
+    naming source: ``list://``, ``mesh://``, ``pod://``), orchestrates
+    prefill → handoff → decode, and returns the tokens.
+
+Request/response bodies are JSON in EchoRequest.message (the examples'
+lingua franca); bulk bytes ride attachments, never the JSON.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+sys.path.insert(0, __file__.rsplit("/", 3)[0])   # repo root
+
+import brpc_tpu.policy  # noqa: F401  (registers protocols)
+from brpc_tpu import rpc
+from examples.example_echo_pb2 import EchoRequest, EchoResponse
+
+from .model import toy_kv_blocks, toy_decode, kv_nbytes
+
+
+def _reply(response, done, **kw) -> None:
+    response.message = json.dumps(kw)
+    done()
+
+
+class PrefillService(rpc.Service):
+    SERVICE_NAME = "Prefill"
+
+    def __init__(self, device=None,
+                 channel_options: Optional[rpc.ChannelOptions] = None):
+        self.device = device
+        self.channel_options = channel_options or rpc.ChannelOptions(
+            timeout_ms=60000)
+        self._channels: Dict[str, rpc.Channel] = {}
+        self._lock = threading.Lock()
+        self.prefills = 0
+        self.handoff_bytes = 0
+        self.handoff_ns = 0      # cumulative LoadKv round-trip time
+
+    def _channel_to(self, target: str) -> rpc.Channel:
+        with self._lock:
+            ch = self._channels.get(target)
+            if ch is None:
+                ch = rpc.Channel()
+                ch.init(target, options=self.channel_options)
+                self._channels[target] = ch
+            return ch
+
+    def close(self) -> None:
+        with self._lock:
+            chans, self._channels = list(self._channels.values()), {}
+        for ch in chans:
+            ch.close()
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Prefill(self, cntl, request, response, done):
+        req = json.loads(request.message)
+        session = req["session"]
+        tokens = req["tokens"]
+        decode_target = req["decode"]
+        import jax
+        t0 = time.perf_counter_ns()
+        kv = toy_kv_blocks(tokens, device=self.device)
+        jax.block_until_ready(kv)
+        t1 = time.perf_counter_ns()
+        # the KV-cache handoff: device payload to the decode worker
+        ch = self._channel_to(decode_target)
+        hand = rpc.Controller()
+        hand.request_attachment.append_device_array(kv)
+        load = EchoRequest(message=json.dumps(
+            {"session": session, "seq_len": len(tokens),
+             "last_token": tokens[-1]}))
+        ch.call_method("Decode.LoadKv", hand, load, EchoResponse)
+        t2 = time.perf_counter_ns()
+        if hand.failed():
+            cntl.set_failed(hand.error_code_,
+                            f"kv handoff failed: {hand.error_text}")
+            done()
+            return
+        with self._lock:
+            self.prefills += 1
+            self.handoff_bytes += kv_nbytes(len(tokens))
+            self.handoff_ns += t2 - t1
+        _reply(response, done, session=session,
+               kv_bytes=kv_nbytes(len(tokens)),
+               prefill_us=(t1 - t0) // 1000,
+               handoff_us=(t2 - t1) // 1000)
+
+
+class DecodeService(rpc.Service):
+    SERVICE_NAME = "Decode"
+
+    # an orphaned session — LoadKv landed but the router's Decode never
+    # arrived (drain ELOGOFF with retries exhausted, router crash) —
+    # would park its KV block forever; sweep stale entries past this
+    # age opportunistically on every LoadKv (no reaper thread needed)
+    SESSION_TTL_S = 120.0
+
+    def __init__(self, device=None):
+        self.device = device
+        self._sessions: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self.loads = 0
+        self.kv_bytes_in = 0
+        self.decode_steps = 0
+        self.sessions_expired = 0
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def LoadKv(self, cntl, request, response, done):
+        req = json.loads(request.message)
+        session = req["session"]
+        seq_len = req["seq_len"]
+        want = kv_nbytes(seq_len)
+        blob = cntl.request_attachment.to_bytes()
+        if len(blob) != want:
+            cntl.set_failed(rpc.errors.EREQUEST,
+                            f"kv size {len(blob)} != {want}")
+            done()
+            return
+        now = time.monotonic()
+        with self._lock:
+            stale = [s for s, e in self._sessions.items()
+                     if now - e[3] > self.SESSION_TTL_S]
+            for s in stale:
+                del self._sessions[s]
+            self.sessions_expired += len(stale)
+            self._sessions[session] = (blob, seq_len, req["last_token"],
+                                       now)
+            self.loads += 1
+            self.kv_bytes_in += want
+        _reply(response, done, session=session, loaded=want)
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Decode(self, cntl, request, response, done):
+        req = json.loads(request.message)
+        session = req["session"]
+        steps = req["steps"]
+        with self._lock:
+            entry = self._sessions.get(session)
+        if entry is None:
+            cntl.set_failed(rpc.errors.EREQUEST,
+                            f"unknown session {session!r}")
+            done()
+            return
+        blob, seq_len, last_token, _loaded_at = entry
+        import numpy as np
+        toks = toy_decode(np.frombuffer(blob, np.uint8), seq_len,
+                          last_token, steps)
+        with self._lock:
+            self.decode_steps += steps
+            if req.get("release", True):
+                self._sessions.pop(session, None)
+        _reply(response, done, session=session, tokens=toks)
+
+    def live_sessions(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+
+class RouterService(rpc.Service):
+    SERVICE_NAME = "Router"
+
+    def __init__(self, prefill_targets: str, decode_targets: Dict[str, str],
+                 channel_options: Optional[rpc.ChannelOptions] = None):
+        """``prefill_targets``: naming url (or single endpoint) for the
+        prefill pool.  ``decode_targets``: {decode worker endpoint url:
+        same url} — the router addresses a SPECIFIC decode worker so the
+        prefill worker knows where to push the KV; a dict keeps the
+        choice explicit and round-robin-able."""
+        opts = channel_options or rpc.ChannelOptions(timeout_ms=60000,
+                                                     max_retry=2)
+        from brpc_tpu.policy.naming import is_naming_url
+        self._prefill = rpc.Channel()
+        self._prefill.init(prefill_targets,
+                           "rr" if is_naming_url(prefill_targets) else "",
+                           options=opts)
+        self._decode_urls = list(decode_targets)
+        self._decode_chs: Dict[str, rpc.Channel] = {}
+        for url in self._decode_urls:
+            ch = rpc.Channel()
+            ch.init(url, options=opts)
+            self._decode_chs[url] = ch
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._next_session = 0
+
+    def close(self) -> None:
+        self._prefill.close()
+        for ch in self._decode_chs.values():
+            ch.close()
+
+    def _pick_decode(self) -> str:
+        with self._lock:
+            url = self._decode_urls[self._rr % len(self._decode_urls)]
+            self._rr += 1
+            return url
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Generate(self, cntl, request, response, done):
+        req = json.loads(request.message)
+        tokens = req["tokens"]
+        steps = req.get("steps", 8)
+        with self._lock:
+            self._next_session += 1
+            session = f"s{self._next_session}"
+        decode_url = self._pick_decode()
+        pc = rpc.Controller()
+        pre_resp = self._prefill.call_method(
+            "Prefill.Prefill", pc,
+            EchoRequest(message=json.dumps(
+                {"session": session, "tokens": tokens,
+                 "decode": decode_url})), EchoResponse)
+        if pc.failed():
+            cntl.set_failed(pc.error_code_,
+                            f"prefill failed: {pc.error_text}")
+            done()
+            return
+        pre = json.loads(pre_resp.message)
+        dc = rpc.Controller()
+        dec_resp = self._decode_chs[decode_url].call_method(
+            "Decode.Decode", dc,
+            EchoRequest(message=json.dumps(
+                {"session": session, "steps": steps, "release": True})),
+            EchoResponse)
+        if dc.failed():
+            cntl.set_failed(dc.error_code_,
+                            f"decode failed: {dc.error_text}")
+            done()
+            return
+        toks = json.loads(dec_resp.message)["tokens"]
+        _reply(response, done, session=session, tokens=toks,
+               decode_worker=decode_url, kv_bytes=pre.get("kv_bytes", 0))
+
+
+def start_prefill_worker(addr: str, device=None,
+                         options: Optional[rpc.ServerOptions] = None
+                         ) -> rpc.Server:
+    server = rpc.Server(options)
+    server.add_service(PrefillService(device=device))
+    rc = server.start(addr)
+    assert rc == 0, f"prefill worker start failed: {rc}"
+    return server
+
+
+def start_decode_worker(addr: str, device=None,
+                        options: Optional[rpc.ServerOptions] = None
+                        ) -> rpc.Server:
+    server = rpc.Server(options)
+    server.add_service(DecodeService(device=device))
+    rc = server.start(addr)
+    assert rc == 0, f"decode worker start failed: {rc}"
+    return server
+
+
+def start_router(addr: str, prefill_targets: str,
+                 decode_targets: Dict[str, str]) -> rpc.Server:
+    server = rpc.Server()
+    server.add_service(RouterService(prefill_targets, decode_targets))
+    rc = server.start(addr)
+    assert rc == 0, f"router start failed: {rc}"
+    return server

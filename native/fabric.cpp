@@ -162,6 +162,11 @@ struct BulkConn {
   bool dead = false;
   std::thread reader;
   std::atomic<uint64_t> bytes_in{0}, bytes_out{0};
+  // per-pair registry tag: the peer process id this conn serves, set by
+  // the owning FabricSocket at attach (-1 = untagged).  Lets the pod
+  // observability layer aggregate the N-member fabric's planes by pair
+  // without walking Python socket state.
+  std::atomic<int32_t> peer{-1};
   // ---- deterministic chaos knobs (brpc_tpu_fab_chaos) ----
   // payload-byte watermark after which the NEXT write severs the conn
   // mid-writev (truncated frame on the wire); -1 = off
@@ -795,6 +800,69 @@ void brpc_tpu_fab_listener_close(uint64_t lh) {
     nfab::g_listeners.erase(it);
   }
   l->stop();
+}
+
+// ---- per-pair plane registry (pod observability) ----------------------
+
+// Tag a conn with the peer process id it serves; -1 clears the tag.
+void brpc_tpu_fab_set_peer(uint64_t h, int32_t peer) {
+  auto c = nfab::find_conn(h);
+  if (c != nullptr) c->peer.store(peer, std::memory_order_relaxed);
+}
+
+// Aggregate the live planes bound to `peer` (live = registered and not
+// dead): conn count + cumulative bytes each way.  Returns 0; outputs may
+// be null.
+int brpc_tpu_fab_pair_stats(int32_t peer, uint64_t* conns,
+                            uint64_t* bytes_in, uint64_t* bytes_out) {
+  uint64_t n = 0, bi = 0, bo = 0;
+  std::vector<std::shared_ptr<nfab::BulkConn>> snapshot;
+  {
+    std::lock_guard<std::mutex> g(nfab::g_mu);
+    for (auto& kv : nfab::g_conns) snapshot.push_back(kv.second);
+  }
+  for (auto& c : snapshot) {
+    if (c->peer.load(std::memory_order_relaxed) != peer) continue;
+    {
+      std::lock_guard<std::mutex> g(c->mu);
+      if (c->dead) continue;
+    }
+    ++n;
+    bi += c->bytes_in.load(std::memory_order_relaxed);
+    bo += c->bytes_out.load(std::memory_order_relaxed);
+  }
+  if (conns != nullptr) *conns = n;
+  if (bytes_in != nullptr) *bytes_in = bi;
+  if (bytes_out != nullptr) *bytes_out = bo;
+  return 0;
+}
+
+// Distinct live peer tags (untagged conns excluded); returns the number
+// written into peers_out (capped at cap).
+int brpc_tpu_fab_peer_list(int32_t* peers_out, int cap) {
+  std::vector<std::shared_ptr<nfab::BulkConn>> snapshot;
+  {
+    std::lock_guard<std::mutex> g(nfab::g_mu);
+    for (auto& kv : nfab::g_conns) snapshot.push_back(kv.second);
+  }
+  std::vector<int32_t> peers;
+  for (auto& c : snapshot) {
+    int32_t p = c->peer.load(std::memory_order_relaxed);
+    if (p < 0) continue;
+    {
+      std::lock_guard<std::mutex> g(c->mu);
+      if (c->dead) continue;
+    }
+    bool seen = false;
+    for (int32_t q : peers) seen = seen || (q == p);
+    if (!seen) peers.push_back(p);
+  }
+  int n = 0;
+  for (int32_t p : peers) {
+    if (n >= cap) break;
+    peers_out[n++] = p;
+  }
+  return n;
 }
 
 // Deterministic pre-exit quiesce: close and JOIN every live bulk conn

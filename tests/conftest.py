@@ -27,6 +27,21 @@ assert len(jax.devices()) >= 8, (
     "tests require the 8-device virtual CPU mesh; got %d" % len(jax.devices()))
 
 
+# ---- seeded port / UDS-path allocator ----------------------------------
+#
+# N-process tests (the chaos harness, the pod suite, the fabric bench)
+# need coordinator ports and unix-socket paths that (a) are DETERMINISTIC
+# per test — a failure reproduces with the same addresses — and (b) can't
+# collide when several pytest processes run the same suite on one host
+# (parallel CI).  The implementation lives in netalloc.py (jax-free) so
+# __graft_entry__'s dryrun can import the N-process harnesses from a
+# parent without the 8-device mesh; re-exported here for test use.
+
+import pytest  # noqa: E402
+
+from netalloc import alloc_port, alloc_uds  # noqa: E402,F401
+
+
 # ---- resource-census plugin --------------------------------------------
 #
 # The LeakSanitizer-shaped leg of the concurrency tooling (see

@@ -20,7 +20,6 @@ fault state leaks between tests.
 """
 import ctypes
 import os
-import socket as pysock
 import subprocess
 import sys
 import threading
@@ -39,11 +38,10 @@ pytestmark = pytest.mark.chaos
 
 
 def _free_port():
-    s = pysock.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    # seeded allocator (conftest): deterministic per run, disjoint across
+    # parallel pytest processes
+    from conftest import alloc_port
+    return alloc_port("chaos_fabric")
 
 
 def _run_pair(script: str, timeout: int = 240, expect_rc=(0, 0)):

@@ -304,6 +304,124 @@ class TestSweepFixRegressions:
             for s in servers:
                 s.stop()
 
+    def test_close_one_of_four_lb_members_leaves_others_untouched(self):
+        """SocketMap.close_endpoint on ONE member of a FOUR-member LB
+        under live traffic: the other members' connections stay live,
+        nobody lands in health-check probing (ECLOSE is a deliberate
+        local close, not an outage), no circuit breaker trips, and
+        traffic keeps flowing to all four — the PR-5 close paths proven
+        beyond the 2-member case.  Only failures carrying ECLOSE (an
+        in-flight call on the closed member's connection at the instant
+        of the close) are tolerated."""
+        import brpc_tpu.policy  # noqa: F401
+        from brpc_tpu import rpc
+        from brpc_tpu.rpc import errors, health_check
+        from brpc_tpu.rpc.circuit_breaker import BreakerRegistry
+        from brpc_tpu.rpc.socket import list_sockets
+        from brpc_tpu.rpc.socket_map import SocketMap
+        from brpc_tpu.butil.endpoint import parse_endpoint
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from echo_pb2 import EchoRequest, EchoResponse
+
+        names = [f"lbn4-{c}" for c in "abcd"]
+
+        def make_service(tag):
+            class Echo(rpc.Service):
+                SERVICE_NAME = "Echo"
+
+                @rpc.method(EchoRequest, EchoResponse)
+                def Echo(self, cntl, request, response, done):
+                    response.message = tag
+                    done()
+            return Echo()
+
+        servers = []
+        for name in names:
+            s = rpc.Server()
+            s.add_service(make_service(name))
+            assert s.start(f"mem://{name}") == 0
+            servers.append(s)
+        ch = rpc.Channel()
+        ch.init("list://" + ",".join(f"mem://{n}" for n in names),
+                lb_name="rr", options=rpc.ChannelOptions(
+                    protocol="tpu_std"))
+        eps = [parse_endpoint(f"mem://{n}") for n in names]
+        failures = []
+        seen = set()
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def traffic():
+            while not stop.is_set():
+                cntl = rpc.Controller()
+                resp = ch.call_method("Echo.Echo", cntl,
+                                      EchoRequest(message="x"),
+                                      EchoResponse)
+                with lock:
+                    if cntl.failed():
+                        failures.append((cntl.error_code_,
+                                         cntl.error_text_))
+                    else:
+                        seen.add(resp.message)
+        try:
+            th = threading.Thread(target=traffic, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with lock:
+                    if len(seen) == 4:
+                        break
+                time.sleep(0.01)
+            with lock:
+                assert seen == set(names), seen
+            # close ONE member's connections mid-traffic
+            SocketMap.instance().close_endpoint(
+                eps[0], ch._channel_signature())
+            # the OTHER members' conns were not disturbed: still live
+            live = {str(s.remote_side) for s in list_sockets()
+                    if not s.failed and "lbn4-" in str(s.remote_side)}
+            for n in names[1:]:
+                assert any(n in r for r in live), (n, live)
+            # traffic reaches all four again (the closed member simply
+            # re-dials — its server never went away)
+            with lock:
+                seen.clear()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with lock:
+                    if len(seen) == 4:
+                        break
+                time.sleep(0.01)
+            with lock:
+                assert seen == set(names), seen
+            stop.set()
+            th.join(10)
+            # a local ECLOSE is not an outage: nobody under health
+            # check, no breaker isolated, and every failure (if any)
+            # carries ECLOSE from the closed member's in-flight window
+            for ep in eps:
+                assert not health_check.checking(ep), ep
+                assert not BreakerRegistry.instance().breaker(
+                    ep).is_isolated(), ep
+            with lock:
+                assert all(code == errors.ECLOSE
+                           for code, _ in failures), failures[:5]
+            # full channel close drops EVERY member's connections
+            ch.close()
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline and any(
+                    "lbn4-" in str(s.remote_side)
+                    for s in list_sockets()):
+                time.sleep(0.05)
+            left = [s.description() for s in list_sockets()
+                    if "lbn4-" in str(s.remote_side)]
+            assert not left, left
+        finally:
+            stop.set()
+            for s in servers:
+                s.stop()
+
     def test_fabric_bulk_counters_exact_under_contention(self):
         """bulk_bytes_sent is bumped by every stream sharing the
         socket; the _bulk_lock-guarded add is exact."""

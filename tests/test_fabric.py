@@ -324,7 +324,7 @@ class TestFabricUnits:
         sock._reestab_pending = None
         sock._reestab_evt = _threading.Event()
         sock._dplane_lock = _threading.Lock()
-        sock._dplane_qs = {}
+        sock._dplane_seq = None
         sock._dplane_closed = False
         sock._init_delivery()
         events = []

@@ -1,0 +1,725 @@
+"""Pod fabric: N-process membership, the sequenced xproc device plane,
+and the N=4 all-to-all chaos contract.
+
+Three legs:
+
+  * **Sequencer units** (single process): the direction-spanning total
+    order — master assignment, client parking, identical execution
+    order on both ends of a simulated pair, teardown failing parked
+    transfers (pins release).
+  * **2-process bidirectional xproc** — the shape that broke the old
+    per-direction executors: concurrent device payloads BOTH WAYS on one
+    socket pair, byte-exact, with both ends' sequencers executing the
+    IDENTICAL uuid order (published through the coordination KV and
+    compared cross-process).
+  * **N=4 chaos** (the acceptance contract): all-to-all traffic over a
+    ``pod://`` LB while one member's serving endpoint is KILLED (listener
+    torn down + every server-side control conn severed — process-death-
+    equivalent at the fabric layer; the OS process is kept alive only
+    because it hosts a quarter of the shared jax coordination service)
+    and another member DRAINS gracefully mid-traffic; zero
+    client-visible failures on surviving pairs throughout; the killed
+    member revives under a NEW socket id and rejoins the pod epoch
+    (gen bump observed by every member, epoch converging to the same
+    value everywhere).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc  # noqa: F401  (re-exported helpers used below)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.pod
+
+
+def _run_pod(script: str, n: int, timeout: int = 300,
+             expect_rc=None, tag: str = "pod"):
+    """Run an n-process pod scenario under the debug_sync runtime
+    lock-order layer (the chaos harness discipline): every child runs
+    with instrumented locks and dumps its acquisition graph; the parent
+    asserts each surviving child's graph stayed acyclic with zero long
+    holds."""
+    import tempfile
+    from netalloc import alloc_port
+    if expect_rc is None:
+        expect_rc = tuple(0 for _ in range(n))
+    coord = f"127.0.0.1:{alloc_port(tag)}"
+    tmpdir = tempfile.mkdtemp(prefix="pod_debug_sync_")
+    procs, report_paths = [], []
+    for i in range(n):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env.pop("JAX_NUM_PROCESSES", None)
+        env["BRPC_TPU_DEBUG_LOCK_ORDER"] = "1"
+        report = os.path.join(tmpdir, f"debug_sync_{i}.json")
+        env["BRPC_TPU_DEBUG_SYNC_REPORT"] = report
+        report_paths.append(report)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script, str(i), coord, str(n)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env))
+    outs, rcs = [], []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+        rcs.append(p.returncode)
+    assert list(rcs) == list(expect_rc), (
+        f"rcs={rcs} want={expect_rc}\n" + "\n".join(
+            f"--- child{i} ---\n{o}" for i, o in enumerate(outs)))
+    for i, (path, want_rc) in enumerate(zip(report_paths, expect_rc)):
+        if want_rc != 0:
+            continue
+        assert os.path.exists(path), (
+            f"child {i} exited 0 but wrote no debug_sync report")
+        with open(path) as f:
+            rep = json.load(f)
+        assert not rep["cycles"], (
+            f"child {i}: runtime lock-order cycle:\n"
+            + json.dumps(rep["cycles"], indent=2))
+        assert not rep["long_holds"], (
+            f"child {i}: long lock holds:\n"
+            + json.dumps(rep["long_holds"], indent=2))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Pod membership units (single process, no fabric).
+# ---------------------------------------------------------------------------
+
+class TestPodUnits:
+    def test_epoch_is_sum_of_gens_and_strictly_monotone(self):
+        from brpc_tpu.ici.pod import PodMember, epoch_of, UP, DOWN
+        m = {0: PodMember(0, 1, UP, [0, 1], [0], []),
+             1: PodMember(1, 2, UP, [2, 3], [2], [])}
+        assert epoch_of(m) == 3
+        # every transition bumps exactly one gen: epoch strictly grows
+        m[1] = PodMember(1, 3, DOWN, [2, 3], [], [])
+        assert epoch_of(m) == 4
+        m[2] = PodMember(2, 1, UP, [4, 5], [4], [])
+        assert epoch_of(m) == 5
+
+    def test_member_record_roundtrip(self):
+        from brpc_tpu.ici.pod import PodMember, DRAINING
+        m = PodMember(3, 7, DRAINING, [6, 7], [6], [6], ctrl="h:1")
+        m2 = PodMember.from_json(m.to_json())
+        assert (m2.pid, m2.gen, m2.state, m2.devices, m2.serving,
+                m2.draining, m2.ctrl) == (3, 7, DRAINING, [6, 7], [6],
+                                          [6], "h:1")
+
+    def test_join_requires_fabric_node(self):
+        from brpc_tpu.ici.fabric import FabricNode
+        from brpc_tpu.ici.pod import Pod
+        if FabricNode.instance() is not None:
+            pytest.skip("fabric initialized in this process")
+        with pytest.raises(RuntimeError):
+            Pod.join("nope")
+
+    def test_pod_naming_empty_without_join(self):
+        from brpc_tpu.policy.naming import create_naming_service
+        ns = create_naming_service("pod://unjoined")
+        assert ns.get_servers() == []
+
+
+# ---------------------------------------------------------------------------
+# CollectiveSequencer units: the total order on a simulated pair.
+# ---------------------------------------------------------------------------
+
+class _SeqSock:
+    """Just enough socket for a CollectiveSequencer: executions recorded,
+    assignments forwarded to the peer sequencer (the control channel)."""
+
+    failed = False
+    is_server_side = False
+    remote_dev = 99
+    remote_side = "fake"
+
+    def __init__(self):
+        self.executed = []
+        self.peer_seq = None
+        self.downs = []
+
+    def _peer_gone(self):
+        return False
+
+    def _device_plane_down(self, reason):
+        self.downs.append(reason)
+
+    def _ctrl_send(self, ftype, body):
+        import struct
+        from brpc_tpu.ici import fabric as F
+        assert ftype == F._F_DPLANE_SEQ
+        u, s = struct.unpack("<Qq", body)
+        if self.peer_seq is not None:
+            self.peer_seq.on_assignment(u, s)
+
+    def _dplane_execute_bulk(self, t):
+        from brpc_tpu.ici import device_plane as dp
+        self.executed.append(t.uuid)
+        dp.plane().finish_remote(t, None)
+
+
+@pytest.fixture()
+def _bulk_leg():
+    """Force the bulk-carried execution leg (routes through the fake
+    socket's _dplane_execute_bulk)."""
+    from brpc_tpu.butil import flags as fl
+    old = fl.get_flag("ici_device_plane_xproc_compiled")
+    fl.set_flag("ici_device_plane_xproc_compiled", "off")
+    yield
+    fl.set_flag("ici_device_plane_xproc_compiled", old)
+
+
+class TestCollectiveSequencer:
+    def _pair(self):
+        from brpc_tpu.ici.fabric import CollectiveSequencer
+        a, b = _SeqSock(), _SeqSock()
+        sa = CollectiveSequencer(a, master=True)
+        sb = CollectiveSequencer(b, master=False)
+        a.peer_seq, b.peer_seq = sb, sa
+        return a, b, sa, sb
+
+    @staticmethod
+    def _transfer(uuid):
+        from brpc_tpu.ici.device_plane import DeviceTransfer
+        return DeviceTransfer(uuid, 0, 1, 64)
+
+    def _wait_executed(self, *socks, n, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while any(len(s.executed) < n for s in socks) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def test_interleaved_bidirectional_total_order(self, _bulk_leg):
+        a, b, sa, sb = self._pair()
+        try:
+            # master sends 1,2; client sends 11,12 — descriptors cross in
+            # a scrambled arrival order, as concurrent directions do
+            t1, t2 = self._transfer(1), self._transfer(2)
+            t11, t12 = self._transfer(11), self._transfer(12)
+            s1 = sa.submit_local(t1)            # master assigns 0
+            s11 = sb.submit_local(t11)          # client parks (-1)
+            # client's descriptor reaches the master BEFORE the master's
+            # own second send; master's first descriptor reaches the
+            # client last
+            sa.submit_remote(self._recv(11), s11)   # master assigns 1
+            s2 = sa.submit_local(t2)                # master assigns 2
+            s12 = sb.submit_local(t12)              # parks
+            sa.submit_remote(self._recv(12), s12)   # assigns 3
+            sb.submit_remote(self._recv(2), s2)
+            sb.submit_remote(self._recv(1), s1)
+            self._wait_executed(a, b, n=4)
+            assert a.executed == b.executed == [1, 11, 2, 12]
+            assert list(sa.executed) == list(sb.executed)
+            assert not a.downs and not b.downs
+        finally:
+            sa.close()
+            sb.close()
+
+    def _recv(self, uuid):
+        from brpc_tpu.ici.device_plane import plane
+        return plane().post_recv_remote(uuid, 64, src_dev=0, dst_dev=1)
+
+    def test_close_fails_parked_and_queued_transfers(self, _bulk_leg):
+        from brpc_tpu.ici.device_plane import FAILED
+        a, b, sa, sb = self._pair()
+        # a parked client send (no assignment yet) and an out-of-order
+        # queued transfer (seq 5 with 0..4 missing: never executable)
+        parked = self._transfer(21)
+        assert sb.submit_local(parked) == -1
+        gapped = self._recv(22)
+        sb.submit_remote(gapped, 5)
+        sa.close()
+        sb.close()
+        deadline = time.monotonic() + 5
+        while (parked.state != FAILED or gapped.state != FAILED) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert parked.state == FAILED      # completion fired, pin released
+        assert gapped.state == FAILED
+        assert parked.completion.poll() and gapped.completion.poll()
+
+    def test_submit_after_close_is_refused(self, _bulk_leg):
+        a, b, sa, sb = self._pair()
+        sb.close()
+        sa.close()
+        assert sb.submit_local(self._transfer(31)) is None
+        t = self._recv(32)
+        sa.submit_remote(t, -1)
+        from brpc_tpu.ici.device_plane import FAILED
+        deadline = time.monotonic() + 5
+        while t.state != FAILED and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert t.state == FAILED
+
+
+# ---------------------------------------------------------------------------
+# 2-process bidirectional xproc: identical total order on both ends.
+# ---------------------------------------------------------------------------
+
+_POD_PRELUDE = r"""
+import os, sys, threading, time, json
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+# Fail FAST and HARD: an uncaught exception must not reach the normal
+# interpreter exit — the coordination-service leader's atexit shutdown
+# waits for every task to disconnect while the other children sit in
+# multi-minute barriers, wedging the whole scenario until the parent's
+# timeout obscures the real traceback.  Print, dump the debug_sync
+# report (the atexit hook won't run), and _exit(1) so peers abort
+# quickly on leader death instead.
+_real_excepthook = sys.excepthook
+def _fail_fast(tp, val, tb):
+    _real_excepthook(tp, val, tb)
+    sys.stdout.flush(); sys.stderr.flush()
+    try:
+        from brpc_tpu.butil.debug_sync import dump_report_now
+        dump_report_now()
+    except Exception:
+        pass
+    os._exit(1)
+sys.excepthook = _fail_fast
+
+pid = int(sys.argv[1]); coord = sys.argv[2]; NPROC = int(sys.argv[3])
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=NPROC, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.rpc.socket import list_sockets, Socket
+from brpc_tpu.butil.iobuf import IOBuf
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+def fabric_socks():
+    return [s for s in list_sockets() if isinstance(s, FabricSocket)]
+"""
+
+_XPROC_BIDIR = _POD_PRELUDE + r"""
+import numpy as np
+import jax.numpy as jnp
+from brpc_tpu.butil import flags as _fl
+_fl.set_flag("ici_device_plane_host_mesh", True)
+_fl.set_flag("ici_device_plane_threshold", 4096)
+
+N = 128 * 1024
+K = 6
+MYDEV = 2 * pid
+PEERDEV = 2 * (1 - pid)
+
+class Echo(rpc.Service):
+    SERVICE_NAME = "Echo"
+    @rpc.method(EchoRequest, EchoResponse)
+    def Bounce(self, cntl, request, response, done):
+        data = np.frombuffer(cntl.request_attachment.to_bytes(), np.uint8)
+        back = jax.device_put(jnp.asarray((data.astype(np.int64) + 1) %% 251,
+                                          dtype=jnp.uint8),
+                              jax.devices()[MYDEV])
+        jax.block_until_ready(back)
+        # device-resident response attachment: the RESPONSE rides kind-4
+        # too — both directions sequenced on ONE socket pair
+        cntl.response_attachment.append_device_array(back)
+        response.message = "ok"
+        done()
+
+server = rpc.Server(); server.add_service(Echo())
+assert server.start("ici://%%d" %% MYDEV) == 0
+kv.key_value_set("xb_up_%%d" %% pid, "1")
+kv.blocking_key_value_get("xb_up_%%d" %% (1 - pid), 60000)
+
+ch = rpc.Channel()
+ch.init("ici://%%d" %% PEERDEV,
+        options=rpc.ChannelOptions(timeout_ms=60000, max_retry=0))
+errs = []
+
+def fire(i):
+    val = (i * 7 + pid * 3 + 1) %% 251
+    payload = jax.device_put(jnp.full((N,), val, jnp.uint8),
+                             jax.devices()[MYDEV])
+    jax.block_until_ready(payload)
+    cntl = rpc.Controller()
+    cntl.request_attachment.append_device_array(payload)
+    resp = ch.call_method("Echo.Bounce", cntl,
+                          EchoRequest(message=str(i)), EchoResponse)
+    if cntl.failed():
+        errs.append((i, cntl.error_code_, cntl.error_text_))
+        return
+    got = np.frombuffer(cntl.response_attachment.to_bytes(), np.uint8)
+    if not (got == (val + 1) %% 251).all():
+        errs.append((i, "corrupt", int(got[0])))
+
+# both directions concurrently: two threads of K calls on each process
+threads = [threading.Thread(target=lambda lo=lo: [fire(i) for i in
+                                                  range(lo, lo + K)])
+           for lo in (0, K)]
+for t in threads: t.start()
+for t in threads: t.join()
+assert not errs, errs[:5]
+
+clients = [s for s in fabric_socks() if not s.is_server_side]
+servers = [s for s in fabric_socks() if s.is_server_side]
+assert len(clients) == 1 and len(servers) == 1, (clients, servers)
+c, s = clients[0], servers[0]
+# every call's request AND response crossed kind-4 (2K transfers per
+# socket: K send halves + K recv halves)
+deadline = time.time() + 30
+while (len(c._dplane_seq.executed) < 4 * K
+       or len(s._dplane_seq.executed) < 4 * K) and time.time() < deadline:
+    time.sleep(0.02)
+assert len(c._dplane_seq.executed) == 4 * K, len(c._dplane_seq.executed)
+assert len(s._dplane_seq.executed) == 4 * K, len(s._dplane_seq.executed)
+assert c._dplane_seq.master is False and s._dplane_seq.master is True
+assert c.dplane_bytes_sent >= 2 * K * N, c.dplane_bytes_sent
+assert c.dplane_bytes_recv >= 2 * K * N, c.dplane_bytes_recv
+# the bulk-carried leg moved the bytes (no compiled collectives on CPU)
+assert c.bulk_bytes_sent >= 2 * K * N, c.bulk_bytes_sent
+kv.key_value_set("xb_order_c_%%d" %% pid,
+                 json.dumps(list(c._dplane_seq.executed)))
+kv.key_value_set("xb_order_s_%%d" %% pid,
+                 json.dumps(list(s._dplane_seq.executed)))
+# pair A = my client socket <-> peer's server socket: IDENTICAL order
+peer_s = json.loads(kv.blocking_key_value_get(
+    "xb_order_s_%%d" %% (1 - pid), 60000))
+assert list(c._dplane_seq.executed) == peer_s, (
+    "total order diverged", list(c._dplane_seq.executed)[:8], peer_s[:8])
+kv.wait_at_barrier("xb_done", 120000)
+server.stop()
+print("XB%%d_OK" %% pid, flush=True)
+"""
+
+
+def test_xproc_bidirectional_total_order_and_byte_exactness():
+    """Concurrent device payloads both ways on one socket pair — the
+    per-direction-executor failure shape — must execute in ONE identical
+    total order on both processes, byte-exact."""
+    outs = _run_pod(_XPROC_BIDIR % {"repo": REPO}, n=2, timeout=240,
+                    tag="xproc_bidir")
+    assert "XB0_OK" in outs[0]
+    assert "XB1_OK" in outs[1]
+
+
+# ---------------------------------------------------------------------------
+# N=4 membership (no faults): join/advertise/resolve/drain/restart/leave.
+# Also the dryrun_multichip membership leg (__graft_entry__).
+# ---------------------------------------------------------------------------
+
+_POD_MEMBERSHIP = _POD_PRELUDE + r"""
+from brpc_tpu.ici.pod import Pod
+
+MYDEV = 2 * pid
+pod = Pod.join("dryrun")
+
+class Svc(rpc.Service):
+    SERVICE_NAME = "EchoService"
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = "m%%d" %% pid
+        done()
+
+server = rpc.Server(); server.add_service(Svc())
+assert server.start("ici://%%d" %% MYDEV) == 0
+pod.wait_epoch(2 * NPROC, timeout=60)        # join xN + advertise xN
+
+# pod:// naming resolves every member's serving device, identically
+from brpc_tpu.policy.naming import create_naming_service
+eps = sorted(str(e.endpoint)
+             for e in create_naming_service("pod://dryrun").get_servers())
+want = sorted("ici://%%d" %% (2 * p) for p in range(NPROC))
+assert eps == want, (eps, want)
+
+# an LB channel over the pod reaches every member
+ch = rpc.Channel()
+ch.init("pod://dryrun", "rr",
+        options=rpc.ChannelOptions(timeout_ms=30000, max_retry=2))
+seen = set()
+deadline = time.time() + 60
+while len(seen) < NPROC and time.time() < deadline:
+    cntl = rpc.Controller()
+    resp = ch.call_method("EchoService.Echo", cntl,
+                          EchoRequest(message="x"), EchoResponse)
+    assert not cntl.failed(), (cntl.error_code_, cntl.error_text_)
+    seen.add(resp.message)
+assert seen == {"m%%d" %% p for p in range(NPROC)}, seen
+
+# one member drains gracefully and restarts: everyone observes the
+# membership move through the epoch, and pod:// follows
+kv.wait_at_barrier("pm_resolved", 120000)
+if pid == NPROC - 1:
+    server.stop(2.0)                         # drain mark + withdraw
+    server2 = rpc.Server(); server2.add_service(Svc())
+    assert server2.start("ici://%%d" %% MYDEV) == 0
+    live_server = server2
+else:
+    live_server = server
+# drain mark + withdraw + restart advertise = 3 bumps
+FINAL = 2 * NPROC + 3
+pod.wait_epoch(FINAL, timeout=60)
+final = pod.members(refresh=True)
+from brpc_tpu.ici.pod import epoch_of
+assert epoch_of(final) == FINAL, (epoch_of(final), FINAL)
+assert all(final[p].serving == [2 * p] for p in range(NPROC)), {
+    p: final[p].serving for p in final}
+kv.wait_at_barrier("pm_done", 120000)
+live_server.stop()
+pod.leave()
+print("PM%%d_OK" %% pid, flush=True)
+"""
+
+
+def run_membership_n4(n: int = 4, timeout: int = 240) -> None:
+    """The N=4 membership leg, importable by __graft_entry__'s
+    dryrun_multichip: join/advertise/pod-naming/LB/drain/restart/epoch
+    convergence across 4 real processes, under the debug_sync runtime
+    lock-order layer."""
+    outs = _run_pod(_POD_MEMBERSHIP % {"repo": REPO}, n=n,
+                    timeout=timeout, tag="pod_membership")
+    for i in range(n):
+        assert f"PM{i}_OK" in outs[i], outs[i][-2000:]
+
+
+def test_pod_membership_join_resolve_drain_restart_n4():
+    """4 processes join the pod, pod:// resolves every serving member
+    identically everywhere, an LB channel reaches all four, a graceful
+    drain + restart moves the epoch on every member, and the final
+    membership converges."""
+    run_membership_n4()
+
+
+# ---------------------------------------------------------------------------
+# N=4 chaos: kill + drain under all-to-all traffic, revival, epoch rejoin.
+# ---------------------------------------------------------------------------
+
+_POD_CHAOS = _POD_PRELUDE + r"""
+from brpc_tpu.ici.pod import Pod
+
+MYDEV = 2 * pid
+pod = Pod.join("chaos")
+
+class Svc(rpc.Service):
+    SERVICE_NAME = "EchoService"
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = "t%%d:%%s" %% (pid, request.message)
+        done()
+
+server = rpc.Server(); server.add_service(Svc())
+assert server.start("ici://%%d" %% MYDEV) == 0
+# join x4 + advertise x4
+pod.wait_epoch(2 * NPROC, timeout=60)
+members = pod.members(refresh=True)
+assert sorted(members) == list(range(NPROC)), members
+assert all(members[p].serving == [2 * p] for p in range(NPROC)), {
+    p: members[p].serving for p in members}
+
+opts = rpc.ChannelOptions(timeout_ms=15000, max_retry=3)
+ch = rpc.Channel()
+ch.init("pod://chaos", "rr", options=opts)
+
+failures = []
+seen = set()
+seen_lock = threading.Lock()
+
+def fire(i):
+    cntl = rpc.Controller()
+    resp = ch.call_method("EchoService.Echo", cntl,
+                          EchoRequest(message=str(i)), EchoResponse)
+    if cntl.failed():
+        failures.append((i, cntl.error_code_, cntl.error_text_))
+    else:
+        with seen_lock:
+            seen.add(resp.message.split(":")[0])
+
+# ---- phase 1: all-to-all warmup — every member sees every tag --------
+deadline = time.time() + 60
+i = 0
+while time.time() < deadline:
+    fire(i); i += 1
+    with seen_lock:
+        if len(seen) == NPROC:
+            break
+assert len(seen) == NPROC, seen
+assert not failures, failures[:5]
+print("PHASE warm %%d" %% pid, flush=True)
+kv.wait_at_barrier("pc_warm", 120000)
+
+if pid in (0, 1):
+    # ---- surviving pair: continuous traffic, ZERO visible failures ----
+    stop_traffic = threading.Event()
+    def traffic():
+        j = 100000 * (pid + 1)
+        while not stop_traffic.is_set():
+            fire(j); j += 1
+            time.sleep(0.01)
+    # daemon: an assertion failure on the main thread must exit the
+    # child with its traceback, not hang behind the traffic loop
+    th = threading.Thread(target=traffic, daemon=True)
+    th.start()
+    old_sid = None
+    if pid == 0:
+        # direct channel to the kill target: record the pre-kill socket id
+        dch = rpc.Channel()
+        dch.init("ici://4", options=rpc.ChannelOptions(timeout_ms=15000,
+                                                       max_retry=0))
+        cntl = rpc.Controller()
+        dch.call_method("EchoService.Echo", cntl, EchoRequest(message="d"),
+                        EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        socks = [s for s in fabric_socks() if s.remote_dev == 4]
+        assert socks, "no fabric socket to the kill target before the kill"
+        old_sid = socks[0].id
+        kv.key_value_set("pc_presock", "1")
+    kv.key_value_set("pc_traffic_on_%%d" %% pid, "1")
+    print("PHASE traffic_on %%d" %% pid, flush=True)
+    kv.blocking_key_value_get("pc_revived", 180000)
+    print("PHASE saw_revived %%d" %% pid, flush=True)
+    # ---- post-revival: both transitioned members serve again ----------
+    with seen_lock:
+        seen.clear()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        with seen_lock:
+            if "t2" in seen and "t3" in seen:
+                break
+        time.sleep(0.05)
+    stop_traffic.set()
+    th.join(30)
+    print("PHASE post_revival_seen %%d %%s" %% (pid, sorted(seen)), flush=True)
+    with seen_lock:
+        assert "t2" in seen, ("killed member never revived into LB", seen)
+        assert "t3" in seen, ("drained member never restarted into LB",
+                              seen)
+    # THE contract: kill + drain under continuous all-to-all traffic was
+    # client-invisible on surviving pairs
+    assert not failures, failures[:5]
+    if pid == 0:
+        # revived under a NEW socket id; the old id is revoked
+        cntl = rpc.Controller()
+        cntl.timeout_ms = 20000
+        cntl.max_retry = 40
+        cntl.retry_backoff_ms = 50
+        resp = dch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="post"), EchoResponse)
+        assert not cntl.failed(), (cntl.error_code_, cntl.error_text_)
+        assert resp.message == "t2:post", (
+            "direct post-revival call answered by the wrong server",
+            resp.message)
+        # The pre-kill socket saw a GRACEFUL EOF (the kill's
+        # shutdown(SHUT_RDWR) is a plain FIN, no error code), and a
+        # graceful EOF deliberately rides the ORDERED delivery queue —
+        # the zombie (peer-gone, unfailed) lingers in the pool until the
+        # messenger drains end-of-stream, which under the instrumented
+        # debug_sync locks can trail this assert.  The contract is
+        # EVENTUAL revocation: wait for it, then require every usable
+        # socket to carry a NEW id.
+        eod = time.time() + 60
+        while Socket.address(old_sid) is not None and time.time() < eod:
+            time.sleep(0.05)
+        assert Socket.address(old_sid) is None, \
+            "stale pre-kill socket id must not resolve"
+        new_socks = [s for s in fabric_socks()
+                     if s.remote_dev == 4 and not s.failed
+                     and not s._peer_gone()]
+        assert new_socks, "no live socket to the revived member"
+        assert all(s.id != old_sid for s in new_socks), (
+            "revived member reached through the PRE-KILL socket id",
+            old_sid, [s.id for s in new_socks])
+elif pid == 2:
+    # ---- the KILL: process-death-equivalent for the serving endpoint.
+    # No GOODBYE, no pod withdraw — the record still claims "serving",
+    # exactly like a crashed process; liveness is the health checker's
+    # job, membership only moves again at REVIVAL (the gen bump).
+    kv.blocking_key_value_get("pc_traffic_on_0", 60000)
+    kv.blocking_key_value_get("pc_traffic_on_1", 60000)
+    kv.blocking_key_value_get("pc_presock", 60000)
+    import socket as pysock
+    from brpc_tpu.ici.transport import ici_unlisten
+    ici_unlisten(MYDEV)
+    nb = getattr(server, "_native_ici", None)
+    if nb is not None:
+        nb.stop()
+    for s in fabric_socks():
+        if s.is_server_side:
+            try:
+                s._conn.shutdown(pysock.SHUT_RDWR)
+            except OSError:
+                pass
+    kv.key_value_set("pc_killed", "1")
+    # the kill itself moved no membership: OUR record (only this process
+    # writes it) still claims serving with the join+advertise gen — the
+    # crashed-process shape; the gen moves again only at revival
+    time.sleep(1.0)
+    assert pod.members(refresh=True)[pid].gen == 2, (
+        "the kill must not move membership",
+        pod.members(refresh=True)[pid].describe())
+    kv.blocking_key_value_get("pc_drained", 180000)
+    time.sleep(0.5)
+    server2 = rpc.Server(); server2.add_service(Svc())
+    assert server2.start("ici://%%d" %% MYDEV) == 0   # the revival
+    kv.key_value_set("pc_revived", "1")
+    live_server = server2
+    kv.wait_at_barrier("pc_done", 300000)
+else:
+    # ---- pid 3: graceful lame-duck drain mid-traffic, then restart ----
+    kv.blocking_key_value_get("pc_killed", 60000)
+    time.sleep(1.0)              # surviving traffic rides the outage
+    t0 = time.monotonic()
+    server.stop(5.0)             # drain: GOODBYE + pod draining mark
+    dt = time.monotonic() - t0
+    assert dt < 4.0, ("drain should converge well before grace", dt)
+    time.sleep(0.3)
+    server_b = rpc.Server(); server_b.add_service(Svc())
+    assert server_b.start("ici://%%d" %% MYDEV) == 0
+    kv.key_value_set("pc_drained", "1")
+    live_server = server_b
+    kv.blocking_key_value_get("pc_revived", 180000)
+    kv.wait_at_barrier("pc_done", 300000)
+
+if pid in (0, 1):
+    live_server = server
+    kv.wait_at_barrier("pc_done", 300000)
+
+# ---- epoch convergence: every member computes the same final epoch ----
+# join x4 (4) + advertise x4 (4) + drain mark (1) + drain withdraw (1)
+# + restart advertise (1) + revival advertise (1) = 12
+print("PHASE pre_epoch %%d" %% pid, flush=True)
+FINAL = 2 * NPROC + 4
+pod.wait_epoch(FINAL, timeout=60)
+final_members = pod.members(refresh=True)
+assert Pod.current() is pod, "pod singleton changed mid-scenario"
+from brpc_tpu.ici.pod import epoch_of
+assert epoch_of(final_members) == FINAL, (epoch_of(final_members), FINAL)
+assert all(final_members[p].state == "up" for p in range(NPROC))
+assert all(final_members[p].serving == [2 * p] for p in range(NPROC)), {
+    p: final_members[p].serving for p in final_members}
+kv.wait_at_barrier("pc_exit", 300000)
+live_server.stop()
+pod.leave()
+print("PC%%d_OK" %% pid, flush=True)
+"""
+
+
+def test_pod_chaos_kill_and_drain_under_all_to_all_n4():
+    """The acceptance contract: N=4 all-to-all traffic; one member's
+    serving endpoint killed, another drained mid-traffic; zero
+    client-visible failures on surviving pairs; the killed member
+    revives under a new socket id and rejoins the pod epoch, which
+    converges to the same value on every member."""
+    outs = _run_pod(_POD_CHAOS % {"repo": REPO}, n=4, timeout=300,
+                    tag="pod_chaos")
+    for i in range(4):
+        assert f"PC{i}_OK" in outs[i], outs[i][-2000:]

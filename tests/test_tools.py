@@ -66,6 +66,43 @@ class TestRpcPress:
         finally:
             server.stop()
 
+    def test_press_multi_endpoint_reports_per_endpoint_counts(self):
+        """A comma-separated --server list drives every endpoint from
+        one process and the summary carries per-endpoint sent/errors/qps
+        (the pod/overload-bench shape)."""
+        from brpc_tpu.tools.rpc_press import run_press
+        pairs = [start_server() for _ in range(3)]
+        targets = [t for _s, t in pairs]
+        try:
+            result = run_press(",".join(targets), "EchoService.Echo",
+                               '{"message":"p"}', qps=0, duration=0.5,
+                               concurrency=3,
+                               proto="tests.echo_pb2:EchoRequest,"
+                                     "EchoResponse",
+                               out=io.StringIO())
+            assert result["errors"] == 0
+            per = result["per_endpoint"]
+            assert sorted(per) == sorted(targets)
+            assert all(c["sent"] > 0 for c in per.values()), per
+            assert sum(c["sent"] for c in per.values()) == result["sent"]
+            assert all(c["qps"] > 0 for c in per.values()), per
+        finally:
+            for s, _t in pairs:
+                s.stop()
+
+    def test_resolve_targets(self):
+        """Endpoint lists split (single endpoints pass through); naming
+        urls resolve through the naming service; an empty resolution is
+        a hard error, not a silent single-channel run."""
+        from brpc_tpu.tools.rpc_press import resolve_targets
+        assert resolve_targets("mem://solo") == ["mem://solo"]
+        assert resolve_targets("mem://a,mem://b") == ["mem://a",
+                                                      "mem://b"]
+        got = resolve_targets("list://mem://x,mem://y")
+        assert sorted(got) == ["mem://x", "mem://y"], got
+        with pytest.raises(SystemExit):
+            resolve_targets("pod://never-joined")
+
     def test_press_sigint_stops_gracefully_with_final_summary(self):
         """^C mid-run stops ISSUING, drains in-flight calls, and still
         prints the final latency/QPS summary — run as a subprocess so
