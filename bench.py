@@ -114,6 +114,107 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
     return out
 
 
+def bench_rpcz_overhead(iters: int = 300, payload_bytes: int = 4096):
+    """Tracing cost (BENCH extra from PR 7 on): the headline-shaped echo
+    (ici:// with a device payload, per-call from Python) with
+    rpcz_enabled ON at default sampling vs OFF.  The acceptance budget is
+    <= 10%% headline-p50 cost with tracing on; the default 'sampled'
+    stage-metrics mode keeps recorder cost off unsampled requests, so
+    the on/off delta is span creation + sampling-gate checks."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc
+    from brpc_tpu.butil import flags as fl
+    from brpc_tpu.ici.mesh import IciMesh
+    sys.path.insert(0, "tests")
+    from tests.echo_pb2 import EchoRequest, EchoResponse
+
+    mesh = IciMesh.default()
+
+    class EchoService(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = request.message
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+            done()
+
+    opts = rpc.ServerOptions()
+    opts.usercode_inline = True
+    server = rpc.Server(opts)
+    server.add_service(EchoService())
+    server.start("ici://0")
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=10000,
+                                                  max_retry=0))
+    payload = jax.device_put(jnp.arange(payload_bytes, dtype=jnp.uint8),
+                             mesh.device(0))
+    jax.block_until_ready(payload)
+
+    def drive(n):
+        lat = []
+        for i in range(n + 30):
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            t0 = _time.perf_counter_ns()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="b"), EchoResponse)
+            t1 = _time.perf_counter_ns()
+            if cntl.failed():
+                raise RuntimeError(f"echo failed: {cntl.error_text}")
+            if i >= 30:
+                lat.append((t1 - t0) / 1000.0)
+        lat.sort()
+        return lat
+
+    # interleaved off/on rounds, median of per-round p50s: a single
+    # off-then-on pass measures warmup order, not tracing cost (the
+    # tail_isolation methodology)
+    old = fl.get_flag("rpcz_enabled")
+    rounds = 3
+    per = max(iters // rounds, 50)
+    offs, ons = [], []
+    try:
+        drive(60)                    # shared warmup
+        for _ in range(rounds):
+            fl.set_flag("rpcz_enabled", False)
+            lat = drive(per)
+            offs.append(lat[len(lat) // 2])
+            fl.set_flag("rpcz_enabled", True)
+            lat = drive(per)
+            ons.append(lat[len(lat) // 2])
+    finally:
+        fl.set_flag("rpcz_enabled", old)
+    server.stop()
+    ch.close()
+    p50_off = statistics.median(offs)
+    p50_on = statistics.median(ons)
+    # paired per-round deltas cancel host-load drift BETWEEN rounds (a
+    # loaded 1-core container drifts far more than tracing costs); the
+    # median delta is the estimate, the delta spread its noise floor
+    deltas = [100.0 * (on - off) / off
+              for off, on in zip(offs, ons) if off > 0]
+    raw = statistics.median(deltas) if deltas else -1.0
+    spread_pct = (max(deltas) - min(deltas)) if deltas else 0.0
+    # a negative overhead within the spread is measurement noise,
+    # clamped with the raw value kept alongside; a REAL negative
+    # (outside the spread) would be a methodology bug worth seeing
+    clamped = 0.0 <= -raw <= spread_pct
+    return {
+        "rpcz_off_p50_us": p50_off,
+        "rpcz_on_p50_us": p50_on,
+        "rpcz_overhead_pct": 0.0 if clamped else raw,
+        "rpcz_overhead_pct_raw": raw,
+        "rpcz_overhead_clamped_noise": clamped,
+        "rpcz_round_spread_pct": spread_pct,
+        "devices": len(jax.devices()),
+    }
+
+
 def _pin_cpu_mesh_if_requested() -> None:
     """Virtual-CPU-mesh fallback guard shared by the mesh subbenches:
     pin the platform BEFORE backend init or the axon TPU plugin wins
@@ -1152,6 +1253,9 @@ def main() -> None:
     print(f"# python-stack ici echo: {echo}", file=sys.stderr)
     # same backend: if echo couldn't reach the device, don't burn another
     # timeout window on allreduce
+    # tracing-cost extra: headline-shaped echo, rpcz on vs off
+    rzo = _run_subbench("rpcz_overhead") if device_ok else {}
+    print(f"# rpcz overhead: {rzo}", file=sys.stderr)
     ar = _run_subbench("allreduce") if device_ok else {}
     print(f"# allreduce: {ar}", file=sys.stderr)
     # relocation tier: the transfer the project is named for.  On >= 2
@@ -1328,6 +1432,9 @@ def main() -> None:
             ring["kv_bytes_per_chip_ring"]
             / ring["kv_bytes_per_chip_dense"], 3)
             if ring.get("devices") else -1.0),
+        "rpcz_off_p50_us": round(rzo.get("rpcz_off_p50_us", -1.0), 1),
+        "rpcz_on_p50_us": round(rzo.get("rpcz_on_p50_us", -1.0), 1),
+        "rpcz_overhead_pct": round(rzo.get("rpcz_overhead_pct", -1.0), 1),
         "python_stack_qps": round(qps.get("qps", 0.0), 0),
         "ici_native_plane_qps": round(iqps.get("qps", -1.0), 0),
         "streaming_mbps": round(strm.get("stream_mbps", 0.0), 1),
@@ -1389,6 +1496,7 @@ if __name__ == "__main__":
               "relocation": bench_relocation,
               "device_plane": bench_device_plane,
               "ring_attention": bench_ring_attention,
+              "rpcz_overhead": bench_rpcz_overhead,
               "pod_prefill_decode": bench_pod_prefill_decode}[sys.argv[2]]
         print(_json.dumps(fn()))
     else:

@@ -133,10 +133,11 @@ class DeviceTransfer:
 
     __slots__ = ("uuid", "src_dev", "dst_dev", "nbytes", "state", "error",
                  "out", "completion", "posted_ns", "matched_ns",
-                 "complete_ns", "_src_arr", "_releases", "_lock")
+                 "complete_ns", "_src_arr", "_releases", "_lock",
+                 "trace_id", "parent_span_id", "span")
 
     def __init__(self, uuid: int, src_dev: int, dst_dev: int, nbytes: int,
-                 src_arr=None):
+                 src_arr=None, trace_id: int = 0, parent_span_id: int = 0):
         self.uuid = uuid
         self.src_dev = src_dev
         self.dst_dev = dst_dev
@@ -151,6 +152,23 @@ class DeviceTransfer:
         self._src_arr = src_arr        # the pin (rdma_endpoint.cpp:926)
         self._releases: List[Callable[[], None]] = []
         self._lock = _dbg.make_lock("DeviceTransfer._lock")
+        # trace context: the RPC span this transfer belongs to, captured
+        # at post time (sender) or carried in the kind-4 descriptor
+        # (receiver), so the transfer's lifecycle lands in the SAME
+        # trace on both processes.  With a context and sampling on, the
+        # transfer owns its own SpanDB entry (a "transfer" span parented
+        # under the RPC span); without one, annotations degrade to the
+        # bthread-local current span, the pre-pod behavior.
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.span = None
+        if trace_id:
+            from ..rpc import span as _span
+            if _span.rpcz_enabled():
+                self.span = _span.start_transfer_span(
+                    f"device_plane ici://{src_dev}->{dst_dev} "
+                    f"{'send' if src_arr is not None else 'recv'} "
+                    f"{nbytes}B", trace_id, parent_span_id)
 
     # -- source pin ------------------------------------------------------
     def add_source_release(self, cb: Optional[Callable[[], None]]) -> None:
@@ -465,8 +483,14 @@ class DevicePlane:
             raise DevicePlaneError("device plane is point-to-point; "
                                    "same-device payloads are ref passes")
         nbytes = int(arr.shape[0])
+        # trace context at post time: the server span being served, or
+        # the ACTIVE client span (channel write path) — the context the
+        # kind-4 descriptor carries to the receiver
+        from ..rpc import span as _span
+        tid, psid = _span.current_trace_context()
         t = DeviceTransfer(uuid if uuid is not None else self.next_uuid(),
-                           src_dev, dst_dev, nbytes, src_arr=arr)
+                           src_dev, dst_dev, nbytes, src_arr=arr,
+                           trace_id=tid, parent_span_id=psid)
         # compile (or fetch) NOW: a compilation error must surface before
         # the descriptor is committed to any wire
         try:
@@ -515,13 +539,18 @@ class DevicePlane:
 
     # ---- fabric (multi-controller) halves ------------------------------
     def post_recv_remote(self, uuid: int, nbytes: int, src_dev: int,
-                         dst_dev: int, socket=None) -> DeviceTransfer:
+                         dst_dev: int, socket=None, trace_id: int = 0,
+                         parent_span_id: int = 0) -> DeviceTransfer:
         """Receiver half of a cross-process transfer: the descriptor
         arrived on the control channel; register the recv WR.  The
         collective itself runs on the fabric socket's executor (control
         order = execution order on both sides, the SPMD ordering
-        contract)."""
-        t = DeviceTransfer(uuid, src_dev, dst_dev, nbytes)
+        contract).  ``trace_id``/``parent_span_id`` come off the kind-4
+        descriptor, so the receiver's half of the transfer joins the
+        sender's trace."""
+        t = DeviceTransfer(uuid, src_dev, dst_dev, nbytes,
+                           trace_id=trace_id,
+                           parent_span_id=parent_span_id)
         self._track(t)
         self._recent.append(t)
         self._annotate(t, "recv enqueued")
@@ -598,7 +627,12 @@ class DevicePlane:
                 _g_bytes_recv << t.nbytes
             t._release_source()
             self._untrack(t)
-            self._annotate(t, "complete")
+            # pin hold-time: posted→complete is exactly how long the
+            # source HBM block stayed pinned (the :926 discipline)
+            self._annotate(
+                t, "complete pin_held_us="
+                   f"{(t.complete_ns - t.posted_ns) // 1000}")
+            self._close_span(t, 0)
             t.completion.signal(0)
 
         if out is not None:
@@ -614,6 +648,7 @@ class DevicePlane:
         t._release_source()
         self._untrack(t)
         self._annotate(t, f"failed: {reason}")
+        self._close_span(t, 1)
         t.completion.signal(1)
 
     def fail_transfer(self, t: DeviceTransfer, reason: str) -> None:
@@ -685,9 +720,28 @@ class DevicePlane:
     # ---- observability -------------------------------------------------
     def _annotate(self, t: DeviceTransfer, what: str) -> None:
         from ..rpc import span as _span
-        _span.annotate_current(
-            f"device_plane {what} uuid={t.uuid:#x} "
-            f"ici://{t.src_dev}->{t.dst_dev} {t.nbytes}B")
+        text = (f"device_plane {what} uuid={t.uuid:#x} "
+                f"ici://{t.src_dev}->{t.dst_dev} {t.nbytes}B")
+        if t.span is not None:
+            # the transfer owns a span in the RPC's trace: its lifecycle
+            # lands there on BOTH processes (the receiver's context rode
+            # the descriptor) instead of on whatever span happens to be
+            # bthread-local on one side
+            t.span.annotate(text)
+        else:
+            _span.annotate_current(text)
+
+    def annotate_transfer(self, t: DeviceTransfer, what: str) -> None:
+        """Public hook for transfer-lifecycle events raised OUTSIDE the
+        plane (the CollectiveSequencer's assignment/queue-wait/admit)."""
+        self._annotate(t, what)
+
+    @staticmethod
+    def _close_span(t: DeviceTransfer, error_code: int) -> None:
+        from ..rpc import span as _span
+        span, t.span = t.span, None
+        if span is not None:
+            _span.end_span(span, error_code)
 
     def pending_sends(self) -> int:
         with self._lock:

@@ -180,6 +180,13 @@ _F_GOODBYE = 15
 # a client-side send goes out with seq -1 in its kind-4 descriptor and
 # receives its assignment in this frame (u64 uuid, i64 seq)
 _F_DPLANE_SEQ = 16
+# Clock alignment (ici/clock.py) deliberately adds NO frame type: the
+# NTP-style exchange piggybacks on the HELLO/HELLO_OK handshake (the
+# client's wall t0 rides the HELLO json; HELLO_OK echoes it with the
+# server's wall), so the chaos suite's deterministic control-frame
+# counting — and the read loop — never see it.  The dialing side derives
+# the peer offset ± RTT/2; since every pod-scope stitch query DIALS its
+# members (client-side sockets), the querier always holds an estimate.
 
 _HDR = struct.Struct("<BI")          # type, body length
 
@@ -362,13 +369,15 @@ class FabricNode:
         if _flags.get_flag("ici_device_plane"):
             # device-plane capability advert (both ends must hold it:
             # one-sided entry into an SPMD program would hang forever).
-            # Version 2 = sequenced kind-4 descriptors (<Iq> src+seq and
-            # the _F_DPLANE_SEQ assignment frame), advertised under a
-            # NEW key so the treat-as-plane-less rule holds in BOTH
-            # directions: a v1 peer checks "dplane" (absent here — it
-            # never sends its 4-byte descriptors at us) and we check
-            # "dplane2" (absent on v1 — we never send <Iq> at it).
-            info["dplane2"] = 2
+            # Version 3 = sequenced AND traced kind-4 descriptors
+            # (<IqQQ> src+seq+trace_id+parent_span_id, plus the
+            # _F_DPLANE_SEQ assignment frame), advertised under a NEW
+            # key so the treat-as-plane-less rule holds in BOTH
+            # directions across every version pair: a v1/v2 peer checks
+            # "dplane"/"dplane2" (absent here — it never sends its
+            # narrower descriptors at us) and we check "dplane3"
+            # (absent on v1/v2 — we never send <IqQQ> at it).
+            info["dplane3"] = 3
         self._kv.key_value_set(_KV_PREFIX + str(self.process_id),
                                json.dumps(info))
         log.info("fabric: process %d/%d up ctrl=%s xfer=%s devices=%s",
@@ -570,7 +579,15 @@ class FabricNode:
             # read — a reader that fires first would drain the input
             # event with no messenger and drop the first request
             listener.on_accept(sock)
-            _send_frame(conn, _F_HELLO_OK, b"")
+            # clock-alignment piggyback (ici/clock.py): echo the
+            # client's wall t0 with OUR wall stamp — the client bounds
+            # our offset by its HELLO round trip.  Empty for old peers.
+            ok_body = b""
+            if "wall_us" in hello:
+                ok_body = json.dumps(
+                    {"t0": hello["wall_us"],
+                     "wall_us": time.time_ns() // 1000}).encode()
+            _send_frame(conn, _F_HELLO_OK, ok_body)
             sock.start_io()
         except Exception as e:
             log.error("fabric handshake failed: %s", e)
@@ -655,9 +672,14 @@ class FabricNode:
         # have the native core; either missing -> transfer-server path)
         bulk_h, bulk_key, lib = self.dial_bulk(owner)
         hello = {"target_dev": target_dev, "client_dev": client_dev,
-                 "pid": self.process_id}
+                 "pid": self.process_id,
+                 # clock-alignment piggyback: our wall at HELLO send;
+                 # the HELLO_OK echo + server wall bounds the peer
+                 # offset by this round trip (±RTT/2, ici/clock.py)
+                 "wall_us": time.time_ns() // 1000}
         if bulk_key:
             hello["bulk_key"] = bulk_key
+        t0_mono = time.monotonic_ns()
         try:
             _send_frame(conn, _F_HELLO, json.dumps(hello).encode())
             fr = _recv_frame(conn)
@@ -675,6 +697,19 @@ class FabricNode:
             if bulk_h:
                 lib.brpc_tpu_fab_conn_close(bulk_h)
             raise ConnectionRefusedError(f"fabric: {msg}")
+        if fr[1]:
+            try:
+                echo = json.loads(fr[1])
+                rtt_us = max(0, (time.monotonic_ns() - t0_mono) // 1000)
+                # +1: a 0 bound would claim perfection no measurement
+                # can prove
+                from . import clock as _clock
+                _clock.record(
+                    owner,
+                    echo["wall_us"] - (echo["t0"] + rtt_us / 2.0),
+                    rtt_us / 2.0 + 1.0)
+            except (ValueError, KeyError, TypeError):
+                pass          # old peer / malformed echo: no estimate
         sock = FabricSocket(conn, local_dev=client_dev,
                             remote_dev=target_dev, peer_pid=owner, node=self)
         if bulk_h:
@@ -756,9 +791,15 @@ class CollectiveSequencer:
                 self._next_assign += 1
                 self._ready[seq] = t
                 self._cv.notify_all()
-                return seq
-            self._unassigned[t.uuid] = t
-            return -1
+            else:
+                self._unassigned[t.uuid] = t
+                seq = -1
+        if seq >= 0:
+            _dp.plane().annotate_transfer(t, f"seq assigned {seq}")
+        else:
+            _dp.plane().annotate_transfer(
+                t, "seq parked (awaiting master assignment)")
+        return seq
 
     def submit_remote(self, t, seq: int) -> None:
         """Admit a transfer the PEER is sending (its kind-4 descriptor
@@ -781,6 +822,7 @@ class CollectiveSequencer:
                 self._next_assign += 1
             self._ready[seq] = t
             self._cv.notify_all()
+        _dp.plane().annotate_transfer(t, f"seq assigned {seq}")
         if assign is not None:
             try:
                 self.sock._ctrl_send(_F_DPLANE_SEQ,
@@ -804,6 +846,8 @@ class CollectiveSequencer:
                 return
             self._ready[seq] = t
             self._cv.notify_all()
+        _dp.plane().annotate_transfer(t, f"seq assigned {seq} "
+                                         "(master reply)")
 
     def _run_loop(self) -> None:
         leftovers: List = []
@@ -832,6 +876,9 @@ class CollectiveSequencer:
         if sock.failed or sock._peer_gone():
             _dp.plane().fail_transfer(t, "socket failed before execution")
             return
+        _dp.plane().annotate_transfer(
+            t, "seq admit queue_wait_us="
+               f"{(time.monotonic_ns() - t.posted_ns) // 1000}")
         try:
             if _dp.xproc_compiled_ok():
                 _dp.plane().execute_remote(t)
@@ -950,12 +997,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         # processes execute in ONE agreed total order (CollectiveSequencer
         # — compiled collectives on capable backends, bulk-carried
         # elsewhere).  Down-latched on failure with a timed re-probe.
-        # Capability advert version 2 = sequenced descriptors (<Iq>)
-        # under the "dplane2" key; a version-1 peer's unsequenced wire
-        # format is not spoken anymore, and v1 never sends at us either
-        # (it keys on "dplane", which v2 no longer publishes).
+        # Capability advert version 3 = sequenced + traced descriptors
+        # (<IqQQ>) under the "dplane3" key; older peers' narrower wire
+        # formats are not spoken anymore, and they never send at us
+        # either (they key on "dplane"/"dplane2", which v3 no longer
+        # publishes).
         self._dplane_peer = \
-            node.peer_info(peer_pid).get("dplane2", 0) >= 2
+            node.peer_info(peer_pid).get("dplane3", 0) >= 3
         self._dplane_lock = _dbg.make_lock("FabricSocket._dplane_lock")
         self._dplane_down_until = 0.0      # 0 = up; else re-probe deadline
         self._dplane_seq: Optional[CollectiveSequencer] = None   # lazy
@@ -1399,6 +1447,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             # control stream until its transport is decided).
             dplane_src = -1
             dplane_seq = -1
+            dplane_trace = (0, 0)
             if (hasattr(arr, "devices")
                     and self._dplane_usable(r.length)):
                 # the route's true source is wherever the array LIVES —
@@ -1428,6 +1477,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                         dplane_seq = assigned
                         uuid = t.uuid
                         dplane_src = src_idx
+                        dplane_trace = (t.trace_id, t.parent_span_id)
                         kind = 4
                         self.dplane_bytes_sent += r.length
                     except _dp.DevicePlaneError as e:
@@ -1488,8 +1538,12 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             if kind == 4:
                 # src device + the sequencer's total-order slot (-1 when
                 # this side is the client: the master assigns on receipt
-                # and answers with _F_DPLANE_SEQ)
-                out.append(struct.pack("<Iq", dplane_src, dplane_seq))
+                # and answers with _F_DPLANE_SEQ) + the trace context the
+                # transfer belongs to (0,0 when the RPC wasn't sampled):
+                # the RECEIVER parents its transfer span under the same
+                # RPC span, so both halves land in one stitched trace
+                out.append(struct.pack("<IqQQ", dplane_src, dplane_seq,
+                                       dplane_trace[0], dplane_trace[1]))
             nchunks += 1
         flush_host()
         out[0] = struct.pack("<I", nchunks)
@@ -1736,8 +1790,9 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 (length,) = struct.unpack_from("<Q", body, off)
                 off += 8
                 if kind == 4:
-                    src_dev, dseq = struct.unpack_from("<Iq", body, off)
-                    off += 12
+                    src_dev, dseq, d_tid, d_psid = struct.unpack_from(
+                        "<IqQQ", body, off)
+                    off += 28
                     # device-plane descriptor: enqueue the matching recv
                     # at its slot in the total order (the rendezvous);
                     # when we are the master and the peer sent -1, the
@@ -1746,7 +1801,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     # with _F_DPLANE_SEQ
                     t = _dp.plane().post_recv_remote(
                         uuid, length, src_dev=src_dev,
-                        dst_dev=self.local_dev, socket=self)
+                        dst_dev=self.local_dev, socket=self,
+                        trace_id=d_tid, parent_span_id=d_psid)
                     seqr = self._dplane_sequencer()
                     if seqr is None:
                         _dp.plane().fail_transfer(

@@ -96,6 +96,31 @@ def is_naming_url(target: str) -> bool:
         ("mem://", "ici://", "tcp://"))
 
 
+def resolve_servers(target: str) -> List[str]:
+    """One endpoint url per resolved server — the ONE resolver the CLI
+    tools (rpc_press, rpc_view) share.  A naming url resolves through
+    its naming service; a comma-separated list is split (ici mesh
+    coords' parens respected); a single endpoint passes through.
+    Raises ValueError on empty resolution — a typo'd pod name must not
+    silently target nothing."""
+    # a COMMA LIST whose first entry is a bare host:port but whose later
+    # entries carry schemes ("127.0.0.1:80,mem://x") contains "://" and
+    # would satisfy is_naming_url — but a real naming url's scheme part
+    # (before the first "://") can never contain a comma
+    if is_naming_url(target) and "," not in target.split("://", 1)[0]:
+        entries = create_naming_service(target).get_servers()
+        out = [str(e.endpoint) for e in entries]
+        if not out:
+            raise ValueError(f"{target} resolved to no servers")
+        return out
+    if "," in target:
+        out = _split_list(target)
+        if not out:
+            raise ValueError(f"empty server list {target!r}")
+        return out
+    return [target]
+
+
 class ListNamingService(NamingService):
     def __init__(self, body: str):
         self._entries = []

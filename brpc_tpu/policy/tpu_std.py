@@ -13,7 +13,9 @@ from __future__ import annotations
 import time
 from typing import Any
 
+from .. import bvar
 from ..butil.iobuf import IOBuf
+from ..butil import flags as _flags
 from ..butil import logging as log
 from ..bthread import id as bthread_id
 from ..proto import rpc_meta_pb2 as meta_pb
@@ -25,14 +27,59 @@ from ..rpc import compress as compress_mod
 MAGIC = b"TRPC"
 HEADER_SIZE = 12
 
+# ---- server-side latency decomposition (ROADMAP item 1's measurement
+# substrate): where does a request's time go on the tpu_std/ici server
+# path?  Five stages, each a LatencyRecorder (p50..p9999 exposed under
+# tpu_std_server_<stage>_*) plus an rpcz annotation on the request's
+# span:
+#   queue   — frame cut on the read loop → process_request entry
+#             (messenger dispatch + usercode-pool queue wait)
+#   parse   — request payload decompress + ParseFromString
+#   handler — md.invoke → done() (user code)
+#   encode  — response meta/payload serialization + frame pack
+#   write   — socket.write (transport enqueue + inline drain)
+# Default "sampled" decomposes only rpcz-sampled requests, as SPAN
+# ANNOTATIONS only — a LatencyRecorder `<<` measures ~4 µs and five
+# stages would burn ~27 µs per request, blowing the ≤10% tracing
+# budget on the 46 µs Python-handler path.  "on" additionally feeds
+# the five tpu_std_server_<stage> recorders on EVERY request (the
+# /vars-distribution mode for dedicated measurement runs); "off"
+# disables everything.
+_flags.define_flag("tpu_std_stage_metrics", "sampled",
+                   "per-stage server latency decomposition: 'sampled' "
+                   "(annotations on rpcz-sampled spans), 'on' (every "
+                   "request + bvar recorders), 'off'")
+
+_STAGES = ("queue", "parse", "handler", "encode", "write")
+_stage_recorders = {s: bvar.LatencyRecorder(f"tpu_std_server_{s}")
+                    for s in _STAGES}
+
+
+def _stages_active(cntl: Controller) -> bool:
+    mode = _flags.get_flag("tpu_std_stage_metrics")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return cntl.span is not None
+
+
+def _record_stage(stage: str, us: int, span) -> None:
+    if _flags.get_flag("tpu_std_stage_metrics") == "on":
+        _stage_recorders[stage] << us
+    if span is not None:
+        span.annotate(f"{stage}_us={us}")
+
 
 class StdMessage:
-    """A cut but not yet parsed frame."""
-    __slots__ = ("meta", "body")
+    """A cut but not yet parsed frame.  ``recv_ns`` stamps the cut on
+    the read loop — the queue-wait stage's start."""
+    __slots__ = ("meta", "body", "recv_ns")
 
     def __init__(self, meta: meta_pb.RpcMeta, body: IOBuf):
         self.meta = meta
         self.body = body
+        self.recv_ns = 0
 
 
 # ---- frame codec ------------------------------------------------------
@@ -70,7 +117,9 @@ def parse(source: IOBuf, socket, read_eof: bool, arg) -> ParseResult:
         meta.ParseFromString(meta_buf.to_bytes())
     except Exception as e:
         return ParseResult.parse_error(f"bad meta: {e}")
-    return ParseResult.ok(StdMessage(meta, body))
+    msg = StdMessage(meta, body)
+    msg.recv_ns = time.monotonic_ns()
+    return ParseResult.ok(msg)
 
 
 # ---- client side ------------------------------------------------------
@@ -184,11 +233,21 @@ def process_request(msg: StdMessage, socket, server) -> None:
     from ..rpc.span import start_server_span, end_server_span
     start_server_span(cntl, full_name, req_meta.trace_id,
                       req_meta.span_id)
+    stages = _stages_active(cntl)
+    if stages and msg.recv_ns:
+        _record_stage("queue",
+                      (time.monotonic_ns() - msg.recv_ns) // 1000,
+                      cntl.span)
     md = server.find_method(full_name)
     status = server.method_status(full_name) if md is not None else None
     server_counted = [False]
+    handler_t0 = [0]
 
     def send_response(resp: Any = None) -> None:
+        t_enc0 = time.monotonic_ns() if stages else 0
+        if stages and handler_t0[0]:
+            _record_stage("handler", (t_enc0 - handler_t0[0]) // 1000,
+                          cntl.span)
         rmeta = meta_pb.RpcMeta()
         rmeta.correlation_id = cid
         rmeta.response.error_code = cntl.error_code_
@@ -214,7 +273,15 @@ def process_request(msg: StdMessage, socket, server) -> None:
         if att_size:
             rmeta.attachment_size = att_size
             payload.append(cntl.response_attachment)
-        socket.write(pack_frame(rmeta, payload))
+        frame = pack_frame(rmeta, payload)
+        t_wr0 = time.monotonic_ns() if stages else 0
+        if stages:
+            _record_stage("encode", (t_wr0 - t_enc0) // 1000, cntl.span)
+        socket.write(frame)
+        if stages:
+            _record_stage("write",
+                          (time.monotonic_ns() - t_wr0) // 1000,
+                          cntl.span)
         if cntl.span is not None:
             end_server_span(cntl)
         if status is not None:
@@ -258,6 +325,7 @@ def process_request(msg: StdMessage, socket, server) -> None:
             return
 
     # parse request payload
+    t_parse0 = time.monotonic_ns() if stages else 0
     try:
         body = msg.body
         if meta.attachment_size:
@@ -274,9 +342,13 @@ def process_request(msg: StdMessage, socket, server) -> None:
         cntl.set_failed(errors.EREQUEST, f"fail to parse request: {e}")
         send_response()
         return
+    if stages:
+        _record_stage("parse", (time.monotonic_ns() - t_parse0) // 1000,
+                      cntl.span)
 
     response = md.response_cls()
     done_called = [False]
+    handler_t0[0] = time.monotonic_ns() if stages else 0
 
     def done() -> None:
         if done_called[0]:

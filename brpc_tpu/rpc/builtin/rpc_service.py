@@ -1,0 +1,156 @@
+"""Builtin admin RPC services — the pages as REAL RPC methods.
+
+The builtin pages have always been reachable over HTTP on any transport;
+this module makes the services.py docstring literally true: every page is
+ALSO an RPC method, dogfooded over the fabric itself.  Two services are
+mounted on every server with builtin services enabled:
+
+  * ``brpc_tpu.Trace`` — the pod-scope rpcz query surface:
+    ``FindTrace``/``ListRecent`` answer from the LOCAL SpanDB (rpc/span.py)
+    with the responder's process id and wall clock attached, so a peer can
+    stitch the spans into its own timeline (builtin/pod_scope.py).
+  * ``brpc_tpu.Builtin`` — ``Call(page, query)`` dispatches any builtin
+    page through the server's BuiltinDispatcher; the pod-scope ``/vars``
+    and ``/brpc_metrics`` aggregation pulls every member's variables
+    through it.
+
+Messages are :class:`JsonMsg` — a self-describing JSON-bytes message that
+speaks the protobuf surface the protocols require (SerializeToString /
+ParseFromString) without a compiled schema, so the services ride tpu_std
+over mem://, tcp://, and ici:// (the fabric) unchanged.
+
+Admin-surface discipline: when ``ServerOptions.internal_port`` moved the
+admin pages off the public port, ``Builtin.Call`` refuses on the public
+RPC surface too (the same reason /flags must not leak onto the VIP).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict
+
+from ..service import Service, method
+from .. import errors
+
+
+class JsonMsg:
+    """A JSON-carried message with the protobuf wire surface.  Fields
+    live in ``.fields``; construct with keyword args."""
+
+    def __init__(self, **fields: Any):
+        self.fields: Dict[str, Any] = dict(fields)
+
+    def SerializeToString(self) -> bytes:
+        return json.dumps(self.fields).encode()
+
+    def ParseFromString(self, data: bytes) -> None:
+        self.fields = json.loads(data.decode()) if data else {}
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.fields.get(key, default)
+
+    def __getitem__(self, key: str) -> Any:
+        return self.fields[key]
+
+    def __repr__(self) -> str:
+        return f"JsonMsg({self.fields!r})"
+
+
+def local_pid() -> int:
+    """This process's pod/fabric process id; -1 single-process."""
+    try:
+        from ...ici.fabric import FabricNode
+        node = FabricNode.instance()
+        return node.process_id if node is not None else -1
+    except Exception:
+        return -1
+
+
+def _refuse_off_internal_port(cntl) -> bool:
+    """When ServerOptions.internal_port moved the admin pages off the
+    public port, the admin RPC surface must refuse there too — the
+    SpanDB (method names, endpoints, timelines) is exactly the data the
+    option exists to keep off the VIP.  True = refused (cntl failed)."""
+    server = cntl.server
+    if server is not None and server.options.internal_port >= 0:
+        cntl.set_failed(errors.EPERM, "admin services are only served "
+                                      "on the internal port")
+        return True
+    return False
+
+
+class TraceService(Service):
+    """find_trace / list-recent over the local SpanDB — the RPC the
+    pod-scope /rpcz stitcher fans out (builtin/rpcz_service.cpp's query
+    surface, reachable over the fabric)."""
+
+    SERVICE_NAME = "brpc_tpu.Trace"
+
+    @method(JsonMsg, JsonMsg)
+    def FindTrace(self, cntl, request, response, done):
+        from ..span import find_trace
+        if _refuse_off_internal_port(cntl):
+            done()
+            return
+        try:
+            tid = int(str(request.get("trace_id", "0")), 16)
+        except ValueError:
+            cntl.set_failed(errors.EREQUEST, "trace_id must be hex")
+            done()
+            return
+        response.fields = {
+            "pid": local_pid(),
+            "wall_us": time.time_ns() // 1000,
+            "spans": [s.describe() for s in find_trace(tid)],
+        }
+        done()
+
+    @method(JsonMsg, JsonMsg)
+    def ListRecent(self, cntl, request, response, done):
+        from ..span import recent_spans
+        if _refuse_off_internal_port(cntl):
+            done()
+            return
+        limit = int(request.get("limit", 100))
+        response.fields = {
+            "pid": local_pid(),
+            "wall_us": time.time_ns() // 1000,
+            "spans": [s.describe() for s in recent_spans(limit)],
+        }
+        done()
+
+
+class BuiltinRpcService(Service):
+    """Any builtin page as an RPC: Call({page, query}) → {status,
+    content_type, body, pid}.  The pod-scope /vars and /brpc_metrics
+    aggregation rides this."""
+
+    SERVICE_NAME = "brpc_tpu.Builtin"
+
+    @method(JsonMsg, JsonMsg)
+    def Call(self, cntl, request, response, done):
+        server = cntl.server
+        builtin = getattr(server, "_builtin", None) \
+            if server is not None else None
+        if builtin is None:
+            cntl.set_failed(errors.ENOSERVICE, "no builtin dispatcher")
+            done()
+            return
+        if _refuse_off_internal_port(cntl):
+            done()
+            return
+        page = str(request.get("page", ""))
+        query = request.get("query") or {}
+        hit = builtin.dispatch(page, {str(k): str(v)
+                                      for k, v in query.items()})
+        if hit is None:
+            response.fields = {"status": 404, "content_type": "text/plain",
+                               "body": f"no builtin page {page!r}",
+                               "pid": local_pid()}
+            done()
+            return
+        status, (ctype, body) = (200, hit) if len(hit) == 2 \
+            else (hit[0], hit[1:])
+        response.fields = {"status": status, "content_type": ctype,
+                           "body": body, "pid": local_pid()}
+        done()

@@ -113,6 +113,11 @@ def _status(server, q):
 
 
 def _vars(server, q):
+    if q.get("scope") == "pod":
+        # pod aggregation: every member's exposed variables over the
+        # brpc_tpu.Builtin.Call RPC, grouped per process
+        from .pod_scope import vars_pod
+        return vars_pod(server, q)
     bvar.expose_default_variables()
     wildcard = q.get("filter", "")
     lines = [f"{name} : {value}" for name, value in bvar.dump_exposed(wildcard)]
@@ -148,6 +153,20 @@ def _connections(server, q):
 def _rpcz(server, q):
     from ..span import recent_spans, find_trace, rpcz_enabled
     tid = q.get("trace_id")
+    scope = q.get("scope")
+    if scope != "local" and (scope == "pod" or tid):
+        # pod-scope stitching: a trace_id query on ANY member fans out
+        # over pod membership and answers with the MERGED causally-
+        # ordered tree — explicit ?scope=local keeps the single-process
+        # view, and a process with no pod falls through to it
+        try:
+            from ...ici.pod import Pod
+            joined = Pod.current() is not None
+        except Exception:
+            joined = False
+        if joined or scope == "pod":
+            from .pod_scope import rpcz_pod
+            return rpcz_pod(server, q)
     if tid:
         spans = find_trace(int(tid, 16))
     else:
@@ -160,6 +179,10 @@ def _rpcz(server, q):
 
 def _metrics(server, q):
     """Prometheus exposition (prometheus_metrics_service.cpp)."""
+    if q.get("scope") == "pod":
+        # process-labelled exposition pulled from every pod member
+        from .pod_scope import metrics_pod
+        return metrics_pod(server, q)
     bvar.expose_default_variables()
     lines = []
     for name, value in bvar.dump_exposed():
@@ -299,6 +322,14 @@ def _ici(server, q):
             out["dplane_sequencers"] = seqs
     except Exception:
         pass
+    try:
+        # per-peer clock alignment (span stitching's offset source)
+        from ...ici import clock as _clock
+        peers = _clock.describe()
+        if peers:
+            out["clock_offsets"] = peers
+    except Exception:
+        pass
     return "application/json", json.dumps(out, indent=1)
 
 
@@ -371,3 +402,10 @@ _start_time = time.time()
 
 def register_builtin_services(server) -> None:
     server._builtin = BuiltinDispatcher(server)
+    # the pages as REAL RPC services too (rpc_service.py): the pod-scope
+    # fan-outs query peers through these over the fabric itself
+    from .rpc_service import BuiltinRpcService, TraceService
+    if "brpc_tpu.Trace" not in server.services():
+        server.add_service(TraceService())
+    if "brpc_tpu.Builtin" not in server.services():
+        server.add_service(BuiltinRpcService())

@@ -290,7 +290,31 @@ class Channel:
             ctx = maker(cid, cntl) if maker is not None else cid
             cntl._pipeline_ctx = ctx
             sock.push_pipelined_context(ctx)
-        rc = sock.write(packet, notify_cid=cid)
+        # publish the client span for the write path: relocation / bulk
+        # / device-plane events raised while THIS thread encodes the
+        # frame annotate the CLIENT span — previously only the
+        # bthread-local server span was consulted, so caller-side
+        # relocation annotations were silently lost.  SAVE/RESTORE, not
+        # clear: a usercode_inline handler dispatched inside this very
+        # write can issue its own call, and clearing would strip the
+        # OUTER window for the rest of the outer frame's encode.
+        from ..bthread import scheduler as _sched
+        from .span import set_client_span_local
+        # `published` is decided BEFORE the write: an inline-completed
+        # call (usercode_inline handler + response inside this very
+        # sock.write) runs _end_rpc, which clears cntl.span — re-reading
+        # it in the finally would skip the restore and leak the finished
+        # span into the thread-local forever
+        published = cntl.span is not None
+        prev_span = None
+        if published:
+            prev_span = _sched.local_get("rpcz_client_span")
+            set_client_span_local(cntl.span)
+        try:
+            rc = sock.write(packet, notify_cid=cid)
+        finally:
+            if published:
+                set_client_span_local(prev_span)
         if rc != 0:
             raise ConnectionError(f"write failed: {rc}")
         cntl._last_socket = sock

@@ -35,22 +35,14 @@ def _load_classes(spec: str):
 
 
 def resolve_targets(server: str) -> List[str]:
-    """One endpoint url per target channel.  A naming url (mesh://,
-    pod://, list://, file://, …) is resolved through the naming service;
-    a comma-separated list is split (ici mesh coords' parens respected);
-    a single endpoint passes through."""
-    from ..policy.naming import is_naming_url
-    if is_naming_url(server):
-        from ..policy.naming import create_naming_service
-        entries = create_naming_service(server).get_servers()
-        targets = [str(e.endpoint) for e in entries]
-        if not targets:
-            raise SystemExit(f"rpc_press: {server} resolved to no servers")
-        return targets
-    if "," in server:
-        from ..policy.naming import _split_list
-        return _split_list(server)
-    return [server]
+    """One endpoint url per target channel — the shared
+    policy.naming.resolve_servers (naming url / comma list / single
+    endpoint), with empty resolution as the CLI's hard exit."""
+    from ..policy.naming import resolve_servers
+    try:
+        return resolve_servers(server)
+    except ValueError as e:
+        raise SystemExit(f"rpc_press: {e}")
 
 
 def run_press(server: str, method: str, request_json: str,

@@ -2,15 +2,31 @@
 
 Reference: tools/rpc_view — a proxy that fetches and displays a remote
 server's admin pages.  Works against any transport the target listens on
-(tcp via HTTP; mem/ici via the HTTP protocol over that transport).
+(tcp via HTTP; mem/ici via the HTTP protocol over that transport), and
+against any NAMING url (``pod://``, ``mesh://``, ``list://…``) or
+comma-separated endpoint list: every resolved member's page is rendered
+in its own section.  Empty resolution is a hard error — a typo'd pod
+name must not silently show nothing.
 
     python -m brpc_tpu.tools.rpc_view --server 127.0.0.1:8000 --page status
+    python -m brpc_tpu.tools.rpc_view --server pod://default --page rpcz \
+        --query trace_id=abcd
 """
 from __future__ import annotations
 
 import argparse
 import sys
 import urllib.request
+from typing import List, Tuple
+
+
+def resolve_servers(server: str) -> List[str]:
+    """One target per resolved member — the shared
+    policy.naming.resolve_servers (naming url / comma list / single
+    endpoint); ValueError propagates on empty resolution."""
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu.policy.naming import resolve_servers as _resolve
+    return _resolve(server)
 
 
 def fetch_page(server: str, page: str, query: str = "") -> str:
@@ -52,13 +68,52 @@ def fetch_page(server: str, page: str, query: str = "") -> str:
         return r.read().decode("utf-8", "replace")
 
 
+def fetch_pages(server: str, page: str,
+                query: str = "") -> List[Tuple[str, str]]:
+    """(target, body) for every member ``server`` resolves to, fetched
+    CONCURRENTLY — pod membership keeps crashed members' records up by
+    design, so per-member timeouts must overlap or each dead member
+    stalls the CLI for a full timeout in turn.  A member that fails to
+    answer contributes its error text as the body — one dead member
+    must not hide the rest of the pod."""
+    import threading
+    targets = resolve_servers(server)
+    bodies: List[str] = [""] * len(targets)
+
+    def fetch(i, target):
+        try:
+            bodies[i] = fetch_page(target, page, query)
+        except Exception as e:
+            bodies[i] = f"<error: {type(e).__name__}: {e}>\n"
+
+    threads = [threading.Thread(target=fetch, args=(i, t), daemon=True)
+               for i, t in enumerate(targets)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    return list(zip(targets, bodies))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--server", required=True)
+    ap.add_argument("--server", required=True,
+                    help="endpoint, comma-separated list, or naming url "
+                         "(pod://, mesh://, list://, file://, …)")
     ap.add_argument("--page", default="status")
     ap.add_argument("--query", default="")
     args = ap.parse_args(argv)
-    print(fetch_page(args.server, args.page, args.query))
+    try:
+        pages = fetch_pages(args.server, args.page, args.query)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if len(pages) == 1:
+        print(pages[0][1])
+        return 0
+    for target, body in pages:
+        print(f"=== {target} ===")
+        print(body)
     return 0
 
 

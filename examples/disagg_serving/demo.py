@@ -44,11 +44,25 @@ def main() -> int:
     # default)
     _fl.set_flag("ici_device_plane_host_mesh", True)
     _fl.set_flag("ici_device_plane_threshold", 64 * 1024)
+    # rpcz: the router→prefill→decode trace — including the KV
+    # handoff's device-plane transfer spans — prints at the end
+    _fl.set_flag("rpcz_enabled", True)
 
     devs = jax.devices()
-    prefill = start_prefill_worker("ici://1", device=devs[1 % len(devs)])
-    decode_a = start_decode_worker("ici://2", device=devs[2 % len(devs)])
-    decode_b = start_decode_worker("ici://3", device=devs[3 % len(devs)])
+    # trace fidelity: the native IN-PROCESS ici fast path creates client
+    # spans only (no server span, no propagation into the handler —
+    # ROADMAP item 1 keeps the whole native path native); the Python
+    # plane traces end to end, and cross-process pods ride it anyway
+    wopts = rpc.ServerOptions()
+    wopts.native_ici = False
+    prefill = start_prefill_worker("ici://1", device=devs[1 % len(devs)],
+                                   options=wopts)
+    decode_a = start_decode_worker("ici://2", device=devs[2 % len(devs)],
+                                   options=rpc.ServerOptions(
+                                       native_ici=False))
+    decode_b = start_decode_worker("ici://3", device=devs[3 % len(devs)],
+                                   options=rpc.ServerOptions(
+                                       native_ici=False))
     router = start_router("mem://disagg-router", "ici://1",
                           {"ici://2": "ici://2", "ici://3": "ici://3"})
     try:
@@ -76,6 +90,29 @@ def main() -> int:
         print("device plane:", stats)
         assert stats["transfers"] > 0, (
             "KV handoff never crossed the device plane", stats)
+        # the last request's trace as one tree (single process here;
+        # across a pod the SAME query on any member stitches every
+        # process's spans — docs/OBSERVABILITY.md)
+        import time as _time
+        from brpc_tpu.rpc.span import find_trace
+        from brpc_tpu.rpc.builtin.pod_scope import stitch_tree
+        _time.sleep(0.2)                  # transfer completions store
+        spans = [s.describe() for s in find_trace(cntl.trace_id)]
+        for s in spans:
+            s["aligned_start_us"] = s["start_real_us"]
+
+        def show(node, depth=0):
+            print("  " * depth
+                  + f"rpcz {node['side']:>8} {node['method']} "
+                    f"{node['latency_us']}us "
+                    f"({len(node['annotations'])} annotations)")
+            for c in node["children"]:
+                show(c, depth + 1)
+
+        for root in stitch_tree(spans):
+            show(root)
+        assert any(s["side"] == "transfer" for s in spans), (
+            "KV handoff transfer spans missing from the trace")
         print(f"disagg_serving demo: {ok}/4 completions verified "
               f"({stats['transfers']} device-plane transfers)")
         ch.close()

@@ -1,0 +1,622 @@
+"""Pod-scope rpcz: trace stitching, clock alignment, traced data planes,
+and the server-path latency decomposition.
+
+Four legs:
+
+  * **Units** — span wall anchors / transfer spans, the per-peer clock
+    table (min-bound keep, local-wall mapping), stitch_tree ordering.
+  * **In-process** — the satellite-1 regression (client-side device-plane
+    annotations land on the CLIENT span via the channel-write local), the
+    tpu_std stage decomposition (queue/parse/handler/encode/write
+    annotations + recorders), and the builtin RPC services
+    (brpc_tpu.Trace / brpc_tpu.Builtin over an ordinary channel).
+  * **2-process** — trace continuity over the fabric: client span (proc
+    A) and server span (proc B) share trace_id and parent linkage, the
+    fabric clock exchange bounds the peer offset, and the stitched tree
+    orders A-send < B-recv < B-send < A-recv within the bound.
+  * **N=3 disagg** (the acceptance contract) — ONE /rpcz?trace_id= query
+    on the router member returns the complete router→prefill→decode
+    trace: client+server spans from all three processes PLUS the
+    device-plane KV-handoff transfer events (posted / seq-admit /
+    complete / pin hold), as one causally-ordered tree.
+"""
+import json
+import time
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+from brpc_tpu.butil import flags as _flags
+
+from echo_pb2 import EchoRequest, EchoResponse
+from test_pod import _run_pod, _POD_PRELUDE, REPO
+
+
+@pytest.fixture()
+def rpcz_on():
+    old = _flags.get_flag("rpcz_enabled")
+    _flags.set_flag("rpcz_enabled", True)
+    yield
+    _flags.set_flag("rpcz_enabled", old)
+
+
+@pytest.fixture()
+def dplane_host():
+    olds = {f: _flags.get_flag(f) for f in
+            ("ici_device_plane_host_mesh", "ici_device_plane_threshold")}
+    _flags.set_flag("ici_device_plane_host_mesh", True)
+    _flags.set_flag("ici_device_plane_threshold", 4096)
+    yield
+    for f, v in olds.items():
+        _flags.set_flag(f, v)
+
+
+class _Echo(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+# ---------------------------------------------------------------------------
+# Units.
+# ---------------------------------------------------------------------------
+
+class TestSpanUnits:
+    def test_wall_anchor_and_kind(self):
+        from brpc_tpu.rpc.span import Span, start_transfer_span
+        s = Span("m", True)
+        assert abs(s.wall_us - time.time_ns() // 1000) < 5_000_000
+        assert s.describe()["side"] == "client"
+        assert s.describe()["start_real_us"] == s.wall_us
+        t = start_transfer_span("device_plane x", s.trace_id, s.span_id)
+        assert t.describe()["side"] == "transfer"
+        assert t.trace_id == s.trace_id
+        assert t.parent_span_id == s.span_id
+
+    def test_clock_table_keeps_tightest_bound(self):
+        from brpc_tpu.ici import clock
+        clock.reset_for_test()
+        try:
+            clock.record(7, 1000.0, 500.0)
+            clock.record(7, 2000.0, 900.0)     # looser: ignored
+            off, bound = clock.offset(7)
+            # the bound carries an age-proportional drift allowance;
+            # freshly recorded it is within a whisker of the sample's
+            assert off == 1000.0 and 500.0 <= bound < 501.0
+            clock.record(7, 1500.0, 100.0)     # tighter: replaces
+            off, bound = clock.offset(7)
+            assert off == 1500.0 and 100.0 <= bound < 101.0
+            aligned, bound = clock.to_local_wall_us(7, 10_000.0)
+            assert aligned == 10_000.0 - 1500.0
+            assert 100.0 <= bound < 101.0
+            # unknown peer: passthrough with the unbounded marker
+            aligned, bound = clock.to_local_wall_us(99, 123.0)
+            assert aligned == 123.0 and bound == -1.0
+        finally:
+            clock.reset_for_test()
+
+    def test_stitch_tree_orders_by_aligned_start(self):
+        from brpc_tpu.rpc.builtin.pod_scope import stitch_tree
+        spans = [
+            {"span_id": "a", "parent": "0", "aligned_start_us": 100},
+            {"span_id": "b", "parent": "a", "aligned_start_us": 300},
+            {"span_id": "c", "parent": "a", "aligned_start_us": 200},
+            {"span_id": "d", "parent": "missing", "aligned_start_us": 50},
+        ]
+        tree = stitch_tree(spans)
+        assert [n["span_id"] for n in tree] == ["d", "a"]
+        assert [n["span_id"] for n in tree[1]["children"]] == ["c", "b"]
+
+
+# ---------------------------------------------------------------------------
+# In-process: client-span data-plane annotations (satellite-1 regression).
+# ---------------------------------------------------------------------------
+
+class TestClientSpanAnnotations:
+    def test_client_side_device_plane_events_land_on_client_span(
+            self, rpcz_on, dplane_host):
+        """A client-side RPC whose request attachment relocates through
+        the device plane: the posted/matched/complete lifecycle must
+        reach the CLIENT span's trace (it used to be lost — only the
+        bthread-local server span was consulted)."""
+        import jax
+        import jax.numpy as jnp
+        from brpc_tpu.ici.mesh import IciMesh
+        from brpc_tpu.rpc.span import find_trace
+        mesh = IciMesh.default()
+        opts = rpc.ServerOptions()
+        opts.native_ici = False          # the Python ici plane relocates
+        server = rpc.Server(opts)
+        server.add_service(_Echo())
+        assert server.start("ici://0") == 0
+        ch = rpc.Channel()
+        ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=15000,
+                                                      max_retry=0))
+        try:
+            payload = jax.device_put(jnp.arange(65536, dtype=jnp.uint8),
+                                     mesh.device(1))
+            jax.block_until_ready(payload)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            deadline = time.monotonic() + 10
+            xfer = []
+            while time.monotonic() < deadline:
+                spans = find_trace(cntl.trace_id)
+                xfer = [s for s in spans if s.kind == "transfer"
+                        and s.end_us]
+                if xfer:
+                    break
+                time.sleep(0.05)
+            assert xfer, "no transfer span joined the client's trace"
+            client = [s for s in find_trace(cntl.trace_id)
+                      if s.kind == "client"]
+            assert client, "client span missing"
+            assert all(x.parent_span_id == client[0].span_id
+                       for x in xfer)
+            ann = " | ".join(a for x in xfer for _, a in x.annotations)
+            assert "posted" in ann
+            assert "complete" in ann and "pin_held_us=" in ann
+        finally:
+            server.stop()
+            ch.close()
+
+
+# ---------------------------------------------------------------------------
+# In-process: tpu_std stage decomposition.
+# ---------------------------------------------------------------------------
+
+class TestStageDecomposition:
+    def test_sampled_request_gets_stage_annotations(self, rpcz_on):
+        from brpc_tpu.rpc.span import find_trace
+        server = rpc.Server()
+        server.add_service(_Echo())
+        assert server.start("mem://stage_decomp") == 0
+        ch = rpc.Channel()
+        ch.init("mem://stage_decomp",
+                options=rpc.ChannelOptions(timeout_ms=5000))
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="d"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            deadline = time.monotonic() + 5
+            srv = []
+            while time.monotonic() < deadline:
+                srv = [s for s in find_trace(cntl.trace_id)
+                       if s.kind == "server"]
+                if srv:
+                    break
+                time.sleep(0.02)
+            assert srv, "server span missing"
+            ann = " | ".join(a for _, a in srv[0].annotations)
+            for stage in ("queue", "parse", "handler", "encode", "write"):
+                assert f"{stage}_us=" in ann, (stage, ann)
+        finally:
+            server.stop()
+            ch.close()
+
+    def test_on_mode_feeds_stage_recorders_for_every_request(self):
+        """mode 'on': the tpu_std_server_* recorders see every request,
+        span or no span (the /vars-distribution measurement mode)."""
+        from brpc_tpu.policy.tpu_std import _stage_recorders
+        old = _flags.get_flag("tpu_std_stage_metrics")
+        _flags.set_flag("tpu_std_stage_metrics", "on")
+        before = {s: r.count() for s, r in _stage_recorders.items()}
+        server = rpc.Server()
+        server.add_service(_Echo())
+        assert server.start("mem://stage_on") == 0
+        ch = rpc.Channel()
+        ch.init("mem://stage_on",
+                options=rpc.ChannelOptions(timeout_ms=5000))
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="d"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            for stage, n in before.items():
+                assert _stage_recorders[stage].count() > n, stage
+        finally:
+            _flags.set_flag("tpu_std_stage_metrics", old)
+            server.stop()
+            ch.close()
+
+    def test_inline_completion_does_not_leak_client_span_local(
+            self, rpcz_on):
+        """usercode_inline completes the whole RPC INSIDE the channel's
+        sock.write, clearing cntl.span before the finally runs — the
+        restore must key on whether the span was PUBLISHED, or the
+        finished span leaks into the thread-local and parents every
+        later transfer on this thread into a dead trace."""
+        from brpc_tpu.bthread import scheduler
+        opts = rpc.ServerOptions()
+        opts.usercode_inline = True
+        server = rpc.Server(opts)
+        server.add_service(_Echo())
+        assert server.start("mem://span_leak") == 0
+        ch = rpc.Channel()
+        ch.init("mem://span_leak",
+                options=rpc.ChannelOptions(timeout_ms=5000))
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="i"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert cntl.span is None          # completed inline
+            assert scheduler.local_get("rpcz_client_span") is None, \
+                "finished client span leaked into the thread-local"
+        finally:
+            server.stop()
+            ch.close()
+
+    def test_stage_metrics_off_mode(self, rpcz_on):
+        from brpc_tpu.policy.tpu_std import _stage_recorders
+        from brpc_tpu.rpc.span import find_trace
+        old = _flags.get_flag("tpu_std_stage_metrics")
+        _flags.set_flag("tpu_std_stage_metrics", "off")
+        before = _stage_recorders["handler"].count()
+        server = rpc.Server()
+        server.add_service(_Echo())
+        assert server.start("mem://stage_off") == 0
+        ch = rpc.Channel()
+        ch.init("mem://stage_off",
+                options=rpc.ChannelOptions(timeout_ms=5000))
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="d"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert _stage_recorders["handler"].count() == before
+        finally:
+            _flags.set_flag("tpu_std_stage_metrics", old)
+            server.stop()
+            ch.close()
+
+
+# ---------------------------------------------------------------------------
+# In-process: the builtin RPC services.
+# ---------------------------------------------------------------------------
+
+class TestBuiltinRpc:
+    def test_trace_service_and_builtin_call_over_rpc(self, rpcz_on):
+        from brpc_tpu.rpc.builtin.rpc_service import JsonMsg
+        server = rpc.Server()
+        server.add_service(_Echo())
+        assert server.start("mem://builtin_rpc") == 0
+        ch = rpc.Channel()
+        ch.init("mem://builtin_rpc",
+                options=rpc.ChannelOptions(timeout_ms=5000))
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="t"), EchoResponse)
+            assert not cntl.failed()
+            tid = cntl.trace_id
+            deadline = time.monotonic() + 5
+            got = {}
+            while time.monotonic() < deadline:
+                c2 = rpc.Controller()
+                r2 = ch.call_method("brpc_tpu.Trace.FindTrace", c2,
+                                    JsonMsg(trace_id=f"{tid:x}"), JsonMsg)
+                assert not c2.failed(), c2.error_text
+                got = r2.fields
+                if len(got.get("spans", [])) >= 2:
+                    break
+                time.sleep(0.02)
+            sides = {s["side"] for s in got["spans"]}
+            assert {"client", "server"} <= sides, got
+            assert "wall_us" in got and "pid" in got
+            # ListRecent
+            c3 = rpc.Controller()
+            r3 = ch.call_method("brpc_tpu.Trace.ListRecent", c3,
+                                JsonMsg(limit=10), JsonMsg)
+            assert not c3.failed() and r3.fields["spans"]
+            # Builtin.Call: any page over RPC
+            c4 = rpc.Controller()
+            r4 = ch.call_method("brpc_tpu.Builtin.Call", c4,
+                                JsonMsg(page="health"), JsonMsg)
+            assert not c4.failed()
+            assert r4.fields["status"] == 200 and r4.fields["body"] == "OK"
+            # unknown page: a 404 payload, not a failed RPC
+            c5 = rpc.Controller()
+            r5 = ch.call_method("brpc_tpu.Builtin.Call", c5,
+                                JsonMsg(page="nope"), JsonMsg)
+            assert not c5.failed() and r5.fields["status"] == 404
+        finally:
+            server.stop()
+            ch.close()
+
+    def test_builtin_call_refused_when_admin_moved_to_internal_port(self):
+        from brpc_tpu.rpc.builtin.rpc_service import JsonMsg
+        opts = rpc.ServerOptions()
+        opts.internal_port = 0           # any free port
+        server = rpc.Server(opts)
+        server.add_service(_Echo())
+        assert server.start("mem://builtin_internal") == 0
+        ch = rpc.Channel()
+        ch.init("mem://builtin_internal",
+                options=rpc.ChannelOptions(timeout_ms=5000, max_retry=0))
+        try:
+            c = rpc.Controller()
+            ch.call_method("brpc_tpu.Builtin.Call", c,
+                           JsonMsg(page="flags"), JsonMsg)
+            assert c.failed() and c.error_code == rpc.errors.EPERM
+            # the SpanDB query surface is admin data too
+            c2 = rpc.Controller()
+            ch.call_method("brpc_tpu.Trace.ListRecent", c2,
+                           JsonMsg(limit=5), JsonMsg)
+            assert c2.failed() and c2.error_code == rpc.errors.EPERM
+        finally:
+            server.stop()
+            ch.close()
+
+    def test_rpcz_page_scope_pod_without_pod_reports_error(self):
+        server = rpc.Server()
+        server.add_service(_Echo())
+        assert server.start("mem://rpcz_nopod") == 0
+        try:
+            ctype, body = server._builtin.dispatch(
+                "rpcz", {"scope": "pod"})
+            assert "requires a joined pod" in body
+            # no pod joined: a trace_id query stays single-process
+            ctype, body = server._builtin.dispatch(
+                "rpcz", {"trace_id": "ab"})
+            assert "spans" in json.loads(body)
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# 2-process fabric: trace continuity + clock-bounded ordering.
+# ---------------------------------------------------------------------------
+
+pytestmark_pod = pytest.mark.pod
+
+_TRACE_2PROC = _POD_PRELUDE + r"""
+from brpc_tpu.butil import flags as _fl
+_fl.set_flag("rpcz_enabled", True)
+from brpc_tpu.ici.pod import Pod
+
+MYDEV = 2 * pid
+pod = Pod.join("trace2")
+
+class Svc(rpc.Service):
+    SERVICE_NAME = "EchoService"
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        time.sleep(0.02)          # a visible server-side dwell
+        response.message = "p%%d" %% pid
+        done()
+
+server = rpc.Server(); server.add_service(Svc())
+assert server.start("ici://%%d" %% MYDEV) == 0
+pod.wait_epoch(2 * NPROC, timeout=60)
+
+if pid == 0:
+    ch = rpc.Channel()
+    ch.init("ici://2", options=rpc.ChannelOptions(timeout_ms=30000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    resp = ch.call_method("EchoService.Echo", cntl,
+                          EchoRequest(message="x"), EchoResponse)
+    assert not cntl.failed(), (cntl.error_code_, cntl.error_text_)
+    tid = cntl.trace_id
+    assert tid, "client span was not sampled"
+    # the fabric clock exchange bounded the peer offset
+    from brpc_tpu.ici import clock
+    off = clock.offset(1)
+    assert off is not None, "no clock sample for peer 1"
+    assert 0 < off[1] < 5_000_000, off
+    # pod-scope stitch from THIS member
+    deadline = time.time() + 30
+    tree = None
+    while time.time() < deadline:
+        ctype, body = server._builtin.dispatch(
+            "rpcz", {"trace_id": "%%x" %% tid})
+        out = json.loads(body)
+        tree = out.get("tree") or []
+        if out.get("span_count", 0) >= 2:
+            break
+        time.sleep(0.1)
+    assert out["scope"] == "pod", out
+    assert len(tree) == 1, json.dumps(tree, indent=1)[:2000]
+    root = tree[0]
+    assert root["side"] == "client" and root["process"] == 0
+    kids = root["children"]
+    assert len(kids) == 1, kids
+    srv = kids[0]
+    assert srv["side"] == "server" and srv["process"] == 1
+    assert srv["method"] == "EchoService.Echo"
+    # causal ordering under the clock bound:
+    #   A-send < B-recv < B-send < A-recv
+    bound = srv["clock_bound_us"]
+    assert bound >= 0, "stitcher lost the clock bound"
+    a_send = root["aligned_start_us"]
+    a_recv = a_send + root["latency_us"]
+    b_recv = srv["aligned_start_us"]
+    b_send = b_recv + srv["latency_us"]
+    assert b_recv >= a_send - bound, (a_send, b_recv, bound)
+    assert b_send <= a_recv + bound, (b_send, a_recv, bound)
+    assert b_recv < b_send
+    # pod-aggregated /vars: every member's variables, per-process
+    ctype, vbody = server._builtin.dispatch("vars", {"scope": "pod"})
+    assert "== process 0 ==" in vbody and "== process 1 ==" in vbody, \
+        vbody[:500]
+    assert "<unreachable" not in vbody, vbody[:2000]
+    # pod-aggregated /brpc_metrics: process-labelled Prometheus
+    ctype, mbody = server._builtin.dispatch("brpc_metrics",
+                                            {"scope": "pod"})
+    assert 'process="0"' in mbody and 'process="1"' in mbody, mbody[:500]
+    assert "# TYPE" in mbody
+    kv.key_value_set("tr_done", "1")
+else:
+    kv.blocking_key_value_get("tr_done", 120000)
+kv.wait_at_barrier("tr_exit", 120000)
+server.stop()
+pod.leave()
+print("TR%%d_OK" %% pid, flush=True)
+"""
+
+
+@pytest.mark.pod
+def test_cross_process_trace_continuity_and_clock_bound():
+    """Client span (proc A) and server span (proc B) share trace_id and
+    parent linkage; one /rpcz?trace_id= on A returns the stitched tree
+    ordering A-send < B-recv < B-send < A-recv under the fabric's
+    ±RTT/2 clock bound."""
+    outs = _run_pod(_TRACE_2PROC % {"repo": REPO}, n=2, timeout=240,
+                    tag="trace2")
+    assert "TR0_OK" in outs[0], outs[0][-2000:]
+    assert "TR1_OK" in outs[1], outs[1][-2000:]
+
+
+# ---------------------------------------------------------------------------
+# N=3 disagg acceptance: the complete router→prefill→decode trace from
+# one query, device-plane KV-handoff events included.
+# ---------------------------------------------------------------------------
+
+_TRACE_DISAGG = _POD_PRELUDE + r"""
+from brpc_tpu.butil import flags as _fl
+_fl.set_flag("rpcz_enabled", True)
+_fl.set_flag("ici_device_plane_host_mesh", True)
+_fl.set_flag("ici_device_plane_threshold", 4096)
+from brpc_tpu.ici.pod import Pod
+from examples.disagg_serving.workers import (PrefillService, DecodeService,
+                                             RouterService)
+from examples.disagg_serving.model import kv_nbytes, reference_generate
+
+MYDEV = 2 * pid
+pod = Pod.join("dtrace")
+TOKENS = list(range(5, 101))          # 96 tokens -> 96KB KV block
+STEPS = 4
+
+opts = rpc.ServerOptions(); opts.native_ici = False
+server = rpc.Server(opts)
+if pid == 1:
+    svc = PrefillService(device=jax.devices()[2])
+    server.add_service(svc)
+elif pid == 2:
+    svc = DecodeService(device=jax.devices()[4])
+    server.add_service(svc)
+else:
+    svc = RouterService("ici://2", {"ici://4": "ici://4"})
+    server.add_service(svc)
+assert server.start("ici://%%d" %% MYDEV) == 0
+pod.wait_epoch(2 * NPROC, timeout=60)
+
+if pid == 0:
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    resp = ch.call_method("Router.Generate", cntl,
+                          EchoRequest(message=json.dumps(
+                              {"tokens": TOKENS, "steps": STEPS})),
+                          EchoResponse)
+    assert not cntl.failed(), (cntl.error_code_, cntl.error_text_)
+    out = json.loads(resp.message)
+    assert out["tokens"] == reference_generate(TOKENS, STEPS), out
+    tid = cntl.trace_id
+    assert tid, "client span was not sampled"
+
+    # ONE query on THIS member returns the whole pod's trace
+    deadline = time.time() + 60
+    stitched = {}
+    want_methods = {
+        (0, "client", "Router.Generate"),
+        (0, "server", "Router.Generate"),
+        (0, "client", "Prefill.Prefill"),
+        (1, "server", "Prefill.Prefill"),
+        (1, "client", "Decode.LoadKv"),
+        (2, "server", "Decode.LoadKv"),
+        (0, "client", "Decode.Decode"),
+        (2, "server", "Decode.Decode"),
+    }
+    def flatten(nodes):
+        for n in nodes:
+            yield n
+            yield from flatten(n["children"])
+    while time.time() < deadline:
+        ctype, body = server._builtin.dispatch(
+            "rpcz", {"trace_id": "%%x" %% tid})
+        stitched = json.loads(body)
+        flat = list(flatten(stitched.get("tree") or []))
+        got = {(n["process"], n["side"], n["method"]) for n in flat
+               if n["side"] != "transfer"}
+        xfers = [n for n in flat if n["side"] == "transfer"]
+        if want_methods <= got and len(xfers) >= 2:
+            break
+        time.sleep(0.2)
+    assert want_methods <= got, (sorted(want_methods - got),
+                                 json.dumps(stitched, indent=1)[:3000])
+    # the KV handoff's device-plane transfer events, BOTH halves: the
+    # sender's (prefill, proc 1) and the receiver's (decode, proc 2)
+    assert {n["process"] for n in xfers} == {1, 2}, xfers
+    ann = {n["process"]: " | ".join(a for _, a in n["annotations"])
+           for n in xfers}
+    assert "posted" in ann[1] and "seq" in ann[1]
+    assert "complete" in ann[1] and "pin_held_us=" in ann[1]
+    assert "seq" in ann[2] and "complete" in ann[2]
+    # every transfer hangs under the LoadKv client span (proc 1): the
+    # descriptor carried the trace context to proc 2
+    loadkv_client = [n for n in flat
+                     if (n["process"], n["side"], n["method"])
+                     == (1, "client", "Decode.LoadKv")][0]
+    for n in xfers:
+        assert n["parent"] == loadkv_client["span_id"], (
+            n["parent"], loadkv_client["span_id"])
+    # causal order: every child starts no earlier than its parent minus
+    # the combined clock bounds (sibling/parent order is explicit and
+    # bounded, never assumed)
+    def check(node):
+        nb = max(node["clock_bound_us"], 0)
+        for c in node["children"]:
+            cb = max(c["clock_bound_us"], 0)
+            slack = nb + cb + 5
+            assert c["aligned_start_us"] >= \
+                node["aligned_start_us"] - slack, (
+                node["method"], node["aligned_start_us"],
+                c["method"], c["aligned_start_us"], slack)
+            check(c)
+    roots = stitched["tree"]
+    assert len(roots) == 1 and roots[0]["side"] == "client", roots
+    check(roots[0])
+    # exactly one trace: 8 RPC spans + the transfer pair
+    assert stitched["span_count"] >= 10, stitched["span_count"]
+    kv.key_value_set("dt_done", "1")
+else:
+    kv.blocking_key_value_get("dt_done", 180000)
+    if pid == 1:
+        # the handoff really rode the sequenced device plane
+        socks = [s for s in fabric_socks()
+                 if s.dplane_bytes_sent >= kv_nbytes(len(TOKENS))]
+        assert socks, [(s.remote_side, s.dplane_bytes_sent)
+                       for s in fabric_socks()]
+        svc.close()
+kv.wait_at_barrier("dt_exit", 180000)
+if pid == 0:
+    svc.close()
+    ch.close()
+server.stop()
+pod.leave()
+print("DT%%d_OK" %% pid, flush=True)
+"""
+
+
+@pytest.mark.pod
+def test_disagg_pod_trace_is_complete_from_one_query_n3():
+    """Acceptance: a single /rpcz?trace_id= query on the router member
+    of the 3-process disagg pod returns the complete
+    router→prefill→decode trace — client+server spans from all three
+    processes plus the device-plane KV-handoff transfer events (posted /
+    seq-admit / complete, pin hold) — as one causally-ordered tree."""
+    outs = _run_pod(_TRACE_DISAGG % {"repo": REPO}, n=3, timeout=300,
+                    tag="disagg_trace")
+    for i in range(3):
+        assert f"DT{i}_OK" in outs[i], outs[i][-3000:]

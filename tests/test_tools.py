@@ -204,6 +204,60 @@ class TestRpcView:
         finally:
             server.stop()
 
+    def test_view_naming_url_renders_every_member(self):
+        """list:// (any naming url) resolves to every member; each gets
+        its own section."""
+        from brpc_tpu.tools.rpc_view import fetch_pages, main
+        s1, t1 = start_server()
+        s2, t2 = start_server()
+        try:
+            pages = fetch_pages(f"list://{t1},{t2}", "health")
+            assert [p[0] for p in pages] == [t1, t2]
+            assert all(body == "OK" for _, body in pages)
+            # the CLI renders per-member sections
+            import contextlib
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = main(["--server", f"list://{t1},{t2}",
+                           "--page", "health"])
+            assert rc == 0
+            out = buf.getvalue()
+            assert f"=== {t1} ===" in out and f"=== {t2} ===" in out
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_view_comma_list_and_dead_member(self):
+        """A comma-separated endpoint list works like rpc_press's; a dead
+        member reports its error inline instead of hiding the rest."""
+        from brpc_tpu.tools.rpc_view import fetch_pages
+        s1, t1 = start_server()
+        try:
+            pages = fetch_pages(f"{t1},mem://view-no-such", "health")
+            assert pages[0] == (t1, "OK")
+            assert pages[1][0] == "mem://view-no-such"
+            assert "error" in pages[1][1]
+        finally:
+            s1.stop()
+
+    def test_resolver_mixed_scheme_comma_list(self):
+        """A comma list whose FIRST entry is a bare host:port but whose
+        later entries carry schemes must split, not parse as a naming
+        url ('127.0.0.1:80,mem://x' contains '://' and used to misroute
+        into create_naming_service)."""
+        from brpc_tpu.policy.naming import resolve_servers
+        assert resolve_servers("127.0.0.1:80,mem://x") == \
+            ["127.0.0.1:80", "mem://x"]
+        assert resolve_servers("mem://a,mem://b") == \
+            ["mem://a", "mem://b"]
+
+    def test_view_empty_resolution_is_hard_error(self):
+        from brpc_tpu.tools.rpc_view import main, resolve_servers
+        with pytest.raises(ValueError):
+            resolve_servers("pod://no-such-pod")
+        assert main(["--server", "pod://no-such-pod",
+                     "--page", "health"]) == 1
+
 
 class TestParallelHttp:
     def test_fetch_many(self):
