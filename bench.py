@@ -53,21 +53,32 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
     server = rpc.Server(opts)
     server.add_service(EchoService())
     server.start("ici://0")
+    # SAME-DEVICE loop, as the metric label says: the caller lives on the
+    # server's device (ici_local_device=0), so the echoed device ref is a
+    # pure ref pass — stack overhead only.  Earlier rounds silently used
+    # the default neighbor binding, which relocated every response 0→1
+    # (a hidden device_put inside a number labeled "no ICI hop crossed");
+    # that cross-device shape is now measured SEPARATELY as
+    # ici_py_handler_xdev_* below.
     ch = rpc.Channel()
     ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=10000,
-                                                  max_retry=0))
+                                                  max_retry=0,
+                                                  ici_local_device=0))
+    ch_xdev = rpc.Channel()
+    ch_xdev.init("ici://0", options=rpc.ChannelOptions(timeout_ms=10000,
+                                                       max_retry=0))
     payload = jnp.arange(payload_bytes, dtype=jnp.uint8)
     payload = jax.device_put(payload, mesh.device(0))
     jax.block_until_ready(payload)
 
-    def drive(n):
+    def drive(n, chan=ch):
         lat = []
         for i in range(n + 30):
             cntl = rpc.Controller()
             cntl.request_attachment.append_device_array(payload)
             t0 = time.perf_counter_ns()
-            ch.call_method("EchoService.Echo", cntl,
-                           EchoRequest(message="b"), EchoResponse)
+            chan.call_method("EchoService.Echo", cntl,
+                             EchoRequest(message="b"), EchoResponse)
             t1 = time.perf_counter_ns()
             if cntl.failed():
                 raise RuntimeError(f"echo failed: {cntl.error_text}")
@@ -77,6 +88,25 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
         return lat
 
     lat_py = drive(iters)               # Python handler tier
+    # per-stage decomposition pass (tpu_std_stage_metrics=on): the SAME
+    # py-handler shape feeds the tpu_std_server_* recorders through the
+    # batched ici upcall tier, so BENCH extra shows WHERE the upcall
+    # microseconds go (queue/parse/handler/encode/write), not just the
+    # headline.  Run on a separate pass — mode "on" costs ~4 µs per
+    # recorder hit and must not pollute the latency numbers above.
+    from brpc_tpu.butil import flags as _fl
+    from brpc_tpu.policy import tpu_std as _tstd
+    _stage_mode_prev = _fl.get_flag("tpu_std_stage_metrics")
+    _fl.set_flag("tpu_std_stage_metrics", "on")
+    try:
+        drive(max(iters // 2, 150))
+        stage_p50s = _tstd.stage_p50s_us()
+    finally:
+        _fl.set_flag("tpu_std_stage_metrics", _stage_mode_prev)
+    # cross-device variant: response relocated to the neighbor device
+    # every call (one real mesh hop on >=2-chip hardware; device_put on
+    # the virtual mesh) — reported alongside, never mixed in
+    lat_py_xdev = drive(max(iters // 2, 100), chan=ch_xdev)
     binding = getattr(server, "_native_ici", None)
     lat_native = []
     if binding is not None:
@@ -109,7 +139,10 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
                              if lat_native else -1.0),
         "py_handler_p50_us": lat_py[len(lat_py) // 2],
         "py_handler_p99_us": lat_py[int(len(lat_py) * 0.99)],
+        "py_handler_xdev_p50_us": lat_py_xdev[len(lat_py_xdev) // 2],
+        "py_handler_xdev_p99_us": lat_py_xdev[int(len(lat_py_xdev) * 0.99)],
         "native_datapath": binding is not None,
+        "stage_p50s_us": stage_p50s,
     }
     return out
 
@@ -1399,6 +1432,15 @@ def main() -> None:
             echo.get("py_handler_p50_us", -1.0), 1),
         "ici_py_handler_echo_p99_us": round(
             echo.get("py_handler_p99_us", -1.0), 1),
+        "ici_py_handler_xdev_echo_p50_us": round(
+            echo.get("py_handler_xdev_p50_us", -1.0), 1),
+        "ici_py_handler_xdev_echo_p99_us": round(
+            echo.get("py_handler_xdev_p99_us", -1.0), 1),
+        # where the py-handler microseconds go (tpu_std_server_* stage
+        # recorder p50s, fed by the batched ici upcall tier under
+        # tpu_std_stage_metrics=on during a dedicated pass)
+        **{f"tpu_std_server_{k}_p50_us": v
+           for k, v in echo.get("stage_p50s_us", {}).items()},
         "native_tcp_echo_p50_us": round(rpc_p50, 2),
         "native_rpc_qps_16thr": round(nqps, 0),
         "native_large_req_gbps": round(ngbps, 3),

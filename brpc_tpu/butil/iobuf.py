@@ -50,10 +50,15 @@ class Block:
                  "on_send_complete")
 
     def __init__(self, kind: int, data: Any, meta: int = 0,
-                 deleter: Optional[Callable[[Any], None]] = None):
+                 deleter: Optional[Callable[[Any], None]] = None,
+                 size: Optional[int] = None):
         self.kind = kind
         self.data = data            # bytearray | memoryview | jax.Array
-        self.size = 0 if kind == HOST else len(data)  # bytes used (HOST only grows)
+        # bytes used (HOST only grows); callers that already know the
+        # length pass it — len() of a jax.Array is a measurable dispatch
+        # on the fast plane
+        self.size = size if size is not None \
+            else (0 if kind == HOST else len(data))
         self.meta = meta
         self.deleter = deleter
         self._lock = threading.Lock() if kind == HOST else None
@@ -194,7 +199,7 @@ class IOBuf:
         if dt.kind != "u" or dt.itemsize != 1 or arr.ndim != 1:
             raise TypeError("device block must be a flat uint8 array")
         n = arr.shape[0]
-        blk = Block(DEVICE, arr, meta=meta)
+        blk = Block(DEVICE, arr, meta=meta, size=n)
         self._refs.append(BlockRef(blk, 0, n))
         self._size += n
 
@@ -203,7 +208,7 @@ class IOBuf:
         uint8 (e.g. re-emerging from the native-plane registry): skips
         the dtype/ndim checks and the shape read — the fast-plane
         response path calls this once per RPC."""
-        blk = Block(DEVICE, arr, meta=0)
+        blk = Block(DEVICE, arr, meta=0, size=nbytes)
         self._refs.append(BlockRef(blk, 0, nbytes))
         self._size += nbytes
 

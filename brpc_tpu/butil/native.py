@@ -17,7 +17,7 @@ from typing import Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_fab_pair_stats"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_ici_respond_batch"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -83,6 +83,45 @@ _ICI_REQ_FN = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_char_p,
                                ctypes.c_uint64,
                                ctypes.POINTER(IciSegC), ctypes.c_uint64,
                                ctypes.c_uint64, ctypes.c_int32)
+
+
+class IciReqC(ctypes.Structure):
+    """One packed request of the batched one-struct upcall ABI
+    (native/rpc.cpp IciReqC): a single ctypes crossing hands the Python
+    handler tier an ARRAY of these.  Pointers are borrowed for the
+    duration of the upcall; seg keys are TAKEN by Python during it."""
+    _fields_ = [("token", ctypes.c_uint64),
+                ("method", ctypes.c_char_p),
+                ("payload", ctypes.POINTER(ctypes.c_uint8)),
+                ("payload_len", ctypes.c_uint64),
+                ("att_host", ctypes.POINTER(ctypes.c_uint8)),
+                ("att_host_len", ctypes.c_uint64),
+                ("segs", ctypes.POINTER(IciSegC)),
+                ("nsegs", ctypes.c_uint64),
+                ("log_id", ctypes.c_uint64),
+                ("recv_ns", ctypes.c_int64),
+                ("peer_dev", ctypes.c_int32),
+                ("_pad", ctypes.c_int32)]
+
+
+class IciRespC(ctypes.Structure):
+    """One packed response for brpc_tpu_ici_respond_batch — the batched
+    write-back half (native/rpc.cpp IciRespC).  Seg custody transfers to
+    native on the call; native releases it on every drop path."""
+    _fields_ = [("token", ctypes.c_uint64),
+                ("err", ctypes.c_uint64),
+                ("err_text", ctypes.c_char_p),
+                ("data", ctypes.POINTER(ctypes.c_uint8)),
+                ("len", ctypes.c_uint64),
+                ("att_host", ctypes.POINTER(ctypes.c_uint8)),
+                ("att_host_len", ctypes.c_uint64),
+                ("segs", ctypes.POINTER(IciSegC)),
+                ("nsegs", ctypes.c_uint64)]
+
+
+# batched ici request upcall: (reqs, n)
+_ICI_BATCH_FN = ctypes.CFUNCTYPE(None, ctypes.POINTER(IciReqC),
+                                 ctypes.c_uint64)
 
 
 def _build() -> bool:
@@ -277,6 +316,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_ici_respond.argtypes = [
         ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p, u8p,
         ctypes.c_uint64, u8p, ctypes.c_uint64, segp, ctypes.c_uint64]
+    lib.brpc_tpu_ici_listen_batch.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_listen_batch.argtypes = [ctypes.c_int32,
+                                              _ICI_BATCH_FN]
+    lib.brpc_tpu_ici_set_batch_params.restype = ctypes.c_int
+    lib.brpc_tpu_ici_set_batch_params.argtypes = [
+        ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64]
+    lib.brpc_tpu_ici_batch_stats.restype = ctypes.c_int
+    lib.brpc_tpu_ici_batch_stats.argtypes = [
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+    lib.brpc_tpu_ici_respond_batch.restype = ctypes.c_int
+    lib.brpc_tpu_ici_respond_batch.argtypes = [ctypes.POINTER(IciRespC),
+                                               ctypes.c_uint64]
     lib.brpc_tpu_ici_echo_p50_ns.restype = ctypes.c_int64
     lib.brpc_tpu_ici_echo_p50_ns.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,

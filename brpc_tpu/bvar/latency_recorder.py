@@ -9,6 +9,7 @@ sliding window.
 """
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
 from ..butil.misc import fast_rand_less_than
@@ -135,6 +136,13 @@ class LatencyRecorder(Variable):
         self._qps_window = PerSecond(self._count, window_size)
         self._percentile = Percentile()
         self._win_percentile = _WindowedPercentile(self._percentile, window_size)
+        # per-thread tuple of the five underlying agents: `rec << us` is
+        # on every request's accounting path (MethodStatus.on_responded),
+        # and five reducer dispatches (tls getattr + lambda op each)
+        # measured ~3 µs/record — one tls load + inline updates keeps it
+        # under 1.  Readers still take each agent's own lock, so the
+        # write-local structure is unchanged.
+        self._tls_fast = threading.local()
         super().__init__(None)
         if prefix:
             self.expose(prefix)
@@ -149,10 +157,30 @@ class LatencyRecorder(Variable):
 
     def __lshift__(self, latency_us: int) -> "LatencyRecorder":
         latency_us = int(latency_us)
-        self._latency << latency_us
-        self._max_latency << latency_us
-        self._count << 1
-        self._percentile << latency_us
+        tls = self._tls_fast
+        ag = getattr(tls, "agents", None)
+        if ag is None:
+            ag = tls.agents = (self._latency._sum._agent(),
+                               self._latency._count._agent(),
+                               self._max_latency._agent(),
+                               self._count._agent(),
+                               self._percentile._agent(),
+                               self._percentile._identity)
+        s, c, m, n, p, pident = ag
+        with s.lock:
+            s.value += latency_us
+        with c.lock:
+            c.value += 1
+        with m.lock:
+            if latency_us > m.value:
+                m.value = latency_us
+        with n.lock:
+            n.value += 1
+        with p.lock:
+            v = p.value
+            if v is pident:          # window reset swapped the reservoir
+                v = p.value = _PercentileSample()
+            v.add(latency_us)
         return self
 
     # reads ------------------------------------------------------------

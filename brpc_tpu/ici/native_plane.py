@@ -25,18 +25,34 @@ Three pieces:
 from __future__ import annotations
 
 import ctypes
+import itertools
 import threading
 import time as _time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..butil import debug_sync as _dbg
+from ..butil import flags as _flags
 from ..butil import logging as log
 from ..butil import native
 from ..butil.iobuf import IOBuf, DEVICE
-from ..butil.native import IciCallOut, IciSegC, _ICI_RELEASE_FN, \
-    _ICI_RELOCATE_FN, _ICI_REQ_FN
+from ..butil.native import IciCallOut, IciRespC, IciSegC, _ICI_BATCH_FN, \
+    _ICI_RELEASE_FN, _ICI_RELOCATE_FN
 from ..rpc import errors
 
 _U8P = ctypes.POINTER(ctypes.c_uint8)
+
+# Batched one-struct upcall tuning (native/rpc.cpp enqueue_batch): the
+# drainer takes up to max_batch requests per GIL crossing; an arrival
+# whose queue head has aged past batch_age_us steals the queue and
+# delivers concurrently, so p99 never pays more than the age bound for
+# batching.  Applied to every new ServerBinding.
+_flags.define_flag("ici_upcall_max_batch", 64,
+                   "max Python-handler requests delivered per batched "
+                   "upcall (one GIL crossing) on the native ici plane")
+_flags.define_flag("ici_upcall_batch_age_us", 50,
+                   "age bound (us) before a queued ici request is "
+                   "stolen from a busy drainer and delivered "
+                   "concurrently — bounds the p99 cost of batching")
 
 # hot-path module handles, resolved once at first call: the per-call
 # `from x import y` dance measured ~1 us/call on the fast plane (the
@@ -54,41 +70,66 @@ def _hot_modules():
     return _hot
 
 
+# tpu_std's stage-decomposition hooks (tpu_std_server_* recorders); the
+# ici handler tier feeds the SAME recorders so the per-stage p50s
+# decompose the deployed-common path (lazy: policy<->ici import cycle)
+_stage_hot = None
+
+
+def _stage_modules():
+    global _stage_hot
+    if _stage_hot is None:
+        from ..policy.tpu_std import _record_stage, _stage_flag
+        _stage_hot = (_stage_flag, _record_stage)
+    return _stage_hot
+
+
+_cntl_pool = None
+
+
+def _controller_pool():
+    global _cntl_pool
+    if _cntl_pool is None:
+        from ..rpc.controller import server_controller_pool
+        _cntl_pool = server_controller_pool
+    return _cntl_pool
+
+
 # ---------------------------------------------------------------------
 # device-ref registry
 # ---------------------------------------------------------------------
 
 class _DevRegistry:
-    """key → jax.Array, alive while the key is in native custody."""
+    """key → jax.Array, alive while the key is in native custody.
+
+    Lock-free by construction: keys come from itertools.count (atomic in
+    CPython) and every table op is a single GIL-atomic dict operation —
+    put/take pairs on the RPC hot path used to cost four lock
+    acquisitions per attachment round trip.  A key is written exactly
+    once and removed exactly once (the exactly-one-exit custody
+    invariant), so there is no read-modify-write to race."""
 
     def __init__(self):
         self._m: Dict[int, Any] = {}
-        self._lock = threading.Lock()
-        self._next = 1
+        self._next = itertools.count(1).__next__
 
     def put(self, arr) -> int:
-        with self._lock:
-            key = self._next
-            self._next += 1
-            self._m[key] = arr
-            return key
+        key = self._next()
+        self._m[key] = arr
+        return key
 
     def peek(self, key: int):
-        with self._lock:
-            return self._m.get(key)
+        return self._m.get(key)
 
     def take(self, key: int):
         """Remove and return — the Python side assumes custody."""
-        with self._lock:
-            return self._m.pop(key, None)
+        return self._m.pop(key, None)
 
     def release(self, key: int) -> None:
-        with self._lock:
-            self._m.pop(key, None)
+        self._m.pop(key, None)
 
     def live(self) -> int:
-        with self._lock:
-            return len(self._m)
+        return len(self._m)
 
 
 _registry = _DevRegistry()
@@ -216,57 +257,74 @@ def listener_dispatch_inline(device_id: int,
 # IOBuf ⇄ (att_host, segs) marshalling
 # ---------------------------------------------------------------------
 
-def split_attachment(buf: IOBuf) -> Tuple[bytes, List[IciSegC]]:
+def split_attachment(buf: IOBuf) -> Tuple[bytes, list]:
     """Decompose an attachment IOBuf into the host byte-stream plus the
-    ordered segment descriptor list.  Device blocks are registered (native
+    ordered segment descriptor list — PLAIN TUPLES (key, nbytes, dev,
+    is_dev), not ctypes structs: a ctypes Structure construction per seg
+    measured ~0.8 µs, and the FFI boundary fills its arrays from the
+    tuples with plain field stores.  Device blocks are registered (native
     custody begins); host runs merge into one descriptor each."""
     if buf.backing_block_num() == 1:
         # the dominant fast-plane shape: one whole device block
         r = buf.backing_block(0)
         if (r.block.kind == DEVICE and not r.offset
-                and r.length == len(r.block.data)):
+                and r.length == r.block.size):
             arr = r.block.data
-            return b"", [IciSegC(_registry.put(arr), r.length,
-                                 _device_index(arr), 1)]
+            return b"", [(_registry.put(arr), r.length,
+                          _device_index(arr), 1)]
     host_parts: List[bytes] = []
-    segs: List[IciSegC] = []
+    segs: list = []
     run = 0
     for i in range(buf.backing_block_num()):
         r = buf.backing_block(i)
         if r.block.kind == DEVICE:
             if run:
-                segs.append(IciSegC(0, run, 0, 0))
+                segs.append((0, run, 0, 0))
                 run = 0
             arr = r.block.data
             if r.offset or r.length != len(arr):
                 arr = arr[r.offset:r.offset + r.length]
             dev = _device_index(arr)
-            segs.append(IciSegC(_registry.put(arr), r.length, dev, 1))
+            segs.append((_registry.put(arr), r.length, dev, 1))
         else:
             host_parts.append(bytes(r.block.host_view(r.offset, r.length)))
             run += r.length
     if run:
-        segs.append(IciSegC(0, run, 0, 0))
+        segs.append((0, run, 0, 0))
     return b"".join(host_parts), segs
 
 
-def build_attachment(att_host: bytes, segs) -> IOBuf:
-    """Inverse of split_attachment on the receiving side: takes each
-    device key out of the registry (custody moves to this IOBuf).
-    Arrays from the registry were shape-validated when they entered it
-    (append_device_array / the relocate hook), so re-validation is
-    skipped here — worth ~0.5 us/call on the fast plane."""
+def fill_seg_array(segs) -> "ctypes.Array":
+    """(IciSegC * n) array from split_attachment's tuple descriptors
+    (tolerates IciSegC instances for callers that build their own)."""
+    arr = (IciSegC * len(segs))()
+    for j, sg in enumerate(segs):
+        if type(sg) is tuple:
+            e = arr[j]
+            e.key, e.nbytes, e.dev, e.is_dev = sg
+        else:
+            arr[j] = sg
+    return arr
+
+
+def build_attachment_from_c(att_host: bytes, segs_p, nsegs: int) -> IOBuf:
+    """build_attachment reading the ctypes seg array DIRECTLY — skips the
+    per-seg IciSegC copy the list-based form needs (one ctypes Structure
+    construction per seg measured ~0.8 µs on the handler tier)."""
     buf = IOBuf()
     off = 0
-    for s in segs:
+    take = _registry.take
+    for i in range(nsegs):
+        s = segs_p[i]
+        n = s.nbytes
         if s.is_dev:
-            arr = _registry.take(s.key)
+            arr = take(s.key)
             if arr is None:
                 raise KeyError(f"ici device ref {s.key} missing")
-            buf.append_device_array_unchecked(arr, s.nbytes)
+            buf.append_device_array_unchecked(arr, n)
         else:
-            buf.append(att_host[off:off + s.nbytes])
-            off += s.nbytes
+            buf.append(att_host[off:off + n])
+            off += n
     return buf
 
 
@@ -283,6 +341,17 @@ def build_attachment(att_host: bytes, segs) -> IOBuf:
 _devidx_cache: Dict[int, Tuple[int, int]] = {}
 
 
+_IciMesh = None
+
+
+def _mesh_cls():
+    global _IciMesh
+    if _IciMesh is None:
+        from .mesh import IciMesh
+        _IciMesh = IciMesh
+    return _IciMesh
+
+
 def _device_index(arr) -> int:
     """Logical mesh id of the array's residence, or -1 when the device is
     not in the mesh.  -1 never equals a target id, so native relocation
@@ -290,7 +359,7 @@ def _device_index(arr) -> int:
     residency check/device_put, preserving Python-plane semantics instead
     of silently skipping relocation (review finding: a 0 default would
     alias device 0)."""
-    from .mesh import IciMesh
+    IciMesh = _mesh_cls()
     gen = IciMesh.generation
     key = id(arr)
     hit = _devidx_cache.get(key)
@@ -322,21 +391,55 @@ def _device_index(arr) -> int:
     return idx
 
 
-def release_segs(segs) -> None:
-    for s in segs:
-        if s.is_dev:
-            _registry.release(s.key)
-
-
 # ---------------------------------------------------------------------
 # server binding
 # ---------------------------------------------------------------------
+
+class _RespondCollector:
+    """Per-upcall response accumulator — the symmetric half of the
+    batched ABI: every ``done()`` that fires while its delivery upcall
+    is still open parks its packed response here, and ONE
+    ``brpc_tpu_ici_respond_batch`` crossing flushes them all when the
+    upcall closes.  A ``done()`` arriving later (async handler, tasklet,
+    usercode pool) misses the window and responds as a batch of one."""
+
+    __slots__ = ("_binding", "_lock", "_items", "_open")
+
+    _GUARDED_BY = {"_items": "_lock", "_open": "_lock"}
+
+    def __init__(self, binding: "ServerBinding"):
+        self._binding = binding
+        self._lock = _dbg.make_lock("_RespondCollector._lock")
+        self._items: List[tuple] = []
+        self._open = True
+
+    def add(self, item: tuple) -> bool:
+        with self._lock:
+            if not self._open:
+                return False
+            self._items.append(item)
+            return True
+
+    def close_and_flush(self) -> None:
+        with self._lock:
+            self._open = False
+            items, self._items = self._items, []
+        if items:
+            self._binding._respond_flush(items)
+
 
 class ServerBinding:
     """Native listener for one device id, dispatching into an
     ``rpc.Server``'s method table (the Python-handler tier; echo-class
     methods can additionally be served fully native via
-    ``register_native_echo``)."""
+    ``register_native_echo``).
+
+    Request boundary: the BATCHED one-struct upcall ABI — native
+    accumulates ready requests and one ctypes crossing delivers an
+    ``IciReqC`` array; responses accumulate in a _RespondCollector and
+    one ``brpc_tpu_ici_respond_batch`` crossing writes them back.  Server
+    Controllers come from the shared pool and recycle at response time.
+    """
 
     def __init__(self, server, device_id: int):
         lib = native.load()
@@ -347,14 +450,20 @@ class ServerBinding:
         self.device_id = device_id
         self._echo_methods: set = set()   # served fully in C, inline
         self._peer_eps: Dict[int, Any] = {}
-        self._cb = _ICI_REQ_FN(self._on_request)   # pinned for lifetime
+        self._method_names: Dict[bytes, str] = {}   # decode cache
+        self._mdcache: Dict[str, tuple] = {}   # full -> (md, status)
+        self._tls = threading.local()          # reused respond array
+        self._cb = _ICI_BATCH_FN(self._on_batch)   # pinned for lifetime
         # handler rides the listen call: the listener is never visible
         # half-initialized (a racing caller could otherwise ENOMETHOD)
-        h = lib.brpc_tpu_ici_listen(device_id, self._cb)
+        h = lib.brpc_tpu_ici_listen_batch(device_id, self._cb)
         if h == 0:
             raise OSError(errors.EINVAL,
                           f"ici://{device_id} already listening (native)")
         self._handle = h
+        lib.brpc_tpu_ici_set_batch_params(
+            h, int(_flags.get_flag("ici_upcall_max_batch")),
+            int(_flags.get_flag("ici_upcall_batch_age_us")))
         with _server_bindings_lock:
             _server_bindings[device_id] = self
 
@@ -374,80 +483,190 @@ class ServerBinding:
     def requests(self) -> int:
         return self._lib.brpc_tpu_ici_requests(self._handle)
 
-    # ---- data-plane upcall -------------------------------------------
+    def batch_stats(self) -> Tuple[int, int, int]:
+        """(upcalls, requests_delivered, max_batch_seen) — the batching
+        amortization counters (native side)."""
+        u = ctypes.c_uint64()
+        r = ctypes.c_uint64()
+        m = ctypes.c_uint64()
+        self._lib.brpc_tpu_ici_batch_stats(
+            self._handle, ctypes.byref(u), ctypes.byref(r),
+            ctypes.byref(m))
+        return u.value, r.value, m.value
 
-    def _on_request(self, token, method, payload_p, payload_len,
-                    att_p, att_len, segs_p, nsegs, log_id, peer_dev):
+    # ---- data-plane upcall (batched one-struct ABI) -------------------
+
+    def _on_batch(self, reqs, n):
+        """ONE ctypes crossing for up to ici_upcall_max_batch ready
+        requests.  Inline servers process every request here and flush
+        every ready response through one respond_batch crossing; other
+        dispatch modes fan the requests out (tasklets / usercode pool —
+        the queued counter counts BATCH CONTENTS, one per request, so
+        the lame-duck drain gate sees each of them)."""
         try:
-            full = method.decode()
-            payload = ctypes.string_at(payload_p, payload_len) \
-                if payload_len else b""
-            att_host = ctypes.string_at(att_p, att_len) if att_len else b""
-            # custody: the registry takes happen HERE, inside the upcall —
-            # native clears its seg list when we return
-            segs = [IciSegC(segs_p[i].key, segs_p[i].nbytes, segs_p[i].dev,
-                            segs_p[i].is_dev) for i in range(nsegs)]
+            server = self._server
+            inline = getattr(server.options, "usercode_inline", False)
+            pool = getattr(server, "usercode_pool", None)
+            # a batch of ONE (the idle/low-load shape) responds directly —
+            # the collector only earns its lock when there is something
+            # to amortize
+            collector = _RespondCollector(self) if inline and n > 1 \
+                else None
+            names = self._method_names
+            scheduler = None
             try:
-                attachment = build_attachment(att_host, segs)
-            except KeyError as e:
-                self._respond_err(token, errors.EINTERNAL, str(e))
-                return
-            if getattr(self._server.options, "usercode_inline", False):
-                self._process(token, full, payload, attachment, log_id,
-                              peer_dev)
-            else:
-                from ..bthread import scheduler
-                scheduler.start_background(
-                    self._process, token, full, payload, attachment,
-                    log_id, peer_dev, name=f"ici-req:{full}")
+                for i in range(n):
+                    # per-request failure isolation: an unexpected error
+                    # on request i must answer ITS token EINTERNAL and
+                    # release ITS seg custody, never abandon the rest of
+                    # the batch (their clients would block to timeout and
+                    # their untaken device refs would pin HBM forever)
+                    r = reqs[i]
+                    token = r.token
+                    try:
+                        mkey = r.method
+                        full = names.get(mkey)
+                        if full is None:
+                            full = names[mkey] = mkey.decode()
+                        payload = ctypes.string_at(r.payload,
+                                                   r.payload_len) \
+                            if r.payload_len else b""
+                        att_host = ctypes.string_at(r.att_host,
+                                                    r.att_host_len) \
+                            if r.att_host_len else b""
+                        nsegs = r.nsegs
+                        if nsegs or att_host:
+                            # custody: the registry takes happen HERE,
+                            # inside the upcall — native clears its seg
+                            # lists when we return
+                            try:
+                                attachment = build_attachment_from_c(
+                                    att_host, r.segs, nsegs)
+                            except KeyError as e:
+                                self._respond_one(token, errors.EINTERNAL,
+                                                  str(e))
+                                continue
+                        else:
+                            attachment = None
+                        if inline:
+                            self._process(token, full, payload, attachment,
+                                          r.log_id, r.peer_dev, r.recv_ns,
+                                          collector)
+                        elif pool is not None:
+                            # usercode_in_pthread under batching: EVERY
+                            # request in the batch is counted queued
+                            # individually — the drain gate counts batch
+                            # contents, not batches
+                            server.on_usercode_queued()
+                            try:
+                                pool.submit(self._run_usercode, token,
+                                            full, payload, attachment,
+                                            r.log_id, r.peer_dev,
+                                            r.recv_ns)
+                            except RuntimeError:
+                                server.on_usercode_done()
+                                # pool shut down mid-stop: run here
+                                self._process(token, full, payload,
+                                              attachment, r.log_id,
+                                              r.peer_dev, r.recv_ns, None)
+                        else:
+                            if scheduler is None:
+                                from ..bthread import scheduler
+                            scheduler.start_background(
+                                self._process, token, full, payload,
+                                attachment, r.log_id, r.peer_dev,
+                                r.recv_ns, None, name=f"ici-req:{full}")
+                    except Exception as e:
+                        log.error("ici batch request failed: %s", e,
+                                  exc_info=True)
+                        try:
+                            for j in range(r.nsegs):   # custody release
+                                sg = r.segs[j]
+                                if sg.is_dev:
+                                    _registry.release(sg.key)
+                        except Exception:
+                            pass
+                        try:
+                            self._respond_one(token, errors.EINTERNAL,
+                                              f"{type(e).__name__}: {e}")
+                        except Exception:
+                            pass
+            finally:
+                # executed requests' parked responses flush even when a
+                # later request in the batch blew up
+                if collector is not None:
+                    collector.close_and_flush()
         except Exception as e:       # never let an exception cross ctypes
-            log.error("ici upcall failed: %s", e, exc_info=True)
-            try:
-                self._respond_err(token, errors.EINTERNAL, str(e))
-            except Exception:
-                pass
+            log.error("ici batch upcall failed: %s", e, exc_info=True)
 
-    def _process(self, token, full, payload, attachment, log_id, peer_dev):
-        from ..rpc.controller import Controller
+    def _run_usercode(self, token, full, payload, attachment, log_id,
+                      peer_dev, recv_ns) -> None:
+        try:
+            self._process(token, full, payload, attachment, log_id,
+                          peer_dev, recv_ns, None)
+        finally:
+            self._server.on_usercode_done()
+
+    def _process(self, token, full, payload, attachment, log_id, peer_dev,
+                 recv_ns, collector) -> None:
+        server_controller_pool = _controller_pool()
         server = self._server
-        if server.is_draining():
+        stage_flag, record_stage = _stage_modules()
+        stages = stage_flag.value == "on"
+        if stages and recv_ns:
+            q_us = (_time.monotonic_ns() - recv_ns) // 1000
+            record_stage("queue", max(q_us, 0), None)
+        if server._draining:
             # lame-duck: the native front door stays open through the
             # grace window so in-flight calls finish, but new ones bounce
             # with retryable ELOGOFF (mirrors tpu_std.process_request)
-            self._respond_err(token, errors.ELOGOFF,
-                              "server is draining (lame duck)")
+            self._respond_one(token, errors.ELOGOFF,
+                              "server is draining (lame duck)", collector)
             return
-        md = server.find_method(full)
-        if md is None:
-            self._respond_err(token, errors.ENOMETHOD, f"no method {full}")
-            return
-        status = server.method_status(full)
+        hit = self._mdcache.get(full)
+        if hit is None:
+            md = server.find_method(full)
+            if md is None:
+                self._respond_one(token, errors.ENOMETHOD,
+                                  f"no method {full}", collector)
+                return
+            hit = self._mdcache[full] = (md, server.method_status(full))
+        md, status = hit
         if not server.on_request_in():
-            self._respond_err(token, errors.ELIMIT,
-                              "server max_concurrency reached")
+            self._respond_one(token, errors.ELIMIT,
+                              "server max_concurrency reached", collector)
             return
         if status is not None and not status.on_requested():
             server.on_request_out()
-            self._respond_err(token, errors.ELIMIT,
-                              f"{full} concurrency limit")
+            self._respond_one(token, errors.ELIMIT,
+                              f"{full} concurrency limit", collector)
             return
-        cntl = Controller()
-        cntl.log_id = log_id
+        cntl = server_controller_pool.acquire()
+        if log_id:
+            cntl.log_id = log_id
         cntl.server = server
         cntl.remote_side = self._peer_endpoint(peer_dev)
-        cntl.request_attachment = attachment
-        cntl._session_data = server._get_session_data()
+        if attachment is not None:
+            cntl.request_attachment = attachment
         start_ns = _time.monotonic_ns()
         try:
             request = md.request_cls()
             request.ParseFromString(payload)
         except Exception as e:
-            server.on_request_out()
-            if status is not None:
-                status.on_responded(errors.EREQUEST, 0)
-            self._respond_err(token, errors.EREQUEST,
-                              f"fail to parse request: {e}")
+            cntl._maybe_recycle()
+
+            def parse_post(err=errors.EREQUEST):
+                if status is not None:
+                    status.on_responded(err, 0)
+                server.on_request_out()
+
+            self._respond_one(token, errors.EREQUEST,
+                              f"fail to parse request: {e}", collector,
+                              post=parse_post)
             return
+        if stages:
+            record_stage("parse",
+                         (_time.monotonic_ns() - start_ns) // 1000, None)
         response = md.response_cls()
         done_called = [False]
 
@@ -455,23 +674,46 @@ class ServerBinding:
             if done_called[0]:
                 return
             done_called[0] = True
-            latency_us = (_time.monotonic_ns() - start_ns) // 1000
-            server.on_request_out()
-            if status is not None:
-                status.on_responded(cntl.error_code_, latency_us)
-            server._return_session_data(
-                getattr(cntl, "_session_data", None))
-            if cntl.failed():
-                self._respond_err(token, cntl.error_code_, cntl.error_text_)
+            t_done = _time.monotonic_ns()
+            latency_us = (t_done - start_ns) // 1000
+            if stages:
+                record_stage("handler", latency_us, None)
+            cntl._release_session_data()
+            err = cntl.error_code_
+
+            def post() -> None:
+                # drain-gate accounting runs AFTER the response crossed
+                # back to native: inflight_requests() must never read
+                # zero while an EXECUTED request's response still sits
+                # in the collector — a lame-duck stop passing the gate
+                # there would purge the tokens and turn completed
+                # non-idempotent calls into retryable ELOGOFF
+                # (duplicate execution), the exact straggler shape the
+                # graceful-drain work ordered queued responses ahead of
+                # connection failure to prevent
+                if status is not None:
+                    status.on_responded(err, latency_us)
+                server.on_request_out()
+
+            if err:
+                self._respond_one(token, err, cntl.error_text_, collector,
+                                  post=post)
                 return
-            if cntl.response_attachment.backing_block_num():
-                att_host, segs = split_attachment(cntl.response_attachment)
+            resp_att = cntl._peek_response_attachment()
+            if resp_att is not None and resp_att.backing_block_num():
+                att_host, segs = split_attachment(resp_att)
             else:
                 att_host, segs = b"", ()
-            self._respond(token, 0, "", response.SerializeToString(),
-                          att_host, segs)
+            item = (token, 0, b"", response.SerializeToString(),
+                    att_host, segs, post)
+            if stages:
+                record_stage("encode",
+                             (_time.monotonic_ns() - t_done) // 1000,
+                             None)
+            if collector is None or not collector.add(item):
+                self._respond_item(item)
 
-        cntl.set_server_done(done)
+        cntl._server_done = done
         try:
             md.invoke(cntl, request, response, done)
         except Exception as e:
@@ -480,6 +722,8 @@ class ServerBinding:
                 cntl.set_failed(errors.EINTERNAL,
                                 f"{type(e).__name__}: {e}")
                 done()
+                cntl._release_session_data()
+                cntl._maybe_recycle()
 
     def _peer_endpoint(self, peer_dev: int):
         """Per-request endpoint objects are identical for a given peer —
@@ -493,20 +737,111 @@ class ServerBinding:
                 IciMesh.default().endpoint(peer_dev)
         return ep
 
-    def _respond(self, token, err, err_text, payload, att_host, segs):
-        p = ctypes.cast(payload, _U8P) if payload else None
-        a = ctypes.cast(att_host, _U8P) if att_host else None
-        seg_arr = (IciSegC * len(segs))(*segs) if segs else None
-        rc = self._lib.brpc_tpu_ici_respond(
-            token, err, err_text.encode() if err_text else b"", p,
-            len(payload), a, len(att_host), seg_arr, len(segs))
-        if rc != 0 and segs:
-            # token vanished before custody transferred (server stopping):
-            # native never saw the keys, release them here
-            release_segs(segs)
+    # ---- batched write-back ------------------------------------------
 
-    def _respond_err(self, token, err, text):
-        self._respond(token, err, text, b"", b"", [])
+    def _respond_one(self, token, err, text, collector=None,
+                     post=None) -> None:
+        item = (token, err,
+                text.encode() if isinstance(text, str) else (text or b""),
+                b"", b"", (), post)
+        if collector is None or not collector.add(item):
+            self._respond_item(item)
+
+    def _respond_item(self, item) -> None:
+        """Single-response write-back through a per-thread reused
+        (IciRespC * 1) array — the batch-of-one fast lane (native copies
+        everything during the call, so reuse is safe; every field is
+        rewritten here including the NULL ones).  The item's ``post``
+        hook (drain-gate accounting) runs AFTER the crossing."""
+        tls = self._tls.__dict__
+        arr = tls.get("resp1")
+        if arr is None:
+            arr = tls["resp1"] = (IciRespC * 1)()
+        token, err, err_text, payload, att_host, segs, post = item
+        e = arr[0]
+        e.token = token
+        e.err = err
+        e.err_text = err_text or None
+        if payload:
+            e.data = ctypes.cast(payload, _U8P)
+            e.len = len(payload)
+        else:
+            e.data = None
+            e.len = 0
+        if att_host:
+            e.att_host = ctypes.cast(att_host, _U8P)
+            e.att_host_len = len(att_host)
+        else:
+            e.att_host = None
+            e.att_host_len = 0
+        if segs:
+            seg_arr = fill_seg_array(segs)
+            e.segs = seg_arr
+            e.nsegs = len(segs)
+        else:
+            seg_arr = None
+            e.segs = None
+            e.nsegs = 0
+        stage_flag, record_stage = _stage_modules()
+        if stage_flag.value == "on":
+            t0 = _time.monotonic_ns()
+            self._lib.brpc_tpu_ici_respond_batch(arr, 1)
+            record_stage("write", (_time.monotonic_ns() - t0) // 1000,
+                         None)
+        else:
+            self._lib.brpc_tpu_ici_respond_batch(arr, 1)
+        del seg_arr, payload, att_host, err_text   # alive across the call
+        if post is not None:
+            post()
+
+    def _respond_flush(self, items) -> None:
+        """One ``brpc_tpu_ici_respond_batch`` crossing for every packed
+        response in ``items`` (each: token, err, err_text, payload,
+        att_host, segs, post).  Seg-key custody transfers to native,
+        which owns release on EVERY drop path — no per-item return code
+        needed.  Each item's ``post`` hook (drain-gate accounting) runs
+        AFTER the crossing — see _process.done's ordering note."""
+        n = len(items)
+        arr = (IciRespC * n)()
+        keep = []                      # buffers alive across the call
+        for i, (token, err, err_text, payload, att_host, segs, _post) in \
+                enumerate(items):
+            e = arr[i]
+            e.token = token
+            e.err = err
+            if err_text:
+                e.err_text = err_text
+                keep.append(err_text)
+            if payload:
+                e.data = ctypes.cast(payload, _U8P)
+                e.len = len(payload)
+                keep.append(payload)
+            if att_host:
+                e.att_host = ctypes.cast(att_host, _U8P)
+                e.att_host_len = len(att_host)
+                keep.append(att_host)
+            if segs:
+                seg_arr = fill_seg_array(segs)
+                e.segs = seg_arr
+                e.nsegs = len(segs)
+                keep.append(seg_arr)
+        stage_flag, record_stage = _stage_modules()
+        if stage_flag.value == "on":
+            t0 = _time.monotonic_ns()
+            self._lib.brpc_tpu_ici_respond_batch(arr, n)
+            # under batched delivery the write stage is the SHARED flush
+            # crossing: every response in the batch records the same
+            # crossing latency (what the request actually waited)
+            w_us = (_time.monotonic_ns() - t0) // 1000
+            for _ in range(n):
+                record_stage("write", w_us, None)
+        else:
+            self._lib.brpc_tpu_ici_respond_batch(arr, n)
+        del keep
+        for it in items:
+            post = it[6]
+            if post is not None:
+                post()
 
 
 # ---------------------------------------------------------------------
@@ -531,6 +866,10 @@ class ChannelBinding:
         self.remote_dev = remote_dev
         self.window_bytes = window_bytes if window_bytes > 0 else (4 << 20)
         self.remote_side = mesh.endpoint(remote_dev)
+        self._names: Dict[str, bytes] = {}      # method encode cache
+        self._tls = threading.local()           # reused IciCallOut
+        self._call2 = lib.brpc_tpu_ici_call2    # bound once: attr-chain
+        self._free = lib.brpc_tpu_buf_free      # lookups are per-call
         h = lib.brpc_tpu_ici_connect(local_dev, remote_dev, window_bytes)
         if h == 0:
             raise ConnectionRefusedError(
@@ -582,9 +921,10 @@ class ChannelBinding:
             req = request.SerializeToString()
         except AttributeError:
             req = bytes(request) if request is not None else b""
-        if cntl.request_attachment.backing_block_num():
-            att_host, segs = split_attachment(cntl.request_attachment)
-            dev_bytes = sum(s.nbytes for s in segs if s.is_dev)
+        req_att = cntl._peek_request_attachment()
+        if req_att is not None and req_att.backing_block_num():
+            att_host, segs = split_attachment(req_att)
+            dev_bytes = sum(s[1] for s in segs if s[3])
         else:
             att_host, segs, dev_bytes = b"", (), 0
         # bytes objects pass by pointer (cast, no copy): the native side
@@ -592,10 +932,20 @@ class ChannelBinding:
         u8p = _U8P
         reqb = ctypes.cast(req, u8p) if req else None
         attb = ctypes.cast(att_host, u8p) if att_host else None
-        seg_arr = (IciSegC * len(segs))(*segs) if segs else None
+        seg_arr = fill_seg_array(segs) if segs else None
         # one out-block instead of seven byref temporaries: the 17-arg
-        # ctypes conversion measured ~3-4 us/call (VERDICT r4 weak #3)
-        out = IciCallOut()
+        # ctypes conversion measured ~3-4 us/call (VERDICT r4 weak #3).
+        # Reused per thread — native zeroes every field on entry, so a
+        # fresh allocation per call buys nothing
+        tls = self._tls.__dict__
+        out = tls.get("out")
+        if out is None:
+            out = tls["out"] = IciCallOut()
+            tls["out_ref"] = ctypes.byref(out)
+        out_ref = tls["out_ref"]
+        name_b = self._names.get(full_name)
+        if name_b is None:
+            name_b = self._names[full_name] = full_name.encode()
         # timeout_ms <= 0 means NO deadline (controller.py:169 semantics);
         # the native side treats timeout_us <= 0 the same way
         tms = cntl.timeout_ms
@@ -608,10 +958,9 @@ class ChannelBinding:
         if blocked:
             scheduler.note_worker_blocked()
         try:
-            rc = self._lib.brpc_tpu_ici_call2(
-                self._handle, full_name.encode(), reqb, len(req), attb,
-                len(att_host), seg_arr, len(segs), timeout_us,
-                ctypes.byref(out))
+            rc = self._call2(
+                self._handle, name_b, reqb, len(req), attb,
+                len(att_host), seg_arr, len(segs), timeout_us, out_ref)
         finally:
             if blocked:
                 scheduler.note_worker_unblocked()
@@ -635,11 +984,13 @@ class ChannelBinding:
             if nsegs or out.att_len:
                 r_att_host = ctypes.string_at(out.att, out.att_len) \
                     if out.att_len else b""
-                rsegs = [IciSegC(out.segs[i].key, out.segs[i].nbytes,
-                                 out.segs[i].dev, out.segs[i].is_dev)
-                         for i in range(nsegs)]
-                cntl.response_attachment.append(
-                    build_attachment(r_att_host, rsegs))
+                rbuf = build_attachment_from_c(r_att_host, out.segs,
+                                               nsegs)
+                prev = cntl._peek_response_attachment()
+                if prev is None:
+                    cntl.response_attachment = rbuf
+                else:
+                    prev.append(rbuf)
             # transport accounting (the Python plane's counters — one
             # fabric-wide truth regardless of datapath)
             with _t._ici_stats_lock:
@@ -654,15 +1005,23 @@ class ChannelBinding:
             return response
         finally:
             cntl.latency_us = (_time.monotonic_ns() - t0) // 1000
-            free = self._lib.brpc_tpu_buf_free
+            # free AND NULL every out pointer: the struct is reused (per
+            # thread, and re-entered by nested calls from inline
+            # handlers) — a stale pointer surviving into a call whose
+            # response leaves that field untouched would double-free
+            free = self._free
             if out.resp:
                 free(out.resp)
+                out.resp = None
             if out.att:
                 free(out.att)
+                out.att = None
             if out.segs:
                 free(out.segs)
+                out.segs = None
             if out.err_text:
                 free(out.err_text)
+                out.err_text = None
 
 
 def native_ici_echo_p50_us(iters: int = 3000, payload: int = 128,

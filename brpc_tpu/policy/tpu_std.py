@@ -20,7 +20,9 @@ from ..butil import logging as log
 from ..bthread import id as bthread_id
 from ..proto import rpc_meta_pb2 as meta_pb
 from ..rpc import errors
-from ..rpc.controller import Controller
+from ..rpc import rpc_dump
+from ..rpc.controller import Controller, server_controller_pool
+from ..rpc.span import start_server_span, end_server_span
 from ..rpc.protocol import Protocol, ParseResult, register_protocol
 from ..rpc import compress as compress_mod
 
@@ -53,10 +55,13 @@ _flags.define_flag("tpu_std_stage_metrics", "sampled",
 _STAGES = ("queue", "parse", "handler", "encode", "write")
 _stage_recorders = {s: bvar.LatencyRecorder(f"tpu_std_server_{s}")
                     for s in _STAGES}
+# the Flag OBJECT, read as one attribute load per request instead of a
+# registry-dict lookup per stage check (hot path)
+_stage_flag = _flags.flag_object("tpu_std_stage_metrics")
 
 
 def _stages_active(cntl: Controller) -> bool:
-    mode = _flags.get_flag("tpu_std_stage_metrics")
+    mode = _stage_flag.value
     if mode == "on":
         return True
     if mode == "off":
@@ -65,10 +70,20 @@ def _stages_active(cntl: Controller) -> bool:
 
 
 def _record_stage(stage: str, us: int, span) -> None:
-    if _flags.get_flag("tpu_std_stage_metrics") == "on":
+    if _stage_flag.value == "on":
         _stage_recorders[stage] << us
     if span is not None:
         span.annotate(f"{stage}_us={us}")
+
+
+def stage_p50s_us() -> dict:
+    """Per-stage p50s from the tpu_std_server_* recorders (µs) — the
+    BENCH `extra` decomposition (only meaningful after a run with
+    tpu_std_stage_metrics=on).  Reads the lifetime reservoir, not the
+    10s window: a short measurement pass finishes before the window
+    sampler's first tick."""
+    return {s: _stage_recorders[s]._percentile.get_value().get_number(0.5)
+            for s in _STAGES}
 
 
 class StdMessage:
@@ -207,7 +222,11 @@ def process_response(msg: StdMessage, socket) -> None:
 
 def process_request(msg: StdMessage, socket, server) -> None:
     """ProcessRpcRequest (baidu_rpc_protocol.cpp:312): find method, check
-    limits, run user code in this tasklet, respond via socket write."""
+    limits, run user code in this tasklet, respond via socket write.
+    The per-request Controller comes from the server-side pool
+    (controller.server_controller_pool) and is recycled once the
+    response is written — the reference keeps this path allocation-free
+    the same way."""
     meta = msg.meta
     if not meta.request.service_name and meta.HasField("stream_settings"):
         from ..rpc.stream import on_stream_frame
@@ -217,20 +236,20 @@ def process_request(msg: StdMessage, socket, server) -> None:
     full_name = f"{req_meta.service_name}.{req_meta.method_name}"
     cid = meta.correlation_id
     start_us = time.monotonic_ns() // 1000
-    from ..rpc import rpc_dump
     if rpc_dump.dump_enabled():
         rpc_dump.maybe_dump_request(pack_frame(meta, msg.body))
 
-    cntl = Controller()
+    cntl = server_controller_pool.acquire()
     cntl.server = server
     cntl.log_id = req_meta.log_id
     cntl.remote_side = socket.remote_side
-    cntl.auth_token = req_meta.auth_token
-    cntl.compress_type = meta.compress_type
+    if req_meta.auth_token:
+        cntl.auth_token = req_meta.auth_token
+    if meta.compress_type:
+        cntl.compress_type = meta.compress_type
     if req_meta.timeout_ms:
         cntl.method_deadline = time.monotonic() + req_meta.timeout_ms / 1000.0
 
-    from ..rpc.span import start_server_span, end_server_span
     start_server_span(cntl, full_name, req_meta.trace_id,
                       req_meta.span_id)
     stages = _stages_active(cntl)
@@ -269,10 +288,11 @@ def process_request(msg: StdMessage, socket, server) -> None:
                 data = compress_mod.compress(meta.compress_type, data)
                 rmeta.compress_type = meta.compress_type
             payload.append(data)
-        att_size = len(cntl.response_attachment)
+        resp_att = cntl._peek_response_attachment()
+        att_size = len(resp_att) if resp_att is not None else 0
         if att_size:
             rmeta.attachment_size = att_size
-            payload.append(cntl.response_attachment)
+            payload.append(resp_att)
         frame = pack_frame(rmeta, payload)
         t_wr0 = time.monotonic_ns() if stages else 0
         if stages:
@@ -299,10 +319,12 @@ def process_request(msg: StdMessage, socket, server) -> None:
         cntl.set_failed(errors.ELOGOFF, "server is draining (lame duck)")
         status = None       # don't on_responded a rejected request
         send_response()
+        cntl._maybe_recycle()
         return
     if not server.on_request_in():
         cntl.set_failed(errors.ELIMIT, "server max_concurrency reached")
         send_response()
+        cntl._maybe_recycle()
         return
     server_counted[0] = True
     if md is None:
@@ -310,18 +332,21 @@ def process_request(msg: StdMessage, socket, server) -> None:
                         server.services() else errors.ENOSERVICE,
                         f"no method {full_name}")
         send_response()
+        cntl._maybe_recycle()
         return
     if status is not None and not status.on_requested():
         cntl.set_failed(errors.ELIMIT,
                         f"method {full_name} max_concurrency reached")
         status = None               # don't on_responded a rejected request
         send_response()
+        cntl._maybe_recycle()
         return
     # auth (reference: protocol verify hook)
     if server.options.auth is not None:
         if not server.options.auth.verify(cntl.auth_token, socket):
             cntl.set_failed(errors.ERPCAUTH, "authentication failed")
             send_response()
+            cntl._maybe_recycle()
             return
 
     # parse request payload
@@ -341,6 +366,7 @@ def process_request(msg: StdMessage, socket, server) -> None:
     except Exception as e:
         cntl.set_failed(errors.EREQUEST, f"fail to parse request: {e}")
         send_response()
+        cntl._maybe_recycle()
         return
     if stages:
         _record_stage("parse", (time.monotonic_ns() - t_parse0) // 1000,
@@ -364,6 +390,8 @@ def process_request(msg: StdMessage, socket, server) -> None:
         if not done_called[0]:
             cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
             done()
+            cntl._release_session_data()
+            cntl._maybe_recycle()
 
 
 PROTOCOL = Protocol(

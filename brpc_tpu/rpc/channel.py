@@ -15,6 +15,7 @@ from ..butil.endpoint import EndPoint, parse_endpoint
 from . import errors
 from .controller import Controller
 from .input_messenger import InputMessenger
+from . import loopback as _loopback
 from .protocol import find_protocol
 from .socket_map import SocketMap
 from .span import end_client_span, maybe_start_client_span
@@ -48,6 +49,28 @@ class ChannelOptions:
     auth: object = None                 # Authenticator
     ssl_context: object = None          # ssl.SSLContext for TLS channels
     ns_filter: object = None            # NamingServiceFilter: fn(ServerEntry)->bool
+    # The mesh device this channel's caller "lives on" for ici://
+    # targets: response device refs relocate TOWARD it.  None keeps the
+    # historical default (the target's neighbor, (remote+1) % mesh.size
+    # — every response pays one relocation hop); a caller colocated with
+    # the server passes the server's own device id for the pure ref-pass
+    # round trip.
+    ici_local_device: object = None     # Optional[int]
+
+
+# loopback-screen module handles, resolved once at first call (lazy only
+# to dodge the policy<->rpc import cycle at load time)
+_loopback_screen = None
+
+
+def _loopback_screen_modules():
+    global _loopback_screen
+    if _loopback_screen is None:
+        from . import fault_injection as _fi
+        from . import rpc_dump as _dump
+        from ..policy.tpu_std import _stage_flag
+        _loopback_screen = (_fi, _dump, _stage_flag)
+    return _loopback_screen
 
 
 class Channel:
@@ -105,6 +128,23 @@ class Channel:
             self._ns_thread.add_watcher(watcher)
             return 0
         self._endpoint = parse_endpoint(target) if isinstance(target, str) else target
+        # loopback fast-plane eligibility (channel-level screens; the
+        # per-call ones live in call_method): unary tpu_std against an
+        # in-process mem:// server, no auth, no hedging
+        from ..butil.endpoint import SCHEME_MEM as _MEM
+        if (self._endpoint is not None
+                and getattr(self._endpoint, "scheme", None) == _MEM
+                and self.options.protocol == "tpu_std"
+                and self.options.auth is None
+                and self.options.backup_request_ms <= 0):
+            self._loopback_name = self._endpoint.host
+            # the breaker gate from _select_socket, honored on the fast
+            # plane too: an isolated endpoint fails fast even in-process
+            # (loopback traffic itself never trips or resets breakers —
+            # there is no connection to be unhealthy)
+            from .circuit_breaker import BreakerRegistry
+            self._loopback_breaker = \
+                BreakerRegistry.instance().breaker(self._endpoint)
         return 0
 
     # ---- calls ----------------------------------------------------------
@@ -166,6 +206,36 @@ class Channel:
                 scheduler.start_background(
                     _run, name=f"ici-call:{method_full_name}")
                 return None
+        # mem:// loopback fast plane (loopback.py): in-process direct
+        # dispatch, no byte codec / socket machinery.  Per-call screens:
+        # anything the wire plane implements that loopback doesn't
+        # (streaming handshakes, compression, fault injection, rpc_dump
+        # sampling) falls through.
+        lb_name = getattr(self, "_loopback_name", None)
+        if (lb_name is not None and cntl.stream_creator is None
+                and cntl.compress_type == 0 and not cntl.auth_token
+                and _loopback.enabled()):
+            hot = _loopback_screen_modules()
+            _fi, _dump, _stage_flag = hot
+            if cntl.span is None:
+                maybe_start_client_span(cntl, method_full_name)
+            srv = _loopback.server_for(lb_name)
+            # rpcz-sampled requests and the stage-metrics measurement
+            # mode ride the wire plane: they exist to observe it (server
+            # span, five-stage decomposition); auth verification needs
+            # the wire socket context
+            if (srv is not None and cntl.span is None
+                    and srv.options.auth is None
+                    and not self._loopback_breaker.is_isolated()
+                    and _stage_flag.value != "on"
+                    and _fi.active() is None
+                    and not _dump.dump_enabled()):
+                if cntl.timeout_ms is None:
+                    cntl.timeout_ms = self.options.timeout_ms
+                # loopback completes the client span itself (the span
+                # ends with the response, also on async completions)
+                return _loopback.call(srv, method_full_name, cntl,
+                                      request, response_cls, done)
         if self.options.auth is not None and not cntl.auth_token:
             cntl.auth_token = self.options.auth.generate_credential(cntl)
         payload = self._protocol.serialize_request(request, cntl)
@@ -269,7 +339,8 @@ class Channel:
             with self._native_ici_lock:
                 if getattr(self, "_native_ici", None) is None:
                     self._native_ici = native_plane.ChannelBinding(
-                        ep.device_id)
+                        ep.device_id,
+                        local_dev=self.options.ici_local_device)
                 return self._native_ici
         except Exception:
             return None

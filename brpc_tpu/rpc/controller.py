@@ -31,56 +31,92 @@ from ..bthread.timer_thread import TimerThread
 from . import errors
 
 
+class _LazyField:
+    """Non-data descriptor: materializes a per-instance default on first
+    READ (the instance dict shadows it afterwards, so steady-state access
+    is a plain attribute load).  This is what makes Controller
+    construction and pool reset nearly free: a request that never touches
+    its attachments never pays for their IOBufs."""
+    __slots__ = ("name", "factory")
+
+    def __init__(self, name: str, factory):
+        self.name = name
+        self.factory = factory
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        val = obj.__dict__[self.name] = self.factory()
+        return val
+
+
 class Controller:
-    def __init__(self):
-        # common
-        self.error_code_: int = 0
-        self.error_text_: str = ""
-        self.log_id: int = 0
-        self.request_attachment = IOBuf()
-        self.response_attachment = IOBuf()
-        self.remote_side: Optional[EndPoint] = None
-        self.local_side: Optional[EndPoint] = None
-        self.auth_token: str = ""
-        self.compress_type: int = 0
-        # tracing
-        self.trace_id: int = 0
-        self.span_id: int = 0
-        self.parent_span_id: int = 0
-        self.span = None
-        # client call state
-        self.timeout_ms: Optional[int] = None
-        self.max_retry: Optional[int] = None
-        self.backup_request_ms: Optional[int] = None
-        self.retry_on_timeout: Optional[bool] = None
-        self.retry_backoff_ms: Optional[int] = None
-        self.retried_count: int = 0
-        self.current_try: int = 0
-        self.latency_us: int = 0
-        self.response: Any = None
-        self._response_cls: Any = None
-        self._done: Optional[Callable[["Controller"], None]] = None
-        self._cid: int = 0
-        self._timeout_timer = None
-        self._backup_timer = None
-        self._channel = None            # issuing channel (for re-issues)
-        self._method_full_name: str = ""
-        self._request_buf: Optional[IOBuf] = None
-        self._start_us: int = 0
-        # lazy: ~3 µs of threading.Event construction per call that the
-        # native ici fast path (sync, never joins) would pay for nothing
-        self._ended_ev: Optional[threading.Event] = None
-        self._excluded_servers: set = set()
-        self.request_protocol: str = ""
-        self.stream_creator = None      # set by stream.create on host RPC
-        self.accepted_stream_id = 0
-        # server side
-        self.server = None
-        self._session_data: Any = None
-        self.method_deadline: Optional[float] = None
-        self._server_done: Optional[Callable[[], None]] = None
-        self.http_request = None
-        self.http_response = None
+    # Every scalar default lives on the CLASS: __init__ sets nothing, so
+    # construction is an empty-dict object and a pooled reset is one
+    # ``__dict__.clear()`` — the "thin shim that inflates on first
+    # access" design (reference Controller + ResetPods).  Writes shadow
+    # the class default in the instance dict as usual; only the mutable
+    # containers (attachments, excluded-server set) need the lazy
+    # descriptor above.
+    # common
+    error_code_: int = 0
+    error_text_: str = ""
+    log_id: int = 0
+    request_attachment = _LazyField("request_attachment", IOBuf)
+    response_attachment = _LazyField("response_attachment", IOBuf)
+    remote_side: Optional[EndPoint] = None
+    local_side: Optional[EndPoint] = None
+    auth_token: str = ""
+    compress_type: int = 0
+    # tracing
+    trace_id: int = 0
+    span_id: int = 0
+    parent_span_id: int = 0
+    span = None
+    # client call state
+    timeout_ms: Optional[int] = None
+    max_retry: Optional[int] = None
+    backup_request_ms: Optional[int] = None
+    retry_on_timeout: Optional[bool] = None
+    retry_backoff_ms: Optional[int] = None
+    retried_count: int = 0
+    current_try: int = 0
+    latency_us: int = 0
+    response: Any = None
+    _response_cls: Any = None
+    _done: Optional[Callable[["Controller"], None]] = None
+    _cid: int = 0
+    _timeout_timer = None
+    _backup_timer = None
+    _channel = None                 # issuing channel (for re-issues)
+    _method_full_name: str = ""
+    _request_buf: Optional[IOBuf] = None
+    _start_us: int = 0
+    # lazy: ~3 µs of threading.Event construction per call that the
+    # native ici fast path (sync, never joins) would pay for nothing
+    _ended_ev: Optional[threading.Event] = None
+    _excluded_servers = _LazyField("_excluded_servers", set)
+    request_protocol: str = ""
+    stream_creator = None           # set by stream.create on host RPC
+    accepted_stream_id = 0
+    # server side
+    server = None
+    _session_data: Any = None
+    method_deadline: Optional[float] = None
+    _server_done: Optional[Callable[[], None]] = None
+    http_request = None
+    http_response = None
+    _recycle_pool = None            # ControllerPool that owns this shim
+
+    # ---- attachment peeks (hot paths) ---------------------------------
+    # Reading request_attachment/response_attachment MATERIALIZES the
+    # IOBuf; presence checks on hot paths use these instead so an
+    # attachment-less echo never allocates either buffer.
+    def _peek_request_attachment(self) -> Optional[IOBuf]:
+        return self.__dict__.get("request_attachment")
+
+    def _peek_response_attachment(self) -> Optional[IOBuf]:
+        return self.__dict__.get("response_attachment")
 
     # ---- per-RPC session data (reference Controller::session_local_data,
     # backed by ServerOptions.session_local_data_factory's pool) ---------
@@ -129,7 +165,18 @@ class Controller:
         return self.error_text_
 
     def reset(self) -> None:
-        self.__init__()
+        # every field is a class default (see above): clearing the
+        # instance dict restores pristine state in one C-level op
+        self.__dict__.clear()
+
+    def _maybe_recycle(self) -> None:
+        """Return a pool-acquired server-side Controller to its pool once
+        the response is fully sent (the protocol-agnostic recycle point —
+        called by MethodDescriptor.invoke's wrapped done and by the
+        pre-invoke error paths).  No-op for plain Controllers."""
+        pool = self.__dict__.get("_recycle_pool")
+        if pool is not None:
+            pool.release(self)
 
     # ---- client call orchestration ------------------------------------
     def _start_call(self, channel, method_full_name: str, request_buf: IOBuf,
@@ -425,6 +472,18 @@ class Controller:
         processing can't be starved by sync callers (the reference blocks
         on a butex, which yields the bthread worker for free)."""
         from ..bthread import scheduler
+        state = self.__dict__.get("_loopback_state")
+        if state is not None:
+            ev = state.wait_begin()
+            if ev is None:
+                return                   # already completed
+            scheduler.note_worker_blocked()
+            try:
+                if not ev.wait(timeout):
+                    raise TimeoutError("RPC join timed out")
+            finally:
+                scheduler.note_worker_unblocked()
+            return
         scheduler.note_worker_blocked()
         try:
             if not self._ended.wait(timeout):
@@ -435,7 +494,11 @@ class Controller:
     def cancel(self) -> None:
         """Cancel the in-flight call (reference StartCancel/CancelRPC): the
         caller completes with ECANCELED; a late response is dropped by the
-        correlation id."""
+        correlation id (wire path) or the loopback claim."""
+        if self.__dict__.get("_loopback_state") is not None:
+            from . import loopback
+            loopback.cancel(self)
+            return
         if self._cid and not self._ended.is_set():
             bthread_id.error(
                 bthread_id.with_version(self._cid, self.current_try),
@@ -449,3 +512,63 @@ class Controller:
         if self._server_done is not None:
             fn, self._server_done = self._server_done, None
             fn()
+
+
+class ControllerPool:
+    """Server-side Controller pool (reference: brpc keeps the whole
+    server path allocation-free; src/butil/resource_pool.h).
+
+    In-use shims are tracked through a versioned-id
+    :class:`~brpc_tpu.butil.resource_pool.ResourcePool` — ``live()`` and
+    ``live_controllers()`` are the census/debug enumeration, and a
+    double release is rejected by the id version instead of corrupting
+    the free list.  Reset is ``Controller.reset()`` (one dict clear), so
+    a recycled shim can never leak request k's error code, attachment,
+    or span into request k+1 — the classic pool bug, pinned by
+    tests/test_controller_pool.py."""
+
+    _GUARDED_BY = {"_free": "_lock"}
+
+    def __init__(self, capacity: int = 1024):
+        from ..butil import debug_sync as _dbg
+        from ..butil.resource_pool import ResourcePool
+        self.capacity = capacity
+        self._ids: "ResourcePool[Controller]" = ResourcePool()
+        self._free: list = []
+        self._lock = _dbg.make_lock("ControllerPool._lock")
+
+    def acquire(self) -> Controller:
+        with self._lock:
+            c = self._free.pop() if self._free else None
+        if c is None:
+            c = Controller()
+        d = c.__dict__
+        d["_pool_rid"] = self._ids.get_resource(c)
+        d["_recycle_pool"] = self
+        return c
+
+    def release(self, c: Controller) -> None:
+        rid = c.__dict__.get("_pool_rid", 0)
+        if not rid or not self._ids.return_resource(rid):
+            return                   # not ours / already released: drop
+        c.reset()
+        with self._lock:
+            if len(self._free) < self.capacity:
+                self._free.append(c)
+
+    def live(self) -> int:
+        """Controllers currently handed out (in-flight requests)."""
+        return self._ids.size()
+
+    def live_controllers(self) -> list:
+        return self._ids.live_payloads()
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+# The process-wide server-side pool: every server protocol that
+# constructs per-request Controllers (tpu_std, the native ici upcall
+# tier, the loopback plane) draws from it.
+server_controller_pool = ControllerPool()

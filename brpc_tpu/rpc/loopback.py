@@ -1,0 +1,377 @@
+"""Loopback call plane: direct in-process dispatch for mem:// channels.
+
+The deployed-common case the tentpole optimizes is a Python handler
+behind an in-process transport.  For ici:// the native plane already
+short-circuits the Python socket machinery (channel.py's fast path);
+this module is the same idea for mem:// — the gRPC *in-process
+transport* analogue: when the client channel and the server live in one
+process, a unary tpu_std call skips the byte codec, socket pair, event
+dispatch, and correlation-id machinery entirely and dispatches straight
+into the server's method table.
+
+What is NOT skipped — semantics are the wire path's, line for line:
+
+* admission: lame-duck draining (retryable ELOGOFF), server
+  max_concurrency (ELIMIT), per-method concurrency limiters (ELIMIT),
+  ENOMETHOD/ENOSERVICE;
+* accounting: ``Server.on_request_in/out``, MethodStatus
+  on_requested/on_responded (the /status page and the lame-duck drain
+  gate see loopback requests exactly like wire ones), and the
+  ``usercode_in_pthread`` queued counter;
+* isolation: the handler gets its OWN pooled server Controller and a
+  request object parsed from the serialized bytes (a handler mutating
+  its request never corrupts the caller's), and handlers run inline
+  only on ``usercode_inline`` servers — otherwise they dispatch to a
+  tasklet / the usercode backup pool, same as InputMessenger;
+* failure surface: ERPCTIMEDOUT on deadline expiry and ECANCELED on
+  Controller.cancel(), with the same late-completion guard the
+  correlation id gives the wire path (a response landing after the
+  claim is dropped, never written into a controller the caller may be
+  reusing); and a lame-duck stop past its grace window fails in-flight
+  loopback stragglers with ELOGOFF exactly like it fails wire
+  connections (Server._stop_locked → fail_inflight).
+
+Ineligible calls fall through to the wire path — the screens live in
+channel.py: streaming, auth (channel- or server-side), compression,
+backup-request hedging, fault injection, rpc_dump sampling, rpcz-sampled
+requests (the wire path carries the server span + stage annotations),
+and ``tpu_std_stage_metrics=on`` (the dedicated wire-pipeline
+measurement mode).
+
+Attachments cross by reference (zero-copy, the point of an in-process
+plane): the server controller's request_attachment IS the caller's
+IOBuf, and the response_attachment IOBuf moves back by reference.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..butil import debug_sync as _dbg
+from ..butil import flags as _flags
+from ..butil import logging as log
+from . import errors
+from .controller import Controller, server_controller_pool
+
+_loopback_flag = _flags.define_flag(
+    "mem_loopback_fast", True,
+    "direct in-process dispatch for unary tpu_std calls on mem:// "
+    "channels (skips the byte codec and socket machinery; admission, "
+    "limits, accounting, and drain semantics identical to the wire "
+    "path).  Off forces every mem:// call through the wire plane.")
+
+# fablint guarded-state: both registries only mutate under their lock.
+_GUARDED_BY_GLOBALS = {"_servers": "_servers_lock",
+                       "_inflight": "_inflight_lock"}
+# mem name -> Server, maintained by Server.start/_teardown_listeners
+_servers: Dict[str, Any] = {}
+_servers_lock = _dbg.make_lock("loopback._servers_lock")
+# id(server) -> set of in-flight _CallStates (the lame-duck straggler
+# hook's view; entries deregister at completion)
+_inflight: Dict[int, set] = {}
+_inflight_lock = _dbg.make_lock("loopback._inflight_lock")
+
+
+def register_server(name: str, server) -> None:
+    with _servers_lock:
+        _servers[name] = server
+
+
+def unregister_server(name: str, server) -> None:
+    with _servers_lock:
+        if _servers.get(name) is server:
+            del _servers[name]
+
+
+def server_for(name: str):
+    """The in-process Server listening on mem://<name>, or None."""
+    with _servers_lock:
+        return _servers.get(name)
+
+
+def enabled() -> bool:
+    return bool(_loopback_flag.value)
+
+
+class _CallState:
+    """First-of(completion, timeout, cancel, lame-duck-fail) arbitration
+    — the loopback translation of the correlation id's version guard:
+    exactly one side writes the client-visible result."""
+
+    __slots__ = ("lock", "finished", "event", "server_key", "cntl",
+                 "done", "t0")
+
+    def __init__(self, server_key: int, cntl, done, t0: int):
+        self.lock = threading.Lock()
+        self.finished = False
+        self.event: Optional[threading.Event] = None
+        self.server_key = server_key
+        self.cntl = cntl
+        self.done = done
+        self.t0 = t0
+
+    def try_finish(self) -> bool:
+        with self.lock:
+            if self.finished:
+                return False
+            self.finished = True
+            ev = self.event
+        _inflight_remove(self)
+        if ev is not None:
+            ev.set()
+        return True
+
+    def wait_begin(self) -> Optional[threading.Event]:
+        """Arm (or reuse) the park event; None when already finished.
+        The sync caller and any join()ers share one event."""
+        with self.lock:
+            if self.finished:
+                return None
+            if self.event is None:
+                self.event = threading.Event()
+            return self.event
+
+
+def _inflight_add(state: _CallState) -> None:
+    with _inflight_lock:
+        _inflight.setdefault(state.server_key, set()).add(state)
+
+
+def _inflight_remove(state: _CallState) -> None:
+    with _inflight_lock:
+        bucket = _inflight.get(state.server_key)
+        if bucket is not None:
+            bucket.discard(state)
+            if not bucket:
+                del _inflight[state.server_key]
+
+
+def fail_inflight(server, code: int, text: str) -> int:
+    """Lame-duck straggler handling (Server._stop_locked, past-grace
+    phase): claim every in-flight loopback call on ``server`` with the
+    given error — the loopback analogue of failing the server's wire
+    connections.  The still-running handlers finish on their own; their
+    late done() is dropped by the claim.  Returns the number failed."""
+    with _inflight_lock:
+        states = list(_inflight.get(id(server), ()))
+    n = 0
+    for st in states:
+        if st.try_finish():
+            st.cntl.set_failed(code, text)
+            st.cntl.latency_us = (time.monotonic_ns() - st.t0) // 1000
+            _finish_client(st.cntl, st.done)
+            n += 1
+    return n
+
+
+def cancel(cntl: Controller) -> bool:
+    """Controller.cancel() hook: claim an in-flight loopback call with
+    ECANCELED (the late server completion is dropped)."""
+    state = cntl.__dict__.get("_loopback_state")
+    if state is None or not state.try_finish():
+        return False
+    cntl.set_failed(errors.ECANCELED, "canceled by caller")
+    cntl.latency_us = (time.monotonic_ns() - state.t0) // 1000
+    _finish_client(cntl, state.done)
+    return True
+
+
+def call(server, method_full_name: str, cntl: Controller, request: Any,
+         response_cls: Any, done=None):
+    """One loopback RPC.  Sync (done is None): returns the response or
+    None on failure, cntl filled either way.  Async: schedules done(cntl)
+    after completion and returns None."""
+    t0 = time.monotonic_ns()
+    state = _CallState(id(server), cntl, done, t0)
+    cntl._loopback_state = state
+    _inflight_add(state)
+    inline = bool(getattr(server.options, "usercode_inline", False))
+    try:
+        req_bytes = request.SerializeToString()
+    except AttributeError:
+        req_bytes = bytes(request) if request is not None else b""
+
+    if inline:
+        _serve(server, method_full_name, cntl, req_bytes, response_cls,
+               state)
+    else:
+        # mirror InputMessenger._process_message: the usercode backup
+        # pool when configured (queued-counter accounting for the drain
+        # gate), else a scheduler tasklet
+        pool = getattr(server, "usercode_pool", None)
+        dispatched = False
+        if pool is not None:
+            server.on_usercode_queued()
+            try:
+                pool.submit(_serve_pooled, server, method_full_name, cntl,
+                            req_bytes, response_cls, state)
+                dispatched = True
+            except RuntimeError:
+                server.on_usercode_done()
+        if not dispatched:
+            from ..bthread import scheduler
+            scheduler.start_background(
+                _serve, server, method_full_name, cntl, req_bytes,
+                response_cls, state,
+                name=f"loopback:{method_full_name}")
+
+    tms = cntl.timeout_ms
+    if done is not None:
+        if not state.finished and tms and tms > 0:
+            from ..bthread.timer_thread import TimerThread
+            TimerThread.instance().schedule_after(
+                lambda: _timeout(cntl, state), tms / 1000.0)
+        return None
+    if not state.finished:
+        ev = state.wait_begin()
+        if ev is not None:
+            from ..bthread import scheduler
+            scheduler.note_worker_blocked()
+            try:
+                # the deadline is the CLIENT's: claim ERPCTIMEDOUT the
+                # moment it expires (wire parity — its timer would fire
+                # now), while the server side keeps running and its late
+                # completion is dropped by the claim
+                ev.wait(tms / 1000.0 if tms and tms > 0 else None)
+            finally:
+                scheduler.note_worker_unblocked()
+            _timeout(cntl, state)
+    return cntl.response if not cntl.failed() else None
+
+
+def _timeout(cntl: Controller, state: _CallState) -> None:
+    """Deadline expiry: claim the completion if the server hasn't."""
+    if not state.try_finish():
+        return
+    cntl.latency_us = (time.monotonic_ns() - state.t0) // 1000
+    cntl.set_failed(errors.ERPCTIMEDOUT,
+                    f"reached timeout={cntl.timeout_ms}ms")
+    _finish_client(cntl, state.done)
+
+
+def _finish_client(cntl: Controller, done) -> None:
+    if cntl.span is not None:
+        from .span import end_client_span
+        end_client_span(cntl)
+    if done is not None:
+        from ..bthread import scheduler
+        scheduler.start_background(done, cntl, name="rpc_done")
+
+
+def _serve_pooled(server, full_name, cntl, req_bytes, response_cls,
+                  state) -> None:
+    try:
+        _serve(server, full_name, cntl, req_bytes, response_cls, state)
+    finally:
+        server.on_usercode_done()
+
+
+def _serve(server, full_name: str, client_cntl: Controller,
+           req_bytes: bytes, response_cls, state: _CallState) -> None:
+    """Server half: admission → parse → invoke → completion copy-back.
+    Runs inline on the caller (usercode_inline) or on a tasklet/pool
+    thread; semantically the loopback ProcessRpcRequest."""
+    t0 = state.t0
+    done = state.done
+    cntl = server_controller_pool.acquire()
+    cntl.server = server
+    if client_cntl.log_id:
+        cntl.log_id = client_cntl.log_id
+    ep = server.listen_endpoint
+    cntl.remote_side = ep
+    cntl.local_side = ep
+    tms = client_cntl.timeout_ms
+    if tms and tms > 0:
+        cntl.method_deadline = time.monotonic() + tms / 1000.0
+
+    def bail(code: int, text: str, status=None, counted=False) -> None:
+        if status is not None:
+            status.on_responded(code, 0)
+        if counted:
+            server.on_request_out()
+        cntl._maybe_recycle()
+        if not state.try_finish():
+            return
+        client_cntl.set_failed(code, text)
+        client_cntl.latency_us = (time.monotonic_ns() - t0) // 1000
+        _finish_client(client_cntl, done)
+
+    if server.is_draining():
+        bail(errors.ELOGOFF, "server is draining (lame duck)")
+        return
+    md = server.find_method(full_name)
+    if not server.on_request_in():
+        bail(errors.ELIMIT, "server max_concurrency reached")
+        return
+    if md is None:
+        service = full_name.rpartition(".")[0]
+        bail(errors.ENOMETHOD if service in server.services()
+             else errors.ENOSERVICE, f"no method {full_name}",
+             counted=True)
+        return
+    status = server.method_status(full_name)
+    if status is not None and not status.on_requested():
+        bail(errors.ELIMIT, f"method {full_name} max_concurrency reached",
+             counted=True)
+        return
+    start_us = time.monotonic_ns() // 1000
+    try:
+        request = md.request_cls()
+        request.ParseFromString(req_bytes)
+    except Exception as e:
+        bail(errors.EREQUEST, f"fail to parse request: {e}",
+             status=status, counted=True)
+        return
+    # zero-copy attachment pass: the handler sees the CALLER's request
+    # attachment IOBuf (in-process plane; mutating cuts consume it).
+    # Session-local data stays LAZY (Controller.session_local_data).
+    req_att = client_cntl._peek_request_attachment()
+    if req_att is not None:
+        cntl.request_attachment = req_att
+    response = md.response_cls()
+    done_called = [False]
+
+    def s_done() -> None:
+        if done_called[0]:
+            return
+        done_called[0] = True
+        err = cntl.error_code_
+        if status is not None:
+            status.on_responded(err,
+                                time.monotonic_ns() // 1000 - start_us)
+        server.on_request_out()
+        if not state.try_finish():
+            return       # caller timed out / canceled / lame-duck-failed:
+        #                  dropped like a stale correlation version
+        if err:
+            client_cntl.set_failed(err, cntl.error_text_)
+        else:
+            resp_att = cntl._peek_response_attachment()
+            if resp_att is not None and len(resp_att):
+                client_cntl.response_attachment = resp_att
+                # detach so the pooled shim's reset can't recycle the
+                # buffer now owned by the caller
+                cntl.__dict__.pop("response_attachment", None)
+            if response_cls is None:
+                client_cntl.response = response.SerializeToString()
+            elif md.response_cls is response_cls:
+                client_cntl.response = response
+            else:
+                out = response_cls()
+                out.ParseFromString(response.SerializeToString())
+                client_cntl.response = out
+            client_cntl.error_code_ = 0
+        client_cntl.latency_us = (time.monotonic_ns() - t0) // 1000
+        _finish_client(client_cntl, done)
+
+    cntl.set_server_done(s_done)
+    try:
+        md.invoke(cntl, request, response, s_done)
+    except Exception as e:   # uncaught user exception → EINTERNAL
+        log.error("method %s raised: %s", full_name, e, exc_info=True)
+        if not done_called[0]:
+            cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
+            s_done()
+            cntl._release_session_data()
+            cntl._maybe_recycle()

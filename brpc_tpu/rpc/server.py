@@ -297,6 +297,10 @@ class Server:
         if ep.scheme == SCHEME_MEM:
             from .mem_transport import mem_listen
             self._mem_listener = mem_listen(ep.host, self._on_accept)
+            # loopback fast plane: in-process tpu_std channels dispatch
+            # straight into this server's method table (loopback.py)
+            from . import loopback
+            loopback.register_server(ep.host, self)
         elif ep.scheme == SCHEME_TCP:
             from .tcp_transport import Acceptor
             self._acceptor = Acceptor(self._on_accept,
@@ -426,8 +430,21 @@ class Server:
     def _teardown_listeners(self, keep_native: bool = False) -> None:
         if self._mem_listener is not None:
             from .mem_transport import mem_unlisten
+            from . import loopback
             mem_unlisten(self._mem_listener.name)
+            if keep_native:
+                # lame-duck drain: the loopback front door stays open so
+                # in-process callers get the retryable ELOGOFF bounce
+                # (mirrors the native ici door below); phase-2 teardown
+                # unregisters it
+                self._drain_loopback_name = self._mem_listener.name
+            else:
+                loopback.unregister_server(self._mem_listener.name, self)
             self._mem_listener = None
+        if not keep_native and getattr(self, "_drain_loopback_name", None):
+            from . import loopback
+            loopback.unregister_server(self._drain_loopback_name, self)
+            self._drain_loopback_name = None
         if self._acceptor is not None:
             self._acceptor.stop()
             self._acceptor = None
@@ -535,6 +552,12 @@ class Server:
             self._close_server_streams(conns)
             if not drained:
                 self._fail_pending_device_transfers(drain_start_ns)
+        # loopback stragglers (past the grace window, or any in-flight on
+        # an immediate stop) fail exactly like the wire connections
+        # below: claimed with retryable ELOGOFF, the still-running
+        # handler's late done() is dropped
+        from . import loopback
+        loopback.fail_inflight(self, errors.ELOGOFF, "server stopping")
         for s in conns:
             # graceful h2 shutdown: GOAWAY first so the peer knows which
             # streams were processed and retries the rest safely

@@ -42,13 +42,18 @@ class MethodDescriptor:
 
     def invoke(self, cntl, request, response, done) -> None:
         """Run the handler with a done that recycles per-RPC server
-        resources (session-local data) once the response is sent — the
-        protocol-agnostic completion point every wire protocol shares."""
+        resources (session-local data, then the pooled Controller shim
+        itself) once the response is sent — the protocol-agnostic
+        completion point every wire protocol shares.  After ``done``
+        returns the controller may be reset and reused by another
+        request, so handlers must not touch it past their ``done()``
+        call (the reference's Closure contract)."""
         def wrapped_done(*args, **kwargs):
             try:
                 return done(*args, **kwargs)
             finally:
                 cntl._release_session_data()
+                cntl._maybe_recycle()
         self.fn(cntl, request, response, wrapped_done)
 
 

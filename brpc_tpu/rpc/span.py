@@ -33,7 +33,8 @@ from ..butil import flags as _flags
 from .. import bvar
 from ..bthread import scheduler
 
-_flags.define_flag("rpcz_enabled", False, "collect per-RPC rpcz spans")
+_rpcz_flag = _flags.define_flag("rpcz_enabled", False,
+                                "collect per-RPC rpcz spans")
 _flags.define_flag("rpcz_keep", 1000, "spans kept in memory",
                    _flags.positive_integer)
 
@@ -91,7 +92,9 @@ class Span:
 
 
 def rpcz_enabled() -> bool:
-    return bool(_flags.get_flag("rpcz_enabled"))
+    # one attribute load, not a registry-dict lookup: this gate sits on
+    # every call's client-span check
+    return bool(_rpcz_flag.value)
 
 
 def maybe_start_client_span(cntl, method: str) -> None:

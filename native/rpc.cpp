@@ -25,6 +25,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -1339,6 +1340,49 @@ typedef void (*py_ici_request_fn)(uint64_t token, const char* method,
                                   const IciSegC* segs, uint64_t nsegs,
                                   uint64_t log_id, int32_t peer_dev);
 
+// ---- one-struct batched upcall ABI -------------------------------------
+// The Python-handler tier's request boundary: ONE ctypes crossing hands
+// the handler tier an array of packed request structs (method id,
+// correlation token, deadline metadata, payload views), and one crossing
+// takes an array of packed response structs back
+// (brpc_tpu_ici_respond_batch).  Replaces the per-request 10-argument
+// upcall + 9-argument respond chatter: under load the GIL acquisition
+// and argument marshalling amortize over the whole batch.
+struct IciReqC {
+  uint64_t token;          // respond exactly once with this token
+  const char* method;      // "Service.Method"
+  const uint8_t* payload;  // request body (borrowed for the upcall)
+  uint64_t payload_len;
+  const uint8_t* att_host; // host-attachment bytes (borrowed)
+  uint64_t att_host_len;
+  const IciSegC* segs;     // device-ref sidecar; Python TAKES the keys
+  uint64_t nsegs;
+  uint64_t log_id;
+  int64_t recv_ns;         // steady-clock enqueue stamp (queue stage)
+  int32_t peer_dev;
+  int32_t _pad;
+};
+// (reqs, n): process each request; every token answered exactly once
+typedef void (*py_ici_batch_fn)(const IciReqC* reqs, uint64_t n);
+
+struct IciRespC {
+  uint64_t token;
+  uint64_t err;            // 0 = success
+  const char* err_text;    // may be null
+  const uint8_t* data;     // response payload (borrowed for the call)
+  uint64_t len;
+  const uint8_t* att_host;
+  uint64_t att_host_len;
+  const IciSegC* segs;     // custody of device keys transfers to native
+  uint64_t nsegs;
+};
+
+static inline int64_t ici_now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
 static std::atomic<py_relocate_fn> g_ici_relocate{nullptr};
 static std::atomic<py_release_fn> g_ici_release{nullptr};
 
@@ -1506,6 +1550,22 @@ struct IciMsg {
   int64_t wire_bytes = 0;        // credits returned when consumed
 };
 
+// A Python-tier request parked in the server's batch queue: owns the
+// frame bytes (the IciReqC views point into them) until the upcall
+// consumes it.  Credits return when the upcall does.
+struct IciBatchItem {
+  uint64_t token = 0;
+  std::string method;
+  std::string bytes;             // full frame; payload/att are spans of it
+  size_t payload_off = 0, payload_len = 0, att_len = 0;
+  std::vector<IciSegC> segs;
+  uint64_t log_id = 0;
+  int32_t peer_dev = 0;
+  int64_t enq_ns = 0;
+  IciConnPtr conn;
+  int64_t wire_bytes = 0;
+};
+
 // Dispatch discipline: the in-process transport's "IO thread" is the
 // CALLER — ici_do_call runs the server's frame processing inline on the
 // client thread (the reference's usercode-in-IO-thread default,
@@ -1526,6 +1586,17 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
 
   void stop() {
     stop_.store(true, std::memory_order_release);
+    // fail queued-but-undelivered Python-tier batch items first: their
+    // device refs release and their callers get a specific error instead
+    // of a parked request that nothing will ever drain
+    std::deque<IciBatchItem> leftover;
+    {
+      std::lock_guard<std::mutex> g(bq_mu_);
+      bq_stopped_ = true;
+      leftover.swap(bq_);
+    }
+    for (auto& it : leftover)
+      fail_batch_item(it, 1009, "ici server stopped");
     std::vector<IciConnPtr> conns;
     {
       std::lock_guard<std::mutex> g(conns_mu_);
@@ -1554,6 +1625,24 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
     handler_.store(fn, std::memory_order_release);
   }
 
+  void set_batch_handler(py_ici_batch_fn fn) {
+    batch_handler_.store(fn, std::memory_order_release);
+  }
+
+  void set_batch_params(uint64_t max_batch, int64_t age_us) {
+    if (max_batch > 0)
+      batch_max_.store(max_batch, std::memory_order_relaxed);
+    if (age_us >= 0)
+      batch_age_ns_.store(age_us * 1000, std::memory_order_relaxed);
+  }
+
+  void batch_stats(uint64_t* upcalls, uint64_t* requests,
+                   uint64_t* max_batch) const {
+    *upcalls = upcalls_.load(std::memory_order_relaxed);
+    *requests = upcall_reqs_.load(std::memory_order_relaxed);
+    *max_batch = batch_max_seen_.load(std::memory_order_relaxed);
+  }
+
   IciConnPtr accept(const IciChannelPtr& ch, int32_t client_dev,
                     int64_t window_bytes) {
     auto c = std::make_shared<IciConn>();
@@ -1578,10 +1667,13 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
   // Inline dispatch entry: runs on the caller's thread; returns the
   // frame's credits to the connection when the frame is consumed.
   void dispatch(IciMsg&& m) {
-    process(m);
+    IciConnPtr conn = m.conn;
+    int64_t credits = m.wire_bytes;
     // request frame consumed: return its credits (the piggybacked-ACK
-    // of the RDMA window; the reference replenishes on completion)
-    m.conn->return_credits(m.wire_bytes);
+    // of the RDMA window; the reference replenishes on completion).
+    // process() returns false when the frame moved into the Python
+    // batch queue — the batch upcall returns the credits then.
+    if (process(m)) conn->return_credits(credits);
   }
 
  private:
@@ -1591,23 +1683,25 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
       ch->deliver(cid, err, text, "", "", {});
   }
 
-  void process(IciMsg& msg) {
+  // Returns true when the frame's credits may be returned by the caller
+  // (consumed inline); false when the frame moved into the batch queue.
+  bool process(IciMsg& msg) {
     const uint8_t* p = (const uint8_t*)msg.bytes.data();
     size_t sz = msg.bytes.size();
     if (sz < kHeaderSize || memcmp(p, kMagic, 4) != 0) {
       ici_release_segs(msg.segs);
-      return;                         // malformed: drop (framing guard)
+      return true;                    // malformed: drop (framing guard)
     }
     uint32_t meta_size = get_u32be(p + 4);
     uint32_t body_size = get_u32be(p + 8);
     if (kHeaderSize + (size_t)meta_size + body_size != sz) {
       ici_release_segs(msg.segs);
-      return;
+      return true;
     }
     RpcMeta meta;
     if (!decode_meta(p + kHeaderSize, p + kHeaderSize + meta_size, &meta)) {
       ici_release_segs(msg.segs);
-      return;
+      return true;
     }
     requests_.fetch_add(1, std::memory_order_relaxed);
     const uint8_t* body = p + kHeaderSize + meta_size;
@@ -1629,7 +1723,7 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
       if (!ici_relocate_segs(msg.segs, msg.conn->client_dev)) {
         ici_release_segs(msg.segs);
         reply_error(msg, cid, 1009, "ici relocation failed");
-        return;
+        return true;
       }
       if (auto ch = msg.conn->client.lock()) {
         ch->deliver(cid, 0, "",
@@ -1639,30 +1733,153 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
       } else {
         ici_release_segs(msg.segs);
       }
-      return;
+      return true;
     }
+    py_ici_batch_fn bh = batch_handler_.load(std::memory_order_acquire);
     py_ici_request_fn h = handler_.load(std::memory_order_acquire);
-    if (h != nullptr) {
+    if (bh != nullptr || h != nullptr) {
       // user-code tier: refs land resident on the SERVER device before
       // the handler sees them (the test contract: a handler observes its
       // attachment in local HBM)
       if (!ici_relocate_segs(msg.segs, dev_)) {
         ici_release_segs(msg.segs);
         reply_error(msg, cid, 1009, "ici relocation failed");
-        return;
+        return true;
       }
       uint64_t token = register_token(msg.conn, cid);
+      if (bh != nullptr) {
+        IciBatchItem item;
+        item.token = token;
+        item.method = std::move(full);
+        item.payload_off = kHeaderSize + meta_size;
+        item.payload_len = payload_len;
+        item.att_len = att;
+        item.log_id = meta.request.log_id;
+        item.peer_dev = msg.conn->client_dev;
+        item.enq_ns = ici_now_ns();
+        item.conn = msg.conn;
+        item.wire_bytes = msg.wire_bytes;
+        item.bytes = std::move(msg.bytes);
+        item.segs = std::move(msg.segs);
+        enqueue_batch(std::move(item));
+        return false;
+      }
+      // legacy single-request upcall ABI (no batch handler installed)
       h(token, full.c_str(), body, payload_len, body + payload_len, att,
         msg.segs.data(), msg.segs.size(), meta.request.log_id,
         msg.conn->client_dev);
       // the upcall TOOK the refs (Python popped them into its IOBuf):
       // native custody ends without release
       msg.segs.clear();
-      return;
+      return true;
     }
     ici_release_segs(msg.segs);
     reply_error(msg, cid, 1002, "no method " + full);
+    return true;
   }
+
+  // ---- Python batch queue (the batched-GIL-crossing core) ------------
+  // Arrival discipline: the first enqueuer becomes the DRAINER and loops
+  // delivering batches until the queue is empty; later arrivals just
+  // enqueue (their requests ride the drainer's next batch — that is the
+  // amortization) unless the oldest queued request has aged past
+  // batch_age_ns_, in which case the arrival STEALS the whole queue and
+  // delivers it concurrently — p99 never pays more than the age bound
+  // for batching, even with a drainer stuck in a slow inline handler.
+  // An idle arrival is a batch of 1 delivered immediately: p50 pays no
+  // batching delay at all.
+  void enqueue_batch(IciBatchItem&& item) {
+    std::vector<IciBatchItem> batch;
+    bool owner = false;
+    {
+      std::lock_guard<std::mutex> g(bq_mu_);
+      if (!bq_stopped_) {
+        bq_.push_back(std::move(item));
+        if (!bq_draining_) {
+          bq_draining_ = true;
+          owner = true;
+          take_batch_locked(&batch);
+        } else if (ici_now_ns() - bq_.front().enq_ns >=
+                   batch_age_ns_.load(std::memory_order_relaxed)) {
+          take_batch_locked(&batch);   // steal: concurrent delivery
+        } else {
+          return;                      // the active drainer will take it
+        }
+      }
+    }
+    if (!owner && batch.empty()) {
+      // enqueued after stop: fail it here (stop's sweep already ran)
+      fail_batch_item(item, 1009, "ici server stopped");
+      return;
+    }
+    for (;;) {
+      deliver_batch(batch);
+      if (!owner) return;
+      {
+        std::lock_guard<std::mutex> g(bq_mu_);
+        if (bq_.empty() || bq_stopped_) {
+          bq_draining_ = false;
+          return;
+        }
+        batch.clear();
+        take_batch_locked(&batch);
+      }
+    }
+  }
+
+  // fablint: lock-held(bq_mu_)
+  void take_batch_locked(std::vector<IciBatchItem>* out) {
+    uint64_t max_n = batch_max_.load(std::memory_order_relaxed);
+    while (!bq_.empty() && out->size() < max_n) {
+      out->push_back(std::move(bq_.front()));
+      bq_.pop_front();
+    }
+  }
+
+  void deliver_batch(std::vector<IciBatchItem>& batch) {
+    py_ici_batch_fn bh = batch_handler_.load(std::memory_order_acquire);
+    if (bh == nullptr) {               // detached mid-flight
+      for (auto& it : batch)
+        fail_batch_item(it, 1009, "ici batch handler detached");
+      return;
+    }
+    std::vector<IciReqC> reqs;
+    reqs.reserve(batch.size());
+    for (auto& it : batch) {
+      const uint8_t* base = (const uint8_t*)it.bytes.data();
+      IciReqC r;
+      r.token = it.token;
+      r.method = it.method.c_str();
+      r.payload = base + it.payload_off;
+      r.payload_len = it.payload_len;
+      r.att_host = base + it.payload_off + it.payload_len;
+      r.att_host_len = it.att_len;
+      r.segs = it.segs.data();
+      r.nsegs = it.segs.size();
+      r.log_id = it.log_id;
+      r.recv_ns = it.enq_ns;
+      r.peer_dev = it.peer_dev;
+      r._pad = 0;
+      reqs.push_back(r);
+    }
+    upcalls_.fetch_add(1, std::memory_order_relaxed);
+    upcall_reqs_.fetch_add(batch.size(), std::memory_order_relaxed);
+    uint64_t n = batch.size();
+    uint64_t seen = batch_max_seen_.load(std::memory_order_relaxed);
+    while (n > seen && !batch_max_seen_.compare_exchange_weak(
+                           seen, n, std::memory_order_relaxed)) {
+    }
+    bh(reqs.data(), reqs.size());
+    // the upcall TOOK every request's seg keys (Python popped them into
+    // its IOBufs): native custody ends without release.  Credits return
+    // now — the frames are consumed.
+    for (auto& it : batch) {
+      it.segs.clear();
+      it.conn->return_credits(it.wire_bytes);
+    }
+  }
+
+  void fail_batch_item(IciBatchItem& it, uint64_t err, const char* text);
 
   uint64_t register_token(const IciConnPtr& conn, uint64_t cid);
 
@@ -1675,7 +1892,18 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
   std::mutex mmu_;
   std::unordered_map<std::string, bool> echo_methods_;
   std::atomic<py_ici_request_fn> handler_{nullptr};
+  std::atomic<py_ici_batch_fn> batch_handler_{nullptr};
   std::atomic<uint64_t> requests_{0};
+  // batch queue state (guarded by bq_mu_; see enqueue_batch)
+  std::mutex bq_mu_;
+  std::deque<IciBatchItem> bq_;
+  bool bq_draining_ = false;
+  bool bq_stopped_ = false;
+  std::atomic<uint64_t> batch_max_{64};
+  std::atomic<int64_t> batch_age_ns_{50 * 1000};   // ~50 us steal bound
+  std::atomic<uint64_t> upcalls_{0};
+  std::atomic<uint64_t> upcall_reqs_{0};
+  std::atomic<uint64_t> batch_max_seen_{0};
 };
 using IciServerPtr = std::shared_ptr<IciServer>;
 
@@ -1706,6 +1934,29 @@ uint64_t IciServer::register_token(const IciConnPtr& conn, uint64_t cid) {
   std::lock_guard<std::mutex> g(g_ici_tokens_mu);
   g_ici_tokens[token] = IciPending{conn, cid};
   return token;
+}
+
+// Drop path for a queued Python-tier request that will never reach the
+// upcall (server stopped / handler detached): release ref custody, take
+// the token so a late respond can't double-deliver, error the caller,
+// and return the frame's credits.
+void IciServer::fail_batch_item(IciBatchItem& it, uint64_t err,
+                                const char* text) {
+  ici_release_segs(it.segs);
+  it.segs.clear();
+  IciPending pr;
+  bool had = false;
+  {
+    std::lock_guard<std::mutex> g(g_ici_tokens_mu);
+    had = g_ici_tokens.take(it.token, &pr);
+  }
+  if (had) {
+    if (auto conn = pr.conn.lock()) {
+      if (auto ch = conn->client.lock())
+        ch->deliver(pr.cid, err, text, "", "", {});
+    }
+  }
+  if (it.conn != nullptr) it.conn->return_credits(it.wire_bytes);
 }
 
 // The client-side unary call: window reservation → TRPC frame encode →
@@ -2191,6 +2442,39 @@ int brpc_tpu_ici_set_handler(uint64_t h, nrpc::py_ici_request_fn fn) {
   return 0;
 }
 
+// Batched one-struct upcall variant of brpc_tpu_ici_listen: the handler
+// receives (IciReqC*, n) — see the ABI comment at IciReqC.
+uint64_t brpc_tpu_ici_listen_batch(int32_t dev, nrpc::py_ici_batch_fn fn) {
+  uint64_t h = brpc_tpu_ici_listen(dev, nullptr);
+  if (h == 0) return 0;
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  auto it = nrpc::g_ici_servers.find(h);
+  if (it != nrpc::g_ici_servers.end()) it->second->set_batch_handler(fn);
+  return h;
+}
+
+// max_batch <= 0 keeps the current cap; age_us < 0 keeps the current
+// steal bound (age_us == 0 means steal-always: every arrival delivers
+// concurrently, i.e. batching effectively off past the first drainer).
+int brpc_tpu_ici_set_batch_params(uint64_t h, int64_t max_batch,
+                                  int64_t age_us) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  auto it = nrpc::g_ici_servers.find(h);
+  if (it == nrpc::g_ici_servers.end()) return -1;
+  it->second->set_batch_params(max_batch > 0 ? (uint64_t)max_batch : 0,
+                               age_us);
+  return 0;
+}
+
+int brpc_tpu_ici_batch_stats(uint64_t h, uint64_t* upcalls,
+                             uint64_t* requests, uint64_t* max_batch) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  auto it = nrpc::g_ici_servers.find(h);
+  if (it == nrpc::g_ici_servers.end()) return -1;
+  it->second->batch_stats(upcalls, requests, max_batch);
+  return 0;
+}
+
 uint64_t brpc_tpu_ici_requests(uint64_t h) {
   std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
   auto it = nrpc::g_ici_servers.find(h);
@@ -2385,6 +2669,53 @@ int brpc_tpu_ici_respond(uint64_t token, uint64_t err, const char* err_text,
                   ? std::string((const char*)att_host, att_host_len)
                   : std::string(),
               std::move(seg_vec));
+  return 0;
+}
+
+// Batched write-back half of the one-struct ABI: one ctypes crossing
+// delivers every ready response the Python side accumulated (symmetric
+// with the batched request upcall).  Per-item custody/drop semantics are
+// brpc_tpu_ici_respond's, EXCEPT that native releases seg custody on
+// every failure path (including a vanished token) — the batch caller
+// gets no per-item return code, so it must never need one.
+int brpc_tpu_ici_respond_batch(const nrpc::IciRespC* rs, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) {
+    const nrpc::IciRespC& r = rs[i];
+    nrpc::IciPending pr;
+    bool had;
+    {
+      std::lock_guard<std::mutex> g(nrpc::g_ici_tokens_mu);
+      had = nrpc::g_ici_tokens.take(r.token, &pr);
+    }
+    std::vector<nrpc::IciSegC> seg_vec(r.segs, r.segs + r.nsegs);
+    if (!had) {
+      nrpc::ici_release_segs(seg_vec);
+      continue;
+    }
+    auto conn = pr.conn.lock();
+    if (conn == nullptr) {
+      nrpc::ici_release_segs(seg_vec);
+      continue;
+    }
+    if (!nrpc::ici_relocate_segs(seg_vec, conn->client_dev)) {
+      nrpc::ici_release_segs(seg_vec);
+      if (auto ch = conn->client.lock())
+        ch->deliver(pr.cid, 1009, "ici relocation failed", "", "", {});
+      continue;
+    }
+    auto ch = conn->client.lock();
+    if (ch == nullptr) {
+      nrpc::ici_release_segs(seg_vec);
+      continue;
+    }
+    ch->deliver(pr.cid, r.err, r.err_text ? r.err_text : "",
+                r.len ? std::string((const char*)r.data, r.len)
+                      : std::string(),
+                r.att_host_len
+                    ? std::string((const char*)r.att_host, r.att_host_len)
+                    : std::string(),
+                std::move(seg_vec));
+  }
   return 0;
 }
 
@@ -2645,6 +2976,12 @@ uint64_t brpc_tpu_ici_call2(uint64_t, const char*, const uint8_t*,
 int brpc_tpu_ici_respond(uint64_t, uint64_t, const char*, const uint8_t*,
                          uint64_t, const uint8_t*, uint64_t, const void*,
                          uint64_t) { return -1; }
+uint64_t brpc_tpu_ici_listen_batch(int32_t, void*) { return 0; }
+int brpc_tpu_ici_set_batch_params(uint64_t, int64_t, int64_t) { return -1; }
+int brpc_tpu_ici_batch_stats(uint64_t, uint64_t*, uint64_t*, uint64_t*) {
+  return -1;
+}
+int brpc_tpu_ici_respond_batch(const void*, uint64_t) { return -1; }
 int64_t brpc_tpu_ici_echo_p50_ns(int, int, uint64_t, uint64_t, int32_t) {
   return -1;
 }

@@ -70,6 +70,7 @@ _SETTLE_S = 2.0
 
 
 def _census():
+    from brpc_tpu.rpc.controller import server_controller_pool
     from brpc_tpu.rpc.socket import _socket_pool
     from brpc_tpu.rpc.stream import _streams
     from brpc_tpu.ici.device_plane import DevicePlane
@@ -83,12 +84,13 @@ def _census():
     streams = {s.sid: s for s in _streams.live_payloads()}
     plane = DevicePlane._instance      # never CREATE one from the census
     pins = plane.active_transfers() if plane is not None else 0
-    return threads, sockets, streams, pins
+    cntls = server_controller_pool.live()
+    return threads, sockets, streams, pins, cntls
 
 
 def _leaks_vs(base):
-    threads0, sockets0, streams0, pins0 = base
-    threads1, sockets1, streams1, pins1 = _census()
+    threads0, sockets0, streams0, pins0, cntls0 = base
+    threads1, sockets1, streams1, pins1, cntls1 = _census()
     leaks = []
     for t in threads1 - threads0:
         leaks.append(f"non-daemon thread {t.name!r}")
@@ -100,6 +102,13 @@ def _leaks_vs(base):
     if pins1 > max(pins0, 0):
         leaks.append(f"device-plane pins: {pins1} active transfers "
                      f"(was {pins0})")
+    if cntls1 > cntls0:
+        # a pooled server Controller acquired for a request and never
+        # recycled: its request never sent a response (or a new code
+        # path skipped _maybe_recycle) — the pool's versioned-id leg
+        # makes the leak countable here
+        leaks.append(f"pooled server Controllers in flight: {cntls1} "
+                     f"(was {cntls0})")
     return leaks
 
 

@@ -1,0 +1,30 @@
+"""Seeded violation for the batched-delivery state: a response
+collector whose batch queue is appended outside its lock — the exact
+shape of the PR-8 _RespondCollector / loopback registries, which fablint
+must keep honest."""
+import threading
+
+
+class BatchCollector:
+    _GUARDED_BY = {"_items": "_lock", "_open": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._open = True
+
+    def add_locked(self, item) -> bool:
+        with self._lock:
+            if not self._open:
+                return False
+            self._items.append(item)
+            return True
+
+    def add_racy(self, item) -> None:
+        self._items.append(item)       # line 24: the violation
+
+    def close(self):
+        with self._lock:
+            self._open = False
+            items, self._items = self._items, []
+        return items

@@ -1,0 +1,194 @@
+"""Pooled server-side Controllers (rpc/controller.py ControllerPool).
+
+The classic pool bug is stale state: request k's error code, attachment,
+span, or session data presented to request k+1 through a recycled shim.
+These tests pin the reset contract at the pool level AND through real
+servers on both in-process planes (mem:// loopback and the native ici
+batched upcall tier), plus the census-facing invariants: in-use count
+returns to zero and the free list reaches a steady state under
+sustained load instead of growing per request.
+"""
+import threading
+
+import pytest
+
+import brpc_tpu.policy  # noqa: F401  (registers protocols)
+from brpc_tpu import rpc
+from brpc_tpu.rpc.controller import (Controller, ControllerPool,
+                                     server_controller_pool)
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+
+class TestPoolUnit:
+    def test_reuse_presents_pristine_state(self):
+        """A shim that carried an error code, attachments, span, log id,
+        and session data on request k is fully reset on request k+1."""
+        pool = ControllerPool()
+        c = pool.acquire()
+        c.set_failed(1003, "deliberate")
+        c.log_id = 77
+        c.request_attachment.append(b"req-bytes")
+        c.response_attachment.append(b"resp-bytes")
+        c.span = object()
+        c.trace_id = 123
+        c._session_data = {"scratch": 1}
+        c.method_deadline = 42.0
+        c.auth_token = "tok"
+        pool.release(c)
+        c2 = pool.acquire()
+        assert c2 is c                       # actually reused
+        assert c2.error_code_ == 0 and c2.error_text_ == ""
+        assert not c2.failed()
+        assert c2.log_id == 0
+        assert c2._peek_request_attachment() is None
+        assert c2._peek_response_attachment() is None
+        assert len(c2.request_attachment) == 0
+        assert c2.span is None and c2.trace_id == 0
+        assert c2._session_data is None
+        assert c2.method_deadline is None
+        assert c2.auth_token == ""
+        pool.release(c2)
+
+    def test_versioned_ids_reject_double_release(self):
+        pool = ControllerPool()
+        a = pool.acquire()
+        assert pool.live() == 1
+        pool.release(a)
+        assert pool.live() == 0
+        free_before = pool.free_count()
+        pool.release(a)                      # stale release: rejected
+        assert pool.free_count() == free_before
+        assert pool.live() == 0
+
+    def test_live_enumeration(self):
+        pool = ControllerPool()
+        a, b = pool.acquire(), pool.acquire()
+        assert pool.live() == 2
+        assert set(map(id, pool.live_controllers())) == {id(a), id(b)}
+        pool.release(a)
+        pool.release(b)
+        assert pool.live() == 0
+
+    def test_capacity_bounds_free_list(self):
+        pool = ControllerPool(capacity=2)
+        cs = [pool.acquire() for _ in range(5)]
+        for c in cs:
+            pool.release(c)
+        assert pool.free_count() == 2
+        assert pool.live() == 0
+
+
+class _StainService(rpc.Service):
+    """Alternates a 'staining' failure (error + attachment + log id)
+    with a clean echo, so consecutive requests exercise reuse."""
+
+    SERVICE_NAME = "EchoService"
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        if request.message == "stain":
+            cntl.response_attachment.append(b"stain" * 100)
+            cntl.log_id = 999
+            cntl.set_failed(1003, "stained")
+            done()
+            return
+        # the clean request must observe a pristine controller even
+        # though the previous (stained) request used the same shim
+        assert cntl.error_code_ == 0, "stale error code leaked"
+        assert cntl.log_id == 0, "stale log_id leaked"
+        resp_att = cntl._peek_response_attachment()
+        assert resp_att is None or len(resp_att) == 0, \
+            "stale response attachment leaked"
+        response.message = request.message
+        done()
+
+
+def _drive_reuse(target, n_pairs=40, **chan_kw):
+    ch = rpc.Channel()
+    ch.init(target, options=rpc.ChannelOptions(timeout_ms=10000,
+                                               max_retry=0, **chan_kw))
+    for i in range(n_pairs):
+        c1 = rpc.Controller()
+        ch.call_method("EchoService.Echo", c1,
+                       EchoRequest(message="stain"), EchoResponse)
+        assert c1.error_code_ == 1003, (c1.error_code_, c1.error_text_)
+        c2 = rpc.Controller()
+        resp = ch.call_method("EchoService.Echo", c2,
+                              EchoRequest(message=f"ok{i}"), EchoResponse)
+        assert not c2.failed(), c2.error_text
+        assert resp.message == f"ok{i}"
+    ch.close()
+
+
+class TestPoolThroughServers:
+    def test_reuse_clean_over_mem_loopback(self):
+        server = rpc.Server()
+        server.add_service(_StainService())
+        assert server.start("mem://cpool") == 0
+        try:
+            live0 = server_controller_pool.live()
+            _drive_reuse("mem://cpool")
+            assert server_controller_pool.live() == live0, \
+                "in-flight pooled controllers leaked"
+        finally:
+            server.stop()
+
+    def test_reuse_clean_over_native_ici(self):
+        from brpc_tpu.ici import native_plane
+        if not native_plane.available():
+            pytest.skip("native core unavailable")
+        opts = rpc.ServerOptions()
+        opts.usercode_inline = True
+        server = rpc.Server(opts)
+        server.add_service(_StainService())
+        assert server.start("ici://7") == 0
+        try:
+            live0 = server_controller_pool.live()
+            _drive_reuse("ici://7")
+            assert server_controller_pool.live() == live0
+        finally:
+            server.stop()
+
+    def test_pool_reaches_steady_state_under_sustained_load(self):
+        """The census contract: sustained concurrent load grows the free
+        list to (at most) the concurrency high-water mark and then STOPS
+        — the pool reuses, it does not allocate per request."""
+        server = rpc.Server()
+        server.add_service(_StainService())
+        assert server.start("mem://cpool-steady") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("mem://cpool-steady",
+                    options=rpc.ChannelOptions(timeout_ms=10000,
+                                               max_retry=0))
+            nthreads = 8
+
+            def worker(k):
+                for i in range(30):
+                    c = rpc.Controller()
+                    ch.call_method("EchoService.Echo", c,
+                                   EchoRequest(message=f"w{k}-{i}"),
+                                   EchoResponse)
+                    assert not c.failed(), c.error_text
+
+            ts = [threading.Thread(target=worker, args=(k,))
+                  for k in range(nthreads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            mark = server_controller_pool.free_count()
+            # steady state: ANOTHER sustained burst must not grow the
+            # free list past the established high-water mark
+            ts = [threading.Thread(target=worker, args=(k,))
+                  for k in range(nthreads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert server_controller_pool.free_count() <= max(mark, 1), (
+                "pool kept allocating instead of reusing",
+                mark, server_controller_pool.free_count())
+            ch.close()
+        finally:
+            server.stop()

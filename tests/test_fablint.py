@@ -57,6 +57,15 @@ class TestFixtureViolations:
         msgs = " | ".join(f.message for f in out)
         assert "daemon" in msgs and "quiesce" in msgs
 
+    def test_unguarded_batch_queue_access_reported_with_line(self):
+        """The batched-delivery state class (PR 8): an append to the
+        response collector's batch queue outside its lock is caught at
+        the exact file:line."""
+        out = _findings("bad_batch_queue.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 24)]
+        assert "_items" in out[0].message and "_lock" in out[0].message
+        assert out[0].path.endswith("bad_batch_queue.py")
+
     def test_clean_fixture_is_silent(self):
         out = _findings(
             "clean_module.py",

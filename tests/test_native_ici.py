@@ -222,13 +222,14 @@ class TestNativeDatapath:
             binding = server._native_ici
             arr = _device_payload(mesh)
 
-            def err_with_segs(token, err, text):
+            def err_with_segs(token, err, text, collector=None, post=None):
                 att = IOBuf()
                 att.append_device_array(arr)
                 att_host, segs = split_attachment(att)
-                binding._respond(token, err, text, b"", att_host, segs)
+                binding._respond_flush([(token, err, text.encode(), b"",
+                                         att_host, segs, post)])
 
-            monkeypatch.setattr(binding, "_respond_err", err_with_segs)
+            monkeypatch.setattr(binding, "_respond_one", err_with_segs)
             ch = rpc.Channel()
             ch.init("ici://5")
             cntl = rpc.Controller()

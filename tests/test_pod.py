@@ -557,8 +557,9 @@ assert not failures, failures[:5]
 print("PHASE warm %%d" %% pid, flush=True)
 kv.wait_at_barrier("pc_warm", 120000)
 
-if pid in (0, 1):
-    # ---- surviving pair: continuous traffic, ZERO visible failures ----
+if pid not in (2, 3):
+    # ---- survivors (every member but the kill/drain targets, N-generic):
+    # continuous traffic, ZERO visible failures ----
     stop_traffic = threading.Event()
     def traffic():
         j = 100000 * (pid + 1)
@@ -689,7 +690,7 @@ else:
     kv.blocking_key_value_get("pc_revived", 180000)
     kv.wait_at_barrier("pc_done", 300000)
 
-if pid in (0, 1):
+if pid not in (2, 3):
     live_server = server
     kv.wait_at_barrier("pc_done", 300000)
 
@@ -713,13 +714,31 @@ print("PC%%d_OK" %% pid, flush=True)
 """
 
 
+def run_chaos(n: int = 4, timeout: int = 300) -> None:
+    """The kill/drain/revive chaos scenario, parameterized over pod
+    size: pids 0..n-1 join; pid 2 is killed, pid 3 drains, every OTHER
+    member keeps firing all-to-all traffic with zero visible failures;
+    both transitioned members revive and the epoch converges to
+    2n + 4 identically everywhere."""
+    outs = _run_pod(_POD_CHAOS % {"repo": REPO}, n=n, timeout=timeout,
+                    tag=f"pod_chaos_n{n}")
+    for i in range(n):
+        assert f"PC{i}_OK" in outs[i], outs[i][-2000:]
+
+
 def test_pod_chaos_kill_and_drain_under_all_to_all_n4():
     """The acceptance contract: N=4 all-to-all traffic; one member's
     serving endpoint killed, another drained mid-traffic; zero
     client-visible failures on surviving pairs; the killed member
     revives under a new socket id and rejoins the pod epoch, which
     converges to the same value on every member."""
-    outs = _run_pod(_POD_CHAOS % {"repo": REPO}, n=4, timeout=300,
-                    tag="pod_chaos")
-    for i in range(4):
-        assert f"PC{i}_OK" in outs[i], outs[i][-2000:]
+    run_chaos(n=4)
+
+
+@pytest.mark.slow
+def test_pod_chaos_kill_and_drain_under_all_to_all_n6():
+    """ROADMAP item 2 follow-on: the same kill/drain/revive contract at
+    N=6 — four surviving members (not two) carry the traffic while the
+    same one kill + one drain land, proving the harness and the epoch
+    algebra scale past the acceptance shape."""
+    run_chaos(n=6, timeout=420)

@@ -1,7 +1,9 @@
 """Native sanitizer builds: `make tsan` / `make asan` (slow-marked).
 
-Each target rebuilds libbrpc_tpu_core.so + core_test + fabric_smoke
-under the sanitizer (into native/build-tsan / build-asan — the
+Each target rebuilds libbrpc_tpu_core.so + core_test + fabric_smoke +
+ici_smoke (the PR-8 batched one-struct upcall ABI under concurrent
+callers, steal-mode drainers, a cross-thread responder, and an
+unlisten-mid-traffic drain) under the sanitizer (into native/build-tsan / build-asan — the
 production .so is never clobbered) and runs both with halt_on_error=1,
 so ANY report is a nonzero exit.  The sweep that landed this wiring
 fixed four real native findings instead of suppressing them:
@@ -62,6 +64,7 @@ def test_sanitizer_build_and_smoke(target, flag):
     assert res.returncode == 0, f"make {target} failed:\n{tail}"
     assert "ALL NATIVE TESTS PASSED" in res.stdout, tail
     assert "ALL FABRIC SMOKE PASSED" in res.stdout, tail
+    assert "ALL ICI SMOKE PASSED" in res.stdout, tail
     # halt_on_error=1 makes any report fatal, but belt-and-braces:
     assert "WARNING: ThreadSanitizer" not in res.stdout + res.stderr, tail
     assert "ERROR: AddressSanitizer" not in res.stdout + res.stderr, tail

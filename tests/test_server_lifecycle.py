@@ -628,3 +628,96 @@ class TestGracefulQuitOnSigterm:
             raise
         assert proc.returncode == 0, out
         assert "DRAINED" in out, out
+
+
+class TestDrainUnderBatchedDelivery:
+    def test_drain_counts_and_rejects_queued_requests_in_batches(self):
+        """usercode_in_pthread accounting under the batched ici upcall
+        ABI: requests delivered in a batch but queued-not-started on the
+        backup pool must (a) be counted INDIVIDUALLY by the drain gate
+        (batch contents, not batches) and (b) be answered retryable
+        ELOGOFF once the lame-duck drain flips — while the one request
+        already executing completes inside the grace window."""
+        from brpc_tpu.ici import native_plane
+        if not native_plane.available():
+            pytest.skip("native core unavailable")
+        gate = threading.Event()
+        entered = threading.Event()
+
+        class Blocky(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                if request.message == "block":
+                    entered.set()
+                    gate.wait(20)
+                response.message = request.message
+                done()
+
+        opts = rpc.ServerOptions()
+        opts.usercode_in_pthread = True
+        opts.usercode_backup_threads = 1      # serializes: 1 running, rest queued
+        server = rpc.Server(opts)
+        server.add_service(Blocky())
+        assert server.start("ici://9") == 0
+        binding = server._native_ici
+        assert binding is not None
+        try:
+            results = {}
+            lock = threading.Lock()
+
+            def caller(i, msg):
+                ch = rpc.Channel()
+                ch.init("ici://9",
+                        options=rpc.ChannelOptions(timeout_ms=20000,
+                                                   max_retry=0))
+                cntl = rpc.Controller()
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message=msg), EchoResponse)
+                with lock:
+                    results[i] = (cntl.error_code_, cntl.error_text_)
+                ch.close()
+
+            ts = [threading.Thread(target=caller, args=(0, "block"))]
+            ts[0].start()
+            assert entered.wait(10), "blocking request never started"
+            # these pile up behind the single busy pool worker: delivered
+            # by the batch upcall, counted queued, not yet started
+            for i in range(1, 4):
+                ts.append(threading.Thread(target=caller, args=(i, f"q{i}")))
+                ts[-1].start()
+            deadline = time.monotonic() + 10
+            while server.inflight_requests() < 4 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            # the drain gate SEES every queued-not-started request: the
+            # executing one plus the three parked in the batch/pool
+            assert server.inflight_requests() >= 4, \
+                server.inflight_requests()
+            # the batched ABI delivered them (snapshot before stop()
+            # tears the native listener down and zeroes the handle)
+            upcalls, delivered, _max = binding.batch_stats()
+            assert delivered >= 4, (upcalls, delivered)
+            stopper = threading.Thread(target=lambda: server.stop(8.0))
+            t0 = time.monotonic()
+            stopper.start()
+            time.sleep(0.4)
+            gate.set()                       # in-flight request completes
+            stopper.join(20)
+            dt = time.monotonic() - t0
+            assert not stopper.is_alive(), "stop() wedged"
+            assert dt < 8.0, ("drain should converge before grace "
+                              "expiry once the queue drains", dt)
+            for t in ts:
+                t.join(20)
+            # the blocked-but-executing request completed successfully...
+            assert results[0][0] == 0, results[0]
+            # ...and every queued-not-started one was answered ELOGOFF
+            # (retryable go-elsewhere), not dropped and not executed
+            for i in range(1, 4):
+                assert results[i][0] == errors.ELOGOFF, (i, results[i])
+            assert server.inflight_requests() == 0
+        finally:
+            gate.set()
+            server.stop()
