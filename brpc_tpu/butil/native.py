@@ -17,7 +17,7 @@ from typing import Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_ici_respond_batch"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_ici_call3"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -58,7 +58,8 @@ class IciCallOut(ctypes.Structure):
                 ("att_len", ctypes.c_uint64),
                 ("segs", ctypes.POINTER(IciSegC)),
                 ("nsegs", ctypes.c_uint64),
-                ("err_text", ctypes.c_void_p)]
+                ("err_text", ctypes.c_void_p),
+                ("retry_after_ms", ctypes.c_uint64)]
 
 
 # relocation upcall: (key, target_dev) -> new key (0 = failure)
@@ -101,7 +102,13 @@ class IciReqC(ctypes.Structure):
                 ("log_id", ctypes.c_uint64),
                 ("recv_ns", ctypes.c_int64),
                 ("peer_dev", ctypes.c_int32),
-                ("_pad", ctypes.c_int32)]
+                ("_pad", ctypes.c_int32),
+                # admission meta (appended; wire-encoded priority:
+                # 0 = unset, 1..N = band 0..N-1)
+                ("tenant", ctypes.c_char_p),
+                ("deadline_left_ms", ctypes.c_uint64),
+                ("priority", ctypes.c_int32),
+                ("_pad2", ctypes.c_int32)]
 
 
 class IciRespC(ctypes.Structure):
@@ -116,7 +123,8 @@ class IciRespC(ctypes.Structure):
                 ("att_host", ctypes.POINTER(ctypes.c_uint8)),
                 ("att_host_len", ctypes.c_uint64),
                 ("segs", ctypes.POINTER(IciSegC)),
-                ("nsegs", ctypes.c_uint64)]
+                ("nsegs", ctypes.c_uint64),
+                ("retry_after_ms", ctypes.c_uint64)]
 
 
 # batched ici request upcall: (reqs, n)
@@ -311,6 +319,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_ici_call2.argtypes = [
         ctypes.c_uint64, ctypes.c_char_p, u8p, ctypes.c_uint64, u8p,
         ctypes.c_uint64, segp, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.POINTER(IciCallOut)]
+    # call2 + admission meta (priority wire-encoded, tenant, remaining
+    # deadline budget); out.retry_after_ms carries the shed hint back
+    lib.brpc_tpu_ici_call3.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_call3.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, u8p, ctypes.c_uint64, u8p,
+        ctypes.c_uint64, segp, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
         ctypes.POINTER(IciCallOut)]
     lib.brpc_tpu_ici_respond.restype = ctypes.c_int
     lib.brpc_tpu_ici_respond.argtypes = [

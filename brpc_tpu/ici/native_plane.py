@@ -451,6 +451,7 @@ class ServerBinding:
         self._echo_methods: set = set()   # served fully in C, inline
         self._peer_eps: Dict[int, Any] = {}
         self._method_names: Dict[bytes, str] = {}   # decode cache
+        self._tenant_names: Dict[bytes, str] = {}   # decode cache
         self._mdcache: Dict[str, tuple] = {}   # full -> (md, status)
         self._tls = threading.local()          # reused respond array
         self._cb = _ICI_BATCH_FN(self._on_batch)   # pinned for lifetime
@@ -548,10 +549,27 @@ class ServerBinding:
                                 continue
                         else:
                             attachment = None
+                        # admission meta: (wire priority, tenant,
+                        # deadline_left_ms) — decoded here once so every
+                        # dispatch mode sees identical values
+                        tb = r.tenant
+                        if tb:
+                            tenant = self._tenant_names.get(tb)
+                            if tenant is None:
+                                tenant = tb.decode()
+                                # wire input: cap the decode cache so a
+                                # caller cycling tenant names can't grow
+                                # it without bound
+                                if len(self._tenant_names) < 1024:
+                                    self._tenant_names[tb] = tenant
+                        else:
+                            tenant = ""
+                        adm_meta = (r.priority, tenant,
+                                    r.deadline_left_ms)
                         if inline:
                             self._process(token, full, payload, attachment,
                                           r.log_id, r.peer_dev, r.recv_ns,
-                                          collector)
+                                          collector, adm_meta)
                         elif pool is not None:
                             # usercode_in_pthread under batching: EVERY
                             # request in the batch is counted queued
@@ -562,20 +580,22 @@ class ServerBinding:
                                 pool.submit(self._run_usercode, token,
                                             full, payload, attachment,
                                             r.log_id, r.peer_dev,
-                                            r.recv_ns)
+                                            r.recv_ns, adm_meta)
                             except RuntimeError:
                                 server.on_usercode_done()
                                 # pool shut down mid-stop: run here
                                 self._process(token, full, payload,
                                               attachment, r.log_id,
-                                              r.peer_dev, r.recv_ns, None)
+                                              r.peer_dev, r.recv_ns, None,
+                                              adm_meta)
                         else:
                             if scheduler is None:
                                 from ..bthread import scheduler
                             scheduler.start_background(
                                 self._process, token, full, payload,
                                 attachment, r.log_id, r.peer_dev,
-                                r.recv_ns, None, name=f"ici-req:{full}")
+                                r.recv_ns, None, adm_meta,
+                                name=f"ici-req:{full}")
                     except Exception as e:
                         log.error("ici batch request failed: %s", e,
                                   exc_info=True)
@@ -600,16 +620,15 @@ class ServerBinding:
             log.error("ici batch upcall failed: %s", e, exc_info=True)
 
     def _run_usercode(self, token, full, payload, attachment, log_id,
-                      peer_dev, recv_ns) -> None:
+                      peer_dev, recv_ns, adm_meta=None) -> None:
         try:
             self._process(token, full, payload, attachment, log_id,
-                          peer_dev, recv_ns, None)
+                          peer_dev, recv_ns, None, adm_meta)
         finally:
             self._server.on_usercode_done()
 
     def _process(self, token, full, payload, attachment, log_id, peer_dev,
-                 recv_ns, collector) -> None:
-        server_controller_pool = _controller_pool()
+                 recv_ns, collector, adm_meta=None) -> None:
         server = self._server
         stage_flag, record_stage = _stage_modules()
         stages = stage_flag.value == "on"
@@ -620,6 +639,7 @@ class ServerBinding:
             # lame-duck: the native front door stays open through the
             # grace window so in-flight calls finish, but new ones bounce
             # with retryable ELOGOFF (mirrors tpu_std.process_request)
+            self._release_attachment_custody(attachment)
             self._respond_one(token, errors.ELOGOFF,
                               "server is draining (lame duck)", collector)
             return
@@ -627,25 +647,83 @@ class ServerBinding:
         if hit is None:
             md = server.find_method(full)
             if md is None:
+                self._release_attachment_custody(attachment)
                 self._respond_one(token, errors.ENOMETHOD,
                                   f"no method {full}", collector)
                 return
             hit = self._mdcache[full] = (md, server.method_status(full))
         md, status = hit
+        adm = server.admission
+        if adm is not None:
+            # admission-control path (rpc/admission.py): the same
+            # shed-before-queue / WFQ / deadline decision as the wire
+            # and loopback planes, in front of the same gates
+            pri_wire, tenant, deadline_left = adm_meta or (0, "", 0)
+            from ..rpc import admission as admission_mod
+
+            def _admitted(queued_us: int,
+                          _stages=stages, _rs=record_stage) -> None:
+                if _stages and queued_us:
+                    _rs("queue", queued_us, None)
+                self._execute(token, full, payload, attachment, log_id,
+                              peer_dev, collector, md, status, adm_meta)
+
+            def _shed(code: int, text: str, retry_after: int) -> None:
+                self._release_attachment_custody(attachment)
+                self._respond_one(token, code, text, collector,
+                                  retry_after=retry_after)
+
+            adm.submit(
+                priority=(pri_wire - 1) if pri_wire else None,
+                tenant=tenant,
+                deadline_left_ms=deadline_left or None,
+                recv_us=(recv_ns // 1000) if recv_ns else 0,
+                try_enter=admission_mod.server_method_gate(server, status),
+                run=_admitted, shed=_shed)
+            return
         if not server.on_request_in():
+            self._release_attachment_custody(attachment)
             self._respond_one(token, errors.ELIMIT,
                               "server max_concurrency reached", collector)
             return
         if status is not None and not status.on_requested():
             server.on_request_out()
+            self._release_attachment_custody(attachment)
             self._respond_one(token, errors.ELIMIT,
                               f"{full} concurrency limit", collector)
             return
+        self._execute(token, full, payload, attachment, log_id, peer_dev,
+                      collector, md, status, adm_meta)
+
+    @staticmethod
+    def _release_attachment_custody(attachment) -> None:
+        """Drop an already-built request attachment on a reject path:
+        its device arrays left the registry at build time (Python owns
+        them through the IOBuf) — letting the IOBuf go is the release."""
+        # nothing to do beyond dropping the reference; documented here
+        # so every reject path states the custody outcome explicitly
+        return
+
+    def _execute(self, token, full, payload, attachment, log_id,
+                 peer_dev, collector, md, status, adm_meta=None) -> None:
+        """Gates held: parse → invoke → batched write-back."""
+        server_controller_pool = _controller_pool()
+        server = self._server
+        stage_flag, record_stage = _stage_modules()
+        stages = stage_flag.value == "on"
         cntl = server_controller_pool.acquire()
         if log_id:
             cntl.log_id = log_id
         cntl.server = server
         cntl.remote_side = self._peer_endpoint(peer_dev)
+        if adm_meta is not None:
+            pri_wire, tenant, deadline_left = adm_meta
+            if pri_wire:
+                cntl.priority = pri_wire - 1
+            if tenant:
+                cntl.tenant = tenant
+            if deadline_left:
+                cntl.deadline_left_ms = deadline_left
         if attachment is not None:
             cntl.request_attachment = attachment
         start_ns = _time.monotonic_ns()
@@ -705,7 +783,7 @@ class ServerBinding:
             else:
                 att_host, segs = b"", ()
             item = (token, 0, b"", response.SerializeToString(),
-                    att_host, segs, post)
+                    att_host, segs, post, 0)
             if stages:
                 record_stage("encode",
                              (_time.monotonic_ns() - t_done) // 1000,
@@ -740,10 +818,10 @@ class ServerBinding:
     # ---- batched write-back ------------------------------------------
 
     def _respond_one(self, token, err, text, collector=None,
-                     post=None) -> None:
+                     post=None, retry_after: int = 0) -> None:
         item = (token, err,
                 text.encode() if isinstance(text, str) else (text or b""),
-                b"", b"", (), post)
+                b"", b"", (), post, retry_after)
         if collector is None or not collector.add(item):
             self._respond_item(item)
 
@@ -757,11 +835,13 @@ class ServerBinding:
         arr = tls.get("resp1")
         if arr is None:
             arr = tls["resp1"] = (IciRespC * 1)()
-        token, err, err_text, payload, att_host, segs, post = item
+        token, err, err_text, payload, att_host, segs, post, \
+            retry_after = item
         e = arr[0]
         e.token = token
         e.err = err
         e.err_text = err_text or None
+        e.retry_after_ms = retry_after
         if payload:
             e.data = ctypes.cast(payload, _U8P)
             e.len = len(payload)
@@ -804,11 +884,12 @@ class ServerBinding:
         n = len(items)
         arr = (IciRespC * n)()
         keep = []                      # buffers alive across the call
-        for i, (token, err, err_text, payload, att_host, segs, _post) in \
-                enumerate(items):
+        for i, (token, err, err_text, payload, att_host, segs, _post,
+                retry_after) in enumerate(items):
             e = arr[i]
             e.token = token
             e.err = err
+            e.retry_after_ms = retry_after
             if err_text:
                 e.err_text = err_text
                 keep.append(err_text)
@@ -867,8 +948,9 @@ class ChannelBinding:
         self.window_bytes = window_bytes if window_bytes > 0 else (4 << 20)
         self.remote_side = mesh.endpoint(remote_dev)
         self._names: Dict[str, bytes] = {}      # method encode cache
+        self._tenants: Dict[str, bytes] = {}    # tenant encode cache
         self._tls = threading.local()           # reused IciCallOut
-        self._call2 = lib.brpc_tpu_ici_call2    # bound once: attr-chain
+        self._call3 = lib.brpc_tpu_ici_call3    # bound once: attr-chain
         self._free = lib.brpc_tpu_buf_free      # lookups are per-call
         h = lib.brpc_tpu_ici_connect(local_dev, remote_dev, window_bytes)
         if h == 0:
@@ -950,6 +1032,17 @@ class ChannelBinding:
         # the native side treats timeout_us <= 0 the same way
         tms = cntl.timeout_ms
         timeout_us = int(tms * 1000) if tms is not None and tms > 0 else 0
+        # admission meta rides the native frame: wire-encoded priority
+        # (0 = unset), tenant, and the remaining deadline budget (the
+        # full per-try budget at this hop's send time)
+        pri_wire = cntl.priority + 1 if cntl.priority is not None else 0
+        tenant = cntl.tenant
+        if tenant:
+            tenant_b = self._tenants.get(tenant)
+            if tenant_b is None:
+                tenant_b = self._tenants[tenant] = tenant.encode()
+        else:
+            tenant_b = None
         # the FFI call can park on a C condvar (Python-tier handler): a
         # tasklet-pool worker must note itself blocked so the scheduler
         # compensates — otherwise handler tasklets starve behind us and
@@ -958,9 +1051,11 @@ class ChannelBinding:
         if blocked:
             scheduler.note_worker_blocked()
         try:
-            rc = self._call2(
+            rc = self._call3(
                 self._handle, name_b, reqb, len(req), attb,
-                len(att_host), seg_arr, len(segs), timeout_us, out_ref)
+                len(att_host), seg_arr, len(segs), timeout_us, pri_wire,
+                tenant_b, int(tms) if tms is not None and tms > 0 else 0,
+                out_ref)
         finally:
             if blocked:
                 scheduler.note_worker_unblocked()
@@ -978,6 +1073,9 @@ class ChannelBinding:
                 text = ctypes.string_at(out.err_text).decode() \
                     if out.err_text else errors.berror(int(rc))
                 cntl.set_failed(int(rc), text)
+                if out.retry_after_ms:
+                    # admission shed hint (retryable ELIMIT backoff)
+                    cntl.retry_after_ms = int(out.retry_after_ms)
                 return None
             payload = ctypes.string_at(out.resp, out.resp_len) \
                 if out.resp_len else b""

@@ -170,8 +170,21 @@ def pack_request(payload: IOBuf, cid: int, cntl: Controller,
     meta.compress_type = cntl.compress_type
     if cntl.timeout_ms:
         meta.request.timeout_ms = cntl.timeout_ms
+        # deadline budget REMAINING at send time (shrinks at each hop):
+        # total budget minus what this caller already spent — a retry
+        # issued late in the budget tells the server how little is left,
+        # and the server sheds it before any work once it hits zero
+        elapsed_ms = (time.monotonic_ns() // 1000
+                      - cntl._start_us) / 1000.0 if cntl._start_us else 0.0
+        meta.request.deadline_left_ms = max(
+            int(cntl.timeout_ms - elapsed_ms), 1)
     if cntl.auth_token:
         meta.request.auth_token = cntl.auth_token
+    if cntl.priority is not None:
+        # offset-encoded: 0 on the wire = unset (server default band)
+        meta.request.priority = cntl.priority + 1
+    if cntl.tenant:
+        meta.request.tenant = cntl.tenant
     if cntl.span is not None:
         meta.request.trace_id = cntl.span.trace_id
         meta.request.span_id = cntl.span.span_id
@@ -249,6 +262,13 @@ def process_request(msg: StdMessage, socket, server) -> None:
         cntl.compress_type = meta.compress_type
     if req_meta.timeout_ms:
         cntl.method_deadline = time.monotonic() + req_meta.timeout_ms / 1000.0
+    # admission-control propagation (offset-decoded; handlers may read)
+    if req_meta.priority:
+        cntl.priority = req_meta.priority - 1
+    if req_meta.tenant:
+        cntl.tenant = req_meta.tenant
+    if req_meta.deadline_left_ms:
+        cntl.deadline_left_ms = req_meta.deadline_left_ms
 
     start_server_span(cntl, full_name, req_meta.trace_id,
                       req_meta.span_id)
@@ -271,6 +291,9 @@ def process_request(msg: StdMessage, socket, server) -> None:
         rmeta.correlation_id = cid
         rmeta.response.error_code = cntl.error_code_
         rmeta.response.error_text = cntl.error_text_
+        if cntl.retry_after_ms:
+            # admission shed hint: how long the client should back off
+            rmeta.response.retry_after_ms = cntl.retry_after_ms
         if cntl.accepted_stream_id and not cntl.failed():
             # complete the stream handshake: echo ids both ways
             from ..rpc.stream import find_stream
@@ -321,77 +344,129 @@ def process_request(msg: StdMessage, socket, server) -> None:
         send_response()
         cntl._maybe_recycle()
         return
-    if not server.on_request_in():
-        cntl.set_failed(errors.ELIMIT, "server max_concurrency reached")
-        send_response()
-        cntl._maybe_recycle()
+
+    def _parse_and_invoke() -> None:
+        # parse request payload (gates held; send_response accounts)
+        t_parse0 = time.monotonic_ns() if stages else 0
+        try:
+            body = msg.body
+            if meta.attachment_size:
+                keep = len(body) - meta.attachment_size
+                payload_part = body.cut(keep)
+                body.cutn(cntl.request_attachment, meta.attachment_size)
+                body = payload_part
+            data = body.to_bytes()
+            if meta.compress_type:
+                data = compress_mod.decompress(meta.compress_type, data)
+            request = md.request_cls()
+            request.ParseFromString(data)
+        except Exception as e:
+            cntl.set_failed(errors.EREQUEST, f"fail to parse request: {e}")
+            send_response()
+            cntl._maybe_recycle()
+            return
+        if stages:
+            _record_stage("parse",
+                          (time.monotonic_ns() - t_parse0) // 1000,
+                          cntl.span)
+
+        response = md.response_cls()
+        done_called = [False]
+        handler_t0[0] = time.monotonic_ns() if stages else 0
+
+        def done() -> None:
+            if done_called[0]:
+                return
+            done_called[0] = True
+            send_response(response)
+
+        cntl.set_server_done(done)
+        try:
+            md.invoke(cntl, request, response, done)
+        except Exception as e:   # uncaught user exception → EINTERNAL
+            log.error("method %s raised: %s", full_name, e, exc_info=True)
+            if not done_called[0]:
+                cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
+                done()
+                cntl._release_session_data()
+                cntl._maybe_recycle()
+
+    adm = server.admission
+    if adm is None:
+        # historical reject-at-gate path (no admission layer)
+        if not server.on_request_in():
+            cntl.set_failed(errors.ELIMIT, "server max_concurrency reached")
+            status = None   # rejected before on_requested: accounting it
+            #                 would skew concurrency and poison the
+            #                 limiter floor (shed != method failure)
+            send_response()
+            cntl._maybe_recycle()
+            return
+        server_counted[0] = True
+        if md is None:
+            cntl.set_failed(errors.ENOMETHOD if req_meta.service_name in
+                            server.services() else errors.ENOSERVICE,
+                            f"no method {full_name}")
+            send_response()
+            cntl._maybe_recycle()
+            return
+        if status is not None and not status.on_requested():
+            cntl.set_failed(errors.ELIMIT,
+                            f"method {full_name} max_concurrency reached")
+            status = None           # don't on_responded a rejected request
+            send_response()
+            cntl._maybe_recycle()
+            return
+        # auth (reference: protocol verify hook)
+        if server.options.auth is not None:
+            if not server.options.auth.verify(cntl.auth_token, socket):
+                cntl.set_failed(errors.ERPCAUTH, "authentication failed")
+                send_response()
+                cntl._maybe_recycle()
+                return
+        _parse_and_invoke()
         return
-    server_counted[0] = True
+
+    # ---- admission-control path (rpc/admission.py): the gate decision
+    # moves into the shared controller — shed-before-queue, per-tenant
+    # WFQ, deadline-expired shed — identical on all three call planes
     if md is None:
         cntl.set_failed(errors.ENOMETHOD if req_meta.service_name in
                         server.services() else errors.ENOSERVICE,
                         f"no method {full_name}")
+        status = None               # never admitted: nothing to account
         send_response()
         cntl._maybe_recycle()
         return
-    if status is not None and not status.on_requested():
-        cntl.set_failed(errors.ELIMIT,
-                        f"method {full_name} max_concurrency reached")
-        status = None               # don't on_responded a rejected request
+    from ..rpc import admission as admission_mod
+
+    def _admitted(queued_us: int) -> None:
+        server_counted[0] = True
+        if stages and queued_us:
+            # admission-queue wait feeds the queue-stage decomposition
+            _record_stage("queue", queued_us, cntl.span)
+        if server.options.auth is not None:
+            if not server.options.auth.verify(cntl.auth_token, socket):
+                cntl.set_failed(errors.ERPCAUTH, "authentication failed")
+                send_response()
+                cntl._maybe_recycle()
+                return
+        _parse_and_invoke()
+
+    def _shed(code: int, text: str, retry_after: int) -> None:
+        nonlocal status
+        status = None               # shed: no on_requested happened
+        cntl.set_failed(code, text)
+        if retry_after:
+            cntl.retry_after_ms = retry_after
         send_response()
         cntl._maybe_recycle()
-        return
-    # auth (reference: protocol verify hook)
-    if server.options.auth is not None:
-        if not server.options.auth.verify(cntl.auth_token, socket):
-            cntl.set_failed(errors.ERPCAUTH, "authentication failed")
-            send_response()
-            cntl._maybe_recycle()
-            return
 
-    # parse request payload
-    t_parse0 = time.monotonic_ns() if stages else 0
-    try:
-        body = msg.body
-        if meta.attachment_size:
-            keep = len(body) - meta.attachment_size
-            payload_part = body.cut(keep)
-            body.cutn(cntl.request_attachment, meta.attachment_size)
-            body = payload_part
-        data = body.to_bytes()
-        if meta.compress_type:
-            data = compress_mod.decompress(meta.compress_type, data)
-        request = md.request_cls()
-        request.ParseFromString(data)
-    except Exception as e:
-        cntl.set_failed(errors.EREQUEST, f"fail to parse request: {e}")
-        send_response()
-        cntl._maybe_recycle()
-        return
-    if stages:
-        _record_stage("parse", (time.monotonic_ns() - t_parse0) // 1000,
-                      cntl.span)
-
-    response = md.response_cls()
-    done_called = [False]
-    handler_t0[0] = time.monotonic_ns() if stages else 0
-
-    def done() -> None:
-        if done_called[0]:
-            return
-        done_called[0] = True
-        send_response(response)
-
-    cntl.set_server_done(done)
-    try:
-        md.invoke(cntl, request, response, done)
-    except Exception as e:   # uncaught user exception → EINTERNAL
-        log.error("method %s raised: %s", full_name, e, exc_info=True)
-        if not done_called[0]:
-            cntl.set_failed(errors.EINTERNAL, f"{type(e).__name__}: {e}")
-            done()
-            cntl._release_session_data()
-            cntl._maybe_recycle()
+    adm.submit(priority=cntl.priority, tenant=cntl.tenant,
+               deadline_left_ms=cntl.deadline_left_ms or None,
+               recv_us=(msg.recv_ns // 1000) if msg.recv_ns else 0,
+               try_enter=admission_mod.server_method_gate(server, status),
+               run=_admitted, shed=_shed)
 
 
 PROTOCOL = Protocol(

@@ -99,7 +99,7 @@ def _version(server, q):
 
 def _status(server, q):
     bvar.expose_default_variables()
-    return "application/json", json.dumps({
+    out = {
         "server": str(server.listen_endpoint),
         "name": server.options.server_info_name or "",
         "state": _lifecycle(server),
@@ -109,7 +109,13 @@ def _status(server, q):
         "services": sorted(server.services()),
         "methods": [ms.describe() for ms in server.method_statuses()],
         "connections": len(server.connections()),
-    }, indent=1)
+    }
+    adm = getattr(server, "admission", None)
+    if adm is not None:
+        # the overload-survival block: queue depth, shed-by-reason per
+        # (tenant, band), observed service rate, current retry hint
+        out["admission"] = adm.describe()
+    return "application/json", json.dumps(out, indent=1)
 
 
 def _vars(server, q):

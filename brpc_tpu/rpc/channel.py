@@ -56,6 +56,12 @@ class ChannelOptions:
     # the server passes the server's own device id for the pure ref-pass
     # round trip.
     ici_local_device: object = None     # Optional[int]
+    # Admission-control defaults stamped on every call that didn't set
+    # its own (Controller.priority/tenant): priority band 0=critical ..
+    # 3=sheddable (None = let the server apply its default band) and the
+    # fair-queueing tenant this channel's traffic belongs to.
+    priority: Optional[int] = None
+    tenant: str = ""
 
 
 # loopback-screen module handles, resolved once at first call (lazy only
@@ -152,6 +158,11 @@ class Channel:
                     request: Any, response_cls: Any = None,
                     done: Optional[Callable[[Controller], None]] = None):
         """Sync when done is None (returns the response); async otherwise."""
+        # channel-level admission defaults (per-call Controller wins)
+        if cntl.priority is None and self.options.priority is not None:
+            cntl.priority = self.options.priority
+        if not cntl.tenant and self.options.tenant:
+            cntl.tenant = self.options.tenant
         # ici:// fast path: when the target device has a native listener in
         # this process, the whole unary hot path (frame/window/dispatch/
         # correlation) runs in native/rpc.cpp — no Python between
@@ -176,6 +187,9 @@ class Channel:
             if done is None:
                 result = self._native_ici_call(nch, method_full_name, cntl,
                                                request, response_cls)
+                result = self._native_shed_retry(nch, method_full_name,
+                                                 cntl, request,
+                                                 response_cls, result)
                 if not self._native_ici_fallback(cntl):
                     if cntl.span is not None:
                         end_client_span(cntl)
@@ -290,6 +304,70 @@ class Channel:
         if cntl.span is None:
             maybe_start_client_span(cntl, method_full_name)
         return nch.call(method_full_name, cntl, request, response_cls)
+
+    def _native_shed_retry(self, nch, method_full_name: str,
+                           cntl: Controller, request, response_cls,
+                           result):
+        """Honor an admission shed's retry_after_ms on the native fast
+        plane (sync calls): the server said how long its backlog needs —
+        sleep the hint (plus jitter ABOVE it, never below: synchronized
+        re-arrival is the storm the shed exists to prevent) and reissue,
+        bounded by the retry budget and the overall deadline.  The wire
+        plane gets the same behavior through the Controller retry
+        machinery (handle_response)."""
+        import time as _time
+
+        from .admission import shed_backoff_s
+        max_retry = cntl.max_retry if cntl.max_retry is not None \
+            else self.options.max_retry
+        attempt = 0
+        orig_tms = cntl.timeout_ms
+        # the budget started when the FIRST attempt was issued: count its
+        # already-recorded duration against the deadline, so the whole
+        # loop — attempts AND backoffs — is bounded by ONE timeout_ms
+        # (the wire plane's single-deadline-timer semantics)
+        t0 = _time.monotonic() - (cntl.latency_us / 1e6)
+        try:
+            while (cntl.error_code_ == errors.ELIMIT
+                   and cntl.retry_after_ms > 0 and attempt < max_retry):
+                attempt += 1
+                delay_s = shed_backoff_s(cntl.retry_after_ms)
+                if orig_tms and orig_tms > 0:
+                    remaining = orig_tms / 1000.0 \
+                        - (_time.monotonic() - t0)
+                    if delay_s >= remaining:
+                        # the backoff cannot fit the budget: the overall
+                        # deadline wins, like the wire plane's timer
+                        cntl.set_failed(
+                            errors.ERPCTIMEDOUT,
+                            f"reached timeout={orig_tms}ms backing "
+                            "off from admission shed")
+                        return None
+                from ..bthread import scheduler as _sched
+                _sched.note_worker_blocked()
+                try:
+                    _time.sleep(delay_s)
+                finally:
+                    _sched.note_worker_unblocked()
+                cntl.error_code_ = 0
+                cntl.error_text_ = ""
+                cntl.retry_after_ms = 0
+                cntl.retried_count += 1
+                if orig_tms and orig_tms > 0:
+                    # the reissue gets only what's LEFT of the budget
+                    left_ms = int((orig_tms / 1000.0
+                                   - (_time.monotonic() - t0)) * 1000)
+                    if left_ms <= 0:
+                        cntl.set_failed(errors.ERPCTIMEDOUT,
+                                        f"reached timeout={orig_tms}ms")
+                        return None
+                    cntl.timeout_ms = left_ms
+                result = self._native_ici_call(nch, method_full_name,
+                                               cntl, request,
+                                               response_cls)
+        finally:
+            cntl.timeout_ms = orig_tms
+        return result
 
     def _native_ici_fallback(self, cntl: Controller) -> bool:
         """After a fast-path failure, decide whether to re-route the call
@@ -518,13 +596,23 @@ class Channel:
         if short is not None:
             short.set_failed(errors.ECLOSE, "short connection done")
         sel = getattr(cntl, "_selected_endpoint", None)
+        # an admission shed (retryable ELIMIT + retry_after_ms) is an
+        # OVERLOADED-BUT-HEALTHY endpoint saying "not now" — it must not
+        # count as an endpoint failure for the circuit breaker, or a 10x
+        # overload isolates the very server still serving critical-band
+        # traffic (the client-side twin of the limiter-floor poisoning
+        # fixed in MethodStatus).  LB feedback still sees the error:
+        # steering weight away from an overloaded member is correct.
+        breaker_code = 0 if (cntl.error_code_ == errors.ELIMIT
+                             and cntl.retry_after_ms > 0) \
+            else cntl.error_code_
         if self._lb is not None:
             if sel is not None:
                 self._lb.feedback(sel, cntl.error_code_, cntl.latency_us)
                 # circuit breaker + health-check revival (SURVEY.md §5.3)
                 from .circuit_breaker import BreakerRegistry
                 breaker = BreakerRegistry.instance().breaker(sel)
-                if not breaker.on_call_end(cntl.error_code_):
+                if not breaker.on_call_end(breaker_code):
                     from .health_check import start_health_check
                     lb = self._lb
                     lb.exclude(sel, breaker.isolated_until())
@@ -542,7 +630,7 @@ class Channel:
             # checker, whose successful probe resets the breaker
             from .circuit_breaker import BreakerRegistry
             if not BreakerRegistry.instance().breaker(sel).on_call_end(
-                    cntl.error_code_):
+                    breaker_code):
                 from .health_check import start_health_check
                 start_health_check(sel)
 

@@ -62,6 +62,17 @@ class Controller:
     error_code_: int = 0
     error_text_: str = ""
     log_id: int = 0
+    # admission-control propagation (rpc/admission.py): priority band
+    # (0=critical .. 3=sheddable; None = the server's default band) and
+    # fair-queueing tenant, carried in RequestMeta on every plane.  On
+    # the server side these are the DECODED request values (handlers may
+    # read them); retry_after_ms is the shed backoff hint — written by
+    # the server before a shed response, filled from ResponseMeta on the
+    # client so callers (and the retry machinery) can honor it.
+    priority: Optional[int] = None
+    tenant: str = ""
+    retry_after_ms: int = 0
+    deadline_left_ms: int = 0       # server side: budget at arrival
     request_attachment = _LazyField("request_attachment", IOBuf)
     response_attachment = _LazyField("response_attachment", IOBuf)
     remote_side: Optional[EndPoint] = None
@@ -390,7 +401,17 @@ class Controller:
                 return
             err = rmeta.error_code
             self.set_failed(err, rmeta.error_text)
-            if self._retryable(err) and self.current_try < self.max_retry:
+            hint_ms = getattr(rmeta, "retry_after_ms", 0)
+            if hint_ms:
+                self.retry_after_ms = hint_ms
+            # an admission shed (ELIMIT + retry_after_ms) is retryable —
+            # but only after the server's hint: the server said exactly
+            # how long its backlog needs, and an immediate re-dispatch
+            # (or a hedge) would be the retry storm the shed exists to
+            # prevent
+            shed_retry = err == errors.ELIMIT and hint_ms > 0
+            if (self._retryable(err) or shed_retry) \
+                    and self.current_try < self.max_retry:
                 # the retry must land on a DIFFERENT replica: a server
                 # that pushed a retryable error (lame-duck ELOGOFF most
                 # of all) will push it again — the reference's per-call
@@ -404,7 +425,22 @@ class Controller:
                 self.retried_count += 1
                 bthread_id.reset_version(self._cid, self.current_try)
                 self._schedule_try_timer()
-                self._issue_rpc()
+                if shed_retry:
+                    # honor the hint via the shared shed-backoff policy
+                    # (admission.shed_backoff_s: hint + above-only
+                    # jitter).  A delay past the overall deadline just
+                    # loses to ERPCTIMEDOUT, which is the correct bound.
+                    from .admission import shed_backoff_s
+                    delay_s = shed_backoff_s(
+                        hint_ms, seed=(self._cid << 8)
+                        ^ self.retried_count)
+                    from ..bthread import scheduler as _sched
+                    TimerThread.instance().schedule_after(
+                        lambda: _sched.start_background(
+                            self._issue_rpc, name="shed_retry"),
+                        delay_s)
+                else:
+                    self._issue_rpc()
                 bthread_id.unlock(cid)
                 return
             self._end_rpc(cid)

@@ -285,7 +285,8 @@ def _serve(server, full_name: str, client_cntl: Controller,
     if tms and tms > 0:
         cntl.method_deadline = time.monotonic() + tms / 1000.0
 
-    def bail(code: int, text: str, status=None, counted=False) -> None:
+    def bail(code: int, text: str, status=None, counted=False,
+             retry_after: int = 0) -> None:
         if status is not None:
             status.on_responded(code, 0)
         if counted:
@@ -294,6 +295,8 @@ def _serve(server, full_name: str, client_cntl: Controller,
         if not state.try_finish():
             return
         client_cntl.set_failed(code, text)
+        if retry_after:
+            client_cntl.retry_after_ms = retry_after
         client_cntl.latency_us = (time.monotonic_ns() - t0) // 1000
         _finish_client(client_cntl, done)
 
@@ -301,6 +304,35 @@ def _serve(server, full_name: str, client_cntl: Controller,
         bail(errors.ELOGOFF, "server is draining (lame duck)")
         return
     md = server.find_method(full_name)
+    adm = server.admission
+    if adm is not None:
+        # admission-control path: identical decision to the wire plane
+        # (shed-before-queue, WFQ, deadline shed) — loopback calls are
+        # not a back door around overload protection
+        if md is None:
+            service = full_name.rpartition(".")[0]
+            bail(errors.ENOMETHOD if service in server.services()
+                 else errors.ENOSERVICE, f"no method {full_name}")
+            return
+        status = server.method_status(full_name)
+        # propagation is in-process: the caller's controller IS the
+        # metadata carrier (no wire decode)
+        cntl.priority = client_cntl.priority
+        cntl.tenant = client_cntl.tenant
+        if tms and tms > 0:
+            cntl.deadline_left_ms = int(tms)
+        from . import admission as admission_mod
+        adm.submit(
+            priority=client_cntl.priority, tenant=client_cntl.tenant,
+            deadline_left_ms=int(tms) if tms and tms > 0 else None,
+            recv_us=t0 // 1000,
+            try_enter=admission_mod.server_method_gate(server, status),
+            run=lambda queued_us: _execute(server, full_name, cntl,
+                                           client_cntl, req_bytes,
+                                           response_cls, state, md,
+                                           status),
+            shed=lambda code, text, ra: bail(code, text, retry_after=ra))
+        return
     if not server.on_request_in():
         bail(errors.ELIMIT, "server max_concurrency reached")
         return
@@ -315,6 +347,30 @@ def _serve(server, full_name: str, client_cntl: Controller,
         bail(errors.ELIMIT, f"method {full_name} max_concurrency reached",
              counted=True)
         return
+    _execute(server, full_name, cntl, client_cntl, req_bytes,
+             response_cls, state, md, status)
+
+
+def _execute(server, full_name: str, cntl: Controller,
+             client_cntl: Controller, req_bytes: bytes, response_cls,
+             state: _CallState, md, status) -> None:
+    """Gates held: parse → invoke → completion copy-back (the post-
+    admission half of the loopback ProcessRpcRequest)."""
+    t0 = state.t0
+    done = state.done
+
+    def bail(code: int, text: str, status=None, counted=False) -> None:
+        if status is not None:
+            status.on_responded(code, 0)
+        if counted:
+            server.on_request_out()
+        cntl._maybe_recycle()
+        if not state.try_finish():
+            return
+        client_cntl.set_failed(code, text)
+        client_cntl.latency_us = (time.monotonic_ns() - t0) // 1000
+        _finish_client(client_cntl, done)
+
     start_us = time.monotonic_ns() // 1000
     try:
         request = md.request_cls()

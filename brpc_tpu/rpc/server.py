@@ -83,6 +83,13 @@ class ServerOptions:
     # -graceful_quit_on_sigterm): a deploy's TERM becomes invisible to
     # callers.  A second TERM during the drain kills immediately.
     graceful_quit_on_sigterm: bool = False
+    # Overload admission control (rpc/admission.py): priority/deadline-
+    # aware shed-before-queue with per-tenant weighted fair queueing in
+    # front of the usercode pool, on all three call planes.  True uses
+    # AdmissionOptions defaults; pass an AdmissionOptions to tune bands,
+    # queue bound, and tenant weights.  None/False keeps the historical
+    # reject-at-gate behavior.
+    admission: Any = None
 
 
 class Server:
@@ -112,6 +119,7 @@ class Server:
         self._session_data_lock = threading.Lock()
         self._thread_local = threading.local()
         self.usercode_pool = None        # usercode_in_pthread backup pool
+        self.admission = None            # AdmissionController when enabled
 
     # ---- registry -----------------------------------------------------
     def add_service(self, svc) -> int:
@@ -202,6 +210,24 @@ class Server:
     def on_request_out(self) -> None:
         with self._conc_lock:
             self._server_concurrency -= 1
+        adm = self.admission
+        if adm is not None:
+            # a slot just freed: the admission queue's release pump
+            # (records a service-rate sample and dispatches the next
+            # queued request off this thread)
+            adm.on_release()
+
+    def on_request_rollback(self) -> None:
+        """Undo on_request_in for a request that was never admitted (the
+        method gate refused after the server gate passed).  Unlike
+        on_request_out this does NOT pump the admission queue or record
+        a service-rate sample: a rollback is not a completion — pumping
+        here would recurse (pump → gate → rollback → pump) and the
+        microsecond-spaced phantom 'releases' would inflate the observed
+        service rate, collapsing retry_after_ms into the synchronized
+        retry storm it exists to prevent."""
+        with self._conc_lock:
+            self._server_concurrency -= 1
 
     # usercode_in_pthread backlog accounting (InputMessenger): a request
     # QUEUED on the backup pool has not yet passed on_request_in, so the
@@ -277,6 +303,14 @@ class Server:
             self.usercode_pool = ThreadPoolExecutor(
                 max_workers=max(self.options.usercode_backup_threads, 1),
                 thread_name_prefix="usercode")
+        if self.options.admission:
+            from .admission import AdmissionController, AdmissionOptions
+            if self.admission is None:
+                aopts = self.options.admission if isinstance(
+                    self.options.admission, AdmissionOptions) else None
+                self.admission = AdmissionController(self, aopts)
+            else:
+                self.admission.reset()   # restart lifts the stop refusal
         if self.options.enable_builtin_services:
             from .builtin import register_builtin_services
             register_builtin_services(self)
@@ -539,9 +573,18 @@ class Server:
                     _pod.on_server_draining(ep)
                 except Exception:
                     pass
+            if self.admission is not None:
+                # queued-not-started admission entries bounce with
+                # retryable ELOGOFF at drain start (the PR-8 batch-queue
+                # discipline): callers fail over instantly instead of
+                # waiting out a grace window they may not survive
+                self.admission.fail_all(errors.ELOGOFF,
+                                        "server is draining (lame duck)")
             self._teardown_listeners(keep_native=True)
             self._send_goodbyes()
             drained = self._drain_until(_time.monotonic() + grace_s)
+        if self.admission is not None:
+            self.admission.fail_all(errors.ELOGOFF, "server stopping")
         self._teardown_listeners()
         with self._conn_lock:
             conns = list(self._connections)

@@ -14,6 +14,14 @@ endpoint, workers spread round-robin, and the summary — including the
 graceful-SIGINT one — reports per-endpoint sent/error/qps counts, so a
 pod/overload bench can drive N servers from one process and see which
 member misbehaved.
+
+Mixed-class load (the admission-control adversary): ``--priority`` takes
+a single band (``--priority 2``) or a ``band:weight,...`` mix
+(``--priority 0:1,3:3`` = one critical per three sheddable); ``--tenant``
+takes a name or a ``tenant:weight,...`` mix (``--tenant a:2,b:1``).
+Each request draws its (priority, tenant) from the weighted mixes, and
+the summary adds per-class sent/shed(ELIMIT)/error/latency so an
+overloaded server's shed fairness is visible from the load generator.
 """
 from __future__ import annotations
 
@@ -34,6 +42,33 @@ def _load_classes(spec: str):
     return getattr(mod, req_name), getattr(mod, resp_name)
 
 
+def parse_weighted_mix(spec: str, *, int_keys: bool = False) -> list:
+    """``"a:2,b:1"`` → [("a", 2), ("b", 1)]; a bare ``"a"`` is weight 1.
+    With ``int_keys`` the keys are parsed as ints (priority bands).
+    Returns an expanded selection wheel: each class repeated weight
+    times, so ``wheel[i % len(wheel)]`` draws the mix deterministically."""
+    wheel = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            weight = int(w) if w else 1
+        except ValueError:
+            raise SystemExit(f"rpc_press: bad weight in {part!r}")
+        if weight < 1:
+            raise SystemExit(f"rpc_press: weight must be >= 1 in {part!r}")
+        key = name.strip()
+        if int_keys:
+            try:
+                key = int(key)
+            except ValueError:
+                raise SystemExit(f"rpc_press: bad priority in {part!r}")
+        wheel.extend([key] * weight)
+    return wheel
+
+
 def resolve_targets(server: str) -> List[str]:
     """One endpoint url per target channel — the shared
     policy.naming.resolve_servers (naming url / comma list / single
@@ -48,10 +83,12 @@ def resolve_targets(server: str) -> List[str]:
 def run_press(server: str, method: str, request_json: str,
               qps: int = 0, duration: float = 5.0, concurrency: int = 8,
               proto: Optional[str] = None, protocol: str = "tpu_std",
-              out=sys.stderr) -> dict:
+              priority: Optional[str] = None, tenant: Optional[str] = None,
+              max_retry: Optional[int] = None, out=sys.stderr) -> dict:
     import brpc_tpu.policy  # noqa: F401 — registers protocols
     from brpc_tpu import rpc, bvar
     from brpc_tpu.codec import json2pb
+    from brpc_tpu.rpc import errors as rpc_errors
 
     if proto:
         req_cls, resp_cls = _load_classes(proto)
@@ -60,17 +97,31 @@ def run_press(server: str, method: str, request_json: str,
         req_cls = resp_cls = None
         request = (request_json or "").encode()
 
+    pri_wheel = parse_weighted_mix(priority, int_keys=True) \
+        if priority else []
+    tenant_wheel = parse_weighted_mix(tenant) if tenant else []
+    # a stride coprime with the tenant wheel decorrelates it from the
+    # priority wheel (equal lengths would pin each band to one tenant)
+    ten_stride = 1
+    if tenant_wheel:
+        ten_stride = next(s for s in (7, 11, 13, 17, 19, 23, 1)
+                          if len(tenant_wheel) % s != 0 or s == 1)
     targets = resolve_targets(server)
     channels = []
     for t in targets:
+        copts = rpc.ChannelOptions(protocol=protocol, timeout_ms=10000)
+        if max_retry is not None:
+            copts.max_retry = max_retry
         ch = rpc.Channel()
-        ch.init(t, options=rpc.ChannelOptions(protocol=protocol,
-                                              timeout_ms=10000))
+        ch.init(t, options=copts)
         channels.append(ch)
     recorder = bvar.LatencyRecorder()
     errors_count = [0]
     sent = [0]
     per_ep = {t: {"sent": 0, "errors": 0} for t in targets}
+    # per (priority, tenant) class: sent / shed (ELIMIT) / errors /
+    # latency recorder — the overload bench's fairness view
+    per_class: dict = {}
     lock = threading.Lock()
     deadline = time.monotonic() + duration
     interval = concurrency / qps if qps > 0 else 0.0
@@ -101,14 +152,39 @@ def run_press(server: str, method: str, request_json: str,
             # starting at its own offset so N workers cover N endpoints
             # even with concurrency == len(targets)
             idx = (wid + i) % len(targets)
-            i += 1
             cntl = rpc.Controller()
+            pri = pri_wheel[(wid + i) % len(pri_wheel)] if pri_wheel \
+                else None
+            ten = tenant_wheel[(wid + ten_stride * i) % len(tenant_wheel)] \
+                if tenant_wheel else ""
+            if pri is not None:
+                cntl.priority = pri
+            if ten:
+                cntl.tenant = ten
+            i += 1
             t0 = time.perf_counter_ns()
             channels[idx].call_method(method, cntl, request, resp_cls)
             lat_us = (time.perf_counter_ns() - t0) // 1000
+            shed = (cntl.error_code_ == rpc_errors.ELIMIT
+                    and cntl.retry_after_ms > 0)
             with lock:
                 sent[0] += 1
                 per_ep[targets[idx]]["sent"] += 1
+                if pri_wheel or tenant_wheel:
+                    ckey = f"p{pri if pri is not None else '-'}" + \
+                        (f"/{ten}" if ten else "")
+                    cls = per_class.get(ckey)
+                    if cls is None:
+                        cls = per_class[ckey] = {
+                            "sent": 0, "shed": 0, "errors": 0,
+                            "rec": bvar.LatencyRecorder()}
+                    cls["sent"] += 1
+                    if shed:
+                        cls["shed"] += 1
+                    elif cntl.failed():
+                        cls["errors"] += 1
+                    else:
+                        cls["rec"] << lat_us
                 if cntl.failed():
                     errors_count[0] += 1
                     per_ep[targets[idx]]["errors"] += 1
@@ -141,6 +217,13 @@ def run_press(server: str, method: str, request_json: str,
         result["per_endpoint"] = {
             t: {**c, "qps": round(c["sent"] / elapsed, 1)}
             for t, c in per_ep.items()}
+    if per_class:
+        result["per_class"] = {
+            k: {"sent": c["sent"], "shed": c["shed"],
+                "errors": c["errors"],
+                "avg_latency_us": round(c["rec"].latency(), 1),
+                "p99_latency_us": c["rec"].latency_percentile(0.99)}
+            for k, c in sorted(per_class.items())}
     print(json.dumps(result), file=out)
     return result
 
@@ -158,10 +241,19 @@ def main(argv=None) -> int:
     ap.add_argument("--proto", default=None,
                     help="module:RequestCls,ResponseCls")
     ap.add_argument("--protocol", default="tpu_std")
+    ap.add_argument("--priority", default=None,
+                    help="priority band (0=critical..3=sheddable) or a "
+                         "band:weight mix, e.g. '0:1,3:3'")
+    ap.add_argument("--tenant", default=None,
+                    help="tenant name or tenant:weight mix, e.g. 'a:2,b:1'")
+    ap.add_argument("--max-retry", type=int, default=None,
+                    help="per-call retry budget (shed retries honor the "
+                         "server's retry_after_ms hint)")
     args = ap.parse_args(argv)
     run_press(args.server, args.method, args.request, args.qps,
               args.duration, args.concurrency, args.proto, args.protocol,
-              out=sys.stdout)
+              priority=args.priority, tenant=args.tenant,
+              max_retry=args.max_retry, out=sys.stdout)
     return 0
 
 

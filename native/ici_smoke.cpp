@@ -43,6 +43,10 @@ struct IciReqC {
   int64_t recv_ns;
   int32_t peer_dev;
   int32_t _pad;
+  const char* tenant;          // admission meta (rpc.cpp IciReqC)
+  uint64_t deadline_left_ms;
+  int32_t priority;
+  int32_t _pad2;
 };
 struct IciRespC {
   uint64_t token;
@@ -54,6 +58,7 @@ struct IciRespC {
   uint64_t att_host_len;
   const IciSegC* segs;
   uint64_t nsegs;
+  uint64_t retry_after_ms;     // admission shed hint
 };
 struct IciCallOut {
   uint8_t* resp;
@@ -63,6 +68,7 @@ struct IciCallOut {
   IciSegC* segs;
   uint64_t nsegs;
   char* err_text;
+  uint64_t retry_after_ms;     // admission shed hint
 };
 
 extern "C" {

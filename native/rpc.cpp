@@ -112,12 +112,19 @@ struct MetaRequest {
   std::string service_name, method_name, auth_token;
   uint64_t log_id = 0, trace_id = 0, span_id = 0, parent_span_id = 0;
   uint64_t timeout_ms = 0;
+  // admission-control propagation (rpc_meta.proto fields 9-11):
+  // priority is offset-encoded on the wire (0 = unset, 1..N = band
+  // 0..N-1); deadline_left_ms is the sender's REMAINING budget.
+  uint64_t priority = 0;
+  std::string tenant;
+  uint64_t deadline_left_ms = 0;
   bool present = false;
 };
 
 struct MetaResponse {
   uint64_t error_code = 0;
   std::string error_text;
+  uint64_t retry_after_ms = 0;   // admission shed backoff hint (field 3)
   bool present = false;
 };
 
@@ -141,6 +148,9 @@ static std::string encode_request_meta(const MetaRequest& r) {
   put_u64_field(out, 6, r.parent_span_id);
   put_u64_field(out, 7, r.timeout_ms);
   put_len_field(out, 8, r.auth_token);
+  put_u64_field(out, 9, r.priority);
+  put_len_field(out, 10, r.tenant);
+  put_u64_field(out, 11, r.deadline_left_ms);
   return out;
 }
 
@@ -148,6 +158,7 @@ static std::string encode_response_meta(const MetaResponse& r) {
   std::string out;
   put_u64_field(out, 1, r.error_code);
   put_len_field(out, 2, r.error_text);
+  put_u64_field(out, 3, r.retry_after_ms);
   return out;
 }
 
@@ -206,6 +217,11 @@ static bool decode_request_meta(const uint8_t* p, const uint8_t* end,
       case 6: if (!get_varint(p, end, &r->parent_span_id)) return false; break;
       case 7: if (!get_varint(p, end, &r->timeout_ms)) return false; break;
       case 8: if (!decode_string(p, end, &r->auth_token)) return false; break;
+      case 9: if (!get_varint(p, end, &r->priority)) return false; break;
+      case 10: if (!decode_string(p, end, &r->tenant)) return false; break;
+      case 11:
+        if (!get_varint(p, end, &r->deadline_left_ms)) return false;
+        break;
       default: if (!skip_field(p, end, wire)) return false; break;
     }
     (void)v;
@@ -223,6 +239,9 @@ static bool decode_response_meta(const uint8_t* p, const uint8_t* end,
     switch (field) {
       case 1: if (!get_varint(p, end, &r->error_code)) return false; break;
       case 2: if (!decode_string(p, end, &r->error_text)) return false; break;
+      case 3:
+        if (!get_varint(p, end, &r->retry_after_ms)) return false;
+        break;
       default: if (!skip_field(p, end, wire)) return false; break;
     }
   }
@@ -1361,6 +1380,13 @@ struct IciReqC {
   int64_t recv_ns;         // steady-clock enqueue stamp (queue stage)
   int32_t peer_dev;
   int32_t _pad;
+  // admission-control propagation (appended: earlier fields keep their
+  // offsets for the ctypes mirror).  priority stays WIRE-encoded
+  // (0 = unset, 1..N = band 0..N-1); tenant is borrowed for the upcall.
+  const char* tenant;
+  uint64_t deadline_left_ms;
+  int32_t priority;
+  int32_t _pad2;
 };
 // (reqs, n): process each request; every token answered exactly once
 typedef void (*py_ici_batch_fn)(const IciReqC* reqs, uint64_t n);
@@ -1375,6 +1401,7 @@ struct IciRespC {
   uint64_t att_host_len;
   const IciSegC* segs;     // custody of device keys transfers to native
   uint64_t nsegs;
+  uint64_t retry_after_ms; // admission shed hint, 0 = none
 };
 
 static inline int64_t ici_now_ns() {
@@ -1425,6 +1452,7 @@ struct IciSlot {
   std::string error_text;
   std::string payload, att_host;
   std::vector<IciSegC> segs;
+  uint64_t retry_after_ms = 0;   // admission shed hint
 };
 using IciSlotPtr = std::shared_ptr<IciSlot>;
 
@@ -1459,7 +1487,7 @@ class IciChannel {
   // abandoned slot drops the payload and releases ref custody.
   void deliver(uint64_t cid, uint64_t err, std::string err_text,
                std::string payload, std::string att_host,
-               std::vector<IciSegC> segs) {
+               std::vector<IciSegC> segs, uint64_t retry_after_ms = 0) {
     IciSlotPtr slot;
     {
       std::lock_guard<std::mutex> g(slots_mu_);
@@ -1481,6 +1509,7 @@ class IciChannel {
       slot->payload = std::move(payload);
       slot->att_host = std::move(att_host);
       slot->segs = std::move(segs);
+      slot->retry_after_ms = retry_after_ms;
       slot->done.store(true, std::memory_order_release);
     }
     slot->cv.notify_all();
@@ -1564,6 +1593,10 @@ struct IciBatchItem {
   int64_t enq_ns = 0;
   IciConnPtr conn;
   int64_t wire_bytes = 0;
+  // admission-control metadata (wire-encoded priority: 0 = unset)
+  uint64_t priority = 0;
+  std::string tenant;
+  uint64_t deadline_left_ms = 0;
 };
 
 // Dispatch discipline: the in-process transport's "IO thread" is the
@@ -1756,6 +1789,9 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
         item.att_len = att;
         item.log_id = meta.request.log_id;
         item.peer_dev = msg.conn->client_dev;
+        item.priority = meta.request.priority;
+        item.tenant = std::move(meta.request.tenant);
+        item.deadline_left_ms = meta.request.deadline_left_ms;
         item.enq_ns = ici_now_ns();
         item.conn = msg.conn;
         item.wire_bytes = msg.wire_bytes;
@@ -1860,6 +1896,10 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
       r.recv_ns = it.enq_ns;
       r.peer_dev = it.peer_dev;
       r._pad = 0;
+      r.tenant = it.tenant.empty() ? nullptr : it.tenant.c_str();
+      r.deadline_left_ms = it.deadline_left_ms;
+      r.priority = (int32_t)it.priority;
+      r._pad2 = 0;
       reqs.push_back(r);
     }
     upcalls_.fetch_add(1, std::memory_order_relaxed);
@@ -1966,7 +2006,10 @@ static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
                             const uint8_t* req, uint64_t req_len,
                             const uint8_t* att_host, uint64_t att_host_len,
                             std::vector<IciSegC> segs, int64_t timeout_us,
-                            IciSlot* out, std::string* err_text) {
+                            IciSlot* out, std::string* err_text,
+                            int64_t priority_wire = 0,
+                            const char* tenant = nullptr,
+                            int64_t deadline_left_ms = 0) {
   IciServerPtr srv = conn->server;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::microseconds(timeout_us > 0 ? timeout_us
@@ -1987,6 +2030,10 @@ static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
   meta.correlation_id = cid;
   meta.attachment_size = att_host_len;
   if (timeout_us > 0) meta.request.timeout_ms = (uint64_t)(timeout_us / 1000);
+  if (priority_wire > 0) meta.request.priority = (uint64_t)priority_wire;
+  if (tenant != nullptr && tenant[0] != '\0') meta.request.tenant = tenant;
+  if (deadline_left_ms > 0)
+    meta.request.deadline_left_ms = (uint64_t)deadline_left_ms;
   std::string frame = pack_head(meta, req_len + att_host_len);
   if (req_len) frame.append((const char*)req, req_len);
   if (att_host_len) frame.append((const char*)att_host, att_host_len);
@@ -2076,6 +2123,7 @@ static uint64_t ici_do_call(const IciChannelPtr& ch, const IciConnPtr& conn,
     out->payload = std::move(slot->payload);
     out->att_host = std::move(slot->att_host);
     out->segs = std::move(slot->segs);
+    out->retry_after_ms = slot->retry_after_ms;
   }
   ch->erase_slot(cid);       // waiter owns slot lifetime (see deliver)
   *err_text = out->error_text;
@@ -2552,21 +2600,30 @@ int64_t brpc_tpu_ici_window_left(uint64_t h) {
   return it->second.second->window_left;
 }
 
-// Unary call.  Outputs are malloc'd (brpc_tpu_buf_free); response device
-// refs land in *segs_out (caller takes their keys from the registry).
-uint64_t brpc_tpu_ici_call(uint64_t h, const char* method,
-                           const uint8_t* req, uint64_t req_len,
-                           const uint8_t* att_host, uint64_t att_host_len,
-                           const nrpc::IciSegC* segs, uint64_t nsegs,
-                           int64_t timeout_us, uint8_t** resp_out,
-                           uint64_t* resp_len, uint8_t** att_out,
-                           uint64_t* att_out_len,
-                           nrpc::IciSegC** segs_out, uint64_t* nsegs_out,
-                           char** err_text_out) {
-  *resp_out = nullptr; *resp_len = 0;
-  *att_out = nullptr; *att_out_len = 0;
-  *segs_out = nullptr; *nsegs_out = 0;
-  *err_text_out = nullptr;
+// Single-output-struct out-block for the unary ici call (see call2/call3):
+// one reusable pointer instead of seven byref temporaries.
+struct IciCallOut {
+  uint8_t* resp;
+  uint64_t resp_len;
+  uint8_t* att;
+  uint64_t att_len;
+  nrpc::IciSegC* segs;
+  uint64_t nsegs;
+  char* err_text;
+  uint64_t retry_after_ms;   // admission shed hint on ELIMIT rejections
+};
+
+// Shared unary-call body: outputs are malloc'd (brpc_tpu_buf_free);
+// response device refs land in out->segs (caller takes their keys).
+static uint64_t ici_call_fill(uint64_t h, const char* method,
+                              const uint8_t* req, uint64_t req_len,
+                              const uint8_t* att_host,
+                              uint64_t att_host_len,
+                              const nrpc::IciSegC* segs, uint64_t nsegs,
+                              int64_t timeout_us, int64_t priority_wire,
+                              const char* tenant, int64_t deadline_left_ms,
+                              IciCallOut* o) {
+  memset(o, 0, sizeof(*o));
   std::pair<nrpc::IciChannelPtr, nrpc::IciConnPtr> entry;
   {
     std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
@@ -2583,53 +2640,76 @@ uint64_t brpc_tpu_ici_call(uint64_t h, const char* method,
   uint64_t rc = nrpc::ici_do_call(entry.first, entry.second, method, req,
                                   req_len, att_host, att_host_len,
                                   std::move(seg_vec), timeout_us, &out,
-                                  &err_text);
+                                  &err_text, priority_wire, tenant,
+                                  deadline_left_ms);
   if (!out.payload.empty()) {
-    *resp_out = (uint8_t*)malloc(out.payload.size());
-    memcpy(*resp_out, out.payload.data(), out.payload.size());
-    *resp_len = out.payload.size();
+    o->resp = (uint8_t*)malloc(out.payload.size());
+    memcpy(o->resp, out.payload.data(), out.payload.size());
+    o->resp_len = out.payload.size();
   }
   if (!out.att_host.empty()) {
-    *att_out = (uint8_t*)malloc(out.att_host.size());
-    memcpy(*att_out, out.att_host.data(), out.att_host.size());
-    *att_out_len = out.att_host.size();
+    o->att = (uint8_t*)malloc(out.att_host.size());
+    memcpy(o->att, out.att_host.data(), out.att_host.size());
+    o->att_len = out.att_host.size();
   }
   if (!out.segs.empty()) {
-    *segs_out = (nrpc::IciSegC*)malloc(out.segs.size() *
-                                       sizeof(nrpc::IciSegC));
-    memcpy(*segs_out, out.segs.data(),
+    o->segs = (nrpc::IciSegC*)malloc(out.segs.size() *
+                                     sizeof(nrpc::IciSegC));
+    memcpy(o->segs, out.segs.data(),
            out.segs.size() * sizeof(nrpc::IciSegC));
-    *nsegs_out = out.segs.size();
+    o->nsegs = out.segs.size();
   }
   if (!err_text.empty()) {
-    *err_text_out = (char*)malloc(err_text.size() + 1);
-    memcpy(*err_text_out, err_text.c_str(), err_text.size() + 1);
+    o->err_text = (char*)malloc(err_text.size() + 1);
+    memcpy(o->err_text, err_text.c_str(), err_text.size() + 1);
   }
+  o->retry_after_ms = out.retry_after_ms;
   return rc;
 }
 
-// Single-output-struct variant of brpc_tpu_ici_call: 17 ctypes-converted
-// arguments (7 of them byref temporaries) measured ~3-4 us of per-call
-// marshalling from Python; one reusable out-block passes in one pointer.
-struct IciCallOut {
-  uint8_t* resp;
-  uint64_t resp_len;
-  uint8_t* att;
-  uint64_t att_len;
-  nrpc::IciSegC* segs;
-  uint64_t nsegs;
-  char* err_text;
-};
+// Legacy 17-argument ABI (kept for existing callers; no admission meta).
+uint64_t brpc_tpu_ici_call(uint64_t h, const char* method,
+                           const uint8_t* req, uint64_t req_len,
+                           const uint8_t* att_host, uint64_t att_host_len,
+                           const nrpc::IciSegC* segs, uint64_t nsegs,
+                           int64_t timeout_us, uint8_t** resp_out,
+                           uint64_t* resp_len, uint8_t** att_out,
+                           uint64_t* att_out_len,
+                           nrpc::IciSegC** segs_out, uint64_t* nsegs_out,
+                           char** err_text_out) {
+  IciCallOut o;
+  uint64_t rc = ici_call_fill(h, method, req, req_len, att_host,
+                              att_host_len, segs, nsegs, timeout_us, 0,
+                              nullptr, 0, &o);
+  *resp_out = o.resp; *resp_len = o.resp_len;
+  *att_out = o.att; *att_out_len = o.att_len;
+  *segs_out = o.segs; *nsegs_out = o.nsegs;
+  *err_text_out = o.err_text;
+  return rc;
+}
 
 uint64_t brpc_tpu_ici_call2(uint64_t h, const char* method,
                             const uint8_t* req, uint64_t req_len,
                             const uint8_t* att_host, uint64_t att_host_len,
                             const nrpc::IciSegC* segs, uint64_t nsegs,
                             int64_t timeout_us, IciCallOut* out) {
-  return brpc_tpu_ici_call(h, method, req, req_len, att_host, att_host_len,
-                           segs, nsegs, timeout_us, &out->resp,
-                           &out->resp_len, &out->att, &out->att_len,
-                           &out->segs, &out->nsegs, &out->err_text);
+  return ici_call_fill(h, method, req, req_len, att_host, att_host_len,
+                       segs, nsegs, timeout_us, 0, nullptr, 0, out);
+}
+
+// call2 + admission-control metadata: wire-encoded priority (0 = unset,
+// 1..N = band 0..N-1), tenant, and the sender's remaining deadline
+// budget.  out->retry_after_ms carries the shed hint back on ELIMIT.
+uint64_t brpc_tpu_ici_call3(uint64_t h, const char* method,
+                            const uint8_t* req, uint64_t req_len,
+                            const uint8_t* att_host, uint64_t att_host_len,
+                            const nrpc::IciSegC* segs, uint64_t nsegs,
+                            int64_t timeout_us, int64_t priority_wire,
+                            const char* tenant, int64_t deadline_left_ms,
+                            IciCallOut* out) {
+  return ici_call_fill(h, method, req, req_len, att_host, att_host_len,
+                       segs, nsegs, timeout_us, priority_wire, tenant,
+                       deadline_left_ms, out);
 }
 
 // Respond to a Python-handled ici request.  Custody of `segs` keys
@@ -2714,7 +2794,7 @@ int brpc_tpu_ici_respond_batch(const nrpc::IciRespC* rs, uint64_t n) {
                 r.att_host_len
                     ? std::string((const char*)r.att_host, r.att_host_len)
                     : std::string(),
-                std::move(seg_vec));
+                std::move(seg_vec), r.retry_after_ms);
   }
   return 0;
 }
@@ -2971,6 +3051,12 @@ uint64_t brpc_tpu_ici_call(uint64_t, const char*, const uint8_t*, uint64_t,
 uint64_t brpc_tpu_ici_call2(uint64_t, const char*, const uint8_t*,
                             uint64_t, const uint8_t*, uint64_t,
                             const void*, uint64_t, int64_t, void*) {
+  return 1009;
+}
+uint64_t brpc_tpu_ici_call3(uint64_t, const char*, const uint8_t*,
+                            uint64_t, const uint8_t*, uint64_t,
+                            const void*, uint64_t, int64_t, int64_t,
+                            const char*, int64_t, void*) {
   return 1009;
 }
 int brpc_tpu_ici_respond(uint64_t, uint64_t, const char*, const uint8_t*,

@@ -1,0 +1,30 @@
+"""Seeded violation for the admission-control state: a band queue
+mutated outside the controller lock — the exact shape of ISSUE 9's
+AdmissionController (_bands/_queued_total under _lock), which fablint
+must keep honest."""
+import threading
+
+
+class MiniAdmission:
+    _GUARDED_BY = {"_bands": "_lock", "_queued_total": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bands = [[] for _ in range(4)]
+        self._queued_total = 0
+
+    def enqueue_locked(self, pri, entry) -> None:
+        with self._lock:
+            self._bands[pri].append(entry)
+            self._queued_total += 1
+
+    def enqueue_racy(self, pri, entry) -> None:
+        self._bands[pri].append(entry)     # line 22: the violation
+
+    def drain(self):
+        with self._lock:
+            out = [e for band in self._bands for e in band]
+            for band in self._bands:
+                band.clear()
+            self._queued_total = 0
+        return out

@@ -57,6 +57,16 @@ class TestFixtureViolations:
         msgs = " | ".join(f.message for f in out)
         assert "daemon" in msgs and "quiesce" in msgs
 
+    def test_unguarded_admission_queue_mutation_reported_with_line(self):
+        """The admission-control state class (ISSUE 9): a band-queue
+        append outside the controller lock is caught at the exact
+        file:line."""
+        out = _findings("bad_admission_queue.py",
+                        fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 22)]
+        assert "_bands" in out[0].message and "_lock" in out[0].message
+        assert out[0].path.endswith("bad_admission_queue.py")
+
     def test_unguarded_batch_queue_access_reported_with_line(self):
         """The batched-delivery state class (PR 8): an append to the
         response collector's batch queue outside its lock is caught at

@@ -222,12 +222,14 @@ class TestNativeDatapath:
             binding = server._native_ici
             arr = _device_payload(mesh)
 
-            def err_with_segs(token, err, text, collector=None, post=None):
+            def err_with_segs(token, err, text, collector=None, post=None,
+                              retry_after=0):
                 att = IOBuf()
                 att.append_device_array(arr)
                 att_host, segs = split_attachment(att)
                 binding._respond_flush([(token, err, text.encode(), b"",
-                                         att_host, segs, post)])
+                                         att_host, segs, post,
+                                         retry_after)])
 
             monkeypatch.setattr(binding, "_respond_one", err_with_segs)
             ch = rpc.Channel()
