@@ -877,6 +877,12 @@ from brpc_tpu.butil import flags as _fl
 # window admits the full pipeline (depth * chunk).  The configuration
 # is set here so it is part of the reported number.
 _fl.set_flag("ici_socket_window_bytes", 64 * 1024 * 1024)
+# per-run bulk-tier pin: "" = auto (the route table prefers the shm
+# ring for this same-host pair) with a ring sized to hold one full
+# 96MB pass, so the producer never parks on the space doorbell inside
+# the timed window; or ici_fabric_shm=False for the uds-pinned pass.
+# Set here so the configuration is part of the reported number.
+%(shm_cfg)s
 from brpc_tpu import rpc, ici
 from echo_pb2 import EchoRequest, EchoResponse
 mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
@@ -945,76 +951,112 @@ else:
         assert not errs, errs
         best = max(best, CALLS * CHUNK / dt / 1e9)
     print("FABRIC_GBPS %%.4f" %% best, flush=True)
+    # which byte mover carried the payloads (route assertion for the
+    # shm-vs-uds comparison): cumulative per-socket counters
+    from brpc_tpu.ici.fabric import FabricSocket
+    from brpc_tpu.rpc.socket import list_sockets
+    shm_b = sum(s.shm_bytes_sent for s in list_sockets()
+                if isinstance(s, FabricSocket))
+    bulk_b = sum(s.bulk_bytes_sent for s in list_sockets()
+                 if isinstance(s, FabricSocket))
+    print("FABRIC_ROUTE shm=%%d bulk=%%d" %% (shm_b, bulk_b), flush=True)
     kv.wait_at_barrier("fb_done", 600000)
     print("FB1_OK", flush=True)
 """
 
 
-def bench_fabric_gbps(timeout_s: int = 300) -> dict:
-    """Cross-PROCESS fabric bandwidth: bulk DEVICE payloads over the
-    NATIVE bulk data plane (native/fabric.cpp — uuid-tagged frames over
-    a dedicated same-host unix / cross-host TCP connection, r5), under
-    the full RPC stack (Channel -> tpu_std frames -> Server dispatch),
+def bench_fabric_gbps(timeout_s: int = 300, plane: str = "auto") -> dict:
+    """Cross-PROCESS fabric bandwidth: bulk DEVICE payloads under the
+    full RPC stack (Channel -> tpu_std frames -> Server dispatch),
     async depth 8, 2 jax.distributed processes on this host.  Payload
     delivery is host-resident zero-copy (the reference RDMA contract:
     bytes land in registered HOST memory; first device use pays H2D) —
     the same semantics the reference's 0.8-2.3 GB/s numbers measure.
+
+    ``plane`` picks the byte mover: "auto" lets the route table choose
+    (same-host pairs take the SHM RING — one NT-store copy into the
+    mmap'd segment, zero receiver copies, no syscalls; ring sized to a
+    full pass so the timed window never parks on the space doorbell);
+    "uds" pins the socket bulk conn (ici_fabric_shm=False) for the
+    before/after comparison.  The child reports which plane actually
+    carried the bytes (FABRIC_ROUTE) and the result carries it as
+    ``route`` — the number is meaningless without the route assertion.
     METHODOLOGY: best of 3 passes (PASSES in _FABRIC_BENCH_CHILD) of
     96MB each — the two processes share one core with the OS, so a
     single pass can eat a scheduling artifact.  r4 (all-Python,
-    transfer-server pulls): 0.495."""
+    transfer-server pulls): 0.495; r9 (UDS bulk): 2.74 on this host."""
     import os
     repo = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.join(repo, "tests"))
     # one spawn harness for the bench, the dryrun stress leg, and the
     # fabric tests — a fix to env/timeouts applies to all three
     from test_fabric import _run_pair
+    shm_cfg = '_fl.set_flag("ici_shm_ring_bytes", 160 * 1024 * 1024)' \
+        if plane == "auto" else '_fl.set_flag("ici_fabric_shm", False)'
     try:
-        outs = _run_pair(_FABRIC_BENCH_CHILD % {"repo": repo},
+        outs = _run_pair(_FABRIC_BENCH_CHILD
+                         % {"repo": repo, "shm_cfg": shm_cfg},
                          timeout=timeout_s)
     except AssertionError as e:
         print(f"# fabric bench children failed: {str(e)[-400:]}",
               file=sys.stderr)
         return {}
+    out = {}
     for line in outs[1].splitlines():
         if line.startswith("FABRIC_GBPS"):
-            return {"fabric_xproc_gbps": float(line.split()[1]),
-                    "processes": 2}
-    return {}
+            out = {"fabric_xproc_gbps": float(line.split()[1]),
+                   "processes": 2}
+        elif line.startswith("FABRIC_ROUTE"):
+            kv = dict(p.split("=", 1) for p in line.split()[1:])
+            shm_b, bulk_b = int(kv.get("shm", 0)), int(kv.get("bulk", 0))
+            out["route"] = "shm" if shm_b > bulk_b else "uds"
+            out["route_shm_bytes"] = shm_b
+            out["route_bulk_bytes"] = bulk_b
+    return out
 
 
-def bench_fabric_streaming_mbps(timeout_s: int = 240) -> dict:
+def bench_fabric_streaming_mbps(timeout_s: int = 240,
+                                plane: str = "auto") -> dict:
     """Streaming RPC across a real process boundary (r6): the stream
     handshake, feedback, and 16-byte DATA descriptors ride the fabric
-    control channel; every 256KB chunk's payload rides the native bulk
-    plane (rpc/stream.py FRAME_DATA_BULK -> native/fabric.cpp
-    gather-send, zero-copy block handoff both ends) — the multi-host leg
-    of the sequence-parallel substrate.  Server verifies every chunk's
-    bytes.  METHODOLOGY: best of 3 passes of 40MB (160 x 256KB); each
-    pass's clock stops on the server's consumed-and-verified ack, so the
-    number includes the drain tail — same peak-of-passes reporting as
-    the bulk tier.  r5 (payload inline in control frames, single pass):
-    214 MB/s."""
+    control channel; every 256KB chunk's payload rides the fast plane
+    the route table picks — the shm ring (FRAME_DATA_SHM: one copy into
+    the mmap'd segment, zero-copy claim) on same-host pairs, else the
+    native bulk conn (FRAME_DATA_BULK gather-send).  ``plane`` "uds"
+    pins the socket bulk conn for the before/after comparison.  Server
+    verifies every chunk's bytes.  METHODOLOGY: best of 3 passes of
+    40MB (160 x 256KB); each pass's clock stops on the server's
+    consumed-and-verified ack, so the number includes the drain tail —
+    same peak-of-passes reporting as the bulk tier.  r5 (payload inline
+    in control frames, single pass): 214 MB/s; r9 (UDS bulk): 554 on
+    this host."""
     import os
     repo = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.join(repo, "tests"))
-    from test_fabric import STREAM_CHILD, _run_pair
+    from test_fabric import STREAM_CHILD, _SHM_OFF_FLAG, _run_pair
     child = STREAM_CHILD % {"repo": repo, "n": 160, "passes": 3}
+    if plane != "auto":
+        marker = "from brpc_tpu.ici.fabric import FabricNode"
+        child = child.replace(marker, marker + _SHM_OFF_FLAG)
     try:
         outs = _run_pair(child, timeout=timeout_s)
     except AssertionError as e:
         print(f"# fabric streaming bench failed: {str(e)[-300:]}",
               file=sys.stderr)
         return {}
+    out = {}
     for line in outs[1].splitlines():
         if line.startswith("FABRIC_STREAM_MBPS"):
             parts = line.split()
-            out = {"stream_mbps": float(parts[1])}
+            out["stream_mbps"] = float(parts[1])
             for p in parts[2:]:
                 if p.startswith("best_of="):
                     out["best_of"] = int(p.split("=", 1)[1])
-            return out
-    return {}
+        elif line.startswith("ST_ROUTE"):
+            kv = dict(p.split("=", 1) for p in line.split()[1:])
+            shm_b, bulk_b = int(kv.get("shm", 0)), int(kv.get("bulk", 0))
+            out["route"] = "shm" if shm_b > bulk_b else "uds"
+    return out
 
 
 _POD_PD_CHILD = r"""
@@ -1551,17 +1593,34 @@ def main() -> None:
         print(f"# ici fanout failed: {e}", file=sys.stderr)
         ifan = {}
     try:
+        # auto = the route table's pick; on this same-host pair that is
+        # the SHM RING tier (route asserted in the result)
         fb = bench_fabric_gbps()
         print(f"# fabric cross-process: {fb}", file=sys.stderr)
     except Exception as e:  # pragma: no cover
         print(f"# fabric bench failed: {e}", file=sys.stderr)
         fb = {}
     try:
+        # the uds-pinned before/after leg (ici_fabric_shm=False)
+        fb_uds = bench_fabric_gbps(plane="uds")
+        print(f"# fabric cross-process (uds pinned): {fb_uds}",
+              file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# fabric uds bench failed: {e}", file=sys.stderr)
+        fb_uds = {}
+    try:
         fstrm = bench_fabric_streaming_mbps()
         print(f"# fabric streaming: {fstrm}", file=sys.stderr)
     except Exception as e:  # pragma: no cover
         print(f"# fabric streaming failed: {e}", file=sys.stderr)
         fstrm = {}
+    try:
+        fstrm_uds = bench_fabric_streaming_mbps(plane="uds")
+        print(f"# fabric streaming (uds pinned): {fstrm_uds}",
+              file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# fabric streaming uds failed: {e}", file=sys.stderr)
+        fstrm_uds = {}
     try:
         pdd = bench_pod_prefill_decode()
         print(f"# pod prefill/decode: {pdd}", file=sys.stderr)
@@ -1654,6 +1713,14 @@ def main() -> None:
         "native_pipelined_gbps": round(async_gbps, 3),
         "raw_epoll_echo_p50_us": round(raw_p50, 2),
         "fabric_xproc_gbps": round(fb.get("fabric_xproc_gbps", -1.0), 3),
+        # the route the auto number rode (acceptance: "shm" on this
+        # same-host pair) + the two tiers measured separately
+        "fabric_xproc_route": fb.get("route", "unavailable"),
+        "fabric_xproc_shm_gbps": round(
+            fb.get("fabric_xproc_gbps", -1.0)
+            if fb.get("route") == "shm" else -1.0, 3),
+        "fabric_xproc_uds_gbps": round(
+            fb_uds.get("fabric_xproc_gbps", -1.0), 3),
         "reloc_platform": reloc.get("platform", "unavailable"),
         "reloc_devices": reloc.get("devices", 0),
         "reloc_nonresident_p50_us_4k": round(
@@ -1690,6 +1757,12 @@ def main() -> None:
         "streaming_mbps_ici": round(strm_ici.get("stream_mbps", -1.0), 1),
         "streaming_mbps_fabric_xproc": round(
             fstrm.get("stream_mbps", -1.0), 1),
+        "streaming_fabric_route": fstrm.get("route", "unavailable"),
+        "streaming_mbps_fabric_shm": round(
+            fstrm.get("stream_mbps", -1.0)
+            if fstrm.get("route") == "shm" else -1.0, 1),
+        "streaming_mbps_fabric_uds": round(
+            fstrm_uds.get("stream_mbps", -1.0), 1),
         "streaming_fabric_best_of": fstrm.get("best_of", 1),
         "pod_pd_tokens_per_s": round(
             pdd.get("pod_pd_tokens_per_s", -1.0), 1),

@@ -17,7 +17,7 @@ from typing import Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_ici_call3"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_shm_create"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -403,6 +403,42 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_fab_peer_list.restype = ctypes.c_int
     lib.brpc_tpu_fab_peer_list.argtypes = [ctypes.POINTER(ctypes.c_int32),
                                            ctypes.c_int]
+    # same-host shared-memory ring tier (native/fabric.cpp nshm): one
+    # mmap'd /dev/shm segment per fabric socket pair, futex doorbells,
+    # zero-copy claims retired on release (consume-to-release credit)
+    lib.brpc_tpu_shm_create.restype = ctypes.c_uint64
+    lib.brpc_tpu_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.brpc_tpu_shm_attach.restype = ctypes.c_uint64
+    lib.brpc_tpu_shm_attach.argtypes = [ctypes.c_char_p]
+    lib.brpc_tpu_shm_unlink.restype = ctypes.c_int
+    lib.brpc_tpu_shm_unlink.argtypes = [ctypes.c_char_p]
+    lib.brpc_tpu_shm_send.restype = ctypes.c_int
+    lib.brpc_tpu_shm_send.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
+                                      u8p, ctypes.c_uint64, ctypes.c_int64]
+    lib.brpc_tpu_shm_sendv.restype = ctypes.c_int
+    lib.brpc_tpu_shm_sendv.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int64]
+    lib.brpc_tpu_shm_recv.restype = ctypes.c_int
+    lib.brpc_tpu_shm_recv.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.brpc_tpu_shm_release.restype = None
+    lib.brpc_tpu_shm_release.argtypes = [ctypes.c_uint64, u8p,
+                                         ctypes.c_uint64]
+    lib.brpc_tpu_shm_alive.restype = ctypes.c_int
+    lib.brpc_tpu_shm_alive.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_shm_mark_dead.restype = None
+    lib.brpc_tpu_shm_mark_dead.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_shm_close.restype = None
+    lib.brpc_tpu_shm_close.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_shm_chaos.restype = ctypes.c_int
+    lib.brpc_tpu_shm_chaos.argtypes = [ctypes.c_uint64, ctypes.c_int,
+                                       ctypes.c_int64]
+    lib.brpc_tpu_shm_stats.restype = ctypes.c_int
+    lib.brpc_tpu_shm_stats.argtypes = [ctypes.c_uint64,
+                                       ctypes.POINTER(ctypes.c_uint64),
+                                       ctypes.c_int]
     _lib = lib
     return _lib
 

@@ -49,6 +49,7 @@ from ..rpc import errors
 from ..rpc import fault_injection as _fi
 from ..rpc.socket import Socket
 from . import device_plane as _dp
+from . import route as _route
 from .transport import CreditWindow, OrderedDelivery
 
 _KV_PREFIX = "brpc_tpu/fabric/"
@@ -94,6 +95,27 @@ _flags.define_flag("ici_fabric_health_check", True,
 # tests shrink this so a dropped bulk frame resolves quickly.
 _flags.define_flag("ici_bulk_claim_timeout_s", 60.0,
                    "max seconds a bulk claim waits for its frame")
+# Same-host SHARED-MEMORY ring tier (native/fabric.cpp nshm): when both
+# ends of a fabric pair run on one host and both advertise the "shm"
+# capability, the dialing side creates an mmap'd /dev/shm segment at
+# handshake (two SPSC rings, one per direction) and payloads >= the
+# bulk thresholds move through it — ONE sender copy into shared memory,
+# ZERO receiver copies (claims are zero-copy views into the ring,
+# retired on release: consume-to-release credit), no syscalls on the
+# byte path, futex doorbells for wakeups.  Only the (uuid, len)
+# slot-descriptor rides the control channel (kinds 5/6 + stream
+# FRAME_DATA_SHM).  Death (segment kill, peer crash mid-slot, mapping
+# failure) degrades to the UDS/TCP bulk tier through the same PR-2
+# machinery and revives in the background (_F_SHM_REESTABLISH).
+_flags.define_flag("ici_fabric_shm", True,
+                   "same-host fabric pairs add the mmap ring bulk tier "
+                   "(False: UDS/TCP bulk only)")
+_flags.define_flag("ici_shm_ring_bytes", 32 * 1024 * 1024,
+                   "per-direction shm ring capacity per socket pair",
+                   _flags.positive_integer)
+_flags.define_flag("ici_shm_send_timeout_s", 20.0,
+                   "max seconds an shm ring send waits for space before "
+                   "the plane is declared dead")
 # Cross-process device plane: device payloads cross through the
 # SEQUENCED xproc plane — every transfer (both directions) is assigned a
 # slot in one total order agreed over the control channel
@@ -122,24 +144,40 @@ _u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
 class _NativeBufOwner:
-    """Releases a native bulk receive buffer when the last numpy view
-    over it is collected (chained via the view's base -> ctypes array ->
+    """Releases a native receive buffer when the last numpy view over
+    it is collected (chained via the view's base -> ctypes array ->
     ._owner).  The exactly-once release for zero-copy host delivery;
-    release recycles into the conn's buffer pool (page-fault avoidance)
-    or frees when the conn is gone."""
+    ``release_fn`` is the plane's release entry point — the socket
+    tier's ``brpc_tpu_fab_buf_release`` (recycles into the conn's
+    buffer pool, frees when the conn is gone) or the shm tier's
+    ``brpc_tpu_shm_release`` (retires the ring slot)."""
 
-    __slots__ = ("_lib", "_conn", "_ptr", "_len")
+    __slots__ = ("_release", "_conn", "_ptr", "_len")
 
-    def __init__(self, lib, conn, ptr, length):
-        self._lib, self._conn, self._ptr = lib, conn, ptr
+    def __init__(self, release_fn, conn, ptr, length):
+        self._release, self._conn, self._ptr = release_fn, conn, ptr
         self._len = length
 
     def __del__(self):
         try:
-            self._lib.brpc_tpu_fab_buf_release(self._conn, self._ptr,
-                                               self._len)
+            self._release(self._conn, self._ptr, self._len)
         except Exception:
             pass
+
+
+def _ShmBufOwner(lib, conn, ptr, length):
+    """Owner for an shm ring slot: releasing retires it — the
+    consume-to-release credit return; the ring space becomes reusable
+    for the producer only now, and after the conn closed the LAST
+    release also unmaps the segment (the native side defers the munmap
+    exactly for this).  Same exactly-once discipline as the socket
+    tier's buffers, so it IS that owner with the shm release symbol."""
+    return _NativeBufOwner(lib.brpc_tpu_shm_release, conn, ptr, length)
+
+
+class _ShmOversize(Exception):
+    """The frame can never fit this ring — route it elsewhere without
+    degrading the (healthy) shm plane."""
 
 
 def _bulk_lib():
@@ -180,6 +218,15 @@ _F_GOODBYE = 15
 # a client-side send goes out with seq -1 in its kind-4 descriptor and
 # receives its assignment in this frame (u64 uuid, i64 seq)
 _F_DPLANE_SEQ = 16
+# shm ring degradation + revival (mirrors _F_BULK_*): the control
+# channel stays the source of truth so every transition is ORDERED
+# relative to the kind-5/6 and FRAME_DATA_SHM descriptors that
+# reference the ring.  Older peers ignore unknown frame types.
+_F_SHM_DOWN = 17          # sender observed ring death; peer degrades too
+_F_SHM_REESTABLISH = 18   # json: {shm_seg, shm_bytes} — client created
+                          # a fresh segment for the server to attach
+_F_SHM_OK = 19            # server attached (and unlinked) the segment
+_F_SHM_ERR = 20           # attach failed/refused; client backs off
 # Clock alignment (ici/clock.py) deliberately adds NO frame type: the
 # NTP-style exchange piggybacks on the HELLO/HELLO_OK handshake (the
 # client's wall t0 rides the HELLO json; HELLO_OK echoes it with the
@@ -248,6 +295,10 @@ class FabricNode:
         self.bulk_addr = ""
         self.bulk_uds = ""
         self.host_ip = ""
+        # same-host shm ring tier: probed at start (a denied /dev/shm
+        # just leaves the capability un-advertised — clean degrade)
+        self._shm_ok = False
+        self._shm_lib = None
 
     # ---- lifecycle -----------------------------------------------------
     @classmethod
@@ -350,6 +401,21 @@ class FabricNode:
                 self._bulk_listener = lh
                 self.bulk_addr = f"{host_ip}:{port_out.value}"
                 self.bulk_uds = uds_out.value.decode()
+        # shm ring capability probe: can this process create, map, and
+        # unlink a segment?  A sandbox that denies /dev/shm fails here
+        # once and the capability simply is not advertised — peers then
+        # keep the socket bulk tier, byte-for-byte the old behavior.
+        if lib is not None and hasattr(lib, "brpc_tpu_shm_create") \
+                and _flags.get_flag("ici_fabric_shm"):
+            import os as _os
+            probe = f"brpc_tpu_shm_probe.{_os.getpid()}"
+            lib.brpc_tpu_shm_unlink(probe.encode())
+            ph = lib.brpc_tpu_shm_create(probe.encode(), 64 * 1024)
+            if ph:
+                lib.brpc_tpu_shm_unlink(probe.encode())
+                lib.brpc_tpu_shm_close(ph)
+                self._shm_ok = True
+                self._shm_lib = lib
         # the handshake publication (GID/QPN analogue)
         info = {
             "ctrl": self.ctrl_addr,
@@ -366,6 +432,13 @@ class FabricNode:
                 # same-host from same-address-on-another-host
                 info["bulk_uds"] = self.bulk_uds
                 info["host"] = self.host_ip
+        if self._shm_ok:
+            # shm capability key: same-host peers (matching "host") may
+            # hand us a segment name at HELLO; mixed-version or
+            # shm-less peers never see an shm descriptor (we only bind
+            # the ring when BOTH ends acked it)
+            info["shm"] = 1
+            info["host"] = self.host_ip
         if _flags.get_flag("ici_device_plane"):
             # device-plane capability advert (both ends must hold it:
             # one-sided entry into an SPMD program would hang forever).
@@ -521,6 +594,7 @@ class FabricNode:
         # map, under a key no one will ever claim (review finding)
         bulk_h = 0
         bulk_key = None
+        shm_h = 0
         try:
             conn.setsockopt(_pysocket.IPPROTO_TCP, _pysocket.TCP_NODELAY, 1)
             fr = _recv_frame(conn)
@@ -569,11 +643,29 @@ class FabricNode:
                                 b"bulk plane binding failed")
                     conn.close()
                     return
+            # shm ring tier: attach the segment the client created and
+            # unlink it (the mapping outlives the name; a later crash
+            # leaks nothing).  Attach failure is SOFT — the client only
+            # binds its end on our explicit ack, so a missing ack
+            # degrades the pair to the socket bulk tier cleanly.
+            shm_name = hello.get("shm_seg")
+            if shm_name and self._shm_ok and self._shm_lib is not None \
+                    and _flags.get_flag("ici_fabric_shm"):
+                refused = plan is not None and plan.on_shm_handshake()
+                if not refused:
+                    shm_h = self._shm_lib.brpc_tpu_shm_attach(
+                        shm_name.encode())
+                    if shm_h:
+                        self._shm_lib.brpc_tpu_shm_unlink(
+                            shm_name.encode())
             sock = FabricSocket(conn, local_dev=target,
                                 remote_dev=hello["client_dev"],
                                 peer_pid=hello["pid"], node=self)
             sock._attach_bulk(self._bulk_lib, bulk_h)
             bulk_h = 0                       # custody passed to the socket
+            if shm_h:
+                sock._attach_shm(self._shm_lib, shm_h)
+                shm_h = 0
             sock.is_server_side = True
             # on_accept attaches the messenger BEFORE any frame can be
             # read — a reader that fires first would drain the input
@@ -581,12 +673,15 @@ class FabricNode:
             listener.on_accept(sock)
             # clock-alignment piggyback (ici/clock.py): echo the
             # client's wall t0 with OUR wall stamp — the client bounds
-            # our offset by its HELLO round trip.  Empty for old peers.
-            ok_body = b""
+            # our offset by its HELLO round trip.  Empty for old peers
+            # unless the shm ack needs carrying.
+            ok = {}
             if "wall_us" in hello:
-                ok_body = json.dumps(
-                    {"t0": hello["wall_us"],
-                     "wall_us": time.time_ns() // 1000}).encode()
+                ok = {"t0": hello["wall_us"],
+                      "wall_us": time.time_ns() // 1000}
+            if sock.shm_bound():
+                ok["shm"] = True
+            ok_body = json.dumps(ok).encode() if ok else b""
             _send_frame(conn, _F_HELLO_OK, ok_body)
             sock.start_io()
         except Exception as e:
@@ -599,6 +694,8 @@ class FabricNode:
                 self._bulk_lib.brpc_tpu_fab_conn_close(bulk_h)
             else:
                 self._reap_parked_bulk(bulk_key)
+            if shm_h and self._shm_lib is not None:
+                self._shm_lib.brpc_tpu_shm_close(shm_h)
 
     # A refused handshake's parked bulk conn is reaped with a short
     # NONZERO claim wait: the client dialed the bulk plane before sending
@@ -621,13 +718,14 @@ class FabricNode:
             self._bulk_lib.brpc_tpu_fab_conn_close(h)
 
     # ---- client side ---------------------------------------------------
-    def dial_bulk(self, peer_pid: int) -> Tuple[int, Optional[str], object]:
+    def dial_bulk(self, peer_pid: int
+                  ) -> Tuple[int, Optional[str], object, bool]:
         """Dial the peer's bulk listener and park a fresh conn under a
-        unique key: (handle, key, lib).  (0, None, lib) when either end
-        lacks the native plane.  Shared by the initial connect and the
-        degradation-recovery re-establishment path."""
+        unique key: (handle, key, lib, is_uds).  (0, None, lib, False)
+        when either end lacks the native plane.  Shared by the initial
+        connect and the degradation-recovery re-establishment path."""
         lib = _bulk_lib()
-        bulk_h, bulk_key = 0, None
+        bulk_h, bulk_key, is_uds = 0, None, False
         info = self.peer_info(peer_pid)
         if lib is not None and info.get("bulk"):
             bhost, _, bport = info["bulk"].rpartition(":")
@@ -637,12 +735,53 @@ class FabricNode:
             if info.get("bulk_uds") and info.get("host") == self.host_ip:
                 bulk_h = lib.brpc_tpu_fab_connect_uds(
                     info["bulk_uds"].encode(), bulk_key.encode())
+                is_uds = bool(bulk_h)
             if not bulk_h:
                 bulk_h = lib.brpc_tpu_fab_connect(
                     bhost.encode(), int(bport), bulk_key.encode())
             if not bulk_h:
                 bulk_key = None
-        return bulk_h, bulk_key, lib
+        return bulk_h, bulk_key, lib, is_uds
+
+    def shm_peer_ok(self, peer_pid: int) -> bool:
+        """Both ends hold the shm capability AND share this host.  The
+        flag is re-checked at CONNECT time (not just at the start-time
+        probe) so a tool pinning the tier off after the node joined —
+        rpc_press --bulk-plane uds, the bench's pinned legs — takes
+        effect on every later socket."""
+        if not self._shm_ok or not _flags.get_flag("ici_fabric_shm"):
+            return False
+        try:
+            info = self.peer_info(peer_pid)
+        except Exception:
+            return False
+        return bool(info.get("shm")) and info.get("host") == self.host_ip
+
+    def create_shm_segment(self) -> Tuple[int, Optional[str], object]:
+        """Create a fresh ring segment as the dialing side: (handle,
+        name, lib); (0, None, None) when shm is unavailable.  The name
+        rides the control channel (HELLO or _F_SHM_REESTABLISH); the
+        ATTACHING side unlinks after mapping, so the /dev/shm entry
+        lives only for the handshake round trip."""
+        if not self._shm_ok or self._shm_lib is None:
+            return 0, None, None
+        name = f"brpc_tpu_shm.{self.process_id}.{self.next_uuid():x}"
+        h = self._shm_lib.brpc_tpu_shm_create(
+            name.encode(), int(_flags.get_flag("ici_shm_ring_bytes")))
+        if not h:
+            return 0, None, None
+        return h, name, self._shm_lib
+
+    def drop_shm_segment(self, h: int, name: Optional[str]) -> None:
+        """Abandon a created-but-never-acked segment: close the handle
+        and remove the directory entry (the attach never happened, so
+        nobody else unlinked it)."""
+        if self._shm_lib is None:
+            return
+        if h:
+            self._shm_lib.brpc_tpu_shm_close(h)
+        if name:
+            self._shm_lib.brpc_tpu_shm_unlink(name.encode())
 
     def ping(self, target_dev: int, timeout: float = 1.0) -> bool:
         """Probe whether ici://target_dev is served by its owner process,
@@ -670,7 +809,15 @@ class FabricNode:
         # bulk plane: dial the peer's bulk listener FIRST so the key is
         # already parked when the control HELLO names it (both ends must
         # have the native core; either missing -> transfer-server path)
-        bulk_h, bulk_key, lib = self.dial_bulk(owner)
+        bulk_h, bulk_key, lib, bulk_uds = self.dial_bulk(owner)
+        # shm ring tier: create the segment BEFORE the HELLO that names
+        # it; the server attaches during the handshake and unlinks, so
+        # the /dev/shm entry lives only for this round trip.  Bound to
+        # the socket only on an explicit ack — a refusing/older server
+        # never sees an shm descriptor.
+        shm_h, shm_name, shm_lib = (0, None, None)
+        if self.shm_peer_ok(owner):
+            shm_h, shm_name, shm_lib = self.create_shm_segment()
         hello = {"target_dev": target_dev, "client_dev": client_dev,
                  "pid": self.process_id,
                  # clock-alignment piggyback: our wall at HELLO send;
@@ -679,6 +826,8 @@ class FabricNode:
                  "wall_us": time.time_ns() // 1000}
         if bulk_key:
             hello["bulk_key"] = bulk_key
+        if shm_name:
+            hello["shm_seg"] = shm_name
         t0_mono = time.monotonic_ns()
         try:
             _send_frame(conn, _F_HELLO, json.dumps(hello).encode())
@@ -686,20 +835,28 @@ class FabricNode:
         except OSError:
             # a reset/timeout mid-handshake must not strand the already
             # -registered native bulk conn (fd + reader thread held by
-            # the process-global registry — review finding)
+            # the process-global registry — review finding) nor the
+            # created-but-unattached shm segment
             conn.close()
             if bulk_h:
                 lib.brpc_tpu_fab_conn_close(bulk_h)
+            self.drop_shm_segment(shm_h, shm_name)
             raise
         if fr is None or fr[0] != _F_HELLO_OK:
             msg = fr[1].decode() if fr else "connection closed"
             conn.close()
             if bulk_h:
                 lib.brpc_tpu_fab_conn_close(bulk_h)
+            self.drop_shm_segment(shm_h, shm_name)
             raise ConnectionRefusedError(f"fabric: {msg}")
+        echo = {}
         if fr[1]:
             try:
                 echo = json.loads(fr[1])
+            except ValueError:
+                echo = {}
+        if "wall_us" in echo:
+            try:
                 rtt_us = max(0, (time.monotonic_ns() - t0_mono) // 1000)
                 # +1: a 0 bound would claim perfection no measurement
                 # can prove
@@ -713,7 +870,15 @@ class FabricNode:
         sock = FabricSocket(conn, local_dev=client_dev,
                             remote_dev=target_dev, peer_pid=owner, node=self)
         if bulk_h:
+            sock._bulk_is_uds = bulk_uds
             sock._attach_bulk(lib, bulk_h)
+        if shm_h:
+            if echo.get("shm"):
+                sock._attach_shm(shm_lib, shm_h)
+            else:
+                # server did not ack (older peer, refused, or attach
+                # failed): the segment must not leak
+                self.drop_shm_segment(shm_h, shm_name)
         sock.start_io()
         return sock
 
@@ -925,6 +1090,16 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         "_reestab_wanted": "_bulk_lock",
         "bulk_bytes_sent": "_bulk_lock",
         "bulk_bytes_claimed": "_bulk_lock",
+        "_shm": "_bulk_lock",
+        "_shm_dead": "_bulk_lock",
+        "_shmlib": "_bulk_lock",
+        "_shm_epoch": "_bulk_lock",
+        "_shm_ring_bytes": "_bulk_lock",
+        "_shm_reestab_pending": "_bulk_lock",
+        "_shm_reestab_running": "_bulk_lock",
+        "_shm_reestab_wanted": "_bulk_lock",
+        "shm_bytes_sent": "_bulk_lock",
+        "shm_bytes_claimed": "_bulk_lock",
         "_staged": "_staged_lock",
         "_inbox": "_inbox_lock",
         "_consumed_unacked": "_inbox_lock",
@@ -986,6 +1161,24 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         # already up so it keeps going instead of exiting
         self._reestab_running = False
         self._reestab_wanted = False
+        # shm ring tier (same-host peers; bound only after BOTH ends
+        # acked the segment at handshake).  Shares _bulk_lock: the two
+        # bulk planes' handle swaps commute under one lock and every
+        # writer already holds it on this path.
+        self._shm = 0                          # native shm conn handle
+        self._shm_dead = 0                     # retired ring, claim-only
+        self._shmlib = None
+        self._shm_epoch = 0                    # attachments so far
+        self._shm_ring_bytes = 0               # per-direction capacity
+        self.shm_bytes_sent = 0                # cumulative, across epochs
+        self.shm_bytes_claimed = 0
+        self._bulk_is_uds = False              # route-counter label only
+        self._shm_peer = node.shm_peer_ok(peer_pid)
+        self._shm_reestab_pending: Optional[Tuple] = None  # (lib, h, name)
+        self._shm_reestab_ok = False
+        self._shm_reestab_evt = threading.Event()
+        self._shm_reestab_running = False
+        self._shm_reestab_wanted = False
         # kind-1 transfer-server staging needs the module on BOTH ends:
         # ours to stage, the peer's to pull.  A peer whose jax build
         # lacks jax.experimental.transfer publishes no "xfer" contact —
@@ -1069,6 +1262,11 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         only the bulk plane degrades and revival begins."""
         self._bulk_plane_down("bulk claim failed")
 
+    def shm_plane_failed(self) -> None:
+        """Receiver-side hook (rpc/stream.py): an shm claim failed —
+        same socket-survives contract as bulk_plane_failed."""
+        self._shm_plane_down("shm claim failed")
+
     def _bulk_plane_down(self, reason: str, notify: bool = True) -> None:
         with self._bulk_lock:
             h, self._bulk = self._bulk, 0
@@ -1132,8 +1330,9 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     continue            # re-attached while we slept
             if self.failed or self._peer_gone():
                 continue                # exit via the top-of-loop path
-            h, key, lib = self.node.dial_bulk(self.peer_pid)
+            h, key, lib, is_uds = self.node.dial_bulk(self.peer_pid)
             if h:
+                self._bulk_is_uds = is_uds
                 self._reestab_evt.clear()
                 self._reestab_ok = False
                 with self._bulk_lock:
@@ -1195,6 +1394,240 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             ok = False
         self._reestab_ok = ok and pending is not None
         self._reestab_evt.set()
+
+    # ---- shm ring tier: attach / degrade / revive ----------------------
+    # Mirrors the bulk-plane self-healing above: ring death with a live
+    # control channel degrades to the socket bulk tier (route table),
+    # the peer is told via _F_SHM_DOWN, and the CLIENT side (the end
+    # that created the original segment) re-creates one in the
+    # background, bound through the _F_SHM_REESTABLISH handshake whose
+    # serial control ordering guarantees no kind-5/6 descriptor can
+    # reference the new ring before both ends attached it.
+
+    def _attach_shm(self, lib, handle: int) -> None:
+        """Bind the shm ring pair (0 = no shm tier).  Re-attachment
+        closes any stale handle and bumps the epoch; chaos plans get to
+        poison the fresh ring here."""
+        old = 0
+        ring_bytes = 0
+        if handle:
+            st = (ctypes.c_uint64 * 6)()
+            if lib.brpc_tpu_shm_stats(handle, st, 6) == 6:
+                ring_bytes = int(st[5])
+        with self._bulk_lock:
+            old, self._shm = self._shm, handle
+            self._shmlib = lib
+            if handle:
+                self._shm_epoch += 1
+                self._shm_ring_bytes = ring_bytes
+        if old and lib is not None:
+            lib.brpc_tpu_shm_close(old)
+        if handle:
+            plan = _fi.fabric_active()
+            if plan is not None:
+                plan.on_shm_attach(self, lib, handle)
+
+    def shm_bound(self) -> bool:
+        with self._bulk_lock:
+            return bool(self._shm)
+
+    def shm_epoch(self) -> int:
+        with self._bulk_lock:
+            return self._shm_epoch
+
+    def _shm_alive(self) -> int:
+        """The shm handle when usable, else 0 — death is detected HERE,
+        at a frame boundary, before any descriptor references the ring
+        (the same degradation discipline as _bulk_alive)."""
+        with self._bulk_lock:
+            h, lib = self._shm, self._shmlib
+        if not h:
+            return 0
+        if lib.brpc_tpu_shm_alive(h):
+            return h
+        self._shm_plane_down("shm ring dead at frame boundary")
+        return 0
+
+    def shm_route_usable(self, nbytes: int) -> bool:
+        """Route-table health/capability probe: a live ring the payload
+        is GUARANTEED to fit (an oversize payload skips shm WITHOUT
+        degrading it — the ring is healthy, just small).  The bound is
+        half the ring: a frame over ring/2 can land at a wrap position
+        where remainder + footprint exceeds the ring and never fits no
+        matter how far the consumer drains (the native send returns -3
+        there — kept as the belt under this screen)."""
+        with self._bulk_lock:
+            h, ring = self._shm, self._shm_ring_bytes
+        if not h:
+            return False
+        if ring and nbytes + 48 > ring // 2:
+            return False
+        return bool(self._shm_alive())
+
+    def _shm_plane_down(self, reason: str, notify: bool = True) -> None:
+        with self._bulk_lock:
+            h, self._shm = self._shm, 0
+            lib = self._shmlib
+            old_dead = 0
+            if h:
+                # the retired ring stays CLAIMABLE (marked dead, not
+                # closed): descriptors already flushed — or batched and
+                # about to flush — reference bytes that are PUBLISHED
+                # and parked in it, and the serial control channel may
+                # deliver them to us after the _F_SHM_DOWN that caused
+                # this call.  Closing here would strand those claims
+                # (rc -2) and kill their streams even though every byte
+                # is sitting in the mapping.  Bounded at one retired
+                # ring: a second death closes the first.
+                old_dead, self._shm_dead = self._shm_dead, h
+        if not h:
+            return                      # already degraded / never bound
+        if lib is not None:
+            lib.brpc_tpu_shm_mark_dead(h)
+            if old_dead:
+                lib.brpc_tpu_shm_close(old_dead)
+        log.warning("fabric %s: shm ring down (%s) — socket bulk tier "
+                    "engaged", self.remote_side, reason)
+        if notify and not self._peer_gone():
+            try:
+                self._ctrl_send(_F_SHM_DOWN, b"")
+            except OSError:
+                pass
+        self._kick_shm_reestablish()
+
+    def _kick_shm_reestablish(self) -> None:
+        """Client side only (the end that created the original segment):
+        ensure one re-create loop is running — the same wanted/running
+        single-lock-hold discipline as _kick_bulk_reestablish."""
+        if self.is_server_side or self.failed or self._peer_gone() \
+                or not self._shm_peer:
+            return
+        with self._bulk_lock:
+            self._shm_reestab_wanted = True
+            if self._shm_reestab_running:
+                return           # the live loop will observe `wanted`
+            self._shm_reestab_running = True
+        # fablint: thread-quiesced(self-terminating: exits on attach, socket failure or peer gone; _close_shm sets _shm_reestab_evt to unblock a parked wait)
+        threading.Thread(target=self._shm_reestablish_loop,
+                         name="fabric_shm_revive", daemon=True).start()
+
+    def _shm_reestablish_loop(self) -> None:
+        rng = random.Random(self.id ^ 0x73686D)
+        delay = 0.05
+        while True:
+            if self.failed or self._peer_gone():
+                with self._bulk_lock:
+                    self._shm_reestab_running = False
+                return
+            with self._bulk_lock:
+                if self._shm or not self._shm_reestab_wanted:
+                    self._shm_reestab_wanted = False
+                    self._shm_reestab_running = False
+                    return
+            time.sleep(delay * (1.0 + 0.25 * rng.random()))
+            delay = min(delay * 2, 1.0)
+            with self._bulk_lock:
+                if self._shm:
+                    continue            # re-attached while we slept
+            if self.failed or self._peer_gone():
+                continue                # exit via the top-of-loop path
+            h, name, lib = self.node.create_shm_segment()
+            if h:
+                self._shm_reestab_evt.clear()
+                self._shm_reestab_ok = False
+                with self._bulk_lock:
+                    self._shm_reestab_pending = (lib, h, name)
+                try:
+                    self._ctrl_send(_F_SHM_REESTABLISH,
+                                    json.dumps({"shm_seg": name}).encode())
+                    ok = self._shm_reestab_evt.wait(5.0) \
+                        and self._shm_reestab_ok
+                except OSError:
+                    ok = False
+                if ok:
+                    log.info("fabric %s: shm ring re-established "
+                             "(epoch %d)", self.remote_side,
+                             self.shm_epoch())
+                    continue    # exit via the top-of-loop check
+                with self._bulk_lock:
+                    pending, self._shm_reestab_pending = \
+                        self._shm_reestab_pending, None
+                if pending is not None:
+                    self.node.drop_shm_segment(pending[1], pending[2])
+
+    def _on_shm_reestablish(self, req: dict) -> None:
+        """Server side: attach the fresh segment the client created;
+        runs on the control read loop so the attach is ordered BEFORE
+        any descriptor that will use it."""
+        name = req.get("shm_seg")
+        node = self.node
+        ok = False
+        plan = _fi.fabric_active()
+        if plan is not None and plan.on_shm_handshake(self):
+            pass                                 # refuse deterministically
+        elif name and node._shm_ok and node._shm_lib is not None \
+                and _flags.get_flag("ici_fabric_shm"):
+            h = node._shm_lib.brpc_tpu_shm_attach(name.encode())
+            if h:
+                node._shm_lib.brpc_tpu_shm_unlink(name.encode())
+                self._attach_shm(node._shm_lib, h)
+                ok = True
+        try:
+            self._ctrl_send(_F_SHM_OK if ok else _F_SHM_ERR, b"")
+        except OSError:
+            pass
+
+    def _on_shm_reply(self, ok: bool) -> None:
+        """Client side: _F_SHM_OK/_F_SHM_ERR.  The attach happens HERE
+        on the read loop (descriptor-ordering, same as _on_bulk_reply)."""
+        with self._bulk_lock:
+            pending, self._shm_reestab_pending = \
+                self._shm_reestab_pending, None
+        if ok and pending is not None:
+            self._attach_shm(pending[0], pending[1])
+        elif pending is not None:
+            self.node.drop_shm_segment(pending[1], pending[2])
+            ok = False
+        self._shm_reestab_ok = ok and pending is not None
+        self._shm_reestab_evt.set()
+
+    def _close_shm(self) -> None:
+        """Socket-level teardown of the shm tier (no revival).  Claimed
+        zero-copy views stay readable — the native side defers the unmap
+        until the last release."""
+        with self._bulk_lock:
+            h, self._shm = self._shm, 0
+            dead_h, self._shm_dead = self._shm_dead, 0
+            pending, self._shm_reestab_pending = \
+                self._shm_reestab_pending, None
+            lib = self._shmlib
+        if lib is not None:
+            if h:
+                lib.brpc_tpu_shm_close(h)
+            if dead_h:
+                lib.brpc_tpu_shm_close(dead_h)
+        if pending is not None:
+            self.node.drop_shm_segment(pending[1], pending[2])
+        self._shm_reestab_evt.set()    # unblock a parked revival thread
+
+    def describe_shm(self) -> Optional[dict]:
+        """Ring-tier snapshot for the /ici builtin: byte totals, epoch,
+        occupancy and doorbell waits from the native side."""
+        with self._bulk_lock:
+            h, lib = self._shm, self._shmlib
+            out = {"epoch": self._shm_epoch,
+                   "bytes_sent": self.shm_bytes_sent,
+                   "bytes_claimed": self.shm_bytes_claimed,
+                   "ring_bytes": self._shm_ring_bytes}
+        if not h and not out["epoch"]:
+            return None
+        if h and lib is not None:
+            st = (ctypes.c_uint64 * 6)()
+            if lib.brpc_tpu_shm_stats(h, st, 6) == 6:
+                out.update({"tx_occupancy": int(st[2]),
+                            "rx_occupancy": int(st[3]),
+                            "doorbell_waits": int(st[4])})
+        return out
 
     # ---- device plane (kind-4 sequenced transfers) ---------------------
     def _dplane_usable(self, nbytes: int) -> bool:
@@ -1392,22 +1825,23 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         return n
 
     def _encode_data(self, frame: IOBuf) -> bytes:
-        """Serialize a frame: host refs inline, DEVICE refs out-of-band —
-        over the native bulk plane when bound (kind 2; synchronous-send
-        custody: the source block is reusable the moment fab_send
-        returns), else staged on the transfer server for a peer pull
-        (kind 1; pinned until the PULLED ack).  Large host blobs also
-        ride the bulk plane (kind 3) to skip the inline join+copy.
+        """Serialize a frame: host refs inline, DEVICE refs out-of-band.
+        Byte-mover selection goes through the route table (ici/route.py
+        — payload class × size × peer capability × plane health):
+        same-host pairs prefer the shm ring (kind 5 device / kind 6
+        host; one copy into shared memory, zero-copy claim), then the
+        socket bulk conn (kind 2/3; synchronous-send custody), then
+        transfer-server staging for device payloads (kind 1; pinned
+        until the PULLED ack), then inline (kind 0).
 
-        Degradation: every bulk use is gated on _bulk_alive() and a
-        failed bulk send falls back to the inline/transfer-server path
-        WITHIN the same frame — nothing bulk-bound is committed to the
-        control stream until its bytes are already on the bulk conn, so
-        a dying bulk plane can never strand an attachment descriptor."""
+        Degradation: every fast-plane use is health-gated and a failed
+        send falls through to the NEXT route WITHIN the same frame —
+        nothing is committed to the control stream until its bytes are
+        already with a transport, so a dying plane can never strand a
+        descriptor."""
         out = [b""]
         nchunks = 0
         pending_host: List[bytes] = []
-        bulk_host_min = _flags.get_flag("ici_fabric_bulk_host_min")
 
         def flush_host():
             nonlocal nchunks
@@ -1416,16 +1850,34 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             blob = b"".join(pending_host)
             pending_host.clear()
             nchunks += 1
-            if len(blob) >= bulk_host_min and self._bulk_alive():
-                uuid = self.node.next_uuid()
-                try:
-                    self._bulk_send(uuid, blob)
-                    out.append(struct.pack("<BQQ", 3, uuid, len(blob)))
+            for rt in _route.candidates(self, _route.HOST, len(blob)):
+                if rt == _route.SHM:
+                    uuid = self.node.next_uuid()
+                    try:
+                        self._shm_send(uuid, blob)
+                    except _ShmOversize:
+                        continue
+                    except ConnectionError:
+                        self._shm_plane_down("shm send failed mid-encode")
+                        continue
+                    out.append(struct.pack("<BQQ", 6, uuid, len(blob)))
+                    _route.record(self, rt, len(blob))
                     return
-                except ConnectionError:
-                    self._bulk_plane_down("bulk send failed mid-encode")
+                if rt == _route.BULK:
+                    uuid = self.node.next_uuid()
+                    try:
+                        self._bulk_send(uuid, blob)
+                    except ConnectionError:
+                        self._bulk_plane_down(
+                            "bulk send failed mid-encode")
+                        continue
+                    out.append(struct.pack("<BQQ", 3, uuid, len(blob)))
+                    _route.record(self, rt, len(blob))
+                    return
+                break                              # INLINE
             out.append(struct.pack("<BI", 0, len(blob)))
             out.append(blob)
+            _route.record(self, _route.INLINE, len(blob))
 
         for i in range(frame.backing_block_num()):
             r = frame.backing_block(i)
@@ -1482,42 +1934,65 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                         self.dplane_bytes_sent += r.length
                     except _dp.DevicePlaneError as e:
                         self._device_plane_down(str(e))
-            if kind == 0 and self._bulk_alive():
-                # device -> host staging (on CPU backends a zero-copy
-                # view; on TPU the D2H leg of a host-staged fabric)
-                import numpy as np
-                np_arr = np.asarray(arr)
-                if not np_arr.flags["C_CONTIGUOUS"]:
-                    np_arr = np.ascontiguousarray(np_arr)
-                uuid = self.node.next_uuid()
-                try:
-                    self._bulk_send(uuid, np_arr)
-                    kind = 2
-                except ConnectionError:
-                    self._bulk_plane_down("bulk send failed mid-encode")
-                if kind == 2:
-                    cb = getattr(r.block, "on_send_complete", None)
-                    if cb is not None:
+            if kind == 0:
+                for rt in _route.candidates(self, _route.DEVICE,
+                                            r.length):
+                    if rt in (_route.SHM, _route.BULK):
+                        # device -> host staging (on CPU backends a
+                        # zero-copy view; on TPU the D2H leg of a
+                        # host-staged fabric)
+                        import numpy as np
+                        np_arr = np.asarray(arr)
+                        if not np_arr.flags["C_CONTIGUOUS"]:
+                            np_arr = np.ascontiguousarray(np_arr)
+                        uuid = self.node.next_uuid()
                         try:
-                            cb()
-                        except Exception:
-                            pass
-            if kind == 0 and self._xfer_usable:
-                if not hasattr(arr, "devices"):
-                    # forwarding a host-delivered numpy over an
-                    # xfer-mode socket: the transfer server stages
-                    # jax arrays only — detach into an owned copy
-                    # (aliasing a ctypes-backed view is unsafe)
-                    import jax
-                    import numpy as np
-                    arr = jax.device_put(
-                        np.array(arr, copy=True),
-                        jax.devices()[self.local_dev])
-                uuid = self.node.next_uuid()
-                self.node.stage(uuid, [arr])
-                with self._staged_lock:
-                    self._staged[uuid] = (r.block, arr)
-                kind = 1
+                            if rt == _route.SHM:
+                                self._shm_send(uuid, np_arr)
+                                kind = 5
+                            else:
+                                self._bulk_send(uuid, np_arr)
+                                kind = 2
+                        except _ShmOversize:
+                            continue
+                        except ConnectionError:
+                            if rt == _route.SHM:
+                                self._shm_plane_down(
+                                    "shm send failed mid-encode")
+                            else:
+                                self._bulk_plane_down(
+                                    "bulk send failed mid-encode")
+                            continue
+                        _route.record(self, rt, r.length)
+                        # synchronous-send custody: the kernel/ring owns
+                        # a copy, the source block is reusable now
+                        cb = getattr(r.block, "on_send_complete", None)
+                        if cb is not None:
+                            try:
+                                cb()
+                            except Exception:
+                                pass
+                        break
+                    if rt == _route.XFER:
+                        if not hasattr(arr, "devices"):
+                            # forwarding a host-delivered numpy over an
+                            # xfer-mode socket: the transfer server
+                            # stages jax arrays only — detach into an
+                            # owned copy (aliasing a ctypes-backed view
+                            # is unsafe)
+                            import jax
+                            import numpy as np
+                            arr = jax.device_put(
+                                np.array(arr, copy=True),
+                                jax.devices()[self.local_dev])
+                        uuid = self.node.next_uuid()
+                        self.node.stage(uuid, [arr])
+                        with self._staged_lock:
+                            self._staged[uuid] = (r.block, arr)
+                        kind = 1
+                        _route.record(self, rt, r.length)
+                        break
+                    break                          # INLINE
             if kind == 0:
                 # neither fast plane: the device payload crosses as plain
                 # host bytes on the control channel (d2h here, h2d on
@@ -1570,6 +2045,33 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             # cumulative counter; unguarded += lost updates (fablint)
             self.bulk_bytes_sent += n
 
+    def _shm_send(self, uuid: int, data) -> None:
+        """Blocking shm ring send (the GIL is dropped for the native
+        copy; a full ring parks on the futex doorbell).  ``data``:
+        bytes or a C-contiguous numpy array.  Raises _ShmOversize when
+        the frame can never fit the ring (route elsewhere; the ring is
+        healthy) and ConnectionError on death/timeout (degrade)."""
+        if isinstance(data, (bytes, bytearray)):
+            ptr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
+                if isinstance(data, bytearray) else \
+                ctypes.cast(data, _u8p)
+            n = len(data)
+        else:
+            ptr = data.ctypes.data_as(_u8p)
+            n = data.nbytes
+        with self._bulk_lock:
+            h, lib = self._shm, self._shmlib
+        timeout_us = int(
+            _flags.get_flag("ici_shm_send_timeout_s") * 1e6)
+        rc = lib.brpc_tpu_shm_send(h, uuid, ptr, n, timeout_us) \
+            if h else -1
+        if rc == -3:
+            raise _ShmOversize()
+        if rc != 0:
+            raise ConnectionError("fabric shm ring closed")
+        with self._bulk_lock:
+            self.shm_bytes_sent += n
+
     # ---- stream fast plane ---------------------------------------------
     # Stream DATA frames above ici_stream_bulk_threshold post their
     # payload here (rpc/stream.py): bytes ride the dedicated bulk
@@ -1579,21 +2081,29 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
     # (the claimed IOBuf wraps the native receive buffer) — the same
     # contract as the kind-2/3 attachment path above.
 
+    def stream_fast_begin(self, nbytes: int) -> Tuple[int, Optional[str]]:
+        """Route one stream DATA frame of ``nbytes``: (uuid, route) with
+        route "shm"/"bulk", or (0, None) to keep the inline path.  The
+        liveness check here is what lets a stream survive plane death: a
+        dead plane is detected BEFORE the descriptor goes out, so the
+        frame — and every later one until revival — rides the next tier
+        instead."""
+        for rt in _route.candidates(self, _route.STREAM, nbytes):
+            if rt == _route.SHM or rt == _route.BULK:
+                return self.node.next_uuid(), rt
+            break
+        return 0, None
+
     def stream_bulk_begin(self) -> int:
-        """Reserve a bulk uuid for one stream DATA frame; 0 when no
-        usable bulk plane is bound (the caller keeps the inline path).
-        The liveness check here is what lets a stream survive bulk
-        death: a dead plane is detected BEFORE the descriptor goes out,
-        so the frame — and every later one until revival — rides the
-        inline wire path instead."""
+        """Legacy single-plane reservation (bulk only); kept for callers
+        that pin the socket bulk tier explicitly."""
         if not self._bulk_alive():
             return 0
         return self.node.next_uuid()
 
-    def stream_bulk_send(self, uuid: int, frame: IOBuf) -> None:
-        """Gather-send the frame's blocks as ONE uuid-tagged bulk frame,
-        zero-copy: block buffers are handed to writev as-is (fab_sendv
-        drops the GIL; synchronous-send custody)."""
+    def _gather_blocks(self, frame: IOBuf):
+        """(ptrs, lens, n, total, keep) for a gather send — keep pins
+        the block buffers until the native call returns."""
         import numpy as np
         nblocks = frame.backing_block_num()
         ptrs = (ctypes.c_void_p * nblocks)()
@@ -1612,9 +2122,45 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             lens[n] = r.length
             total += r.length
             n += 1
+        return ptrs, lens, n, total, keep
+
+    def stream_fast_send(self, route: str, uuid: int,
+                         frame: IOBuf) -> None:
+        """Gather-send the frame's blocks as ONE uuid-tagged frame on
+        the chosen plane, zero-copy hand-off (the native call drops the
+        GIL; synchronous-send custody either way)."""
+        if route == _route.SHM:
+            ptrs, lens, n, total, keep = self._gather_blocks(frame)
+            with self._bulk_lock:
+                h, lib = self._shm, self._shmlib
+            timeout_us = int(
+                _flags.get_flag("ici_shm_send_timeout_s") * 1e6)
+            rc = lib.brpc_tpu_shm_sendv(h, uuid, ptrs, lens, n,
+                                        timeout_us) if h else -1
+            del keep
+            if rc != 0:
+                # descriptor already on the control channel: the peer's
+                # claim fails and closes THAT stream; the socket only
+                # degrades (rc -3 cannot happen: stream_fast_begin
+                # screened the frame against the ring capacity)
+                self._shm_plane_down("shm sendv failed")
+                raise ConnectionError("fabric shm ring closed")
+            with self._bulk_lock:
+                self.shm_bytes_sent += total
+            _route.record(self, _route.SHM, total)
+            return
+        self.stream_bulk_send(uuid, frame)
+        _route.record(self, _route.BULK, len(frame))
+
+    def stream_bulk_send(self, uuid: int, frame: IOBuf) -> None:
+        """Gather-send the frame's blocks as ONE uuid-tagged bulk frame,
+        zero-copy: block buffers are handed to writev as-is (fab_sendv
+        drops the GIL; synchronous-send custody)."""
+        ptrs, lens, n, total, keep = self._gather_blocks(frame)
         with self._bulk_lock:
             h, lib = self._bulk, self._blib
         rc = lib.brpc_tpu_fab_sendv(h, uuid, ptrs, lens, n) if h else -1
+        del keep
         if rc != 0:
             # the descriptor for this frame is already on the control
             # channel: the peer's claim will fail and close THAT stream
@@ -1624,13 +2170,19 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         with self._bulk_lock:
             self.bulk_bytes_sent += total
 
+    def stream_fast_abort(self, route: Optional[str]) -> None:
+        """Sever the plane a descriptor went out on whose payload never
+        will (sender-side Python failure): the peer's pending claim must
+        fail promptly, not sit out the full claim timeout.  The failed
+        claim closes the affected STREAM on the peer; the socket
+        survives and the plane re-establishes in the background."""
+        if route == _route.SHM:
+            self._shm_plane_down("stream shm abort")
+        else:
+            self._bulk_plane_down("stream bulk abort")
+
     def stream_bulk_abort(self) -> None:
-        """Sever the bulk plane after a descriptor went out whose payload
-        never will (sender-side Python failure): the peer's pending claim
-        must fail promptly, not sit out the full claim timeout.  The
-        failed claim closes the affected STREAM on the peer; the socket
-        survives and the bulk plane re-establishes in the background."""
-        self._bulk_plane_down("stream bulk abort")
+        self.stream_fast_abort(_route.BULK)
 
     def stream_bulk_claim(self, uuid: int, length: int) -> IOBuf:
         """Claim a stream DATA frame's bulk bytes as a zero-copy IOBuf:
@@ -1640,6 +2192,15 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         buf.append_user_data(memoryview(self._claim_zero_copy(uuid, length)))
         with self._bulk_lock:
             self.bulk_bytes_claimed += length
+        return buf
+
+    def stream_shm_claim(self, uuid: int, length: int) -> IOBuf:
+        """Claim a stream DATA frame's shm bytes as a zero-copy IOBuf:
+        the USER block wraps the ring slot itself — released (ring
+        credit returned) when the last ref dies (_ShmBufOwner)."""
+        buf = IOBuf()
+        buf.append_user_data(
+            memoryview(self._shm_claim_zero_copy(uuid, length)))
         return buf
 
     def _claim_zero_copy(self, uuid: int, expect_len: int):
@@ -1658,7 +2219,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         # the owner pins the HANDLE the claim was served from: after a
         # degrade/re-attach, releasing against a closed handle falls
         # back to free() in the native layer — never a leak
-        ca._owner = _NativeBufOwner(lib, h, ptr, n)
+        ca._owner = _NativeBufOwner(lib.brpc_tpu_fab_buf_release, h, ptr, n)
         return ca
 
     # ---- read path -----------------------------------------------------
@@ -1690,6 +2251,15 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     self._on_bulk_reply(True)
                 elif ftype == _F_BULK_ERR:
                     self._on_bulk_reply(False)
+                elif ftype == _F_SHM_DOWN:
+                    self._shm_plane_down("peer reported shm death",
+                                         notify=False)
+                elif ftype == _F_SHM_REESTABLISH:
+                    self._on_shm_reestablish(json.loads(body))
+                elif ftype == _F_SHM_OK:
+                    self._on_shm_reply(True)
+                elif ftype == _F_SHM_ERR:
+                    self._on_shm_reply(False)
                 elif ftype == _F_GOODBYE:
                     self._on_goodbye()
                 elif ftype == _F_DPLANE_SEQ:
@@ -1724,6 +2294,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._wake_window()
         self._flush_staged()
         self._close_bulk()
+        self._close_shm()
         self._close_dplane()
 
         def commit_eof():
@@ -1777,6 +2348,10 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 uuid, blen = struct.unpack_from("<QQ", body, off)
                 off += 16
                 parts.append(self._bulk_claim_bytes(uuid, blen))
+            elif kind == 6:
+                uuid, blen = struct.unpack_from("<QQ", body, off)
+                off += 16
+                parts.append(self._shm_claim_bytes(uuid, blen))
             else:
                 uuid, dtlen = struct.unpack_from("<QH", body, off)
                 off += 10
@@ -1812,9 +2387,10 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     parts.append(t)
                     waits.append(t)
                     continue
-                if kind == 2:
-                    arr = self._bulk_claim_array(uuid, dt, shape, length,
-                                                 local_device)
+                if kind in (2, 5):
+                    claim = self._bulk_claim_array if kind == 2 \
+                        else self._shm_claim_array
+                    arr = claim(uuid, dt, shape, length, local_device)
                     # host-delivered numpy is resident by construction —
                     # only genuine device arrays gate ordered delivery
                     # on the device waiter
@@ -1935,6 +2511,84 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         del host, ca                  # last refs: owner releases the buffer
         return jax.device_put(np_arr, local_device)
 
+    # ---- shm ring claims (kinds 5/6 + FRAME_DATA_SHM) -------------------
+    def _shm_claim(self, uuid: int):
+        """(ptr, len, handle, lib) for one shm frame — the zero-copy
+        twin of _bulk_claim, same skew-tolerant timeout, same release-
+        against-the-served-handle custody rule.
+
+        The RETIRED ring (if a degrade left one behind) is consulted
+        FIRST: descriptors flushed around a plane death reference bytes
+        published there, and asking a dead ring is instantaneous either
+        way — parked frames return at once, missing ones fail -2
+        without a wait.  Only then does the live ring get the full
+        skew-tolerant timeout."""
+        with self._bulk_lock:
+            h, dead_h, lib = self._shm, self._shm_dead, self._shmlib
+        out, olen = _u8p(), ctypes.c_uint64()
+        if dead_h:
+            rc = lib.brpc_tpu_shm_recv(
+                dead_h, uuid, 0, ctypes.byref(out), ctypes.byref(olen))
+            if rc == 0:
+                return out, olen.value, dead_h, lib
+        timeout_us = int(
+            _flags.get_flag("ici_bulk_claim_timeout_s") * 1e6)
+        rc = lib.brpc_tpu_shm_recv(
+            h, uuid, timeout_us,
+            ctypes.byref(out), ctypes.byref(olen)) if h else -2
+        if rc != 0:
+            raise ConnectionError(
+                f"fabric shm frame {uuid:#x} unclaimable (rc {rc})")
+        return out, olen.value, h, lib
+
+    def _shm_claim_zero_copy(self, uuid: int, expect_len: int):
+        """Claim an shm frame as a ctypes array WRAPPING the ring slot
+        — zero receiver copies; the slot is retired (ring credit
+        returned) when the last view dies (_ShmBufOwner)."""
+        ptr, n, h, lib = self._shm_claim(uuid)
+        if n != expect_len:
+            lib.brpc_tpu_shm_release(h, ptr, n)
+            raise ConnectionError(
+                f"shm frame {uuid:#x}: {n} bytes, descriptor "
+                f"said {expect_len}")
+        ca = (ctypes.c_uint8 * n).from_address(
+            ctypes.addressof(ptr.contents))
+        ca._owner = _ShmBufOwner(lib, h, ptr, n)
+        with self._bulk_lock:
+            self.shm_bytes_claimed += n
+        return ca
+
+    def _shm_claim_bytes(self, uuid: int, expect_len: int) -> bytes:
+        """Kind-6 host blobs: one owned copy off the ring (the blob is
+        protocol bytes the parser consumes), slot retired immediately."""
+        ptr, n, h, lib = self._shm_claim(uuid)
+        try:
+            if n != expect_len:
+                raise ConnectionError(
+                    f"shm frame {uuid:#x}: {n} bytes, descriptor "
+                    f"said {expect_len}")
+            with self._bulk_lock:
+                self.shm_bytes_claimed += n
+            return ctypes.string_at(ptr, n)
+        finally:
+            lib.brpc_tpu_shm_release(h, ptr, n)
+
+    def _shm_claim_array(self, uuid: int, dt: str, shape, length: int,
+                         local_device):
+        """Kind-5 device payload: same delivery semantics as the kind-2
+        bulk claim (_bulk_claim_array), zero-copy host-resident by
+        default with the release chained through numpy's base."""
+        import numpy as np
+        ca = self._shm_claim_zero_copy(uuid, length)
+        host = np.frombuffer(ca, dtype=np.uint8).view(
+            np.dtype(dt)).reshape(shape)
+        if _flags.get_flag("ici_fabric_host_delivery"):
+            return host
+        import jax
+        np_arr = host.copy()          # the owned copy device_put may alias
+        del host, ca                  # last refs: owner releases the slot
+        return jax.device_put(np_arr, local_device)
+
     def _on_pulled(self, uuid: int) -> None:
         with self._staged_lock:
             entry = self._staged.pop(uuid, None)
@@ -2013,6 +2667,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._wake_window()
         self._flush_staged()
         self._close_bulk()
+        self._close_shm()
         self._close_dplane()
 
     def _close_bulk(self) -> None:

@@ -319,13 +319,31 @@ def _ici(server, q):
                                   for pid, st in pairs.items()}
         from ..socket import list_sockets
         seqs = {}
+        shms = {}
         for s in list_sockets():
             if isinstance(s, FabricSocket):
                 d = s.describe_dplane_sequencer()
                 if d is not None:
                     seqs[str(s.remote_side)] = d
+                sh = s.describe_shm()
+                if sh is not None:
+                    shms[str(s.remote_side)] = sh
         if seqs:
             out["dplane_sequencers"] = seqs
+        if shms:
+            # per-pair shm ring tier: byte totals, epoch, live ring
+            # occupancy and doorbell waits
+            out["shm_planes"] = shms
+    except Exception:
+        pass
+    try:
+        # per-route byte-mover counters (ici/route.py): which plane
+        # carried how many frames/bytes — shm / uds / tcp / xfer /
+        # dplane / inline
+        from ...ici.route import route_stats
+        rs = route_stats()
+        if rs:
+            out["routes"] = rs
     except Exception:
         pass
     try:

@@ -17,6 +17,7 @@ from .controller import Controller
 from .input_messenger import InputMessenger
 from . import loopback as _loopback
 from .protocol import find_protocol
+from . import request_context as _reqctx
 from .socket_map import SocketMap
 from .span import end_client_span, maybe_start_client_span
 
@@ -158,6 +159,34 @@ class Channel:
                     request: Any, response_cls: Any = None,
                     done: Optional[Callable[[Controller], None]] = None):
         """Sync when done is None (returns the response); async otherwise."""
+        # cascading inbound context (rpc/request_context.py): a call made
+        # inside a handler's scope inherits the inbound priority/tenant
+        # unless THIS call overrides them, and its timeout is capped at
+        # the inbound deadline budget minus the handler time already
+        # spent.  Inherited values beat channel-wide defaults (a static
+        # channel config must not demote a critical inbound request).
+        _ctx = _reqctx.current()
+        if _ctx is not None:
+            if cntl.priority is None and _ctx.priority is not None:
+                cntl.priority = _ctx.priority
+            if not cntl.tenant and _ctx.tenant:
+                cntl.tenant = _ctx.tenant
+            residual = _ctx.residual_deadline_ms()
+            if residual is not None:
+                if residual <= 0:
+                    cntl.set_failed(
+                        errors.ERPCTIMEDOUT,
+                        "inherited deadline budget spent before call")
+                    if cntl.span is not None:
+                        end_client_span(cntl)
+                    if done is not None:
+                        done(cntl)
+                        return None
+                    return None
+                base = cntl.timeout_ms if cntl.timeout_ms is not None \
+                    else self.options.timeout_ms
+                if base is None or base <= 0 or base > residual:
+                    cntl.timeout_ms = max(int(residual), 1)
         # channel-level admission defaults (per-call Controller wins)
         if cntl.priority is None and self.options.priority is not None:
             cntl.priority = self.options.priority

@@ -101,7 +101,8 @@ class inject:
 
 # ---- fabric chaos plans -------------------------------------------------
 
-# native chaos modes (native/fabric.cpp brpc_tpu_fab_chaos)
+# native chaos modes (native/fabric.cpp brpc_tpu_fab_chaos; the shm
+# twin brpc_tpu_shm_chaos shares the numbering, DELAY excepted)
 CHAOS_CLEAR = 0
 CHAOS_SEVER_AFTER_OUT_BYTES = 1
 CHAOS_DROP_FRAMES = 2
@@ -143,6 +144,20 @@ class FabricFaultPlan:
                                   post_send WRs (before any descriptor
                                   exists) — forces the device plane to
                                   degrade to the bulk/inline fallback
+      shm_kill_now                mark the shm ring segment dead the
+                                  moment it is (re)attached — the
+                                  "segment killed" fault; descriptors
+                                  fall back to the socket bulk tier
+      shm_sever_after_bytes       native watermark: the ring write that
+                                  crosses it copies a PARTIAL slot and
+                                  dies without publishing — the
+                                  producer-crash-mid-slot shape
+      shm_drop_frames             native: next N ring frames vanish at
+                                  the receiver's scan (descriptor
+                                  arrives, claim never satisfied)
+      refuse_shm_handshakes       refuse the next N shm attach
+                                  handshakes (HELLO piggyback or
+                                  _F_SHM_REESTABLISH)
 
     ``injected`` counts what actually fired, keyed by knob name."""
 
@@ -157,7 +172,11 @@ class FabricFaultPlan:
                  bulk_delay_park_ms: int = 0,
                  refuse_bulk_handshakes: int = 0,
                  refuse_hellos: int = 0,
-                 device_plane_fail_posts: int = 0):
+                 device_plane_fail_posts: int = 0,
+                 shm_kill_now: bool = False,
+                 shm_sever_after_bytes: int = 0,
+                 shm_drop_frames: int = 0,
+                 refuse_shm_handshakes: int = 0):
         self.match = match
         self.control_sever_after_frames = control_sever_after_frames
         self.control_drop_ratio = control_drop_ratio
@@ -169,13 +188,18 @@ class FabricFaultPlan:
         self._refuse_bulk = refuse_bulk_handshakes
         self._refuse_hellos = refuse_hellos
         self._fail_device_posts = device_plane_fail_posts
+        self.shm_kill_now = shm_kill_now
+        self.shm_sever_after_bytes = shm_sever_after_bytes
+        self.shm_drop_frames = shm_drop_frames
+        self._refuse_shm = refuse_shm_handshakes
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._ctrl_out = 0           # outbound control frames seen
         self._ctrl_in = 0            # inbound control frames seen
         self.injected = {"control_sever": 0, "control_drop": 0,
                          "bulk_chaos": 0, "refuse_bulk": 0,
-                         "refuse_hello": 0, "die": 0, "device_plane": 0}
+                         "refuse_hello": 0, "die": 0, "device_plane": 0,
+                         "shm_chaos": 0, "refuse_shm": 0}
 
     def _matches(self, socket) -> bool:
         return self.match is None or bool(self.match(socket))
@@ -236,7 +260,39 @@ class FabricFaultPlan:
             with self._lock:
                 self.injected["bulk_chaos"] += 1
 
+    def on_shm_attach(self, socket, lib, handle: int) -> None:
+        """Applies the native shm chaos knobs to a just-attached ring."""
+        if not handle or lib is None or not self._matches(socket):
+            return
+        fired = False
+        if self.shm_sever_after_bytes:
+            lib.brpc_tpu_shm_chaos(handle, CHAOS_SEVER_AFTER_OUT_BYTES,
+                                   self.shm_sever_after_bytes)
+            fired = True
+        if self.shm_drop_frames:
+            lib.brpc_tpu_shm_chaos(handle, CHAOS_DROP_FRAMES,
+                                   self.shm_drop_frames)
+            fired = True
+        if self.shm_kill_now:
+            lib.brpc_tpu_shm_chaos(handle, CHAOS_SEVER_NOW, 0)
+            fired = True
+        if fired:
+            with self._lock:
+                self.injected["shm_chaos"] += 1
+
     # -- handshake hooks -------------------------------------------------
+    def on_shm_handshake(self, socket=None) -> bool:
+        """True → refuse this shm segment attach (HELLO piggyback or
+        re-establishment)."""
+        if socket is not None and not self._matches(socket):
+            return False
+        with self._lock:
+            if self._refuse_shm > 0:
+                self._refuse_shm -= 1
+                self.injected["refuse_shm"] += 1
+                return True
+        return False
+
     def on_bulk_handshake(self, socket=None) -> bool:
         """True → refuse this bulk (re)establishment handshake."""
         if socket is not None and not self._matches(socket):

@@ -284,6 +284,16 @@ def _serve(server, full_name: str, client_cntl: Controller,
     tms = client_cntl.timeout_ms
     if tms and tms > 0:
         cntl.method_deadline = time.monotonic() + tms / 1000.0
+    # admission-metadata propagation is in-process: the caller's
+    # controller IS the carrier (no wire decode).  Copied for EVERY
+    # call, not just under an admission controller — handlers read
+    # cntl.priority/tenant/deadline_left_ms on all planes, and the
+    # cascading request context (rpc/request_context.py) inherits from
+    # these fields
+    cntl.priority = client_cntl.priority
+    cntl.tenant = client_cntl.tenant
+    if tms and tms > 0:
+        cntl.deadline_left_ms = int(tms)
 
     def bail(code: int, text: str, status=None, counted=False,
              retry_after: int = 0) -> None:
@@ -315,12 +325,6 @@ def _serve(server, full_name: str, client_cntl: Controller,
                  else errors.ENOSERVICE, f"no method {full_name}")
             return
         status = server.method_status(full_name)
-        # propagation is in-process: the caller's controller IS the
-        # metadata carrier (no wire decode)
-        cntl.priority = client_cntl.priority
-        cntl.tenant = client_cntl.tenant
-        if tms and tms > 0:
-            cntl.deadline_left_ms = int(tms)
         from . import admission as admission_mod
         adm.submit(
             priority=client_cntl.priority, tenant=client_cntl.tenant,

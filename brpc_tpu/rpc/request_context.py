@@ -1,0 +1,88 @@
+"""Cascading request context: a handler's outbound calls inherit the
+inbound request's admission metadata by default.
+
+PR-9 propagated ``priority`` / ``tenant`` / ``deadline_left_ms`` on the
+wire, but a SERVICE that fans out (the proxy/orchestrator shape —
+router → prefill → decode) re-originated every outbound call with
+channel defaults: a critical-band inbound request could spawn
+default-band sub-calls that the downstream's admission controller sheds
+first, and a nearly-spent deadline budget silently reset to the full
+channel timeout at each hop (the runaway-work shape deadline
+propagation exists to kill).
+
+The fix is a thread-scoped inbound context installed around the
+handler's synchronous body (``MethodDescriptor.invoke``) and consulted
+by ``Channel.call_method``:
+
+  * ``priority`` / ``tenant``: inherited unless the CALL overrides them
+    (an explicit ``cntl.priority``/``cntl.tenant`` wins; the inherited
+    value beats channel-wide ``ChannelOptions`` defaults — a static
+    channel config must not demote a critical inbound request).
+  * deadline: the outbound budget is capped at the inbound budget MINUS
+    the time this handler already spent (monotonic, measured from
+    handler entry) — the decrement-at-each-hop contract.  A spent
+    budget fails the call immediately with ERPCTIMEDOUT instead of
+    dispatching work the caller can no longer use.
+
+Scope: the handler's synchronous body and everything it calls on the
+same thread.  Work handed to other threads/tasklets re-originates (no
+ambient context) — explicit propagation there is the caller's choice.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+_tls = threading.local()
+
+
+class InboundContext:
+    """Immutable snapshot of one inbound request's admission metadata,
+    anchored at handler entry for deadline decrement."""
+
+    __slots__ = ("priority", "tenant", "deadline_left_ms", "entry_mono")
+
+    def __init__(self, priority: Optional[int], tenant: str,
+                 deadline_left_ms: int):
+        self.priority = priority
+        self.tenant = tenant
+        self.deadline_left_ms = deadline_left_ms
+        self.entry_mono = time.monotonic()
+
+    def residual_deadline_ms(self) -> Optional[float]:
+        """Inbound budget minus handler time already spent; None when
+        the inbound request carried no budget."""
+        if not self.deadline_left_ms:
+            return None
+        spent_ms = (time.monotonic() - self.entry_mono) * 1000.0
+        return self.deadline_left_ms - spent_ms
+
+
+def current() -> Optional[InboundContext]:
+    return getattr(_tls, "ctx", None)
+
+
+class scope:
+    """Install the inbound context for a handler invocation; restores
+    the previous one on exit (nested inline dispatch — a loopback call
+    inside a handler — sees ITS request's context, then the outer one
+    again)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, cntl):
+        pri = getattr(cntl, "priority", None)
+        ten = getattr(cntl, "tenant", "") or ""
+        ddl = getattr(cntl, "deadline_left_ms", 0) or 0
+        self._ctx = (InboundContext(pri, ten, int(ddl))
+                     if pri is not None or ten or ddl else None)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self._ctx
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _tls.ctx = self._prev

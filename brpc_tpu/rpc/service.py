@@ -47,14 +47,21 @@ class MethodDescriptor:
         completion point every wire protocol shares.  After ``done``
         returns the controller may be reset and reused by another
         request, so handlers must not touch it past their ``done()``
-        call (the reference's Closure contract)."""
+        call (the reference's Closure contract).
+
+        The handler's synchronous body runs under the inbound request's
+        cascading context (rpc/request_context.py): outbound calls it
+        makes inherit priority/tenant and the decremented deadline
+        budget by default."""
         def wrapped_done(*args, **kwargs):
             try:
                 return done(*args, **kwargs)
             finally:
                 cntl._release_session_data()
                 cntl._maybe_recycle()
-        self.fn(cntl, request, response, wrapped_done)
+        from . import request_context as _reqctx
+        with _reqctx.scope(cntl):
+            self.fn(cntl, request, response, wrapped_done)
 
 
 class Service:

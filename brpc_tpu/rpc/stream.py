@@ -40,6 +40,22 @@ FRAME_CLOSE = 3
 # bytes move out-of-band on the dedicated bulk connection
 # (native/fabric.cpp).  frame_type 4 is the tpu_std stream handshake.
 FRAME_DATA_BULK = 5
+# DATA whose payload rode the same-host SHM RING tier: identical
+# 16-byte descriptor, bytes move through the mmap'd ring (one sender
+# copy, zero-copy claim, no syscalls).  Which plane a frame rode is
+# explicit in the frame type because the route can change mid-stream
+# (plane death falls back tier by tier).
+FRAME_DATA_SHM = 6
+# N shm DATA frames announced by ONE control frame: the body is a
+# CONCATENATION of 16-byte descriptors, in stream order.  On the ring
+# tier the bytes are PUBLISHED before their descriptor is even queued
+# (a memcpy, not a drained writev), so descriptors can coalesce without
+# delaying any byte — and the per-frame control cost (RpcMeta pack +
+# socket write on the sender, recv + protobuf parse + dispatch on the
+# receiver) amortizes across the batch.  Measured: the 256KB-chunk
+# cross-process stream tier is CONTROL-bound, not byte-bound, once the
+# ring removes the copies.
+FRAME_DATA_SHM_BATCH = 7
 
 _BULK_DESC = struct.Struct("<QQ")
 
@@ -53,6 +69,22 @@ DEFAULT_MAX_BUF_SIZE = 2 * 1024 * 1024
 _flags.define_flag("ici_stream_bulk_threshold", 64 * 1024,
                    "min stream DATA frame bytes routed over the fabric "
                    "bulk plane", _flags.positive_integer)
+# Descriptor coalescing on the shm ring route: up to this many DATA
+# frames share one control frame (1 = a descriptor per frame, the bulk
+# tier's behavior).  Pending descriptors flush when the batch fills,
+# when any OTHER frame must go out on the stream (ordering), before the
+# writer parks on a full window (the receiver cannot return credits for
+# frames it has not been told about), and after a short linger so a
+# bursty-then-idle writer never strands a tail.  The effective batch is
+# also bounded by the stream window (window-full forces a flush), so 32
+# in practice means "amortize control across the in-flight window";
+# latency-sensitive streams are bounded by the linger, not the batch.
+_flags.define_flag("ici_stream_desc_batch", 32,
+                   "max shm stream DATA descriptors coalesced into one "
+                   "control frame", _flags.positive_integer)
+_flags.define_flag("ici_stream_desc_flush_us", 1000,
+                   "linger before a partial shm descriptor batch is "
+                   "flushed", _flags.positive_integer)
 
 
 class StreamOptions:
@@ -82,12 +114,19 @@ class Stream:
     # fablint guarded-state contract: flow-control counters under the
     # flow lock, lifecycle transitions + lazy queue under the state
     # lock, frame sequencing under the wire lock (see __init__ notes)
+    # _flush_gen is deliberately NOT in this map: writes happen under
+    # _wire_lock, but the linger timer's staleness probe reads it
+    # lock-free on the shared TimerThread (a blocking acquire there
+    # would stall every RPC deadline behind a writer parked in an shm
+    # send) — GIL-atomic int read, false positives only spawn a no-op
+    # flush tasklet.
     _GUARDED_BY = {
         "_produced": "_flow_lock",
         "_remote_consumed": "_flow_lock",
         "_exec": "_state_lock",
         "_sock_failed_cb": "_state_lock",
         "_seq": "_wire_lock",
+        "_pending_desc": "_wire_lock",
     }
 
     def __init__(self, options: StreamOptions, is_client: bool):
@@ -121,6 +160,11 @@ class Stream:
         # post, and the control write must stay one atomic step so frame
         # k's bulk bytes can never trail frame k+1's descriptor
         self._wire_lock = _dbg.make_lock("Stream._wire_lock")
+        # shm descriptor coalescing (FRAME_DATA_SHM_BATCH): published-
+        # but-unannounced ring frames, flushed per the batch policy.
+        # _flush_gen invalidates stale linger timers.
+        self._pending_desc: List = []
+        self._flush_gen = 0
         self._exec: Optional[ExecutionQueue] = None
 
     # -- sender ---------------------------------------------------------
@@ -150,6 +194,11 @@ class Stream:
             rc = self.append_if_not_full(data)
             if rc != errors.EAGAIN:
                 return rc
+            # about to park on a full window: the receiver can only
+            # return credits for frames it has been TOLD about — flush
+            # any coalesced shm descriptors first or the wait deadlocks
+            # until the linger timer fires
+            self._flush_pending()
             remaining = None
             if deadline is not None:
                 remaining = deadline - time.monotonic()
@@ -268,6 +317,11 @@ class Stream:
         self._on_closed_local()
 
     def _on_closed_local(self) -> None:
+        # published-but-unannounced ring frames must still be announced:
+        # the receiver's stale-stream discard path claims and RELEASES
+        # them, returning the ring space (otherwise those slots stay
+        # parked until the whole socket dies)
+        self._flush_pending()
         with self._state_lock:
             cb, self._sock_failed_cb = self._sock_failed_cb, None
             sock = self.socket
@@ -311,18 +365,27 @@ class Stream:
         if sock is None:
             raise ConnectionError("stream not connected")
         payload = data if data is not None else IOBuf()
-        # large DATA payloads ride the bulk fast plane when the socket
-        # binds one: the bytes go out-of-band under a reserved uuid and
-        # only a 16-byte descriptor rides the control channel.  Sockets
-        # without a bulk plane (mem://, tcp://, in-process ici, or a
-        # fabric peer that lacks the native core) return uuid 0 and the
-        # frame stays inline — byte-identical to the pre-bulk wire.
+        # large DATA payloads ride a fast plane when the socket binds
+        # one: the bytes go out-of-band under a reserved uuid and only a
+        # 16-byte descriptor rides the control channel.  The ROUTE
+        # (same-host shm ring vs the socket bulk conn) is the socket's
+        # route-table decision (ici/route.py); sockets without a fast
+        # plane (mem://, tcp://, in-process ici, or a fabric peer that
+        # lacks the native core) return uuid 0 and the frame stays
+        # inline — byte-identical to the pre-bulk wire.
         bulk_uuid = 0
+        bulk_route = None
         if (frame_type == FRAME_DATA and len(payload)
                 >= _flags.get_flag("ici_stream_bulk_threshold")):
-            begin = getattr(sock, "stream_bulk_begin", None)
-            if begin is not None:
-                bulk_uuid = begin()
+            fast = getattr(sock, "stream_fast_begin", None)
+            if fast is not None:
+                bulk_uuid, bulk_route = fast(len(payload))
+            else:
+                begin = getattr(sock, "stream_bulk_begin", None)
+                if begin is not None:
+                    bulk_uuid = begin()
+                    if bulk_uuid:
+                        bulk_route = "bulk"
         meta = meta_pb.RpcMeta()
         ss = meta.stream_settings
         ss.stream_id = self.remote_sid       # addressed to receiver's id
@@ -330,25 +393,66 @@ class Stream:
         if consumed_bytes:
             ss.consumed_bytes = consumed_bytes
         bulk_exc = None
+        rc = 0
         with self._wire_lock:
-            self._seq += 1
-            ss.frame_seq = self._seq
-            if bulk_uuid:
-                # descriptor FIRST, bulk bytes second: the receiver then
-                # parses the frame and parks in the claim while the bulk
-                # writev is still draining, overlapping its per-frame
-                # Python work with the transfer.  A bulk send that fails
-                # after the descriptor went out degrades the bulk plane,
-                # which fails the peer's claim (-2) and with it THIS
-                # stream (descriptor-consistency: no silent gap in the
-                # stream's byte sequence) — the socket itself survives
-                # and later frames ride the inline path until revival.
-                ss.frame_type = FRAME_DATA_BULK
-                desc = IOBuf(_BULK_DESC.pack(bulk_uuid, len(payload)))
-                rc = sock.write(pack_frame(meta, desc))
+            if bulk_route == "shm":
+                # RING route: bytes FIRST — publishing is a memcpy, not
+                # a drained writev, so the descriptor can coalesce into
+                # a batch (FRAME_DATA_SHM_BATCH) without delaying any
+                # byte.  And because nothing references the frame until
+                # its descriptor goes out, a failed publish falls back
+                # to the next tier for THIS SAME FRAME — ring death
+                # costs the sender zero stream casualties.
+                try:
+                    sock.stream_fast_send("shm", bulk_uuid, payload)
+                except Exception:
+                    rc = self._flush_desc_locked(sock)
+                    bulk_uuid, bulk_route = 0, None
+                    if rc == 0:
+                        fast = getattr(sock, "stream_fast_begin", None)
+                        if fast is not None:
+                            bulk_uuid, bulk_route = fast(len(payload))
+                    if bulk_route == "shm":
+                        # the ring re-attached between degrade and
+                        # re-screen: one more try, else next tier
+                        try:
+                            sock.stream_fast_send("shm", bulk_uuid,
+                                                  payload)
+                        except Exception:
+                            bulk_uuid, bulk_route = 0, None
+            if rc == 0 and bulk_route == "shm":
+                self._pending_desc.append((bulk_uuid, len(payload)))
+                if (len(self._pending_desc)
+                        >= _flags.get_flag("ici_stream_desc_batch")):
+                    rc = self._flush_desc_locked(sock)
+                else:
+                    self._arm_flush_timer(sock)
+            elif rc == 0 and bulk_uuid:
+                # socket bulk tier: descriptor FIRST, bulk bytes second
+                # — the receiver parses the frame and parks in the claim
+                # while the writev is still draining, overlapping its
+                # per-frame Python work with the transfer.  A send that
+                # fails after the descriptor went out degrades the
+                # plane, which fails the peer's claim (-2) and with it
+                # THIS stream (descriptor-consistency: no silent gap in
+                # the stream's byte sequence) — the socket survives and
+                # later frames ride the next tier until revival.
+                # Pending shm descriptors flush first (stream order).
+                rc = self._flush_desc_locked(sock)
+                if rc == 0:
+                    self._seq += 1
+                    ss.frame_seq = self._seq
+                    ss.frame_type = FRAME_DATA_BULK
+                    desc = IOBuf(_BULK_DESC.pack(bulk_uuid, len(payload)))
+                    rc = sock.write(pack_frame(meta, desc))
                 if rc == 0:
                     try:
-                        sock.stream_bulk_send(bulk_uuid, payload)
+                        fast_send = getattr(sock, "stream_fast_send",
+                                            None)
+                        if fast_send is not None:
+                            fast_send(bulk_route, bulk_uuid, payload)
+                        else:
+                            sock.stream_bulk_send(bulk_uuid, payload)
                     except Exception as e:
                         # descriptor went out but the payload never will:
                         # the peer's claim fails when the dead bulk conn
@@ -358,23 +462,36 @@ class Stream:
                         # close() re-enters _send_frame for FRAME_CLOSE
                         # and the lock is not reentrant (review finding)
                         bulk_exc = e
-            else:
-                ss.frame_type = frame_type
-                rc = sock.write(pack_frame(meta, payload))
+            elif rc == 0:
+                # inline frame (small DATA, FEEDBACK, CLOSE, RST):
+                # pending shm descriptors flush first — the receiver
+                # must learn of every preceding DATA frame before this
+                # one (stream order; CLOSE after unflushed data would
+                # drop the tail)
+                rc = self._flush_desc_locked(sock)
+                if rc == 0:
+                    self._seq += 1
+                    ss.frame_seq = self._seq
+                    ss.frame_type = frame_type
+                    rc = sock.write(pack_frame(meta, payload))
         if bulk_exc is not None:
             # the descriptor is on the wire but the payload never went.
-            # A native write error already degraded the bulk plane, but
-            # a PYTHON-side failure (e.g. materializing a device block)
+            # A native write error already degraded the plane, but a
+            # PYTHON-side failure (e.g. materializing a device block)
             # leaves it alive — sever it explicitly so the peer's pending
             # claim fails promptly (-2) and closes the peer's stream,
             # instead of stalling its control loop for the full claim
             # timeout (review finding)
-            abort = getattr(sock, "stream_bulk_abort", None)
-            if abort is not None:
-                try:
-                    abort()
-                except Exception:
-                    pass
+            try:
+                fast_abort = getattr(sock, "stream_fast_abort", None)
+                if fast_abort is not None:
+                    fast_abort(bulk_route)
+                else:
+                    abort = getattr(sock, "stream_bulk_abort", None)
+                    if abort is not None:
+                        abort()
+            except Exception:
+                pass
             self.close()
             raise bulk_exc
         if rc != 0:
@@ -387,6 +504,75 @@ class Stream:
                 # a healthy stream (review finding).
                 self.close()
             raise ConnectionError(f"stream write failed: {rc}")
+
+    # -- shm descriptor batching -----------------------------------------
+    # fablint: lock-held(_wire_lock)
+    def _flush_desc_locked(self, sock) -> int:
+        """Announce every published-but-unannounced ring frame in ONE
+        control frame.  Caller holds _wire_lock.  Returns the socket
+        write rc (0 when there was nothing to flush)."""
+        if not self._pending_desc:
+            return 0
+        from ..proto import rpc_meta_pb2 as meta_pb
+        from ..policy.tpu_std import pack_frame
+        pending, self._pending_desc = self._pending_desc, []
+        self._flush_gen += 1            # a parked linger timer is stale
+        meta = meta_pb.RpcMeta()
+        ss = meta.stream_settings
+        ss.stream_id = self.remote_sid
+        ss.remote_stream_id = self.sid
+        self._seq += 1
+        ss.frame_seq = self._seq
+        # a lone descriptor goes out as plain FRAME_DATA_SHM (identical
+        # 16-byte body) — the batch type is reserved for actual batches
+        ss.frame_type = FRAME_DATA_SHM if len(pending) == 1 \
+            else FRAME_DATA_SHM_BATCH
+        body = IOBuf(b"".join(_BULK_DESC.pack(u, ln)
+                              for u, ln in pending))
+        return sock.write(pack_frame(meta, body))
+
+    def _flush_pending(self) -> None:
+        """Flush from outside the wire lock (linger timer, a writer
+        about to park on a full window).  Write failures surface at the
+        NEXT frame; the stream is usually dying already."""
+        sock = self.socket
+        if sock is None:
+            return
+        try:
+            with self._wire_lock:
+                self._flush_desc_locked(sock)
+        except Exception:
+            pass
+
+    # fablint: lock-held(_wire_lock)
+    def _arm_flush_timer(self, sock) -> None:
+        """Caller holds _wire_lock: linger-flush a partial batch so a
+        bursty-then-idle writer never strands announced-to-nobody
+        frames (the window could never drain).  Armed once per batch;
+        the generation check makes a timer whose batch already flushed
+        a no-op.  The flush itself runs on a tasklet — a socket write
+        must never run on the shared TimerThread."""
+        if len(self._pending_desc) != 1:
+            return
+        gen = self._flush_gen
+        from ..bthread.timer_thread import TimerThread
+
+        def fire():
+            # NO lock here: every RPC deadline rides the shared
+            # TimerThread, and _wire_lock can be held for up to the shm
+            # send timeout by a writer parked on a full ring.  The
+            # staleness check is a lock-free int read (GIL-atomic;
+            # _flush_gen only ever increments under the lock) — a stale
+            # positive merely spawns a tasklet whose locked flush
+            # no-ops on an empty pending list.
+            if self._flush_gen != gen:
+                return
+            from ..bthread import scheduler
+            scheduler.start_background(self._flush_pending,
+                                       name="stream_desc_flush")
+
+        TimerThread.instance().schedule_after(
+            fire, _flags.get_flag("ici_stream_desc_flush_us") / 1e6)
 
 
 # ---- stream registry (versioned ids like SocketId) ---------------------
@@ -434,31 +620,73 @@ def on_stream_frame(meta, body: IOBuf, socket) -> None:
     ss = meta.stream_settings
     s = find_stream(ss.stream_id)
     if s is None:
-        if ss.frame_type == FRAME_DATA_BULK:
-            _discard_bulk_frame(body, socket)
+        if ss.frame_type in (FRAME_DATA_BULK, FRAME_DATA_SHM,
+                             FRAME_DATA_SHM_BATCH):
+            _discard_bulk_frame(ss.frame_type, body, socket)
         return                           # stale frame for a closed stream
     if not s.connected:
         s.mark_connected(ss.remote_stream_id, socket)
     if ss.frame_type == FRAME_DATA:
         s.on_data(body)
-    elif ss.frame_type == FRAME_DATA_BULK:
+    elif ss.frame_type == FRAME_DATA_SHM_BATCH:
+        # N coalesced ring descriptors: claim and deliver in order.  A
+        # claim failure mid-batch keeps the delivered prefix (stream
+        # order) and fails the stream exactly like a single-frame claim
+        # failure below.
+        raw = body.to_bytes()
+        ok = True
+        for off in range(0, len(raw), _BULK_DESC.size):
+            uuid, blen = _BULK_DESC.unpack_from(raw, off)
+            try:
+                data = socket.stream_shm_claim(uuid, blen)
+            except Exception as e:
+                from ..butil import logging as log
+                log.error("stream %d shm batch frame %#x unclaimable: %s",
+                          s.sid, uuid, e)
+                degrade = getattr(socket, "shm_plane_failed", None)
+                try:
+                    if degrade is not None:
+                        degrade()
+                        try:
+                            s._send_frame(FRAME_RST, None)
+                        except Exception:
+                            pass
+                    else:
+                        socket.set_failed(
+                            errors.EFAILEDSOCKET,
+                            f"stream shm batch claim failed: {e}")
+                finally:
+                    s.on_remote_close()
+                ok = False
+                break
+            s.on_data(data)
+        if not ok:
+            return
+    elif ss.frame_type in (FRAME_DATA_BULK, FRAME_DATA_SHM):
+        is_shm = ss.frame_type == FRAME_DATA_SHM
         uuid, blen = _BULK_DESC.unpack(body.to_bytes())
         try:
-            data = socket.stream_bulk_claim(uuid, blen)
+            if is_shm:
+                data = socket.stream_shm_claim(uuid, blen)
+            else:
+                data = socket.stream_bulk_claim(uuid, blen)
         except Exception as e:
-            # the bulk plane died under the stream: this descriptor's
+            # the fast plane died under the stream: this descriptor's
             # bytes will never arrive, and dropping the frame would
             # silently corrupt the byte stream — so THIS stream fails
             # (descriptor-consistency rule).  The socket survives: the
             # control channel is intact, later/other streams fall back
-            # to the inline wire path, and the bulk plane re-establishes
-            # in the background (bulk_plane_failed).  Sockets without a
-            # degradation hook keep the old bulk-death==socket-death
-            # contract.
+            # to the next tier, and the plane re-establishes in the
+            # background (bulk_plane_failed / shm_plane_failed).
+            # Sockets without a degradation hook keep the old
+            # plane-death==socket-death contract.
             from ..butil import logging as log
-            log.error("stream %d bulk frame %#x unclaimable: %s",
-                      s.sid, uuid, e)
-            degrade = getattr(socket, "bulk_plane_failed", None)
+            log.error("stream %d %s frame %#x unclaimable: %s",
+                      s.sid, "shm" if is_shm else "bulk", uuid, e)
+            degrade = getattr(
+                socket,
+                "shm_plane_failed" if is_shm else "bulk_plane_failed",
+                None)
             try:
                 if degrade is not None:
                     degrade()
@@ -482,15 +710,22 @@ def on_stream_frame(meta, body: IOBuf, socket) -> None:
         s.on_remote_close()
 
 
-def _discard_bulk_frame(body: IOBuf, socket) -> None:
-    """A bulk descriptor addressed to a closed stream still has its
-    payload parked in the native frame map — claim and drop it, or it
-    would pin a window's worth of receive buffers until the conn dies."""
-    claim = getattr(socket, "stream_bulk_claim", None)
-    if claim is None or len(body) != _BULK_DESC.size:
+def _discard_bulk_frame(frame_type: int, body: IOBuf, socket) -> None:
+    """A fast-plane descriptor addressed to a closed stream still has
+    its payload parked (native frame map / shm ring slot) — claim and
+    drop it, or it would pin a window's worth of receive buffers (or
+    ring space) until the conn dies."""
+    claim = getattr(socket, "stream_bulk_claim"
+                    if frame_type == FRAME_DATA_BULK
+                    else "stream_shm_claim", None)
+    if claim is None:
         return
-    uuid, blen = _BULK_DESC.unpack(body.to_bytes())
-    try:
-        claim(uuid, blen)
-    except Exception:
-        pass
+    raw = body.to_bytes()
+    if frame_type != FRAME_DATA_SHM_BATCH and len(raw) != _BULK_DESC.size:
+        return
+    for off in range(0, len(raw) - _BULK_DESC.size + 1, _BULK_DESC.size):
+        uuid, blen = _BULK_DESC.unpack_from(raw, off)
+        try:
+            claim(uuid, blen)
+        except Exception:
+            pass

@@ -80,15 +80,44 @@ def resolve_targets(server: str) -> List[str]:
         raise SystemExit(f"rpc_press: {e}")
 
 
+BULK_PLANES = ("auto", "shm", "uds", "inline")
+
+
+def apply_bulk_plane(mode: str) -> None:
+    """Pin the fabric bulk tier for this process: "auto" keeps the route
+    table's preference (shm > uds/tcp > inline), "shm" force-enables the
+    shm flag (it already outranks the rest; whether a ring actually
+    bound is visible in the summary's per-route counters — the /dev/shm
+    capability probe cannot be forced), "uds" disables the shm ring so
+    payloads take the socket conn, "inline" disables both descriptor
+    planes so everything rides the control channel."""
+    if mode not in BULK_PLANES:
+        raise SystemExit(f"rpc_press: unknown --bulk-plane {mode!r} "
+                         f"(choose from {', '.join(BULK_PLANES)})")
+    if mode == "auto":
+        return
+    import brpc_tpu.ici.fabric  # noqa: F401 — defines the ici_fabric_* flags
+    from brpc_tpu.butil import flags as _fl
+    if mode == "shm":
+        _fl.set_flag("ici_fabric_shm", True)
+    elif mode == "uds":
+        _fl.set_flag("ici_fabric_shm", False)
+    elif mode == "inline":
+        _fl.set_flag("ici_fabric_shm", False)
+        _fl.set_flag("ici_fabric_bulk", False)
+
+
 def run_press(server: str, method: str, request_json: str,
               qps: int = 0, duration: float = 5.0, concurrency: int = 8,
               proto: Optional[str] = None, protocol: str = "tpu_std",
               priority: Optional[str] = None, tenant: Optional[str] = None,
-              max_retry: Optional[int] = None, out=sys.stderr) -> dict:
+              max_retry: Optional[int] = None,
+              bulk_plane: str = "auto", out=sys.stderr) -> dict:
     import brpc_tpu.policy  # noqa: F401 — registers protocols
     from brpc_tpu import rpc, bvar
     from brpc_tpu.codec import json2pb
     from brpc_tpu.rpc import errors as rpc_errors
+    apply_bulk_plane(bulk_plane)
 
     if proto:
         req_cls, resp_cls = _load_classes(proto)
@@ -212,7 +241,17 @@ def run_press(server: str, method: str, request_json: str,
         "p99_latency_us": recorder.latency_percentile(0.99),
         "elapsed_s": round(elapsed, 2),
         "interrupted": stop_evt.is_set(),
+        "bulk_plane": bulk_plane,
     }
+    # which byte mover actually carried the run's payloads (ici/route.py
+    # counters; empty off the fabric) — the "chosen route" in the summary
+    try:
+        from brpc_tpu.ici.route import route_stats
+        rs = route_stats()
+        if rs:
+            result["routes"] = rs
+    except Exception:
+        pass
     if len(targets) > 1:
         result["per_endpoint"] = {
             t: {**c, "qps": round(c["sent"] / elapsed, 1)}
@@ -249,11 +288,17 @@ def main(argv=None) -> int:
     ap.add_argument("--max-retry", type=int, default=None,
                     help="per-call retry budget (shed retries honor the "
                          "server's retry_after_ms hint)")
+    ap.add_argument("--bulk-plane", default="auto", choices=BULK_PLANES,
+                    help="pin the fabric bulk tier for this run: auto "
+                         "(route table: shm > uds/tcp > inline), shm, "
+                         "uds (shm off), inline (both descriptor planes "
+                         "off); the summary reports per-route counters")
     args = ap.parse_args(argv)
     run_press(args.server, args.method, args.request, args.qps,
               args.duration, args.concurrency, args.proto, args.protocol,
               priority=args.priority, tenant=args.tenant,
-              max_retry=args.max_retry, out=sys.stdout)
+              max_retry=args.max_retry, bulk_plane=args.bulk_plane,
+              out=sys.stdout)
     return 0
 
 

@@ -1,0 +1,26 @@
+"""Seeded violation for the shm-plane state: a socket-like class whose
+ring handle is swapped outside the plane lock — the exact shape of the
+FabricSocket._shm / _shm_epoch / shm_bytes_sent family (ISSUE 10),
+which fablint must keep honest across degrade/re-attach races."""
+import threading
+
+
+class ShmPlane:
+    _GUARDED_BY = {"_shm": "_plane_lock", "_shm_epoch": "_plane_lock"}
+
+    def __init__(self):
+        self._plane_lock = threading.Lock()
+        self._shm = 0
+        self._shm_epoch = 0
+
+    def attach_locked(self, handle: int) -> None:
+        with self._plane_lock:
+            self._shm = handle
+            self._shm_epoch += 1
+
+    def attach_racy(self, handle: int) -> None:
+        self._shm = handle             # line 22: the violation
+
+    def snapshot(self):
+        with self._plane_lock:
+            return self._shm, self._shm_epoch

@@ -679,10 +679,20 @@ class TestClientRetryAfter:
                 options=rpc.ChannelOptions(timeout_ms=4000, max_retry=3))
         threads = []
         try:
+            # warm the channel (connect + first-dispatch costs) BEFORE
+            # saturating: the probe below must reach the still-full
+            # server ahead of the free timer, and a cold first dispatch
+            # under full-suite load can eat tens of ms (observed flake:
+            # the probe arrived after the slots freed, was never shed,
+            # and retried_count stayed 0)
+            warm = rpc.Controller()
+            ch.call_method("Echo.Echo", warm, EchoRequest(message="w"),
+                           EchoResponse)
+            assert not warm.failed(), warm.error_text
             threads = _saturate(ch, entered)
-            # free the slots well BEFORE the hint elapses: any
+            # free the slots well BEFORE the 100ms hint elapses: any
             # early re-dispatch would succeed too soon
-            t_free = threading.Timer(0.03, gate.set)
+            t_free = threading.Timer(0.05, gate.set)
             t_free.start()
             c = rpc.Controller()
             c.priority = 3

@@ -433,6 +433,14 @@ jax.config.update("jax_platforms", "cpu")
 
 pid = int(sys.argv[1]); coord = sys.argv[2]
 from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+# Pin the same-host shm ring tier OFF (flag exists once fabric is
+# imported, BEFORE initialize probes it): these scenarios exercise the
+# SOCKET bulk plane's death/degradation/revival machinery and assert
+# its engagement byte-exactly; shm outranks it in the route table and
+# would absorb the traffic.  The shm tier's own chaos coverage (kill /
+# unlink / crash-mid-slot / revival) lives in tests/test_shm.py.
+from brpc_tpu.butil import flags as _prelude_fl
+_prelude_fl.set_flag("ici_fabric_shm", False)
 node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
 kv = node._kv
 import brpc_tpu.policy

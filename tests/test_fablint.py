@@ -76,6 +76,15 @@ class TestFixtureViolations:
         assert "_items" in out[0].message and "_lock" in out[0].message
         assert out[0].path.endswith("bad_batch_queue.py")
 
+    def test_unguarded_shm_handle_swap_reported_with_line(self):
+        """The shm ring-plane state class (ISSUE 10): a ring-handle
+        swap outside the plane lock is caught at the exact file:line —
+        the FabricSocket._shm degrade/re-attach shape."""
+        out = _findings("bad_shm_route.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 22)]
+        assert "_shm" in out[0].message and "_plane_lock" in out[0].message
+        assert out[0].path.endswith("bad_shm_route.py")
+
     def test_clean_fixture_is_silent(self):
         out = _findings(
             "clean_module.py",

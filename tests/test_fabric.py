@@ -231,6 +231,14 @@ from brpc_tpu.butil import flags as _xfl
 _xfl.set_flag("ici_fabric_bulk", False)
 '''
 
+# pin the same-host shm ring tier off for tests that assert the socket
+# bulk plane's engagement byte-exactly (shm outranks it in the route
+# table; its own coverage lives in tests/test_shm.py)
+_SHM_OFF_FLAG = '''
+from brpc_tpu.butil import flags as _sfl
+_sfl.set_flag("ici_fabric_shm", False)
+'''
+
 
 def test_two_process_stress_over_transfer_server():
     """The flagged pod-DMA alternative (ici_fabric_bulk=False: device
@@ -323,6 +331,11 @@ class TestFabricUnits:
         sock._bulk_lock = _threading.Lock()
         sock._reestab_pending = None
         sock._reestab_evt = _threading.Event()
+        sock._shm = 0
+        sock._shm_dead = 0
+        sock._shmlib = None
+        sock._shm_reestab_pending = None
+        sock._shm_reestab_evt = _threading.Event()
         sock._dplane_lock = _threading.Lock()
         sock._dplane_seq = None
         sock._dplane_closed = False
@@ -649,6 +662,14 @@ else:
     stream.close()
     print("FABRIC_STREAM_MBPS %%.1f best_of=%%d" %% (best, PASSES),
           flush=True)
+    # which fast plane carried the DATA payloads (bench route assertion)
+    from brpc_tpu.ici.fabric import FabricSocket
+    from brpc_tpu.rpc.socket import list_sockets
+    shm_b = sum(s.shm_bytes_sent for s in list_sockets()
+                if isinstance(s, FabricSocket))
+    bulk_b = sum(s.bulk_bytes_sent for s in list_sockets()
+                 if isinstance(s, FabricSocket))
+    print("ST_ROUTE shm=%%d bulk=%%d" %% (shm_b, bulk_b), flush=True)
     kv.wait_at_barrier("st_done", 120000)
     print("ST1_OK", flush=True)
 """
@@ -775,9 +796,16 @@ def test_streaming_over_cross_process_fabric():
     descriptor on the control channel while the payload gather-sends on
     the native bulk connection; smaller frames keep the inline path.
     Byte-exact seq-order verification server-side, credit accounting
-    asserted on both ends, bulk engagement asserted byte-exactly."""
+    asserted on both ends, bulk engagement asserted byte-exactly.
+
+    The same-host shm ring tier is pinned OFF here: it outranks the
+    socket bulk conn in the route table, and this test exists to keep
+    the UDS/TCP leg honest (tests/test_shm.py owns the shm leg)."""
     child = MIXED_STREAM_CHILD % {"repo": REPO, "n": 80,
                                   "bulk_assert": _BULK_ON_ASSERT}
+    marker = "from brpc_tpu.ici.fabric import FabricNode"
+    assert marker in child
+    child = child.replace(marker, marker + _SHM_OFF_FLAG)
     outs = _run_pair(child, timeout=240)
     assert "MX0_OK" in outs[0]
     assert "MX1_OK" in outs[1]

@@ -1,0 +1,303 @@
+"""Cascading request context (rpc/request_context.py): a handler's
+outbound calls inherit the inbound priority/tenant and the DECREMENTED
+deadline budget by default — the PR-9 follow-on that keeps admission
+metadata meaningful across fan-out hops (proxy/orchestrator shapes)."""
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc import request_context as reqctx
+
+from echo_pb2 import EchoRequest, EchoResponse
+
+
+class TestScopeUnits:
+    def _cntl(self, priority=None, tenant="", deadline=0):
+        c = rpc.Controller()
+        if priority is not None:
+            c.priority = priority
+        if tenant:
+            c.tenant = tenant
+        if deadline:
+            c.deadline_left_ms = deadline
+        return c
+
+    def test_scope_installs_and_restores(self):
+        assert reqctx.current() is None
+        with reqctx.scope(self._cntl(priority=1, tenant="t")):
+            ctx = reqctx.current()
+            assert ctx is not None
+            assert ctx.priority == 1 and ctx.tenant == "t"
+        assert reqctx.current() is None
+
+    def test_no_metadata_installs_no_context(self):
+        with reqctx.scope(self._cntl()):
+            assert reqctx.current() is None
+
+    def test_nested_scope_shadows_then_restores(self):
+        with reqctx.scope(self._cntl(priority=0)):
+            outer = reqctx.current()
+            with reqctx.scope(self._cntl(priority=3)):
+                assert reqctx.current().priority == 3
+            assert reqctx.current() is outer
+
+    def test_residual_deadline_decrements_with_elapsed_time(self):
+        with reqctx.scope(self._cntl(deadline=100)):
+            ctx = reqctx.current()
+            r0 = ctx.residual_deadline_ms()
+            assert r0 is not None and r0 <= 100
+            time.sleep(0.05)
+            r1 = ctx.residual_deadline_ms()
+            assert r1 < r0 and r1 <= 100 - 45
+
+    def test_no_deadline_means_no_residual(self):
+        with reqctx.scope(self._cntl(priority=2)):
+            assert reqctx.current().residual_deadline_ms() is None
+
+    def test_scope_is_thread_local(self):
+        seen = {}
+        with reqctx.scope(self._cntl(priority=1)):
+            def other():
+                seen["ctx"] = reqctx.current()
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert seen["ctx"] is None
+
+
+def _start_server(name, service):
+    opts = rpc.ServerOptions()
+    opts.usercode_inline = True
+    s = rpc.Server(opts)
+    s.add_service(service)
+    assert s.start(f"mem://{name}") == 0
+    return s
+
+
+class TestTwoHopEndToEnd:
+    """A → B → C over mem:// transports: B's handler calls C through a
+    plain channel and C must observe A's metadata, decremented."""
+
+    def test_priority_tenant_and_deadline_inherit_across_two_hops(self):
+        seen = {}
+
+        class CService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Leaf(self, cntl, request, response, done):
+                seen["priority"] = cntl.priority
+                seen["tenant"] = cntl.tenant
+                seen["deadline_left_ms"] = cntl.deadline_left_ms
+                response.message = "leaf"
+                done()
+
+        class BService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Mid(self, cntl, request, response, done):
+                # burn a slice of the budget before fanning out, so the
+                # decrement is observable
+                time.sleep(0.05)
+                ch = rpc.Channel()
+                ch.init("mem://reqctx-c")
+                sub = rpc.Controller()
+                r = ch.call_method("CService.Leaf", sub,
+                                   EchoRequest(message="x"), EchoResponse)
+                assert not sub.failed(), sub.error_text
+                seen["sub_timeout_ms"] = sub.timeout_ms
+                response.message = "mid:" + r.message
+                done()
+
+        sc = _start_server("reqctx-c", CService())
+        sb = _start_server("reqctx-b", BService())
+        try:
+            ch = rpc.Channel()
+            ch.init("mem://reqctx-b")
+            cntl = rpc.Controller()
+            cntl.priority = 0
+            cntl.tenant = "gold"
+            cntl.timeout_ms = 2000
+            resp = ch.call_method("BService.Mid", cntl,
+                                  EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "mid:leaf"
+            # C saw A's class, not a re-originated default
+            assert seen["priority"] == 0
+            assert seen["tenant"] == "gold"
+            # and a budget strictly below A's, shrunk by B's 50ms burn
+            assert 0 < seen["deadline_left_ms"] <= 2000 - 40, seen
+            # the sub-call's timeout was capped at the residual budget
+            assert seen["sub_timeout_ms"] <= 2000 - 40, seen
+        finally:
+            sb.stop()
+            sc.stop()
+
+    def test_explicit_override_beats_inheritance(self):
+        seen = {}
+
+        class CService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Leaf(self, cntl, request, response, done):
+                seen["priority"] = cntl.priority
+                seen["tenant"] = cntl.tenant
+                response.message = "leaf"
+                done()
+
+        class BService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Mid(self, cntl, request, response, done):
+                ch = rpc.Channel()
+                ch.init("mem://reqctx-c2")
+                sub = rpc.Controller()
+                sub.priority = 3            # explicit per-call override
+                sub.tenant = "scrap"
+                ch.call_method("CService.Leaf", sub,
+                               EchoRequest(message="x"), EchoResponse)
+                assert not sub.failed(), sub.error_text
+                response.message = "mid"
+                done()
+
+        sc = _start_server("reqctx-c2", CService())
+        sb = _start_server("reqctx-b2", BService())
+        try:
+            ch = rpc.Channel()
+            ch.init("mem://reqctx-b2")
+            cntl = rpc.Controller()
+            cntl.priority = 0
+            cntl.tenant = "gold"
+            ch.call_method("BService.Mid", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert seen["priority"] == 3
+            assert seen["tenant"] == "scrap"
+        finally:
+            sb.stop()
+            sc.stop()
+
+    def test_inherited_beats_channel_defaults(self):
+        seen = {}
+
+        class CService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Leaf(self, cntl, request, response, done):
+                seen["priority"] = cntl.priority
+                seen["tenant"] = cntl.tenant
+                response.message = "leaf"
+                done()
+
+        class BService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Mid(self, cntl, request, response, done):
+                ch = rpc.Channel()
+                # a static channel-wide default must NOT demote the
+                # critical inbound request
+                ch.init("mem://reqctx-c3",
+                        options=rpc.ChannelOptions(priority=3,
+                                                   tenant="bulkload"))
+                sub = rpc.Controller()
+                ch.call_method("CService.Leaf", sub,
+                               EchoRequest(message="x"), EchoResponse)
+                assert not sub.failed(), sub.error_text
+                response.message = "mid"
+                done()
+
+        sc = _start_server("reqctx-c3", CService())
+        sb = _start_server("reqctx-b3", BService())
+        try:
+            ch = rpc.Channel()
+            ch.init("mem://reqctx-b3")
+            cntl = rpc.Controller()
+            cntl.priority = 0
+            cntl.tenant = "gold"
+            ch.call_method("BService.Mid", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert seen["priority"] == 0
+            assert seen["tenant"] == "gold"
+        finally:
+            sb.stop()
+            sc.stop()
+
+    def test_spent_budget_fails_subcall_before_any_work(self):
+        leaf_ran = []
+
+        class CService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Leaf(self, cntl, request, response, done):
+                leaf_ran.append(1)
+                response.message = "leaf"
+                done()
+
+        class BService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Mid(self, cntl, request, response, done):
+                time.sleep(0.08)            # burn past the inbound budget
+                ch = rpc.Channel()
+                ch.init("mem://reqctx-c4")
+                sub = rpc.Controller()
+                ch.call_method("CService.Leaf", sub,
+                               EchoRequest(message="x"), EchoResponse)
+                # the sub-call failed fast with the deadline code and
+                # never dispatched
+                assert sub.failed()
+                assert sub.error_code_ == errors.ERPCTIMEDOUT, \
+                    (sub.error_code_, sub.error_text)
+                response.message = "mid"
+                done()
+
+        sc = _start_server("reqctx-c4", CService())
+        sb = _start_server("reqctx-b4", BService())
+        try:
+            ch = rpc.Channel()
+            ch.init("mem://reqctx-b4")
+            cntl = rpc.Controller()
+            cntl.timeout_ms = 50            # the whole budget B burns past
+            ch.call_method("BService.Mid", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert not leaf_ran, "sub-call dispatched on a spent budget"
+        finally:
+            sb.stop()
+            sc.stop()
+
+    def test_async_done_sees_failed_subcall_on_spent_budget(self):
+        fired = []
+
+        class CService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Leaf(self, cntl, request, response, done):
+                response.message = "leaf"
+                done()
+
+        class BService(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def Mid(self, cntl, request, response, done):
+                time.sleep(0.08)
+                ch = rpc.Channel()
+                ch.init("mem://reqctx-c5")
+                sub = rpc.Controller()
+                evt = threading.Event()
+
+                def sub_done(c):
+                    fired.append(c.error_code_)
+                    evt.set()
+                ch.call_method("CService.Leaf", sub,
+                               EchoRequest(message="x"), EchoResponse,
+                               done=sub_done)
+                assert evt.wait(2), "async done never fired"
+                response.message = "mid"
+                done()
+
+        sc = _start_server("reqctx-c5", CService())
+        sb = _start_server("reqctx-b5", BService())
+        try:
+            ch = rpc.Channel()
+            ch.init("mem://reqctx-b5")
+            cntl = rpc.Controller()
+            cntl.timeout_ms = 50
+            ch.call_method("BService.Mid", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert fired == [errors.ERPCTIMEDOUT], fired
+        finally:
+            sb.stop()
+            sc.stop()

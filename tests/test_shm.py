@@ -1,0 +1,791 @@
+"""Same-host shared-memory bulk tier: the mmap ring transport
+(native/fabric.cpp nshm), its route-table selection (ici/route.py), and
+its chaos/degradation/revival semantics (ROADMAP item 3).
+
+Two tiers of coverage:
+
+  * ring units — drive the native API directly over a small ring so
+    wraparound, out-of-order release (consume-to-release head advance),
+    full-ring doorbell blocking, dead-ring fail-fast, and the chaos
+    knobs (drop, sever-mid-slot) all fire deterministically;
+  * 2-process — the full RPC stack over a real fabric pair: the shm
+    route carries attachments and stream frames byte-exactly (asserted
+    on the shm/bulk byte counters), segment kill falls back to the
+    UDS bulk tier with ZERO client-visible failures and revives
+    (epoch bump + bytes resume), a refused handshake degrades cleanly,
+    and unlink-while-mapped is a no-op by design (the attach unlinks
+    the name; the mapping is the resource).
+"""
+import ctypes
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.shm
+
+u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _lib():
+    from brpc_tpu.butil import native
+    lib = native.load()
+    if lib is None or not hasattr(lib, "brpc_tpu_shm_create"):
+        pytest.skip("native core without shm support")
+    return lib
+
+
+def _ring_pair(lib, name: str, ring_bytes: int):
+    """Create+attach one segment in-process (two mappings of the same
+    pages — exactly what two processes see) and unlink immediately."""
+    lib.brpc_tpu_shm_unlink(name.encode())
+    h0 = lib.brpc_tpu_shm_create(name.encode(), ring_bytes)
+    if not h0:
+        pytest.skip("/dev/shm unavailable in this sandbox")
+    h1 = lib.brpc_tpu_shm_attach(name.encode())
+    assert h1, "attach failed on a just-created segment"
+    assert lib.brpc_tpu_shm_unlink(name.encode()) == 0
+    assert not os.path.exists(f"/dev/shm/{name}")
+    return h0, h1
+
+
+def _send(lib, h, uuid, payload: bytes, timeout_us=5_000_000) -> int:
+    buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+    return lib.brpc_tpu_shm_send(h, uuid, buf, len(payload), timeout_us)
+
+
+def _recv(lib, h, uuid, timeout_us=5_000_000):
+    out, olen = u8p(), ctypes.c_uint64()
+    rc = lib.brpc_tpu_shm_recv(h, uuid, timeout_us,
+                               ctypes.byref(out), ctypes.byref(olen))
+    return rc, out, olen.value
+
+
+def _stats(lib, h):
+    st = (ctypes.c_uint64 * 6)()
+    assert lib.brpc_tpu_shm_stats(h, st, 6) == 6
+    return {"bytes_out": st[0], "bytes_in": st[1], "tx_occ": st[2],
+            "rx_occ": st[3], "db_waits": st[4], "ring_bytes": st[5]}
+
+
+class TestShmRingUnits:
+    def test_byte_exact_incl_wraparound_and_gather(self):
+        lib = _lib()
+        h0, h1 = _ring_pair(lib, f"shm_t_wrap.{os.getpid()}", 1 << 20)
+        payload = bytes(range(256)) * 1000          # 256000 B
+        # 24 frames through a 1MB ring: wraps several times
+        for i in range(24):
+            if i % 2 == 0:
+                assert _send(lib, h0, 100 + i, payload) == 0
+            else:
+                # gather: three segments reassemble into one frame
+                b = (ctypes.c_uint8 * len(payload)).from_buffer_copy(
+                    payload)
+                base = ctypes.addressof(b)
+                ptrs = (ctypes.c_void_p * 3)(base, base + 1000,
+                                             base + 50000)
+                lens = (ctypes.c_uint64 * 3)(1000, 49000,
+                                             len(payload) - 50000)
+                assert lib.brpc_tpu_shm_sendv(
+                    h0, 100 + i, ptrs, lens, 3, 5_000_000) == 0
+            rc, out, n = _recv(lib, h1, 100 + i)
+            assert rc == 0 and n == len(payload)
+            assert ctypes.string_at(out, n) == payload
+            lib.brpc_tpu_shm_release(h1, out, n)
+        st = _stats(lib, h1)
+        assert st["bytes_in"] == 24 * len(payload)
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_out_of_order_release_advances_head_in_order(self):
+        lib = _lib()
+        h0, h1 = _ring_pair(lib, f"shm_t_ooo.{os.getpid()}", 1 << 20)
+        payload = b"z" * 100_000
+        foot = 16 + (len(payload) + 15) // 16 * 16
+        claims = []
+        for i in range(3):
+            assert _send(lib, h0, i + 1, payload) == 0
+            rc, out, n = _recv(lib, h1, i + 1)
+            assert rc == 0
+            claims.append((out, n))
+        # release the MIDDLE first: every footprint still held (head
+        # may only advance over the retired PREFIX)
+        lib.brpc_tpu_shm_release(h1, claims[1][0], claims[1][1])
+        assert _stats(lib, h1)["rx_occ"] >= 3 * foot
+        # releasing the head retires slots 0 AND 1 together
+        lib.brpc_tpu_shm_release(h1, claims[0][0], claims[0][1])
+        assert _stats(lib, h1)["rx_occ"] == foot
+        lib.brpc_tpu_shm_release(h1, claims[2][0], claims[2][1])
+        assert _stats(lib, h1)["rx_occ"] == 0
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_full_ring_blocks_then_doorbell_wakes(self):
+        lib = _lib()
+        # fresh 1MB ring: two 400KB frames fit, the third cannot until
+        # space retires
+        h0, h1 = _ring_pair(lib, f"shm_t_full.{os.getpid()}", 1 << 20)
+        payload = b"f" * 400_000
+        assert _send(lib, h0, 1, payload) == 0
+        assert _send(lib, h0, 2, payload) == 0
+        t0 = time.monotonic()
+        assert _send(lib, h0, 3, payload, timeout_us=250_000) == -1
+        assert 0.2 < time.monotonic() - t0 < 3.0, "timeout not honored"
+
+        def drain():
+            time.sleep(0.25)
+            for i in (1, 2):
+                rc, out, n = _recv(lib, h1, i)
+                assert rc == 0
+                lib.brpc_tpu_shm_release(h1, out, n)
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        # blocked on the space doorbell until the drain retires slots
+        t0 = time.monotonic()
+        assert _send(lib, h0, 3, payload, timeout_us=10_000_000) == 0
+        assert time.monotonic() - t0 >= 0.2, "send did not block"
+        t.join()
+        assert _stats(lib, h0)["db_waits"] > 0
+        rc, out, n = _recv(lib, h1, 3)
+        assert rc == 0
+        lib.brpc_tpu_shm_release(h1, out, n)
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_oversize_frame_routes_elsewhere_ring_stays_alive(self):
+        lib = _lib()
+        h0, h1 = _ring_pair(lib, f"shm_t_big.{os.getpid()}", 1 << 20)
+        assert _send(lib, h0, 1, b"x" * (2 << 20), timeout_us=0) == -3
+        assert lib.brpc_tpu_shm_alive(h0) == 1
+        assert _send(lib, h0, 2, b"ok") == 0
+        rc, out, n = _recv(lib, h1, 2)
+        assert rc == 0 and ctypes.string_at(out, n) == b"ok"
+        lib.brpc_tpu_shm_release(h1, out, n)
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_wrap_unfittable_frame_fails_fast_not_dead(self):
+        """A frame that fits the ring in principle but NOT at the
+        current wrap position (remainder + footprint > ring) must
+        return -3 IMMEDIATELY — not park out the send timeout and get
+        the healthy ring declared dead (review finding)."""
+        lib = _lib()
+        h0, h1 = _ring_pair(lib, f"shm_t_wrapbig.{os.getpid()}", 1 << 20)
+        # advance the cursor to ~400KB, fully drained
+        assert _send(lib, h0, 1, b"a" * 400_000) == 0
+        rc, out, n = _recv(lib, h1, 1)
+        assert rc == 0
+        lib.brpc_tpu_shm_release(h1, out, n)
+        # 700KB frame: footprint < ring but wrap cost pushes the need
+        # past the ring — instant -3 even with a generous timeout
+        t0 = time.monotonic()
+        rc = _send(lib, h0, 2, b"b" * 700_000, timeout_us=10_000_000)
+        assert rc == -3, rc
+        assert time.monotonic() - t0 < 1.0, "did not fail fast"
+        assert lib.brpc_tpu_shm_alive(h0) == 1
+        # normal traffic continues
+        assert _send(lib, h0, 3, b"c" * 100_000) == 0
+        rc, out, n = _recv(lib, h1, 3)
+        assert rc == 0 and n == 100_000
+        lib.brpc_tpu_shm_release(h1, out, n)
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_dead_ring_fails_fast_but_parked_frames_claimable(self):
+        lib = _lib()
+        h0, h1 = _ring_pair(lib, f"shm_t_dead.{os.getpid()}", 1 << 20)
+        assert _send(lib, h0, 7, b"before-death") == 0
+        # a claim parked on a frame that never arrives fails the moment
+        # the ring dies — not after its full timeout
+        got = {}
+
+        def parked():
+            rc, _, _ = _recv(lib, h1, 999, timeout_us=30_000_000)
+            got["rc"] = rc
+        t = threading.Thread(target=parked, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        lib.brpc_tpu_shm_close(h0)
+        t.join(5)
+        assert not t.is_alive(), "claim not woken by ring death"
+        assert got["rc"] == -2
+        # but the frame published BEFORE death is still claimable
+        rc, out, n = _recv(lib, h1, 7)
+        assert rc == 0 and ctypes.string_at(out, n) == b"before-death"
+        lib.brpc_tpu_shm_release(h1, out, n)
+        assert _send(lib, h1, 8, b"x", timeout_us=100_000) == -1
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_chaos_drop_frames_loses_bytes_not_ring(self):
+        lib = _lib()
+        h0, h1 = _ring_pair(lib, f"shm_t_drop.{os.getpid()}", 1 << 20)
+        assert lib.brpc_tpu_shm_chaos(h1, 2, 1) == 0   # drop next rx frame
+        assert _send(lib, h0, 1, b"vanishes") == 0
+        rc, _, _ = _recv(lib, h1, 1, timeout_us=200_000)
+        assert rc == -1                                # claim times out
+        assert lib.brpc_tpu_shm_alive(h1) == 1
+        assert _send(lib, h0, 2, b"arrives") == 0
+        rc, out, n = _recv(lib, h1, 2)
+        assert rc == 0 and ctypes.string_at(out, n) == b"arrives"
+        lib.brpc_tpu_shm_release(h1, out, n)
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_chaos_sever_mid_slot_is_producer_crash(self):
+        lib = _lib()
+        h0, h1 = _ring_pair(lib, f"shm_t_sever.{os.getpid()}", 1 << 20)
+        assert _send(lib, h0, 1, b"a" * 10_000) == 0
+        rc, out, n = _recv(lib, h1, 1)
+        assert rc == 0
+        lib.brpc_tpu_shm_release(h1, out, n)
+        # watermark lands inside the next frame: a PARTIAL slot is
+        # copied, tail never advances, the ring dies — the receiver can
+        # never observe a torn frame, only conn death
+        assert lib.brpc_tpu_shm_chaos(h0, 1, 12_000) == 0
+        assert _send(lib, h0, 2, b"b" * 10_000) == -1
+        assert lib.brpc_tpu_shm_alive(h0) == 0
+        assert lib.brpc_tpu_shm_alive(h1) == 0
+        rc, _, _ = _recv(lib, h1, 2, timeout_us=5_000_000)
+        assert rc == -2
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_unlink_while_mapped_is_harmless(self):
+        """The crash-safety design: the attach unlinks the name, the
+        MAPPING is the resource — a racing/duplicate unlink (or a chaos
+        'unlink the segment' fault) changes nothing for live traffic."""
+        lib = _lib()
+        name = f"shm_t_unlink.{os.getpid()}"
+        h0, h1 = _ring_pair(lib, name, 1 << 20)   # already unlinked
+        assert lib.brpc_tpu_shm_unlink(name.encode()) == -1  # idempotent
+        for i in range(8):
+            payload = bytes([i]) * 50_000
+            assert _send(lib, h0, i + 1, payload) == 0
+            rc, out, n = _recv(lib, h1, i + 1)
+            assert rc == 0 and ctypes.string_at(out, n) == payload
+            lib.brpc_tpu_shm_release(h1, out, n)
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)
+
+    def test_claimed_slot_readable_after_close_until_release(self):
+        """Zero-copy custody across teardown: the mapping is unmapped
+        only when the LAST claimed slot is released, so a Python view
+        held across socket close never reads freed memory."""
+        lib = _lib()
+        h0, h1 = _ring_pair(lib, f"shm_t_hold.{os.getpid()}", 1 << 20)
+        assert _send(lib, h0, 1, b"\x5a" * 4096) == 0
+        rc, out, n = _recv(lib, h1, 1)
+        assert rc == 0
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)              # claim out: unmap deferred
+        assert out[0] == 0x5A and out[n - 1] == 0x5A
+        lib.brpc_tpu_shm_release(h1, out, n)    # last release unmaps
+
+
+# ---------------------------------------------------------------------------
+# 2-process: the full RPC stack over a real fabric pair.
+# ---------------------------------------------------------------------------
+
+_SHM_ROUTE_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.rpc.socket import list_sockets
+from brpc_tpu.ici.route import route_stats
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+def fabric_socks():
+    return [s for s in list_sockets() if isinstance(s, FabricSocket)]
+
+CHUNK = 512 * 1024
+
+if pid == 0:
+    class EchoService(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "srv0:" + request.message
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+            done()
+
+    server = rpc.Server(); server.add_service(EchoService())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("shm_srv_up", "1")
+    kv.wait_at_barrier("shm_echo_done", 180000)
+    # the server's socket claimed the request payloads off its ring
+    socks = fabric_socks()
+    assert socks and socks[0].shm_bound(), "server socket has no shm ring"
+    assert socks[0].shm_bytes_claimed >= 4 * CHUNK, \
+        socks[0].shm_bytes_claimed
+    server.stop()
+    print("SHMR0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("shm_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    payload = jax.device_put(jnp.arange(CHUNK, dtype=jnp.uint8) %% 251,
+                             jax.devices()[local_dev])
+    jax.block_until_ready(payload)
+    expect = bytes(np.asarray(payload))
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=120000,
+                                                  max_retry=0))
+    for i in range(4):
+        cntl = rpc.Controller()
+        cntl.request_attachment.append_device_array(payload)
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="m%%d" %% i),
+                              EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "srv0:m%%d" %% i
+        assert cntl.response_attachment.to_bytes() == expect, \
+            "bounced payload corrupted"
+    s = fabric_socks()[0]
+    assert s.shm_bound(), "client socket has no shm ring"
+    # the payloads rode the RING both ways — not the socket bulk conn
+    assert s.shm_bytes_sent >= 4 * CHUNK, s.shm_bytes_sent
+    assert s.shm_bytes_claimed >= 4 * CHUNK, s.shm_bytes_claimed
+    assert s.bulk_bytes_sent == 0, s.bulk_bytes_sent
+    rs = route_stats()
+    assert rs.get("shm", {}).get("bytes", 0) >= 4 * CHUNK, rs
+    assert s.describe_shm()["epoch"] == 1
+    kv.wait_at_barrier("shm_echo_done", 180000)
+    print("SHMR1_OK", flush=True)
+"""
+
+
+_SHM_KILL_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.rpc import fault_injection as fi
+from brpc_tpu.rpc.socket import list_sockets
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+def fabric_socks():
+    return [s for s in list_sockets() if isinstance(s, FabricSocket)]
+
+CHUNK = 256 * 1024
+PHASE = 4
+MODE = %(mode)r      # "kill" (segment dead now) or "midslot" (producer
+                     # crash mid-copy via the byte watermark)
+
+if pid == 0:
+    total = [0]
+    lock = threading.Lock()
+
+    class Sink(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Push(self, cntl, request, response, done):
+            with lock:
+                total[0] += len(cntl.request_attachment)
+            # verify every chunk byte-exactly — fallback must not
+            # corrupt or reorder
+            got = cntl.request_attachment.to_bytes()
+            seq = int(request.message)
+            want = bytes([seq %% 251]) * CHUNK
+            assert got == want, "corrupt payload at seq %%d" %% seq
+            response.message = str(total[0])
+            done()
+
+    server = rpc.Server(); server.add_service(Sink())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("sk_srv_up", "1")
+    kv.wait_at_barrier("sk_done", 300000)
+    assert total[0] == 3 * PHASE * CHUNK, total[0]
+    server.stop()
+    print("SK0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("sk_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=120000,
+                                                  max_retry=0))
+
+    def push(seq):
+        arr = jax.device_put(jnp.full((CHUNK,), seq %% 251, jnp.uint8),
+                             jax.devices()[local_dev])
+        jax.block_until_ready(arr)
+        cntl = rpc.Controller()
+        cntl.request_attachment.append_device_array(arr)
+        ch.call_method("Sink.Push", cntl,
+                       EchoRequest(message=str(seq)), EchoResponse)
+        assert not cntl.failed(), (seq, cntl.error_text)
+
+    seq = 0
+    # phase 1: healthy — chunks ride the ring
+    for _ in range(PHASE):
+        push(seq); seq += 1
+    s = fabric_socks()[0]
+    assert s.shm_bound() and s.shm_bytes_sent >= PHASE * CHUNK
+    assert s.shm_epoch() == 1
+    bulk_before = s.bulk_bytes_sent
+
+    # CHAOS: kill the ring under the live control channel
+    if MODE == "kill":
+        with s._bulk_lock:
+            h, lib = s._shm, s._shmlib
+        lib.brpc_tpu_shm_chaos(h, fi.CHAOS_SEVER_NOW, 0)
+    else:     # producer crash mid-slot: the NEXT ring write dies
+              # half-copied without publishing
+        with s._bulk_lock:
+            h, lib = s._shm, s._shmlib
+        lib.brpc_tpu_shm_chaos(h, fi.CHAOS_SEVER_AFTER_OUT_BYTES,
+                               s.shm_bytes_sent + CHUNK // 2)
+
+    # phase 2: degraded — ZERO client-visible failures, chunks fall
+    # back to the socket bulk tier byte-exactly.  At least the first
+    # degraded chunk MUST ride bulk; background revival may legally
+    # reclaim the rest of the phase for the ring.
+    for _ in range(PHASE):
+        push(seq); seq += 1
+    assert s.bulk_bytes_sent >= bulk_before + CHUNK, (
+        s.bulk_bytes_sent, bulk_before)
+
+    # phase 3: revival — a fresh segment re-establishes in the
+    # background (epoch bumps) and the ring carries bytes again
+    deadline = time.time() + 30
+    while s.shm_epoch() < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert s.shm_epoch() >= 2, "shm ring never re-established"
+    shm_before = s.shm_bytes_sent
+    for _ in range(PHASE):
+        push(seq); seq += 1
+    assert s.shm_bytes_sent >= shm_before + (PHASE - 1) * CHUNK, (
+        s.shm_bytes_sent, shm_before)
+    assert not s.failed, "socket died over an shm-plane fault"
+    kv.wait_at_barrier("sk_done", 300000)
+    print("SK1_OK", flush=True)
+"""
+
+
+_SHM_REFUSE_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.rpc import fault_injection as fi
+from brpc_tpu.rpc.socket import list_sockets
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+CHUNK = 256 * 1024
+
+if pid == 0:
+    # refuse the shm attach at HELLO: the pair must come up WITHOUT an
+    # shm ring and serve byte-exact traffic on the socket bulk tier
+    plan = fi.FabricFaultPlan(refuse_shm_handshakes=1)
+    fi.install_fabric(plan)
+
+    class EchoService(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "ok"
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+            done()
+
+    server = rpc.Server(); server.add_service(EchoService())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("sr_srv_up", "1")
+    kv.wait_at_barrier("sr_done", 180000)
+    assert plan.injected["refuse_shm"] == 1, plan.injected
+    socks = [s for s in list_sockets() if isinstance(s, FabricSocket)]
+    assert socks and not socks[0].shm_bound()
+    server.stop()
+    print("SR0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("sr_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    payload = jax.device_put(jnp.arange(CHUNK, dtype=jnp.uint8) %% 251,
+                             jax.devices()[local_dev])
+    jax.block_until_ready(payload)
+    expect = bytes(np.asarray(payload))
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=120000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    cntl.request_attachment.append_device_array(payload)
+    ch.call_method("EchoService.Echo", cntl,
+                   EchoRequest(message="x"), EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert cntl.response_attachment.to_bytes() == expect
+    s = [s for s in list_sockets() if isinstance(s, FabricSocket)][0]
+    assert not s.shm_bound(), "client bound shm despite server refusal"
+    assert s.shm_bytes_sent == 0
+    assert s.bulk_bytes_sent >= CHUNK       # the bulk tier carried it
+    kv.wait_at_barrier("sr_done", 180000)
+    print("SR1_OK", flush=True)
+"""
+
+
+_SHM_STREAM_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc.socket import list_sockets
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+CHUNK = 256 * 1024
+N = 24
+
+def body_for(seq):
+    return b"%%08d" %% seq + bytes([(seq * 7 + 3) %% 251]) * (CHUNK - 8)
+
+if pid == 0:
+    state = {"next": 0, "bad": 0}
+    done_evt = threading.Event()
+
+    class Sink:
+        def on_received_messages(self, sid, msgs):
+            for m in msgs:
+                if m.to_bytes() != body_for(state["next"]):
+                    state["bad"] += 1
+                state["next"] += 1
+        def on_closed(self, sid):
+            done_evt.set()
+
+    class StreamSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Start(self, cntl, request, response, done):
+            rpc.stream_accept(cntl, rpc.StreamOptions(handler=Sink()))
+            response.message = "ok"
+            done()
+
+    server = rpc.Server(); server.add_service(StreamSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("ss_srv_up", "1")
+    assert done_evt.wait(180), ("stream never closed", state["next"])
+    assert state["next"] == N and state["bad"] == 0, state
+    socks = [s for s in list_sockets() if isinstance(s, FabricSocket)]
+    assert socks and socks[0].shm_bytes_claimed >= N * CHUNK
+    kv.wait_at_barrier("ss_done", 120000)
+    server.stop()
+    print("SS0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("ss_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    stream = rpc.stream_create(cntl, rpc.StreamOptions(max_buf_size=8 << 20))
+    ch.call_method("StreamSvc.Start", cntl,
+                   EchoRequest(message="s"), EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(10)
+    for seq in range(N):
+        assert stream.write(IOBuf(body_for(seq)), timeout=30) == 0
+    s = [s for s in list_sockets() if isinstance(s, FabricSocket)][0]
+    # every DATA frame's payload rode the RING (FRAME_DATA_SHM), none
+    # the socket bulk conn
+    assert s.shm_bytes_sent >= N * CHUNK, s.shm_bytes_sent
+    assert s.bulk_bytes_sent == 0, s.bulk_bytes_sent
+    stream.close()
+    kv.wait_at_barrier("ss_done", 120000)
+    print("SS1_OK", flush=True)
+"""
+
+
+_SHM_STREAM_KILL_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import fault_injection as fi
+from brpc_tpu.rpc.socket import list_sockets
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+CHUNK = 200 * 1024
+N = 18          # 6 pre-kill (descriptors BATCHED, some unflushed when
+                # the ring dies), 6 degraded, 6 post-revival
+
+def body_for(seq):
+    return b"%%08d" %% seq + bytes([(seq * 13 + 1) %% 251]) * (CHUNK - 8)
+
+if pid == 0:
+    state = {"next": 0, "bad": 0}
+    done_evt = threading.Event()
+
+    class Sink:
+        def on_received_messages(self, sid, msgs):
+            for m in msgs:
+                if m.to_bytes() != body_for(state["next"]):
+                    state["bad"] += 1
+                state["next"] += 1
+        def on_closed(self, sid):
+            done_evt.set()
+
+    class StreamSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Start(self, cntl, request, response, done):
+            rpc.stream_accept(cntl, rpc.StreamOptions(handler=Sink()))
+            response.message = "ok"
+            done()
+
+    server = rpc.Server(); server.add_service(StreamSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("sks_srv_up", "1")
+    # EVERY frame must arrive, in order, byte-exact — the kill lands
+    # while descriptors for published ring frames are still batched
+    # unflushed, and _F_SHM_DOWN reaches us BEFORE them: the retired
+    # ring must stay claimable or those frames are lost (regression:
+    # the receiver used to close its handle on DOWN and fail the
+    # stream with rc -2 claims)
+    assert done_evt.wait(180), ("stream never closed", state["next"])
+    assert state["next"] == N, state
+    assert state["bad"] == 0, state
+    kv.wait_at_barrier("sks_done", 180000)
+    server.stop()
+    print("SKS0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("sks_srv_up", 60000)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+    cntl = rpc.Controller()
+    stream = rpc.stream_create(cntl, rpc.StreamOptions(max_buf_size=8 << 20))
+    ch.call_method("StreamSvc.Start", cntl,
+                   EchoRequest(message="s"), EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(10)
+    seq = 0
+    for _ in range(6):
+        assert stream.write(IOBuf(body_for(seq)), timeout=30) == 0
+        seq += 1
+    s = [x for x in list_sockets() if isinstance(x, FabricSocket)][0]
+    assert s.shm_bound() and s.shm_epoch() == 1
+    # kill the segment with published-but-unannounced descriptors
+    # pending (batch default 32 >> 6, nothing flushed yet)
+    with s._bulk_lock:
+        h, lib = s._shm, s._shmlib
+    lib.brpc_tpu_shm_chaos(h, fi.CHAOS_SEVER_NOW, 0)
+    for _ in range(6):
+        assert stream.write(IOBuf(body_for(seq)), timeout=30) == 0
+        seq += 1
+    deadline = time.time() + 30
+    while s.shm_epoch() < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert s.shm_epoch() >= 2, "shm ring never re-established"
+    for _ in range(6):
+        assert stream.write(IOBuf(body_for(seq)), timeout=30) == 0
+        seq += 1
+    stream.close()
+    assert not s.failed, "socket died over an shm-plane fault"
+    kv.wait_at_barrier("sks_done", 180000)
+    print("SKS1_OK", flush=True)
+"""
+
+
+def test_shm_route_carries_attachments_byte_exact():
+    from test_fabric import _run_pair
+    outs = _run_pair(_SHM_ROUTE_CHILD % {"repo": REPO}, timeout=240)
+    assert "SHMR0_OK" in outs[0]
+    assert "SHMR1_OK" in outs[1]
+
+
+@pytest.mark.chaos
+def test_shm_segment_kill_falls_back_to_bulk_and_revives():
+    from test_fabric import _run_pair
+    outs = _run_pair(_SHM_KILL_CHILD % {"repo": REPO, "mode": "kill"},
+                     timeout=300)
+    assert "SK0_OK" in outs[0]
+    assert "SK1_OK" in outs[1]
+
+
+@pytest.mark.chaos
+def test_shm_producer_crash_mid_slot_falls_back_and_revives():
+    from test_fabric import _run_pair
+    outs = _run_pair(_SHM_KILL_CHILD % {"repo": REPO, "mode": "midslot"},
+                     timeout=300)
+    assert "SK0_OK" in outs[0]
+    assert "SK1_OK" in outs[1]
+
+
+@pytest.mark.chaos
+def test_shm_refused_handshake_degrades_to_bulk():
+    from test_fabric import _run_pair
+    outs = _run_pair(_SHM_REFUSE_CHILD % {"repo": REPO}, timeout=240)
+    assert "SR0_OK" in outs[0]
+    assert "SR1_OK" in outs[1]
+
+
+def test_streaming_rides_shm_ring_byte_exact():
+    from test_fabric import _run_pair
+    outs = _run_pair(_SHM_STREAM_CHILD % {"repo": REPO}, timeout=240)
+    assert "SS0_OK" in outs[0]
+    assert "SS1_OK" in outs[1]
+
+
+@pytest.mark.chaos
+def test_shm_kill_mid_stream_with_batched_descriptors_loses_nothing():
+    """Segment kill while descriptors for published ring frames are
+    still COALESCED unflushed: every frame must still arrive byte-exact
+    (the retired ring stays claimable after _F_SHM_DOWN), later frames
+    fall back to the socket bulk tier without a single client-visible
+    failure, and the ring revives for the tail."""
+    from test_fabric import _run_pair
+    outs = _run_pair(_SHM_STREAM_KILL_CHILD % {"repo": REPO},
+                     timeout=300)
+    assert "SKS0_OK" in outs[0]
+    assert "SKS1_OK" in outs[1]

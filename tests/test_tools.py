@@ -90,6 +90,41 @@ class TestRpcPress:
             for s, _t in pairs:
                 s.stop()
 
+    def test_press_bulk_plane_pin_sets_flags_and_reports(self):
+        """--bulk-plane pins the fabric byte-mover tier for the run:
+        "uds" turns the shm ring off, "inline" turns both descriptor
+        planes off, the pin is reported in the summary, and an unknown
+        mode is a hard CLI error."""
+        import pytest
+        import brpc_tpu.ici.fabric  # noqa: F401 — defines the flags
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.tools.rpc_press import apply_bulk_plane, run_press
+        saved = {k: _fl.get_flag(k) for k in ("ici_fabric_shm",
+                                              "ici_fabric_bulk")}
+        try:
+            apply_bulk_plane("uds")
+            assert _fl.get_flag("ici_fabric_shm") is False
+            assert _fl.get_flag("ici_fabric_bulk") == saved[
+                "ici_fabric_bulk"]
+            apply_bulk_plane("inline")
+            assert _fl.get_flag("ici_fabric_shm") is False
+            assert _fl.get_flag("ici_fabric_bulk") is False
+            with pytest.raises(SystemExit):
+                apply_bulk_plane("warp-drive")
+            server, target = start_server()
+            try:
+                result = run_press(
+                    target, "EchoService.Echo", '{"message":"p"}',
+                    qps=0, duration=0.2, concurrency=2,
+                    proto="tests.echo_pb2:EchoRequest,EchoResponse",
+                    bulk_plane="auto", out=io.StringIO())
+                assert result["bulk_plane"] == "auto"
+            finally:
+                server.stop()
+        finally:
+            for k, v in saved.items():
+                _fl.set_flag(k, v)
+
     def test_resolve_targets(self):
         """Endpoint lists split (single endpoints pass through); naming
         urls resolve through the naming service; an empty resolution is
