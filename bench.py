@@ -681,6 +681,200 @@ def bench_parallel_fanout_us(subs: int = 8, iters: int = 60,
             "subs": subs, "transport": transport}
 
 
+def bench_collective_fanout(subs: int = 8, iters: int = 80,
+                            shard: int = 512):
+    """ISSUE 11 tentpole: the 8-way partitioned fan-out as ONE compiled
+    SPMD program (scatter by sharded placement → N device-local handler
+    bodies → gather collective) vs the SAME call on the per-member RPC
+    loop — A/B in one run, routes asserted per call.
+
+    Three numbers:
+      * ``collective_p50_us`` — gather merge (the full scatter → N
+        handlers → ONE mesh gather), pre-sharded operand;
+      * ``collective_sharded_p50_us`` — MERGE_NONE: result stays
+        mesh-resident (the composition shape pipelines chain);
+      * ``fallback_p50_us`` — ici_fanout_collective=False, same call on
+        N per-member RPCs.
+    Needs >= ``subs`` devices (main() re-runs on the 8-virtual-device
+    CPU mesh off-TPU, labeled)."""
+    import jax
+
+    _pin_cpu_mesh_if_requested()
+    import numpy as np
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc, channels
+    from brpc_tpu.butil import flags as fl
+    from brpc_tpu.channels import collective_fanout as cf
+    from brpc_tpu.ici.mesh import IciMesh
+    from brpc_tpu.ici.route import collective_stats
+    sys.path.insert(0, "tests")
+    from tests.echo_pb2 import EchoRequest, EchoResponse
+
+    mesh = IciMesh.default()
+    if mesh.size < subs:
+        return {}
+
+    class FanEcho(rpc.Service):
+        SERVICE_NAME = "Fan"
+
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            cntl.response_attachment.append(
+                cntl.request_attachment.to_bytes())
+            done()
+
+        @rpc.method(EchoRequest, EchoResponse)
+        def EchoSharded(self, cntl, request, response, done):
+            cntl.response_attachment.append(
+                cntl.request_attachment.to_bytes())
+            done()
+
+    servers = []
+    for i in range(subs):
+        opts = rpc.ServerOptions()
+        opts.usercode_inline = True
+        s = rpc.Server(opts)
+        s.add_service(FanEcho())
+        s.register_collective("Fan.Echo", lambda x: x,
+                              merge=channels.MERGE_GATHER,
+                              mapping=channels.MAP_SHARD)
+        s.register_collective("Fan.EchoSharded", lambda x: x,
+                              merge=channels.MERGE_NONE,
+                              mapping=channels.MAP_SHARD)
+        s.start(f"ici://{i}")
+        servers.append(s)
+
+    def mk_pc(merge, shard_shape):
+        pc = channels.ParallelChannel()
+        mapper = channels.ShardingCallMapper()
+        merger = channels.CollectiveMerger(merge=merge, dtype="uint8",
+                                           shard_shape=shard_shape)
+        for i in range(subs):
+            ch = rpc.Channel()
+            ch.init(f"ici://{i}")
+            pc.add_channel(ch, mapper=mapper, merger=merger)
+        return pc
+
+    pc_gather = mk_pc(channels.MERGE_GATHER, (shard,))
+    pc_none = mk_pc(channels.MERGE_NONE, (shard,))
+    op_host = np.arange(subs * shard, dtype=np.uint8).reshape(subs, shard)
+    op_dev = cf.shard_operand(range(subs), op_host)
+    jax.block_until_ready(op_dev)
+
+    def measure(pc, op, method):
+        lat, routes = [], {}
+        for i in range(iters + 10):
+            cntl = rpc.Controller()
+            cntl.fanout_operand = op
+            t0 = time.perf_counter_ns()
+            pc.call_method(method, cntl, EchoRequest(message="f"),
+                           EchoResponse())
+            t1 = time.perf_counter_ns()
+            if cntl.failed():
+                routes["failed"] = routes.get("failed", 0) + 1
+                continue
+            routes[cntl.fanout_route] = routes.get(cntl.fanout_route,
+                                                   0) + 1
+            if i >= 10:
+                lat.append((t1 - t0) / 1000.0)
+        lat.sort()
+        return (lat[len(lat) // 2] if lat else -1.0,
+                lat[int(len(lat) * 0.99)] if lat else -1.0, routes)
+
+    coll_p50, coll_p99, coll_routes = measure(pc_gather, op_dev,
+                                              "Fan.Echo")
+    shd_p50, shd_p99, shd_routes = measure(pc_none, op_dev,
+                                           "Fan.EchoSharded")
+    fl.set_flag("ici_fanout_collective", False)
+    try:
+        fb_p50, fb_p99, fb_routes = measure(pc_gather, op_host,
+                                            "Fan.Echo")
+    finally:
+        fl.set_flag("ici_fanout_collective", True)
+    for s in servers:
+        s.stop()
+    return {
+        "devices": mesh.size,
+        "platform": jax.devices()[0].platform,
+        "subs": subs,
+        "shard_bytes": shard,
+        "collective_p50_us": round(coll_p50, 1),
+        "collective_p99_us": round(coll_p99, 1),
+        "collective_sharded_p50_us": round(shd_p50, 1),
+        "collective_sharded_p99_us": round(shd_p99, 1),
+        "fallback_p50_us": round(fb_p50, 1),
+        "fallback_p99_us": round(fb_p99, 1),
+        # the route-assertion surface: every timed collective call must
+        # say "collective", every fallback call "rpc"
+        "collective_routes": coll_routes,
+        "sharded_routes": shd_routes,
+        "fallback_routes": fb_routes,
+        "route_counters": collective_stats(),
+    }
+
+
+def bench_collective_single(iters: int = 200, shard: int = 512):
+    """The ≤3x acceptance's DENOMINATOR, measured alone: one single-call
+    py-handler echo (same attachment size as one fan-out shard) on the
+    same mesh platform the fan-out numbers run on — but in its OWN
+    process, because on a 1-core host the native channel's event thread
+    and the 8-device collective rendezvous contaminate each other when
+    co-measured (the fan-out subbench stays pure for the same reason)."""
+    import jax
+
+    _pin_cpu_mesh_if_requested()
+    import numpy as np
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc
+    from brpc_tpu.ici.mesh import IciMesh
+    sys.path.insert(0, "tests")
+    from tests.echo_pb2 import EchoRequest, EchoResponse
+
+    mesh = IciMesh.default()
+    if mesh.size < 2:
+        return {}
+
+    class FanEcho(rpc.Service):
+        SERVICE_NAME = "Fan"
+
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            cntl.response_attachment.append(
+                cntl.request_attachment.to_bytes())
+            done()
+
+    opts = rpc.ServerOptions()
+    opts.usercode_inline = True
+    s = rpc.Server(opts)
+    s.add_service(FanEcho())
+    s.start("ici://0")
+    ch = rpc.Channel()
+    ch.init("ici://0")
+    row = np.arange(shard, dtype=np.uint8).tobytes()
+    lat = []
+    for i in range(iters + 20):
+        cntl = rpc.Controller()
+        cntl.request_attachment.append(row)
+        t0 = time.perf_counter_ns()
+        ch.call_method("Fan.Echo", cntl, EchoRequest(message="s"),
+                       EchoResponse)
+        t1 = time.perf_counter_ns()
+        if not cntl.failed() and i >= 20:
+            lat.append((t1 - t0) / 1000.0)
+    s.stop()
+    lat.sort()
+    return {
+        "devices": mesh.size,
+        "platform": jax.devices()[0].platform,
+        "single_call_p50_us": round(lat[len(lat) // 2], 1) if lat
+        else -1.0,
+        "single_call_p99_us": round(lat[int(len(lat) * 0.99)], 1) if lat
+        else -1.0,
+    }
+
+
 def bench_qps(seconds: float = 2.0, concurrency: int = 32,
               transport: str = "mem"):
     import brpc_tpu.policy
@@ -1592,6 +1786,15 @@ def main() -> None:
     except Exception as e:  # pragma: no cover
         print(f"# ici fanout failed: {e}", file=sys.stderr)
         ifan = {}
+    # compiled collective fan-out (ISSUE 11): the same 8-way fan-out as
+    # ONE SPMD program, A/B'd against the per-member RPC loop in one
+    # run, routes asserted per call
+    cfan = _run_mesh_subbench("collective_fanout") if device_ok else {}
+    print(f"# collective fanout: {cfan}", file=sys.stderr)
+    cfan_base = _run_mesh_subbench("collective_single") if device_ok \
+        else {}
+    print(f"# collective fanout single-call baseline: {cfan_base}",
+          file=sys.stderr)
     try:
         # auto = the route table's pick; on this same-host pair that is
         # the SHM RING tier (route asserted in the result)
@@ -1773,6 +1976,33 @@ def main() -> None:
         "parallel_fanout8_p50_us": round(fan.get("fanout_p50_us", 0.0), 1),
         "parallel_fanout8_ici_p50_us": round(
             ifan.get("fanout_p50_us", -1.0), 1),
+        # compiled collective fan-out A/B (ISSUE 11): ONE SPMD program
+        # (scatter → 8 handler bodies → gather) vs the per-member RPC
+        # loop, same run; *_routes prove which route carried each leg
+        "fanout8_collective_p50_us": round(
+            cfan.get("collective_p50_us", -1.0), 1),
+        "fanout8_collective_p99_us": round(
+            cfan.get("collective_p99_us", -1.0), 1),
+        "fanout8_collective_sharded_p50_us": round(
+            cfan.get("collective_sharded_p50_us", -1.0), 1),
+        "fanout8_fallback_p50_us": round(
+            cfan.get("fallback_p50_us", -1.0), 1),
+        "fanout8_collective_route_ok": (
+            set(cfan.get("collective_routes", {})) == {"collective"}
+            and set(cfan.get("fallback_routes", {})) == {"rpc"}),
+        # same-mesh-platform single-call denominator (own process — see
+        # bench_collective_single) + the ratio the ≤3x acceptance bounds
+        "fanout8_single_call_p50_us": round(
+            cfan_base.get("single_call_p50_us", -1.0), 1),
+        "fanout8_collective_vs_single_ratio": (
+            round(cfan.get("collective_p50_us", -1.0)
+                  / cfan_base.get("single_call_p50_us", -1.0), 2)
+            if cfan.get("collective_p50_us", 0) > 0
+            and cfan_base.get("single_call_p50_us", 0) > 0 else -1.0),
+        "fanout8_collective_platform": cfan.get("platform",
+                                                "unavailable"),
+        "fanout8_collective_route_counters": cfan.get(
+            "route_counters", {}),
         "tail_isolation_ratio": round(
             tail.get("tail_isolation_ratio", -1.0), 3),
         "tail_isolation_ratio_raw": round(
@@ -1833,6 +2063,8 @@ if __name__ == "__main__":
               "ring_attention": bench_ring_attention,
               "rpcz_overhead": bench_rpcz_overhead,
               "overload": bench_overload,
+              "collective_fanout": bench_collective_fanout,
+              "collective_single": bench_collective_single,
               "pod_prefill_decode": bench_pod_prefill_decode}[sys.argv[2]]
         print(_json.dumps(fn()))
     else:

@@ -8,3 +8,6 @@ from .selective_channel import SelectiveChannel
 from .collective_lowering import (CollectiveChannel, MERGE_SUM, MERGE_GATHER,
                                   MERGE_CONCAT, MERGE_NONE, MAP_REPLICATE,
                                   MAP_SHARD)
+from .collective_fanout import (CollectiveFanoutPlane, CollectiveMerger,
+                                ShardingCallMapper, ReplicateFanoutMapper,
+                                register_device_handler)

@@ -25,12 +25,17 @@ from ..rpc.controller import Controller
 
 
 class SubCall:
-    """What CallMapper returns for one sub-channel."""
-    __slots__ = ("request", "skip")
+    """What CallMapper returns for one sub-channel.  ``attachment``
+    (bytes), when set, becomes the sub-call's request attachment — the
+    wire half of a scattered fan-out operand (collective_fanout.py's
+    ShardingCallMapper)."""
+    __slots__ = ("request", "skip", "attachment")
 
-    def __init__(self, request: Any = None, skip: bool = False):
+    def __init__(self, request: Any = None, skip: bool = False,
+                 attachment: Optional[bytes] = None):
         self.request = request
         self.skip = skip
+        self.attachment = attachment
 
     @staticmethod
     def skip_call() -> "SubCall":
@@ -38,6 +43,16 @@ class SubCall:
 
 
 class CallMapper:
+    # Lowerability contract (collective_fanout.py): a mapper opts into
+    # the compiled route by declaring ``collective_mapping`` ("replicate"
+    # or "shard") AND implementing ``map_fanout`` (the RPC-loop half
+    # that carries the operand — a degrade mid-call must reproduce the
+    # same bytes).  This base class has neither, so it always rides the
+    # per-member loop; ReplicateFanoutMapper / ShardingCallMapper are
+    # the opt-ins.  A subclass with a custom map() and no declaration
+    # likewise refuses — inheritance must never smuggle an unknown
+    # map() into a lowering.
+
     def map(self, channel_index: int, method_full_name: str,
             request: Any) -> SubCall:
         return SubCall(request)             # default: replicate
@@ -49,7 +64,12 @@ class ResponseMerger:
     FAIL_ALL = 2
 
     def merge(self, response: Any, sub_response: Any) -> int:
-        """Fold sub_response into response; default: protobuf MergeFrom."""
+        """Fold sub_response into response; default: protobuf MergeFrom.
+        Mergers may instead implement ``merge_sub(parent_cntl, index,
+        sub_cntl, response)`` to see the sub-call's INDEX and controller
+        (attachment-carrying fan-outs merge by index, never arrival
+        order), plus ``finalize_fanout(parent_cntl)`` run once when the
+        whole fan-out succeeded."""
         if response is not None and hasattr(response, "MergeFrom"):
             response.MergeFrom(sub_response)
             return self.MERGED
@@ -78,17 +98,51 @@ class ParallelChannel:
             cntl.set_failed(errors.EINVAL, "no sub channels")
             if done: done(cntl)
             return None
+        # Compiled collective route (collective_fanout.py): when every
+        # sub targets a pod member with a registered device handler and
+        # the operand/mapper/merger lower, the WHOLE fan-out+merge runs
+        # as one cached SPMD program — and any mid-fan-out failure falls
+        # through HERE, completing on the per-member loop below with the
+        # route already marked down (zero client-visible failures).
+        from . import collective_fanout as _cf
+        if _cf.maybe_call(self, method_full_name, cntl, request,
+                          response, done):
+            return response if done is None else None
         fail_limit = self.fail_limit if self.fail_limit > 0 else n
-        state = _ParallelCallState(cntl, response, n, fail_limit, done)
+        # finalizer lookup only for operand fan-outs: the common plain
+        # protobuf fan-out must not pay a per-call merger scan
+        finalizer = None
+        if cntl.__dict__.get("fanout_operand") is not None:
+            finalizer = next(
+                (m for _, _, m in self._subs
+                 if hasattr(m, "finalize_fanout")), None)
+        state = _ParallelCallState(cntl, response, n, fail_limit, done,
+                                   finalizer=finalizer)
 
         import time
         cntl._start_us = time.monotonic_ns() // 1000
         for i, (chan, mapper, merger) in enumerate(self._subs):
-            sub = mapper.map(i, method_full_name, request)
+            try:
+                mf = getattr(mapper, "map_fanout", None)
+                if mf is not None \
+                        and cntl.__dict__.get("fanout_operand") is not None:
+                    sub = mf(i, method_full_name, request, cntl)
+                else:
+                    sub = mapper.map(i, method_full_name, request)
+            except Exception as e:
+                # a raising mapper (operand/sub-count mismatch, a user
+                # bug) fails ITS sub-call, never the whole issue loop
+                bad = Controller()
+                bad.set_failed(errors.EREQUEST,
+                               f"CallMapper failed for sub {i}: {e}")
+                state.on_sub_done(i, merger, bad)
+                continue
             if sub.skip:
                 state.on_skip()
                 continue
             sub_cntl = Controller()
+            if sub.attachment is not None:
+                sub_cntl.request_attachment.append(sub.attachment)
             sub_cntl.timeout_ms = cntl.timeout_ms
             sub_cntl.max_retry = cntl.max_retry
             sub_cntl.log_id = cntl.log_id
@@ -130,7 +184,7 @@ class ParallelChannel:
 
 class _ParallelCallState:
     def __init__(self, cntl: Controller, response: Any, total: int,
-                 fail_limit: int, done):
+                 fail_limit: int, done, finalizer=None):
         self.cntl = cntl
         self.response = response
         self.total = total
@@ -139,13 +193,19 @@ class _ParallelCallState:
         self.lock = threading.Lock()
         self.finished = 0
         self.failed = 0
+        self.skipped = 0
         self.ended = False
         self.event = threading.Event()
         self.sub_errors: List[int] = []
+        # one finalize per fan-out (operand fan-outs only): the merger
+        # exposing finalize_fanout runs once at success end — the
+        # index-ordered merge of attachment-carrying fan-outs
+        self.finalizer = finalizer
 
     def on_skip(self) -> None:
         with self.lock:
             self.total -= 1
+            self.skipped += 1
             if self.finished >= self.total:
                 self._maybe_end_locked()
 
@@ -160,8 +220,16 @@ class _ParallelCallState:
                 self.sub_errors.append(sub_cntl.error_code_)
             else:
                 try:
-                    rc = merger.merge(self.response, sub_cntl.response)
+                    ms = getattr(merger, "merge_sub", None)
+                    if ms is not None:
+                        rc = ms(self.cntl, index, sub_cntl,
+                                self.response)
+                    else:
+                        rc = merger.merge(self.response, sub_cntl.response)
                 except Exception as e:
+                    from ..butil import logging as log
+                    log.warning("fan-out merge failed for sub %d: %s",
+                                index, e)
                     rc = ResponseMerger.FAIL
                 if rc == ResponseMerger.FAIL:
                     self.failed += 1
@@ -185,6 +253,25 @@ class _ParallelCallState:
     def _end_locked(self) -> None:
         self.ended = True
         import time
+        if self.finalizer is not None and not self.cntl.failed():
+            if self.failed or self.skipped:
+                # index-merged collective semantics are all-or-nothing:
+                # a gather/sum missing a shard — whether its sub FAILED
+                # or was mapper-SKIPPED — is WRONG data, not a partial
+                # success; it must not yield a silently truncated
+                # fanout_result
+                self.cntl.set_failed(
+                    errors.ERESPONSE,
+                    f"fan-out merge incomplete: {self.failed} failed / "
+                    f"{self.skipped} skipped sub-call(s) before merge: "
+                    f"{self.sub_errors[:4]}")
+            else:
+                try:
+                    self.finalizer.finalize_fanout(self.cntl)
+                except Exception as e:
+                    self.cntl.set_failed(
+                        errors.ERESPONSE,
+                        f"fan-out finalize failed: {e}")
         self.cntl.latency_us = time.monotonic_ns() // 1000 - self.cntl._start_us
         self.cntl.response = self.response
         self.event.set()

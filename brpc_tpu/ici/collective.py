@@ -23,16 +23,19 @@ class Collectives:
     def __init__(self, mesh: Optional[IciMesh] = None):
         self.mesh = mesh or IciMesh.default()
         self._cache: Dict[Tuple, Callable] = {}
+        self._building: Dict[Tuple, threading.Event] = {}
         self._cache_lock = threading.Lock()
 
     # -- plumbing --------------------------------------------------------
     def _cached(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
-        with self._cache_lock:
-            fn = self._cache.get(key)
-            if fn is None:
-                fn = builder()
-                self._cache[key] = fn
-            return fn
+        """Compile-or-fetch with the build OUTSIDE the cache lock: an
+        XLA compile can take seconds, and holding ``_cache_lock`` across
+        it blocked every OTHER key's lookup for the duration (ISSUE 11
+        satellite bugfix; the once-guard idiom lives in
+        butil/once_cache.py, shared with the fan-out plane's cache)."""
+        from ..butil.once_cache import build_once
+        return build_once(self._cache_lock, self._cache, self._building,
+                          key, builder)
 
     def _shard_map(self, fn, in_spec, out_spec):
         import jax

@@ -227,6 +227,23 @@ _F_SHM_REESTABLISH = 18   # json: {shm_seg, shm_bytes} — client created
                           # a fresh segment for the server to attach
 _F_SHM_OK = 19            # server attached (and unlinked) the segment
 _F_SHM_ERR = 20           # attach failed/refused; client backs off
+# Compiled collective fan-out announce (channels/collective_fanout.py):
+# the fan-out client is the order master — it commits a fan-out group at
+# a dense seq and announces it over each remote member's control channel
+# (FIFO per member, so every member observes the client's order); the
+# member accepts (PARKING the SPMD entry until the client's commit) or
+# refuses with a reason, and a refusal/timeout degrades the client's
+# collective route in-call.  Two-phase: only after EVERY member accepted
+# does the client send GO — an accepted member must never enter a
+# program a degraded client will not join (its serial entry runner
+# would wedge on the rendezvous forever); parked entries expire on the
+# announce timeout.  Older peers ignore unknown frame types; the client
+# then degrades on the announce timeout — compatible both ways.
+_F_COLL_CALL = 21    # json: {method, seq, devices, mapping, merge,
+                     #        shape, dtype, uuid}
+_F_COLL_OK = 22      # json: {uuid, pid} — member accepted + parked entry
+_F_COLL_ERR = 23     # json: {uuid, pid, reason} — refused, degrade
+_F_COLL_GO = 24      # json: {uuid} — commit: the parked entry runs
 # Clock alignment (ici/clock.py) deliberately adds NO frame type: the
 # NTP-style exchange piggybacks on the HELLO/HELLO_OK handshake (the
 # client's wall t0 rides the HELLO json; HELLO_OK echoes it with the
@@ -2267,6 +2284,18 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     seqr = self._dplane_sequencer()
                     if seqr is not None:
                         seqr.on_assignment(u, s)
+                elif ftype == _F_COLL_CALL:
+                    from ..channels import collective_fanout as _cf
+                    _cf.on_remote_announce(self, json.loads(body))
+                elif ftype == _F_COLL_OK:
+                    from ..channels import collective_fanout as _cf
+                    _cf.on_remote_reply(self, json.loads(body), ok=True)
+                elif ftype == _F_COLL_ERR:
+                    from ..channels import collective_fanout as _cf
+                    _cf.on_remote_reply(self, json.loads(body), ok=False)
+                elif ftype == _F_COLL_GO:
+                    from ..channels import collective_fanout as _cf
+                    _cf.on_remote_go(self, json.loads(body))
                 elif ftype == _F_FIN:
                     if len(body) >= 4:
                         # the peer closed with an explicit code (lame-duck

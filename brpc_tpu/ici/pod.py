@@ -54,14 +54,20 @@ DOWN = "down"
 
 
 class PodMember:
-    """One member record as read from the KV (immutable snapshot)."""
+    """One member record as read from the KV (immutable snapshot).
+    ``coll`` lists the method names this member registered DEVICE-SIDE
+    handlers for (``Server.register_collective``) — the capability half
+    of the compiled fan-out handshake: a client only lowers a fan-out
+    onto members advertising the method (records written by older
+    builds simply advertise none)."""
 
     __slots__ = ("pid", "gen", "state", "devices", "serving", "draining",
-                 "ctrl", "ts")
+                 "ctrl", "ts", "coll")
 
     def __init__(self, pid: int, gen: int, state: str,
                  devices: List[int], serving: List[int],
-                 draining: List[int], ctrl: str = "", ts: float = 0.0):
+                 draining: List[int], ctrl: str = "", ts: float = 0.0,
+                 coll: Optional[List[str]] = None):
         self.pid = pid
         self.gen = gen
         self.state = state
@@ -70,6 +76,7 @@ class PodMember:
         self.draining = draining
         self.ctrl = ctrl
         self.ts = ts
+        self.coll = coll or []
 
     @classmethod
     def from_json(cls, raw: str) -> "PodMember":
@@ -77,19 +84,20 @@ class PodMember:
         return cls(d["pid"], d["gen"], d.get("state", UP),
                    d.get("devices", []), d.get("serving", []),
                    d.get("draining", []), d.get("ctrl", ""),
-                   d.get("ts", 0.0))
+                   d.get("ts", 0.0), d.get("coll", []))
 
     def to_json(self) -> str:
         return json.dumps({
             "pid": self.pid, "gen": self.gen, "state": self.state,
             "devices": self.devices, "serving": self.serving,
             "draining": self.draining, "ctrl": self.ctrl, "ts": self.ts,
+            "coll": self.coll,
         })
 
     def describe(self) -> dict:
         return {"pid": self.pid, "gen": self.gen, "state": self.state,
                 "devices": self.devices, "serving": self.serving,
-                "draining": self.draining}
+                "draining": self.draining, "coll": self.coll}
 
 
 def epoch_of(members: Dict[int, PodMember]) -> int:
@@ -117,6 +125,7 @@ class Pod:
         "_draining_devs": "_lock",
         "_state": "_lock",
         "_watchers": "_lock",
+        "_coll": "_lock",
     }
 
     def __init__(self, name: str, node) -> None:
@@ -130,6 +139,7 @@ class Pod:
         self._state = DOWN
         self._serving: List[int] = []
         self._draining_devs: List[int] = []
+        self._coll: List[str] = []
         self._members: Dict[int, PodMember] = {}
         self._watchers: List[Callable[[Dict[int, PodMember]], None]] = []
         self._stop = threading.Event()
@@ -181,11 +191,22 @@ class Pod:
         # — the epoch is the sum of gens and may NEVER regress, or every
         # peer's wait_epoch convergence primitive times out.
         prior = self._refresh().get(self.pid)
+        # collective capability registered BEFORE the join (a server
+        # built its method table first) rides the join publish — without
+        # this the record says coll=[] forever and remote clients'
+        # compiled-fan-out screens silently never engage on this member
+        try:
+            from ..channels.collective_fanout import registry as _cfreg
+            coll = _cfreg().method_names()
+        except Exception:
+            coll = []
         with self._lock:
             if prior is not None and prior.gen > self._gen:
                 self._gen = prior.gen
             self._gen += 1
             self._state = UP
+            if coll:
+                self._coll = coll
         self._publish()
         self._refresh()
         # fablint: thread-quiesced(leave() sets _stop and joins; the watch loop checks it every poll)
@@ -231,7 +252,8 @@ class Pod:
                 rec = PodMember(self.pid, self._gen, self._state,
                                 list(self._devices), list(self._serving),
                                 list(self._draining_devs),
-                                ctrl=self.node.ctrl_addr, ts=time.time())
+                                ctrl=self.node.ctrl_addr, ts=time.time(),
+                                coll=list(self._coll))
             self._kv.key_value_set(self._key(self.pid), rec.to_json(),
                                    allow_overwrite=True)
 
@@ -264,6 +286,19 @@ class Pod:
                 self._serving.remove(device_id)
             if device_id in self._draining_devs:
                 self._draining_devs.remove(device_id)
+            self._gen += 1
+        self._publish()
+
+    def publish_collective(self, methods: List[str]) -> None:
+        """Advertise the process's registered device-side handler
+        methods (the compiled fan-out capability handshake).  A no-op
+        when nothing changed; otherwise a gen bump — peers whose
+        collective route degraded on this member re-screen at the epoch
+        move, exactly like a serving transition."""
+        with self._lock:
+            if methods == self._coll:
+                return
+            self._coll = list(methods)
             self._gen += 1
         self._publish()
 

@@ -112,3 +112,41 @@ def route_stats() -> dict:
         items = list(_counters.items())
     return {label: {"frames": f.get_value(), "bytes": b.get_value()}
             for label, (f, b) in items}
+
+
+# ---- the COLLECTIVE route (channels/collective_fanout.py) --------------
+#
+# Not a byte mover, so it is not a row in candidates(): a compiled
+# fan-out is an SPMD program every participant enters, selected by the
+# plane's own screen BEFORE any per-member RPC is issued.  What the
+# table owns is its observability — the selected/degraded/revived
+# event counters (per degrade reason), same publish-once/read-lock-free
+# discipline as the byte-route pair above.  Event Adders are named
+# ``rpc_fabric_route_collective_<event>[_<reason>]`` so they surface in
+# /vars alongside the byte-route counters.
+
+_events = {}
+
+
+def record_collective(event: str, reason: str = "", n: int = 1) -> None:
+    """Count one collective-route event (``selected``, ``degraded``,
+    ``revived``, ``ineligible``, ``member_entries``, ...) with an
+    optional reason suffix."""
+    label = f"collective_{event}" + (f"_{reason}" if reason else "")
+    adder = _events.get(label)
+    if adder is None:
+        with _counters_lock:
+            adder = _events.get(label)
+            if adder is None:
+                from .. import bvar
+                adder = _events[label] = bvar.Adder(
+                    name=f"rpc_fabric_route_{label}")
+    adder << n
+
+
+def collective_stats() -> dict:
+    """Snapshot {event_label: count} for /ici, bench extra, and the
+    tools' route assertions."""
+    with _counters_lock:
+        items = list(_events.items())
+    return {label: a.get_value() for label, a in items}

@@ -52,6 +52,12 @@ class LoadBalancer:
     def server_count(self) -> int:
         raise NotImplementedError
 
+    def servers(self) -> List[ServerEntry]:
+        """Membership snapshot (screens/tools — e.g. the collective
+        fan-out screen resolving a single-server partition to its
+        ici:// device)."""
+        return []
+
 
 # Every live LB, weakly held: the lame-duck registry uses this to pull a
 # draining endpoint (GOODBYE) from ALL balancers at once — proactive
@@ -103,6 +109,10 @@ class _ListLB(LoadBalancer):
     def server_count(self) -> int:
         with self._dbd.read() as lst:
             return len(lst)
+
+    def servers(self) -> List[ServerEntry]:
+        with self._dbd.read() as lst:
+            return list(lst)
 
     def exclude(self, ep: EndPoint, until_ts: float) -> None:
         with self._excl_lock:

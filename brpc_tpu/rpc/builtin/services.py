@@ -340,10 +340,22 @@ def _ici(server, q):
         # per-route byte-mover counters (ici/route.py): which plane
         # carried how many frames/bytes — shm / uds / tcp / xfer /
         # dplane / inline
-        from ...ici.route import route_stats
+        from ...ici.route import route_stats, collective_stats
         rs = route_stats()
         if rs:
             out["routes"] = rs
+        cs = collective_stats()
+        if cs:
+            out["collective_route_events"] = cs
+    except Exception:
+        pass
+    try:
+        # compiled fan-out plane: health, entry order cursor, compile
+        # cache, registered device-handler methods
+        from ...channels import collective_fanout as _cf
+        if _cf.registry().method_names() \
+                or _cf.CollectiveFanoutPlane._instance is not None:
+            out["collective_fanout"] = _cf.describe()
     except Exception:
         pass
     try:

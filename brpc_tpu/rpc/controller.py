@@ -73,6 +73,15 @@ class Controller:
     tenant: str = ""
     retry_after_ms: int = 0
     deadline_left_ms: int = 0       # server side: budget at arrival
+    # compiled fan-out call state (channels/collective_fanout.py): the
+    # typed array operand the caller scatters across a Parallel/
+    # Partition fan-out, the merged result, and which route actually
+    # carried the call ("collective" = one compiled SPMD program,
+    # "rpc" = the per-member loop, "" = not an operand fan-out) — the
+    # route assertion surface for bench/tools/tests
+    fanout_operand: Any = None
+    fanout_result: Any = None
+    fanout_route: str = ""
     request_attachment = _LazyField("request_attachment", IOBuf)
     response_attachment = _LazyField("response_attachment", IOBuf)
     remote_side: Optional[EndPoint] = None

@@ -158,6 +158,16 @@ class FabricFaultPlan:
       refuse_shm_handshakes       refuse the next N shm attach
                                   handshakes (HELLO piggyback or
                                   _F_SHM_REESTABLISH)
+      collective_kill_device      refuse every compiled fan-out whose
+                                  participant set contains this device —
+                                  the "member killed mid-fan-out" fault:
+                                  the collective route degrades in-call
+                                  to per-member RPCs and revives only on
+                                  an epoch bump (clear the plan + the
+                                  member re-advertises)
+      collective_fail_execs       refuse the next N compiled fan-out
+                                  executions regardless of participants
+                                  (transient execution failure)
 
     ``injected`` counts what actually fired, keyed by knob name."""
 
@@ -176,7 +186,9 @@ class FabricFaultPlan:
                  shm_kill_now: bool = False,
                  shm_sever_after_bytes: int = 0,
                  shm_drop_frames: int = 0,
-                 refuse_shm_handshakes: int = 0):
+                 refuse_shm_handshakes: int = 0,
+                 collective_kill_device: Optional[int] = None,
+                 collective_fail_execs: int = 0):
         self.match = match
         self.control_sever_after_frames = control_sever_after_frames
         self.control_drop_ratio = control_drop_ratio
@@ -192,6 +204,8 @@ class FabricFaultPlan:
         self.shm_sever_after_bytes = shm_sever_after_bytes
         self.shm_drop_frames = shm_drop_frames
         self._refuse_shm = refuse_shm_handshakes
+        self.collective_kill_device = collective_kill_device
+        self._fail_coll_execs = collective_fail_execs
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._ctrl_out = 0           # outbound control frames seen
@@ -199,7 +213,7 @@ class FabricFaultPlan:
         self.injected = {"control_sever": 0, "control_drop": 0,
                          "bulk_chaos": 0, "refuse_bulk": 0,
                          "refuse_hello": 0, "die": 0, "device_plane": 0,
-                         "shm_chaos": 0, "refuse_shm": 0}
+                         "shm_chaos": 0, "refuse_shm": 0, "collective": 0}
 
     def _matches(self, socket) -> bool:
         return self.match is None or bool(self.match(socket))
@@ -303,6 +317,23 @@ class FabricFaultPlan:
                 self.injected["refuse_bulk"] += 1
                 return True
         return False
+
+    def on_collective_execute(self, devices=()) -> Optional[str]:
+        """Refusal reason (the fan-out degrades in-call to per-member
+        RPCs) or None.  Fires BETWEEN the screen and the program entry —
+        the mid-fan-out window — like a participant dying after the
+        client committed to the compiled route."""
+        with self._lock:
+            if self.collective_kill_device is not None \
+                    and self.collective_kill_device in devices:
+                self.injected["collective"] += 1
+                return (f"member ici://{self.collective_kill_device} "
+                        f"killed mid-fan-out")
+            if self._fail_coll_execs > 0:
+                self._fail_coll_execs -= 1
+                self.injected["collective"] += 1
+                return "injected collective execution failure"
+        return None
 
     def on_device_post(self, socket=None) -> bool:
         """True → refuse this device-plane post_send (the WR fails before

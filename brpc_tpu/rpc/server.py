@@ -120,8 +120,34 @@ class Server:
         self._thread_local = threading.local()
         self.usercode_pool = None        # usercode_in_pthread backup pool
         self.admission = None            # AdmissionController when enabled
+        self._collective_regs: List[str] = []   # register_collective names
+        self._collective_served: List[int] = []  # devices marked serving
 
     # ---- registry -----------------------------------------------------
+    def register_collective(self, method_full_name: str, handler,
+                            merge: str = "gather", mapping: str = "shard",
+                            takes_index: bool = False) -> None:
+        """Attach a DEVICE-SIDE handler body to a served method: the
+        compiled fan-out plane (channels/collective_fanout.py) runs it
+        as one shard of the single SPMD program a Parallel/Partition
+        call lowers to, with ``merge``/``mapping`` the collective
+        contract the client's merger/mapper must match.  The normal
+        (wire) service method stays the fallback body — the per-member
+        RPC loop any degrade completes on.  When this server starts on
+        ``ici://k``, device k advertises the capability (and the pod
+        record carries it to remote members)."""
+        from ..channels import collective_fanout as _cf
+        _cf.register_device_handler(method_full_name, handler,
+                                    merge=merge, mapping=mapping,
+                                    takes_index=takes_index)
+        self._collective_regs.append(method_full_name)
+        if self._started:
+            for ep in self._listen_endpoints:
+                if ep.scheme == "ici" \
+                        and ep.device_id not in self._collective_served:
+                    _cf.registry().serve(ep.device_id)
+                    self._collective_served.append(ep.device_id)
+
     def add_service(self, svc) -> int:
         if self._started:
             raise RuntimeError("cannot add service after start")
@@ -391,6 +417,14 @@ class Server:
             _pod.on_server_started(ep)
         except Exception:
             pass
+        if self._collective_regs and ep.scheme == "ici" \
+                and ep.device_id not in self._collective_served:
+            # compiled fan-out capability: this device serves the
+            # registered device handlers (epoch bump — a degraded
+            # collective route re-probes on the revival advertise)
+            from ..channels import collective_fanout as _cf
+            _cf.registry().serve(ep.device_id)
+            self._collective_served.append(ep.device_id)
         if self.options.graceful_quit_on_sigterm:
             if not lameduck.enable_graceful_quit(self):
                 # the hook only installs from the main thread — the
@@ -636,6 +670,11 @@ class Server:
                 _pod.on_server_stopped(ep)
             except Exception:
                 pass
+        if self._collective_served:
+            from ..channels import collective_fanout as _cf
+            served, self._collective_served = self._collective_served, []
+            for dev in served:
+                _cf.registry().withdraw(dev)
 
     # ---- drain machinery ----------------------------------------------
     def _send_goodbyes(self) -> None:

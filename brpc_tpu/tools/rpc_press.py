@@ -107,6 +107,102 @@ def apply_bulk_plane(mode: str) -> None:
         _fl.set_flag("ici_fabric_bulk", False)
 
 
+def run_press_fanout(server: str, method: str, n: int,
+                     duration: float = 5.0, concurrency: int = 2,
+                     shard_bytes: int = 512, out=sys.stderr) -> dict:
+    """``--fanout N``: drive ONE ParallelChannel over the first N
+    resolved members (pod://name, mesh://, a comma list) with a
+    sharded operand per call — the compiled collective route where the
+    members registered a device handler, the per-member RPC loop where
+    they did not (or the route degraded).  The summary reports fan-out
+    p50/p99 plus PER-ROUTE call counts and the route-table event
+    counters, so a degraded pod is visible from the load generator."""
+    import numpy as np
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc, bvar, channels
+    targets = resolve_targets(server)
+    if len(targets) < n:
+        raise SystemExit(f"rpc_press: --fanout {n} needs {n} members, "
+                         f"resolved {len(targets)}")
+    targets = targets[:n]
+    pc = channels.ParallelChannel()
+    mapper = channels.ShardingCallMapper()
+    merger = channels.CollectiveMerger(merge=channels.MERGE_GATHER,
+                                       dtype="uint8",
+                                       shard_shape=(shard_bytes,))
+    for t in targets:
+        ch = rpc.Channel()
+        ch.init(t, options=rpc.ChannelOptions(timeout_ms=10000))
+        pc.add_channel(ch, mapper=mapper, merger=merger)
+    op = np.arange(n * shard_bytes, dtype=np.uint8).reshape(n,
+                                                            shard_bytes)
+    recorder = bvar.LatencyRecorder()
+    sent = [0]
+    errors_count = [0]
+    routes: dict = {}
+    lock = threading.Lock()
+    deadline = time.monotonic() + duration
+    stop_evt = threading.Event()
+    prev_sigint = None
+    try:
+        prev_sigint = signal.signal(signal.SIGINT,
+                                    lambda *_: stop_evt.set())
+    except ValueError:
+        pass
+
+    def worker():
+        while not stop_evt.is_set() and time.monotonic() < deadline:
+            cntl = rpc.Controller()
+            cntl.fanout_operand = op
+            t0 = time.perf_counter_ns()
+            pc.call_method(method, cntl, b"", None)
+            lat_us = (time.perf_counter_ns() - t0) // 1000
+            route = cntl.fanout_route or "none"
+            with lock:
+                sent[0] += 1
+                routes[route] = routes.get(route, 0) + 1
+                if cntl.failed():
+                    errors_count[0] += 1
+                else:
+                    recorder << lat_us
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(max(concurrency, 1))]
+    t_start = time.monotonic()
+    for t in threads: t.start()
+    for t in threads: t.join()
+    elapsed = time.monotonic() - t_start
+    if prev_sigint is not None:
+        try:
+            signal.signal(signal.SIGINT, prev_sigint)
+        except ValueError:
+            pass
+    from brpc_tpu.bvar import SamplerCollector
+    SamplerCollector.instance().sample_once()
+    result = {
+        "fanout": n,
+        "members": targets,
+        "sent": sent[0],
+        "errors": errors_count[0],
+        "qps": round(sent[0] / elapsed, 1) if elapsed else 0.0,
+        "fanout_p50_us": recorder.latency_percentile(0.5),
+        "fanout_p99_us": recorder.latency_percentile(0.99),
+        "avg_latency_us": round(recorder.latency(), 1),
+        "per_route": routes,
+        "interrupted": stop_evt.is_set(),
+    }
+    try:
+        from brpc_tpu.ici.route import collective_stats
+        cs = collective_stats()
+        if cs:
+            result["route_counters"] = cs
+    except Exception:
+        pass
+    print(json.dumps(result), file=out)
+    return result
+
+
 def run_press(server: str, method: str, request_json: str,
               qps: int = 0, duration: float = 5.0, concurrency: int = 8,
               proto: Optional[str] = None, protocol: str = "tpu_std",
@@ -293,7 +389,22 @@ def main(argv=None) -> int:
                          "(route table: shm > uds/tcp > inline), shm, "
                          "uds (shm off), inline (both descriptor planes "
                          "off); the summary reports per-route counters")
+    ap.add_argument("--fanout", type=int, default=0,
+                    help="drive ONE ParallelChannel over the first N "
+                         "resolved members (compiled collective route "
+                         "where registered, per-member RPCs otherwise); "
+                         "summary adds fan-out p50/p99 and per-route "
+                         "call counts")
+    ap.add_argument("--fanout-shard-bytes", type=int, default=512,
+                    help="bytes per member shard in --fanout mode")
     args = ap.parse_args(argv)
+    if args.fanout > 0:
+        run_press_fanout(args.server, args.method, args.fanout,
+                         duration=args.duration,
+                         concurrency=args.concurrency,
+                         shard_bytes=args.fanout_shard_bytes,
+                         out=sys.stdout)
+        return 0
     run_press(args.server, args.method, args.request, args.qps,
               args.duration, args.concurrency, args.proto, args.protocol,
               priority=args.priority, tenant=args.tenant,

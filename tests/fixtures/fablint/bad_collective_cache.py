@@ -1,0 +1,28 @@
+"""Seeded violation for the compiled fan-out plane's state: a compile-
+cache insert outside the plane lock — the exact shape of
+CollectiveFanoutPlane._programs / _building (ISSUE 11), whose
+once-guarded build-outside-the-lock discipline fablint must keep honest
+(an unguarded insert silently drops a concurrent builder's entry AND
+corrupts the LRU ordering under contention)."""
+import threading
+
+
+class FanoutPlane:
+    _GUARDED_BY = {"_programs": "_lock", "_building": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs = {}
+        self._building = {}
+
+    def insert_locked(self, key, fn) -> None:
+        with self._lock:
+            self._programs[key] = fn
+            self._building.pop(key, None)
+
+    def insert_racy(self, key, fn) -> None:
+        self._programs[key] = fn       # line 24: the violation
+
+    def lookup(self, key):
+        with self._lock:
+            return self._programs.get(key)

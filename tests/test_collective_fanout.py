@@ -1,0 +1,720 @@
+"""Compiled collective fan-out (channels/collective_fanout.py): the
+Parallel/Partition combo-channel call as ONE SPMD program.
+
+Legs:
+
+  * **screen units** — ineligible shapes (unregistered method, custom
+    mapper, merge mismatch, wrong shard count, non-ici target) decline
+    the compiled route and ride the per-member RPC loop untouched;
+  * **parity** — compiled route vs per-member RPC loop byte-exact on
+    the same call, for shard/replicate mappings and gather/sum merges,
+    plus the xproc program shape (zeros rows + psum broadcast — what a
+    multi-controller pod enters) against the local placement leg;
+  * **chaos** (the acceptance contract) — kill one pod member
+    MID-FAN-OUT (FabricFaultPlan.collective_kill_device fires between
+    the sequencer slot and the program entry): the call degrades
+    in-call to per-member RPCs with ZERO client-visible failures, the
+    route stays down (fault cleared alone is not revival), and the
+    member re-advertising (epoch bump) restores the compiled route —
+    N=4 in tier-1, N=8 slow-marked;
+  * **once-guard** — the Collectives._cached / fan-out compile-cache
+    fix: a slow builder must not block other keys' lookups (regression
+    pin for the satellite bugfix);
+  * **2-process** — a fan-out spanning a REAL remote pod member:
+    declined cleanly off-TPU (xproc_uncompiled), and with the compiled
+    leg forced on, the _F_COLL_CALL announce reaches the member, the
+    member refuses entry (no multi-controller backend on CPU), and the
+    client degrades in-call with zero visible failures.
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc, channels
+from brpc_tpu.butil import flags as fl
+from brpc_tpu.channels import collective_fanout as cf
+from brpc_tpu.ici import route as iroute
+from brpc_tpu.rpc import fault_injection as fi
+from tests.echo_pb2 import EchoRequest, EchoResponse
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.collective
+
+SHARD = 128
+
+
+class FanSvc(rpc.Service):
+    """Wire fallback body: the same x*2 semantics the device handler
+    compiles — scatter rows ride request attachments, result shards
+    ride response attachments."""
+    SERVICE_NAME = "Fan"
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Scale(self, cntl, request, response, done):
+        x = np.frombuffer(cntl.request_attachment.to_bytes(), np.float32)
+        cntl.response_attachment.append(
+            (x * 2.0).astype(np.float32).tobytes())
+        response.message = "ok"
+        done()
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def Accum(self, cntl, request, response, done):
+        x = np.frombuffer(cntl.request_attachment.to_bytes(), np.float32)
+        cntl.response_attachment.append(
+            (x + 1.0).astype(np.float32).tobytes())
+        done()
+
+
+def _mk_server(dev: int):
+    s = rpc.Server()
+    s.add_service(FanSvc())
+    s.register_collective("Fan.Scale", lambda x: x * 2.0,
+                          merge=channels.MERGE_GATHER,
+                          mapping=channels.MAP_SHARD)
+    s.register_collective("Fan.Accum", lambda x: x + 1.0,
+                          merge=channels.MERGE_SUM,
+                          mapping=channels.MAP_REPLICATE)
+    assert s.start(f"ici://{dev}") == 0
+    return s
+
+
+def _mk_fanout(devs, method="Fan.Scale"):
+    pc = channels.ParallelChannel()
+    if method == "Fan.Scale":
+        mapper = channels.ShardingCallMapper()
+        merger = channels.CollectiveMerger(merge=channels.MERGE_GATHER,
+                                           dtype="float32",
+                                           shard_shape=(SHARD,))
+    else:
+        mapper = channels.ReplicateFanoutMapper()
+        merger = channels.CollectiveMerger(merge=channels.MERGE_SUM,
+                                           dtype="float32")
+    chans = []
+    for d in devs:
+        ch = rpc.Channel()
+        ch.init(f"ici://{d}")
+        pc.add_channel(ch, mapper=mapper, merger=merger)
+        chans.append(ch)
+    return pc
+
+
+def _call(pc, op, method="Fan.Scale"):
+    cntl = rpc.Controller()
+    cntl.fanout_operand = op
+    pc.call_method(method, cntl, EchoRequest(message="x"), EchoResponse())
+    assert not cntl.failed(), (cntl.error_code_, cntl.error_text_)
+    return cntl
+
+
+@pytest.fixture()
+def fan4():
+    servers = [_mk_server(i) for i in range(4)]
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def _plane_healthy():
+    """Tests must start route-up: a previous test's degrade would
+    otherwise leak into this one's route assertions."""
+    plane = cf.CollectiveFanoutPlane.instance()
+    if plane.health()["down"]:
+        # any registry transition moves the epoch
+        cf.registry().serve(99)
+        cf.registry().withdraw(99)
+        assert plane.route_usable()
+
+
+# ---------------------------------------------------------------------------
+# Screen units.
+# ---------------------------------------------------------------------------
+
+class TestScreen:
+    def test_plain_fanout_untouched(self, fan4):
+        """No operand → the compiled plane never engages and plain
+        protobuf fan-out behaves exactly as before."""
+        pc = channels.ParallelChannel()
+        for d in range(4):
+            ch = rpc.Channel(); ch.init(f"ici://{d}")
+            pc.add_channel(ch)
+        cntl = rpc.Controller()
+        pc.call_method("Fan.Scale", cntl, EchoRequest(message="x"),
+                       EchoResponse())
+        assert not cntl.failed()
+        assert cntl.fanout_route == ""
+
+    def test_unregistered_method_declines(self, fan4):
+        _plane_healthy()
+        pc = _mk_fanout(range(4))
+        op = np.ones((4, SHARD), np.float32)
+        before = iroute.collective_stats().get(
+            "collective_ineligible_unregistered", 0)
+        cntl = rpc.Controller()
+        cntl.fanout_operand = op
+        pc.call_method("Fan.Nope", cntl, EchoRequest(message="x"),
+                       EchoResponse())
+        assert cntl.fanout_route == "rpc"
+        assert iroute.collective_stats().get(
+            "collective_ineligible_unregistered", 0) == before + 1
+
+    def test_custom_mapper_declines(self, fan4):
+        """A mapper with custom semantics opts OUT of the compiled
+        route (collective_mapping = None): the fan-out rides the
+        per-member loop and still completes — inheritance must never
+        smuggle an unknown map() into a lowering."""
+        _plane_healthy()
+
+        class MyMapper(channels.ShardingCallMapper):
+            collective_mapping = None
+
+        pc = channels.ParallelChannel()
+        merger = channels.CollectiveMerger(merge=channels.MERGE_GATHER,
+                                           dtype="float32",
+                                           shard_shape=(SHARD,))
+        for d in range(4):
+            ch = rpc.Channel(); ch.init(f"ici://{d}")
+            pc.add_channel(ch, mapper=MyMapper(), merger=merger)
+        op = np.ones((4, SHARD), np.float32)
+        cntl = _call(pc, op)
+        assert cntl.fanout_route == "rpc"
+        np.testing.assert_allclose(np.asarray(cntl.fanout_result),
+                                   op * 2.0)
+
+    def test_merge_mismatch_declines(self, fan4):
+        _plane_healthy()
+        pc = channels.ParallelChannel()
+        mapper = channels.ShardingCallMapper()
+        merger = channels.CollectiveMerger(merge=channels.MERGE_SUM,
+                                           dtype="float32")
+        for d in range(4):
+            ch = rpc.Channel(); ch.init(f"ici://{d}")
+            pc.add_channel(ch, mapper=mapper, merger=merger)
+        cntl = rpc.Controller()
+        cntl.fanout_operand = np.ones((4, SHARD), np.float32)
+        pc.call_method("Fan.Scale", cntl, EchoRequest(message="x"),
+                       EchoResponse())
+        # Fan.Scale registered gather; client merger says sum → declined
+        assert cntl.fanout_route == "rpc"
+
+    def test_wrong_shard_count_declines(self, fan4):
+        """Operand rows != fan-out width: the screen declines, and on
+        the fallback loop the overflowing sub fails ITS call (EREQUEST
+        through the fail_limit machinery), never the issue loop."""
+        _plane_healthy()
+        pc = _mk_fanout(range(4))
+        pc.fail_limit = 1
+        from brpc_tpu.rpc import errors
+        cntl = rpc.Controller()
+        cntl.fanout_operand = np.ones((3, SHARD), np.float32)
+        pc.call_method("Fan.Scale", cntl, EchoRequest(message="x"),
+                       EchoResponse())
+        assert cntl.fanout_route == "rpc"
+        assert cntl.failed() and cntl.error_code_ == errors.ETOOMANYFAILS
+
+    def test_unserved_device_declines(self, fan4):
+        _plane_healthy()
+        pc = _mk_fanout([0, 1, 2, 5])        # no server on ici://5
+        cntl = rpc.Controller()
+        cntl.fanout_operand = np.ones((4, SHARD), np.float32)
+        pc.call_method("Fan.Scale", cntl, EchoRequest(message="x"),
+                       EchoResponse())
+        # the per-member loop then fails on ici://5 — the SCREEN decision
+        # is what this test pins
+        assert cntl.fanout_route == "rpc"
+
+
+# ---------------------------------------------------------------------------
+# Parity: compiled vs per-member loop, byte-exact.
+# ---------------------------------------------------------------------------
+
+class TestParity:
+    def test_shard_gather_parity(self, fan4):
+        _plane_healthy()
+        pc = _mk_fanout(range(4))
+        op = np.arange(4 * SHARD, dtype=np.float32).reshape(4, SHARD)
+        c1 = _call(pc, op)
+        assert c1.fanout_route == "collective"
+        got1 = np.asarray(c1.fanout_result)
+        fl.set_flag("ici_fanout_collective", False)
+        try:
+            c2 = _call(pc, op)
+        finally:
+            fl.set_flag("ici_fanout_collective", True)
+        assert c2.fanout_route == "rpc"
+        got2 = np.asarray(c2.fanout_result)
+        assert got1.shape == got2.shape == (4, SHARD)
+        np.testing.assert_array_equal(got1, got2)
+        np.testing.assert_allclose(got1, op * 2.0)
+
+    def test_replicate_sum_parity(self, fan4):
+        _plane_healthy()
+        pc = _mk_fanout(range(4), method="Fan.Accum")
+        op = np.linspace(0, 1, SHARD, dtype=np.float32)
+        c1 = _call(pc, op, method="Fan.Accum")
+        assert c1.fanout_route == "collective"
+        fl.set_flag("ici_fanout_collective", False)
+        try:
+            c2 = _call(pc, op, method="Fan.Accum")
+        finally:
+            fl.set_flag("ici_fanout_collective", True)
+        assert c2.fanout_route == "rpc"
+        want = (op + 1.0) * 4
+        np.testing.assert_allclose(np.asarray(c1.fanout_result), want,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(c2.fanout_result), want,
+                                   rtol=1e-6)
+
+    def test_async_done_compiled(self, fan4):
+        _plane_healthy()
+        pc = _mk_fanout(range(4))
+        op = np.ones((4, SHARD), np.float32)
+        ev = threading.Event()
+        out = {}
+
+        def done(c):
+            out["route"] = c.fanout_route
+            out["ok"] = not c.failed()
+            ev.set()
+
+        cntl = rpc.Controller()
+        cntl.fanout_operand = op
+        pc.call_method("Fan.Scale", cntl, EchoRequest(message="x"),
+                       EchoResponse(), done=done)
+        assert ev.wait(30)
+        assert out == {"route": "collective", "ok": True}
+
+    def test_partition_channel_lowers(self, fan4, tmp_path):
+        """PartitionChannel (LB-backed subs) lowers when each partition
+        resolves to exactly one ici:// member."""
+        _plane_healthy()
+        listing = tmp_path / "parts"
+        listing.write_text("".join(
+            f"ici://{d} 100 {d}/4\n" for d in range(4)))
+        pc = channels.PartitionChannel()
+        mapper = channels.ShardingCallMapper()
+        merger = channels.CollectiveMerger(merge=channels.MERGE_GATHER,
+                                           dtype="float32",
+                                           shard_shape=(SHARD,))
+        assert pc.init(4, f"file://{listing}", mapper=mapper,
+                       merger=merger) == 0
+        deadline = time.time() + 10
+        while not pc.partitions_ready() and time.time() < deadline:
+            time.sleep(0.05)
+        assert pc.partitions_ready()
+        op = np.arange(4 * SHARD, dtype=np.float32).reshape(4, SHARD)
+        cntl = _call(pc, op)
+        assert cntl.fanout_route == "collective"
+        np.testing.assert_allclose(np.asarray(cntl.fanout_result),
+                                   op * 2.0)
+
+    def test_selective_channel_propagates(self, fan4):
+        """A SelectiveChannel over a ParallelChannel unit passes the
+        operand through and surfaces the unit's route."""
+        _plane_healthy()
+        pc = _mk_fanout(range(4))
+        sc = channels.SelectiveChannel()
+        sc.add_channel(pc)
+        cntl = rpc.Controller()
+        cntl.fanout_operand = np.ones((4, SHARD), np.float32)
+        sc.call_method("Fan.Scale", cntl, EchoRequest(message="x"),
+                       EchoResponse)
+        assert not cntl.failed(), (cntl.error_code_, cntl.error_text_)
+        assert cntl.fanout_route == "collective"
+        np.testing.assert_allclose(np.asarray(cntl.fanout_result), 2.0)
+
+    def test_concurrent_fanouts_serialize_without_wedge(self, fan4):
+        """Two threads issuing compiled fan-outs concurrently: the
+        sequencer admits one program at a time (unsynced overlapping
+        collective dispatches wedge the backend rendezvous — measured),
+        and every call completes."""
+        _plane_healthy()
+        pc = _mk_fanout(range(4))
+        op = np.arange(4 * SHARD, dtype=np.float32).reshape(4, SHARD)
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(4):
+                    c = _call(pc, op)
+                    assert c.fanout_route == "collective"
+            except Exception as e:      # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(2)]
+        for t in ts: t.start()
+        for t in ts: t.join(120)
+        assert not errs, errs
+        d = cf.CollectiveFanoutPlane.instance().sequencer.describe()
+        assert d["assigned"] == d["executed"]
+
+
+# ---------------------------------------------------------------------------
+# Chaos: kill a member mid-fan-out → in-call degrade → epoch revival.
+# ---------------------------------------------------------------------------
+
+def run_kill_revive(n: int) -> None:
+    servers = {i: _mk_server(i) for i in range(n)}
+    try:
+        _plane_healthy()
+        pc = _mk_fanout(range(n))
+        op = np.arange(n * SHARD, dtype=np.float32).reshape(n, SHARD)
+        want = op * 2.0
+
+        def call():
+            c = _call(pc, op)
+            np.testing.assert_array_equal(np.asarray(c.fanout_result),
+                                          want)
+            return c.fanout_route
+
+        base = iroute.collective_stats()
+        assert call() == "collective"
+
+        # the kill fires MID-fan-out: after the screen committed to the
+        # compiled route and the sequencer assigned the slot
+        victim = n // 2
+        plan = fi.FabricFaultPlan(collective_kill_device=victim)
+        fi.install_fabric(plan)
+        try:
+            assert call() == "rpc"       # degraded IN-CALL, zero failures
+            assert plan.injected["collective"] == 1
+            assert call() == "rpc"       # stays down; no second injection
+            assert plan.injected["collective"] == 1
+        finally:
+            fi.install_fabric(None)
+        # fault cleared but no epoch movement: still down (a dead member
+        # does not resurrect by the client forgetting about it)
+        assert call() == "rpc"
+
+        # revival: the victim re-advertises (restart = withdraw + serve,
+        # two epoch bumps) and the compiled route re-probes.  While the
+        # victim is STOPPED the screen must still refuse (its device no
+        # longer serves the method) — no parity assert: the wire member
+        # itself is gone, which is exactly what the screen reports.
+        servers[victim].stop()
+        c = rpc.Controller()
+        c.fanout_operand = op
+        pc.call_method("Fan.Scale", c, EchoRequest(message="x"),
+                       EchoResponse())
+        assert c.fanout_route == "rpc"
+        servers[victim] = _mk_server(victim)
+        assert call() == "collective"
+
+        stats = iroute.collective_stats()
+        assert stats.get("collective_degraded_member_killed", 0) \
+            == base.get("collective_degraded_member_killed", 0) + 1
+        assert stats.get("collective_revived_member_killed", 0) \
+            == base.get("collective_revived_member_killed", 0) + 1
+        assert stats.get("collective_selected", 0) \
+            >= base.get("collective_selected", 0) + 2
+        d = cf.CollectiveFanoutPlane.instance().sequencer.describe()
+        assert d["assigned"] == d["executed"], \
+            "an abandoned fan-out slot must retire"
+    finally:
+        for s in servers.values():
+            s.stop()
+
+
+def test_member_kill_mid_fanout_degrades_and_revives_n4():
+    run_kill_revive(4)
+
+
+@pytest.mark.slow
+def test_member_kill_mid_fanout_degrades_and_revives_n8():
+    run_kill_revive(8)
+
+
+def test_transient_exec_failure_reprobes_on_timer(fan4):
+    """A route downed by a TRANSIENT reason (a program that fails to
+    build/execute) re-probes after ici_fanout_reprobe_s WITHOUT an
+    epoch move — one bad input must not degrade every method on the
+    process forever under stable membership.  Membership reasons
+    (member_killed) stay epoch-gated (see the chaos leg)."""
+    _plane_healthy()
+
+    def bad_handler(x):
+        raise ValueError("bad handler body")
+
+    cf.register_device_handler("Fan.Bad", bad_handler,
+                               merge=channels.MERGE_GATHER,
+                               mapping=channels.MAP_SHARD)
+    pc_bad = _mk_fanout(range(4), method="Fan.Scale")
+    op = np.ones((4, SHARD), np.float32)
+    old = fl.get_flag("ici_fanout_reprobe_s")
+    fl.set_flag("ici_fanout_reprobe_s", 0.05)
+    try:
+        # trip the route via the bad method (compile raises -> R_EXEC)
+        cntl = rpc.Controller()
+        cntl.fanout_operand = op
+        pc_bad.call_method("Fan.Bad", cntl, EchoRequest(message="x"),
+                           EchoResponse())
+        assert cf.CollectiveFanoutPlane.instance().health()["down"]
+        time.sleep(0.1)
+        # no epoch movement: the timer alone revives the route
+        c2 = _call(_mk_fanout(range(4)), op)
+        assert c2.fanout_route == "collective"
+    finally:
+        fl.set_flag("ici_fanout_reprobe_s", old)
+
+
+def test_screen_cache_invalidated_by_channel_reinit(fan4):
+    """Re-init()ing a sub-channel to a different device must invalidate
+    the per-channel screen cache — a stale device set would scatter the
+    compiled program to the OLD member."""
+    _plane_healthy()
+    pc = channels.ParallelChannel()
+    mapper = channels.ShardingCallMapper()
+    merger = channels.CollectiveMerger(merge=channels.MERGE_GATHER,
+                                       dtype="float32",
+                                       shard_shape=(SHARD,))
+    chans = []
+    for d in range(4):
+        ch = rpc.Channel(); ch.init(f"ici://{d}")
+        pc.add_channel(ch, mapper=mapper, merger=merger)
+        chans.append(ch)
+    op = np.arange(4 * SHARD, dtype=np.float32).reshape(4, SHARD)
+    assert _call(pc, op).fanout_route == "collective"
+    # rebind sub 3 to a device with no serving member
+    chans[3].init("ici://6")
+    cntl = rpc.Controller()
+    cntl.fanout_operand = op
+    pc.call_method("Fan.Scale", cntl, EchoRequest(message="x"),
+                   EchoResponse())
+    assert cntl.fanout_route == "rpc"
+
+
+def test_transient_exec_failures_budget(fan4):
+    """collective_fail_execs: a bounded burst of execution failures
+    degrades once, never fails the client call."""
+    _plane_healthy()
+    pc = _mk_fanout(range(4))
+    op = np.ones((4, SHARD), np.float32)
+    plan = fi.FabricFaultPlan(collective_fail_execs=2)
+    fi.install_fabric(plan)
+    try:
+        c = _call(pc, op)
+        assert c.fanout_route == "rpc"
+        assert plan.injected["collective"] == 1   # down: no more probes
+    finally:
+        fi.install_fabric(None)
+
+
+# ---------------------------------------------------------------------------
+# xproc program shape (what a multi-controller pod enters), in-process.
+# ---------------------------------------------------------------------------
+
+class TestXprocProgram:
+    def test_xproc_program_matches_local_leg(self, fan4):
+        """The zeros-rows + psum-broadcast xproc program is byte-exact
+        with the placement-scatter local program."""
+        import jax
+        _plane_healthy()
+        plane = cf.CollectiveFanoutPlane.instance()
+        md = cf.registry().method("Fan.Scale")
+        op = np.arange(4 * SHARD, dtype=np.float32).reshape(4, SHARD)
+        low_x = cf._Lowering("Fan.Scale", md, (0, 1, 2, 3), op,
+                             channels.MAP_SHARD, "xproc", {})
+        fn, ga = plane._prepare_xproc(low_x)
+        got_x = np.asarray(jax.block_until_ready(fn(ga)))
+        low_l = cf._Lowering("Fan.Scale", md, (0, 1, 2, 3), op,
+                             channels.MAP_SHARD, "local", {})
+        fn2, placed = plane._prepare_local(low_l)
+        got_l = np.asarray(jax.block_until_ready(fn2(placed)))
+        np.testing.assert_array_equal(got_x, got_l)
+        np.testing.assert_allclose(got_l, op * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# Compile-cache once-guard (the Collectives._cached satellite bugfix).
+# ---------------------------------------------------------------------------
+
+class TestCompileCacheOnceGuard:
+    def test_slow_builder_does_not_block_other_keys(self):
+        """Regression pin: one key's slow build (an XLA compile can take
+        seconds) must not serialize every other key's cache lookup."""
+        from brpc_tpu.ici.collective import Collectives
+        c = Collectives.__new__(Collectives)   # no mesh needed
+        c._cache = {}
+        c._building = {}
+        import threading as _t
+        c._cache_lock = _t.Lock()
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow_builder():
+            started.set()
+            assert release.wait(30)
+            return "slow"
+
+        t = threading.Thread(
+            target=lambda: c._cached(("slow",), slow_builder))
+        t.start()
+        assert started.wait(10)
+        # the slow build holds NO lock: another key resolves immediately
+        t0 = time.monotonic()
+        assert c._cached(("fast",), lambda: "fast") == "fast"
+        assert time.monotonic() - t0 < 5.0, \
+            "fast key waited on the slow key's build"
+        release.set()
+        t.join(30)
+        assert c._cache[("slow",)] == "slow"
+
+    def test_concurrent_same_key_builds_once(self):
+        from brpc_tpu.ici.collective import Collectives
+        c = Collectives.__new__(Collectives)
+        c._cache = {}
+        c._building = {}
+        import threading as _t
+        c._cache_lock = _t.Lock()
+        builds = []
+        gate = threading.Event()
+
+        def builder():
+            builds.append(1)
+            gate.wait(2)
+            return "v"
+
+        out = []
+        ts = [threading.Thread(
+            target=lambda: out.append(c._cached(("k",), builder)))
+            for _ in range(4)]
+        for t in ts: t.start()
+        time.sleep(0.2)
+        gate.set()
+        for t in ts: t.join(30)
+        assert out == ["v"] * 4
+        assert len(builds) == 1, "same key compiled more than once"
+
+    def test_failed_build_clears_guard_and_retries(self):
+        from brpc_tpu.ici.collective import Collectives
+        c = Collectives.__new__(Collectives)
+        c._cache = {}
+        c._building = {}
+        import threading as _t
+        c._cache_lock = _t.Lock()
+        with pytest.raises(RuntimeError):
+            c._cached(("k",), lambda: (_ for _ in ()).throw(
+                RuntimeError("boom")))
+        assert c._cached(("k",), lambda: "ok") == "ok"
+
+
+# ---------------------------------------------------------------------------
+# 2-process: a REAL remote member — clean decline off-TPU, and the
+# forced-on announce path degrading in-call with zero visible failures.
+# ---------------------------------------------------------------------------
+
+_XPROC_FANOUT = r"""
+import os, sys, threading, time, json
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+_real_excepthook = sys.excepthook
+def _fail_fast(tp, val, tb):
+    _real_excepthook(tp, val, tb)
+    sys.stdout.flush(); sys.stderr.flush()
+    try:
+        from brpc_tpu.butil.debug_sync import dump_report_now
+        dump_report_now()
+    except Exception:
+        pass
+    os._exit(1)
+sys.excepthook = _fail_fast
+
+pid = int(sys.argv[1]); coord = sys.argv[2]; NPROC = int(sys.argv[3])
+from brpc_tpu.ici.fabric import FabricNode
+node = FabricNode.initialize(coord, num_processes=NPROC, process_id=pid)
+kv = node._kv
+import numpy as np
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici, channels
+from brpc_tpu.butil import flags as fl
+from brpc_tpu.ici import route as iroute
+from brpc_tpu.ici.pod import Pod
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+pod = Pod.join("fanout")
+MYDEV = 2 * pid
+SHARD = 64
+
+class FanSvc(rpc.Service):
+    SERVICE_NAME = "Fan"
+    @rpc.method(EchoRequest, EchoResponse)
+    def Scale(self, cntl, request, response, done):
+        x = np.frombuffer(cntl.request_attachment.to_bytes(), np.float32)
+        cntl.response_attachment.append((x * 2.0).astype(np.float32).tobytes())
+        done()
+
+server = rpc.Server()
+server.add_service(FanSvc())
+server.register_collective("Fan.Scale", lambda x: x * 2.0)
+assert server.start("ici://%%d" %% MYDEV) == 0
+# join x2 + advertise x2 + publish_collective x2
+pod.wait_epoch(3 * NPROC, timeout=60)
+members = pod.members(refresh=True)
+assert all("Fan.Scale" in m.coll for m in members.values()), {
+    p: m.coll for p, m in members.items()}
+
+if pid == 0:
+    pc = channels.ParallelChannel()
+    mapper = channels.ShardingCallMapper()
+    merger = channels.CollectiveMerger(merge=channels.MERGE_GATHER,
+                                       dtype="float32", shard_shape=(SHARD,))
+    for d in (0, 2):
+        ch = rpc.Channel()
+        ch.init("ici://%%d" %% d,
+                options=rpc.ChannelOptions(timeout_ms=30000, max_retry=1))
+        pc.add_channel(ch, mapper=mapper, merger=merger)
+    op = np.arange(2 * SHARD, dtype=np.float32).reshape(2, SHARD)
+
+    def call():
+        cntl = rpc.Controller()
+        cntl.fanout_operand = op
+        pc.call_method("Fan.Scale", cntl, EchoRequest(message="x"),
+                       EchoResponse())
+        assert not cntl.failed(), (cntl.error_code_, cntl.error_text_)
+        got = np.asarray(cntl.fanout_result)
+        assert got.shape == (2, SHARD)
+        assert np.allclose(got, op * 2.0), got[:, :4]
+        return cntl.fanout_route
+
+    # leg 1: default screen — remote member, no multi-controller backend
+    # on CPU: decline BEFORE any announce, per-member RPCs carry the call
+    assert call() == "rpc"
+    s1 = iroute.collective_stats()
+    assert s1.get("collective_ineligible_xproc_uncompiled", 0) >= 1, s1
+
+    # leg 2: force the compiled xproc leg on — the announce goes out,
+    # the member refuses entry (CPU), the client degrades IN-CALL with
+    # zero visible failures
+    fl.set_flag("ici_device_plane_xproc_compiled", "on")
+    assert call() == "rpc"
+    s2 = iroute.collective_stats()
+    assert s2.get("collective_degraded_announce_refused", 0) >= 1, s2
+    kv.key_value_set("fanout_client_done", "1")
+else:
+    kv.blocking_key_value_get("fanout_client_done", 120000)
+    # the member SAW the announce and refused it (counter proof the
+    # _F_COLL_CALL frame crossed processes and was answered)
+    s = iroute.collective_stats()
+    assert s.get("collective_announce_refused_xproc_uncompiled", 0) >= 1, s
+
+kv.wait_at_barrier("fanout_done", 120000)
+server.stop()
+pod.leave()
+print("XF%%d_OK" %% pid, flush=True)
+"""
+
+
+@pytest.mark.pod
+def test_xproc_fanout_declines_and_forced_announce_degrades():
+    from tests.test_pod import _run_pod
+    outs = _run_pod(_XPROC_FANOUT % {"repo": REPO}, n=2, timeout=240,
+                    tag="xproc_fanout")
+    assert "XF0_OK" in outs[0]
+    assert "XF1_OK" in outs[1]

@@ -85,6 +85,17 @@ class TestFixtureViolations:
         assert "_shm" in out[0].message and "_plane_lock" in out[0].message
         assert out[0].path.endswith("bad_shm_route.py")
 
+    def test_unguarded_compile_cache_insert_reported_with_line(self):
+        """The compiled fan-out plane's state class (ISSUE 11): a
+        compile-cache insert outside the plane lock is caught at the
+        exact file:line — the once-guard's publish step must stay
+        under _lock even though the BUILD runs outside it."""
+        out = _findings("bad_collective_cache.py",
+                        fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 24)]
+        assert "_programs" in out[0].message and "_lock" in out[0].message
+        assert out[0].path.endswith("bad_collective_cache.py")
+
     def test_clean_fixture_is_silent(self):
         out = _findings(
             "clean_module.py",

@@ -52,6 +52,48 @@ class TestRpcPress:
         finally:
             server.stop()
 
+    def test_press_fanout_mode(self):
+        """--fanout N: ONE ParallelChannel over N members, per-route
+        call counts + fan-out latency in the summary; with device
+        handlers registered the calls ride the compiled route."""
+        import numpy as np
+        from brpc_tpu.tools.rpc_press import run_press_fanout
+
+        class FanSvc(rpc.Service):
+            SERVICE_NAME = "Fan"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Press(self, cntl, request, response, done):
+                cntl.response_attachment.append(
+                    cntl.request_attachment.to_bytes())
+                done()
+
+        servers = []
+        for i in range(4):
+            s = rpc.Server()
+            s.add_service(FanSvc())
+            s.register_collective("Fan.Press", lambda x: x,
+                                  merge="gather", mapping="shard")
+            assert s.start(f"ici://{i}") == 0
+            servers.append(s)
+        try:
+            from brpc_tpu.channels import collective_fanout as cf
+            if cf.CollectiveFanoutPlane.instance().health()["down"]:
+                cf.registry().serve(99); cf.registry().withdraw(99)
+            result = run_press_fanout(
+                "ici://0,ici://1,ici://2,ici://3", "Fan.Press", 4,
+                duration=0.5, concurrency=2, shard_bytes=64,
+                out=io.StringIO())
+            assert result["sent"] > 0
+            assert result["errors"] == 0
+            assert result["fanout_p50_us"] > 0
+            assert set(result["per_route"]) == {"collective"}, result
+            assert result["route_counters"].get(
+                "collective_selected", 0) > 0
+        finally:
+            for s in servers:
+                s.stop()
+
     def test_press_throttled(self):
         from brpc_tpu.tools.rpc_press import run_press
         server, target = start_server()
