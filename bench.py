@@ -40,12 +40,27 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
 
     mesh = IciMesh.default()
 
+    # Attachment echo idiom: ASSIGNMENT is the reference's zero-copy
+    # shape (example/echo_c++ swaps request into response attachment —
+    # cntl->response_attachment()->swap(*cntl->request_attachment()));
+    # under native att custody (ISSUE 12) it is the full pass-through:
+    # the parked handle rides back without a single Python seg walk.
+    # The PR-8 append(...) idiom is measured separately below
+    # (materializes the view — correct, slower), as is the legacy
+    # custody path (ici_native_att_custody=False, byte-for-byte PR 8)
+    # so the A/B lives in ONE container run.
+    echo_mode = ["assign"]
+
     class EchoService(rpc.Service):
         @rpc.method(EchoRequest, EchoResponse)
         def Echo(self, cntl, request, response, done):
             response.message = request.message
             if len(cntl.request_attachment):
-                cntl.response_attachment.append(cntl.request_attachment)
+                if echo_mode[0] == "assign":
+                    cntl.response_attachment = cntl.request_attachment
+                else:
+                    cntl.response_attachment.append(
+                        cntl.request_attachment)
             done()
 
     opts = rpc.ServerOptions()
@@ -87,7 +102,12 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
         lat.sort()
         return lat
 
-    lat_py = drive(iters)               # Python handler tier
+    lat_py = drive(iters)               # Python handler tier (assign)
+    # the PR-8 append idiom on the SAME server: the view materializes
+    # instead of passing through — what handlers that mutate pay
+    echo_mode[0] = "append"
+    lat_py_append = drive(max(iters // 2, 150))
+    echo_mode[0] = "assign"
     # per-stage decomposition pass (tpu_std_stage_metrics=on): the SAME
     # py-handler shape feeds the tpu_std_server_* recorders through the
     # batched ici upcall tier, so BENCH extra shows WHERE the upcall
@@ -122,6 +142,30 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
         cpp_loop = native_plane.native_ici_echo_p50_us(
             5000, 128, device_array=payload)
         cpp_loop_host = native_plane.native_ici_echo_p50_us(5000, 128)
+    # legacy-custody A/B leg (ISSUE 12): ici_native_att_custody=False
+    # restores the PR-8 take-during-upcall seg walks byte-for-byte, on
+    # a FRESH server+channel generation (the flag snapshots at bind) —
+    # same process, same warmed jit, same container run.  The handler
+    # uses the append idiom (assignment vs a plain IOBuf is the same
+    # ref copy either way; append was the PR-8 bench shape).
+    lat_py_legacy = []
+    _custody_prev = _fl.get_flag("ici_native_att_custody")
+    _fl.set_flag("ici_native_att_custody", False)
+    try:
+        echo_mode[0] = "append"
+        server_l = rpc.Server(opts)
+        server_l.add_service(EchoService())
+        server_l.start("ici://0")
+        ch_l = rpc.Channel()
+        ch_l.init("ici://0",
+                  options=rpc.ChannelOptions(timeout_ms=10000,
+                                             max_retry=0,
+                                             ici_local_device=0))
+        lat_py_legacy = drive(max(iters // 2, 150), chan=ch_l)
+        server_l.stop()
+    finally:
+        _fl.set_flag("ici_native_att_custody", _custody_prev)
+        echo_mode[0] = "assign"
     if cpp_loop > 0:
         p50, src = cpp_loop, "cpp_loop"
     elif lat_native:
@@ -139,6 +183,14 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
                              if lat_native else -1.0),
         "py_handler_p50_us": lat_py[len(lat_py) // 2],
         "py_handler_p99_us": lat_py[int(len(lat_py) * 0.99)],
+        "py_handler_append_p50_us":
+            lat_py_append[len(lat_py_append) // 2],
+        "py_handler_legacy_custody_p50_us":
+            (lat_py_legacy[len(lat_py_legacy) // 2]
+             if lat_py_legacy else -1.0),
+        "py_handler_legacy_custody_p99_us":
+            (lat_py_legacy[int(len(lat_py_legacy) * 0.99)]
+             if lat_py_legacy else -1.0),
         "py_handler_xdev_p50_us": lat_py_xdev[len(lat_py_xdev) // 2],
         "py_handler_xdev_p99_us": lat_py_xdev[int(len(lat_py_xdev) * 0.99)],
         "native_datapath": binding is not None,
@@ -1154,6 +1206,13 @@ else:
     bulk_b = sum(s.bulk_bytes_sent for s in list_sockets()
                  if isinstance(s, FabricSocket))
     print("FABRIC_ROUTE shm=%%d bulk=%%d" %% (shm_b, bulk_b), flush=True)
+    from brpc_tpu.ici.route import route_stats as _rs
+    stripe_rows = {k: v["bytes"] for k, v in _rs().items()
+                   if k.startswith("shm_stripe_")}
+    if stripe_rows:
+        print("FABRIC_STRIPES " + " ".join(
+            "%%s=%%d" %% (k, v) for k, v in sorted(stripe_rows.items())),
+            flush=True)
     kv.wait_at_barrier("fb_done", 600000)
     print("FB1_OK", flush=True)
 """
@@ -1185,8 +1244,17 @@ def bench_fabric_gbps(timeout_s: int = 300, plane: str = "auto") -> dict:
     # one spawn harness for the bench, the dryrun stress leg, and the
     # fabric tests — a fix to env/timeouts applies to all three
     from test_fabric import _run_pair
-    shm_cfg = '_fl.set_flag("ici_shm_ring_bytes", 160 * 1024 * 1024)' \
-        if plane == "auto" else '_fl.set_flag("ici_fabric_shm", False)'
+    if plane == "auto":
+        shm_cfg = '_fl.set_flag("ici_shm_ring_bytes", 160 * 1024 * 1024)'
+    elif plane == "shm_striped":
+        # ISSUE 12: the striped plane — N ring pairs per segment, per-
+        # stripe locks/doorbells so concurrent senders stop serializing.
+        # Smaller per-stripe rings keep the /dev/shm footprint near the
+        # single-ring leg's (4 x 48MB x 2 dirs ~ 384MB vs 320MB).
+        shm_cfg = ('_fl.set_flag("ici_shm_ring_bytes", 48 * 1024 * 1024)'
+                   '; _fl.set_flag("ici_shm_stripes", 4)')
+    else:
+        shm_cfg = '_fl.set_flag("ici_fabric_shm", False)'
     try:
         outs = _run_pair(_FABRIC_BENCH_CHILD
                          % {"repo": repo, "shm_cfg": shm_cfg},
@@ -1206,6 +1274,13 @@ def bench_fabric_gbps(timeout_s: int = 300, plane: str = "auto") -> dict:
             out["route"] = "shm" if shm_b > bulk_b else "uds"
             out["route_shm_bytes"] = shm_b
             out["route_bulk_bytes"] = bulk_b
+        elif line.startswith("FABRIC_STRIPES"):
+            # per-stripe truth: the striped leg is proven striped by
+            # these counters, not assumed from the flag
+            kv = dict(p.split("=", 1) for p in line.split()[1:])
+            out["stripe_bytes"] = {k: int(v) for k, v in kv.items()}
+            if out.get("route") == "shm" and len(kv) > 1:
+                out["route"] = "shm_striped"
     return out
 
 
@@ -1811,6 +1886,28 @@ def main() -> None:
     except Exception as e:  # pragma: no cover
         print(f"# fabric uds bench failed: {e}", file=sys.stderr)
         fb_uds = {}
+    # striped shm leg (ISSUE 12): only meaningful with cores to run the
+    # stripes on — on a 1-core host the single ring IS the bound
+    # (copy-count-limited near 2x, ROADMAP 4b), so the leg SKIPs with
+    # the reason recorded instead of publishing a meaningless number.
+    # Functional striped coverage runs in tier-1 either way
+    # (test_shm.py striped legs force ici_shm_stripes=4).
+    _cores = __import__("os").cpu_count() or 1
+    fb_striped = {}
+    striped_skip = ""
+    if _cores > 1:
+        try:
+            fb_striped = bench_fabric_gbps(plane="shm_striped")
+            print(f"# fabric cross-process (shm striped): {fb_striped}",
+                  file=sys.stderr)
+        except Exception as e:  # pragma: no cover
+            print(f"# fabric striped bench failed: {e}", file=sys.stderr)
+    else:
+        striped_skip = ("host_cores == 1: stripes have no cores to run "
+                        "on (the single-ring copy-count bound applies); "
+                        "striped functional coverage lives in tier-1")
+        print(f"# fabric striped leg SKIPPED: {striped_skip}",
+              file=sys.stderr)
     try:
         fstrm = bench_fabric_streaming_mbps()
         print(f"# fabric streaming: {fstrm}", file=sys.stderr)
@@ -1900,6 +1997,15 @@ def main() -> None:
             echo.get("py_handler_p50_us", -1.0), 1),
         "ici_py_handler_echo_p99_us": round(
             echo.get("py_handler_p99_us", -1.0), 1),
+        # ISSUE-12 custody A/B, all in THIS run: append = the PR-8
+        # handler idiom under native custody (view materializes);
+        # legacy = ici_native_att_custody=False, byte-for-byte PR 8
+        "ici_py_handler_append_p50_us": round(
+            echo.get("py_handler_append_p50_us", -1.0), 1),
+        "ici_py_handler_legacy_custody_p50_us": round(
+            echo.get("py_handler_legacy_custody_p50_us", -1.0), 1),
+        "ici_py_handler_legacy_custody_p99_us": round(
+            echo.get("py_handler_legacy_custody_p99_us", -1.0), 1),
         "ici_py_handler_xdev_echo_p50_us": round(
             echo.get("py_handler_xdev_p50_us", -1.0), 1),
         "ici_py_handler_xdev_echo_p99_us": round(
@@ -1924,6 +2030,11 @@ def main() -> None:
             if fb.get("route") == "shm" else -1.0, 3),
         "fabric_xproc_uds_gbps": round(
             fb_uds.get("fabric_xproc_gbps", -1.0), 3),
+        # striped shm (ISSUE 12): -1 + skip reason on 1-core hosts
+        "fabric_xproc_shm_striped_gbps": round(
+            fb_striped.get("fabric_xproc_gbps", -1.0)
+            if fb_striped.get("route") == "shm_striped" else -1.0, 3),
+        "fabric_shm_striped_skip_reason": striped_skip,
         "reloc_platform": reloc.get("platform", "unavailable"),
         "reloc_devices": reloc.get("devices", 0),
         "reloc_nonresident_p50_us_4k": round(

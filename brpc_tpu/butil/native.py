@@ -17,7 +17,7 @@ from typing import Optional
 
 # stale-.so detector: ALWAYS the most recently added C symbol, so an old
 # build triggers a rebuild instead of silently disabling the native layer
-_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_shm_create"
+_BRPC_TPU_NEWEST_SYMBOL_ = "brpc_tpu_shm_stripe_stats"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -59,7 +59,16 @@ class IciCallOut(ctypes.Structure):
                 ("segs", ctypes.POINTER(IciSegC)),
                 ("nsegs", ctypes.c_uint64),
                 ("err_text", ctypes.c_void_p),
-                ("retry_after_ms", ctypes.c_uint64)]
+                ("retry_after_ms", ctypes.c_uint64),
+                # native att custody (call4 only): the response seg list
+                # parked under att_handle; seg0_* mirrors segs[0] inline
+                # so the 1-seg shape needs no pointer deref (segs stays
+                # NULL then — nothing to free)
+                ("att_handle", ctypes.c_uint64),
+                ("seg0_key", ctypes.c_uint64),
+                ("seg0_nbytes", ctypes.c_uint64),
+                ("seg0_dev", ctypes.c_int32),
+                ("_pad", ctypes.c_int32)]
 
 
 # relocation upcall: (key, target_dev) -> new key (0 = failure)
@@ -108,7 +117,16 @@ class IciReqC(ctypes.Structure):
                 ("tenant", ctypes.c_char_p),
                 ("deadline_left_ms", ctypes.c_uint64),
                 ("priority", ctypes.c_int32),
-                ("_pad2", ctypes.c_int32)]
+                ("_pad2", ctypes.c_int32),
+                # native att custody (appended, ISSUE 12): nonzero
+                # att_handle parks the device-seg list natively; seg0_*
+                # mirrors segs[0] so the dominant 1-seg shape reads
+                # plain struct fields, never the segs pointer
+                ("att_handle", ctypes.c_uint64),
+                ("seg0_key", ctypes.c_uint64),
+                ("seg0_nbytes", ctypes.c_uint64),
+                ("seg0_dev", ctypes.c_int32),
+                ("_pad3", ctypes.c_int32)]
 
 
 class IciRespC(ctypes.Structure):
@@ -124,7 +142,11 @@ class IciRespC(ctypes.Structure):
                 ("att_host_len", ctypes.c_uint64),
                 ("segs", ctypes.POINTER(IciSegC)),
                 ("nsegs", ctypes.c_uint64),
-                ("retry_after_ms", ctypes.c_uint64)]
+                ("retry_after_ms", ctypes.c_uint64),
+                # nonzero: pass a parked att-table entry back as this
+                # response's attachment (segs/nsegs ignored) — the echo
+                # pass-through never walks segs in Python
+                ("att_handle", ctypes.c_uint64)]
 
 
 # batched ici request upcall: (reqs, n)
@@ -328,6 +350,28 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_uint64, segp, ctypes.c_uint64, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
         ctypes.POINTER(IciCallOut)]
+    # call3 + native att custody on the response (out.att_handle + seg0
+    # inline; error-path response segs released natively)
+    lib.brpc_tpu_ici_call4.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_call4.argtypes = [
+        ctypes.c_uint64, ctypes.c_char_p, u8p, ctypes.c_uint64, u8p,
+        ctypes.c_uint64, segp, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(IciCallOut)]
+    # native att custody handle ops: each consumes the handle exactly
+    # once (take = Python assumed the keys; dispose = release upcalls)
+    lib.brpc_tpu_ici_att_take.restype = ctypes.c_int64
+    lib.brpc_tpu_ici_att_take.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_ici_att_dispose.restype = ctypes.c_int
+    lib.brpc_tpu_ici_att_dispose.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_ici_att_peek.restype = ctypes.c_int64
+    lib.brpc_tpu_ici_att_peek.argtypes = [ctypes.c_uint64, segp,
+                                          ctypes.c_uint64]
+    lib.brpc_tpu_ici_att_count.restype = ctypes.c_uint64
+    lib.brpc_tpu_ici_att_count.argtypes = []
+    lib.brpc_tpu_ici_set_att_handles.restype = ctypes.c_int
+    lib.brpc_tpu_ici_set_att_handles.argtypes = [ctypes.c_uint64,
+                                                 ctypes.c_int]
     lib.brpc_tpu_ici_respond.restype = ctypes.c_int
     lib.brpc_tpu_ici_respond.argtypes = [
         ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p, u8p,
@@ -439,6 +483,31 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.brpc_tpu_shm_stats.argtypes = [ctypes.c_uint64,
                                        ctypes.POINTER(ctypes.c_uint64),
                                        ctypes.c_int]
+    # striped shm (ISSUE 12): N independent ring pairs per segment with
+    # explicit per-call stripe selection; a 1-stripe segment is the v1
+    # layout byte-for-byte (create2 delegates)
+    lib.brpc_tpu_shm_create2.restype = ctypes.c_uint64
+    lib.brpc_tpu_shm_create2.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                         ctypes.c_uint32]
+    lib.brpc_tpu_shm_send2.restype = ctypes.c_int
+    lib.brpc_tpu_shm_send2.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint64, u8p,
+        ctypes.c_uint64, ctypes.c_int64]
+    lib.brpc_tpu_shm_sendv2.restype = ctypes.c_int
+    lib.brpc_tpu_shm_sendv2.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int, ctypes.c_int64]
+    lib.brpc_tpu_shm_recv2.restype = ctypes.c_int
+    lib.brpc_tpu_shm_recv2.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64)]
+    lib.brpc_tpu_shm_stripes.restype = ctypes.c_uint32
+    lib.brpc_tpu_shm_stripes.argtypes = [ctypes.c_uint64]
+    lib.brpc_tpu_shm_stripe_stats.restype = ctypes.c_int
+    lib.brpc_tpu_shm_stripe_stats.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
     _lib = lib
     return _lib
 

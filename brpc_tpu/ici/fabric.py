@@ -33,7 +33,9 @@ Server/Channel code is identical single- or multi-controller.
 from __future__ import annotations
 
 import ctypes
+import itertools
 import json
+import os as _os
 import random
 import socket as _pysocket
 import struct
@@ -116,6 +118,31 @@ _flags.define_flag("ici_shm_ring_bytes", 32 * 1024 * 1024,
 _flags.define_flag("ici_shm_send_timeout_s", 20.0,
                    "max seconds an shm ring send waits for space before "
                    "the plane is declared dead")
+# STRIPED shm (ISSUE 12): on multi-core hosts the segment holds N
+# independent SPSC ring pairs (per-stripe futex doorbells and locks) so
+# concurrent sender/claimer threads stop serializing on one ring — the
+# single-core shm plane is copy-count-bounded near 2x, and stripes are
+# how the remaining headroom is reached when there are cores to use.
+# The descriptor carries its stripe in the uuid's top byte; frames of
+# one STREAM share a stripe (affinity by stream id) so per-stream
+# ordering is decided by one ring, while unary bulk frames round-robin.
+# Health stays plane-wide: one dead stripe degrades the whole plane
+# IN-FRAME exactly like the single ring.  0 = auto (1 on a 1-core
+# host — the v1 single-ring layout, byte-identical to PR 10 — else
+# min(4, cores)).
+_flags.define_flag("ici_shm_stripes", 0,
+                   "SPSC ring-pair stripes per shm segment (0 = auto: "
+                   "1 on 1-core hosts, else min(4, host cores))")
+
+_SHM_STRIPE_SHIFT = 56          # stripe id rides the uuid's top byte
+
+
+def _resolve_shm_stripes() -> int:
+    n = int(_flags.get_flag("ici_shm_stripes"))
+    if n <= 0:
+        cores = _os.cpu_count() or 1
+        return 1 if cores <= 1 else min(4, cores)
+    return min(n, 64)
 # Cross-process device plane: device payloads cross through the
 # SEQUENCED xproc plane — every transfer (both directions) is assigned a
 # slot in one total order agreed over the control channel
@@ -783,8 +810,16 @@ class FabricNode:
         if not self._shm_ok or self._shm_lib is None:
             return 0, None, None
         name = f"brpc_tpu_shm.{self.process_id}.{self.next_uuid():x}"
-        h = self._shm_lib.brpc_tpu_shm_create(
-            name.encode(), int(_flags.get_flag("ici_shm_ring_bytes")))
+        stripes = _resolve_shm_stripes()
+        if stripes > 1 and hasattr(self._shm_lib, "brpc_tpu_shm_create2"):
+            # striped v2 segment (multi-core hosts): the attacher reads
+            # the stripe count from the header, no hello change needed
+            h = self._shm_lib.brpc_tpu_shm_create2(
+                name.encode(),
+                int(_flags.get_flag("ici_shm_ring_bytes")), stripes)
+        else:
+            h = self._shm_lib.brpc_tpu_shm_create(
+                name.encode(), int(_flags.get_flag("ici_shm_ring_bytes")))
         if not h:
             return 0, None, None
         return h, name, self._shm_lib
@@ -1112,6 +1147,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         "_shmlib": "_bulk_lock",
         "_shm_epoch": "_bulk_lock",
         "_shm_ring_bytes": "_bulk_lock",
+        "_shm_stripes": "_bulk_lock",
+        "_shm_dead_stripes": "_bulk_lock",
         "_shm_reestab_pending": "_bulk_lock",
         "_shm_reestab_running": "_bulk_lock",
         "_shm_reestab_wanted": "_bulk_lock",
@@ -1187,6 +1224,11 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._shmlib = None
         self._shm_epoch = 0                    # attachments so far
         self._shm_ring_bytes = 0               # per-direction capacity
+        self._shm_stripes = 1                  # ring pairs in the segment
+        self._shm_dead_stripes = 1             # stripes of the retired ring
+        # round-robin stripe cursor for unary bulk frames (streams pin
+        # a stripe by affinity instead); itertools.count is GIL-atomic
+        self._shm_rr = itertools.count().__next__
         self.shm_bytes_sent = 0                # cumulative, across epochs
         self.shm_bytes_claimed = 0
         self._bulk_is_uds = False              # route-counter label only
@@ -1427,16 +1469,20 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         poison the fresh ring here."""
         old = 0
         ring_bytes = 0
+        stripes = 1
         if handle:
             st = (ctypes.c_uint64 * 6)()
             if lib.brpc_tpu_shm_stats(handle, st, 6) == 6:
                 ring_bytes = int(st[5])
+            if hasattr(lib, "brpc_tpu_shm_stripes"):
+                stripes = int(lib.brpc_tpu_shm_stripes(handle)) or 1
         with self._bulk_lock:
             old, self._shm = self._shm, handle
             self._shmlib = lib
             if handle:
                 self._shm_epoch += 1
                 self._shm_ring_bytes = ring_bytes
+                self._shm_stripes = stripes
         if old and lib is not None:
             lib.brpc_tpu_shm_close(old)
         if handle:
@@ -1497,6 +1543,9 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 # is sitting in the mapping.  Bounded at one retired
                 # ring: a second death closes the first.
                 old_dead, self._shm_dead = self._shm_dead, h
+                # the retired ring keeps ITS stripe geometry for claims
+                self._shm_dead_stripes = self._shm_stripes
+                self._shm_stripes = 1
         if not h:
             return                      # already degraded / never bound
         if lib is not None:
@@ -1632,10 +1681,12 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         occupancy and doorbell waits from the native side."""
         with self._bulk_lock:
             h, lib = self._shm, self._shmlib
+            stripes = self._shm_stripes
             out = {"epoch": self._shm_epoch,
                    "bytes_sent": self.shm_bytes_sent,
                    "bytes_claimed": self.shm_bytes_claimed,
-                   "ring_bytes": self._shm_ring_bytes}
+                   "ring_bytes": self._shm_ring_bytes,
+                   "stripes": stripes}
         if not h and not out["epoch"]:
             return None
         if h and lib is not None:
@@ -1644,6 +1695,16 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 out.update({"tx_occupancy": int(st[2]),
                             "rx_occupancy": int(st[3]),
                             "doorbell_waits": int(st[4])})
+            if stripes > 1 and hasattr(lib, "brpc_tpu_shm_stripe_stats"):
+                per = []
+                for i in range(stripes):
+                    if lib.brpc_tpu_shm_stripe_stats(h, i, st, 6) == 6:
+                        per.append({"bytes_out": int(st[0]),
+                                    "bytes_in": int(st[1]),
+                                    "tx_occupancy": int(st[2]),
+                                    "rx_occupancy": int(st[3]),
+                                    "doorbell_waits": int(st[4])})
+                out["stripe_stats"] = per
         return out
 
     # ---- device plane (kind-4 sequenced transfers) ---------------------
@@ -1869,7 +1930,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             nchunks += 1
             for rt in _route.candidates(self, _route.HOST, len(blob)):
                 if rt == _route.SHM:
-                    uuid = self.node.next_uuid()
+                    uuid = self.shm_tag_uuid(self.node.next_uuid())
                     try:
                         self._shm_send(uuid, blob)
                     except _ShmOversize:
@@ -1965,6 +2026,7 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                         uuid = self.node.next_uuid()
                         try:
                             if rt == _route.SHM:
+                                uuid = self.shm_tag_uuid(uuid)
                                 self._shm_send(uuid, np_arr)
                                 kind = 5
                             else:
@@ -2062,12 +2124,38 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             # cumulative counter; unguarded += lost updates (fablint)
             self.bulk_bytes_sent += n
 
+    def shm_tag_uuid(self, uuid: int,
+                     affinity: Optional[int] = None) -> int:
+        """Stamp the chosen stripe into the uuid's top byte — the
+        descriptor carries it to the claimer, so no wire format
+        changes.  ``affinity`` pins a stripe (streams pass their stream
+        id: per-stream ordering is decided by ONE ring); unary bulk
+        frames round-robin.  A 1-stripe segment leaves the uuid
+        untouched — the PR-10 shape, byte-identical."""
+        with self._bulk_lock:
+            n = self._shm_stripes
+        if n <= 1:
+            return uuid
+        stripe = (affinity if affinity is not None
+                  else self._shm_rr()) % n
+        return (uuid & ~(0xff << _SHM_STRIPE_SHIFT)) | \
+            (stripe << _SHM_STRIPE_SHIFT)
+
+    @staticmethod
+    def _shm_stripe_of(uuid: int, nstripes: int) -> int:
+        """Decode the stripe a tagged uuid names; clamped so a
+        malformed tag can never index out of range."""
+        if nstripes <= 1:
+            return 0
+        return min(uuid >> _SHM_STRIPE_SHIFT, nstripes - 1)
+
     def _shm_send(self, uuid: int, data) -> None:
         """Blocking shm ring send (the GIL is dropped for the native
         copy; a full ring parks on the futex doorbell).  ``data``:
         bytes or a C-contiguous numpy array.  Raises _ShmOversize when
         the frame can never fit the ring (route elsewhere; the ring is
-        healthy) and ConnectionError on death/timeout (degrade)."""
+        healthy) and ConnectionError on death/timeout (degrade).  The
+        uuid's top byte names the stripe (shm_tag_uuid)."""
         if isinstance(data, (bytes, bytearray)):
             ptr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
                 if isinstance(data, bytearray) else \
@@ -2077,11 +2165,19 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             ptr = data.ctypes.data_as(_u8p)
             n = data.nbytes
         with self._bulk_lock:
-            h, lib = self._shm, self._shmlib
+            h, lib, stripes = self._shm, self._shmlib, self._shm_stripes
         timeout_us = int(
             _flags.get_flag("ici_shm_send_timeout_s") * 1e6)
-        rc = lib.brpc_tpu_shm_send(h, uuid, ptr, n, timeout_us) \
-            if h else -1
+        if not h:
+            rc = -1
+        elif stripes > 1:
+            stripe = self._shm_stripe_of(uuid, stripes)
+            rc = lib.brpc_tpu_shm_send2(h, stripe, uuid, ptr, n,
+                                        timeout_us)
+            if rc == 0:
+                _route.record_shm_stripe(stripe, n)
+        else:
+            rc = lib.brpc_tpu_shm_send(h, uuid, ptr, n, timeout_us)
         if rc == -3:
             raise _ShmOversize()
         if rc != 0:
@@ -2098,15 +2194,21 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
     # (the claimed IOBuf wraps the native receive buffer) — the same
     # contract as the kind-2/3 attachment path above.
 
-    def stream_fast_begin(self, nbytes: int) -> Tuple[int, Optional[str]]:
+    def stream_fast_begin(self, nbytes: int,
+                          affinity: Optional[int] = None
+                          ) -> Tuple[int, Optional[str]]:
         """Route one stream DATA frame of ``nbytes``: (uuid, route) with
         route "shm"/"bulk", or (0, None) to keep the inline path.  The
         liveness check here is what lets a stream survive plane death: a
         dead plane is detected BEFORE the descriptor goes out, so the
         frame — and every later one until revival — rides the next tier
-        instead."""
+        instead.  ``affinity`` (the stream id) pins shm frames to one
+        stripe so per-stream ordering is decided by a single ring."""
         for rt in _route.candidates(self, _route.STREAM, nbytes):
-            if rt == _route.SHM or rt == _route.BULK:
+            if rt == _route.SHM:
+                return self.shm_tag_uuid(self.node.next_uuid(),
+                                         affinity), rt
+            if rt == _route.BULK:
                 return self.node.next_uuid(), rt
             break
         return 0, None
@@ -2149,11 +2251,21 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         if route == _route.SHM:
             ptrs, lens, n, total, keep = self._gather_blocks(frame)
             with self._bulk_lock:
-                h, lib = self._shm, self._shmlib
+                h, lib, stripes = self._shm, self._shmlib, \
+                    self._shm_stripes
             timeout_us = int(
                 _flags.get_flag("ici_shm_send_timeout_s") * 1e6)
-            rc = lib.brpc_tpu_shm_sendv(h, uuid, ptrs, lens, n,
-                                        timeout_us) if h else -1
+            if not h:
+                rc = -1
+            elif stripes > 1:
+                stripe = self._shm_stripe_of(uuid, stripes)
+                rc = lib.brpc_tpu_shm_sendv2(h, stripe, uuid, ptrs,
+                                             lens, n, timeout_us)
+                if rc == 0:
+                    _route.record_shm_stripe(stripe, total)
+            else:
+                rc = lib.brpc_tpu_shm_sendv(h, uuid, ptrs, lens, n,
+                                            timeout_us)
             del keep
             if rc != 0:
                 # descriptor already on the control channel: the peer's
@@ -2554,17 +2666,32 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         skew-tolerant timeout."""
         with self._bulk_lock:
             h, dead_h, lib = self._shm, self._shm_dead, self._shmlib
+            stripes, dead_stripes = self._shm_stripes, \
+                self._shm_dead_stripes
         out, olen = _u8p(), ctypes.c_uint64()
         if dead_h:
-            rc = lib.brpc_tpu_shm_recv(
-                dead_h, uuid, 0, ctypes.byref(out), ctypes.byref(olen))
+            if dead_stripes > 1:
+                rc = lib.brpc_tpu_shm_recv2(
+                    dead_h, self._shm_stripe_of(uuid, dead_stripes),
+                    uuid, 0, ctypes.byref(out), ctypes.byref(olen))
+            else:
+                rc = lib.brpc_tpu_shm_recv(
+                    dead_h, uuid, 0, ctypes.byref(out),
+                    ctypes.byref(olen))
             if rc == 0:
                 return out, olen.value, dead_h, lib
         timeout_us = int(
             _flags.get_flag("ici_bulk_claim_timeout_s") * 1e6)
-        rc = lib.brpc_tpu_shm_recv(
-            h, uuid, timeout_us,
-            ctypes.byref(out), ctypes.byref(olen)) if h else -2
+        if not h:
+            rc = -2
+        elif stripes > 1:
+            rc = lib.brpc_tpu_shm_recv2(
+                h, self._shm_stripe_of(uuid, stripes), uuid, timeout_us,
+                ctypes.byref(out), ctypes.byref(olen))
+        else:
+            rc = lib.brpc_tpu_shm_recv(
+                h, uuid, timeout_us,
+                ctypes.byref(out), ctypes.byref(olen))
         if rc != 0:
             raise ConnectionError(
                 f"fabric shm frame {uuid:#x} unclaimable (rc {rc})")

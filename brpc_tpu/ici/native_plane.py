@@ -54,6 +54,17 @@ _flags.define_flag("ici_upcall_batch_age_us", 50,
                    "stolen from a busy drainer and delivered "
                    "concurrently — bounds the p99 cost of batching")
 
+# Native attachment custody (ISSUE 12): device-seg lists park in a
+# NATIVE att table and move as one opaque handle — the handler tier
+# receives a ready zero-copy IOBuf view (NativeAttachment) instead of
+# walking seg descriptors through the registry twice per RPC.  Off =
+# the PR-8 take-during-upcall walk, byte-for-byte (the A/B leg).
+_flags.define_flag("ici_native_att_custody", True,
+                   "resolve ici attachment seg tokens native-side: "
+                   "handlers receive a lazily-materialized zero-copy "
+                   "view backed by native custody instead of a "
+                   "per-seg registry walk")
+
 # hot-path module handles, resolved once at first call: the per-call
 # `from x import y` dance measured ~1 us/call on the fast plane (the
 # lazy-at-call-time form exists only to dodge import cycles at load)
@@ -205,7 +216,7 @@ _release_cb = None
 
 def ensure_hooks() -> bool:
     """Install the relocate/release upcalls once per process."""
-    global _hooks_installed, _relocate_cb, _release_cb
+    global _hooks_installed, _relocate_cb, _release_cb, _att_fns
     lib = native.load()
     if lib is None:
         return False
@@ -214,6 +225,10 @@ def ensure_hooks() -> bool:
             _relocate_cb = _ICI_RELOCATE_FN(_relocate)
             _release_cb = _ICI_RELEASE_FN(_release)
             lib.brpc_tpu_ici_set_hooks(_relocate_cb, _release_cb)
+            # att-custody handle ops, bound once (the view's custody
+            # exits must not pay native.load()'s lock)
+            _att_fns = (lib.brpc_tpu_ici_att_take,
+                        lib.brpc_tpu_ici_att_dispose)
             _hooks_installed = True
     return True
 
@@ -310,22 +325,179 @@ def fill_seg_array(segs) -> "ctypes.Array":
 def build_attachment_from_c(att_host: bytes, segs_p, nsegs: int) -> IOBuf:
     """build_attachment reading the ctypes seg array DIRECTLY — skips the
     per-seg IciSegC copy the list-based form needs (one ctypes Structure
-    construction per seg measured ~0.8 µs on the handler tier)."""
+    construction per seg measured ~0.8 µs on the handler tier).
+
+    EXCEPTION-SAFE (ISSUE 12 satellite): the upcall contract says the
+    walk TAKES every device key — native clears its seg list when the
+    upcall returns, so a mid-walk failure used to strand every
+    not-yet-walked key in the registry forever (already-taken keys ride
+    the dropped buf; the REMAINING ones had no owner left).  On any
+    failure the un-walked device keys are released before re-raising."""
     buf = IOBuf()
     off = 0
     take = _registry.take
+    i = 0
+    try:
+        while i < nsegs:
+            s = segs_p[i]
+            n = s.nbytes
+            if s.is_dev:
+                arr = take(s.key)
+                if arr is None:
+                    raise KeyError(f"ici device ref {s.key} missing")
+                buf.append_device_array_unchecked(arr, n)
+            else:
+                buf.append(att_host[off:off + n])
+                off += n
+            i += 1
+    except BaseException:
+        release = _registry.release
+        for j in range(i + 1, nsegs):
+            s = segs_p[j]
+            if s.is_dev:
+                release(s.key)
+        raise
+    return buf
+
+
+# native att-custody handle ops, bound once at ensure_hooks (the hot
+# path must not pay native.load()'s lock per call): (take, dispose)
+_att_fns = None
+
+
+class NativeAttachment(IOBuf):
+    """Zero-copy attachment view backed by NATIVE custody (ISSUE 12).
+
+    The device-seg list this buffer represents is PARKED in the native
+    att table under ``_h``; the keys stay in the device-ref registry
+    (arrays alive, custody native).  Construction costs one small
+    object — no registry ops, no Block/BlockRef builds, no seg walk.
+    The handle exits custody EXACTLY ONCE, by whichever happens first:
+
+      * pass-through — ``cntl.response_attachment = view`` hands the
+        handle back to native in the respond struct (the echo shape:
+        zero Python walks end to end);
+      * materialization — any structural touch (``backing_block_num``,
+        ``to_bytes``, appending it into another IOBuf, ...) inflates
+        real DEVICE blocks: the registry keys are taken into Python
+        custody and the native entry is dropped without release;
+      * dispose — Controller pool-recycle (server side), ``__del__``
+        (client side / safety net): native releases every parked key.
+
+    ``len()``/``size()``/``empty()`` answer from the descriptor total
+    WITHOUT materializing — presence checks stay free.  Like IOBuf
+    itself, instances are not thread-safe."""
+
+    __slots__ = ("_h", "_total", "_seg_meta", "_mat")
+
+    def __init__(self, handle: int, total: int, seg_meta: tuple):
+        # deliberately NOT calling IOBuf.__init__: _refs/_size stay
+        # unset until materialization — __getattr__ inflates on the
+        # first structural touch
+        self._h = handle
+        self._total = total
+        self._seg_meta = seg_meta      # ((key, nbytes, dev), ...)
+        self._mat = False
+
+    # ---- lazy inflation ----------------------------------------------
+    def __getattr__(self, name):
+        if name in ("_refs", "_size"):
+            self._materialize()
+            return object.__getattribute__(self, name)
+        raise AttributeError(name)
+
+    def _materialize(self) -> None:
+        IOBuf.__init__(self)           # sets _refs/_size
+        self._mat = True
+        h = self._h
+        if not h:
+            return                     # surrendered/disposed: empty
+        self._h = 0
+        fns = _att_fns
+        if fns is None or fns[0](h) < 0:    # att_take consumes the entry
+            raise KeyError(f"ici native att handle {h} missing")
+        take = _registry.take
+        metas = self._seg_meta
+        for i, (key, nbytes, _dev) in enumerate(metas):
+            arr = take(key)
+            if arr is None:
+                # custody bug surface: keep exactly-one-exit for the
+                # REST of the list before raising
+                release = _registry.release
+                for k2, _n2, _d2 in metas[i + 1:]:
+                    release(k2)
+                raise KeyError(f"ici device ref {key} missing")
+            self.append_device_array_unchecked(arr, nbytes)
+
+    # ---- cheap overrides (no materialization) ------------------------
+    def __len__(self) -> int:
+        return self._total if not self._mat else self._size
+
+    def size(self) -> int:
+        return self.__len__()
+
+    def empty(self) -> bool:
+        return self.__len__() == 0
+
+    def __repr__(self) -> str:
+        if self._mat:
+            return IOBuf.__repr__(self)
+        return (f"NativeAttachment(size={self._total}, "
+                f"handle={self._h:#x}, lazy)")
+
+    # ---- custody exits -----------------------------------------------
+    def _surrender_native(self) -> int:
+        """Hand the parked entry back to native (the response pass-
+        through): returns the handle and forgets it — the respond
+        struct now owns the exit.  0 when there is nothing to pass."""
+        if self._mat:
+            return 0
+        h = self._h
+        self._h = 0
+        return h
+
+    def _dispose_native(self) -> None:
+        """Drop path (pool recycle / reject): native releases every
+        parked key.  Idempotent — a surrendered or materialized view
+        holds no handle."""
+        h = self._h
+        if h:
+            self._h = 0
+            fns = _att_fns
+            if fns is not None:
+                fns[1](h)
+
+    def __del__(self):                 # noqa: D105 — safety net: a view
+        try:                           # GC'd unexited must not strand
+            self._dispose_native()     # keys in the registry forever
+        except Exception:
+            pass
+
+
+def _seg_meta_from_req(r, nsegs: int):
+    """((key, nbytes, dev), ...) + total bytes for a handle-carrying
+    request struct: the dominant 1-seg shape reads the inline seg0
+    mirror (plain struct fields); longer lists walk the parked segs."""
+    if nsegs == 1:
+        n = r.seg0_nbytes
+        return ((r.seg0_key, n, r.seg0_dev),), n
+    segs_p = r.segs
+    total = 0
+    meta = []
     for i in range(nsegs):
         s = segs_p[i]
-        n = s.nbytes
-        if s.is_dev:
-            arr = take(s.key)
-            if arr is None:
-                raise KeyError(f"ici device ref {s.key} missing")
-            buf.append_device_array_unchecked(arr, n)
-        else:
-            buf.append(att_host[off:off + n])
-            off += n
-    return buf
+        meta.append((s.key, s.nbytes, s.dev))
+        total += s.nbytes
+    return tuple(meta), total
+
+
+def att_table_live() -> int:
+    """Parked native att entries (census surface); 0 when the native
+    core is unavailable."""
+    lib = native.load()
+    if lib is None or not hasattr(lib, "brpc_tpu_ici_att_count"):
+        return 0
+    return int(lib.brpc_tpu_ici_att_count())
 
 
 # id(arr) -> (mesh generation, mesh index), evicted by a finalizer when
@@ -465,6 +637,13 @@ class ServerBinding:
         lib.brpc_tpu_ici_set_batch_params(
             h, int(_flags.get_flag("ici_upcall_max_batch")),
             int(_flags.get_flag("ici_upcall_batch_age_us")))
+        # native att custody: device-seg lists arrive as parked handles
+        # (IciReqC.att_handle) instead of take-during-upcall seg walks.
+        # Snapshot at bind time — the A/B bench flips the flag between
+        # server generations, never mid-listener.
+        self._att_custody = bool(
+            _flags.get_flag("ici_native_att_custody"))
+        lib.brpc_tpu_ici_set_att_handles(h, 1 if self._att_custody else 0)
         with _server_bindings_lock:
             _server_bindings[device_id] = self
 
@@ -537,16 +716,30 @@ class ServerBinding:
                             if r.att_host_len else b""
                         nsegs = r.nsegs
                         if nsegs or att_host:
-                            # custody: the registry takes happen HERE,
-                            # inside the upcall — native clears its seg
-                            # lists when we return
-                            try:
-                                attachment = build_attachment_from_c(
-                                    att_host, r.segs, nsegs)
-                            except KeyError as e:
-                                self._respond_one(token, errors.EINTERNAL,
-                                                  str(e))
-                                continue
+                            ah = r.att_handle
+                            if ah:
+                                # native custody: the seg list stays
+                                # PARKED under ah — one small view
+                                # object, zero registry ops, zero
+                                # Block builds on this path
+                                meta, total = _seg_meta_from_req(
+                                    r, nsegs)
+                                attachment = NativeAttachment(
+                                    ah, total, meta)
+                            else:
+                                # legacy walk: the registry takes
+                                # happen HERE, inside the upcall —
+                                # native clears its seg lists when we
+                                # return
+                                try:
+                                    attachment = \
+                                        build_attachment_from_c(
+                                            att_host, r.segs, nsegs)
+                                except KeyError as e:
+                                    self._respond_one(
+                                        token, errors.EINTERNAL,
+                                        str(e))
+                                    continue
                         else:
                             attachment = None
                         # admission meta: (wire priority, tenant,
@@ -600,10 +793,20 @@ class ServerBinding:
                         log.error("ici batch request failed: %s", e,
                                   exc_info=True)
                         try:
-                            for j in range(r.nsegs):   # custody release
-                                sg = r.segs[j]
-                                if sg.is_dev:
-                                    _registry.release(sg.key)
+                            if r.att_handle:
+                                # per-request failure isolation, handle
+                                # mode: dispose the PARKED entry (a
+                                # table miss is a no-op, so racing the
+                                # view's own __del__ is safe — handles
+                                # are never reused)
+                                lib = self._lib
+                                lib.brpc_tpu_ici_att_dispose(
+                                    r.att_handle)
+                            else:
+                                for j in range(r.nsegs):  # custody rel.
+                                    sg = r.segs[j]
+                                    if sg.is_dev:
+                                        _registry.release(sg.key)
                         except Exception:
                             pass
                         try:
@@ -697,11 +900,14 @@ class ServerBinding:
 
     @staticmethod
     def _release_attachment_custody(attachment) -> None:
-        """Drop an already-built request attachment on a reject path:
+        """Drop a request attachment on a reject path.  Legacy walk:
         its device arrays left the registry at build time (Python owns
-        them through the IOBuf) — letting the IOBuf go is the release."""
-        # nothing to do beyond dropping the reference; documented here
-        # so every reject path states the custody outcome explicitly
+        them through the IOBuf) — letting the IOBuf go is the release.
+        Native custody: the view still parks its seg list in the att
+        table — dispose is the exactly-one exit (idempotent; a
+        materialized or surrendered view holds no handle)."""
+        if type(attachment) is NativeAttachment:
+            attachment._dispose_native()
         return
 
     def _execute(self, token, full, payload, attachment, log_id,
@@ -778,12 +984,25 @@ class ServerBinding:
                                   post=post)
                 return
             resp_att = cntl._peek_response_attachment()
-            if resp_att is not None and resp_att.backing_block_num():
-                att_host, segs = split_attachment(resp_att)
+            pass_h = 0
+            if resp_att is not None:
+                if type(resp_att) is NativeAttachment:
+                    # echo pass-through: the UNMATERIALIZED request view
+                    # assigned as the response — hand the parked handle
+                    # straight back to native; zero Python walks on the
+                    # whole response side.  (A materialized view holds
+                    # no handle and falls through to the normal split.)
+                    pass_h = resp_att._surrender_native()
+                if pass_h:
+                    att_host, segs = b"", ()
+                elif resp_att.backing_block_num():
+                    att_host, segs = split_attachment(resp_att)
+                else:
+                    att_host, segs = b"", ()
             else:
                 att_host, segs = b"", ()
             item = (token, 0, b"", response.SerializeToString(),
-                    att_host, segs, post, 0)
+                    att_host, segs, post, 0, pass_h)
             if stages:
                 record_stage("encode",
                              (_time.monotonic_ns() - t_done) // 1000,
@@ -821,7 +1040,7 @@ class ServerBinding:
                      post=None, retry_after: int = 0) -> None:
         item = (token, err,
                 text.encode() if isinstance(text, str) else (text or b""),
-                b"", b"", (), post, retry_after)
+                b"", b"", (), post, retry_after, 0)
         if collector is None or not collector.add(item):
             self._respond_item(item)
 
@@ -836,12 +1055,13 @@ class ServerBinding:
         if arr is None:
             arr = tls["resp1"] = (IciRespC * 1)()
         token, err, err_text, payload, att_host, segs, post, \
-            retry_after = item
+            retry_after, att_handle = item
         e = arr[0]
         e.token = token
         e.err = err
         e.err_text = err_text or None
         e.retry_after_ms = retry_after
+        e.att_handle = att_handle
         if payload:
             e.data = ctypes.cast(payload, _U8P)
             e.len = len(payload)
@@ -885,11 +1105,12 @@ class ServerBinding:
         arr = (IciRespC * n)()
         keep = []                      # buffers alive across the call
         for i, (token, err, err_text, payload, att_host, segs, _post,
-                retry_after) in enumerate(items):
+                retry_after, att_handle) in enumerate(items):
             e = arr[i]
             e.token = token
             e.err = err
             e.retry_after_ms = retry_after
+            e.att_handle = att_handle
             if err_text:
                 e.err_text = err_text
                 keep.append(err_text)
@@ -950,7 +1171,14 @@ class ChannelBinding:
         self._names: Dict[str, bytes] = {}      # method encode cache
         self._tenants: Dict[str, bytes] = {}    # tenant encode cache
         self._tls = threading.local()           # reused IciCallOut
-        self._call3 = lib.brpc_tpu_ici_call3    # bound once: attr-chain
+        # native att custody (snapshot at init, like ServerBinding):
+        # call4 parks device-only response attachments under a handle
+        # and releases error-path segs natively — the client sheds its
+        # take-walks both ways
+        self._att_custody = bool(
+            _flags.get_flag("ici_native_att_custody"))
+        self._call3 = lib.brpc_tpu_ici_call4 if self._att_custody \
+            else lib.brpc_tpu_ici_call3         # bound once: attr-chain
         self._free = lib.brpc_tpu_buf_free      # lookups are per-call
         h = lib.brpc_tpu_ici_connect(local_dev, remote_dev, window_bytes)
         if h == 0:
@@ -1063,13 +1291,15 @@ class ChannelBinding:
             cntl.remote_side = self.remote_side
             nsegs = out.nsegs
             if rc != 0:
-                # native copies response segs to segs_out even when the
-                # handler responded with an error: release their device
-                # keys or they strand in the registry forever (the
-                # exactly-one-exit custody invariant)
-                for i in range(nsegs):
-                    if out.segs[i].is_dev and out.segs[i].key:
-                        _registry.release(out.segs[i].key)
+                if not self._att_custody:
+                    # native copies response segs to segs_out even when
+                    # the handler responded with an error: release their
+                    # device keys or they strand in the registry forever
+                    # (the exactly-one-exit custody invariant).  call4
+                    # releases them native-side — no walk at all.
+                    for i in range(nsegs):
+                        if out.segs[i].is_dev and out.segs[i].key:
+                            _registry.release(out.segs[i].key)
                 text = ctypes.string_at(out.err_text).decode() \
                     if out.err_text else errors.berror(int(rc))
                 cntl.set_failed(int(rc), text)
@@ -1080,10 +1310,30 @@ class ChannelBinding:
             payload = ctypes.string_at(out.resp, out.resp_len) \
                 if out.resp_len else b""
             if nsegs or out.att_len:
-                r_att_host = ctypes.string_at(out.att, out.att_len) \
-                    if out.att_len else b""
-                rbuf = build_attachment_from_c(r_att_host, out.segs,
-                                               nsegs)
+                ah = out.att_handle
+                if ah:
+                    # native custody: the response seg list stays
+                    # parked — wrap it lazily (seg0 rides inline for
+                    # the 1-seg shape; the >1 metadata copy is read
+                    # NOW, before the finally block frees it)
+                    if nsegs == 1:
+                        total = out.seg0_nbytes
+                        meta = ((out.seg0_key, total, out.seg0_dev),)
+                    else:
+                        segs_p = out.segs
+                        lst = []
+                        total = 0
+                        for i in range(nsegs):
+                            s = segs_p[i]
+                            lst.append((s.key, s.nbytes, s.dev))
+                            total += s.nbytes
+                        meta = tuple(lst)
+                    rbuf = NativeAttachment(ah, total, meta)
+                else:
+                    r_att_host = ctypes.string_at(out.att, out.att_len) \
+                        if out.att_len else b""
+                    rbuf = build_attachment_from_c(r_att_host, out.segs,
+                                                   nsegs)
                 prev = cntl._peek_response_attachment()
                 if prev is None:
                     cntl.response_attachment = rbuf

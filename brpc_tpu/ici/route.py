@@ -82,17 +82,10 @@ def candidates(sock, cls: str, nbytes: int) -> List[str]:
     return out
 
 
-def record(sock, route: str, nbytes: int, frames: int = 1) -> None:
-    """Count ``frames`` frame(s) on ``route``; the ``bulk`` row is
-    labeled by the transport the socket's bulk conn actually uses
-    (uds/tcp).  This sits on the per-frame fast path, so the counter
-    pair is read lock-free (dict.get is atomic under the GIL; entries
-    are only ever added) and the module lock is taken only to create
-    one."""
-    if route == BULK:
-        label = "uds" if getattr(sock, "_bulk_is_uds", False) else "tcp"
-    else:
-        label = route
+def _counter_pair(label: str):
+    """(frames, bytes) Adder pair for ``label`` — the publish-once /
+    read-lock-free discipline in ONE place (dict.get is GIL-atomic and
+    entries are only ever added; the module lock guards creation)."""
     pair = _counters.get(label)
     if pair is None:
         with _counters_lock:
@@ -102,6 +95,30 @@ def record(sock, route: str, nbytes: int, frames: int = 1) -> None:
                 pair = _counters[label] = (
                     bvar.Adder(name=f"rpc_fabric_route_{label}_frames"),
                     bvar.Adder(name=f"rpc_fabric_route_{label}_bytes"))
+    return pair
+
+
+def record(sock, route: str, nbytes: int, frames: int = 1) -> None:
+    """Count ``frames`` frame(s) on ``route``; the ``bulk`` row is
+    labeled by the transport the socket's bulk conn actually uses
+    (uds/tcp).  This sits on the per-frame fast path — see
+    _counter_pair for the lock discipline."""
+    if route == BULK:
+        label = "uds" if getattr(sock, "_bulk_is_uds", False) else "tcp"
+    else:
+        label = route
+    pair = _counter_pair(label)
+    pair[0] << frames
+    pair[1] << nbytes
+
+
+def record_shm_stripe(stripe: int, nbytes: int, frames: int = 1) -> None:
+    """Per-stripe shm accounting (``rpc_fabric_route_shm_stripe_<i>_
+    frames/bytes``) — the route-assertion surface for the striped
+    plane: a striped transfer is proven striped by these counters, not
+    assumed.  Only the striped path records here (1-stripe planes keep
+    the plain ``shm`` row, byte-identical to PR 10)."""
+    pair = _counter_pair(f"shm_stripe_{stripe}")
     pair[0] << frames
     pair[1] << nbytes
 

@@ -596,6 +596,22 @@ class ControllerPool:
         rid = c.__dict__.get("_pool_rid", 0)
         if not rid or not self._ids.return_resource(rid):
             return                   # not ours / already released: drop
+        # native att custody (ISSUE 12): pool-recycle is the blessed
+        # drop point for an attachment view whose handle never exited
+        # (handler ignored it / response failed before the pass-back) —
+        # duck-typed so this module never imports the ici plane.  Both
+        # hooks are idempotent; plain IOBufs don't carry them.
+        d = c.__dict__
+        att = d.get("request_attachment")
+        if att is not None:
+            fn = getattr(att, "_dispose_native", None)
+            if fn is not None:
+                fn()
+        att = d.get("response_attachment")
+        if att is not None:
+            fn = getattr(att, "_dispose_native", None)
+            if fn is not None:
+                fn()
         c.reset()
         with self._lock:
             if len(self._free) < self.capacity:

@@ -379,7 +379,10 @@ class Stream:
                 >= _flags.get_flag("ici_stream_bulk_threshold")):
             fast = getattr(sock, "stream_fast_begin", None)
             if fast is not None:
-                bulk_uuid, bulk_route = fast(len(payload))
+                # the stream id pins a striped shm plane's stripe —
+                # per-stream ordering is decided by ONE ring
+                bulk_uuid, bulk_route = fast(len(payload),
+                                             affinity=self.sid)
             else:
                 begin = getattr(sock, "stream_bulk_begin", None)
                 if begin is not None:
@@ -411,7 +414,8 @@ class Stream:
                     if rc == 0:
                         fast = getattr(sock, "stream_fast_begin", None)
                         if fast is not None:
-                            bulk_uuid, bulk_route = fast(len(payload))
+                            bulk_uuid, bulk_route = fast(
+                                len(payload), affinity=self.sid)
                     if bulk_route == "shm":
                         # the ring re-attached between degrade and
                         # re-screen: one more try, else next tier

@@ -203,17 +203,33 @@ def run_press_fanout(server: str, method: str, n: int,
     return result
 
 
+def apply_shm_stripes(n: int) -> None:
+    """``--shm-stripes N``: force the striped shm plane (ISSUE 12) —
+    N SPSC ring pairs per segment, round-robin for unary frames,
+    stream-id affinity for streams.  0 keeps auto (1 on 1-core hosts).
+    Whether stripes actually carried bytes is visible in the summary's
+    ``rpc_fabric_route_shm_stripe_*`` counters — asserted, not
+    assumed."""
+    if n <= 0:
+        return
+    import brpc_tpu.ici.fabric  # noqa: F401 — defines ici_shm_stripes
+    from brpc_tpu.butil import flags as _fl
+    _fl.set_flag("ici_shm_stripes", n)
+
+
 def run_press(server: str, method: str, request_json: str,
               qps: int = 0, duration: float = 5.0, concurrency: int = 8,
               proto: Optional[str] = None, protocol: str = "tpu_std",
               priority: Optional[str] = None, tenant: Optional[str] = None,
               max_retry: Optional[int] = None,
-              bulk_plane: str = "auto", out=sys.stderr) -> dict:
+              bulk_plane: str = "auto", shm_stripes: int = 0,
+              out=sys.stderr) -> dict:
     import brpc_tpu.policy  # noqa: F401 — registers protocols
     from brpc_tpu import rpc, bvar
     from brpc_tpu.codec import json2pb
     from brpc_tpu.rpc import errors as rpc_errors
     apply_bulk_plane(bulk_plane)
+    apply_shm_stripes(shm_stripes)
 
     if proto:
         req_cls, resp_cls = _load_classes(proto)
@@ -338,6 +354,7 @@ def run_press(server: str, method: str, request_json: str,
         "elapsed_s": round(elapsed, 2),
         "interrupted": stop_evt.is_set(),
         "bulk_plane": bulk_plane,
+        "shm_stripes": shm_stripes,
     }
     # which byte mover actually carried the run's payloads (ici/route.py
     # counters; empty off the fabric) — the "chosen route" in the summary
@@ -389,6 +406,11 @@ def main(argv=None) -> int:
                          "(route table: shm > uds/tcp > inline), shm, "
                          "uds (shm off), inline (both descriptor planes "
                          "off); the summary reports per-route counters")
+    ap.add_argument("--shm-stripes", type=int, default=0,
+                    help="force N shm ring stripes per segment (0 = "
+                         "auto: 1 on 1-core hosts, else min(4, cores)); "
+                         "per-stripe counters appear in the summary's "
+                         "routes")
     ap.add_argument("--fanout", type=int, default=0,
                     help="drive ONE ParallelChannel over the first N "
                          "resolved members (compiled collective route "
@@ -409,7 +431,7 @@ def main(argv=None) -> int:
               args.duration, args.concurrency, args.proto, args.protocol,
               priority=args.priority, tenant=args.tenant,
               max_retry=args.max_retry, bulk_plane=args.bulk_plane,
-              out=sys.stdout)
+              shm_stripes=args.shm_stripes, out=sys.stdout)
     return 0
 
 

@@ -739,6 +739,32 @@ struct SegHdr {
   RingHdr rings[2];                 // [0] creator->attacher, [1] reverse
 };
 
+// STRIPED segment header (v2, ISSUE 12): same leading fields as SegHdr
+// (an attacher reads the shared 24-byte prefix to pick the layout by
+// magic), then nstripes, then RingHdr[2 * nstripes] and the per-stripe
+// data regions (stripe s: ring 2s = creator->attacher, 2s+1 reverse).
+// ring_bytes stays PER-DIRECTION PER-STRIPE: a frame must fit one
+// stripe's ring, exactly the v1 capacity contract, so the Python route
+// screen is unchanged.  Created only when nstripes > 1 — a 1-stripe
+// segment is ALWAYS the v1 layout, byte-identical to PR 10.
+static constexpr uint32_t kShmMagic2 = 0x53484d32;  // "SHM2"
+struct SegHdrS {
+  std::atomic<uint32_t> magic;
+  uint32_t version;
+  uint64_t ring_bytes;
+  std::atomic<uint32_t> dead;
+  std::atomic<uint32_t> attached;
+  uint32_t nstripes;
+  uint32_t _pad;
+};
+static inline uint64_t pad64(uint64_t n) { return (n + 63) & ~63ull; }
+static inline uint64_t seg2_data_off(uint32_t nstripes) {
+  return pad64(sizeof(SegHdrS) + 2ull * nstripes * sizeof(RingHdr));
+}
+static inline uint64_t seg2_total(uint64_t ring_bytes, uint32_t nstripes) {
+  return seg2_data_off(nstripes) + 2ull * nstripes * ring_bytes;
+}
+
 static_assert(std::atomic<uint64_t>::is_always_lock_free,
               "shm rings need address-free atomics");
 
@@ -752,30 +778,48 @@ struct ShmSlot {
   int state;
 };
 
-struct ShmConn {
-  void* base = nullptr;
-  size_t map_len = 0;
-  SegHdr* hdr = nullptr;
-  int side = 0;                    // 0 creator, 1 attacher
+// One stripe = one SPSC ring pair + the receiver-side bookkeeping for
+// it.  A v1 segment is exactly one stripe; a v2 segment holds N, each
+// with its OWN tx/rx locks so concurrent Python sender/claimer threads
+// on different stripes never serialize on a shared mutex — that is the
+// multi-core win the striping exists for.  Health stays SEGMENT-wide
+// (the shared dead word): one dead stripe degrades the whole plane
+// in-frame, exactly like the single ring.
+struct ShmStripe {
   RingHdr* tx = nullptr;
   uint8_t* txd = nullptr;          // tx ring data
   RingHdr* rx = nullptr;
   uint8_t* rxd = nullptr;
   // Process-local serialization: the ring itself is SPSC per direction;
   // these locks make the many-threaded Python side look like one
-  // producer / one consumer.
+  // producer / one consumer PER STRIPE.
   std::mutex tx_mu;
   std::mutex rx_mu;                // guards scan/claim/retire bookkeeping
   uint64_t scan_cursor = 0;        // guarded by rx_mu
   std::deque<ShmSlot> slots;       // ring order; guarded by rx_mu
   nbase::FlatMap64<ShmSlot*> parked;                 // uuid -> slot (rx_mu)
   std::unordered_map<uintptr_t, ShmSlot*> claimed;   // ptr -> slot (rx_mu)
-  bool closed = false;             // rx_mu
   std::atomic<uint64_t> bytes_in{0}, bytes_out{0};
   std::atomic<uint64_t> db_waits_send{0}, db_waits_recv{0};
+};
+
+struct ShmConn {
+  void* base = nullptr;
+  size_t map_len = 0;
+  SegHdr* hdr = nullptr;           // v1 header (null on a v2 segment)
+  SegHdrS* hdr2 = nullptr;         // v2 header (null on a v1 segment)
+  std::atomic<uint32_t>* dead_w = nullptr;   // shared death word
+  RingHdr* rings_base = nullptr;   // all 2*nstripes ring headers
+  uint64_t ring_bytes = 0;         // per direction PER STRIPE
+  uint32_t nstripes = 1;
+  int side = 0;                    // 0 creator, 1 attacher
+  std::vector<std::unique_ptr<ShmStripe>> stripes;
+  std::atomic<bool> closed{false};
+  std::atomic<uint64_t> bytes_in{0}, bytes_out{0};   // conn totals
   // chaos knobs (brpc_tpu_shm_chaos)
   std::atomic<int64_t> chaos_sever_after{-1};  // tx payload-byte watermark
   std::atomic<int64_t> chaos_drop_frames{0};   // rx: drop next N at scan
+  std::atomic<int64_t> chaos_kill_stripe{-1};  // next send on stripe dies
 
   ~ShmConn() {
     if (base != nullptr) ::munmap(base, map_len);
@@ -786,37 +830,91 @@ struct ShmConn {
     map_len = len;
     hdr = reinterpret_cast<SegHdr*>(b);
     side = s;
+    dead_w = &hdr->dead;
+    rings_base = hdr->rings;
+    ring_bytes = hdr->ring_bytes;
+    nstripes = 1;
     uint8_t* d0 = reinterpret_cast<uint8_t*>(b) + sizeof(SegHdr);
     uint8_t* d1 = d0 + hdr->ring_bytes;
-    tx = &hdr->rings[s];
-    txd = s == 0 ? d0 : d1;
-    rx = &hdr->rings[1 - s];
-    rxd = s == 0 ? d1 : d0;
+    auto st = std::make_unique<ShmStripe>();
+    st->tx = &hdr->rings[s];
+    st->txd = s == 0 ? d0 : d1;
+    st->rx = &hdr->rings[1 - s];
+    st->rxd = s == 0 ? d1 : d0;
+    stripes.clear();
+    stripes.push_back(std::move(st));
+  }
+
+  void bind2(void* b, size_t len, int s) {
+    base = b;
+    map_len = len;
+    hdr2 = reinterpret_cast<SegHdrS*>(b);
+    side = s;
+    dead_w = &hdr2->dead;
+    ring_bytes = hdr2->ring_bytes;
+    nstripes = hdr2->nstripes;
+    rings_base = reinterpret_cast<RingHdr*>(
+        reinterpret_cast<uint8_t*>(b) + sizeof(SegHdrS));
+    uint8_t* data0 = reinterpret_cast<uint8_t*>(b) +
+                     seg2_data_off(nstripes);
+    stripes.clear();
+    for (uint32_t i = 0; i < nstripes; ++i) {
+      auto st = std::make_unique<ShmStripe>();
+      RingHdr* fwd = &rings_base[2 * i];       // creator -> attacher
+      RingHdr* rev = &rings_base[2 * i + 1];
+      uint8_t* fwd_d = data0 + (2ull * i) * ring_bytes;
+      uint8_t* rev_d = data0 + (2ull * i + 1) * ring_bytes;
+      st->tx = s == 0 ? fwd : rev;
+      st->txd = s == 0 ? fwd_d : rev_d;
+      st->rx = s == 0 ? rev : fwd;
+      st->rxd = s == 0 ? rev_d : fwd_d;
+      stripes.push_back(std::move(st));
+    }
   }
 
   void mark_dead() {
-    hdr->dead.store(1, std::memory_order_release);
-    // wake EVERY doorbell both directions so parked waiters re-check
-    for (int r = 0; r < 2; ++r) {
-      hdr->rings[r].data_seq.fetch_add(1, std::memory_order_release);
-      hdr->rings[r].space_seq.fetch_add(1, std::memory_order_release);
-      shm_futex_wake(&hdr->rings[r].data_seq);
-      shm_futex_wake(&hdr->rings[r].space_seq);
+    dead_w->store(1, std::memory_order_release);
+    // wake EVERY doorbell, every stripe, both directions so parked
+    // waiters re-check
+    for (uint32_t r = 0; r < 2 * nstripes; ++r) {
+      rings_base[r].data_seq.fetch_add(1, std::memory_order_release);
+      rings_base[r].space_seq.fetch_add(1, std::memory_order_release);
+      shm_futex_wake(&rings_base[r].data_seq);
+      shm_futex_wake(&rings_base[r].space_seq);
     }
+  }
+
+  bool is_dead() const {
+    return dead_w->load(std::memory_order_acquire) != 0;
+  }
+
+  ShmStripe* stripe(uint32_t i) {
+    return i < stripes.size() ? stripes[i].get() : nullptr;
   }
 
   // 0 ok; -1 dead/severed/timeout (the caller degrades the shm plane);
   // -3 frame can never fit this ring (route elsewhere, plane healthy).
-  int send(uint64_t uuid, const uint8_t* const* ptrs, const uint64_t* lens,
-           int n, int64_t timeout_us) {
+  int send(uint32_t stripe_idx, uint64_t uuid, const uint8_t* const* ptrs,
+           const uint64_t* lens, int n, int64_t timeout_us) {
+    ShmStripe* st = stripe(stripe_idx);
+    if (st == nullptr) return -1;
+    if (chaos_kill_stripe.load(std::memory_order_relaxed) ==
+        (int64_t)stripe_idx) {
+      // stripe-targeted chaos: THIS stripe's next send dies, and the
+      // shared death word takes the whole plane with it — the
+      // stripe-kill shape the tests pin (health is segment-wide)
+      chaos_kill_stripe.store(-1, std::memory_order_relaxed);
+      mark_dead();
+      return -1;
+    }
     uint64_t total = 0;
     for (int i = 0; i < n; ++i) total += lens[i];
-    uint64_t ring = hdr->ring_bytes;
+    uint64_t ring = ring_bytes;
     uint64_t footprint = kAlign + pad16(total);
     if (footprint > ring) return -3;
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::microseconds(timeout_us);
-    std::lock_guard<std::mutex> g(tx_mu);
+    std::lock_guard<std::mutex> g(st->tx_mu);
     // tail is ours (tx_mu held), so the placement — and with it the
     // wrap cost — is FIXED for the whole call: when the frame must
     // wrap, need = remainder + footprint, and if that exceeds the ring
@@ -825,19 +923,19 @@ struct ShmConn {
     // parking out the full timeout and letting the caller declare a
     // healthy ring dead (review finding; frames ≤ ring/2 never hit
     // this, which is what the Python route screen guarantees).
-    uint64_t tail = tx->tail.load(std::memory_order_relaxed);
+    uint64_t tail = st->tx->tail.load(std::memory_order_relaxed);
     uint64_t pos = tail % ring;
     uint64_t to_end = ring - pos;
     uint64_t need = footprint <= to_end ? footprint : to_end + footprint;
     if (need > ring) return -3;
     for (;;) {
-      if (hdr->dead.load(std::memory_order_acquire)) return -1;
-      uint32_t seen = tx->space_seq.load(std::memory_order_acquire);
-      uint64_t head = tx->head.load(std::memory_order_acquire);
+      if (is_dead()) return -1;
+      uint32_t seen = st->tx->space_seq.load(std::memory_order_acquire);
+      uint64_t head = st->tx->head.load(std::memory_order_acquire);
       if (need <= ring - (tail - head)) break;
       if (std::chrono::steady_clock::now() >= deadline) return -1;
-      db_waits_send.fetch_add(1, std::memory_order_relaxed);
-      shm_futex_wait(&tx->space_seq, seen, 50 * 1000000ll);
+      st->db_waits_send.fetch_add(1, std::memory_order_relaxed);
+      shm_futex_wait(&st->tx->space_seq, seen, 50 * 1000000ll);
     }
     // chaos: the configured payload-byte watermark lands inside this
     // frame — copy only the allowed prefix and die WITHOUT advancing
@@ -848,7 +946,7 @@ struct ShmConn {
       int64_t out = (int64_t)bytes_out.load(std::memory_order_relaxed);
       uint64_t allowed = out >= watermark ? 0 : (uint64_t)(watermark - out);
       if (allowed < total) {
-        uint8_t* p = txd + (footprint <= to_end ? pos : 0);
+        uint8_t* p = st->txd + (footprint <= to_end ? pos : 0);
         memcpy(p, &uuid, 8);
         memcpy(p + 8, &total, 8);
         uint64_t left = allowed;
@@ -865,13 +963,13 @@ struct ShmConn {
     }
     if (footprint > to_end) {
       // wrap marker: remainder is dead space, frame starts at offset 0
-      uint8_t* m = txd + pos;
+      uint8_t* m = st->txd + pos;
       uint64_t wrap = kWrapUuid, zero = 0;
       memcpy(m, &wrap, 8);
       memcpy(m + 8, &zero, 8);
       pos = 0;
     }
-    uint8_t* p = txd + pos;
+    uint8_t* p = st->txd + pos;
     memcpy(p, &uuid, 8);
     memcpy(p + 8, &total, 8);
     uint8_t* w = p + kAlign;
@@ -881,132 +979,162 @@ struct ShmConn {
       w += lens[i];
     }
     if (big) ring_copy_fence();
-    tx->tail.store(tail + need, std::memory_order_release);
-    tx->data_seq.fetch_add(1, std::memory_order_release);
-    shm_futex_wake(&tx->data_seq);
+    st->tx->tail.store(tail + need, std::memory_order_release);
+    st->tx->data_seq.fetch_add(1, std::memory_order_release);
+    shm_futex_wake(&st->tx->data_seq);
+    st->bytes_out.fetch_add(total, std::memory_order_relaxed);
     bytes_out.fetch_add(total, std::memory_order_relaxed);
     return 0;
   }
 
-  // Caller holds rx_mu.  Parks every frame published since the last
+  // Caller holds st->rx_mu.  Parks every frame published since the last
   // scan; chaos-dropped frames retire immediately (bytes vanish — the
   // descriptor's claim can never be satisfied).
-  void scan_locked() {
-    uint64_t ring = hdr->ring_bytes;
-    uint64_t tail = rx->tail.load(std::memory_order_acquire);
+  void scan_locked(ShmStripe* st) {
+    uint64_t ring = ring_bytes;
+    uint64_t tail = st->rx->tail.load(std::memory_order_acquire);
     bool dropped = false;
-    while (scan_cursor < tail) {
-      uint64_t pos = scan_cursor % ring;
-      uint8_t* p = rxd + pos;
+    while (st->scan_cursor < tail) {
+      uint64_t pos = st->scan_cursor % ring;
+      uint8_t* p = st->rxd + pos;
       uint64_t uuid, len;
       memcpy(&uuid, p, 8);
       memcpy(&len, p + 8, 8);
       uint64_t footprint;
       if (uuid == kWrapUuid) {
         footprint = ring - pos;
-        slots.push_back(ShmSlot{scan_cursor, footprint, nullptr, 0,
-                                kRetired});
+        st->slots.push_back(ShmSlot{st->scan_cursor, footprint, nullptr,
+                                    0, kRetired});
       } else {
         footprint = kAlign + pad16(len);
         if (chaos_drop_frames.load(std::memory_order_relaxed) > 0) {
           chaos_drop_frames.fetch_sub(1, std::memory_order_relaxed);
-          slots.push_back(ShmSlot{scan_cursor, footprint, nullptr, len,
-                                  kRetired});
+          st->slots.push_back(ShmSlot{st->scan_cursor, footprint, nullptr,
+                                      len, kRetired});
           dropped = true;
         } else {
-          slots.push_back(ShmSlot{scan_cursor, footprint, p + kAlign, len,
-                                  kParked});
-          ShmSlot* sp = &slots.back();
+          st->slots.push_back(ShmSlot{st->scan_cursor, footprint,
+                                      p + kAlign, len, kParked});
+          ShmSlot* sp = &st->slots.back();
           // duplicate uuid: keep the NEWER frame claimable (mirror of
           // the socket tier's replace-defensively rule); the older one
           // can still retire through its slot record
-          ShmSlot** old = parked.seek(uuid);
+          ShmSlot** old = st->parked.seek(uuid);
           if (old != nullptr) (*old)->state = kRetired;
-          parked[uuid] = sp;
+          st->parked[uuid] = sp;
         }
       }
-      scan_cursor += footprint;
+      st->scan_cursor += footprint;
     }
-    if (dropped) retire_locked();
+    if (dropped) retire_locked(st);
   }
 
-  // Caller holds rx_mu: advance head over the retired prefix and ring
-  // the space doorbell — the consume-to-release credit return.
-  void retire_locked() {
+  // Caller holds st->rx_mu: advance head over the retired prefix and
+  // ring the space doorbell — the consume-to-release credit return.
+  void retire_locked(ShmStripe* st) {
     bool advanced = false;
-    while (!slots.empty() && slots.front().state == kRetired) {
-      rx->head.fetch_add(slots.front().footprint,
-                         std::memory_order_release);
-      slots.pop_front();
+    while (!st->slots.empty() && st->slots.front().state == kRetired) {
+      st->rx->head.fetch_add(st->slots.front().footprint,
+                             std::memory_order_release);
+      st->slots.pop_front();
       advanced = true;
     }
     if (advanced) {
-      rx->space_seq.fetch_add(1, std::memory_order_release);
-      shm_futex_wake(&rx->space_seq);
+      st->rx->space_seq.fetch_add(1, std::memory_order_release);
+      shm_futex_wake(&st->rx->space_seq);
     }
   }
 
   // 0 ok (*out points INTO the ring; release with brpc_tpu_shm_release
   // — ownership of the SLOT transfers, the memory stays ring-owned);
   // -1 timeout; -2 dead/closed and the frame never arrived.
-  int recv(uint64_t uuid, int64_t timeout_us, uint8_t** out,
-           uint64_t* out_len) {
+  int recv(uint32_t stripe_idx, uint64_t uuid, int64_t timeout_us,
+           uint8_t** out, uint64_t* out_len) {
+    ShmStripe* st = stripe(stripe_idx);
+    if (st == nullptr) return -2;
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::microseconds(timeout_us);
     for (;;) {
       uint32_t seen;
       {
-        std::lock_guard<std::mutex> g(rx_mu);
-        if (closed) return -2;
+        std::lock_guard<std::mutex> g(st->rx_mu);
+        if (closed.load(std::memory_order_acquire)) return -2;
         // doorbell value FIRST, then scan: a publish racing the scan
         // changes the word, so the wait below returns immediately
-        seen = rx->data_seq.load(std::memory_order_acquire);
-        scan_locked();
-        ShmSlot** sp = parked.seek(uuid);
+        seen = st->rx->data_seq.load(std::memory_order_acquire);
+        scan_locked(st);
+        ShmSlot** sp = st->parked.seek(uuid);
         if (sp != nullptr) {
           ShmSlot* s = *sp;
-          parked.erase(uuid);
+          st->parked.erase(uuid);
           s->state = kClaimed;
-          claimed[(uintptr_t)s->data] = s;
+          st->claimed[(uintptr_t)s->data] = s;
           *out = s->data;
           *out_len = s->len;
+          st->bytes_in.fetch_add(s->len, std::memory_order_relaxed);
           bytes_in.fetch_add(s->len, std::memory_order_relaxed);
           return 0;
         }
-        if (hdr->dead.load(std::memory_order_acquire)) return -2;
+        if (is_dead()) return -2;
       }
       if (timeout_us >= 0 &&
           std::chrono::steady_clock::now() >= deadline)
         return -1;
-      db_waits_recv.fetch_add(1, std::memory_order_relaxed);
-      shm_futex_wait(&rx->data_seq, seen, 50 * 1000000ll);
+      st->db_waits_recv.fetch_add(1, std::memory_order_relaxed);
+      shm_futex_wait(&st->rx->data_seq, seen, 50 * 1000000ll);
     }
   }
 
   // True when the conn should be dropped from the registry (closed and
-  // every claimed buffer returned — the deferred-unmap gate).
+  // every claimed buffer returned — the deferred-unmap gate).  The
+  // owning stripe is found by pointer (claims are infrequent relative
+  // to bytes, and nstripes is tiny).
+  // The stripe that owns an rx-ring pointer, derived from the mapping
+  // layout (data regions are contiguous per ring) — release must not
+  // scan stripes under their claim-hot rx_mu locks (review finding:
+  // that would re-introduce exactly the cross-stripe contention the
+  // striping removes).  Returns nullptr for a pointer outside any rx
+  // data region.
+  ShmStripe* stripe_of_ptr(const uint8_t* p) {
+    if (nstripes == 1) return stripes[0].get();
+    const uint8_t* data0 = reinterpret_cast<const uint8_t*>(base) +
+                           seg2_data_off(nstripes);
+    if (p < data0) return nullptr;
+    uint64_t ring_idx = (uint64_t)(p - data0) / ring_bytes;
+    if (ring_idx >= 2ull * nstripes) return nullptr;
+    return stripes[ring_idx / 2].get();
+  }
+
   bool release(uint8_t* p, bool* drained) {
-    std::lock_guard<std::mutex> g(rx_mu);
-    auto it = claimed.find((uintptr_t)p);
-    if (it == claimed.end()) return false;
-    it->second->state = kRetired;
-    claimed.erase(it);
-    retire_locked();
-    *drained = closed && claimed.empty();
+    ShmStripe* st = stripe_of_ptr(p);
+    if (st == nullptr) return false;
+    {
+      std::lock_guard<std::mutex> g(st->rx_mu);
+      auto it = st->claimed.find((uintptr_t)p);
+      if (it == st->claimed.end()) return false;
+      it->second->state = kRetired;
+      st->claimed.erase(it);
+      retire_locked(st);
+    }
+    // drained check AFTER the stripe lock dropped: each stripe is
+    // re-locked in index order (concurrent releasers on different
+    // stripes must never hold one rx_mu while waiting on another)
+    *drained = closed.load(std::memory_order_acquire) && this->drained();
     return true;
   }
 
   void close() {
-    {
-      std::lock_guard<std::mutex> g(rx_mu);
-      closed = true;
-    }
+    closed.store(true, std::memory_order_release);
     mark_dead();
   }
 
   bool drained() {
-    std::lock_guard<std::mutex> g(rx_mu);
-    return claimed.empty();
+    for (auto& stp : stripes) {
+      ShmStripe* st = stp.get();
+      std::lock_guard<std::mutex> g(st->rx_mu);
+      if (!st->claimed.empty()) return false;
+    }
+    return true;
   }
 };
 
@@ -1387,6 +1515,47 @@ uint64_t brpc_tpu_shm_create(const char* name, uint64_t ring_bytes) {
   return nshm::register_shm(c);
 }
 
+// STRIPED create (ISSUE 12): nstripes independent SPSC ring pairs in
+// ONE segment (v2 layout), each ring_bytes per direction, each with its
+// own futex doorbells — same create-side custody and failure semantics
+// as brpc_tpu_shm_create.  nstripes <= 1 delegates to the v1 creator so
+// the single-ring file format (and every byte of its behavior) is
+// untouched on 1-core hosts.
+uint64_t brpc_tpu_shm_create2(const char* name, uint64_t ring_bytes,
+                              uint32_t nstripes) {
+  if (nstripes <= 1) return brpc_tpu_shm_create(name, ring_bytes);
+  if (nstripes > 64) nstripes = 64;
+  char path[256];
+  if (!nshm::shm_path(name, path, sizeof(path))) return 0;
+  ring_bytes = nshm::pad16(ring_bytes);
+  if (ring_bytes < 64 * 1024) ring_bytes = 64 * 1024;
+  size_t total = (size_t)nshm::seg2_total(ring_bytes, nstripes);
+  int fd = ::open(path, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return 0;
+  if (::posix_fallocate(fd, 0, (off_t)total) != 0) {
+    ::close(fd);
+    ::unlink(path);
+    return 0;
+  }
+  void* base = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                      fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    ::unlink(path);
+    return 0;
+  }
+  for (size_t off = 0; off < total; off += 4096)
+    reinterpret_cast<volatile uint8_t*>(base)[off] = 0;
+  auto* hdr = reinterpret_cast<nshm::SegHdrS*>(base);
+  hdr->version = 2;
+  hdr->ring_bytes = ring_bytes;
+  hdr->nstripes = nstripes;
+  hdr->magic.store(nshm::kShmMagic2, std::memory_order_release);
+  auto c = std::make_shared<nshm::ShmConn>();
+  c->bind2(base, total, 0);
+  return nshm::register_shm(c);
+}
+
 // Attach the acceptor side to a segment the peer created.  Validates
 // the header against the file size; 0 on any mismatch.
 uint64_t brpc_tpu_shm_attach(const char* name) {
@@ -1403,17 +1572,30 @@ uint64_t brpc_tpu_shm_attach(const char* name) {
                       MAP_SHARED, fd, 0);
   ::close(fd);
   if (base == MAP_FAILED) return 0;
+  // the v1 and v2 headers share their leading fields: read the common
+  // prefix, then validate against whichever layout the magic names
   auto* hdr = reinterpret_cast<nshm::SegHdr*>(base);
-  if (hdr->magic.load(std::memory_order_acquire) != nshm::kShmMagic ||
-      hdr->version != nshm::kShmVersion ||
-      sizeof(nshm::SegHdr) + 2 * hdr->ring_bytes != (size_t)st.st_size) {
-    ::munmap(base, (size_t)st.st_size);
-    return 0;
+  uint32_t magic = hdr->magic.load(std::memory_order_acquire);
+  if (magic == nshm::kShmMagic && hdr->version == nshm::kShmVersion &&
+      sizeof(nshm::SegHdr) + 2 * hdr->ring_bytes == (size_t)st.st_size) {
+    hdr->attached.store(1, std::memory_order_release);
+    auto c = std::make_shared<nshm::ShmConn>();
+    c->bind(base, (size_t)st.st_size, 1);
+    return nshm::register_shm(c);
   }
-  hdr->attached.store(1, std::memory_order_release);
-  auto c = std::make_shared<nshm::ShmConn>();
-  c->bind(base, (size_t)st.st_size, 1);
-  return nshm::register_shm(c);
+  if (magic == nshm::kShmMagic2) {
+    auto* hdr2 = reinterpret_cast<nshm::SegHdrS*>(base);
+    uint32_t n = hdr2->nstripes;
+    if (hdr2->version == 2 && n >= 2 && n <= 64 &&
+        nshm::seg2_total(hdr2->ring_bytes, n) == (size_t)st.st_size) {
+      hdr2->attached.store(1, std::memory_order_release);
+      auto c = std::make_shared<nshm::ShmConn>();
+      c->bind2(base, (size_t)st.st_size, 1);
+      return nshm::register_shm(c);
+    }
+  }
+  ::munmap(base, (size_t)st.st_size);
+  return 0;
 }
 
 // Unlink the segment NAME (idempotent; both sides may call).  The
@@ -1435,7 +1617,7 @@ int brpc_tpu_shm_send(uint64_t h, uint64_t uuid, const uint8_t* data,
   if (c == nullptr) return -1;
   const uint8_t* ptrs[1] = {data};
   const uint64_t lens[1] = {len};
-  return c->send(uuid, ptrs, lens, len ? 1 : 0, timeout_us);
+  return c->send(0, uuid, ptrs, lens, len ? 1 : 0, timeout_us);
 }
 
 // Gather send: one uuid frame assembled from n segments directly into
@@ -1445,7 +1627,69 @@ int brpc_tpu_shm_sendv(uint64_t h, uint64_t uuid,
                        int n, int64_t timeout_us) {
   auto c = nshm::find_shm(h);
   if (c == nullptr) return -1;
-  return c->send(uuid, ptrs, lens, n, timeout_us);
+  return c->send(0, uuid, ptrs, lens, n, timeout_us);
+}
+
+// ---- striped variants (ISSUE 12): explicit stripe selection ----------
+// The sender picks the stripe (stream-affinity / round-robin lives in
+// Python); the descriptor carries it to the claimer.  An out-of-range
+// stripe fails -1 (degrade) rather than silently aliasing stripe 0.
+
+int brpc_tpu_shm_send2(uint64_t h, uint32_t stripe, uint64_t uuid,
+                       const uint8_t* data, uint64_t len,
+                       int64_t timeout_us) {
+  auto c = nshm::find_shm(h);
+  if (c == nullptr) return -1;
+  const uint8_t* ptrs[1] = {data};
+  const uint64_t lens[1] = {len};
+  return c->send(stripe, uuid, ptrs, lens, len ? 1 : 0, timeout_us);
+}
+
+int brpc_tpu_shm_sendv2(uint64_t h, uint32_t stripe, uint64_t uuid,
+                        const uint8_t* const* ptrs, const uint64_t* lens,
+                        int n, int64_t timeout_us) {
+  auto c = nshm::find_shm(h);
+  if (c == nullptr) return -1;
+  return c->send(stripe, uuid, ptrs, lens, n, timeout_us);
+}
+
+int brpc_tpu_shm_recv2(uint64_t h, uint32_t stripe, uint64_t uuid,
+                       int64_t timeout_us, uint8_t** out,
+                       uint64_t* out_len) {
+  *out = nullptr;
+  *out_len = 0;
+  auto c = nshm::find_shm(h);
+  if (c == nullptr) return -2;
+  return c->recv(stripe, uuid, timeout_us, out, out_len);
+}
+
+// Stripe count of the segment behind `h` (1 for a v1 segment; 0 for an
+// unknown handle).  The claimer reads this once at attach to decode
+// stripe-tagged descriptors.
+uint32_t brpc_tpu_shm_stripes(uint64_t h) {
+  auto c = nshm::find_shm(h);
+  return c == nullptr ? 0 : c->nstripes;
+}
+
+// Per-stripe observability: out[0..5] = bytes_out, bytes_in, tx
+// occupancy, rx occupancy, doorbell sleeps (send+recv, this side),
+// ring_bytes.  Returns the count written (0 on a bad handle/stripe).
+int brpc_tpu_shm_stripe_stats(uint64_t h, uint32_t stripe, uint64_t* out,
+                              int cap) {
+  auto c = nshm::find_shm(h);
+  if (c == nullptr || out == nullptr || cap < 6) return 0;
+  nshm::ShmStripe* st = c->stripe(stripe);
+  if (st == nullptr) return 0;
+  out[0] = st->bytes_out.load(std::memory_order_relaxed);
+  out[1] = st->bytes_in.load(std::memory_order_relaxed);
+  out[2] = st->tx->tail.load(std::memory_order_relaxed) -
+           st->tx->head.load(std::memory_order_relaxed);
+  out[3] = st->rx->tail.load(std::memory_order_relaxed) -
+           st->rx->head.load(std::memory_order_relaxed);
+  out[4] = st->db_waits_send.load(std::memory_order_relaxed) +
+           st->db_waits_recv.load(std::memory_order_relaxed);
+  out[5] = c->ring_bytes;
+  return 6;
 }
 
 // Zero-copy claim: *out points INTO the mapped ring.  The slot's space
@@ -1459,7 +1703,7 @@ int brpc_tpu_shm_recv(uint64_t h, uint64_t uuid, int64_t timeout_us,
   *out_len = 0;
   auto c = nshm::find_shm(h);
   if (c == nullptr) return -2;
-  return c->recv(uuid, timeout_us, out, out_len);
+  return c->recv(0, uuid, timeout_us, out, out_len);
 }
 
 // Return a claimed slot: the ring space becomes reclaimable once every
@@ -1482,7 +1726,7 @@ void brpc_tpu_shm_release(uint64_t h, uint8_t* p, uint64_t len) {
 int brpc_tpu_shm_alive(uint64_t h) {
   auto c = nshm::find_shm(h);
   if (c == nullptr) return 0;
-  return c->hdr->dead.load(std::memory_order_acquire) ? 0 : 1;
+  return c->is_dead() ? 0 : 1;
 }
 
 // Mark dead, wake every doorbell, and unregister — UNLESS claims are
@@ -1520,6 +1764,9 @@ void brpc_tpu_shm_mark_dead(uint64_t h) {
 //   2 drop the next `arg` received frames at scan (descriptor arrives,
 //     claim never satisfied — the lost-frame shape)
 //   4 kill now (both directions dead, every doorbell woken)
+//   5 kill stripe `arg`: its NEXT send dies and takes the shared death
+//     word with it — the stripe-kill shape (health is segment-wide, so
+//     one dead stripe degrades the whole plane)
 int brpc_tpu_shm_chaos(uint64_t h, int mode, int64_t arg) {
   auto c = nshm::find_shm(h);
   if (c == nullptr) return -1;
@@ -1527,6 +1774,7 @@ int brpc_tpu_shm_chaos(uint64_t h, int mode, int64_t arg) {
     case 0:
       c->chaos_sever_after.store(-1, std::memory_order_relaxed);
       c->chaos_drop_frames.store(0, std::memory_order_relaxed);
+      c->chaos_kill_stripe.store(-1, std::memory_order_relaxed);
       return 0;
     case 1:
       c->chaos_sever_after.store(arg, std::memory_order_relaxed);
@@ -1536,6 +1784,9 @@ int brpc_tpu_shm_chaos(uint64_t h, int mode, int64_t arg) {
       return 0;
     case 4:
       c->mark_dead();
+      return 0;
+    case 5:
+      c->chaos_kill_stripe.store(arg, std::memory_order_relaxed);
       return 0;
     default:
       return -1;
@@ -1550,13 +1801,21 @@ int brpc_tpu_shm_stats(uint64_t h, uint64_t* out, int cap) {
   if (c == nullptr || out == nullptr || cap < 6) return 0;
   out[0] = c->bytes_out.load(std::memory_order_relaxed);
   out[1] = c->bytes_in.load(std::memory_order_relaxed);
-  out[2] = c->tx->tail.load(std::memory_order_relaxed) -
-           c->tx->head.load(std::memory_order_relaxed);
-  out[3] = c->rx->tail.load(std::memory_order_relaxed) -
-           c->rx->head.load(std::memory_order_relaxed);
-  out[4] = c->db_waits_send.load(std::memory_order_relaxed) +
-           c->db_waits_recv.load(std::memory_order_relaxed);
-  out[5] = c->hdr->ring_bytes;
+  uint64_t tx_occ = 0, rx_occ = 0, db = 0;
+  for (auto& stp : c->stripes) {
+    nshm::ShmStripe* st = stp.get();
+    tx_occ += st->tx->tail.load(std::memory_order_relaxed) -
+              st->tx->head.load(std::memory_order_relaxed);
+    rx_occ += st->rx->tail.load(std::memory_order_relaxed) -
+              st->rx->head.load(std::memory_order_relaxed);
+    db += st->db_waits_send.load(std::memory_order_relaxed) +
+          st->db_waits_recv.load(std::memory_order_relaxed);
+  }
+  out[2] = tx_occ;
+  out[3] = rx_occ;
+  out[4] = db;
+  out[5] = c->ring_bytes;    // per-direction PER-STRIPE capacity: the
+                             // max-frame contract the route screen uses
   return 6;
 }
 

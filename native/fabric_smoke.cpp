@@ -33,6 +33,21 @@ void brpc_tpu_shm_release(uint64_t h, uint8_t* p, uint64_t len);
 int brpc_tpu_shm_alive(uint64_t h);
 void brpc_tpu_shm_close(uint64_t h);
 int brpc_tpu_shm_stats(uint64_t h, uint64_t* out, int cap);
+uint64_t brpc_tpu_shm_create2(const char* name, uint64_t ring_bytes,
+                              uint32_t nstripes);
+int brpc_tpu_shm_send2(uint64_t h, uint32_t stripe, uint64_t uuid,
+                       const uint8_t* data, uint64_t len,
+                       int64_t timeout_us);
+int brpc_tpu_shm_sendv2(uint64_t h, uint32_t stripe, uint64_t uuid,
+                        const uint8_t* const* ptrs, const uint64_t* lens,
+                        int n, int64_t timeout_us);
+int brpc_tpu_shm_recv2(uint64_t h, uint32_t stripe, uint64_t uuid,
+                       int64_t timeout_us, uint8_t** out,
+                       uint64_t* out_len);
+uint32_t brpc_tpu_shm_stripes(uint64_t h);
+int brpc_tpu_shm_stripe_stats(uint64_t h, uint32_t stripe, uint64_t* out,
+                              int cap);
+int brpc_tpu_shm_chaos(uint64_t h, int mode, int64_t arg);
 uint64_t brpc_tpu_fab_listen(const char* host, int* port_out,
                              char* uds_out, int uds_cap);
 uint64_t brpc_tpu_fab_connect(const char* host, int port, const char* key);
@@ -238,6 +253,118 @@ int main() {
     assert(held[0] == 0x5A);             // still mapped until release
     brpc_tpu_shm_release(hb, held, held_n);   // last release unmaps
     printf("shm teardown mid-transfer ok\n");
+  }
+
+  // ---- STRIPED shm rings (ISSUE 12): concurrent sender+claimer pairs
+  // on DISTINCT stripes of one v2 segment — the per-stripe lock split
+  // is exactly what TSan must bless (no shared tx/rx mutex between
+  // stripes), with small rings so wrap + doorbell blocking fire inside
+  // each stripe.  Then the stripe-kill chaos path: one stripe's send
+  // dies and the SHARED death word degrades the whole plane, while a
+  // claimed buffer on another stripe stays readable until released
+  // (deferred unmap across stripes).
+  {
+    const char* seg = "brpc_tpu_shm_smoke_striped";
+    brpc_tpu_shm_unlink(seg);
+    const uint32_t kStripes = 4;
+    uint64_t ha = brpc_tpu_shm_create2(seg, 128 * 1024, kStripes);
+    assert(ha != 0);
+    uint64_t hb = brpc_tpu_shm_attach(seg);   // layout auto-detected
+    assert(hb != 0);
+    assert(brpc_tpu_shm_unlink(seg) == 0);
+    assert(brpc_tpu_shm_stripes(ha) == kStripes);
+    assert(brpc_tpu_shm_stripes(hb) == kStripes);
+
+    const int kFrames = 48;
+    const uint64_t kLen = 20 * 1024;
+    std::vector<std::thread> sthreads, cthreads;
+    std::atomic<int> serrs{0}, cerrs{0};
+    std::atomic<uint64_t> cbytes{0};
+    for (uint32_t s = 0; s < kStripes; ++s) {
+      sthreads.emplace_back([&, s] {
+        std::vector<uint8_t> buf(kLen);
+        for (int i = 0; i < kFrames; ++i) {
+          uint64_t uuid = (uint64_t)(s + 1) << 32 | (uint64_t)i;
+          memset(buf.data(), (s * kFrames + i) & 0xFF, buf.size());
+          int rc;
+          if (i % 2 == 0) {
+            rc = brpc_tpu_shm_send2(ha, s, uuid, buf.data(), buf.size(),
+                                    10 * 1000 * 1000);
+          } else {
+            const uint8_t* ptrs[2] = {buf.data(), buf.data() + 700};
+            const uint64_t lens[2] = {700, kLen - 700};
+            rc = brpc_tpu_shm_sendv2(ha, s, uuid, ptrs, lens, 2,
+                                     10 * 1000 * 1000);
+          }
+          if (rc != 0) serrs.fetch_add(1);
+        }
+      });
+      cthreads.emplace_back([&, s] {
+        for (int i = 0; i < kFrames; ++i) {
+          uint64_t uuid = (uint64_t)(s + 1) << 32 | (uint64_t)i;
+          uint8_t* p = nullptr;
+          uint64_t n = 0;
+          int rc = brpc_tpu_shm_recv2(hb, s, uuid, 10 * 1000 * 1000,
+                                      &p, &n);
+          if (rc != 0 || n != kLen) {
+            cerrs.fetch_add(1);
+            continue;
+          }
+          uint8_t want = (uint8_t)((s * kFrames + i) & 0xFF);
+          if (p[0] != want || p[n - 1] != want) cerrs.fetch_add(1);
+          cbytes.fetch_add(n);
+          brpc_tpu_shm_release(hb, p, n);
+        }
+      });
+    }
+    for (auto& t : sthreads) t.join();
+    for (auto& t : cthreads) t.join();
+    assert(serrs.load() == 0);
+    assert(cerrs.load() == 0);
+    assert(cbytes.load() == (uint64_t)kStripes * kFrames * kLen);
+    uint64_t st[6];
+    // per-stripe truth: every stripe moved exactly its share
+    for (uint32_t s = 0; s < kStripes; ++s) {
+      assert(brpc_tpu_shm_stripe_stats(ha, s, st, 6) == 6);
+      assert(st[0] == (uint64_t)kFrames * kLen);
+    }
+    // conn aggregate matches
+    assert(brpc_tpu_shm_stats(ha, st, 6) == 6);
+    assert(st[0] == cbytes.load());
+    printf("shm striped transfer ok (%llu bytes over %u stripes)\n",
+           (unsigned long long)cbytes.load(), kStripes);
+
+    // stripe-kill: park a claimed buffer on stripe 0, then kill via
+    // stripe 2's send — the whole plane reads dead (shared death word),
+    // a parked claim on stripe 3 fails fast, and the stripe-0 claim
+    // stays readable until released
+    uint8_t one[64];
+    memset(one, 0xA5, sizeof(one));
+    assert(brpc_tpu_shm_send2(ha, 0, 0x701, one, sizeof(one),
+                              1000 * 1000) == 0);
+    uint8_t* held = nullptr;
+    uint64_t held_n = 0;
+    assert(brpc_tpu_shm_recv2(hb, 0, 0x701, 1000 * 1000, &held,
+                              &held_n) == 0);
+    std::thread parked([&] {
+      uint8_t* p = nullptr;
+      uint64_t n = 0;
+      int rc = brpc_tpu_shm_recv2(hb, 3, 0xBEEF, 10 * 1000 * 1000, &p,
+                                  &n);
+      assert(rc == -2);
+    });
+    assert(brpc_tpu_shm_chaos(ha, 5, 2) == 0);     // arm stripe-2 kill
+    assert(brpc_tpu_shm_send2(ha, 2, 0x702, one, sizeof(one),
+                              1000 * 1000) == -1);
+    assert(!brpc_tpu_shm_alive(ha));
+    assert(!brpc_tpu_shm_alive(hb));
+    parked.join();
+    assert(held[0] == 0xA5 && held[held_n - 1] == 0xA5);
+    brpc_tpu_shm_close(ha);
+    brpc_tpu_shm_close(hb);              // claim out: unmap deferred
+    assert(held[0] == 0xA5);
+    brpc_tpu_shm_release(hb, held, held_n);
+    printf("shm stripe-kill degrade ok\n");
   }
 
   // the exit-race teardown path: close + join every reader thread

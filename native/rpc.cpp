@@ -1387,6 +1387,22 @@ struct IciReqC {
   uint64_t deadline_left_ms;
   int32_t priority;
   int32_t _pad2;
+  // native attachment custody (appended, ISSUE 12): nonzero means the
+  // device-seg list is PARKED in the native att table under this
+  // handle instead of being taken by Python during the upcall.  Python
+  // wraps it lazily and exits custody exactly once — pass the handle
+  // back in IciRespC.att_handle (echo pass-through), take the keys via
+  // brpc_tpu_ici_att_take at materialization, or dispose it
+  // (brpc_tpu_ici_att_dispose) at Controller pool-recycle.  segs/nsegs
+  // still point at the parked list (heap-stable while the handle
+  // lives) for callers that need the full walk; seg0_* mirrors
+  // segs[0] inline so the dominant one-seg shape is readable with
+  // plain struct field loads instead of a ctypes pointer deref.
+  uint64_t att_handle;
+  uint64_t seg0_key;
+  uint64_t seg0_nbytes;
+  int32_t seg0_dev;
+  int32_t _pad3;
 };
 // (reqs, n): process each request; every token answered exactly once
 typedef void (*py_ici_batch_fn)(const IciReqC* reqs, uint64_t n);
@@ -1402,6 +1418,11 @@ struct IciRespC {
   const IciSegC* segs;     // custody of device keys transfers to native
   uint64_t nsegs;
   uint64_t retry_after_ms; // admission shed hint, 0 = none
+  // native custody pass-through (appended, ISSUE 12): nonzero names a
+  // parked att-table entry whose seg list IS this response's device
+  // attachment — the echo shape never walks segs in Python.  segs/
+  // nsegs are ignored when set.
+  uint64_t att_handle;
 };
 
 static inline int64_t ici_now_ns() {
@@ -1418,6 +1439,48 @@ static void ici_release_segs(const std::vector<IciSegC>& segs) {
   if (rel == nullptr) return;
   for (const auto& s : segs)
     if (s.is_dev) rel(s.key);
+}
+
+// ---- native-owned attachment custody table (ISSUE 12) ----------------
+// One entry parks a whole device-seg list under an opaque handle, so
+// the Python handler tier never walks segs or touches its device-ref
+// registry on the hot path: the handle moves with the structs
+// (IciReqC.att_handle in, IciRespC.att_handle back out on the echo
+// pass-through) and exits custody EXACTLY once — pass-back, take
+// (Python assumed the keys), or dispose (keys released via the release
+// upcall).  Entries are heap-allocated so IciReqC.segs pointers into
+// them stay stable across table rehashes.
+struct IciAttEntry {
+  std::vector<IciSegC> segs;
+};
+static std::mutex g_ici_atts_mu;
+// Leaked like the other registries (see g_ici_listeners): static
+// teardown must never race live holders at exit.
+// fablint: guarded-by(g_ici_atts_mu): g_ici_atts
+static auto& g_ici_atts = *new nbase::FlatMap64<IciAttEntry*>();
+static std::atomic<uint64_t> g_ici_next_att{1};
+
+// Register a parked entry; `out_e` (optional) receives the heap entry
+// so callers can point borrowed views (IciReqC.segs) at its stable
+// seg storage.  EVERY registration goes through here — the protocol
+// (alloc, counter, publish under the lock) has exactly one home.
+static uint64_t ici_att_register(std::vector<IciSegC>&& segs,
+                                 IciAttEntry** out_e = nullptr) {
+  auto* e = new IciAttEntry{std::move(segs)};
+  uint64_t h = g_ici_next_att.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> g(g_ici_atts_mu);
+    g_ici_atts[h] = e;
+  }
+  if (out_e != nullptr) *out_e = e;
+  return h;
+}
+
+static IciAttEntry* ici_att_pop(uint64_t h) {
+  std::lock_guard<std::mutex> g(g_ici_atts_mu);
+  IciAttEntry* e = nullptr;
+  if (!g_ici_atts.take(h, &e)) return nullptr;
+  return e;
 }
 
 // Move every non-resident device ref to target_dev via the Python/JAX
@@ -1669,6 +1732,13 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
       batch_age_ns_.store(age_us * 1000, std::memory_order_relaxed);
   }
 
+  // Opt the batched upcall into native att custody (IciReqC.att_handle):
+  // OFF by default so an older Python tier on a newer .so keeps the
+  // take-during-upcall semantics byte-for-byte.
+  void set_att_handles(bool on) {
+    att_handles_.store(on, std::memory_order_relaxed);
+  }
+
   void batch_stats(uint64_t* upcalls, uint64_t* requests,
                    uint64_t* max_batch) const {
     *upcalls = upcalls_.load(std::memory_order_relaxed);
@@ -1881,6 +1951,7 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
     }
     std::vector<IciReqC> reqs;
     reqs.reserve(batch.size());
+    bool handles = att_handles_.load(std::memory_order_relaxed);
     for (auto& it : batch) {
       const uint8_t* base = (const uint8_t*)it.bytes.data();
       IciReqC r;
@@ -1890,8 +1961,6 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
       r.payload_len = it.payload_len;
       r.att_host = base + it.payload_off + it.payload_len;
       r.att_host_len = it.att_len;
-      r.segs = it.segs.data();
-      r.nsegs = it.segs.size();
       r.log_id = it.log_id;
       r.recv_ns = it.enq_ns;
       r.peer_dev = it.peer_dev;
@@ -1900,6 +1969,33 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
       r.deadline_left_ms = it.deadline_left_ms;
       r.priority = (int32_t)it.priority;
       r._pad2 = 0;
+      r.att_handle = 0;
+      r.seg0_key = 0;
+      r.seg0_nbytes = 0;
+      r.seg0_dev = 0;
+      r._pad3 = 0;
+      if (handles && it.att_len == 0 && !it.segs.empty()) {
+        // native custody: the seg list PARKS in the att table; Python
+        // receives a ready handle + an inline mirror of segs[0] and
+        // never walks the list on the hot path.  Host-mixed
+        // attachments keep the legacy take-during-upcall walk (the
+        // host spans interleave with device segs positionally).
+        IciAttEntry* e = nullptr;
+        r.att_handle = ici_att_register(std::move(it.segs), &e);
+        r.segs = e->segs.data();     // heap-stable while the handle lives
+        r.nsegs = e->segs.size();
+        r.seg0_key = e->segs[0].key;
+        r.seg0_nbytes = e->segs[0].nbytes;
+        r.seg0_dev = e->segs[0].dev;
+      } else {
+        r.segs = it.segs.data();
+        r.nsegs = it.segs.size();
+        if (!it.segs.empty()) {
+          r.seg0_key = it.segs[0].key;
+          r.seg0_nbytes = it.segs[0].nbytes;
+          r.seg0_dev = it.segs[0].dev;
+        }
+      }
       reqs.push_back(r);
     }
     upcalls_.fetch_add(1, std::memory_order_relaxed);
@@ -1941,6 +2037,7 @@ class IciServer : public std::enable_shared_from_this<IciServer> {
   bool bq_stopped_ = false;
   std::atomic<uint64_t> batch_max_{64};
   std::atomic<int64_t> batch_age_ns_{50 * 1000};   // ~50 us steal bound
+  std::atomic<bool> att_handles_{false};   // native att custody opt-in
   std::atomic<uint64_t> upcalls_{0};
   std::atomic<uint64_t> upcall_reqs_{0};
   std::atomic<uint64_t> batch_max_seen_{0};
@@ -2611,6 +2708,18 @@ struct IciCallOut {
   uint64_t nsegs;
   char* err_text;
   uint64_t retry_after_ms;   // admission shed hint on ELIMIT rejections
+  // native custody outputs (appended, ISSUE 12; filled by call4 only):
+  // nonzero att_handle parks the response seg list in the att table —
+  // the caller wraps it lazily and exits custody exactly once (take at
+  // materialization / dispose when the view dies).  seg0_* mirrors the
+  // first seg inline; for the dominant 1-seg shape segs stays NULL
+  // (nothing to free), >1 segs are additionally malloc'd into segs so
+  // the caller can read metadata without another crossing.
+  uint64_t att_handle;
+  uint64_t seg0_key;
+  uint64_t seg0_nbytes;
+  int32_t seg0_dev;
+  int32_t _pad;
 };
 
 // Shared unary-call body: outputs are malloc'd (brpc_tpu_buf_free);
@@ -2622,7 +2731,7 @@ static uint64_t ici_call_fill(uint64_t h, const char* method,
                               const nrpc::IciSegC* segs, uint64_t nsegs,
                               int64_t timeout_us, int64_t priority_wire,
                               const char* tenant, int64_t deadline_left_ms,
-                              IciCallOut* o) {
+                              IciCallOut* o, int want_handle = 0) {
   memset(o, 0, sizeof(*o));
   std::pair<nrpc::IciChannelPtr, nrpc::IciConnPtr> entry;
   {
@@ -2652,12 +2761,37 @@ static uint64_t ici_call_fill(uint64_t h, const char* method,
     memcpy(o->att, out.att_host.data(), out.att_host.size());
     o->att_len = out.att_host.size();
   }
+  if (want_handle && rc != 0 && !out.segs.empty()) {
+    // handle-mode error path: a handler that failed the RPC may still
+    // have shipped response segs — release them HERE so the Python
+    // caller's error path needs no custody walk at all
+    nrpc::ici_release_segs(out.segs);
+    out.segs.clear();
+  }
   if (!out.segs.empty()) {
-    o->segs = (nrpc::IciSegC*)malloc(out.segs.size() *
-                                     sizeof(nrpc::IciSegC));
-    memcpy(o->segs, out.segs.data(),
-           out.segs.size() * sizeof(nrpc::IciSegC));
-    o->nsegs = out.segs.size();
+    if (want_handle && out.att_host.empty()) {
+      // native custody: park the seg list under a handle; the caller
+      // builds a lazy view.  seg0 rides inline; >1 segs additionally
+      // get the malloc'd metadata copy (the caller reads it during
+      // THIS call — it is freed with the other outputs).
+      o->seg0_key = out.segs[0].key;
+      o->seg0_nbytes = out.segs[0].nbytes;
+      o->seg0_dev = out.segs[0].dev;
+      o->nsegs = out.segs.size();
+      if (out.segs.size() > 1) {
+        o->segs = (nrpc::IciSegC*)malloc(out.segs.size() *
+                                         sizeof(nrpc::IciSegC));
+        memcpy(o->segs, out.segs.data(),
+               out.segs.size() * sizeof(nrpc::IciSegC));
+      }
+      o->att_handle = nrpc::ici_att_register(std::move(out.segs));
+    } else {
+      o->segs = (nrpc::IciSegC*)malloc(out.segs.size() *
+                                       sizeof(nrpc::IciSegC));
+      memcpy(o->segs, out.segs.data(),
+             out.segs.size() * sizeof(nrpc::IciSegC));
+      o->nsegs = out.segs.size();
+    }
   }
   if (!err_text.empty()) {
     o->err_text = (char*)malloc(err_text.size() + 1);
@@ -2710,6 +2844,76 @@ uint64_t brpc_tpu_ici_call3(uint64_t h, const char* method,
   return ici_call_fill(h, method, req, req_len, att_host, att_host_len,
                        segs, nsegs, timeout_us, priority_wire, tenant,
                        deadline_left_ms, out);
+}
+
+// call3 + native att custody on the RESPONSE: device-only response
+// attachments come back as out->att_handle (+ seg0 inline; >1 segs
+// also malloc'd as metadata) instead of owned seg copies the caller
+// must walk and take.  Error-path response segs are released natively.
+uint64_t brpc_tpu_ici_call4(uint64_t h, const char* method,
+                            const uint8_t* req, uint64_t req_len,
+                            const uint8_t* att_host, uint64_t att_host_len,
+                            const nrpc::IciSegC* segs, uint64_t nsegs,
+                            int64_t timeout_us, int64_t priority_wire,
+                            const char* tenant, int64_t deadline_left_ms,
+                            IciCallOut* out) {
+  return ici_call_fill(h, method, req, req_len, att_host, att_host_len,
+                       segs, nsegs, timeout_us, priority_wire, tenant,
+                       deadline_left_ms, out, /*want_handle=*/1);
+}
+
+// ---- native att custody handle ops (ISSUE 12) ----
+// Exactly-one-exit per handle: pass-back (IciRespC.att_handle), take,
+// or dispose.  Each op consumes the handle.
+
+// Python assumed custody of every key in the entry (it pulled them
+// from its registry itself): drop the entry WITHOUT releasing.
+// Returns the seg count, -1 for an unknown handle.
+int64_t brpc_tpu_ici_att_take(uint64_t handle) {
+  nrpc::IciAttEntry* e = nrpc::ici_att_pop(handle);
+  if (e == nullptr) return -1;
+  int64_t n = (int64_t)e->segs.size();
+  delete e;
+  return n;
+}
+
+// Drop path: release every parked key via the release upcall (the
+// registry forgets them) and free the entry.  -1 unknown handle.
+int brpc_tpu_ici_att_dispose(uint64_t handle) {
+  nrpc::IciAttEntry* e = nrpc::ici_att_pop(handle);
+  if (e == nullptr) return -1;
+  nrpc::ici_release_segs(e->segs);
+  delete e;
+  return 0;
+}
+
+// Copy out up to `cap` seg descriptors WITHOUT consuming the handle
+// (materialization reads metadata here when it outlived the upcall's
+// borrowed pointers).  Returns the full seg count, -1 unknown.
+int64_t brpc_tpu_ici_att_peek(uint64_t handle, nrpc::IciSegC* out,
+                              uint64_t cap) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_atts_mu);
+  nrpc::IciAttEntry** ep = nrpc::g_ici_atts.seek(handle);
+  if (ep == nullptr) return -1;
+  const auto& segs = (*ep)->segs;
+  uint64_t n = segs.size() < cap ? segs.size() : cap;
+  for (uint64_t i = 0; i < n; ++i) out[i] = segs[i];
+  return (int64_t)segs.size();
+}
+
+// Live parked entries — the census/leak-detection surface.
+uint64_t brpc_tpu_ici_att_count() {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_atts_mu);
+  return nrpc::g_ici_atts.size();
+}
+
+// Opt a listener's batched upcall into IciReqC.att_handle delivery.
+int brpc_tpu_ici_set_att_handles(uint64_t h, int on) {
+  std::lock_guard<std::mutex> g(nrpc::g_ici_mu);
+  auto it = nrpc::g_ici_servers.find(h);
+  if (it == nrpc::g_ici_servers.end()) return -1;
+  it->second->set_att_handles(on != 0);
+  return 0;
 }
 
 // Respond to a Python-handled ici request.  Custody of `segs` keys
@@ -2767,7 +2971,20 @@ int brpc_tpu_ici_respond_batch(const nrpc::IciRespC* rs, uint64_t n) {
       std::lock_guard<std::mutex> g(nrpc::g_ici_tokens_mu);
       had = nrpc::g_ici_tokens.take(r.token, &pr);
     }
-    std::vector<nrpc::IciSegC> seg_vec(r.segs, r.segs + r.nsegs);
+    std::vector<nrpc::IciSegC> seg_vec;
+    if (r.att_handle != 0) {
+      // native-custody pass-through: the parked request att IS the
+      // response attachment — custody continues into delivery without
+      // Python ever walking the segs.  A vanished handle (double
+      // pass-back would be a caller bug) degrades to an empty att.
+      nrpc::IciAttEntry* e = nrpc::ici_att_pop(r.att_handle);
+      if (e != nullptr) {
+        seg_vec = std::move(e->segs);
+        delete e;
+      }
+    } else {
+      seg_vec.assign(r.segs, r.segs + r.nsegs);
+    }
     if (!had) {
       nrpc::ici_release_segs(seg_vec);
       continue;
@@ -3059,6 +3276,17 @@ uint64_t brpc_tpu_ici_call3(uint64_t, const char*, const uint8_t*,
                             const char*, int64_t, void*) {
   return 1009;
 }
+uint64_t brpc_tpu_ici_call4(uint64_t, const char*, const uint8_t*,
+                            uint64_t, const uint8_t*, uint64_t,
+                            const void*, uint64_t, int64_t, int64_t,
+                            const char*, int64_t, void*) {
+  return 1009;
+}
+int64_t brpc_tpu_ici_att_take(uint64_t) { return -1; }
+int brpc_tpu_ici_att_dispose(uint64_t) { return -1; }
+int64_t brpc_tpu_ici_att_peek(uint64_t, void*, uint64_t) { return -1; }
+uint64_t brpc_tpu_ici_att_count() { return 0; }
+int brpc_tpu_ici_set_att_handles(uint64_t, int) { return -1; }
 int brpc_tpu_ici_respond(uint64_t, uint64_t, const char*, const uint8_t*,
                          uint64_t, const uint8_t*, uint64_t, const void*,
                          uint64_t) { return -1; }

@@ -85,12 +85,26 @@ def _census():
     plane = DevicePlane._instance      # never CREATE one from the census
     pins = plane.active_transfers() if plane is not None else 0
     cntls = server_controller_pool.live()
-    return threads, sockets, streams, pins, cntls
+    # native att custody (ISSUE 12): device-ref registry entries +
+    # parked native att-table entries.  At rest BOTH must be zero — a
+    # key is either inside an IOBuf (Python custody, not in the
+    # registry) or parked under a handle that some live view/struct
+    # still names.  A net-new entry at teardown = a custody exit was
+    # skipped (the exactly-one-exit invariant).
+    import sys as _sys
+    np_mod = _sys.modules.get("brpc_tpu.ici.native_plane")
+    if np_mod is not None:
+        devrefs = np_mod.registry().live()
+        atts = np_mod.att_table_live()
+    else:
+        devrefs = atts = 0
+    return threads, sockets, streams, pins, cntls, devrefs, atts
 
 
 def _leaks_vs(base):
-    threads0, sockets0, streams0, pins0, cntls0 = base
-    threads1, sockets1, streams1, pins1, cntls1 = _census()
+    threads0, sockets0, streams0, pins0, cntls0, devrefs0, atts0 = base
+    threads1, sockets1, streams1, pins1, cntls1, devrefs1, atts1 = \
+        _census()
     leaks = []
     for t in threads1 - threads0:
         leaks.append(f"non-daemon thread {t.name!r}")
@@ -109,6 +123,12 @@ def _leaks_vs(base):
         # makes the leak countable here
         leaks.append(f"pooled server Controllers in flight: {cntls1} "
                      f"(was {cntls0})")
+    if devrefs1 > devrefs0:
+        leaks.append(f"ici device-ref registry entries: {devrefs1} "
+                     f"(was {devrefs0}) — a key never exited custody")
+    if atts1 > atts0:
+        leaks.append(f"native att-table entries parked: {atts1} "
+                     f"(was {atts0}) — an att handle never exited")
     return leaks
 
 
@@ -123,6 +143,12 @@ def _resource_census(request):
     deadline = _time.monotonic() + _SETTLE_S
     leaks = _leaks_vs(base)
     while leaks and _time.monotonic() < deadline:
+        if any("custody" in l or "att handle" in l for l in leaks):
+            # att views release via __del__ — collect cycles so a
+            # cyclically-referenced controller can't read as a custody
+            # leak while the GC simply hasn't run yet
+            import gc
+            gc.collect()
         _time.sleep(0.05)
         leaks = _leaks_vs(base)
     if leaks:

@@ -1,0 +1,30 @@
+"""Seeded violation for the STRIPED shm-plane state (ISSUE 12): a
+socket-like class whose stripe geometry is swapped outside the plane
+lock — the exact shape of FabricSocket._shm_stripes /
+_shm_dead_stripes, which must move ATOMICALLY with the ring handle on
+degrade (a claimer reading a new handle with the old stripe count
+would decode descriptors onto the wrong ring)."""
+import threading
+
+
+class StripedShmPlane:
+    _GUARDED_BY = {"_shm": "_plane_lock", "_shm_stripes": "_plane_lock"}
+
+    def __init__(self):
+        self._plane_lock = threading.Lock()
+        self._shm = 0
+        self._shm_stripes = 1
+
+    def attach_locked(self, handle: int, stripes: int) -> None:
+        with self._plane_lock:
+            self._shm = handle
+            self._shm_stripes = stripes
+
+    def degrade_racy(self, handle: int) -> None:
+        with self._plane_lock:
+            self._shm = handle
+        self._shm_stripes = 1          # line 26: the violation
+
+    def snapshot(self):
+        with self._plane_lock:
+            return self._shm, self._shm_stripes

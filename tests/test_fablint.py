@@ -85,6 +85,18 @@ class TestFixtureViolations:
         assert "_shm" in out[0].message and "_plane_lock" in out[0].message
         assert out[0].path.endswith("bad_shm_route.py")
 
+    def test_unguarded_stripe_health_swap_reported_with_line(self):
+        """The STRIPED shm plane's state class (ISSUE 12): resetting the
+        stripe geometry outside the plane lock is caught at the exact
+        file:line — _shm_stripes must move ATOMICALLY with the handle
+        swap on degrade, or a claimer decodes descriptors onto the
+        wrong ring."""
+        out = _findings("bad_shm_stripe.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 26)]
+        assert "_shm_stripes" in out[0].message \
+            and "_plane_lock" in out[0].message
+        assert out[0].path.endswith("bad_shm_stripe.py")
+
     def test_unguarded_compile_cache_insert_reported_with_line(self):
         """The compiled fan-out plane's state class (ISSUE 11): a
         compile-cache insert outside the plane lock is caught at the

@@ -229,7 +229,7 @@ class TestNativeDatapath:
                 att_host, segs = split_attachment(att)
                 binding._respond_flush([(token, err, text.encode(), b"",
                                          att_host, segs, post,
-                                         retry_after)])
+                                         retry_after, 0)])
 
             monkeypatch.setattr(binding, "_respond_one", err_with_segs)
             ch = rpc.Channel()
@@ -674,3 +674,372 @@ class TestRelocateCustody:
             reg.release(key)
             if new_key and new_key != key:
                 reg.release(new_key)
+
+
+class TestNativeAttCustody:
+    """ISSUE 12: native-side attachment custody.  Every path a parked
+    handle can take — pass-through, materialize, pool-recycle dispose,
+    reject, per-request batch failure, late response after timeout —
+    must end with the exactly-one-exit invariant: the device-ref
+    registry AND the native att table drain to zero (also enforced
+    fleet-wide by the conftest census)."""
+
+    def _echo_server(self, dev, body):
+        class Svc(rpc.Service):
+            SERVICE_NAME = "EchoService"
+
+            @rpc.method(EchoRequest, EchoResponse)
+            def Echo(self, cntl, request, response, done):
+                body(cntl, request, response)
+                done()
+
+        server = rpc.Server()
+        server.add_service(Svc())
+        assert server.start(f"ici://{dev}") == 0
+        ch = rpc.Channel()
+        ch.init(f"ici://{dev}",
+                options=rpc.ChannelOptions(timeout_ms=10000, max_retry=0,
+                                           ici_local_device=dev))
+        return server, ch
+
+    @staticmethod
+    def _drained():
+        deadline = time.monotonic() + 3
+        import gc
+        while time.monotonic() < deadline:
+            if (native_plane.registry().live() == 0
+                    and native_plane.att_table_live() == 0):
+                return True
+            gc.collect()
+            time.sleep(0.02)
+        return False
+
+    def test_passthrough_view_is_lazy_and_byte_exact(self, mesh):
+        """The echo shape: the handler sees a lazily-materialized
+        NativeAttachment (len answers WITHOUT inflating), assigns it as
+        the response, and the handle rides back natively — the client's
+        view materializes to the exact bytes."""
+        seen = {}
+
+        def body(cntl, request, response):
+            att = cntl.request_attachment
+            seen["type"] = type(att).__name__
+            seen["len"] = len(att)
+            seen["mat_before_len"] = att._mat
+            response.message = request.message
+            cntl.response_attachment = att
+
+        server, ch = self._echo_server(20, body)
+        try:
+            payload = _device_payload(mesh, dev=20)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="pt"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "pt"
+            assert seen["type"] == "NativeAttachment"
+            assert seen["len"] == 4096
+            assert seen["mat_before_len"] is False, \
+                "len() must not materialize the view"
+            out = cntl.response_attachment
+            assert type(out).__name__ == "NativeAttachment"
+            assert len(out) == 4096 and not out._mat
+            assert out.to_bytes() == bytes(np.arange(4096, dtype=np.uint8))
+            assert out._mat                     # touch materialized it
+            del cntl, out
+        finally:
+            server.stop()
+        assert self._drained()
+
+    def test_append_pattern_materializes_and_stays_correct(self, mesh):
+        """The PR-8 idiom (response_attachment.append(request_attachment))
+        keeps working: appending an unmaterialized view into another
+        IOBuf inflates it (keys taken, entry dropped) and the bytes are
+        exact — slower than the pass-through, never wrong."""
+        def body(cntl, request, response):
+            response.message = request.message
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+
+        server, ch = self._echo_server(21, body)
+        try:
+            payload = _device_payload(mesh, dev=21)
+            for _ in range(3):
+                cntl = rpc.Controller()
+                cntl.request_attachment.append_device_array(payload)
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="ap"), EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert cntl.response_attachment.to_bytes() == bytes(
+                    np.arange(4096, dtype=np.uint8))
+            del cntl
+        finally:
+            server.stop()
+        assert self._drained()
+
+    def test_ignored_attachment_disposed_at_pool_recycle(self, mesh):
+        """A handler that never touches its attachment: the parked
+        handle's ONLY exit is Controller pool-recycle — the registry
+        and att table must still drain."""
+        def body(cntl, request, response):
+            response.message = "ok"        # attachment deliberately unread
+
+        server, ch = self._echo_server(22, body)
+        try:
+            payload = _device_payload(mesh, dev=22)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            del cntl
+        finally:
+            server.stop()
+        assert self._drained()
+
+    def test_reject_path_disposes_view(self, mesh):
+        """ENOMETHOD with a device attachment: the reject runs before
+        any handler — _release_attachment_custody must dispose the
+        parked handle."""
+        server = rpc.Server()
+        server.add_service(EchoService())
+        assert server.start("ici://23") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://23",
+                    options=rpc.ChannelOptions(timeout_ms=5000,
+                                               max_retry=0,
+                                               ici_local_device=23))
+            payload = _device_payload(mesh, dev=23)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("NoSuch.Method", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code_ == rpc.errors.ENOMETHOD
+            del cntl
+        finally:
+            server.stop()
+        assert self._drained()
+
+    def test_per_request_failure_isolation_disposes_handle(self, mesh):
+        """A handler raising mid-request: the EINTERNAL answer must not
+        strand the parked handle (the batch loop's isolation path or
+        the invoke error path dispose it)."""
+        def body(cntl, request, response):
+            if request.message == "boom":
+                raise RuntimeError("deliberate")
+            response.message = request.message
+
+        server, ch = self._echo_server(24, body)
+        try:
+            payload = _device_payload(mesh, dev=24)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="boom"), EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code_ == rpc.errors.EINTERNAL
+            # a healthy request right after: the route stays up
+            cntl2 = rpc.Controller()
+            cntl2.request_attachment.append_device_array(payload)
+            resp = ch.call_method("EchoService.Echo", cntl2,
+                                  EchoRequest(message="fine"),
+                                  EchoResponse)
+            assert not cntl2.failed() and resp.message == "fine"
+            del cntl, cntl2
+        finally:
+            server.stop()
+        assert self._drained()
+
+    def test_late_passthrough_after_timeout_releases(self, mesh):
+        """Chaos kill mid-batch shape: the client times out, the handler
+        passes the handle back LATE — native delivers to an abandoned
+        slot and must release the parked keys (no strand)."""
+        release = threading.Event()
+        responded = threading.Event()
+
+        class Slow(rpc.Service):
+            @rpc.method(EchoRequest, EchoResponse)
+            def S(self, cntl, request, response, done):
+                def later():
+                    release.wait(5)
+                    cntl.response_attachment = cntl.request_attachment
+                    response.message = "late"
+                    done()
+                    responded.set()
+                threading.Thread(target=later, daemon=True).start()
+
+        server = rpc.Server()
+        server.add_service(Slow())
+        assert server.start("ici://25") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://25",
+                    options=rpc.ChannelOptions(timeout_ms=150,
+                                               max_retry=0,
+                                               ici_local_device=25))
+            payload = _device_payload(mesh, dev=25)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            ch.call_method("Slow.S", cntl, EchoRequest(message="x"),
+                           EchoResponse)
+            assert cntl.failed()
+            assert cntl.error_code_ == rpc.errors.ERPCTIMEDOUT
+            release.set()
+            assert responded.wait(5)
+            del cntl
+        finally:
+            release.set()
+            server.stop()
+        assert self._drained()
+
+    def test_client_view_del_is_the_release(self, mesh):
+        """A client that never reads its response attachment: dropping
+        the view (refcount/GC) must dispose the handle — the steady
+        bench shape, where cleanup rides __del__ between calls."""
+        def body(cntl, request, response):
+            response.message = "ok"
+            cntl.response_attachment = cntl.request_attachment
+
+        server, ch = self._echo_server(26, body)
+        try:
+            payload = _device_payload(mesh, dev=26)
+            for _ in range(4):
+                cntl = rpc.Controller()
+                cntl.request_attachment.append_device_array(payload)
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="x"), EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                # response view intentionally untouched; the rebind of
+                # `cntl` next iteration drops it
+            del cntl
+        finally:
+            server.stop()
+        assert self._drained()
+
+    def test_legacy_mode_byte_identical(self, mesh):
+        """ici_native_att_custody=False restores the PR-8 walk: plain
+        IOBuf both sides, same bytes, same drained registry."""
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.butil.iobuf import IOBuf
+        prev = _fl.get_flag("ici_native_att_custody")
+        _fl.set_flag("ici_native_att_custody", False)
+        try:
+            seen = {}
+
+            def body(cntl, request, response):
+                seen["type"] = type(cntl.request_attachment).__name__
+                response.message = request.message
+                cntl.response_attachment.append(cntl.request_attachment)
+
+            server, ch = self._echo_server(27, body)
+            try:
+                payload = _device_payload(mesh, dev=27)
+                cntl = rpc.Controller()
+                cntl.request_attachment.append_device_array(payload)
+                ch.call_method("EchoService.Echo", cntl,
+                               EchoRequest(message="x"), EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert seen["type"] == "IOBuf"
+                assert type(cntl.response_attachment) is IOBuf
+                assert cntl.response_attachment.to_bytes() == bytes(
+                    np.arange(4096, dtype=np.uint8))
+                del cntl
+            finally:
+                server.stop()
+        finally:
+            _fl.set_flag("ici_native_att_custody", prev)
+        assert self._drained()
+
+    def test_proxy_forwarding_view_as_request(self, mesh):
+        """Proxy shape: handler A forwards its (unmaterialized) view as
+        the REQUEST attachment of a nested call to server B —
+        materialization + re-registration keep bytes and custody
+        exact end to end."""
+        inner_server = rpc.Server()
+        inner_server.add_service(EchoService())
+        assert inner_server.start("ici://28") == 0
+        inner_ch = rpc.Channel()
+        inner_ch.init("ici://28",
+                      options=rpc.ChannelOptions(timeout_ms=10000,
+                                                 max_retry=0,
+                                                 ici_local_device=28))
+
+        def body(cntl, request, response):
+            inner = rpc.Controller()
+            inner.request_attachment.append(cntl.request_attachment)
+            r = inner_ch.call_method("EchoService.Echo", inner,
+                                     EchoRequest(message="inner"),
+                                     EchoResponse)
+            assert not inner.failed(), inner.error_text
+            response.message = r.message
+            cntl.response_attachment = inner.response_attachment
+
+        server, ch = self._echo_server(29, body)
+        try:
+            payload = _device_payload(mesh, dev=29)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            resp = ch.call_method("EchoService.Echo", cntl,
+                                  EchoRequest(message="outer"),
+                                  EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "inner"
+            assert cntl.response_attachment.to_bytes() == bytes(
+                np.arange(4096, dtype=np.uint8))
+            del cntl
+        finally:
+            server.stop()
+            inner_server.stop()
+        assert self._drained()
+
+
+class TestBuildAttachmentExceptionSafety:
+    """ISSUE 12 satellite: build_attachment_from_c used to strand every
+    not-yet-walked device key when IOBuf construction raised mid-walk
+    (native clears its seg list when the upcall returns — the remaining
+    keys had no owner left).  Pinned with a fault-injected mid-walk
+    failure at the unit level."""
+
+    def test_midwalk_failure_releases_unwalked_keys(self, mesh,
+                                                    monkeypatch):
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.ici.native_plane import (build_attachment_from_c,
+                                               fill_seg_array)
+        reg = native_plane.registry()
+        base = reg.live()
+        arrs = [_device_payload(mesh, dev=0, n=256) for _ in range(3)]
+        segs = [(reg.put(a), 256, 0, 1) for a in arrs]
+        seg_arr = fill_seg_array(segs)
+        calls = {"n": 0}
+        real = IOBuf.append_device_array_unchecked
+
+        def flaky(self, arr, nbytes):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise MemoryError("injected mid-walk failure")
+            return real(self, arr, nbytes)
+
+        monkeypatch.setattr(IOBuf, "append_device_array_unchecked", flaky)
+        with pytest.raises(MemoryError):
+            build_attachment_from_c(b"", seg_arr, 3)
+        # seg 0: taken into the dropped buf (custody exited into Python);
+        # seg 1: taken then the append failed (the local ref released it);
+        # seg 2: NEVER walked — the fix releases it before re-raising
+        assert reg.live() == base, (
+            f"{reg.live() - base} keys stranded after mid-walk failure")
+
+    def test_clean_walk_unchanged(self, mesh):
+        from brpc_tpu.ici.native_plane import (build_attachment_from_c,
+                                               fill_seg_array)
+        reg = native_plane.registry()
+        arrs = [_device_payload(mesh, dev=0, n=128) for _ in range(2)]
+        segs = [(reg.put(arrs[0]), 128, 0, 1), (0, 3, 0, 0),
+                (reg.put(arrs[1]), 128, 0, 1)]
+        buf = build_attachment_from_c(b"abc", fill_seg_array(segs), 3)
+        assert len(buf) == 128 + 3 + 128
+        assert buf.to_bytes() == bytes(np.arange(128, dtype=np.uint8)) \
+            + b"abc" + bytes(np.arange(128, dtype=np.uint8))
+        assert reg.live() == 0

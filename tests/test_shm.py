@@ -789,3 +789,412 @@ def test_shm_kill_mid_stream_with_batched_descriptors_loses_nothing():
                      timeout=300)
     assert "SKS0_OK" in outs[0]
     assert "SKS1_OK" in outs[1]
+
+
+# ======================================================================
+# STRIPED shm (ISSUE 12): N independent SPSC ring pairs per segment on
+# multi-core hosts.  Units drive the v2 native API directly; the
+# 2-process legs force ici_shm_stripes=4 (this CI host is 1-core, where
+# auto keeps the v1 single ring — byte-identical to PR 10, which the
+# unchanged tests above keep proving) and assert the route per-stripe:
+# round-robin spread for unary attachment frames, ONE stripe per stream
+# (affinity by stream id), and stripe-kill degrading the WHOLE plane
+# in-frame with zero client-visible failures.
+# ======================================================================
+
+
+class TestShmStripedUnits:
+    def test_striped_create_attach_byte_exact_per_stripe(self):
+        lib = _lib()
+        if not hasattr(lib, "brpc_tpu_shm_create2"):
+            pytest.skip("native core without striped shm")
+        name = f"brpc_tpu_stripe_u1.{os.getpid()}"
+        lib.brpc_tpu_shm_unlink(name.encode())
+        h0 = lib.brpc_tpu_shm_create2(name.encode(), 128 * 1024, 4)
+        if not h0:
+            pytest.skip("/dev/shm unavailable in this sandbox")
+        h1 = lib.brpc_tpu_shm_attach(name.encode())
+        assert h1, "v2 attach failed (layout auto-detect)"
+        assert lib.brpc_tpu_shm_unlink(name.encode()) == 0
+        assert lib.brpc_tpu_shm_stripes(h0) == 4
+        assert lib.brpc_tpu_shm_stripes(h1) == 4
+        try:
+            for stripe in range(4):
+                payload = bytes([(stripe * 31 + i) % 251
+                                 for i in range(5000)])
+                buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(
+                    payload)
+                assert lib.brpc_tpu_shm_send2(
+                    h0, stripe, 100 + stripe, buf, len(payload),
+                    5_000_000) == 0
+            for stripe in range(4):
+                out, olen = u8p(), ctypes.c_uint64()
+                assert lib.brpc_tpu_shm_recv2(
+                    h1, stripe, 100 + stripe, 5_000_000,
+                    ctypes.byref(out), ctypes.byref(olen)) == 0
+                got = ctypes.string_at(out, olen.value)
+                want = bytes([(stripe * 31 + i) % 251
+                              for i in range(5000)])
+                assert got == want, f"stripe {stripe} corrupt"
+                lib.brpc_tpu_shm_release(h1, out, olen.value)
+            # per-stripe truth + conn aggregate
+            st = (ctypes.c_uint64 * 6)()
+            total = 0
+            for stripe in range(4):
+                assert lib.brpc_tpu_shm_stripe_stats(
+                    h0, stripe, st, 6) == 6
+                assert st[0] == 5000, (stripe, st[0])
+                total += st[0]
+            assert lib.brpc_tpu_shm_stats(h0, st, 6) == 6
+            assert st[0] == total
+            assert st[5] == 128 * 1024       # per-stripe ring capacity
+            # a stripe that does not exist fails cleanly, plane healthy
+            one = (ctypes.c_uint8 * 4).from_buffer_copy(b"abcd")
+            assert lib.brpc_tpu_shm_send2(h0, 7, 1, one, 4, 1000) == -1
+            assert lib.brpc_tpu_shm_alive(h0)
+        finally:
+            lib.brpc_tpu_shm_close(h0)
+            lib.brpc_tpu_shm_close(h1)
+
+    def test_stripe_kill_degrades_whole_plane(self):
+        """Chaos mode 5: one stripe's next send dies and the SHARED
+        death word takes the plane with it — health is segment-wide,
+        exactly the single-ring discipline; a claimed slot on another
+        stripe stays readable until released (deferred unmap)."""
+        lib = _lib()
+        if not hasattr(lib, "brpc_tpu_shm_create2"):
+            pytest.skip("native core without striped shm")
+        name = f"brpc_tpu_stripe_u2.{os.getpid()}"
+        lib.brpc_tpu_shm_unlink(name.encode())
+        h0 = lib.brpc_tpu_shm_create2(name.encode(), 128 * 1024, 4)
+        if not h0:
+            pytest.skip("/dev/shm unavailable in this sandbox")
+        h1 = lib.brpc_tpu_shm_attach(name.encode())
+        assert h1
+        lib.brpc_tpu_shm_unlink(name.encode())
+        one = (ctypes.c_uint8 * 64).from_buffer_copy(b"\x5a" * 64)
+        assert lib.brpc_tpu_shm_send2(h0, 0, 0x901, one, 64,
+                                      1_000_000) == 0
+        out, olen = u8p(), ctypes.c_uint64()
+        assert lib.brpc_tpu_shm_recv2(h1, 0, 0x901, 1_000_000,
+                                      ctypes.byref(out),
+                                      ctypes.byref(olen)) == 0
+        assert lib.brpc_tpu_shm_chaos(h0, 5, 2) == 0   # arm stripe-2 kill
+        assert lib.brpc_tpu_shm_send2(h0, 2, 0x902, one, 64,
+                                      1_000_000) == -1
+        assert lib.brpc_tpu_shm_alive(h0) == 0
+        assert lib.brpc_tpu_shm_alive(h1) == 0
+        # sends on OTHER stripes fail too: the plane degrades as one
+        assert lib.brpc_tpu_shm_send2(h0, 1, 0x903, one, 64, 1000) == -1
+        # parked frame published before death is still claimable;
+        # a missing one fails fast (-2), no timeout burn
+        o2, l2 = u8p(), ctypes.c_uint64()
+        assert lib.brpc_tpu_shm_recv2(h1, 3, 0xBEEF, 5_000_000,
+                                      ctypes.byref(o2),
+                                      ctypes.byref(l2)) == -2
+        assert ctypes.string_at(out, olen.value) == b"\x5a" * 64
+        lib.brpc_tpu_shm_close(h0)
+        lib.brpc_tpu_shm_close(h1)       # claim out: unmap deferred
+        assert ctypes.string_at(out, 1) == b"\x5a"
+        lib.brpc_tpu_shm_release(h1, out, olen.value)
+
+    def test_create2_single_stripe_is_v1_layout(self):
+        """nstripes<=1 delegates to the v1 creator: the 1-core shape is
+        the SAME file format and machinery as PR 10, byte-identical."""
+        lib = _lib()
+        if not hasattr(lib, "brpc_tpu_shm_create2"):
+            pytest.skip("native core without striped shm")
+        name = f"brpc_tpu_stripe_u3.{os.getpid()}"
+        lib.brpc_tpu_shm_unlink(name.encode())
+        h0 = lib.brpc_tpu_shm_create2(name.encode(), 64 * 1024, 1)
+        if not h0:
+            pytest.skip("/dev/shm unavailable in this sandbox")
+        try:
+            with open(f"/dev/shm/{name}", "rb") as f:
+                magic = f.read(4)
+            # v1 magic 0x53484d31 little-endian on disk = b"1MHS"
+            assert magic == b"1MHS", magic
+            assert lib.brpc_tpu_shm_stripes(h0) == 1
+        finally:
+            lib.brpc_tpu_shm_unlink(name.encode())
+            lib.brpc_tpu_shm_close(h0)
+
+    def test_stripe_resolution_and_uuid_tagging(self, monkeypatch):
+        """auto = 1 on a 1-core host (the byte-identical path), else
+        min(4, cores); the uuid tag rides the top byte and decodes
+        clamped."""
+        from brpc_tpu.ici import fabric as fab
+        from brpc_tpu.butil import flags as _fl
+        prev = _fl.get_flag("ici_shm_stripes")
+        try:
+            _fl.set_flag("ici_shm_stripes", 0)
+            monkeypatch.setattr(fab._os, "cpu_count", lambda: 1)
+            assert fab._resolve_shm_stripes() == 1
+            monkeypatch.setattr(fab._os, "cpu_count", lambda: 8)
+            assert fab._resolve_shm_stripes() == 4
+            monkeypatch.setattr(fab._os, "cpu_count", lambda: 2)
+            assert fab._resolve_shm_stripes() == 2
+            _fl.set_flag("ici_shm_stripes", 6)
+            assert fab._resolve_shm_stripes() == 6
+        finally:
+            _fl.set_flag("ici_shm_stripes", prev)
+        # stripe decode: identity at 1 stripe, clamped at N
+        sof = fab.FabricSocket._shm_stripe_of
+        assert sof(0x123, 1) == 0
+        assert sof((3 << 56) | 0x123, 4) == 3
+        assert sof((9 << 56) | 0x123, 4) == 3     # clamped, never OOR
+
+
+_SHM_STRIPED_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.butil import flags as _fl
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+_fl.set_flag("ici_shm_stripes", 4)      # force striping on this 1-core CI
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc.socket import list_sockets
+from brpc_tpu.ici.route import route_stats
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+def fabric_socks():
+    return [s for s in list_sockets() if isinstance(s, FabricSocket)]
+
+def stripe_bytes():
+    rs = route_stats()
+    return {k: v["bytes"] for k, v in rs.items()
+            if k.startswith("shm_stripe_")}
+
+CHUNK = 512 * 1024
+SCHUNK = 256 * 1024
+NSTREAM = 6
+
+if pid == 0:
+    state = {"next": 0, "bad": 0}
+    done_evt = threading.Event()
+
+    class Sink:
+        def on_received_messages(self, sid, msgs):
+            for m in msgs:
+                want = b"%%08d" %% state["next"] + \
+                    bytes([(state["next"] * 7 + 3) %% 251]) * (SCHUNK - 8)
+                if m.to_bytes() != want:
+                    state["bad"] += 1
+                state["next"] += 1
+        def on_closed(self, sid):
+            done_evt.set()
+
+    class EchoService(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "srv0:" + request.message
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+            done()
+
+    class StreamSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Start(self, cntl, request, response, done):
+            rpc.stream_accept(cntl, rpc.StreamOptions(handler=Sink()))
+            response.message = "ok"
+            done()
+
+    server = rpc.Server()
+    server.add_service(EchoService()); server.add_service(StreamSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("sst_srv_up", "1")
+    assert done_evt.wait(180), ("stream never closed", state["next"])
+    assert state["next"] == NSTREAM and state["bad"] == 0, state
+    socks = fabric_socks()
+    assert socks and socks[0].shm_bound()
+    d = socks[0].describe_shm()
+    assert d["stripes"] == 4, d
+    # the server's RESPONSES round-robined its stripes too
+    sb = stripe_bytes()
+    assert sum(sb.values()) >= 8 * CHUNK, sb
+    kv.wait_at_barrier("sst_done", 180000)
+    server.stop()
+    print("SST0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("sst_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    payload = jax.device_put(jnp.arange(CHUNK, dtype=jnp.uint8) %% 251,
+                             jax.devices()[local_dev])
+    jax.block_until_ready(payload)
+    expect = bytes(np.asarray(payload))
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=120000,
+                                                  max_retry=0))
+    # phase 1: 8 unary attachment echoes — round-robin should spread
+    # the sends over EVERY stripe (8 frames, 4 stripes)
+    for i in range(8):
+        cntl = rpc.Controller()
+        cntl.request_attachment.append_device_array(payload)
+        resp = ch.call_method("EchoService.Echo", cntl,
+                              EchoRequest(message="m%%d" %% i),
+                              EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "srv0:m%%d" %% i
+        assert cntl.response_attachment.to_bytes() == expect
+    s = fabric_socks()[0]
+    d = s.describe_shm()
+    assert d["stripes"] == 4, d
+    sb1 = stripe_bytes()
+    hit = [k for k, v in sb1.items() if v >= CHUNK]
+    assert len(hit) == 4, ("round-robin left stripes idle", sb1)
+    assert s.bulk_bytes_sent == 0, s.bulk_bytes_sent
+    # phase 2: ONE stream — affinity pins every DATA frame to a single
+    # stripe (per-stream ordering decided by one ring)
+    cntl = rpc.Controller()
+    stream = rpc.stream_create(cntl,
+                               rpc.StreamOptions(max_buf_size=8 << 20))
+    ch.call_method("StreamSvc.Start", cntl,
+                   EchoRequest(message="s"), EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(10)
+    for seq in range(NSTREAM):
+        body = b"%%08d" %% seq + \
+            bytes([(seq * 7 + 3) %% 251]) * (SCHUNK - 8)
+        assert stream.write(IOBuf(body), timeout=30) == 0
+    stream.close()
+    sb2 = stripe_bytes()
+    grew = [k for k in sb2
+            if sb2[k] - sb1.get(k, 0) > 0]
+    assert len(grew) == 1, ("stream frames crossed stripes", sb1, sb2)
+    assert sb2[grew[0]] - sb1.get(grew[0], 0) >= NSTREAM * SCHUNK
+    kv.wait_at_barrier("sst_done", 180000)
+    print("SST1_OK", flush=True)
+"""
+
+
+def test_striped_shm_round_robin_and_stream_affinity_2proc():
+    """Forced 4-stripe plane over a real fabric pair: unary attachment
+    frames round-robin over every stripe (per-stripe counters assert
+    the route), ONE stream's frames stay on ONE stripe (affinity), all
+    byte-exact, zero bulk fallbacks."""
+    from test_fabric import _run_pair
+    outs = _run_pair(_SHM_STRIPED_CHILD % {"repo": REPO}, timeout=300)
+    assert "SST0_OK" in outs[0]
+    assert "SST1_OK" in outs[1]
+
+
+_SHM_STRIPED_KILL_CHILD = r"""
+import os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.butil import flags as _fl
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+_fl.set_flag("ici_shm_stripes", 4)
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.rpc.socket import list_sockets
+from echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+def fabric_socks():
+    return [s for s in list_sockets() if isinstance(s, FabricSocket)]
+
+CHUNK = 256 * 1024
+PHASE = 4
+
+if pid == 0:
+    total = [0]
+    lock = threading.Lock()
+
+    class Sink(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Push(self, cntl, request, response, done):
+            got = cntl.request_attachment.to_bytes()
+            seq = int(request.message)
+            want = bytes([seq %% 251]) * CHUNK
+            assert got == want, "corrupt payload at seq %%d" %% seq
+            with lock:
+                total[0] += 1
+            response.message = str(total[0])
+            done()
+
+    server = rpc.Server(); server.add_service(Sink())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("stk_srv_up", "1")
+    kv.wait_at_barrier("stk_done", 240000)
+    assert total[0] == 3 * PHASE, total[0]
+    server.stop()
+    print("STK0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("stk_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=120000,
+                                                  max_retry=0))
+
+    def push(seq):
+        arr = jax.device_put(
+            jnp.full(CHUNK, seq %% 251, dtype=jnp.uint8),
+            jax.devices()[local_dev])
+        jax.block_until_ready(arr)
+        cntl = rpc.Controller()
+        cntl.request_attachment.append_device_array(arr)
+        ch.call_method("Sink.Push", cntl,
+                       EchoRequest(message=str(seq)), EchoResponse)
+        assert not cntl.failed(), (seq, cntl.error_text)
+
+    seq = 0
+    for _ in range(PHASE):            # striped plane up
+        push(seq); seq += 1
+    s = fabric_socks()[0]
+    assert s.shm_bound() and s.describe_shm()["stripes"] == 4
+    epoch0 = s.shm_epoch()
+    # stripe-targeted kill: stripe 1's NEXT send dies and takes the
+    # whole plane (shared death word) — the degrade must be IN-FRAME
+    with s._bulk_lock:
+        h, lib = s._shm, s._shmlib
+    assert lib.brpc_tpu_shm_chaos(h, 5, 1) == 0
+    for _ in range(PHASE):            # degraded: bulk tier, zero failures
+        push(seq); seq += 1
+    assert s.shm_bytes_sent < 3 * PHASE * CHUNK   # some went bulk
+    assert s.bulk_bytes_sent >= CHUNK, s.bulk_bytes_sent
+    deadline = time.time() + 60
+    while s.shm_epoch() == epoch0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert s.shm_epoch() > epoch0, "striped ring never re-established"
+    assert s.describe_shm()["stripes"] == 4   # revived STRIPED
+    for _ in range(PHASE):            # revived
+        push(seq); seq += 1
+    assert not s.failed
+    kv.wait_at_barrier("stk_done", 240000)
+    print("STK1_OK", flush=True)
+"""
+
+
+@pytest.mark.chaos
+def test_striped_shm_stripe_kill_degrades_in_frame_and_revives():
+    """Stripe-kill on a live striped plane: the killed stripe's send
+    fails IN-FRAME, the whole plane degrades to the socket bulk tier
+    with zero client-visible failures, and revival comes back striped
+    (epoch bump, stripes=4)."""
+    from test_fabric import _run_pair
+    outs = _run_pair(_SHM_STRIPED_KILL_CHILD % {"repo": REPO},
+                     timeout=360)
+    assert "STK0_OK" in outs[0]
+    assert "STK1_OK" in outs[1]
