@@ -103,11 +103,41 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
         return lat
 
     lat_py = drive(iters)               # Python handler tier (assign)
-    # the PR-8 append idiom on the SAME server: the view materializes
-    # instead of passing through — what handlers that mutate pay
+    # the PR-8 append idiom on the SAME server: under ISSUE 13's
+    # adoption the whole-view append passes the parked handle through
+    # like assignment (a small construction tax remains; a handler that
+    # touches the buffer again pays the materialize)
     echo_mode[0] = "append"
     lat_py_append = drive(max(iters // 2, 150))
     echo_mode[0] = "assign"
+    # frames/RPC (ISSUE 13): interpreter frames for ONE call_method on
+    # the default (fused) path — sys.setprofile 'call'-event count, the
+    # same methodology the tier-1 frame-budget test pins.  PR-12's
+    # same-methodology count was 93 (its ROADMAP cProfile figure ~170
+    # also counted C calls).
+    frames_per_rpc = -1
+    try:
+        _fcounts = []
+        for _ in range(15):
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(payload)
+            _nfr = [0]
+
+            def _prof(frame, event, arg, _n=_nfr):
+                if event == "call":
+                    _n[0] += 1
+
+            sys.setprofile(_prof)
+            ch.call_method("EchoService.Echo", cntl,
+                           EchoRequest(message="b"), EchoResponse)
+            sys.setprofile(None)
+            if cntl.failed():
+                raise RuntimeError(cntl.error_text)
+            _fcounts.append(_nfr[0])
+        _fcounts.sort()
+        frames_per_rpc = _fcounts[len(_fcounts) // 2]
+    finally:
+        sys.setprofile(None)
     # per-stage decomposition pass (tpu_std_stage_metrics=on): the SAME
     # py-handler shape feeds the tpu_std_server_* recorders through the
     # batched ici upcall tier, so BENCH extra shows WHERE the upcall
@@ -166,6 +196,28 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
     finally:
         _fl.set_flag("ici_native_att_custody", _custody_prev)
         echo_mode[0] = "assign"
+    # fused-dispatch A/B leg (ISSUE 13): ici_fused_dispatch=False
+    # restores the PR-12 dispatch chain byte-for-byte (server AND
+    # client snapshot the flag at bind/connect) on a FRESH generation,
+    # same process, same warmed jit, same container run — the legacy
+    # leg the >=25% acceptance compares against.  Assignment idiom,
+    # like the headline.
+    lat_py_unfused = []
+    _fused_prev = _fl.get_flag("ici_fused_dispatch")
+    _fl.set_flag("ici_fused_dispatch", False)
+    try:
+        server_u = rpc.Server(opts)
+        server_u.add_service(EchoService())
+        server_u.start("ici://0")
+        ch_u = rpc.Channel()
+        ch_u.init("ici://0",
+                  options=rpc.ChannelOptions(timeout_ms=10000,
+                                             max_retry=0,
+                                             ici_local_device=0))
+        lat_py_unfused = drive(max(iters // 2, 150), chan=ch_u)
+        server_u.stop()
+    finally:
+        _fl.set_flag("ici_fused_dispatch", _fused_prev)
     if cpp_loop > 0:
         p50, src = cpp_loop, "cpp_loop"
     elif lat_native:
@@ -191,6 +243,13 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
         "py_handler_legacy_custody_p99_us":
             (lat_py_legacy[int(len(lat_py_legacy) * 0.99)]
              if lat_py_legacy else -1.0),
+        "py_handler_unfused_p50_us":
+            (lat_py_unfused[len(lat_py_unfused) // 2]
+             if lat_py_unfused else -1.0),
+        "py_handler_unfused_p99_us":
+            (lat_py_unfused[int(len(lat_py_unfused) * 0.99)]
+             if lat_py_unfused else -1.0),
+        "frames_per_rpc": frames_per_rpc,
         "py_handler_xdev_p50_us": lat_py_xdev[len(lat_py_xdev) // 2],
         "py_handler_xdev_p99_us": lat_py_xdev[int(len(lat_py_xdev) * 0.99)],
         "native_datapath": binding is not None,
@@ -925,6 +984,122 @@ def bench_collective_single(iters: int = 200, shard: int = 512):
         "single_call_p99_us": round(lat[int(len(lat) * 0.99)], 1) if lat
         else -1.0,
     }
+
+
+def bench_cpu_bound_qps(duration_s: float = 1.2, concurrency: int = 4):
+    """python_stack_cpu_bound_qps (ISSUE 13 / ROADMAP 4c): CPU-bound
+    handlers behind the ``usercode_in_pthread`` pool — isolated
+    (subinterpreter workers) vs unisolated (backup threads under the
+    GIL), same spin work, same concurrency.  The ≥2× scaling
+    acceptance applies only where the interpreter gives isolated
+    workers their own GIL (3.12+ subinterpreters / a free-threading
+    build) AND the host has cores to run them; otherwise the
+    capability record + reason land in ``skip_reason`` (the
+    striped-shm SKIP precedent) and both functional qps numbers are
+    still reported."""
+    import os
+    import threading
+    import time as _time
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc
+    from brpc_tpu.ici import native_plane
+    from brpc_tpu.rpc.usercode_pool import probe_isolation
+    sys.path.insert(0, "tests")
+    from tests.echo_pb2 import EchoRequest, EchoResponse
+
+    caps = probe_isolation()
+    cores = os.cpu_count() or 1
+    out = {
+        "pool_mode": caps.mode,
+        "pool_functional": caps.functional,
+        "pool_scaling_supported": caps.scaling,
+        "host_cores": cores,
+    }
+    skip = ""
+    if not caps.scaling:
+        skip = caps.reason
+    if cores < 2:
+        skip = (skip + "; " if skip else "") + (
+            f"host_cores == {cores}: isolated workers have no second "
+            "core to scale onto")
+    out["skip_reason"] = skip
+    if not native_plane.available():
+        out["skip_reason"] = (skip + "; " if skip else "") + \
+            "native core unavailable"
+        out["qps_isolated"] = out["qps_pthread"] = -1.0
+        out["scaling_x"] = -1.0
+        return out
+
+    SPIN = 4000          # pure-python LCG iterations (~250 µs of GIL hold)
+    ISO_SRC = f"""
+def handle(payload):
+    x = 1
+    for _ in range({SPIN}):
+        x = (x * 1103515245 + 12345) & 0x7FFFFFFF
+    return payload
+"""
+
+    class SpinService(rpc.Service):
+        SERVICE_NAME = "CpuService"
+
+        @rpc.method(EchoRequest, EchoResponse)
+        def Spin(self, cntl, request, response, done):
+            x = 1
+            for _ in range(SPIN):
+                x = (x * 1103515245 + 12345) & 0x7FFFFFFF
+            response.message = request.message
+            done()
+
+    def leg(isolated: bool) -> float:
+        srv = rpc.Server(rpc.ServerOptions(
+            usercode_in_pthread=True,
+            usercode_backup_threads=concurrency,
+            usercode_pool_kind="auto" if isolated else "pthread"))
+        if isolated:
+            srv.register_isolated("CpuService.Spin", ISO_SRC)
+        else:
+            srv.add_service(SpinService())
+        srv.start("ici://0")
+        ch = rpc.Channel()
+        ch.init("ici://0",
+                options=rpc.ChannelOptions(timeout_ms=30000, max_retry=0,
+                                           ici_local_device=0))
+        req = EchoRequest(message="s")
+        done_counts = [0] * concurrency
+        stop = threading.Event()
+
+        def worker(idx: int) -> None:
+            while not stop.is_set():
+                cntl = rpc.Controller()
+                ch.call_method("CpuService.Spin", cntl, req, None)
+                if cntl.failed():
+                    raise RuntimeError(cntl.error_text)
+                done_counts[idx] += 1
+
+        # warm (pool workers spawn, codec caches fill)
+        cntl = rpc.Controller()
+        ch.call_method("CpuService.Spin", cntl, req, None)
+        if cntl.failed():
+            raise RuntimeError(cntl.error_text)
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(concurrency)]
+        t0 = _time.monotonic()
+        for t in threads:
+            t.start()
+        _time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        dt = _time.monotonic() - t0
+        srv.stop()
+        return sum(done_counts) / dt
+
+    out["qps_isolated"] = round(leg(True), 1)
+    out["qps_pthread"] = round(leg(False), 1)
+    out["scaling_x"] = round(out["qps_isolated"] / out["qps_pthread"], 2) \
+        if out["qps_pthread"] > 0 else -1.0
+    return out
 
 
 def bench_qps(seconds: float = 2.0, concurrency: int = 32,
@@ -1935,6 +2110,9 @@ def main() -> None:
         tail = {}
     # overload survival tier (admission control): 10x offered load,
     # 3:1 low:high priority mix, 4 tenants, wire + native-ici planes
+    cpu = _run_subbench("cpu_bound") if device_ok else {}
+    print(f"# python-stack cpu-bound qps (usercode pool): {cpu}",
+          file=sys.stderr)
     ovl = _run_subbench("overload", timeout_s=300) if reachable else {}
     print(f"# overload survival: {ovl}", file=sys.stderr)
     target_us = 10.0
@@ -2006,6 +2184,15 @@ def main() -> None:
             echo.get("py_handler_legacy_custody_p50_us", -1.0), 1),
         "ici_py_handler_legacy_custody_p99_us": round(
             echo.get("py_handler_legacy_custody_p99_us", -1.0), 1),
+        # ISSUE-13 fused-dispatch A/B, all in THIS run: unfused =
+        # ici_fused_dispatch=False, the PR-12 chain byte-for-byte;
+        # frames_per_rpc = sys.setprofile call-events for one
+        # call_method (PR-12 same-methodology count: 93)
+        "ici_py_handler_unfused_p50_us": round(
+            echo.get("py_handler_unfused_p50_us", -1.0), 1),
+        "ici_py_handler_unfused_p99_us": round(
+            echo.get("py_handler_unfused_p99_us", -1.0), 1),
+        "ici_frames_per_rpc": echo.get("frames_per_rpc", -1),
         "ici_py_handler_xdev_echo_p50_us": round(
             echo.get("py_handler_xdev_p50_us", -1.0), 1),
         "ici_py_handler_xdev_echo_p99_us": round(
@@ -2146,6 +2333,19 @@ def main() -> None:
             "tenant_min_share_ratio", -1.0),
         "overload_shed_wire": ovl.get("wire", {}).get("shed", -1),
         "overload_shed_ici": ovl.get("ici", {}).get("shed", -1),
+        # ISSUE-13 usercode pool (ROADMAP 4c): CPU-bound handler qps,
+        # isolated subinterp workers vs GIL-bound backup threads; the
+        # >=2x scaling acceptance SKIPs with the recorded reason where
+        # the interpreter or host can't scale (striped-shm precedent)
+        "python_stack_cpu_bound_qps_pool": cpu.get("qps_isolated", -1.0),
+        "python_stack_cpu_bound_qps_pthread": cpu.get("qps_pthread",
+                                                      -1.0),
+        "python_stack_cpu_bound_scaling_x": cpu.get("scaling_x", -1.0),
+        "python_stack_cpu_bound_skip_reason": cpu.get("skip_reason",
+                                                      "unmeasured"),
+        "usercode_pool_mode": cpu.get("pool_mode", "unknown"),
+        "usercode_pool_scaling_supported": cpu.get(
+            "pool_scaling_supported", False),
     }
     # single-device allreduce is local-HBM bandwidth, not ICI: label it so
     # no reader mistakes it for line rate (VERDICT r3 #3a)
@@ -2174,6 +2374,7 @@ if __name__ == "__main__":
               "ring_attention": bench_ring_attention,
               "rpcz_overhead": bench_rpcz_overhead,
               "overload": bench_overload,
+              "cpu_bound": bench_cpu_bound_qps,
               "collective_fanout": bench_collective_fanout,
               "collective_single": bench_collective_single,
               "pod_prefill_decode": bench_pod_prefill_decode}[sys.argv[2]]

@@ -38,8 +38,51 @@ from ..butil.iobuf import IOBuf, DEVICE
 from ..butil.native import IciCallOut, IciRespC, IciSegC, _ICI_BATCH_FN, \
     _ICI_RELEASE_FN, _ICI_RELOCATE_FN
 from ..rpc import errors
+from ..rpc import request_context as _reqctx
 
 _U8P = ctypes.POINTER(ctypes.c_uint8)
+
+# the fused paths read the request-context slot without the
+# current()/scope() call frames — same thread-local the module owns
+_reqctx_tls = _reqctx._tls
+
+# call_fused returns this when the call must re-route to the Python
+# plane (frame too large / hedging configured / dead-conn fallback):
+# distinct from None, which is a legitimate failed-call result
+FUSED_FALLTHROUGH = object()
+
+# the raw C string_at (stable since 2.5): the public wrapper is a
+# Python frame per read, and the fused paths read 2-3 borrowed buffers
+# per RPC
+_string_at = ctypes._string_at
+
+_fused_ffi = None
+
+
+def _fused_call_binding(att_custody: bool):
+    """Fused-path FFI bindings for call3/call4 whose payload/att-host
+    argtypes are ``c_char_p`` — bytes objects pass straight through
+    (ABI-identical pointer) instead of paying two ``ctypes.cast``
+    frames per call.  Bound on a SEPARATE CDLL handle so the legacy
+    ``call`` keeps its POINTER(c_uint8) binding byte-for-byte."""
+    global _fused_ffi
+    if _fused_ffi is None:
+        lib = native.load()
+        lib2 = ctypes.CDLL(lib._name)
+        segp = ctypes.POINTER(IciSegC)
+        argt = [ctypes.c_uint64, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+                segp, ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(IciCallOut)]
+        f3 = lib2.brpc_tpu_ici_call3
+        f3.restype = ctypes.c_uint64
+        f3.argtypes = argt
+        f4 = lib2.brpc_tpu_ici_call4
+        f4.restype = ctypes.c_uint64
+        f4.argtypes = argt
+        _fused_ffi = (f3, f4)
+    return _fused_ffi[1] if att_custody else _fused_ffi[0]
 
 # Batched one-struct upcall tuning (native/rpc.cpp enqueue_batch): the
 # drainer takes up to max_batch requests per GIL crossing; an arrival
@@ -64,6 +107,21 @@ _flags.define_flag("ici_native_att_custody", True,
                    "handlers receive a lazily-materialized zero-copy "
                    "view backed by native custody instead of a "
                    "per-seg registry walk")
+
+# Fused dispatch (ISSUE 13): the per-RPC interpreter-frame chain on the
+# native-ici hot path collapses into single flat code objects —
+# _process/_execute/done fuse into ServerBinding._process_fused +
+# _FusedDone on the server, Channel.call_method's native preamble +
+# screens + ChannelBinding.call fuse into ChannelBinding.call_fused on
+# the client, with per-method dispatch resolved ONCE per (listener,
+# method) instead of per call.  Off = the PR-12 frame chain
+# byte-for-byte (the A/B leg).  Snapshot at bind/connect time, like
+# ici_native_att_custody.
+_flags.define_flag("ici_fused_dispatch", True,
+                   "collapse the native-ici per-RPC dispatch chain "
+                   "into fused code objects (server process/execute/"
+                   "done and the client call path); off restores the "
+                   "unfused PR-12 frame chain for A/B")
 
 # hot-path module handles, resolved once at first call: the per-call
 # `from x import y` dance measured ~1 us/call on the fast plane (the
@@ -474,6 +532,58 @@ class NativeAttachment(IOBuf):
             pass
 
 
+class ResponseAttachment(NativeAttachment):
+    """The server/client response-attachment default (installed as
+    ``Controller.response_attachment``'s lazy factory once this module
+    loads): a plain IOBuf until a WHOLE, untouched ``NativeAttachment``
+    view is appended while this buffer is still empty — the PR-8 echo
+    idiom ``cntl.response_attachment.append(cntl.request_attachment)``
+    — which ADOPTS the parked handle instead of materializing it
+    (ISSUE 13 satellite): the respond path then passes the handle back
+    with zero Python seg walks, byte-identical to the assignment
+    idiom.  Any structural touch after adoption inflates through the
+    inherited lazy discipline; exactly-one-exit holds (pass-through at
+    respond, or dispose at pool recycle / GC)."""
+
+    __slots__ = ()
+
+    def __init__(self):
+        IOBuf.__init__(self)
+        self._h = 0
+        self._total = 0
+        self._seg_meta = ()
+        self._mat = True               # a real (empty) buffer until adopted
+
+    def append(self, data) -> None:
+        if (self._mat and isinstance(data, NativeAttachment)
+                and not data._mat and data._h and not self._refs):
+            # adopt: the handle moves here and THIS buffer becomes the
+            # lazy view — the donor is left surrendered (same aliasing
+            # the assignment idiom has).  The real refs/size slots are
+            # deleted so the first structural touch re-inflates through
+            # NativeAttachment.__getattr__.
+            self._h = data._h
+            data._h = 0
+            self._total = data._total
+            self._seg_meta = data._seg_meta
+            self._mat = False
+            del self._refs, self._size
+            return
+        IOBuf.append(self, data)
+
+
+def _install_response_attachment_factory() -> None:
+    """Swap Controller's lazy response-attachment factory to
+    ResponseAttachment — process-wide, on every call plane (the wire
+    and loopback planes see a plain IOBuf in all but the adoption
+    shape, which only the native custody tier can produce)."""
+    from ..rpc.controller import Controller
+    vars(Controller)["response_attachment"].factory = ResponseAttachment
+
+
+_install_response_attachment_factory()
+
+
 def _seg_meta_from_req(r, nsegs: int):
     """((key, nbytes, dev), ...) + total bytes for a handle-carrying
     request struct: the dominant 1-seg shape reads the inline seg0
@@ -531,7 +641,9 @@ def _device_index(arr) -> int:
     residency check/device_put, preserving Python-plane semantics instead
     of silently skipping relocation (review finding: a 0 default would
     alias device 0)."""
-    IciMesh = _mesh_cls()
+    IciMesh = _IciMesh
+    if IciMesh is None:
+        IciMesh = _mesh_cls()
     gen = IciMesh.generation
     key = id(arr)
     hit = _devidx_cache.get(key)
@@ -644,6 +756,26 @@ class ServerBinding:
         self._att_custody = bool(
             _flags.get_flag("ici_native_att_custody"))
         lib.brpc_tpu_ici_set_att_handles(h, 1 if self._att_custody else 0)
+        # fused dispatch (ISSUE 13), snapshot at bind like att custody:
+        # the inline hot path runs through _process_fused — one flat
+        # code object per request — with the per-method dispatch tuple
+        # resolved once per raw method key and every hot module handle
+        # bound HERE instead of re-resolved per call
+        self._fused = bool(_flags.get_flag("ici_fused_dispatch"))
+        # the batch-of-1 fast lane's gate, snapshot at bind (options
+        # are final once start() ran; the A/B flips flags between
+        # server generations, never mid-listener)
+        self._fused_inline1 = self._fused and bool(
+            getattr(server.options, "usercode_inline", False))
+        self._fcache: Dict[bytes, tuple] = {}   # mkey -> dispatch tuple
+        self._stage_flag, self._record_stage = _stage_modules()
+        self._pool = _controller_pool()
+        # dispatch-route truth (OBSERVABILITY.md): how many requests ran
+        # the fused body vs the legacy chain on this listener — plain
+        # ints bumped on the hot path (an Adder op per RPC is real µs),
+        # published by describe()/bench
+        self.fused_dispatched = 0
+        self.legacy_dispatched = 0
         with _server_bindings_lock:
             _server_bindings[device_id] = self
 
@@ -683,10 +815,21 @@ class ServerBinding:
         dispatch modes fan the requests out (tasklets / usercode pool —
         the queued counter counts BATCH CONTENTS, one per request, so
         the lame-duck drain gate sees each of them)."""
+        # the idle/low-load fast lane: ONE fused inline request, no
+        # collector, no loop setup — the dominant shape on the echo
+        # bench (the snapshot below is taken at bind; options are
+        # final once the server started)
+        if n == 1 and self._fused_inline1:
+            try:
+                self._process_fused(reqs[0], None)
+            except Exception as e:
+                self._batch_request_failed(reqs[0], e)
+            return
         try:
             server = self._server
             inline = getattr(server.options, "usercode_inline", False)
             pool = getattr(server, "usercode_pool", None)
+            fused = self._fused and inline
             # a batch of ONE (the idle/low-load shape) responds directly —
             # the collector only earns its lock when there is something
             # to amortize
@@ -704,6 +847,17 @@ class ServerBinding:
                     r = reqs[i]
                     token = r.token
                     try:
+                        if fused:
+                            # inline hot path: the whole request — method
+                            # resolve, gates, controller setup, parse,
+                            # invoke, completion — runs in ONE flat code
+                            # object (custody exits inside match the
+                            # legacy chain exactly; the except arm below
+                            # still covers a failure here, and its
+                            # dispose of an already-exited handle is a
+                            # table-miss no-op)
+                            self._process_fused(r, collector)
+                            continue
                         mkey = r.method
                         full = names.get(mkey)
                         if full is None:
@@ -769,18 +923,42 @@ class ServerBinding:
                             # individually — the drain gate counts batch
                             # contents, not batches
                             server.on_usercode_queued()
+                            reg = server._isolated.get(full) \
+                                if server._isolated else None
                             try:
-                                pool.submit(self._run_usercode, token,
-                                            full, payload, attachment,
-                                            r.log_id, r.peer_dev,
-                                            r.recv_ns, adm_meta)
+                                if reg is not None:
+                                    # isolated method (usercode_pool):
+                                    # the payload crosses as bytes to a
+                                    # subinterpreter worker; gates —
+                                    # admission included — and custody
+                                    # run in _run_isolated on the
+                                    # backup thread
+                                    pool.submit(self._run_isolated,
+                                                token, full, payload,
+                                                attachment, reg,
+                                                adm_meta, r.recv_ns)
+                                else:
+                                    pool.submit(self._run_usercode,
+                                                token, full, payload,
+                                                attachment, r.log_id,
+                                                r.peer_dev, r.recv_ns,
+                                                adm_meta)
                             except RuntimeError:
                                 server.on_usercode_done()
-                                # pool shut down mid-stop: run here
-                                self._process(token, full, payload,
-                                              attachment, r.log_id,
-                                              r.peer_dev, r.recv_ns, None,
-                                              adm_meta)
+                                if reg is not None:
+                                    # isolation workers are gone too:
+                                    # bounce retryable, like the drain
+                                    self._release_attachment_custody(
+                                        attachment)
+                                    self._respond_one(
+                                        token, errors.ELOGOFF,
+                                        "server stopping")
+                                else:
+                                    # pool shut down mid-stop: run here
+                                    self._process(token, full, payload,
+                                                  attachment, r.log_id,
+                                                  r.peer_dev, r.recv_ns,
+                                                  None, adm_meta)
                         else:
                             if scheduler is None:
                                 from ..bthread import scheduler
@@ -830,8 +1008,326 @@ class ServerBinding:
         finally:
             self._server.on_usercode_done()
 
+    def _run_isolated(self, token, full, payload, attachment, reg,
+                      adm_meta=None, recv_ns=0) -> None:
+        """A registered isolated method on a backup thread: gates
+        (including the SAME admission decision tree every other plane
+        runs), the share-nothing pool call (payload bytes →
+        subinterpreter worker → response bytes), attachment custody,
+        respond.  Isolated methods have no MethodDescriptor — the
+        handler source lives in the pool's workers
+        (Server.register_isolated)."""
+        server = self._server
+        try:
+            if server._draining:
+                self._release_attachment_custody(attachment)
+                self._respond_one(token, errors.ELOGOFF,
+                                  "server is draining (lame duck)")
+                return
+            pri_wire, tenant, ddl = adm_meta or (0, "", 0)
+            adm = server.admission
+            if adm is not None:
+                from ..rpc import admission as admission_mod
+
+                def _admitted(queued_us: int) -> None:
+                    # the budget shrank while queued: bound the worker
+                    # wait by what is LEFT, not the at-recv value
+                    left = max(ddl - queued_us // 1000, 1) if ddl else 0
+                    self._isolated_admitted(token, full, payload,
+                                            attachment, reg, left)
+
+                def _shed(code: int, text: str, retry_after: int) -> None:
+                    self._release_attachment_custody(attachment)
+                    self._respond_one(token, code, text,
+                                      retry_after=retry_after)
+
+                adm.submit(
+                    priority=(pri_wire - 1) if pri_wire else None,
+                    tenant=tenant,
+                    deadline_left_ms=ddl or None,
+                    recv_us=(recv_ns // 1000) if recv_ns else 0,
+                    try_enter=admission_mod.server_method_gate(server,
+                                                               None),
+                    run=_admitted, shed=_shed)
+                return
+            if not server.on_request_in():
+                self._release_attachment_custody(attachment)
+                self._respond_one(token, errors.ELIMIT,
+                                  "server max_concurrency reached")
+                return
+            self._isolated_admitted(token, full, payload, attachment,
+                                    reg, ddl)
+        finally:
+            server.on_usercode_done()
+
+    def _isolated_admitted(self, token, full, payload, attachment, reg,
+                           deadline_left_ms) -> None:
+        """Gates held: the pool round trip + custody + respond.  The
+        wait on the isolation worker is bounded by the request's OWN
+        remaining deadline when it carried one (a 100 ms client must
+        not pin a backup thread for the pool's default bound)."""
+        server = self._server
+        _src, att_mode = reg
+        start_ns = _time.monotonic_ns()
+        pool = server.usercode_pool
+        try:
+            if pool is None:
+                raise RuntimeError("usercode pool stopped")
+            resp = pool.call_isolated(
+                full, payload,
+                timeout=(deadline_left_ms / 1000.0)
+                if deadline_left_ms else None)
+        except TimeoutError:
+            # budget spent waiting on the worker: the same
+            # ERPCTIMEDOUT every other plane reports for a spent
+            # deadline, not an internal error
+            self._release_attachment_custody(attachment)
+            item = (token, errors.ERPCTIMEDOUT,
+                    f"isolated handler exceeded deadline "
+                    f"({deadline_left_ms}ms)".encode(), b"", b"",
+                    (), (None, errors.ERPCTIMEDOUT, 0, server), 0, 0)
+            self._respond_item(item)
+            return
+        except Exception as e:
+            self._release_attachment_custody(attachment)
+            item = (token, errors.EINTERNAL,
+                    f"{type(e).__name__}: {e}".encode(), b"", b"",
+                    (), (None, errors.EINTERNAL, 0, server), 0, 0)
+            self._respond_item(item)
+            return
+        latency_us = (_time.monotonic_ns() - start_ns) // 1000
+        pass_h = 0
+        att_host = b""
+        segs = ()
+        if attachment is not None:
+            if isinstance(attachment, NativeAttachment) \
+                    and not attachment._mat:
+                if att_mode == "echo":
+                    pass_h = attachment._surrender_native()
+                else:
+                    attachment._dispose_native()
+            elif att_mode == "echo" and attachment.backing_block_num():
+                # legacy-walk attachment: the echo pays the split
+                att_host, segs = split_attachment(attachment)
+        item = (token, 0, b"", resp, att_host, segs,
+                (None, 0, latency_us, server), 0, pass_h)
+        self._respond_item(item)
+
+    # ---- fused dispatch (ISSUE 13) -----------------------------------
+
+    def _batch_request_failed(self, r, e) -> None:
+        """Per-request failure isolation for the fused batch-of-1 fast
+        lane — mirrors the loop's except arm: answer THIS token
+        EINTERNAL and release THIS request's seg custody (a dispose of
+        an already-exited handle is a table-miss no-op)."""
+        log.error("ici batch request failed: %s", e, exc_info=True)
+        try:
+            if r.att_handle:
+                self._lib.brpc_tpu_ici_att_dispose(r.att_handle)
+            else:
+                for j in range(r.nsegs):
+                    sg = r.segs[j]
+                    if sg.is_dev:
+                        _registry.release(sg.key)
+        except Exception:
+            pass
+        try:
+            self._respond_one(r.token, errors.EINTERNAL,
+                              f"{type(e).__name__}: {e}")
+        except Exception:
+            pass
+
+    def _fused_entry(self, mkey: bytes):
+        """Resolve + memoize the per-method dispatch tuple for a raw
+        method key: (full, handler fn, request_cls, response_cls,
+        status).  Everything per-method — name decode, method lookup,
+        codec classes, the limiter handle — resolves ONCE per listener
+        instead of per call.  Services cannot be added after start, so
+        the cache never goes stale; a miss (unknown method) is NOT
+        cached so a typo probe can't grow the table."""
+        full = mkey.decode()
+        md = self._server.find_method(full)
+        if md is None:
+            return None
+        ent = (full, md.fn, md.request_cls, md.response_cls,
+               self._server.method_status(full))
+        self._fcache[mkey] = ent
+        return ent
+
+    def _process_fused(self, r, collector) -> None:
+        """The whole inline request path as ONE flat code object —
+        the fusion of _on_batch's extraction, _process's gates, and
+        _execute's setup/parse/invoke (completion lives in _FusedDone).
+        Semantics mirror the legacy chain exactly; admission-controlled
+        servers delegate to it (the shed/WFQ decision tree is not a
+        hot-path shape).  Custody: every exit point below matches the
+        legacy chain's exactly-one-exit discipline."""
+        server = self._server
+        token = r.token
+        mkey = r.method
+        ent = self._fcache.get(mkey)
+        if ent is None:
+            ent = self._fused_entry(mkey)
+        nsegs = r.nsegs
+        ahl = r.att_host_len
+        attachment = None
+        if nsegs or ahl:
+            ah = r.att_handle
+            if ah:
+                # native custody: the seg list stays PARKED under ah —
+                # the dominant 1-seg shape reads the inline seg0 mirror
+                if nsegs == 1:
+                    total = r.seg0_nbytes
+                    attachment = NativeAttachment(
+                        ah, total, ((r.seg0_key, total, r.seg0_dev),))
+                else:
+                    meta, total = _seg_meta_from_req(r, nsegs)
+                    attachment = NativeAttachment(ah, total, meta)
+            else:
+                att_host = _string_at(r.att_host, ahl) \
+                    if ahl else b""
+                try:
+                    attachment = build_attachment_from_c(
+                        att_host, r.segs, nsegs)
+                except KeyError as e:
+                    self._respond_one(token, errors.EINTERNAL, str(e),
+                                      collector)
+                    return
+        if server._draining:
+            # lame-duck bounce comes BEFORE method resolution, like the
+            # legacy chain
+            if attachment is not None and \
+                    type(attachment) is NativeAttachment:
+                attachment._dispose_native()
+            self._respond_one(token, errors.ELOGOFF,
+                              "server is draining (lame duck)", collector)
+            return
+        if ent is None:
+            if attachment is not None and \
+                    type(attachment) is NativeAttachment:
+                attachment._dispose_native()
+            self._respond_one(token, errors.ENOMETHOD,
+                              f"no method {mkey.decode()}", collector)
+            return
+        full, fn, request_cls, response_cls, status = ent
+        pri_wire = r.priority
+        tb = r.tenant
+        ddl = r.deadline_left_ms
+        # the wire tenant decodes BEFORE any gate or pool acquire: a
+        # malformed (non-UTF-8) tenant must fail in the pre-gate region
+        # — _on_batch's except arm answers EINTERNAL and releases
+        # custody, but cannot roll back a concurrency slot or a pooled
+        # Controller (the legacy chain decoded in _on_batch for the
+        # same reason)
+        if tb:
+            tenant = self._tenant_names.get(tb)
+            if tenant is None:
+                tenant = tb.decode()
+                if len(self._tenant_names) < 1024:
+                    self._tenant_names[tb] = tenant
+        else:
+            tenant = ""
+        payload = _string_at(r.payload, r.payload_len) \
+            if r.payload_len else b""
+        if server.admission is not None:
+            # admission rides the legacy chain (identical decision tree
+            # on all planes); the fused entry still saved the method
+            # resolve — _process re-reads its own mdcache
+            self._process(token, full, payload, attachment, r.log_id,
+                          r.peer_dev, r.recv_ns, collector,
+                          (pri_wire, tenant, ddl))
+            return
+        self.fused_dispatched += 1
+        stages = self._stage_flag.value == "on"
+        if stages:
+            recv_ns = r.recv_ns
+            if recv_ns:
+                q_us = (_time.monotonic_ns() - recv_ns) // 1000
+                self._record_stage("queue", max(q_us, 0), None)
+        if not server.on_request_in():
+            if attachment is not None and \
+                    type(attachment) is NativeAttachment:
+                attachment._dispose_native()
+            self._respond_one(token, errors.ELIMIT,
+                              "server max_concurrency reached", collector)
+            return
+        if status is not None and not status.on_requested():
+            server.on_request_out()
+            if attachment is not None and \
+                    type(attachment) is NativeAttachment:
+                attachment._dispose_native()
+            self._respond_one(token, errors.ELIMIT,
+                              f"{full} concurrency limit", collector)
+            return
+        cntl = self._pool.acquire()
+        d = cntl.__dict__
+        log_id = r.log_id
+        if log_id:
+            d["log_id"] = log_id
+        d["server"] = server
+        peer_dev = r.peer_dev
+        ep = self._peer_eps.get(peer_dev)
+        d["remote_side"] = ep if ep is not None \
+            else self._peer_endpoint(peer_dev)
+        has_meta = False
+        if pri_wire:
+            d["priority"] = pri_wire - 1
+            has_meta = True
+        if tb:
+            d["tenant"] = tenant
+            has_meta = True
+        if ddl:
+            d["deadline_left_ms"] = ddl
+            has_meta = True
+        if attachment is not None:
+            d["request_attachment"] = attachment
+        start_ns = _time.monotonic_ns()
+        try:
+            request = request_cls()
+            request.ParseFromString(payload)
+        except Exception as e:
+            cntl._maybe_recycle()
+            item = (token, errors.EREQUEST,
+                    f"fail to parse request: {e}".encode(), b"", b"", (),
+                    (status, errors.EREQUEST, 0, server), 0, 0)
+            if collector is None or not collector.add(item):
+                self._respond_item(item)
+            return
+        if stages:
+            self._record_stage(
+                "parse", (_time.monotonic_ns() - start_ns) // 1000, None)
+        response = response_cls()
+        fd = _FusedDone(self, token, cntl, response, status, start_ns,
+                        collector, stages)
+        d["_server_done"] = fd       # cntl.send_response() support
+        try:
+            # the context scope installs only when it would matter: the
+            # request carries admission meta, or an OUTER inline context
+            # must be masked for this handler's own outbound calls
+            # (nested in-process dispatch) — the no-meta echo shape pays
+            # zero frames here.  Inlined _reqctx.scope (same
+            # save/install/restore discipline, minus the class frames).
+            prev_ctx = getattr(_reqctx_tls, "ctx", None)
+            if has_meta or prev_ctx is not None:
+                _reqctx_tls.ctx = _reqctx.InboundContext(
+                    d.get("priority"), d.get("tenant", ""), ddl) \
+                    if has_meta else None
+                try:
+                    fn(cntl, request, response, fd)
+                finally:
+                    _reqctx_tls.ctx = prev_ctx
+            else:
+                fn(cntl, request, response, fd)
+        except Exception as e:
+            log.error("ici method %s raised: %s", full, e, exc_info=True)
+            if not fd.called:
+                cntl.set_failed(errors.EINTERNAL,
+                                f"{type(e).__name__}: {e}")
+                fd()
+
     def _process(self, token, full, payload, attachment, log_id, peer_dev,
                  recv_ns, collector, adm_meta=None) -> None:
+        self.legacy_dispatched += 1
         server = self._server
         stage_flag, record_stage = _stage_modules()
         stages = stage_flag.value == "on"
@@ -906,7 +1402,7 @@ class ServerBinding:
         Native custody: the view still parks its seg list in the att
         table — dispose is the exactly-one exit (idempotent; a
         materialized or surrendered view holds no handle)."""
-        if type(attachment) is NativeAttachment:
+        if isinstance(attachment, NativeAttachment):
             attachment._dispose_native()
         return
 
@@ -986,12 +1482,13 @@ class ServerBinding:
             resp_att = cntl._peek_response_attachment()
             pass_h = 0
             if resp_att is not None:
-                if type(resp_att) is NativeAttachment:
+                if isinstance(resp_att, NativeAttachment):
                     # echo pass-through: the UNMATERIALIZED request view
-                    # assigned as the response — hand the parked handle
-                    # straight back to native; zero Python walks on the
-                    # whole response side.  (A materialized view holds
-                    # no handle and falls through to the normal split.)
+                    # assigned as the response — or a ResponseAttachment
+                    # that ADOPTED one via append — hands the parked
+                    # handle straight back to native; zero Python walks
+                    # on the whole response side.  (A materialized view
+                    # holds no handle and falls through to the split.)
                     pass_h = resp_att._surrender_native()
                 if pass_h:
                     att_host, segs = b"", ()
@@ -1082,17 +1579,23 @@ class ServerBinding:
             seg_arr = None
             e.segs = None
             e.nsegs = 0
-        stage_flag, record_stage = _stage_modules()
-        if stage_flag.value == "on":
+        if self._stage_flag.value == "on":
             t0 = _time.monotonic_ns()
             self._lib.brpc_tpu_ici_respond_batch(arr, 1)
-            record_stage("write", (_time.monotonic_ns() - t0) // 1000,
-                         None)
+            self._record_stage("write",
+                               (_time.monotonic_ns() - t0) // 1000, None)
         else:
             self._lib.brpc_tpu_ici_respond_batch(arr, 1)
         del seg_arr, payload, att_host, err_text   # alive across the call
         if post is not None:
-            post()
+            if type(post) is tuple:
+                # fused accounting (no per-RPC closure): see _FusedDone
+                status, perr, lat, server = post
+                if status is not None:
+                    status.on_responded(perr, lat)
+                server.on_request_out()
+            else:
+                post()
 
     def _respond_flush(self, items) -> None:
         """One ``brpc_tpu_ici_respond_batch`` crossing for every packed
@@ -1127,8 +1630,7 @@ class ServerBinding:
                 e.segs = seg_arr
                 e.nsegs = len(segs)
                 keep.append(seg_arr)
-        stage_flag, record_stage = _stage_modules()
-        if stage_flag.value == "on":
+        if self._stage_flag.value == "on":
             t0 = _time.monotonic_ns()
             self._lib.brpc_tpu_ici_respond_batch(arr, n)
             # under batched delivery the write stage is the SHARED flush
@@ -1136,14 +1638,123 @@ class ServerBinding:
             # crossing latency (what the request actually waited)
             w_us = (_time.monotonic_ns() - t0) // 1000
             for _ in range(n):
-                record_stage("write", w_us, None)
+                self._record_stage("write", w_us, None)
         else:
             self._lib.brpc_tpu_ici_respond_batch(arr, n)
         del keep
         for it in items:
             post = it[6]
             if post is not None:
-                post()
+                if type(post) is tuple:
+                    status, perr, lat, server = post
+                    if status is not None:
+                        status.on_responded(perr, lat)
+                    server.on_request_out()
+                else:
+                    post()
+
+
+class _FusedDone:
+    """The fused completion: the legacy chain's done() + post() +
+    wrapped_done() collapsed into one callable object — response
+    encode, attachment custody exit (pass-through / split), the batched
+    write-back, and the pool recycle, with the drain-gate accounting
+    (status.on_responded + server.on_request_out) packed as a TUPLE
+    into the respond item so it still runs AFTER the response crossed
+    back to native (see _process.done's ordering note) without a
+    per-RPC closure.  Idempotent like the legacy done."""
+
+    __slots__ = ("binding", "token", "cntl", "response", "status",
+                 "start_ns", "collector", "stages", "called")
+
+    def __init__(self, binding, token, cntl, response, status, start_ns,
+                 collector, stages):
+        self.binding = binding
+        self.token = token
+        self.cntl = cntl
+        self.response = response
+        self.status = status
+        self.start_ns = start_ns
+        self.collector = collector
+        self.stages = stages
+        self.called = False
+
+    def __call__(self) -> None:
+        if self.called:
+            return
+        self.called = True
+        b = self.binding
+        cntl = self.cntl
+        t_done = _time.monotonic_ns()
+        latency_us = (t_done - self.start_ns) // 1000
+        stages = self.stages
+        if stages:
+            b._record_stage("handler", latency_us, None)
+        d = cntl.__dict__
+        if d.get("_session_data") is not None:
+            cntl._release_session_data()
+        err = cntl.error_code_
+        status = self.status
+        server = b._server
+        if err:
+            text = cntl.error_text_
+            item = (self.token, err,
+                    text.encode() if isinstance(text, str)
+                    else (text or b""), b"", b"", (),
+                    (status, err, latency_us, server), 0, 0)
+        else:
+            resp_att = d.get("response_attachment")
+            pass_h = 0
+            att_host = b""
+            segs = ()
+            if resp_att is not None:
+                if isinstance(resp_att, NativeAttachment) \
+                        and not resp_att._mat:
+                    # echo pass-through (also the adopted append shape,
+                    # ISSUE 13 satellite): hand the parked handle
+                    # straight back — zero Python walks.  Inlined
+                    # _surrender_native.
+                    pass_h = resp_att._h
+                    resp_att._h = 0
+                if not pass_h and resp_att.backing_block_num():
+                    att_host, segs = split_attachment(resp_att)
+            item = (self.token, 0, b"", self.response.SerializeToString(),
+                    att_host, segs, (status, 0, latency_us, server),
+                    0, pass_h)
+            if stages:
+                b._record_stage(
+                    "encode", (_time.monotonic_ns() - t_done) // 1000,
+                    None)
+        coll = self.collector
+        if coll is None or not coll.add(item):
+            b._respond_item(item)
+        # attachment custody exits, inlined (the pool-release hooks
+        # would re-discover them through getattr): a request view whose
+        # handle never exited (handler ignored it) disposes HERE; a
+        # surrendered/adopted/materialized one holds no handle and the
+        # pop makes the pool's own duck-typed sweep a no-op
+        ra = d.pop("request_attachment", None)
+        if ra is not None and isinstance(ra, NativeAttachment):
+            h = ra._h
+            if h:
+                ra._h = 0
+                fns = _att_fns
+                if fns is not None:
+                    fns[1](h)
+        ra = d.pop("response_attachment", None)
+        if ra is not None and isinstance(ra, NativeAttachment):
+            h = ra._h
+            if h:
+                ra._h = 0
+                fns = _att_fns
+                if fns is not None:
+                    fns[1](h)
+        # pool recycle (the wrapped_done tail): safe before the
+        # collector flushes — the item owns its own buffers and the
+        # accounting tuple carries no controller reference
+        pool = d.get("_recycle_pool")
+        if pool is not None:
+            pool.release(cntl)
 
 
 # ---------------------------------------------------------------------
@@ -1153,6 +1764,10 @@ class ServerBinding:
 class ChannelBinding:
     """Client half: one native connection (with its credit window) to the
     in-process native listener at ``remote_dev``."""
+
+    # class-attribute alias: Channel.call_method compares the fused
+    # result against the sentinel without an import frame per call
+    FUSED_FALLTHROUGH = FUSED_FALLTHROUGH
 
     def __init__(self, remote_dev: int, local_dev: Optional[int] = None,
                  window_bytes: int = 0):
@@ -1180,6 +1795,19 @@ class ChannelBinding:
         self._call3 = lib.brpc_tpu_ici_call4 if self._att_custody \
             else lib.brpc_tpu_ici_call3         # bound once: attr-chain
         self._free = lib.brpc_tpu_buf_free      # lookups are per-call
+        # fused client path (ISSUE 13), snapshot at connect like att
+        # custody: Channel.call_method routes sync calls through
+        # call_fused — the preamble/screen/issue/response chain as one
+        # flat code object.  Hot module handles resolve on first call
+        # (the lazy import dance exists only for load-time cycles).
+        self._fused = bool(_flags.get_flag("ici_fused_dispatch"))
+        self._callf = _fused_call_binding(self._att_custody) \
+            if self._fused else None
+        self._hot = None
+        from ..rpc import span as _span_mod
+        self._rpcz_flag = _span_mod._rpcz_flag
+        self._start_span = _span_mod.maybe_start_client_span
+        self._end_span = _span_mod.end_client_span
         h = lib.brpc_tpu_ici_connect(local_dev, remote_dev, window_bytes)
         if h == 0:
             raise ConnectionRefusedError(
@@ -1370,6 +1998,249 @@ class ChannelBinding:
             if out.err_text:
                 free(out.err_text)
                 out.err_text = None
+
+    def call_fused(self, full_name: str, cntl, request: Any,
+                   response_cls, chan):
+        """The fused sync client path (ISSUE 13): Channel.call_method's
+        context/default preamble, the per-call screens, and the whole
+        ``call`` body as ONE flat code object, with the dominant
+        1-device-block attachment shape inlined (no split/fill frames)
+        and the shed-retry / fallback helpers entered ONLY when their
+        error actually occurred.  Must mirror ``call_method`` +
+        ``call`` semantics exactly — the ``ici_fused_dispatch=False``
+        leg A/Bs them.  Returns FUSED_FALLTHROUGH when the call must
+        re-route to the Python plane (frame too large, hedging
+        configured, dead-conn re-route)."""
+        opts = chan.options
+        # ---- cascading inbound context + channel defaults ------------
+        ctx = getattr(_reqctx_tls, "ctx", None)
+        if ctx is not None:
+            if cntl.priority is None and ctx.priority is not None:
+                cntl.priority = ctx.priority
+            if not cntl.tenant and ctx.tenant:
+                cntl.tenant = ctx.tenant
+            residual = ctx.residual_deadline_ms()
+            if residual is not None:
+                if residual <= 0:
+                    cntl.set_failed(
+                        errors.ERPCTIMEDOUT,
+                        "inherited deadline budget spent before call")
+                    if cntl.span is not None:
+                        self._end_span(cntl)
+                    return None
+                base = cntl.timeout_ms if cntl.timeout_ms is not None \
+                    else opts.timeout_ms
+                if base is None or base <= 0 or base > residual:
+                    cntl.timeout_ms = max(int(residual), 1)
+        if cntl.priority is None and opts.priority is not None:
+            cntl.priority = opts.priority
+        if not cntl.tenant and opts.tenant:
+            cntl.tenant = opts.tenant
+        # ---- per-call screens (mirrors _fast_call_fits) --------------
+        if opts.backup_request_ms > 0:
+            return FUSED_FALLTHROUGH
+        req_att = cntl.__dict__.get("request_attachment")
+        if req_att is None:
+            att_len = 0
+        elif type(req_att) is IOBuf:
+            att_len = req_att._size
+        else:
+            att_len = len(req_att)     # lazy views answer w/o inflating
+        try:
+            req_sz = request.ByteSize()
+        except Exception:
+            req_sz = 0
+        if att_len + req_sz + 65536 > self.window_bytes:
+            return FUSED_FALLTHROUGH
+        if cntl.timeout_ms is None:
+            cntl.timeout_ms = opts.timeout_ms
+        if cntl.span is None and self._rpcz_flag.value:
+            self._start_span(cntl, full_name)
+        hot = self._hot
+        if hot is None:
+            hot = self._hot = _hot_modules()
+        _fi, scheduler, _t = hot
+        if _fi._active is not None:
+            # fault injection armed: the legacy body implements the
+            # drop/sever semantics — not a hot shape
+            result = self.call(full_name, cntl, request, response_cls)
+        else:
+            t0 = _time.monotonic_ns()
+            try:
+                req = request.SerializeToString()
+            except AttributeError:
+                req = bytes(request) if request is not None else b""
+            tls = self._tls.__dict__
+            att_host = b""
+            seg_arr = None
+            nseg = 0
+            dev_bytes = 0
+            if req_att is not None and att_len:
+                fast = None
+                if type(req_att) is IOBuf:
+                    refs = req_att._refs
+                    if len(refs) == 1:
+                        ref = refs[0]
+                        blk = ref.block
+                        if (blk.kind == DEVICE and not ref.offset
+                                and ref.length == blk.size):
+                            fast = (blk.data, ref.length)
+                if fast is not None:
+                    # the dominant shape — one whole device block:
+                    # registry put + reused 1-seg array, zero
+                    # split/fill frames; the residence cache hit is
+                    # inlined (a steady workload re-posts the same
+                    # arrays)
+                    arr, nbytes = fast
+                    seg_arr = tls.get("seg1")
+                    if seg_arr is None:
+                        seg_arr = tls["seg1"] = (IciSegC * 1)()
+                    e = seg_arr[0]
+                    e.key = _registry.put(arr)
+                    e.nbytes = nbytes
+                    IM = _IciMesh
+                    hit = _devidx_cache.get(id(arr)) \
+                        if IM is not None else None
+                    if hit is not None and hit[0] == IM.generation:
+                        e.dev = hit[1]
+                    else:
+                        e.dev = _device_index(arr)
+                    e.is_dev = 1
+                    nseg = 1
+                    dev_bytes = nbytes
+                else:
+                    att_host, segs = split_attachment(req_att)
+                    if segs:
+                        seg_arr = fill_seg_array(segs)
+                        nseg = len(segs)
+                        dev_bytes = sum(s[1] for s in segs if s[3])
+            out = tls.get("out")
+            if out is None:
+                out = tls["out"] = IciCallOut()
+                tls["out_ref"] = ctypes.byref(out)
+            out_ref = tls["out_ref"]
+            name_b = self._names.get(full_name)
+            if name_b is None:
+                name_b = self._names[full_name] = full_name.encode()
+            tms = cntl.timeout_ms
+            timeout_us = int(tms * 1000) if tms is not None and tms > 0 \
+                else 0
+            pri_wire = cntl.priority + 1 if cntl.priority is not None \
+                else 0
+            tenant = cntl.tenant
+            if tenant:
+                tenant_b = self._tenants.get(tenant)
+                if tenant_b is None:
+                    tenant_b = self._tenants[tenant] = tenant.encode()
+            else:
+                tenant_b = None
+            # inlined scheduler.in_worker (one thread-local read)
+            blocked = getattr(scheduler._tls, "group", None) is not None
+            if blocked:
+                scheduler.note_worker_blocked()
+            try:
+                rc = self._callf(
+                    self._handle, name_b, req or None, len(req),
+                    att_host or None, len(att_host), seg_arr, nseg,
+                    timeout_us, pri_wire, tenant_b,
+                    int(tms) if tms is not None and tms > 0 else 0,
+                    out_ref)
+            finally:
+                if blocked:
+                    scheduler.note_worker_unblocked()
+            result = None
+            # read each out pointer ONCE into locals: the finally frees
+            # from these instead of re-reading the struct
+            resp_p = out.resp
+            att_p = out.att
+            segs_p0 = out.segs
+            err_p = out.err_text
+            try:
+                cntl.remote_side = self.remote_side
+                nsegs = out.nsegs
+                if rc != 0:
+                    if not self._att_custody:
+                        for i in range(nsegs):
+                            if out.segs[i].is_dev and out.segs[i].key:
+                                _registry.release(out.segs[i].key)
+                    text = _string_at(err_p, -1).decode() \
+                        if err_p else errors.berror(int(rc))
+                    cntl.set_failed(int(rc), text)
+                    if out.retry_after_ms:
+                        cntl.retry_after_ms = int(out.retry_after_ms)
+                else:
+                    payload = _string_at(resp_p, out.resp_len) \
+                        if out.resp_len else b""
+                    if nsegs or out.att_len:
+                        ah = out.att_handle
+                        if ah:
+                            if nsegs == 1:
+                                total = out.seg0_nbytes
+                                meta = ((out.seg0_key, total,
+                                         out.seg0_dev),)
+                            else:
+                                segs_p = out.segs
+                                lst = []
+                                total = 0
+                                for i in range(nsegs):
+                                    s = segs_p[i]
+                                    lst.append((s.key, s.nbytes, s.dev))
+                                    total += s.nbytes
+                                meta = tuple(lst)
+                            rbuf = NativeAttachment(ah, total, meta)
+                        else:
+                            r_att_host = _string_at(
+                                att_p, out.att_len) if out.att_len \
+                                else b""
+                            rbuf = build_attachment_from_c(
+                                r_att_host, out.segs, nsegs)
+                        prev = cntl.__dict__.get("response_attachment")
+                        if prev is None:
+                            cntl.response_attachment = rbuf
+                        else:
+                            prev.append(rbuf)
+                    with _t._ici_stats_lock:
+                        _t._ici_bytes_moved += \
+                            len(req) + len(att_host) + dev_bytes
+                        _t._ici_device_bytes_moved += dev_bytes
+                    cntl.error_code_ = 0
+                    if response_cls is None:
+                        result = payload
+                    else:
+                        response = response_cls()
+                        response.ParseFromString(payload)
+                        cntl.response = response
+                        result = response
+            finally:
+                cntl.latency_us = (_time.monotonic_ns() - t0) // 1000
+                free = self._free
+                if resp_p:
+                    free(resp_p)
+                    out.resp = None
+                if att_p:
+                    free(att_p)
+                    out.att = None
+                if segs_p0:
+                    free(segs_p0)
+                    out.segs = None
+                if err_p:
+                    free(err_p)
+                    out.err_text = None
+        # ---- legacy tail, entered only on the error that needs it ----
+        ec = cntl.error_code_
+        if ec:
+            if ec == errors.ELIMIT and cntl.retry_after_ms > 0:
+                result = chan._native_shed_retry(
+                    self, full_name, cntl, request, response_cls, result)
+                ec = cntl.error_code_
+            if ec == errors.EFAILEDSOCKET or (
+                    ec == errors.EOVERCROWDED
+                    and cntl.error_text_.startswith("frame larger")):
+                if chan._native_ici_fallback(cntl):
+                    return FUSED_FALLTHROUGH
+        if cntl.span is not None:
+            self._end_span(cntl)
+        return result
 
 
 def native_ici_echo_p50_us(iters: int = 3000, payload: int = 128,

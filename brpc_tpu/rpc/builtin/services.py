@@ -115,6 +115,13 @@ def _status(server, q):
         # the overload-survival block: queue depth, shed-by-reason per
         # (tenant, band), observed service rate, current retry hint
         out["admission"] = adm.describe()
+    pool = getattr(server, "usercode_pool", None)
+    if pool is not None and hasattr(pool, "describe"):
+        # the usercode pool block (ROADMAP 4c): isolation capability
+        # (probed once — mode/functional/scaling + the reason when a
+        # host can't scale), worker counts, and the share-nothing
+        # contract/death counters
+        out["usercode_pool"] = pool.describe()
     return "application/json", json.dumps(out, indent=1)
 
 

@@ -159,6 +159,23 @@ class Channel:
                     request: Any, response_cls: Any = None,
                     done: Optional[Callable[[Controller], None]] = None):
         """Sync when done is None (returns the response); async otherwise."""
+        # fused native fast path (ISSUE 13): a cached in-process ici
+        # binding bound with ici_fused_dispatch serves sync calls
+        # through ONE flat code object (context inherit, screens, issue,
+        # response, error tails all inside call_fused).  Anything it
+        # can't serve — oversize frames, hedging, a dead conn's one-shot
+        # re-route — returns the FALLTHROUGH sentinel and the unfused
+        # body below handles it exactly as before.
+        nch0 = self._native_ici
+        if (nch0 is not None and done is None and nch0._fused
+                and cntl.stream_creator is None):
+            result = nch0.call_fused(method_full_name, cntl, request,
+                                     response_cls, self)
+            if result is not nch0.FUSED_FALLTHROUGH:
+                return result
+            skip_native = True     # the fused leg already decided the
+        else:                      # re-route; don't re-enter the native
+            skip_native = False    # block below
         # cascading inbound context (rpc/request_context.py): a call made
         # inside a handler's scope inherits the inbound priority/tenant
         # unless THIS call overrides them, and its timeout is capped at
@@ -200,9 +217,10 @@ class Channel:
         # and frames too large for the native send window ride the Python
         # plane (which drains big payloads chunkwise through its credit
         # window).
-        nch = self._native_ici
+        nch = None if skip_native else self._native_ici
         if nch is None:
-            nch = self._native_ici_binding(cntl)
+            if not skip_native:
+                nch = self._native_ici_binding(cntl)
         elif cntl.stream_creator is not None:
             # the cached-binding fast path must re-screen the ONE
             # eligibility input that varies per call; the channel-level

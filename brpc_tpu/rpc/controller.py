@@ -83,6 +83,11 @@ class Controller:
     fanout_result: Any = None
     fanout_route: str = ""
     request_attachment = _LazyField("request_attachment", IOBuf)
+    # the response factory is swapped to ici/native_plane.py's
+    # ResponseAttachment once that module loads (ISSUE 13): identical
+    # to a plain IOBuf except that appending a whole, untouched
+    # NativeAttachment view into it while empty ADOPTS the parked
+    # native handle (the PR-8 echo idiom stops materializing)
     response_attachment = _LazyField("response_attachment", IOBuf)
     remote_side: Optional[EndPoint] = None
     local_side: Optional[EndPoint] = None

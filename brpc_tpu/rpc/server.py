@@ -54,6 +54,15 @@ class ServerOptions:
     # parse/dispatch and stay available no matter what usercode does.
     usercode_in_pthread: bool = False
     usercode_backup_threads: int = 8
+    # Isolation backend for the backup pool (rpc/usercode_pool.py,
+    # ROADMAP 4c): "auto" uses subinterpreter workers when the
+    # interpreter supports them (free-threading builds scale on plain
+    # threads), "pthread" pins the plain backup pool (byte-identical to
+    # the pre-pool behavior), "subinterp" requires isolation and raises
+    # when unavailable.  Only REGISTERED isolated handlers
+    # (Server.register_isolated) run isolated; regular handlers always
+    # use the backup threads.
+    usercode_pool_kind: str = "auto"
     ssl_context: Any = None             # ssl.SSLContext for TLS listeners
     # per-RPC session data: factory() -> object, pooled across requests
     # (reference server.h:146-150 session_local_data_factory; reached via
@@ -119,11 +128,38 @@ class Server:
         self._session_data_lock = threading.Lock()
         self._thread_local = threading.local()
         self.usercode_pool = None        # usercode_in_pthread backup pool
+        self._isolated: Dict[str, tuple] = {}   # full -> (src, att_mode)
         self.admission = None            # AdmissionController when enabled
         self._collective_regs: List[str] = []   # register_collective names
         self._collective_served: List[int] = []  # devices marked serving
 
     # ---- registry -----------------------------------------------------
+    def register_isolated(self, method_full_name: str, src: str,
+                          att: str = "echo") -> None:
+        """Register a method served by the ISOLATED usercode pool
+        (rpc/usercode_pool.py): ``src`` is handler SOURCE defining
+        ``handle(payload: bytes) -> bytes`` — the request payload
+        crosses as bytes, the return value is the serialized response
+        payload, and nothing else crosses (the share-nothing contract;
+        the pool refuses anything else with a TypeError).  ``att``
+        says what happens to a parked request-attachment handle:
+        "echo" passes it through to the response (the zero-copy
+        shape), "drop" disposes it.  Requires
+        ``usercode_in_pthread=True``; without isolation support the
+        handler still runs (on the backup threads — the capability
+        fallback), just without GIL-free scaling."""
+        if att not in ("echo", "drop"):
+            raise ValueError(f"unknown isolated att mode {att!r}")
+        if self._started and not self.options.usercode_in_pthread:
+            # without the pool the method has no dispatch route at all:
+            # callers would get a misleading ENOMETHOD
+            raise ValueError(
+                "register_isolated requires usercode_in_pthread=True "
+                "(isolated methods dispatch through the usercode pool)")
+        self._isolated[method_full_name] = (src, att)
+        if self.usercode_pool is not None:
+            self.usercode_pool.register(method_full_name, src)
+
     def register_collective(self, method_full_name: str, handler,
                             merge: str = "gather", mapping: str = "shard",
                             takes_index: bool = False) -> None:
@@ -322,13 +358,22 @@ class Server:
         self._stopped = threading.Event()
         self._draining = False
         self._listen_endpoints = []     # fresh run, fresh addresses
+        if self._isolated and not self.options.usercode_in_pthread:
+            # isolated methods only have a dispatch route through the
+            # usercode pool; starting without it would answer them
+            # with a misleading ENOMETHOD
+            raise ValueError(
+                "register_isolated requires usercode_in_pthread=True "
+                "(isolated methods dispatch through the usercode pool)")
         with self._conn_lock:
             self._connections = []
         if self.options.usercode_in_pthread and self.usercode_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            self.usercode_pool = ThreadPoolExecutor(
-                max_workers=max(self.options.usercode_backup_threads, 1),
-                thread_name_prefix="usercode")
+            from .usercode_pool import UsercodePool
+            self.usercode_pool = UsercodePool(
+                kind=self.options.usercode_pool_kind,
+                workers=max(self.options.usercode_backup_threads, 1))
+            for full, (src, _att) in self._isolated.items():
+                self.usercode_pool.register(full, src)
         if self.options.admission:
             from .admission import AdmissionController, AdmissionOptions
             if self.admission is None:

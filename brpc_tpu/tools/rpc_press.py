@@ -107,6 +107,63 @@ def apply_bulk_plane(mode: str) -> None:
         _fl.set_flag("ici_fabric_bulk", False)
 
 
+USERCODE_POOLS = ("auto", "pthread", "subinterp", "off")
+
+
+def apply_usercode_pool(mode: str) -> None:
+    """Pin the usercode-pool backend for servers hosted IN THIS process
+    (mem:// targets, self-hosted ici:// members): "auto" keeps each
+    server's configured resolution, "pthread"/"subinterp" override the
+    default backend before those servers start, "off" just records the
+    pin (a load generator cannot un-pool a remote server).  The summary
+    reports the probed isolation capability either way, plus per-server
+    pool stats for every in-process server that carries a pool."""
+    if mode not in USERCODE_POOLS:
+        raise SystemExit(f"rpc_press: unknown --usercode-pool {mode!r} "
+                         f"(choose from {', '.join(USERCODE_POOLS)})")
+    if mode in ("pthread", "subinterp"):
+        from brpc_tpu.rpc import usercode_pool as _up
+        try:
+            _up.set_default_kind(mode)
+        except ValueError as e:
+            raise SystemExit(f"rpc_press: {e}")
+
+
+def collect_usercode_pool_stats() -> dict:
+    """The summary's pool block: the process isolation capability
+    (probe record incl. the no-scaling reason) + describe() of every
+    in-process server's pool (loopback registry + native ici
+    bindings)."""
+    from brpc_tpu.rpc.usercode_pool import probe_isolation
+    out: dict = {"isolation": probe_isolation()._asdict(), "servers": {}}
+    seen = set()
+    try:
+        from brpc_tpu.rpc import loopback
+        with loopback._servers_lock:
+            servers = list(loopback._servers.items())
+        for name, srv in servers:
+            pool = getattr(srv, "usercode_pool", None)
+            if pool is not None and hasattr(pool, "describe") \
+                    and id(srv) not in seen:
+                seen.add(id(srv))
+                out["servers"][f"mem://{name}"] = pool.describe()
+    except Exception:
+        pass
+    try:
+        from brpc_tpu.ici import native_plane
+        with native_plane._server_bindings_lock:
+            bindings = list(native_plane._server_bindings.items())
+        for dev, b in bindings:
+            pool = getattr(b._server, "usercode_pool", None)
+            if pool is not None and hasattr(pool, "describe") \
+                    and id(b._server) not in seen:
+                seen.add(id(b._server))
+                out["servers"][f"ici://{dev}"] = pool.describe()
+    except Exception:
+        pass
+    return out
+
+
 def run_press_fanout(server: str, method: str, n: int,
                      duration: float = 5.0, concurrency: int = 2,
                      shard_bytes: int = 512, out=sys.stderr) -> dict:
@@ -223,6 +280,7 @@ def run_press(server: str, method: str, request_json: str,
               priority: Optional[str] = None, tenant: Optional[str] = None,
               max_retry: Optional[int] = None,
               bulk_plane: str = "auto", shm_stripes: int = 0,
+              usercode_pool: str = "auto",
               out=sys.stderr) -> dict:
     import brpc_tpu.policy  # noqa: F401 — registers protocols
     from brpc_tpu import rpc, bvar
@@ -230,6 +288,7 @@ def run_press(server: str, method: str, request_json: str,
     from brpc_tpu.rpc import errors as rpc_errors
     apply_bulk_plane(bulk_plane)
     apply_shm_stripes(shm_stripes)
+    apply_usercode_pool(usercode_pool)
 
     if proto:
         req_cls, resp_cls = _load_classes(proto)
@@ -355,7 +414,14 @@ def run_press(server: str, method: str, request_json: str,
         "interrupted": stop_evt.is_set(),
         "bulk_plane": bulk_plane,
         "shm_stripes": shm_stripes,
+        "usercode_pool": usercode_pool,
     }
+    # isolation capability + per-in-process-server pool stats (ROADMAP
+    # 4c): a SKIPping host records WHY it cannot scale
+    try:
+        result["usercode_pool_stats"] = collect_usercode_pool_stats()
+    except Exception:
+        pass
     # which byte mover actually carried the run's payloads (ici/route.py
     # counters; empty off the fabric) — the "chosen route" in the summary
     try:
@@ -406,6 +472,13 @@ def main(argv=None) -> int:
                          "(route table: shm > uds/tcp > inline), shm, "
                          "uds (shm off), inline (both descriptor planes "
                          "off); the summary reports per-route counters")
+    ap.add_argument("--usercode-pool", default="auto",
+                    choices=USERCODE_POOLS,
+                    help="pin the usercode-pool backend for servers "
+                         "hosted in this process (auto keeps each "
+                         "server's resolution; off records the pin); "
+                         "the summary reports the probed isolation "
+                         "capability and per-server pool stats")
     ap.add_argument("--shm-stripes", type=int, default=0,
                     help="force N shm ring stripes per segment (0 = "
                          "auto: 1 on 1-core hosts, else min(4, cores)); "
@@ -431,7 +504,8 @@ def main(argv=None) -> int:
               args.duration, args.concurrency, args.proto, args.protocol,
               priority=args.priority, tenant=args.tenant,
               max_retry=args.max_retry, bulk_plane=args.bulk_plane,
-              shm_stripes=args.shm_stripes, out=sys.stdout)
+              shm_stripes=args.shm_stripes,
+              usercode_pool=args.usercode_pool, out=sys.stdout)
     return 0
 
 

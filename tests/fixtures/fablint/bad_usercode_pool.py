@@ -1,0 +1,30 @@
+"""Seeded violation for the usercode pool's worker table (ISSUE 13): a
+pool-like class that swaps its isolation worker list outside the pool
+lock — the exact shape of UsercodePool._iso_workers, which must move
+ATOMICALLY with the shutdown flag (a death-handler replacing a worker
+while shutdown clears the table would resurrect a worker the sentinel
+loop will never stop)."""
+import threading
+
+
+class IsoPool:
+    _GUARDED_BY = {"_iso_workers": "_lock", "_shutdown_flag": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._iso_workers = []
+        self._shutdown_flag = False
+
+    def replace_locked(self, dead, fresh) -> None:
+        with self._lock:
+            self._iso_workers.remove(dead)
+            self._iso_workers.append(fresh)
+
+    def shutdown_racy(self) -> None:
+        with self._lock:
+            self._shutdown_flag = True
+        self._iso_workers = []         # line 26: the violation
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._iso_workers), self._shutdown_flag

@@ -108,6 +108,18 @@ class TestFixtureViolations:
         assert "_programs" in out[0].message and "_lock" in out[0].message
         assert out[0].path.endswith("bad_collective_cache.py")
 
+    def test_unguarded_worker_table_swap_reported_with_line(self):
+        """The usercode pool's worker table (ISSUE 13): clearing
+        _iso_workers outside the pool lock is caught at the exact
+        file:line — the table must move atomically with the shutdown
+        flag or a death-handler resurrects a worker the sentinel loop
+        never stops."""
+        out = _findings("bad_usercode_pool.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 26)]
+        assert "_iso_workers" in out[0].message \
+            and "_lock" in out[0].message
+        assert out[0].path.endswith("bad_usercode_pool.py")
+
     def test_clean_fixture_is_silent(self):
         out = _findings(
             "clean_module.py",

@@ -167,6 +167,45 @@ class TestRpcPress:
             for k, v in saved.items():
                 _fl.set_flag(k, v)
 
+    def test_press_usercode_pool_pin_and_stats(self):
+        """--usercode-pool pins the backend for in-process servers and
+        the summary carries the isolation capability record + the
+        server's pool stats (ISSUE 13)."""
+        from brpc_tpu.rpc import usercode_pool as up
+        from brpc_tpu.tools.rpc_press import (apply_usercode_pool,
+                                              run_press)
+        # pin BEFORE the server starts: the backend resolves when the
+        # pool is created (the press re-applies the same pin)
+        apply_usercode_pool("pthread")
+        server = rpc.Server(rpc.ServerOptions(usercode_in_pthread=True,
+                                              usercode_backup_threads=2))
+        server.add_service(EchoService())
+        name = unique()
+        assert server.start(f"mem://{name}") == 0
+        try:
+            result = run_press(
+                f"mem://{name}", "EchoService.Echo", '{"message":"p"}',
+                qps=0, duration=0.3, concurrency=2,
+                proto="tests.echo_pb2:EchoRequest,EchoResponse",
+                usercode_pool="pthread", out=io.StringIO())
+            assert result["usercode_pool"] == "pthread"
+            stats = result["usercode_pool_stats"]
+            caps = up.probe_isolation()
+            assert stats["isolation"]["mode"] == caps.mode
+            if not caps.scaling:
+                assert stats["isolation"]["reason"]
+            blk = stats["servers"][f"mem://{name}"]
+            assert blk["kind"] in ("pthread", "subinterp")
+            assert blk["workers"] == 2
+            # the pin applied to this (auto-configured) server
+            assert blk["kind"] == "pthread"
+        finally:
+            server.stop()
+            up.set_default_kind("auto")
+        import pytest as _pytest
+        with _pytest.raises(SystemExit):
+            apply_usercode_pool("bogus")
+
     def test_resolve_targets(self):
         """Endpoint lists split (single endpoints pass through); naming
         urls resolve through the naming service; an empty resolution is

@@ -129,3 +129,489 @@ def test_usercode_pool_lifecycle_and_results():
     finally:
         srv.stop()
     assert srv.usercode_pool is None
+
+
+# ---------------------------------------------------------------------
+# ISSUE 13 (ROADMAP 4c): the free-threading/subinterpreter pool behind
+# the same seam.  The plain surface above stays byte-identical; these
+# cover the isolation backend: probe/capability fallback, the
+# share-nothing contract, per-worker registration, worker-death chaos,
+# and the native-plane isolated dispatch end to end.
+# ---------------------------------------------------------------------
+
+from brpc_tpu.rpc.usercode_pool import (IsolationCaps, UsercodePool,  # noqa: E402
+                                        probe_isolation)
+
+
+class TestIsolationProbe:
+    def test_probe_is_cached_and_shaped(self):
+        caps = probe_isolation()
+        assert caps is probe_isolation()        # once per process
+        assert caps.mode in ("free-threading", "subinterp",
+                             "subinterp-shared-gil", "none")
+        if not caps.scaling:
+            assert caps.reason, "a non-scaling probe must say why"
+
+    def test_pool_kind_resolution(self):
+        caps = probe_isolation()
+        p = UsercodePool(kind="auto", workers=1)
+        try:
+            if caps.mode == "free-threading":
+                # plain threads already scale: the backup pool IS the
+                # scaling backend
+                assert p.kind == "pthread"
+            elif caps.functional:
+                assert p.kind == "subinterp"
+            else:
+                assert p.kind == "pthread"
+        finally:
+            p.shutdown()
+        with pytest.raises(ValueError):
+            UsercodePool(kind="nope")
+
+
+class TestShareNothingContract:
+    def test_non_bytes_payload_refused(self):
+        p = UsercodePool(kind="pthread", workers=1)
+        try:
+            p.register("M.h", "def handle(payload):\n    return payload\n")
+            with pytest.raises(TypeError, match="share-nothing"):
+                p.call_isolated("M.h", {"an": "object"})
+            with pytest.raises(TypeError, match="share-nothing"):
+                p.call_isolated("M.h", object())
+            assert p.contract_rejections == 2
+            # bytes-like all cross
+            assert p.call_isolated("M.h", b"x") == b"x"
+            assert p.call_isolated("M.h", bytearray(b"y")) == b"y"
+            assert p.call_isolated("M.h", memoryview(b"z")) == b"z"
+        finally:
+            p.shutdown()
+
+    def test_non_source_registration_refused(self):
+        p = UsercodePool(kind="pthread", workers=1)
+        try:
+            with pytest.raises(TypeError, match="share-nothing"):
+                p.register("M.h", lambda payload: payload)
+        finally:
+            p.shutdown()
+
+
+class TestIsolationBackend:
+    def test_isolated_call_roundtrip(self):
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        p = UsercodePool(kind="subinterp", workers=2)
+        try:
+            p.register("M.h",
+                       "def handle(payload):\n    return b'ok:' + payload\n")
+            assert p.call_isolated("M.h", b"abc") == b"ok:abc"
+            assert p.isolation_active
+            d = p.describe()
+            assert d["isolation_workers"] == 2
+            assert d["registered_isolated"] == ["M.h"]
+        finally:
+            p.shutdown()
+
+    def test_handler_error_surfaces_not_worker_death(self):
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        p = UsercodePool(kind="subinterp", workers=1)
+        try:
+            p.register("M.boom",
+                       "def handle(payload):\n"
+                       "    raise ValueError('boom')\n")
+            with pytest.raises(RuntimeError, match="boom"):
+                p.call_isolated("M.boom", b"x")
+            assert p.worker_deaths == 0
+            # the worker survived: a later call still works
+            p.register("M.ok", "def handle(payload):\n    return payload\n")
+            assert p.call_isolated("M.ok", b"y") == b"y"
+        finally:
+            p.shutdown()
+
+    def test_worker_death_requeues_with_zero_visible_failures(self):
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        p = UsercodePool(kind="subinterp", workers=2)
+        try:
+            p.register("M.h", "def handle(payload):\n    return payload\n")
+            assert p.call_isolated("M.h", b"warm") == b"warm"
+            p.chaos_kill_next = True
+            assert p.call_isolated("M.h", b"survives") == b"survives"
+            assert p.worker_deaths == 1
+            assert p.requeues == 1
+            # the replacement keeps the pool at strength
+            assert p.describe()["isolation_workers"] == 2
+        finally:
+            p.shutdown()
+
+    def test_capability_fallback_runs_same_source(self):
+        """kind='pthread' executes the registered SOURCE on the backup
+        thread — functional parity when isolation is unsupported."""
+        p = UsercodePool(kind="pthread", workers=1)
+        try:
+            assert not p.isolation_active
+            p.register("M.h",
+                       "def handle(payload):\n    return b'fb:' + payload\n")
+            assert p.call_isolated("M.h", b"x") == b"fb:x"
+        finally:
+            p.shutdown()
+
+
+class TestIsolatedRpcDispatch:
+    """End to end over the native-ici plane: Server.register_isolated
+    routes the method's payload bytes to a pool worker; the parked
+    attachment handle passes through to the response (the zero-copy
+    echo shape); a worker dying mid-RPC is invisible to the client."""
+
+    ISO_SRC = """
+import sys
+sys.path.insert(0, %r)
+from echo_pb2 import EchoRequest, EchoResponse
+def handle(payload):
+    req = EchoRequest(); req.ParseFromString(payload)
+    resp = EchoResponse(); resp.message = "iso:" + req.message
+    return resp.SerializeToString()
+""" % __file__.rsplit("/", 1)[0]
+
+    def _mesh(self):
+        import jax
+        from brpc_tpu import ici
+        m = ici.IciMesh(jax.devices())
+        ici.IciMesh.set_default(m)
+        return m
+
+    def _serve(self, dev=5):
+        from brpc_tpu.ici import native_plane
+        if not native_plane.available():
+            pytest.skip("native core unavailable")
+        mesh = self._mesh()
+        srv = rpc.Server(rpc.ServerOptions(usercode_in_pthread=True,
+                                           usercode_backup_threads=2))
+        srv.register_isolated("IsoService.Echo", self.ISO_SRC)
+        assert srv.start(f"ici://{dev}") == 0
+        ch = rpc.Channel()
+        ch.init(f"ici://{dev}",
+                options=rpc.ChannelOptions(timeout_ms=20000, max_retry=0,
+                                           ici_local_device=dev))
+        return mesh, srv, ch
+
+    def test_isolated_method_end_to_end(self):
+        import jax
+        import jax.numpy as jnp
+        from brpc_tpu.ici import native_plane
+        mesh, srv, ch = self._serve()
+        try:
+            payload = jax.device_put(jnp.arange(256, dtype=jnp.uint8),
+                                     mesh.device(5))
+            jax.block_until_ready(payload)
+            for i in range(4):
+                cntl = rpc.Controller()
+                cntl.request_attachment.append_device_array(payload)
+                resp = ch.call_method("IsoService.Echo", cntl,
+                                      EchoRequest(message=f"m{i}"),
+                                      EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert resp.message == f"iso:m{i}"
+                # attachment handle passed through (the echo shape)
+                assert len(cntl.response_attachment) == 256
+            del cntl, resp
+            import gc
+            gc.collect()
+            assert native_plane.registry().live() == 0
+            assert native_plane.att_table_live() == 0
+        finally:
+            srv.stop()
+
+    def test_worker_death_mid_rpc_invisible_to_client(self):
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        mesh, srv, ch = self._serve(dev=6)
+        try:
+            cntl = rpc.Controller()
+            resp = ch.call_method("IsoService.Echo", cntl,
+                                  EchoRequest(message="warm"),
+                                  EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            srv.usercode_pool.chaos_kill_next = True
+            cntl = rpc.Controller()
+            resp = ch.call_method("IsoService.Echo", cntl,
+                                  EchoRequest(message="chaos"),
+                                  EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "iso:chaos"
+            assert srv.usercode_pool.worker_deaths == 1
+        finally:
+            srv.stop()
+
+    def test_status_page_records_capability(self):
+        srv = rpc.Server(rpc.ServerOptions(usercode_in_pthread=True,
+                                           usercode_backup_threads=1))
+        srv.add_service(EchoService())
+        target = f"mem://{unique('caps')}"
+        assert srv.start(target) == 0
+        try:
+            import json
+            from brpc_tpu.rpc.builtin.services import _status
+            _ctype, body = _status(srv, {})
+            block = json.loads(body)["usercode_pool"]
+            caps = probe_isolation()
+            assert block["isolation"]["mode"] == caps.mode
+            assert block["isolation"]["scaling"] == caps.scaling
+            if not caps.scaling:
+                assert block["isolation"]["reason"]
+        finally:
+            srv.stop()
+
+    def test_drain_semantics_preserved_with_new_pool(self):
+        """The queued-counter / drain-bounce discipline is unchanged:
+        a draining server bounces isolated methods with retryable
+        ELOGOFF like any other."""
+        mesh, srv, ch = self._serve(dev=7)
+        try:
+            cntl = rpc.Controller()
+            ch.call_method("IsoService.Echo", cntl,
+                           EchoRequest(message="ok"), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            srv._draining = True
+            cntl = rpc.Controller()
+            ch.call_method("IsoService.Echo", cntl,
+                           EchoRequest(message="x"), EchoResponse)
+            assert cntl.error_code == rpc.errors.ELOGOFF
+        finally:
+            srv._draining = False
+            srv.stop()
+
+
+class TestReviewFixes:
+    """Regression pins for the PR-13 review findings."""
+
+    def test_process_exit_after_shutdown_does_not_abort(self):
+        """shutdown() joins the isolation workers so their
+        subinterpreters are destroyed BEFORE process finalization — a
+        live subinterpreter at exit is a hard CPython abort
+        ('PyInterpreterState_Delete: remaining subinterpreters')."""
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        import subprocess
+        import sys as _sys
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from brpc_tpu.rpc.usercode_pool import UsercodePool\n"
+            "p = UsercodePool(kind='subinterp', workers=2)\n"
+            "p.register('M.h', 'def handle(payload):\\n    return payload\\n')\n"
+            "assert p.call_isolated('M.h', b'x') == b'x'\n"
+            "p.shutdown()\n"
+            "print('CLEAN')\n"
+        ) % __file__.rsplit("/", 2)[0]
+        r = subprocess.run([_sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, (r.returncode, r.stderr[-500:])
+        assert "CLEAN" in r.stdout
+
+    def test_call_isolated_after_shutdown_fails_fast(self):
+        p = UsercodePool(kind="pthread", workers=1)
+        p.register("M.h", "def handle(payload):\n    return payload\n")
+        p.shutdown()
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="stopped"):
+            p.call_isolated("M.h", b"x")
+        assert time.monotonic() - t0 < 1.0, "caller parked on a dead pool"
+
+    def test_fallback_namespace_cached_across_calls(self):
+        """The pthread fallback compiles the handler source once per
+        registration, not once per call."""
+        p = UsercodePool(kind="pthread", workers=1)
+        try:
+            p.register("M.h",
+                       "import itertools\n"
+                       "_c = itertools.count()\n"
+                       "def handle(payload):\n"
+                       "    return str(next(_c)).encode()\n")
+            # module-level state persists across calls = one exec
+            assert p.call_isolated("M.h", b"") == b"0"
+            assert p.call_isolated("M.h", b"") == b"1"
+            # re-registration recompiles
+            p.register("M.h", "def handle(payload):\n    return b'v2'\n")
+            assert p.call_isolated("M.h", b"") == b"v2"
+        finally:
+            p.shutdown()
+
+    def test_isolated_method_rides_admission(self):
+        """An admission-enabled server runs isolated methods through
+        the SAME decision tree as every other plane (the review found
+        them bypassing it): the admission counters move."""
+        from brpc_tpu.ici import native_plane
+        if not native_plane.available():
+            pytest.skip("native core unavailable")
+        import jax
+        from brpc_tpu import ici
+        m = ici.IciMesh(jax.devices())
+        ici.IciMesh.set_default(m)
+        src = ("def handle(payload):\n"
+               "    return b''\n")
+        srv = rpc.Server(rpc.ServerOptions(usercode_in_pthread=True,
+                                           usercode_backup_threads=2,
+                                           admission=True))
+        srv.register_isolated("Iso.Adm", src, att="drop")
+        assert srv.start("ici://4") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://4",
+                    options=rpc.ChannelOptions(timeout_ms=20000,
+                                               max_retry=0,
+                                               ici_local_device=4))
+            before = srv.admission.describe()["admitted"]
+            cntl = rpc.Controller()
+            ch.call_method("Iso.Adm", cntl,
+                           EchoRequest(message="a"), None)
+            assert not cntl.failed(), cntl.error_text
+            after = srv.admission.describe()["admitted"]
+            assert after == before + 1, (before, after)
+        finally:
+            srv.stop()
+
+    def test_reregistration_reaches_subinterp_workers(self):
+        """Re-registering a handler recompiles on the SUBINTERP backend
+        too (the per-worker memoization is version-keyed, review
+        finding): both backends serve the new source."""
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        p = UsercodePool(kind="subinterp", workers=1)
+        try:
+            p.register("M.h", "def handle(payload):\n    return b'v1'\n")
+            assert p.call_isolated("M.h", b"") == b"v1"
+            p.register("M.h", "def handle(payload):\n    return b'v2'\n")
+            assert p.call_isolated("M.h", b"") == b"v2"
+        finally:
+            p.shutdown()
+
+    def test_shutdown_sweeps_stranded_tasks(self):
+        """A task enqueued just before shutdown (racing the sentinels)
+        is failed by the leftover sweep, not parked to its timeout."""
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        # NO registration → no workers spawned: a task planted in the
+        # queue is exactly the lost-race shape (enqueued with nobody
+        # left to drain it) and only the shutdown sweep can answer it
+        p = UsercodePool(kind="subinterp", workers=1)
+        from brpc_tpu.rpc.usercode_pool import _IsoTask
+        stale = _IsoTask("M.h", b"y")
+        p._iso_queue.put(stale)
+        t0 = time.monotonic()
+        p.shutdown()
+        assert stale.event.wait(5), "stranded task never answered"
+        assert stale.error == "usercode pool stopped"
+        assert time.monotonic() - t0 < 6.0
+
+    def test_register_isolated_requires_pool(self):
+        """Starting a server with isolated methods but no usercode pool
+        is a configuration error, not a latent ENOMETHOD."""
+        srv = rpc.Server()     # usercode_in_pthread defaults False
+        srv.register_isolated("M.h", "def handle(p):\n    return p\n")
+        with pytest.raises(ValueError, match="usercode_in_pthread"):
+            srv.start(f"mem://{unique('iso-misconfig')}")
+
+    def test_isolated_deadline_maps_to_rpc_timeout(self):
+        """A spent deadline waiting on the isolation worker reports
+        ERPCTIMEDOUT like every other plane, and the abandoned task
+        does not burn a worker later."""
+        from brpc_tpu.ici import native_plane
+        if not native_plane.available():
+            pytest.skip("native core unavailable")
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        import jax
+        from brpc_tpu import ici
+        m = ici.IciMesh(jax.devices())
+        ici.IciMesh.set_default(m)
+        # ONE worker, wedged by a slow handler; the probe call then
+        # waits out its own (short) deadline behind it
+        slow = ("import time\n"
+                "def handle(payload):\n"
+                "    time.sleep(0.8 if payload == b'' else 0)\n"
+                "    return payload\n")
+        srv = rpc.Server(rpc.ServerOptions(usercode_in_pthread=True,
+                                           usercode_backup_threads=2))
+        srv.register_isolated("Iso.Slow", slow, att="drop")
+        assert srv.start("ici://3") == 0
+        try:
+            ch = rpc.Channel()
+            ch.init("ici://3",
+                    options=rpc.ChannelOptions(timeout_ms=10000,
+                                               max_retry=0,
+                                               ici_local_device=3))
+            # force the single isolation worker: shrink after spawn
+            pool = srv.usercode_pool
+            cntl0 = rpc.Controller()
+            ch.call_method("Iso.Slow", cntl0,
+                           EchoRequest(message="warm"), None)
+            assert not cntl0.failed(), cntl0.error_text
+            # retire all but one isolation worker (each sentinel ends
+            # exactly one), so the wedge below is exclusive
+            for _ in range(len(pool._iso_workers) - 1):
+                pool._iso_queue.put(None)
+            time.sleep(0.1)
+            # wedge: an async empty-payload call sleeps 0.8s on the
+            # remaining worker
+            wedge = rpc.Controller()
+            wedge_done = threading.Event()
+            ch.call_method("Iso.Slow", wedge, b"", None,
+                           done=lambda c: wedge_done.set())
+            time.sleep(0.05)
+            cntl = rpc.Controller()
+            cntl.timeout_ms = 200
+            ch.call_method("Iso.Slow", cntl,
+                           EchoRequest(message="x"), None)
+            # the client's native deadline and the server's pool-wait
+            # deadline carry the same 200 ms budget and race; BOTH
+            # sides now report the timeout code (pre-fix the server
+            # side answered EINTERNAL)
+            assert cntl.error_code == rpc.errors.ERPCTIMEDOUT, \
+                (cntl.error_code, cntl.error_text)
+            assert wedge_done.wait(10), "wedge call never completed"
+        finally:
+            srv.stop()
+
+    def test_abandoned_task_not_executed_after_timeout(self):
+        """A call that timed out waiting marks its task abandoned; a
+        worker that later dequeues it drops it instead of burning a
+        slot on an unread result."""
+        caps = probe_isolation()
+        if not caps.functional:
+            pytest.skip(f"no isolation support: {caps.reason}")
+        p = UsercodePool(kind="subinterp", workers=1)
+        try:
+            p.register(
+                "M.count",
+                "import time\n"
+                "_n = [0]\n"
+                "def handle(payload):\n"
+                "    if payload == b'slow':\n"
+                "        time.sleep(0.5)\n"
+                "    elif payload == b'count':\n"
+                "        return str(_n[0]).encode()\n"
+                "    _n[0] += 1\n"
+                "    return b'ok'\n")
+            assert p.call_isolated("M.count", b"x") == b"ok"   # _n=1
+            import threading as _th
+            wedge = _th.Thread(
+                target=lambda: p.call_isolated("M.count", b"slow"))
+            wedge.start()
+            time.sleep(0.05)
+            with pytest.raises(TimeoutError):
+                p.call_isolated("M.count", b"y", timeout=0.1)  # abandoned
+            wedge.join(5)
+            # the abandoned b'y' task must have been DROPPED: the
+            # counter saw only x and slow (2), never y
+            assert p.call_isolated("M.count", b"count") == b"2"
+        finally:
+            p.shutdown()
