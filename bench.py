@@ -1864,6 +1864,398 @@ def bench_pod_prefill_decode(timeout_s: int = 300) -> dict:
     return {}
 
 
+def bench_serving_soak(soak_s: float = 12.0) -> dict:
+    """The pod_serving_soak tier (ISSUE 14 acceptance): the serving
+    subsystem under sustained mixed traffic, in one subprocess hosting
+    a real 1-member pod.
+
+    Legs, all in ONE run:
+
+      * **one-RPC-one-token baseline** — the pre-batching architecture:
+        one session parked on the decode worker, one ``mode=sync``
+        Decode RPC per token (full cache read per call, the old
+        example's shape), tokens/s measured over the native-ici plane;
+      * **unloaded interactive baseline** — Generate p99 with nothing
+        else running;
+      * **the soak** — open batch flood (long sessions through the
+        continuous-batching scheduler) + paced interactive sessions,
+        while the load-threshold autoscaler scales a second decode
+        worker up, the ORIGINAL worker is KILLED mid-soak (no drain),
+        revived, and the flood's end scales the second worker back
+        down.  Zero client-visible failures required (batch sheds are
+        the admission layer working, counted separately); epoch delta
+        asserted; tokens/s measured across every completed session.
+
+    Acceptance: soak tokens/s >= 10x the one-RPC-one-token leg, and
+    interactive p99 under soak <= 2x unloaded."""
+    import os
+    import threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    from netalloc import alloc_port
+    coord = f"127.0.0.1:{alloc_port('bench_serving_soak')}"
+
+    import jax
+    from brpc_tpu.ici.fabric import FabricNode
+    FabricNode.initialize(coord, num_processes=1, process_id=0)
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import ici, rpc
+    from brpc_tpu.ici.pod import Pod
+    from brpc_tpu.rpc import errors as rpc_errors
+    from brpc_tpu.rpc.admission import AdmissionOptions
+    from brpc_tpu.serving import (AutoscalerOptions,
+                                  BatchSchedulerOptions, KvPoolOptions,
+                                  LoadThresholdAutoscaler)
+    import numpy as np
+    from examples.disagg_serving.model import (KV_DMODEL, KV_LAYERS,
+                                               VOCAB, reference_generate,
+                                               toy_kv_blocks)
+    from examples.disagg_serving.workers import (DecodeService,
+                                                 start_prefill_worker,
+                                                 start_router)
+    from examples.example_echo_pb2 import EchoRequest, EchoResponse
+    mesh = ici.IciMesh()
+    ici.IciMesh.set_default(mesh)
+    pod = Pod.join("serving-soak")
+    BPT = KV_LAYERS * KV_DMODEL
+
+    def mk_decode(dev_url):
+        opts = rpc.ServerOptions()
+        # per-tenant admission (PR 9): interactive outweighs batch 4:1,
+        # batch band sheds before queueing — the soak's shed absorber
+        opts.admission = AdmissionOptions(
+            tenant_weights={"inter": 4, "bulk": 1})
+        server = rpc.Server(opts)
+        svc = DecodeService(
+            pool_options=KvPoolOptions.from_admission(
+                opts.admission, bytes_per_token=BPT, num_blocks=2048,
+                block_tokens=16),
+            sched_options=BatchSchedulerOptions(vocab=VOCAB,
+                                                max_batch=8))
+        server.add_service(svc)
+        assert server.start(dev_url) == 0
+        return server, svc
+
+    # prefill is the 1-core contended stage: a small concurrency gate +
+    # per-tenant admission sheds the batch flood BEFORE it queues (the
+    # PR-9 shed-before-queue line) so interactive prefills keep a
+    # bounded wait — "batch tenants absorb the shedding"
+    popts = rpc.ServerOptions()
+    popts.max_concurrency = 2
+    popts.admission = AdmissionOptions(
+        tenant_weights={"inter": 4, "bulk": 1})
+    prefill = start_prefill_worker("ici://0", options=popts)
+    dec_a, svc_a = mk_decode("ici://1")
+    router = start_router("mem://soak-router", "ici://0", ["ici://1"])
+    rsvc = next(iter(router._services.values()))
+    epoch0 = pod.epoch(refresh=True)
+
+    workers = {"ici://1": (dec_a, svc_a)}
+    wlock = threading.Lock()
+
+    def current_load():
+        with wlock:
+            svcs = [s for (_, s) in workers.values()]
+        if not svcs:
+            return 1.0
+        load = 0.0
+        for s in svcs:
+            d = s.scheduler.describe()
+            load += (d["active"] + sum(d["pending_by_band"])) \
+                / max(d["max_batch"], 1)
+        return load / len(svcs)
+
+    def scale_up():
+        with wlock:
+            if "ici://2" in workers:
+                return False
+            workers["ici://2"] = mk_decode("ici://2")
+        rsvc.add_decode_target("ici://2")
+        return True
+
+    def scale_down():
+        with wlock:
+            if "ici://2" not in workers:
+                return False
+            server, svc = workers.pop("ici://2")
+        rsvc.remove_decode_target("ici://2")
+        time.sleep(0.1)
+        server.stop(grace_s=1.0)
+        svc.close()
+        return True
+
+    def size_fn():
+        with wlock:
+            return len(workers)
+
+    scaler = LoadThresholdAutoscaler(
+        current_load, size_fn, scale_up, scale_down,
+        options=AutoscalerOptions(high_water=0.3, low_water=0.05,
+                                  interval_s=0.25, samples_to_scale=2,
+                                  cooldown_s=2.0, min_size=1,
+                                  max_size=2),
+        pod=pod)
+
+    ch_opts = rpc.ChannelOptions(timeout_ms=30000)
+
+    # ---- leg 1: one-RPC-one-token baseline (the old architecture) ----
+    dch = rpc.Channel()
+    dch.init("ici://1", options=ch_opts)
+    base_tokens = [(5 * j) % 997 for j in range(64)]
+    kv = np.asarray(toy_kv_blocks(base_tokens)).tobytes()
+    lc = rpc.Controller()
+    lc.request_attachment.append(kv)
+    dch.call_method("Decode.LoadKv", lc, EchoRequest(
+        message=json.dumps({"session": "base", "seq_len": 64,
+                            "last_token": base_tokens[-1]})),
+        EchoResponse)
+    assert not lc.failed(), lc.error_text
+    one_rpc_tokens = 0
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 1.2:
+        cntl = rpc.Controller()
+        dch.call_method("Decode.Decode", cntl, EchoRequest(
+            message=json.dumps({"session": "base", "steps": 1,
+                                "mode": "sync", "release": False})),
+            EchoResponse)
+        if cntl.failed():
+            break
+        one_rpc_tokens += 1
+    one_rpc_elapsed = time.monotonic() - t0
+    one_rpc_tps = one_rpc_tokens / one_rpc_elapsed
+    svc_a.pool.release("base")
+
+    # ---- traffic machinery -------------------------------------------
+    stop_evt = threading.Event()        # interactive clients
+    bulk_stop = threading.Event()       # the batch flood ends FIRST
+    stats = {"inter_ok": 0, "inter_shed": 0, "inter_fail": 0,
+             "bulk_ok": 0, "bulk_shed": 0, "bulk_fail": 0,
+             "mismatch": 0, "tokens": 0}
+    slock = threading.Lock()
+    inter_lats_quiet: list = []
+    inter_lats_soak: list = []
+    soak_started = threading.Event()
+
+    def client(wid, priority, pace_s, steps, seq):
+        ch = rpc.Channel()
+        ch.init("mem://soak-router", options=ch_opts)
+        evt = stop_evt if priority == 0 else bulk_stop
+        i = 0
+        while not evt.is_set():
+            tokens = [(wid * 131 + i * 17 + j) % 997
+                      for j in range(seq)]
+            i += 1
+            cntl = rpc.Controller()
+            cntl.priority = priority
+            cntl.tenant = "inter" if priority == 0 else "bulk"
+            t1 = time.perf_counter_ns()
+            resp = ch.call_method(
+                "Router.Generate", cntl,
+                EchoRequest(message=json.dumps(
+                    {"tokens": tokens, "steps": steps})), EchoResponse)
+            lat_us = (time.perf_counter_ns() - t1) / 1000.0
+            kind = "inter" if priority == 0 else "bulk"
+            backoff = 0.0
+            with slock:
+                if cntl.failed():
+                    if cntl.error_code_ in (rpc_errors.ELIMIT,
+                                            rpc_errors.ELOGOFF):
+                        stats[f"{kind}_shed"] += 1
+                        # the PR-9 client contract: a shed caller backs
+                        # off by the server's hint instead of hammering
+                        # (an unthrottled shed loop would also burn the
+                        # 1-core GIL the interactive tail rides on)
+                        backoff = max(cntl.retry_after_ms, 20) / 1000.0
+                    else:
+                        stats[f"{kind}_fail"] += 1
+                        print(f"# soak client failure: "
+                              f"{cntl.error_code_} {cntl.error_text}",
+                              file=sys.stderr)
+                else:
+                    toks = json.loads(resp.message)["tokens"]
+                    # verify every interactive completion; SAMPLE the
+                    # bulk ones (1 in 4) — client-side reference
+                    # recompute is a full prefill and 12 verifying
+                    # clients would contend the 1-core host the soak
+                    # is measuring
+                    verify = kind == "inter" or (i % 4 == 1)
+                    if verify and toks != reference_generate(tokens,
+                                                             steps):
+                        stats["mismatch"] += 1
+                    else:
+                        stats[f"{kind}_ok"] += 1
+                        stats["tokens"] += len(toks)
+                    if kind == "inter":
+                        (inter_lats_soak if soak_started.is_set()
+                         else inter_lats_quiet).append(lat_us)
+            if backoff:
+                time.sleep(backoff)
+            if pace_s:
+                time.sleep(pace_s)
+        ch.close()
+
+    def p99(lats):
+        if not lats:
+            return -1.0
+        lats = sorted(lats)
+        return lats[min(int(len(lats) * 0.99), len(lats) - 1)]
+
+    # ---- warmup: compile the prefill program for the one shared seq
+    # length BEFORE any latency is measured (a jit compile in the
+    # unloaded-p99 window is warmup noise, not serving latency)
+    wch = rpc.Channel()
+    wch.init("mem://soak-router", options=ch_opts)
+    for k in range(3):
+        wc = rpc.Controller()
+        wch.call_method("Router.Generate", wc, EchoRequest(
+            message=json.dumps({"tokens": [(k + j) % 997
+                                           for j in range(48)],
+                                "steps": 8})), EchoResponse)
+        assert not wc.failed(), wc.error_text
+    wch.close()
+
+    # ---- leg 2: unloaded interactive baseline ------------------------
+    inter_threads = [threading.Thread(
+        target=client, args=(w, 0, 0.03, 8, 48)) for w in range(2)]
+    for t in inter_threads:
+        t.start()
+    time.sleep(2.5)
+    with slock:
+        quiet_tokens = stats["tokens"]
+
+    # ---- leg 3: the soak ---------------------------------------------
+    scaler.start()
+    soak_started.set()
+    soak_t0 = time.monotonic()
+    # bulk sessions share the interactive prompt length (ONE compiled
+    # prefill program) and decode LONG (1536 tokens): the roster stays
+    # saturated while the per-session PREFILL rate — the 1-core
+    # contended stage every interactive tail queues behind — stays low
+    # enough that the admission queue bound, not raw CPU starvation,
+    # sets the interactive p99
+    bulk_threads = [threading.Thread(
+        target=client, args=(10 + w, 3, 0.0, 1536, 48))
+        for w in range(5)]
+    for t in bulk_threads:
+        t.start()
+
+    def wait_for(pred, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        print(f"# soak: timeout waiting for {what}", file=sys.stderr)
+        return False
+
+    scaled_up = wait_for(
+        lambda: scaler.scale_ups.get_value() >= 1, 10.0, "scale-up")
+    killed = revived = False
+    time.sleep(max(soak_s * 0.3 - (time.monotonic() - soak_t0), 0.2))
+    if scaled_up:
+        # KILL the original worker mid-soak, no drain; router retries
+        # carry every in-flight session to the scaled-up worker
+        dec_a.stop(grace_s=0)
+        svc_a.close()
+        rsvc.remove_decode_target("ici://1")
+        with wlock:
+            workers.pop("ici://1", None)
+        killed = True
+        time.sleep(1.0)
+        dec_a2, svc_a2 = mk_decode("ici://1")
+        with wlock:
+            workers["ici://1"] = (dec_a2, svc_a2)
+        rsvc.add_decode_target("ici://1")
+        revived = True
+    remaining = soak_s - (time.monotonic() - soak_t0)
+    if remaining > 0:
+        time.sleep(remaining)
+    with slock:
+        soak_tokens = stats["tokens"] - quiet_tokens
+    soak_elapsed = time.monotonic() - soak_t0
+    # the flood ends first: load collapses under the low-water mark and
+    # the autoscaler drains the scaled-up worker (interactive traffic
+    # keeps flowing through the scale-down — elastic, not stop-the-world)
+    bulk_stop.set()
+    for t in bulk_threads:
+        t.join(timeout=60)
+    scaled_down = wait_for(
+        lambda: scaler.scale_downs.get_value() >= 1, 15.0, "scale-down")
+    stop_evt.set()
+    for t in inter_threads:
+        t.join(timeout=30)
+    scaler.stop()
+
+    epoch_delta = pod.epoch(refresh=True) - epoch0
+    soak_tps = soak_tokens / soak_elapsed
+    hi_p99_quiet = p99(inter_lats_quiet)
+    hi_p99_soak = p99(inter_lats_soak)
+    with wlock:
+        serving_status = {url: svc.describe_serving()
+                          for url, (_, svc) in workers.items()}
+    result = {
+        "pod_serving_soak_tokens_per_s": round(soak_tps, 1),
+        "pod_serving_one_rpc_tokens_per_s": round(one_rpc_tps, 1),
+        "pod_serving_speedup_x": round(soak_tps / one_rpc_tps, 2)
+        if one_rpc_tps > 0 else -1.0,
+        "interactive_p99_unloaded_us": round(hi_p99_quiet, 1),
+        "interactive_p99_soak_us": round(hi_p99_soak, 1),
+        "interactive_p99_ratio": round(hi_p99_soak / hi_p99_quiet, 3)
+        if hi_p99_quiet > 0 else -1.0,
+        "epoch_delta": epoch_delta,
+        "scale_ups": scaler.scale_ups.get_value(),
+        "scale_downs": scaler.scale_downs.get_value(),
+        "killed_mid_soak": killed,
+        "revived_mid_soak": revived,
+        "client_failures": stats["inter_fail"] + stats["bulk_fail"],
+        "token_mismatches": stats["mismatch"],
+        "inter_sessions_ok": stats["inter_ok"],
+        "bulk_sessions_ok": stats["bulk_ok"],
+        "bulk_sheds": stats["bulk_shed"],
+        "inter_sheds": stats["inter_shed"],
+        "router": rsvc.describe_serving()["router"],
+        "serving_status": serving_status,
+        "pass_10x": (one_rpc_tps > 0
+                     and soak_tps >= 10.0 * one_rpc_tps),
+        "pass_p99_bound": (hi_p99_quiet > 0
+                           and hi_p99_soak <= 2.0 * hi_p99_quiet),
+        # 1-core honesty (the striped-shm / usercode-pool precedent):
+        # on a single core the interactive tail rides the SAME cpu the
+        # batch prefills and the step loop compute on, so the 2x bound
+        # is scheduler-shaped, not load-shaped — record the reason
+        # alongside the measured ratio instead of pretending the bound
+        # is stable here
+        "p99_note": ("" if os.cpu_count() > 1 else
+                     "1-core host: interactive tail shares the core "
+                     "with batch prefill compute and the step loop; "
+                     "the 2x bound is measured but scheduler-noise-"
+                     "sensitive run to run (multi-core holds the "
+                     "load-shaped bound)"),
+        "pass_chaos": (killed and revived and scaled_up and scaled_down
+                       and stats["inter_fail"] + stats["bulk_fail"] == 0
+                       and stats["mismatch"] == 0
+                       and epoch_delta >= 4),
+    }
+    # teardown
+    dch.close()
+    with wlock:
+        live = list(workers.values())
+    for server, svc in live:
+        svc.close()
+        server.stop()
+    for svc in router._services.values():
+        if hasattr(svc, "close"):
+            svc.close()
+    router.stop()
+    for svc in prefill._services.values():
+        if hasattr(svc, "close"):
+            svc.close()
+    prefill.stop()
+    pod.leave()
+    return result
+
+
 def device_backend_reachable() -> bool:
     """Fast-fail probe for the device backend (VERDICT r1 #1): under the
     axon tunnel, jax backend init dials the terminal's stateless port —
@@ -2115,6 +2507,16 @@ def main() -> None:
           file=sys.stderr)
     ovl = _run_subbench("overload", timeout_s=300) if reachable else {}
     print(f"# overload survival: {ovl}", file=sys.stderr)
+    # pod_serving_soak (ISSUE 14): continuous batching vs one-RPC-one-
+    # token, elastic scale-up + kill + revive + scale-down mid-soak,
+    # per-tenant admission — its own subprocess (1-member pod + jax
+    # distributed init must not leak into the parent)
+    soak = _run_subbench(
+        "serving_soak", timeout_s=240,
+        env={"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}) \
+        if device_ok else {}
+    print(f"# pod serving soak: {soak}", file=sys.stderr)
     target_us = 10.0
     # Metric of record: a MESH-CROSSING p50 — the payload actually
     # changes chips (VERDICT r5 weak #1: the old headline was a
@@ -2346,6 +2748,28 @@ def main() -> None:
         "usercode_pool_mode": cpu.get("pool_mode", "unknown"),
         "usercode_pool_scaling_supported": cpu.get(
             "pool_scaling_supported", False),
+        # ISSUE-14 serving soak: continuous batching vs the one-RPC-one-
+        # token architecture, same run; chaos + p99 acceptance booleans
+        # computed where the data is; route asserted via the serving
+        # /status block (pod_serving_status below carries it verbatim)
+        "pod_serving_soak_tokens_per_s": soak.get(
+            "pod_serving_soak_tokens_per_s", -1.0),
+        "pod_serving_one_rpc_tokens_per_s": soak.get(
+            "pod_serving_one_rpc_tokens_per_s", -1.0),
+        "pod_serving_speedup_x": soak.get("pod_serving_speedup_x",
+                                          -1.0),
+        "pod_serving_interactive_p99_ratio": soak.get(
+            "interactive_p99_ratio", -1.0),
+        "pod_serving_epoch_delta": soak.get("epoch_delta", -1),
+        "pod_serving_client_failures": soak.get("client_failures", -1),
+        "pod_serving_bulk_sheds": soak.get("bulk_sheds", -1),
+        "pod_serving_pass_10x": soak.get("pass_10x", False),
+        "pod_serving_pass_p99_bound": soak.get("pass_p99_bound", False),
+        "pod_serving_pass_chaos": soak.get("pass_chaos", False),
+        "pod_serving_batch_occupancy": soak.get(
+            "serving_status", {}).get("ici://1", {}).get(
+            "scheduler", {}).get("batch_occupancy_avg", -1.0),
+        "pod_serving_status": soak.get("serving_status", {}),
     }
     # single-device allreduce is local-HBM bandwidth, not ICI: label it so
     # no reader mistakes it for line rate (VERDICT r3 #3a)
@@ -2377,7 +2801,8 @@ if __name__ == "__main__":
               "cpu_bound": bench_cpu_bound_qps,
               "collective_fanout": bench_collective_fanout,
               "collective_single": bench_collective_single,
-              "pod_prefill_decode": bench_pod_prefill_decode}[sys.argv[2]]
+              "pod_prefill_decode": bench_pod_prefill_decode,
+              "serving_soak": bench_serving_soak}[sys.argv[2]]
         print(_json.dumps(fn()))
     else:
         main()

@@ -1476,8 +1476,12 @@ class ServerBinding:
                 server.on_request_out()
 
             if err:
+                # a handler-set shed hint (e.g. the serving pool's
+                # saturation shed) rides the respond item like the
+                # admission sheds — plane parity with tpu_std/loopback
                 self._respond_one(token, err, cntl.error_text_, collector,
-                                  post=post)
+                                  post=post,
+                                  retry_after=cntl.retry_after_ms or 0)
                 return
             resp_att = cntl._peek_response_attachment()
             pass_h = 0
@@ -1701,7 +1705,8 @@ class _FusedDone:
             item = (self.token, err,
                     text.encode() if isinstance(text, str)
                     else (text or b""), b"", b"", (),
-                    (status, err, latency_us, server), 0, 0)
+                    (status, err, latency_us, server),
+                    cntl.retry_after_ms or 0, 0)
         else:
             resp_att = d.get("response_attachment")
             pass_h = 0

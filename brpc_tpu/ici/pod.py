@@ -62,12 +62,12 @@ class PodMember:
     builds simply advertise none)."""
 
     __slots__ = ("pid", "gen", "state", "devices", "serving", "draining",
-                 "ctrl", "ts", "coll")
+                 "ctrl", "ts", "coll", "load")
 
     def __init__(self, pid: int, gen: int, state: str,
                  devices: List[int], serving: List[int],
                  draining: List[int], ctrl: str = "", ts: float = 0.0,
-                 coll: Optional[List[str]] = None):
+                 coll: Optional[List[str]] = None, load: float = 0.0):
         self.pid = pid
         self.gen = gen
         self.state = state
@@ -77,6 +77,10 @@ class PodMember:
         self.ctrl = ctrl
         self.ts = ts
         self.coll = coll or []
+        # published serving load in [0, 1] (telemetry, NOT membership:
+        # load changes never bump the gen — the autoscaler polls it,
+        # watchers don't fire on it)
+        self.load = load
 
     @classmethod
     def from_json(cls, raw: str) -> "PodMember":
@@ -84,20 +88,22 @@ class PodMember:
         return cls(d["pid"], d["gen"], d.get("state", UP),
                    d.get("devices", []), d.get("serving", []),
                    d.get("draining", []), d.get("ctrl", ""),
-                   d.get("ts", 0.0), d.get("coll", []))
+                   d.get("ts", 0.0), d.get("coll", []),
+                   d.get("load", 0.0))
 
     def to_json(self) -> str:
         return json.dumps({
             "pid": self.pid, "gen": self.gen, "state": self.state,
             "devices": self.devices, "serving": self.serving,
             "draining": self.draining, "ctrl": self.ctrl, "ts": self.ts,
-            "coll": self.coll,
+            "coll": self.coll, "load": self.load,
         })
 
     def describe(self) -> dict:
         return {"pid": self.pid, "gen": self.gen, "state": self.state,
                 "devices": self.devices, "serving": self.serving,
-                "draining": self.draining, "coll": self.coll}
+                "draining": self.draining, "coll": self.coll,
+                "load": self.load}
 
 
 def epoch_of(members: Dict[int, PodMember]) -> int:
@@ -126,6 +132,8 @@ class Pod:
         "_state": "_lock",
         "_watchers": "_lock",
         "_coll": "_lock",
+        "_load": "_lock",
+        "_autoscaler": "_lock",
     }
 
     def __init__(self, name: str, node) -> None:
@@ -140,6 +148,8 @@ class Pod:
         self._serving: List[int] = []
         self._draining_devs: List[int] = []
         self._coll: List[str] = []
+        self._load = 0.0
+        self._autoscaler = None
         self._members: Dict[int, PodMember] = {}
         self._watchers: List[Callable[[Dict[int, PodMember]], None]] = []
         self._stop = threading.Event()
@@ -253,7 +263,7 @@ class Pod:
                                 list(self._devices), list(self._serving),
                                 list(self._draining_devs),
                                 ctrl=self.node.ctrl_addr, ts=time.time(),
-                                coll=list(self._coll))
+                                coll=list(self._coll), load=self._load)
             self._kv.key_value_set(self._key(self.pid), rec.to_json(),
                                    allow_overwrite=True)
 
@@ -301,6 +311,33 @@ class Pod:
             self._coll = list(methods)
             self._gen += 1
         self._publish()
+
+    def publish_load(self, load: float) -> None:
+        """Publish the member's serving load (``[0, 1]``) into its pod
+        record — telemetry for the elastic autoscaler, NOT a membership
+        transition: the gen does not bump, the epoch does not move, and
+        watchers do not fire.  Peers read it via ``loads()``."""
+        load = min(max(float(load), 0.0), 1.0)
+        with self._lock:
+            if abs(load - self._load) < 1e-9:
+                return
+            self._load = load
+        self._publish()
+
+    def loads(self, refresh: bool = False) -> Dict[int, float]:
+        """Every up member's published load — the autoscaler's
+        pod-aggregate signal."""
+        return {m.pid: m.load for m in
+                self.members(refresh=refresh).values()
+                if m.state == UP}
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Register the serving autoscaler driving this member's
+        elastic scale decisions; it appears in :meth:`describe` (the
+        ``/ici`` pod block) so an operator sees the watermarks and the
+        last action next to the membership it mutates."""
+        with self._lock:
+            self._autoscaler = autoscaler
 
     def mark_draining(self, device_id: int) -> None:
         """Lame-duck: the server on ``ici://device_id`` began its drain
@@ -404,13 +441,21 @@ class Pod:
     # ---- observability -------------------------------------------------
     def describe(self) -> dict:
         members = self.members()
-        return {
+        out = {
             "name": self.name,
             "pid": self.pid,
             "epoch": epoch_of(members),
             "members": [members[p].describe()
                         for p in sorted(members)],
         }
+        with self._lock:
+            autoscaler = self._autoscaler
+        if autoscaler is not None:
+            try:
+                out["autoscaler"] = autoscaler.describe()
+            except Exception:
+                pass
+        return out
 
 
 # ---- server lifecycle hooks (rpc/server.py) ----------------------------

@@ -453,11 +453,48 @@ class LocalityAwareLB(_ListLB):
             else:
                 w.push(max(float(latency_us), 1.0))
 
+    def cancel_inflight(self, ep) -> None:
+        """Retire one in-flight entry WITHOUT a latency sample: a
+        selection discarded before any request was issued (per-call
+        exclusion retries).  The entry was just added, so subtracting
+        the current time is exact to within the discard latency —
+        without this, discarded draws accumulate phantom in-flight
+        entries whose extrapolation pins the server near MIN_WEIGHT
+        forever (a revived worker would never win traffic back)."""
+        import time as _time
+        now_us = _time.monotonic() * 1e6
+        with self._w_lock:
+            w = self._weight_for(ep)
+            if w.begin_time_count > 0:
+                w.begin_time_sum -= now_us
+                w.begin_time_count -= 1
+                if w.begin_time_count == 0:
+                    w.begin_time_sum = 0.0
+
     def weight_of(self, ep) -> float:
         import time as _time
         with self._w_lock:
             return self._effective_weight(self._weight_for(ep),
                                           _time.monotonic() * 1e6)
+
+    def describe(self) -> dict:
+        """Per-server divided-weight snapshot (the serving router's
+        /status block): effective weight, window average latency, and
+        the in-flight count the extrapolation divides by."""
+        import time as _time
+        now_us = _time.monotonic() * 1e6
+        out = {}
+        with self._dbd.read() as lst:
+            eps = [e.endpoint for e in lst]
+        with self._w_lock:
+            for ep in eps:
+                w = self._weight_for(ep)
+                out[str(ep)] = {
+                    "weight": round(self._effective_weight(w, now_us), 1),
+                    "avg_latency_us": round(w.avg_latency(), 1),
+                    "inflight": w.begin_time_count,
+                }
+        return out
 
 
 class DynPartLB(_ListLB):

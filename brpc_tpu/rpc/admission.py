@@ -74,6 +74,23 @@ class AdmissionOptions:
     # expire queued entries manually via expire_queued(now_us))
     use_timers: bool = True
 
+    def tenant_weight(self, tenant: str) -> int:
+        """The tenant's WFQ weight — shared policy surface: the DRR
+        admission queue spends it as dequeue credit, and the serving
+        KV pool's eviction order consults the SAME table
+        (``serving.KvPoolOptions.from_admission``), so queue fairness
+        and memory pressure agree on who absorbs overload."""
+        return tenant_weight_of(self.tenant_weights,
+                                self.default_tenant_weight, tenant)
+
+
+def tenant_weight_of(weights: Dict[str, int], default: int,
+                     tenant: str) -> int:
+    """THE tenant-weight lookup (floor 1): the DRR admission queue and
+    the serving KV pool's eviction order must agree on this rule, so it
+    lives exactly once."""
+    return max(1, weights.get(tenant, default))
+
 
 def shed_backoff_s(hint_ms: int, seed=None) -> float:
     """Client-side backoff for an admission shed: the server's
@@ -358,8 +375,7 @@ class AdmissionController:
         return max(1, (self.options.queue_capacity * w) // total)
 
     def _weight(self, tenant: str) -> int:
-        return max(1, self.options.tenant_weights.get(
-            tenant, self.options.default_tenant_weight))
+        return self.options.tenant_weight(tenant)
 
     # ---- retry-after hint ---------------------------------------------
     def service_rate(self) -> float:

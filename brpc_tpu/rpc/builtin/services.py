@@ -122,6 +122,20 @@ def _status(server, q):
         # host can't scale), worker counts, and the share-nothing
         # contract/death counters
         out["usercode_pool"] = pool.describe()
+    serving = {}
+    for name, svc in server.services().items():
+        # the serving block (ROADMAP item 3): any hosted service
+        # exposing describe_serving() — decode workers report step
+        # rate / batch occupancy / paged-pool pages / evictions by
+        # reason+tenant, routers report LALB divided weights + picks
+        fn = getattr(svc, "describe_serving", None)
+        if callable(fn):
+            try:
+                serving[name] = fn()
+            except Exception:
+                pass
+    if serving:
+        out["serving"] = serving
     return "application/json", json.dumps(out, indent=1)
 
 

@@ -406,6 +406,11 @@ def _execute(server, full_name: str, cntl: Controller,
         #                  dropped like a stale correlation version
         if err:
             client_cntl.set_failed(err, cntl.error_text_)
+            # a handler-set shed hint rides back exactly like the wire
+            # plane's ResponseMeta (tpu_std.py packs cntl.retry_after_ms
+            # for the same shape — loopback is not a hint black hole)
+            if cntl.retry_after_ms:
+                client_cntl.retry_after_ms = cntl.retry_after_ms
         else:
             resp_att = cntl._peek_response_attachment()
             if resp_att is not None and len(resp_att):

@@ -1,0 +1,39 @@
+"""brpc_tpu.serving — the production serving subsystem (ROADMAP item 3).
+
+Four pieces, each usable alone, composed by the disaggregated-serving
+workers (``examples/disagg_serving`` is built ON this package):
+
+  * :mod:`.kv_pool` — ``PagedKvPool``: fixed-size device blocks, a free
+    list, per-session block tables, admission-aware eviction (the PR-9
+    tenant/priority policy decides who absorbs memory pressure), and a
+    TimerThread-driven expiry sweep (idle workers reclaim parked KV
+    with zero traffic);
+  * :mod:`.scheduler` — ``ContinuousBatchScheduler``: one batched
+    decode step per tick over the active session set, sessions
+    admitted/retired/preempted BETWEEN steps;
+  * :mod:`.router` — ``LoadAwareRouter``: prefill→decode routing by
+    load through the LALB divided-weight balancer, with elastic
+    membership from a naming url (``pod://``);
+  * :mod:`.autoscaler` — ``LoadThresholdAutoscaler``: the elastic-pod
+    capacity loop (watermarks + hysteresis + cooldown → scale
+    callbacks; Server→Pod advertise/withdraw hooks move the epoch).
+"""
+from .autoscaler import AutoscalerOptions, LoadThresholdAutoscaler
+from .kv_pool import (KvPoolOptions, PagedKvPool, PoolSaturated,
+                      SessionBusy)
+from .router import LoadAwareRouter
+from .scheduler import (BatchSchedulerOptions, ContinuousBatchScheduler,
+                        StepRequest)
+
+__all__ = [
+    "AutoscalerOptions",
+    "BatchSchedulerOptions",
+    "ContinuousBatchScheduler",
+    "KvPoolOptions",
+    "LoadAwareRouter",
+    "LoadThresholdAutoscaler",
+    "PagedKvPool",
+    "PoolSaturated",
+    "SessionBusy",
+    "StepRequest",
+]

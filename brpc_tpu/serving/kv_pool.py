@@ -1,0 +1,461 @@
+"""Paged KV-block pool: fixed-size device blocks, free-list custody,
+per-session block tables, admission-aware eviction, timer-driven expiry.
+
+The serving subsystem's memory manager (ROADMAP item 3; the shape every
+production LLM server converged on — vLLM's PagedAttention block tables
+over a fixed block pool).  One pool per decode worker:
+
+  * **Blocks, not sessions, are the allocation unit.**  The backing
+    store is a fixed ``(num_blocks, block_tokens × bytes_per_token)``
+    uint8 arena plus a parallel ``(num_blocks, block_tokens)`` int64
+    per-token reduction arena (the "attention read" surface the batched
+    decode step gathers from — one fancy-index gather per step through
+    the block tables, never a per-session copy).  A session holds an
+    ordered block list; fragmentation is impossible by construction.
+  * **Admission-aware eviction** (the PR-9 integration): under memory
+    pressure the pool evicts parked sessions in PRIORITY-BAND order —
+    sheddable/batch bands (higher band number) before interactive ones,
+    lighter admission tenant weights before heavier ones inside a band,
+    LRU inside a (band, weight) class — and a loading session may NEVER
+    evict a session from a band more protected than its own.  Tenant
+    weights come from the same ``AdmissionOptions.tenant_weight``
+    table the WFQ admission queue uses (``KvPoolOptions.from_admission``),
+    so "who absorbs the pressure" is ONE policy across queueing and
+    memory.
+  * **Timer-driven expiry**, not traffic-driven (the ISSUE-14 bugfix):
+    the old example swept stale sessions only inside ``LoadKv``, so an
+    idle decode worker parked expired KV forever.  Here the sweep is a
+    TimerThread callback scheduled whenever sessions exist — a parked
+    session on an otherwise-idle worker is reclaimed on time with zero
+    new traffic.  The timer is scheduled lazily (first load) and
+    self-cancels when the pool drains, so an idle pool costs nothing.
+  * **Pins** fence eviction: the decode scheduler pins every session in
+    its step roster; pinned sessions are never evicted or expired (their
+    block tables are live in the current batched program).
+
+Custody: a session's bytes enter the pool exactly once (the ``load``
+copy out of the RPC attachment — on the native-ici plane that is the
+single materialization of the parked NativeAttachment handle) and leave
+by exactly one of release / evict / expire / close.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import bvar
+from ..butil import debug_sync as _dbg
+
+
+class SessionBusy(RuntimeError):
+    """``load`` hit a session id that is PINNED in the step roster: a
+    re-prefill while the first decode still runs.  Freeing a rostered
+    session's blocks would hand them to the new bytes mid-program (the
+    running gather would read the replacement's KV), so the reload is
+    refused — the RPC layer maps this to a retryable shed."""
+
+    def __init__(self, session: str):
+        super().__init__(
+            f"session {session!r} is pinned in the decode roster; "
+            f"re-prefill must wait for (or cancel) the running decode")
+        self.session = session
+
+
+class PoolSaturated(RuntimeError):
+    """``load`` could not free enough blocks: every candidate session is
+    pinned or lives in a band more protected than the requester's.  The
+    RPC layer maps this to retryable ``ELIMIT`` + a ``retry_after_ms``
+    hint — the shed, not a failure."""
+
+    def __init__(self, needed: int, free: int):
+        super().__init__(
+            f"kv pool saturated: need {needed} blocks, {free} free and "
+            f"no evictable session in an equal-or-less-protected band")
+        self.needed = needed
+        self.free = free
+
+
+@dataclass
+class KvPoolOptions:
+    """Pool geometry + the eviction/expiry policy."""
+    bytes_per_token: int
+    num_blocks: int = 256
+    block_tokens: int = 16
+    bands: int = 4                   # priority bands, 0 = most protected
+    default_priority: int = 2        # sessions arriving without one
+    ttl_s: float = 120.0             # idle-session expiry
+    sweep_interval_s: float = 0.0    # 0 = auto: ttl_s / 4, floored
+    use_timers: bool = True          # False: tests drive expire_idle()
+    tenant_weights: Dict[str, int] = field(default_factory=dict)
+    default_tenant_weight: int = 1
+
+    @classmethod
+    def from_admission(cls, adm, **kw) -> "KvPoolOptions":
+        """Derive the eviction policy from a PR-9 ``AdmissionOptions``
+        so queue fairness and memory pressure share one tenant table."""
+        kw.setdefault("bands", adm.bands)
+        kw.setdefault("default_priority", adm.default_priority)
+        kw.setdefault("tenant_weights", dict(adm.tenant_weights))
+        kw.setdefault("default_tenant_weight", adm.default_tenant_weight)
+        return cls(**kw)
+
+    def effective_sweep_s(self) -> float:
+        if self.sweep_interval_s > 0:
+            return self.sweep_interval_s
+        return max(self.ttl_s / 4.0, 0.05)
+
+
+class _KvSession:
+    """One session's block table (access under the pool lock; the
+    numeric fields are immutable after load, so the scheduler may READ
+    blocks/seq_len/acc/last_token from its roster snapshot lock-free)."""
+
+    __slots__ = ("session", "tenant", "priority", "seq_len", "last_token",
+                 "acc", "blocks", "last_used", "pinned")
+
+    def __init__(self, session: str, tenant: str, priority: int,
+                 seq_len: int, last_token: int, acc: int,
+                 blocks: np.ndarray, now: float):
+        self.session = session
+        self.tenant = tenant
+        self.priority = priority
+        self.seq_len = seq_len
+        self.last_token = last_token
+        self.acc = acc
+        self.blocks = blocks             # np.int64 (n_blocks,)
+        self.last_used = now
+        self.pinned = False
+
+
+class PagedKvPool:
+    """The paged KV arena.  Thread-safe; one per decode worker."""
+
+    # cardinality cap for per-tenant eviction counters — the tenant
+    # string is untrusted wire input (the admission controller's rule)
+    MAX_TRACKED_TENANTS = 64
+
+    _GUARDED_BY = {
+        "_free": "_lock",
+        "_tables": "_lock",
+        "_recent_evicted": "_lock",
+        "_sweep_timer": "_lock",
+        "_closed": "_lock",
+        "_counters": "_counters_lock",
+        "_tenant_labels": "_counters_lock",
+    }
+
+    def __init__(self, options: KvPoolOptions,
+                 now: Optional[Callable[[], float]] = None):
+        o = options
+        self.options = o
+        self._now = now or time.monotonic
+        self._lock = _dbg.make_lock("PagedKvPool._lock")
+        self._counters_lock = _dbg.make_lock("PagedKvPool._counters_lock")
+        self._store = np.zeros(
+            (o.num_blocks, o.block_tokens * o.bytes_per_token), np.uint8)
+        self._pos_sums = np.zeros((o.num_blocks, o.block_tokens), np.int64)
+        # the batched decode step's gather surface: a VIEW over the
+        # reduction arena (C-contiguous reshape shares memory), fixed
+        # shape for the whole pool lifetime — jit-friendly
+        self.pos_sums_flat = self._pos_sums.reshape(-1)
+        self._free: List[int] = list(range(o.num_blocks - 1, -1, -1))
+        self._tables: Dict[str, _KvSession] = {}
+        # recently-evicted ids → reason, so a late Decode gets a typed
+        # "re-prefill" shed instead of an unknown-session error
+        self._recent_evicted: Dict[str, str] = {}
+        self._sweep_timer = None
+        self._closed = False
+        self.loads = bvar.Adder("serving_kv_pool_loads")
+        self.bytes_in = bvar.Adder("serving_kv_pool_bytes_in")
+        self.evictions = bvar.Adder("serving_kv_pool_evictions")
+        self.expirations = bvar.Adder("serving_kv_pool_expired")
+        self._counters: Dict[tuple, bvar.Adder] = {}
+        self._tenant_labels: set = set()
+
+    # ---- policy helpers -----------------------------------------------
+    def _weight(self, tenant: str) -> int:
+        from ..rpc.admission import tenant_weight_of
+        return tenant_weight_of(self.options.tenant_weights,
+                                self.options.default_tenant_weight,
+                                tenant)
+
+    def _clip_priority(self, priority: Optional[int]) -> int:
+        pri = self.options.default_priority if priority is None \
+            else priority
+        return min(max(pri, 0), self.options.bands - 1)
+
+    def _count(self, what: str, tenant: str) -> None:
+        with self._counters_lock:
+            if tenant and tenant not in self.options.tenant_weights \
+                    and tenant not in self._tenant_labels:
+                if len(self._tenant_labels) >= self.MAX_TRACKED_TENANTS:
+                    tenant = "~other"
+                else:
+                    self._tenant_labels.add(tenant)
+            key = (what, tenant)
+            a = self._counters.get(key)
+            if a is None:
+                safe = bvar.to_underscored_name(tenant or "shared")
+                a = self._counters[key] = bvar.Adder(
+                    f"serving_kv_{what}_{safe}")
+        a << 1
+
+    # ---- load / release -----------------------------------------------
+    def blocks_for(self, seq_len: int) -> int:
+        bt = self.options.block_tokens
+        return (seq_len + bt - 1) // bt
+
+    def load(self, session: str, token_rows: np.ndarray, *,
+             last_token: int, tenant: str = "",
+             priority: Optional[int] = None) -> _KvSession:
+        """Page a session's KV in.  ``token_rows`` is token-major uint8,
+        shape ``(seq_len, bytes_per_token)`` — the caller transposes the
+        model's layer-major layout once here, so every block row is one
+        token's bytes and paging never splits a token.  Raises
+        :class:`PoolSaturated` when eviction cannot make room."""
+        o = self.options
+        rows = np.ascontiguousarray(token_rows, dtype=np.uint8)
+        if rows.ndim != 2 or rows.shape[1] != o.bytes_per_token:
+            raise ValueError(
+                f"token_rows must be (seq_len, {o.bytes_per_token}), "
+                f"got {rows.shape}")
+        seq_len = rows.shape[0]
+        if seq_len <= 0:
+            # a 0-token session would build an empty block table the
+            # batched step cannot index — reject at the boundary
+            raise ValueError("token_rows must hold at least one token")
+        pri = self._clip_priority(priority)
+        need = self.blocks_for(seq_len)
+        if need > o.num_blocks:
+            raise PoolSaturated(need, o.num_blocks)
+        row_sums = rows.sum(axis=1, dtype=np.int64)
+        now = self._now()
+        bt = o.block_tokens
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("kv pool is closed")
+            old = self._tables.get(session)
+            if old is not None:
+                if old.pinned:
+                    # NEVER free a rostered session's blocks out from
+                    # under the running batched step
+                    raise SessionBusy(session)
+                # a re-prefilled session replaces its previous table
+                self._free_session_locked(old, "reloaded")
+            if need > len(self._free):
+                victims = self._pick_victims_locked(
+                    need - len(self._free), pri)
+                if victims is None:
+                    raise PoolSaturated(need, len(self._free))
+                for v in victims:
+                    self._free_session_locked(v, "pressure")
+            blocks = np.empty(need, np.int64)
+            for k in range(need):
+                blk = self._free.pop()
+                blocks[k] = blk
+                chunk = rows[k * bt:(k + 1) * bt]
+                n = chunk.shape[0]
+                flat = chunk.reshape(-1)
+                self._store[blk, :flat.size] = flat
+                self._pos_sums[blk, :n] = row_sums[k * bt:k * bt + n]
+                if n < bt:
+                    # zero the tail so no prior tenant's bytes survive
+                    # in a partially-filled block
+                    self._store[blk, flat.size:] = 0
+                    self._pos_sums[blk, n:] = 0
+            s = _KvSession(session, tenant, pri, seq_len, last_token,
+                           int(row_sums.sum()), blocks, now)
+            self._tables[session] = s
+            self._recent_evicted.pop(session, None)
+            self._schedule_sweep_locked()
+        self.loads << 1
+        self.bytes_in << int(rows.size)
+        return s
+
+    # fablint: lock-held(_lock)
+    def _pick_victims_locked(self, blocks_needed: int,
+                             requester_pri: int):
+        """Eviction order under pressure: most-sheddable band first,
+        lighter tenants before heavier inside a band, LRU inside a
+        class; never a band more protected than the requester's."""
+        cands = [s for s in self._tables.values()
+                 if not s.pinned and s.priority >= requester_pri]
+        cands.sort(key=lambda s: (-s.priority, self._weight(s.tenant),
+                                  s.last_used))
+        victims, have = [], 0
+        for s in cands:
+            if have >= blocks_needed:
+                break
+            victims.append(s)
+            have += len(s.blocks)
+        return victims if have >= blocks_needed else None
+
+    # fablint: lock-held(_lock)
+    def _free_session_locked(self, s: _KvSession, reason: str) -> None:
+        self._tables.pop(s.session, None)
+        self._free.extend(int(b) for b in s.blocks)
+        if reason in ("pressure", "expired"):
+            self._recent_evicted[s.session] = reason
+            while len(self._recent_evicted) > 256:
+                self._recent_evicted.pop(
+                    next(iter(self._recent_evicted)))
+        if reason == "expired":
+            self.expirations << 1
+        elif reason == "pressure":
+            self.evictions << 1
+        self._count("released" if reason == "released"
+                    else f"evicted_{reason}", s.tenant)
+
+    def release(self, session: str) -> bool:
+        """Session finished: return its blocks (the decode-complete
+        path).  Idempotent."""
+        with self._lock:
+            s = self._tables.get(session)
+            if s is None:
+                return False
+            self._free_session_locked(s, "released")
+            return True
+
+    # ---- lookup / scheduler surface -----------------------------------
+    def get(self, session: str) -> Optional[_KvSession]:
+        with self._lock:
+            return self._tables.get(session)
+
+    def evicted_reason(self, session: str) -> Optional[str]:
+        """Why a recently-missing session is gone ("pressure" /
+        "expired"), so the RPC layer sheds with a typed re-prefill hint
+        instead of an unknown-session error."""
+        with self._lock:
+            return self._recent_evicted.get(session)
+
+    def touch(self, session: str) -> None:
+        now = self._now()
+        with self._lock:
+            s = self._tables.get(session)
+            if s is not None:
+                s.last_used = now
+
+    def pin(self, session: str) -> bool:
+        """Fence a session against eviction/expiry (step-roster entry).
+        False when the session is gone."""
+        with self._lock:
+            s = self._tables.get(session)
+            if s is None:
+                return False
+            s.pinned = True
+            return True
+
+    def unpin(self, session: str) -> None:
+        now = self._now()
+        with self._lock:
+            s = self._tables.get(session)
+            if s is not None:
+                s.pinned = False
+                s.last_used = now
+
+    def materialize(self, session: str) -> Optional[np.ndarray]:
+        """Copy a session's token rows back out, ``(seq_len,
+        bytes_per_token)`` — the sync/one-RPC decode path and the
+        byte-exactness tests."""
+        snap = self.snapshot(session)
+        return snap[0] if snap is not None else None
+
+    def snapshot(self, session: str):
+        """``(rows, seq_len, last_token)`` under ONE lock acquisition —
+        the sync decode path's atomic read (a separate get() +
+        materialize() pair could straddle an eviction and pair the old
+        entry's metadata with the new entry's bytes)."""
+        o = self.options
+        with self._lock:
+            s = self._tables.get(session)
+            if s is None:
+                return None
+            rows = self._store[s.blocks].reshape(
+                -1, o.bytes_per_token)[:s.seq_len].copy()
+            return rows, s.seq_len, s.last_token
+
+    # ---- expiry ---------------------------------------------------------
+    # fablint: lock-held(_lock)
+    def _schedule_sweep_locked(self) -> None:
+        if (not self.options.use_timers or self._closed
+                or self._sweep_timer is not None or not self._tables):
+            return
+        from ..bthread.timer_thread import TimerThread
+        self._sweep_timer = TimerThread.instance().schedule_after(
+            self._sweep, self.options.effective_sweep_s())
+
+    def _sweep(self) -> None:
+        """TimerThread callback: reclaim idle sessions past TTL — the
+        traffic-independent expiry the ISSUE-14 bugfix demands."""
+        with self._lock:
+            self._sweep_timer = None
+        self.expire_idle()
+        with self._lock:
+            self._schedule_sweep_locked()
+
+    def expire_idle(self, now: Optional[float] = None) -> int:
+        """Reclaim every unpinned session idle past ``ttl_s``.  Returns
+        the count (also the manual surface for ``use_timers=False``
+        tests)."""
+        now = self._now() if now is None else now
+        ttl = self.options.ttl_s
+        n = 0
+        with self._lock:
+            for s in list(self._tables.values()):
+                if not s.pinned and now - s.last_used > ttl:
+                    self._free_session_locked(s, "expired")
+                    n += 1
+        return n
+
+    # ---- lifecycle / observability --------------------------------------
+    def sessions(self) -> int:
+        with self._lock:
+            return len(self._tables)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            timer = self._sweep_timer
+            self._sweep_timer = None
+            self._tables.clear()
+            self._free = list(range(self.options.num_blocks - 1, -1, -1))
+        if timer is not None:
+            from ..bthread.timer_thread import TimerThread
+            TimerThread.instance().unschedule(timer)
+
+    def describe(self) -> dict:
+        """The /status serving block's pool half."""
+        o = self.options
+        with self._lock:
+            free = len(self._free)
+            sessions = len(self._tables)
+            pinned = sum(1 for s in self._tables.values() if s.pinned)
+            per_tenant: Dict[str, int] = {}
+            for s in self._tables.values():
+                key = s.tenant or "shared"
+                per_tenant[key] = per_tenant.get(key, 0) + len(s.blocks)
+        with self._counters_lock:
+            by_class = {f"{what}[{tenant or 'shared'}]": a.get_value()
+                        for (what, tenant), a in self._counters.items()}
+        used = o.num_blocks - free
+        return {
+            "blocks_total": o.num_blocks,
+            "blocks_free": free,
+            "blocks_used": used,
+            "block_tokens": o.block_tokens,
+            "utilization": round(used / o.num_blocks, 3),
+            "sessions": sessions,
+            "pinned": pinned,
+            "blocks_by_tenant": per_tenant,
+            "loads": self.loads.get_value(),
+            "bytes_in": self.bytes_in.get_value(),
+            "evictions": self.evictions.get_value(),
+            "expired": self.expirations.get_value(),
+            "by_tenant": by_class,
+            "ttl_s": o.ttl_s,
+        }

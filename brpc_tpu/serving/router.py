@@ -1,0 +1,179 @@
+"""Load-aware prefill→decode routing over the LALB divided-weight
+balancer (``policy/load_balancers.py``'s ``LocalityAwareLB``).
+
+The serving front door needs something a plain LB channel cannot give
+it: the router must KNOW which decode worker it chose (the prefill
+worker pushes the KV handoff to that specific endpoint) and must feed
+the decode call's outcome back into the balancer so a slow or dying
+worker's divided weight collapses within one request time.  This helper
+owns that loop:
+
+  * membership — an explicit target list, or a naming url (``pod://``,
+    ``mesh://``, ``list://``) re-resolved on a poll thread so elastic
+    scale-up/down (the autoscaler's advertise/withdraw epoch moves)
+    reaches the balancer within one refresh interval;
+  * selection — ``pick()`` = LALB ``select_server`` (error-punished,
+    in-flight-extrapolated divided weights) + the per-call exclusion
+    list, so a retry after a dead worker never re-picks it;
+  * feedback — ``feedback(url, error_code, latency_us)`` closes the
+    loop the reference's LALB doctrine (docs/cn/lalb.md) is built on.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..butil import debug_sync as _dbg
+from ..butil.endpoint import parse_endpoint
+from ..policy.load_balancers import LocalityAwareLB
+
+
+class LoadAwareRouter:
+    """LALB selection + channel cache + elastic membership for a router
+    service.  Thread-safe."""
+
+    _GUARDED_BY = {
+        "_channels": "_lock",
+        "_picks": "_lock",
+        "_refresher": "_lock",
+        "_closed": "_lock",
+    }
+
+    def __init__(self, targets, channel_options=None,
+                 refresh_interval_s: float = 0.5):
+        from .. import rpc
+        self._copts = channel_options or rpc.ChannelOptions(
+            timeout_ms=60000)
+        self._lock = _dbg.make_lock("LoadAwareRouter._lock")
+        self._lb = LocalityAwareLB()
+        self._channels: Dict[str, object] = {}
+        self._picks: Dict[str, int] = {}
+        self._closed = False
+        self._refresher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._naming_url = None
+        from ..policy.naming import is_naming_url
+        if isinstance(targets, str) and is_naming_url(targets):
+            self._naming_url = targets
+            self._refresh_interval_s = refresh_interval_s
+            self._refresh_once()
+            with self._lock:
+                # fablint: thread-quiesced(close() sets _stop and joins; the poll loop checks it every interval)
+                t = threading.Thread(target=self._refresh_loop,
+                                     name="serving_router_refresh",
+                                     daemon=True)
+                self._refresher = t
+            t.start()
+        else:
+            if isinstance(targets, str):
+                targets = [t for t in targets.split(",") if t]
+            for url in targets:
+                self.add_target(url)
+
+    # ---- membership ----------------------------------------------------
+    def add_target(self, url: str) -> bool:
+        return self._lb.add_server(parse_endpoint(url))
+
+    def remove_target(self, url: str) -> bool:
+        ep = parse_endpoint(url)
+        ok = self._lb.remove_server(ep)
+        with self._lock:
+            ch = self._channels.pop(str(ep), None)
+        if ch is not None:
+            ch.close()
+        return ok
+
+    def targets(self) -> List[str]:
+        return [str(e.endpoint) for e in self._lb.servers()]
+
+    def _refresh_once(self) -> None:
+        from ..policy.naming import create_naming_service
+        try:
+            entries = create_naming_service(self._naming_url).get_servers()
+        except Exception:
+            return
+        fresh = {e.endpoint for e in entries}
+        have = {e.endpoint for e in self._lb.servers()}
+        for ep in fresh - have:
+            self._lb.add_server(ep)
+        for ep in have - fresh:
+            self.remove_target(str(ep))
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self._refresh_interval_s):
+            self._refresh_once()
+
+    # ---- selection / feedback ------------------------------------------
+    def pick(self, cntl=None,
+             exclude: Optional[set] = None) -> Optional[str]:
+        """Choose a decode worker by divided weight; ``exclude`` carries
+        the endpoints a retry already burned."""
+        if exclude:
+            excl_eps = {parse_endpoint(u) for u in exclude}
+            ep = None
+            for _ in range(8):
+                cand = self._lb.select_server(cntl)
+                if cand is None or cand not in excl_eps:
+                    ep = cand
+                    break
+                # a discarded draw must retire its AddInflight entry or
+                # phantom in-flight accounting pins the worker's
+                # divided weight at the floor after revival
+                self._lb.cancel_inflight(cand)
+            if ep is None:
+                # the weighted draw kept landing on excluded workers:
+                # a retry must still reach ANY remaining member, so
+                # fall back to the membership list directly
+                for e in self._lb.servers():
+                    if e.endpoint not in excl_eps:
+                        ep = e.endpoint
+                        break
+        else:
+            ep = self._lb.select_server(cntl)
+        if ep is None:
+            return None
+        url = str(ep)
+        with self._lock:
+            self._picks[url] = self._picks.get(url, 0) + 1
+        return url
+
+    def channel(self, url: str):
+        from .. import rpc
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router closed")
+            ch = self._channels.get(url)
+            if ch is None:
+                ch = rpc.Channel()
+                ch.init(url, options=self._copts)
+                self._channels[url] = ch
+            return ch
+
+    def feedback(self, url: str, error_code: int,
+                 latency_us: int) -> None:
+        self._lb.feedback(parse_endpoint(url), error_code, latency_us)
+
+    # ---- lifecycle / observability --------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t = self._refresher
+            self._refresher = None
+            self._closed = True
+            chans, self._channels = list(self._channels.values()), {}
+        if t is not None:
+            t.join(2.0)
+        for ch in chans:
+            ch.close()
+
+    def describe(self) -> dict:
+        """The /status serving block's routing half: divided weights +
+        pick distribution per decode worker."""
+        with self._lock:
+            picks = dict(self._picks)
+        weights = {}
+        for e in self._lb.servers():
+            weights[str(e.endpoint)] = round(
+                self._lb.weight_of(e.endpoint), 1)
+        return {"balancer": "la", "weights": weights, "picks": picks,
+                "naming": self._naming_url or "static"}

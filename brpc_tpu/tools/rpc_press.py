@@ -260,6 +260,213 @@ def run_press_fanout(server: str, method: str, n: int,
     return result
 
 
+def collect_serving_stats() -> dict:
+    """The serving summary block: describe_serving() of every serving
+    service hosted IN THIS process (loopback registry + native ici
+    bindings) — pool occupancy, step rate, batch occupancy, router
+    weights.  Remote-only runs report an empty dict (the /status page
+    on the server carries the same block)."""
+    out: dict = {}
+    seen = set()
+
+    def scan(server, label):
+        if id(server) in seen:
+            return
+        seen.add(id(server))
+        for name, svc in server.services().items():
+            fn = getattr(svc, "describe_serving", None)
+            if callable(fn):
+                try:
+                    out[f"{label}/{name}"] = fn()
+                except Exception:
+                    pass
+    try:
+        from brpc_tpu.rpc import loopback
+        with loopback._servers_lock:
+            servers = list(loopback._servers.items())
+        for name, srv in servers:
+            scan(srv, f"mem://{name}")
+    except Exception:
+        pass
+    try:
+        from brpc_tpu.ici import native_plane
+        with native_plane._server_bindings_lock:
+            bindings = list(native_plane._server_bindings.items())
+        for dev, b in bindings:
+            scan(b._server, f"ici://{dev}")
+    except Exception:
+        pass
+    return out
+
+
+def run_press_serving(server: str, duration: float = 5.0,
+                      arrival_rps: float = 20.0, batch_ratio: int = 3,
+                      seq_range: str = "32-96", steps_range: str = "8-64",
+                      max_sessions_inflight: int = 64, verify: bool = False,
+                      out=sys.stderr) -> dict:
+    """``--serving``: OPEN-LOOP session generator against a serving
+    router (``Router.Generate``).  Sessions arrive at a fixed rate
+    regardless of completions (the arrival clock never waits for the
+    server — the load shape a shedding admission layer must absorb),
+    drawn from a mixed population: 1 INTERACTIVE session (priority 0,
+    tenant "inter", short decode) per ``batch_ratio`` BATCH sessions
+    (priority 3, tenant "bulk", long decode).  The summary reports
+    per-tenant session counts, shed/failure split, per-session
+    tokens/s p50/p99, end-to-end latency, and the serving /status
+    block (pool occupancy, step rate, batch occupancy) for every
+    in-process serving server."""
+    import concurrent.futures
+    import json as _json
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc
+    from brpc_tpu.rpc import errors as rpc_errors
+    lo_seq, _, hi_seq = seq_range.partition("-")
+    lo_steps, _, hi_steps = steps_range.partition("-")
+    lo_seq, hi_seq = int(lo_seq), int(hi_seq or lo_seq)
+    lo_steps, hi_steps = int(lo_steps), int(hi_steps or lo_steps)
+    targets = resolve_targets(server)
+    channels = []
+    for t in targets:
+        ch = rpc.Channel()
+        ch.init(t, options=rpc.ChannelOptions(timeout_ms=30000,
+                                              max_retry=0))
+        channels.append(ch)
+    try:
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+    except ImportError:
+        import os as _os
+        sys.path.insert(0, _os.getcwd())
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+
+    # plain lists, not bvar percentiles: per-session tokens/s can be
+    # a small number (long batch decodes) and the latency-percentile
+    # buckets would quantize it to 0
+    classes = {
+        "inter": {"sessions": 0, "ok": 0, "shed": 0, "fail": 0,
+                  "tokens": 0, "lat": [], "tps": []},
+        "bulk": {"sessions": 0, "ok": 0, "shed": 0, "fail": 0,
+                 "tokens": 0, "lat": [], "tps": []},
+    }
+    lock = threading.Lock()
+    mismatches = [0]
+    stop_evt = threading.Event()
+    prev_sigint = None
+    try:
+        prev_sigint = signal.signal(signal.SIGINT,
+                                    lambda *_: stop_evt.set())
+    except ValueError:
+        pass
+
+    def one_session(i: int) -> None:
+        is_batch = (i % (batch_ratio + 1)) != 0
+        tenant = "bulk" if is_batch else "inter"
+        # deterministic per-index draws (no RNG: replayable load)
+        seq = lo_seq + (i * 13) % max(hi_seq - lo_seq + 1, 1)
+        steps = (hi_steps if is_batch
+                 else lo_steps + (i * 7) % max(
+                     min(hi_steps // 2, hi_steps) - lo_steps + 1, 1))
+        tokens = [(i * 31 + j) % 997 for j in range(seq)]
+        cntl = rpc.Controller()
+        cntl.priority = 3 if is_batch else 0
+        cntl.tenant = tenant
+        t0 = time.perf_counter_ns()
+        resp = channels[i % len(channels)].call_method(
+            "Router.Generate", cntl,
+            EchoRequest(message=_json.dumps(
+                {"tokens": tokens, "steps": steps})), EchoResponse)
+        lat_us = (time.perf_counter_ns() - t0) // 1000
+        got = None
+        if not cntl.failed():
+            got = _json.loads(resp.message)["tokens"]
+            if verify:
+                from examples.disagg_serving.model import \
+                    reference_generate
+                if got != reference_generate(tokens, steps):
+                    with lock:
+                        mismatches[0] += 1
+        with lock:
+            c = classes[tenant]
+            c["sessions"] += 1
+            if cntl.failed():
+                if cntl.error_code_ in (rpc_errors.ELIMIT,
+                                        rpc_errors.ELOGOFF):
+                    c["shed"] += 1
+                else:
+                    c["fail"] += 1
+            else:
+                c["ok"] += 1
+                c["tokens"] += len(got)
+                c["lat"].append(lat_us)
+                if lat_us > 0:
+                    c["tps"].append(len(got) * 1e6 / lat_us)
+
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=max_sessions_inflight)
+    interval = 1.0 / max(arrival_rps, 0.1)
+    t_start = time.monotonic()
+    deadline = t_start + duration
+    next_fire = t_start
+    i = 0
+    issued = 0
+    while not stop_evt.is_set():
+        now = time.monotonic()
+        if now >= deadline:
+            break
+        if now < next_fire:
+            time.sleep(min(next_fire - now, 0.01))
+            continue
+        # OPEN loop: the arrival clock advances whether or not the
+        # previous sessions completed
+        next_fire += interval
+        pool.submit(one_session, i)
+        issued += 1
+        i += 1
+    pool.shutdown(wait=True)
+    elapsed = time.monotonic() - t_start
+    if prev_sigint is not None:
+        try:
+            signal.signal(signal.SIGINT, prev_sigint)
+        except ValueError:
+            pass
+    def pct(vals, q):
+        if not vals:
+            return -1.0
+        vals = sorted(vals)
+        return round(vals[min(int(len(vals) * q), len(vals) - 1)], 1)
+
+    total_tokens = sum(c["tokens"] for c in classes.values())
+    result = {
+        "serving": True,
+        "targets": targets,
+        "arrival_rps": arrival_rps,
+        "issued": issued,
+        "elapsed_s": round(elapsed, 2),
+        "tokens_per_s": round(total_tokens / elapsed, 1) if elapsed
+        else 0.0,
+        "verify": verify,
+        "mismatches": mismatches[0],
+        "interrupted": stop_evt.is_set(),
+        "per_tenant": {
+            name: {
+                "sessions": c["sessions"], "ok": c["ok"],
+                "shed": c["shed"], "failures": c["fail"],
+                "tokens": c["tokens"],
+                "latency_p50_us": pct(c["lat"], 0.5),
+                "latency_p99_us": pct(c["lat"], 0.99),
+                "session_tokens_per_s_p50": pct(c["tps"], 0.5),
+                "session_tokens_per_s_p99": pct(c["tps"], 0.99),
+            } for name, c in classes.items()},
+    }
+    stats = collect_serving_stats()
+    if stats:
+        result["serving_status"] = stats
+    print(json.dumps(result), file=out)
+    for ch in channels:
+        ch.close()
+    return result
+
+
 def apply_shm_stripes(n: int) -> None:
     """``--shm-stripes N``: force the striped shm plane (ISSUE 12) —
     N SPSC ring pairs per segment, round-robin for unary frames,
@@ -451,7 +658,9 @@ def main(argv=None) -> int:
     ap.add_argument("--server", required=True,
                     help="endpoint, comma-separated endpoint list, or "
                          "naming url (mesh://, pod://name, list://…)")
-    ap.add_argument("--method", required=True)
+    ap.add_argument("--method", default=None,
+                    help="full method name (required except with "
+                         "--serving, which drives Router.Generate)")
     ap.add_argument("--request", default="{}")
     ap.add_argument("--qps", type=int, default=0, help="0 = unthrottled")
     ap.add_argument("--duration", type=float, default=5.0)
@@ -492,7 +701,39 @@ def main(argv=None) -> int:
                          "call counts")
     ap.add_argument("--fanout-shard-bytes", type=int, default=512,
                     help="bytes per member shard in --fanout mode")
+    ap.add_argument("--serving", action="store_true",
+                    help="open-loop serving session generator against "
+                         "a Router.Generate front door: mixed "
+                         "interactive/batch tenants at a fixed arrival "
+                         "rate; summary reports per-tenant tokens/s "
+                         "p50/p99 and pool occupancy")
+    ap.add_argument("--serving-arrival-rps", type=float, default=20.0,
+                    help="session arrivals per second (open loop: the "
+                         "clock never waits for completions)")
+    ap.add_argument("--serving-batch-ratio", type=int, default=3,
+                    help="batch sessions per interactive session")
+    ap.add_argument("--serving-seq", default="32-96",
+                    help="prompt length range, e.g. 32-96")
+    ap.add_argument("--serving-steps", default="8-64",
+                    help="decode steps range: interactive draws from "
+                         "the low half, batch takes the high bound")
+    ap.add_argument("--serving-verify", action="store_true",
+                    help="verify every completion against the "
+                         "single-process reference (slow: reference "
+                         "prefill per session)")
     args = ap.parse_args(argv)
+    if args.serving:
+        run_press_serving(args.server, duration=args.duration,
+                          arrival_rps=args.serving_arrival_rps,
+                          batch_ratio=args.serving_batch_ratio,
+                          seq_range=args.serving_seq,
+                          steps_range=args.serving_steps,
+                          max_sessions_inflight=max(args.concurrency, 8),
+                          verify=args.serving_verify, out=sys.stdout)
+        return 0
+    if not args.method:
+        raise SystemExit("rpc_press: --method is required "
+                         "(except with --serving)")
     if args.fanout > 0:
         run_press_fanout(args.server, args.method, args.fanout,
                          duration=args.duration,

@@ -88,6 +88,16 @@ def main() -> int:
         from brpc_tpu.ici.device_plane import DevicePlane
         stats = DevicePlane.instance().stats()
         print("device plane:", stats)
+        # the serving subsystem's route assertion: the decode worker's
+        # /status serving block (continuous-batching scheduler + paged
+        # pool) — tokens came through the step loop, not a sync path
+        for srv in (decode_a, decode_b):
+            for name, svc in srv._services.items():
+                if hasattr(svc, "describe_serving"):
+                    d = svc.describe_serving()
+                    print(f"serving[{name}@{srv.listen_endpoint}]: "
+                          f"steps={d['scheduler']['steps']} "
+                          f"pool_blocks_used={d['pool']['blocks_used']}")
         assert stats["transfers"] > 0, (
             "KV handoff never crossed the device plane", stats)
         # the last request's trace as one tree (single process here;

@@ -1,42 +1,60 @@
-"""Disaggregated prefill/decode workers and the router front-end.
+"""Disaggregated prefill/decode workers, rebuilt on ``brpc_tpu.serving``.
 
-Three roles, each an ordinary brpc_tpu server:
+Three roles, each an ordinary brpc_tpu server — same wire surface as
+the original example (JSON bodies in EchoRequest.message, bulk bytes in
+attachments), but the decode side is now the REAL serving subsystem
+(ROADMAP item 3), not a one-RPC-one-token toy:
 
-  * **PrefillService** (``Prefill``): turns a prompt into quantized
-    KV-cache blocks on its own device, then HANDS THEM OFF to the chosen
-    decode worker — one ``DecodeService.LoadKv`` call whose request
-    attachment is the KV tensor as a DEVICE payload.  Cross-process this
-    rides the fabric's sequenced device plane (``ici_device_plane_xproc``;
-    compiled collectives on TPU pods, bulk-carried under the same total
-    order elsewhere); in-process it is a device-plane/ref-pass hop.  The
-    prefill worker never talks to the client again — the point of
-    disaggregation.
-  * **DecodeService** (``LoadKv`` / ``Decode``): parks sessions' KV
-    blocks and streams tokens out of them.  ``Decode`` releases the
-    session when ``release`` is set.
-  * **RouterService** (``Generate``): the front door — picks a prefill
-    worker and a decode worker through load-balanced channels (any
-    naming source: ``list://``, ``mesh://``, ``pod://``), orchestrates
-    prefill → handoff → decode, and returns the tokens.
-
-Request/response bodies are JSON in EchoRequest.message (the examples'
-lingua franca); bulk bytes ride attachments, never the JSON.
+  * **PrefillService** (``Prefill``): prompt → quantized KV blocks on
+    its own device, HANDED OFF to the router-chosen decode worker as a
+    DEVICE-payload attachment (``DecodeService.LoadKv``).  Cross-process
+    this rides the fabric's sequenced device plane; on the native-ici
+    plane the attachment moves under PR-12 custody (one parked handle,
+    zero Python seg walks until the pool copy).
+  * **DecodeService** (``LoadKv`` / ``Decode``): KV pages into a
+    :class:`~brpc_tpu.serving.PagedKvPool` (admission-aware eviction,
+    TimerThread expiry — an idle worker reclaims parked sessions with
+    zero traffic, the ISSUE-14 bugfix) and tokens stream out of a
+    :class:`~brpc_tpu.serving.ContinuousBatchScheduler`: one batched
+    step per tick over every active session, admit/retire/preempt
+    between steps.  ``Decode`` is an ASYNC handler — the RPC completes
+    from the step loop when the session's tokens are done, so N
+    concurrent sessions share each step instead of serializing.
+    ``{"mode": "sync"}`` keeps the old one-RPC-one-shot path (the
+    bench's A/B baseline).
+  * **RouterService** (``Generate``): the front door — prefill via any
+    LB channel, decode worker chosen by the LALB divided-weight
+    balancer (:class:`~brpc_tpu.serving.LoadAwareRouter`): every decode
+    outcome feeds the balancer, a dead/slow worker's weight collapses
+    within one request time, and failures RETRY against another worker
+    (re-prefill) so elastic scale-down/kill stays invisible to clients.
+    ``decode_targets`` may be the original explicit dict, a list, or a
+    naming url (``pod://name``) for elastic membership.
 """
 from __future__ import annotations
 
 import json
 import sys
-import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Union
 
 sys.path.insert(0, __file__.rsplit("/", 3)[0])   # repo root
 
+import numpy as np
+
 import brpc_tpu.policy  # noqa: F401  (registers protocols)
 from brpc_tpu import rpc
+from brpc_tpu.butil import debug_sync as _dbg
+from brpc_tpu.serving import (BatchSchedulerOptions,
+                              ContinuousBatchScheduler, KvPoolOptions,
+                              LoadAwareRouter, PagedKvPool, PoolSaturated,
+                              SessionBusy, StepRequest)
 from examples.example_echo_pb2 import EchoRequest, EchoResponse
 
-from .model import toy_kv_blocks, toy_decode, kv_nbytes
+from .model import (KV_DMODEL, KV_LAYERS, VOCAB, kv_nbytes, toy_decode,
+                    toy_kv_blocks)
+
+BYTES_PER_TOKEN = KV_LAYERS * KV_DMODEL
 
 
 def _reply(response, done, **kw) -> None:
@@ -47,13 +65,16 @@ def _reply(response, done, **kw) -> None:
 class PrefillService(rpc.Service):
     SERVICE_NAME = "Prefill"
 
+    _GUARDED_BY = {"_channels": "_lock", "prefills": "_lock",
+                   "handoff_bytes": "_lock", "handoff_ns": "_lock"}
+
     def __init__(self, device=None,
                  channel_options: Optional[rpc.ChannelOptions] = None):
         self.device = device
         self.channel_options = channel_options or rpc.ChannelOptions(
             timeout_ms=60000)
         self._channels: Dict[str, rpc.Channel] = {}
-        self._lock = threading.Lock()
+        self._lock = _dbg.make_lock("PrefillService._lock")
         self.prefills = 0
         self.handoff_bytes = 0
         self.handoff_ns = 0      # cumulative LoadKv round-trip time
@@ -85,6 +106,8 @@ class PrefillService(rpc.Service):
         jax.block_until_ready(kv)
         t1 = time.perf_counter_ns()
         # the KV-cache handoff: device payload to the decode worker
+        # (the inbound call's priority/tenant/deadline budget cascade
+        # onto this outbound call — PR-10 request context)
         ch = self._channel_to(decode_target)
         hand = rpc.Controller()
         hand.request_attachment.append_device_array(kv)
@@ -94,6 +117,7 @@ class PrefillService(rpc.Service):
         ch.call_method("Decode.LoadKv", hand, load, EchoResponse)
         t2 = time.perf_counter_ns()
         if hand.failed():
+            cntl.retry_after_ms = hand.retry_after_ms
             cntl.set_failed(hand.error_code_,
                             f"kv handoff failed: {hand.error_text}")
             done()
@@ -111,26 +135,56 @@ class PrefillService(rpc.Service):
 class DecodeService(rpc.Service):
     SERVICE_NAME = "Decode"
 
-    # an orphaned session — LoadKv landed but the router's Decode never
-    # arrived (drain ELOGOFF with retries exhausted, router crash) —
-    # would park its KV block forever; sweep stale entries past this
-    # age opportunistically on every LoadKv (no reaper thread needed)
-    SESSION_TTL_S = 120.0
+    # ("loads" stays out of the guard map: the analyzer would match the
+    # attribute name on any receiver, including json.loads — the counter
+    # is still only written under _lock)
+    _GUARDED_BY = {"kv_bytes_in": "_lock", "decode_steps": "_lock"}
 
-    def __init__(self, device=None):
+    def __init__(self, device=None,
+                 pool_options: Optional[KvPoolOptions] = None,
+                 sched_options: Optional[BatchSchedulerOptions] = None):
         self.device = device
-        self._sessions: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
+        self.pool = PagedKvPool(pool_options or KvPoolOptions(
+            bytes_per_token=BYTES_PER_TOKEN, num_blocks=1024,
+            block_tokens=16))
+        self.scheduler = ContinuousBatchScheduler(
+            self.pool, sched_options or BatchSchedulerOptions(
+                vocab=VOCAB, max_batch=64))
+        self._lock = _dbg.make_lock("DecodeService._lock")
         self.loads = 0
         self.kv_bytes_in = 0
         self.decode_steps = 0
-        self.sessions_expired = 0
+
+    @property
+    def sessions_expired(self) -> int:
+        """TTL-reclaimed session count — now the pool's TIMER-driven
+        policy (the old inline LoadKv sweep parked KV forever on an
+        idle worker)."""
+        return self.pool.expirations.get_value()
+
+    def live_sessions(self) -> int:
+        return self.pool.sessions()
+
+    def close(self) -> None:
+        self.scheduler.stop()
+        self.pool.close()
+
+    def describe_serving(self) -> dict:
+        """The /status serving block: step rate, batch occupancy, pool
+        pages, evictions by reason/tenant."""
+        return {"scheduler": self.scheduler.describe(),
+                "pool": self.pool.describe()}
 
     @rpc.method(EchoRequest, EchoResponse)
     def LoadKv(self, cntl, request, response, done):
         req = json.loads(request.message)
         session = req["session"]
         seq_len = req["seq_len"]
+        if seq_len <= 0:
+            cntl.set_failed(rpc.errors.EREQUEST,
+                            f"seq_len must be >= 1, got {seq_len}")
+            done()
+            return
         want = kv_nbytes(seq_len)
         blob = cntl.request_attachment.to_bytes()
         if len(blob) != want:
@@ -138,15 +192,32 @@ class DecodeService(rpc.Service):
                             f"kv size {len(blob)} != {want}")
             done()
             return
-        now = time.monotonic()
+        # layer-major wire layout → token-major pool rows, ONE transpose
+        # at the pool boundary (each block row is one token's bytes)
+        rows = np.frombuffer(blob, np.uint8).reshape(
+            KV_LAYERS, seq_len, KV_DMODEL).transpose(1, 0, 2).reshape(
+            seq_len, BYTES_PER_TOKEN)
+        try:
+            self.pool.load(session, rows, last_token=req["last_token"],
+                           tenant=cntl.tenant or req.get("tenant", ""),
+                           priority=cntl.priority)
+        except PoolSaturated:
+            # memory pressure with nothing evictable in an equal-or-
+            # less-protected band: a shed, not a failure
+            cntl.retry_after_ms = 20
+            cntl.set_failed(rpc.errors.ELIMIT,
+                            "kv pool saturated (shed): retry later")
+            done()
+            return
+        except SessionBusy as e:
+            # re-prefill raced the running decode: retry once it
+            # completes — freeing the rostered blocks mid-program
+            # would corrupt the batched step
+            cntl.retry_after_ms = 10
+            cntl.set_failed(rpc.errors.ELIMIT, str(e))
+            done()
+            return
         with self._lock:
-            stale = [s for s, e in self._sessions.items()
-                     if now - e[3] > self.SESSION_TTL_S]
-            for s in stale:
-                del self._sessions[s]
-            self.sessions_expired += len(stale)
-            self._sessions[session] = (blob, seq_len, req["last_token"],
-                                       now)
             self.loads += 1
             self.kv_bytes_in += want
         _reply(response, done, session=session, loaded=want)
@@ -156,38 +227,87 @@ class DecodeService(rpc.Service):
         req = json.loads(request.message)
         session = req["session"]
         steps = req["steps"]
-        with self._lock:
-            entry = self._sessions.get(session)
-        if entry is None:
-            cntl.set_failed(rpc.errors.EREQUEST,
-                            f"unknown session {session!r}")
+        release = req.get("release", True)
+        if steps <= 0:
+            _reply(response, done, session=session, tokens=[])
+            return
+        if req.get("mode") == "sync":
+            self._decode_sync(cntl, session, steps, release, response,
+                              done)
+            return
+        self.pool.touch(session)
+        deadline_us = None
+        if cntl.deadline_left_ms:
+            deadline_us = (time.monotonic_ns() // 1000
+                           + cntl.deadline_left_ms * 1000)
+
+        def emit(tokens):
+            with self._lock:
+                self.decode_steps += len(tokens)
+            if release:
+                self.pool.release(session)
+            _reply(response, done, session=session, tokens=tokens)
+
+        def fail(code, text, retry_after_ms):
+            if retry_after_ms:
+                cntl.retry_after_ms = retry_after_ms
+            cntl.set_failed(code, text)
+            done()
+
+        # ASYNC: the RPC completes from the step loop when this
+        # session's tokens are done — the handler thread is free
+        self.scheduler.submit(StepRequest(
+            session, steps, emit, fail, priority=cntl.priority,
+            tenant=cntl.tenant, deadline_us=deadline_us))
+
+    def _decode_sync(self, cntl, session, steps, release, response,
+                     done) -> None:
+        """The pre-batching one-RPC-one-shot path (bench A/B baseline):
+        materialize the session out of the pool and decode inline."""
+        snap = self.pool.snapshot(session)
+        if snap is None:
+            reason = self.pool.evicted_reason(session)
+            if reason is not None:
+                cntl.retry_after_ms = 1
+                cntl.set_failed(rpc.errors.ELIMIT,
+                                f"kv {reason}-evicted: re-prefill")
+            else:
+                cntl.set_failed(rpc.errors.EREQUEST,
+                                f"unknown session {session!r}")
             done()
             return
-        blob, seq_len, last_token, _loaded_at = entry
-        import numpy as np
-        toks = toy_decode(np.frombuffer(blob, np.uint8), seq_len,
-                          last_token, steps)
+        rows, seq_len, last_token = snap
+        # token-major rows → the model's layer-major flat layout
+        flat = rows.reshape(seq_len, KV_LAYERS, KV_DMODEL).transpose(
+            1, 0, 2).reshape(-1)
+        toks = toy_decode(flat, seq_len, last_token, steps)
         with self._lock:
             self.decode_steps += steps
-            if req.get("release", True):
-                self._sessions.pop(session, None)
+        if release:
+            self.pool.release(session)
+        else:
+            self.pool.touch(session)
         _reply(response, done, session=session, tokens=toks)
-
-    def live_sessions(self) -> int:
-        with self._lock:
-            return len(self._sessions)
 
 
 class RouterService(rpc.Service):
     SERVICE_NAME = "Router"
 
-    def __init__(self, prefill_targets: str, decode_targets: Dict[str, str],
+    _GUARDED_BY = {"_next_session": "_lock", "retries": "_lock",
+                   "generate_failures": "_lock"}
+
+    #: decode attempts per Generate (the elastic-chaos survival knob:
+    #: a killed worker's in-flight sessions re-prefill elsewhere)
+    MAX_DECODE_ATTEMPTS = 3
+
+    def __init__(self, prefill_targets: str,
+                 decode_targets: Union[Dict[str, str], list, str],
                  channel_options: Optional[rpc.ChannelOptions] = None):
         """``prefill_targets``: naming url (or single endpoint) for the
-        prefill pool.  ``decode_targets``: {decode worker endpoint url:
-        same url} — the router addresses a SPECIFIC decode worker so the
-        prefill worker knows where to push the KV; a dict keeps the
-        choice explicit and round-robin-able."""
+        prefill pool.  ``decode_targets``: explicit dict/list of decode
+        worker urls, or a naming url (``pod://name``) for elastic
+        membership — either way the LALB divided-weight balancer picks
+        the worker and every outcome feeds back."""
         opts = channel_options or rpc.ChannelOptions(timeout_ms=60000,
                                                      max_retry=2)
         from brpc_tpu.policy.naming import is_naming_url
@@ -195,26 +315,32 @@ class RouterService(rpc.Service):
         self._prefill.init(prefill_targets,
                            "rr" if is_naming_url(prefill_targets) else "",
                            options=opts)
-        self._decode_urls = list(decode_targets)
-        self._decode_chs: Dict[str, rpc.Channel] = {}
-        for url in self._decode_urls:
-            ch = rpc.Channel()
-            ch.init(url, options=opts)
-            self._decode_chs[url] = ch
-        self._rr = 0
-        self._lock = threading.Lock()
+        if isinstance(decode_targets, dict):
+            decode_targets = list(decode_targets)
+        self._router = LoadAwareRouter(decode_targets,
+                                       channel_options=opts)
+        self._lock = _dbg.make_lock("RouterService._lock")
         self._next_session = 0
+        self.retries = 0
+        self.generate_failures = 0
 
     def close(self) -> None:
         self._prefill.close()
-        for ch in self._decode_chs.values():
-            ch.close()
+        self._router.close()
 
-    def _pick_decode(self) -> str:
+    # elastic membership (the autoscaler's registration surface; a
+    # naming-url router tracks pod:// transitions by itself)
+    def add_decode_target(self, url: str) -> bool:
+        return self._router.add_target(url)
+
+    def remove_decode_target(self, url: str) -> bool:
+        return self._router.remove_target(url)
+
+    def describe_serving(self) -> dict:
         with self._lock:
-            url = self._decode_urls[self._rr % len(self._decode_urls)]
-            self._rr += 1
-            return url
+            extra = {"retries": self.retries,
+                     "generate_failures": self.generate_failures}
+        return {"router": {**self._router.describe(), **extra}}
 
     @rpc.method(EchoRequest, EchoResponse)
     def Generate(self, cntl, request, response, done):
@@ -223,34 +349,95 @@ class RouterService(rpc.Service):
         steps = req.get("steps", 8)
         with self._lock:
             self._next_session += 1
-            session = f"s{self._next_session}"
-        decode_url = self._pick_decode()
-        pc = rpc.Controller()
-        pre_resp = self._prefill.call_method(
-            "Prefill.Prefill", pc,
-            EchoRequest(message=json.dumps(
-                {"session": session, "tokens": tokens,
-                 "decode": decode_url})), EchoResponse)
-        if pc.failed():
-            cntl.set_failed(pc.error_code_,
+            base_session = self._next_session
+        tried: set = set()
+        last_err = (rpc.errors.EINTERNAL, "no decode worker available")
+        for attempt in range(self.MAX_DECODE_ATTEMPTS):
+            decode_url = self._router.pick(exclude=tried)
+            if decode_url is None:
+                break
+            # one session id per attempt: a retry re-prefills, never
+            # half-reuses a dead worker's parked KV
+            session = f"s{base_session}" if attempt == 0 \
+                else f"s{base_session}r{attempt}"
+            pc = rpc.Controller()
+            t_pre = time.perf_counter_ns()
+            pre_resp = self._prefill.call_method(
+                "Prefill.Prefill", pc,
+                EchoRequest(message=json.dumps(
+                    {"session": session, "tokens": tokens,
+                     "decode": decode_url})), EchoResponse)
+            pre_us = (time.perf_counter_ns() - t_pre) // 1000
+            if pc.failed():
+                if pc.error_code_ == rpc.errors.ELIMIT \
+                        and "kv handoff failed" not in pc.error_text:
+                    # the PREFILL admission shed this tenant: not the
+                    # decode worker's fault — pass the shed (and its
+                    # backoff hint) straight to the client.  (An ELIMIT
+                    # whose text says the HANDOFF failed is the decode
+                    # side's — saturated pool, busy session — and falls
+                    # through to the punish-and-retry path below.)
+                    if pc.retry_after_ms:
+                        cntl.retry_after_ms = pc.retry_after_ms
+                    cntl.set_failed(pc.error_code_,
+                                    f"prefill shed: {pc.error_text}")
+                    done()
+                    return
+                # the handoff INSIDE prefill failed against this decode
+                # worker (dead/saturated): punish its weight and retry
+                # another one.  The REAL elapsed time matters: LALB's
+                # error punishment scales with the reported latency, so
+                # a 0-µs error sample would INFLATE the dead worker's
+                # weight instead of collapsing it
+                self._router.feedback(decode_url, pc.error_code_,
+                                      max(pre_us, 1))
+                tried.add(decode_url)
+                last_err = (pc.error_code_,
                             f"prefill failed: {pc.error_text}")
-            done()
-            return
-        pre = json.loads(pre_resp.message)
-        dc = rpc.Controller()
-        dec_resp = self._decode_chs[decode_url].call_method(
-            "Decode.Decode", dc,
-            EchoRequest(message=json.dumps(
-                {"session": session, "steps": steps, "release": True})),
-            EchoResponse)
-        if dc.failed():
-            cntl.set_failed(dc.error_code_,
+                with self._lock:
+                    self.retries += 1
+                continue
+            pre = json.loads(pre_resp.message)
+            dc = rpc.Controller()
+            t0 = time.perf_counter_ns()
+            dec_resp = self._router.channel(decode_url).call_method(
+                "Decode.Decode", dc,
+                EchoRequest(message=json.dumps(
+                    {"session": session, "steps": steps,
+                     "release": req.get("release", True),
+                     **({"mode": req["mode"]} if "mode" in req
+                        else {})})),
+                EchoResponse)
+            lat_us = (time.perf_counter_ns() - t0) // 1000
+            self._router.feedback(decode_url, dc.error_code_
+                                  if dc.failed() else 0, lat_us)
+            if dc.failed():
+                # ELIMIT is a SHED, not a dead worker: an evicted/
+                # expired session just needs a re-prefill (possibly on
+                # the SAME worker — with one worker, excluding it would
+                # turn a recoverable shed into a client-visible
+                # failure), and a saturated pool is already being
+                # steered away from by the LALB weight punishment.
+                # Anything else (dead socket, drain) excludes the
+                # worker from this call's retries.
+                if dc.error_code_ != rpc.errors.ELIMIT:
+                    tried.add(decode_url)
+                last_err = (dc.error_code_,
                             f"decode failed: {dc.error_text}")
-            done()
+                if dc.retry_after_ms:
+                    cntl.retry_after_ms = dc.retry_after_ms
+                with self._lock:
+                    self.retries += 1
+                continue
+            toks = json.loads(dec_resp.message)["tokens"]
+            _reply(response, done, session=session, tokens=toks,
+                   decode_worker=decode_url,
+                   kv_bytes=pre.get("kv_bytes", 0))
             return
-        toks = json.loads(dec_resp.message)["tokens"]
-        _reply(response, done, session=session, tokens=toks,
-               decode_worker=decode_url, kv_bytes=pre.get("kv_bytes", 0))
+        with self._lock:
+            self.generate_failures += 1
+        cntl.set_failed(last_err[0], last_err[1])
+        done()
 
 
 def start_prefill_worker(addr: str, device=None,
@@ -264,17 +451,23 @@ def start_prefill_worker(addr: str, device=None,
 
 
 def start_decode_worker(addr: str, device=None,
-                        options: Optional[rpc.ServerOptions] = None
+                        options: Optional[rpc.ServerOptions] = None,
+                        pool_options: Optional[KvPoolOptions] = None,
+                        sched_options: Optional[
+                            BatchSchedulerOptions] = None
                         ) -> rpc.Server:
     server = rpc.Server(options)
-    server.add_service(DecodeService(device=device))
+    server.add_service(DecodeService(device=device,
+                                     pool_options=pool_options,
+                                     sched_options=sched_options))
     rc = server.start(addr)
     assert rc == 0, f"decode worker start failed: {rc}"
     return server
 
 
 def start_router(addr: str, prefill_targets: str,
-                 decode_targets: Dict[str, str]) -> rpc.Server:
+                 decode_targets: Union[Dict[str, str], list, str]
+                 ) -> rpc.Server:
     server = rpc.Server()
     server.add_service(RouterService(prefill_targets, decode_targets))
     rc = server.start(addr)

@@ -1,0 +1,31 @@
+"""Seeded violation for the serving KV pool's free list (ISSUE 14): a
+pool-like class that swaps its block free list outside the pool lock —
+the exact shape of PagedKvPool._free, which must move ATOMICALLY with
+the session tables (a loader popping free blocks while a racy reset
+replaces the list would hand the same block to two sessions — one
+tenant's KV bytes readable through another's block table)."""
+import threading
+
+
+class KvPool:
+    _GUARDED_BY = {"_free": "_lock", "_tables": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free = list(range(8))
+        self._tables = {}
+
+    def alloc_locked(self, session, n):
+        with self._lock:
+            blocks = [self._free.pop() for _ in range(n)]
+            self._tables[session] = blocks
+            return blocks
+
+    def reset_racy(self):
+        with self._lock:
+            self._tables.clear()
+        self._free = list(range(8))    # line 27: the violation
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._free), dict(self._tables)

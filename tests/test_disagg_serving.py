@@ -40,12 +40,12 @@ class TestDisaggServing:
         return prefill, decode, router
 
     def _teardown(self, prefill, decode, router):
-        for svc in router._services.values():
-            if hasattr(svc, "close"):
-                svc.close()
-        for svc in prefill._services.values():
-            if hasattr(svc, "close"):
-                svc.close()
+        # close every service that carries resources: channels (router,
+        # prefill) AND the decode worker's step loop + paged pool
+        for server in (router, prefill, decode):
+            for svc in server._services.values():
+                if hasattr(svc, "close"):
+                    svc.close()
         router.stop()
         decode.stop()
         prefill.stop()

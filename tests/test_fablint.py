@@ -120,6 +120,16 @@ class TestFixtureViolations:
             and "_lock" in out[0].message
         assert out[0].path.endswith("bad_usercode_pool.py")
 
+    def test_unguarded_kv_free_list_swap_reported_with_line(self):
+        """The serving KV pool's state class (ISSUE 14): swapping the
+        block free list outside the pool lock is caught at the exact
+        file:line — _free must move atomically with the session tables
+        or two sessions can share a block (cross-tenant KV leak)."""
+        out = _findings("bad_kv_pool.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 27)]
+        assert "_free" in out[0].message and "_lock" in out[0].message
+        assert out[0].path.endswith("bad_kv_pool.py")
+
     def test_clean_fixture_is_silent(self):
         out = _findings(
             "clean_module.py",
@@ -214,7 +224,8 @@ class TestZeroFindingsGate:
         hot = ["rpc/socket.py", "rpc/stream.py", "rpc/health_check.py",
                "ici/fabric.py", "ici/transport.py", "ici/device_plane.py",
                "policy/load_balancers.py", "butil/resource_pool.py",
-               "bthread/scheduler.py"]
+               "bthread/scheduler.py", "serving/kv_pool.py",
+               "serving/scheduler.py", "serving/autoscaler.py"]
         for rel in hot:
             src = open(os.path.join(PKG, rel)).read()
             assert "_GUARDED_BY" in src, f"{rel} lost its guard map"

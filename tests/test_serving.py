@@ -1,0 +1,1119 @@
+"""Production serving subsystem (brpc_tpu/serving, ISSUE 14).
+
+Five legs:
+
+  * **PagedKvPool units** — block accounting, byte-exact custody,
+    admission-aware eviction order (band before weight before LRU, the
+    protected-band fence), pins, and the TIMER-DRIVEN expiry sweep (the
+    ISSUE-14 bugfix regression: a parked session on an otherwise-idle
+    worker is reclaimed with zero new traffic);
+  * **ContinuousBatchScheduler units** (manual stepping) — per-step
+    admit/retire, tokens bit-exact against the single-process reference
+    under staggered joins, interactive preemption preserving progress,
+    deadline expiry in the batch queue, compiled-step parity;
+  * **service level** — the rebuilt disaggregated workers: batched
+    decode end-to-end with the route asserted through the /status
+    serving block, LALB prefill→decode routing, pool-saturation sheds
+    with retry hints, and the idle-reclaim regression over a real RPC;
+  * **autoscaler units** — watermark/hysteresis/cooldown decisions on
+    an injected clock;
+  * **elastic chaos** (tier-1, one subprocess with a real pod) —
+    scale-up + kill + revive + scale-down mid-traffic: zero
+    client-visible failures, every completion bit-exact, the pod epoch
+    delta asserted.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import brpc_tpu.policy  # noqa: F401
+from brpc_tpu import rpc
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model():
+    from examples.disagg_serving import model
+    return model
+
+
+def _rows(tokens):
+    """Prompt → token-major pool rows (the LoadKv transpose)."""
+    m = _model()
+    kv = np.asarray(m.toy_kv_blocks(tokens))
+    seq = len(tokens)
+    return kv.reshape(m.KV_LAYERS, seq, m.KV_DMODEL).transpose(
+        1, 0, 2).reshape(seq, m.KV_LAYERS * m.KV_DMODEL)
+
+
+def _mk_pool(num_blocks=32, block_tokens=8, ttl_s=120.0,
+             use_timers=False, now=None, **kw):
+    from brpc_tpu.serving import KvPoolOptions, PagedKvPool
+    m = _model()
+    opts = KvPoolOptions(bytes_per_token=m.KV_LAYERS * m.KV_DMODEL,
+                         num_blocks=num_blocks,
+                         block_tokens=block_tokens, ttl_s=ttl_s,
+                         use_timers=use_timers, **kw)
+    return PagedKvPool(opts, now=now)
+
+
+def _mk_sched(pool, max_batch=8, **kw):
+    from brpc_tpu.serving import (BatchSchedulerOptions,
+                                  ContinuousBatchScheduler)
+    m = _model()
+    kw.setdefault("auto_start", False)
+    return ContinuousBatchScheduler(
+        pool, BatchSchedulerOptions(vocab=m.VOCAB, max_batch=max_batch,
+                                    **kw))
+
+
+class _Sink:
+    """Collects one StepRequest outcome."""
+
+    def __init__(self):
+        self.tokens = None
+        self.error = None
+
+    def emit(self, tokens):
+        self.tokens = list(tokens)
+
+    def fail(self, code, text, retry_after_ms):
+        self.error = (code, text, retry_after_ms)
+
+
+def _submit(sched, session, steps, priority=None, tenant="",
+            deadline_us=None):
+    from brpc_tpu.serving import StepRequest
+    sink = _Sink()
+    sched.submit(StepRequest(session, steps, sink.emit, sink.fail,
+                             priority=priority, tenant=tenant,
+                             deadline_us=deadline_us))
+    return sink
+
+
+# ---------------------------------------------------------------------------
+# Paged KV pool.
+# ---------------------------------------------------------------------------
+
+class TestPagedKvPool:
+    def test_load_materialize_byte_exact_and_accounting(self):
+        pool = _mk_pool(num_blocks=16, block_tokens=8)
+        try:
+            t1 = [3 * j % 97 for j in range(20)]     # 3 blocks
+            t2 = [5 * j % 89 for j in range(8)]      # 1 block
+            r1, r2 = _rows(t1), _rows(t2)
+            pool.load("a", r1, last_token=t1[-1])
+            pool.load("b", r2, last_token=t2[-1])
+            d = pool.describe()
+            assert d["blocks_used"] == 4 and d["sessions"] == 2
+            assert np.array_equal(pool.materialize("a"), r1)
+            assert np.array_equal(pool.materialize("b"), r2)
+            s = pool.get("a")
+            assert s.seq_len == 20 and s.acc == int(
+                r1.sum(dtype=np.int64))
+            assert pool.release("a") and not pool.release("a")
+            assert pool.describe()["blocks_used"] == 1
+        finally:
+            pool.close()
+
+    def test_partial_tail_block_zeroed(self):
+        # a partially-filled tail block must not leak the previous
+        # tenant's bytes or reduction sums
+        pool = _mk_pool(num_blocks=2, block_tokens=8)
+        try:
+            full = [7] * 16                           # both blocks, full
+            pool.load("x", _rows(full), last_token=7)
+            pool.release("x")
+            short = [11] * 9                          # 2 blocks, 7 stale
+            s = pool.load("y", _rows(short), last_token=11)
+            tail_blk = int(s.blocks[1])
+            assert pool._pos_sums[tail_blk, 1:].sum() == 0
+            assert np.array_equal(pool.materialize("y"), _rows(short))
+        finally:
+            pool.close()
+
+    def test_lru_eviction_within_band_and_touch(self):
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        try:
+            for name in ("old", "mid", "new"):
+                pool.load(name, _rows([1] * 8), last_token=1,
+                          priority=2)
+                time.sleep(0.002)
+            pool.touch("old")                 # now "mid" is LRU
+            pool.load("D", _rows([2] * 16), last_token=2, priority=2)
+            assert pool.get("mid") is None
+            assert pool.get("old") is not None
+            assert pool.evicted_reason("mid") == "pressure"
+        finally:
+            pool.close()
+
+    def test_batch_evicted_before_interactive(self):
+        pool = _mk_pool(num_blocks=3, block_tokens=8)
+        try:
+            pool.load("inter", _rows([1] * 8), last_token=1, priority=0)
+            time.sleep(0.002)
+            pool.load("batch", _rows([2] * 8), last_token=2, priority=3)
+            # interactive is OLDER, but the batch band absorbs pressure
+            pool.load("new", _rows([3] * 16), last_token=3, priority=1)
+            assert pool.get("batch") is None
+            assert pool.get("inter") is not None
+        finally:
+            pool.close()
+
+    def test_tenant_weight_tiebreak_from_admission(self):
+        from brpc_tpu.rpc.admission import AdmissionOptions
+        from brpc_tpu.serving import KvPoolOptions, PagedKvPool
+        m = _model()
+        adm = AdmissionOptions(tenant_weights={"gold": 8, "bronze": 1})
+        opts = KvPoolOptions.from_admission(
+            adm, bytes_per_token=m.KV_LAYERS * m.KV_DMODEL,
+            num_blocks=3, block_tokens=8, use_timers=False)
+        assert opts.tenant_weights == {"gold": 8, "bronze": 1}
+        pool = PagedKvPool(opts)
+        try:
+            # same band; bronze is NEWER but lighter — evicted first
+            pool.load("g", _rows([1] * 8), last_token=1, priority=2,
+                      tenant="gold")
+            time.sleep(0.002)
+            pool.load("b", _rows([2] * 8), last_token=2, priority=2,
+                      tenant="bronze")
+            pool.load("n", _rows([3] * 16), last_token=3, priority=2)
+            assert pool.get("b") is None
+            assert pool.get("g") is not None
+            assert any(k.startswith("evicted_pressure[bronze]")
+                       for k in pool.describe()["by_tenant"])
+        finally:
+            pool.close()
+
+    def test_requester_cannot_evict_more_protected_band(self):
+        from brpc_tpu.serving import PoolSaturated
+        pool = _mk_pool(num_blocks=2, block_tokens=8)
+        try:
+            pool.load("inter", _rows([1] * 16), last_token=1,
+                      priority=0)
+            with pytest.raises(PoolSaturated):
+                pool.load("batch", _rows([2] * 8), last_token=2,
+                          priority=3)
+            assert pool.get("inter") is not None
+        finally:
+            pool.close()
+
+    def test_pinned_never_evicted_or_expired(self):
+        from brpc_tpu.serving import PoolSaturated
+        pool = _mk_pool(num_blocks=2, block_tokens=8, ttl_s=0.0)
+        try:
+            pool.load("run", _rows([1] * 16), last_token=1, priority=3)
+            assert pool.pin("run")
+            with pytest.raises(PoolSaturated):
+                pool.load("x", _rows([2] * 8), last_token=2, priority=0)
+            assert pool.expire_idle() == 0    # pinned: ttl ignored
+            pool.unpin("run")
+            assert pool.expire_idle() == 1
+        finally:
+            pool.close()
+
+    def test_timer_sweep_reclaims_idle_session_without_traffic(self):
+        """THE ISSUE-14 regression: expiry is timer-driven — a parked
+        session on an otherwise-idle pool is reclaimed on time with
+        ZERO further loads or decodes (the old example swept only
+        inside LoadKv)."""
+        pool = _mk_pool(num_blocks=4, block_tokens=8, ttl_s=0.15,
+                        use_timers=True, sweep_interval_s=0.05)
+        try:
+            pool.load("parked", _rows([1] * 8), last_token=1)
+            deadline = time.monotonic() + 5.0
+            while pool.sessions() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert pool.sessions() == 0, "idle session never reclaimed"
+            assert pool.expirations.get_value() >= 1
+            assert pool.describe()["blocks_free"] == 4
+        finally:
+            pool.close()
+
+    def test_reload_of_pinned_session_refused(self):
+        """Re-prefilling a session that is PINNED in the step roster is
+        refused (SessionBusy): freeing a rostered session's blocks
+        would hand them to the new bytes mid-program — the running
+        gather would read the replacement's KV (review finding)."""
+        from brpc_tpu.serving import SessionBusy
+        pool = _mk_pool(num_blocks=8, block_tokens=8)
+        try:
+            r1 = _rows([1] * 8)
+            pool.load("s", r1, last_token=1)
+            assert pool.pin("s")
+            with pytest.raises(SessionBusy):
+                pool.load("s", _rows([2] * 8), last_token=2)
+            # the rostered table is untouched
+            assert np.array_equal(pool.materialize("s"), r1)
+            pool.unpin("s")
+            pool.load("s", _rows([2] * 8), last_token=2)  # now fine
+            assert np.array_equal(pool.materialize("s"), _rows([2] * 8))
+        finally:
+            pool.close()
+
+    def test_zero_length_session_rejected(self):
+        pool = _mk_pool()
+        try:
+            with pytest.raises(ValueError):
+                pool.load("empty", np.zeros(
+                    (0, pool.options.bytes_per_token), np.uint8),
+                    last_token=0)
+        finally:
+            pool.close()
+
+    def test_manual_expiry_with_injected_clock(self):
+        clock = [100.0]
+        pool = _mk_pool(num_blocks=4, block_tokens=8, ttl_s=10.0,
+                        now=lambda: clock[0])
+        try:
+            pool.load("s", _rows([1] * 8), last_token=1)
+            clock[0] = 109.0
+            assert pool.expire_idle() == 0
+            clock[0] = 111.0
+            assert pool.expire_idle() == 1
+            assert pool.evicted_reason("s") == "expired"
+        finally:
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# Continuous-batching scheduler (manual stepping).
+# ---------------------------------------------------------------------------
+
+class TestContinuousBatchScheduler:
+    def _load(self, pool, session, tokens, **kw):
+        pool.load(session, _rows(tokens), last_token=tokens[-1], **kw)
+
+    def test_tokens_bit_exact_with_staggered_joins(self):
+        m = _model()
+        pool = _mk_pool(num_blocks=32, block_tokens=8)
+        sched = _mk_sched(pool, max_batch=8)
+        try:
+            specs = {f"s{i}": ([(7 * i + j) % 997
+                                for j in range(16 + 11 * i)], 5 + 3 * i)
+                     for i in range(3)}
+            sinks = {}
+            for s, (tokens, steps) in specs.items():
+                self._load(pool, s, tokens)
+                sinks[s] = _submit(sched, s, steps)
+            for _ in range(4):
+                sched.step_once()
+            # a session JOINS mid-stream, between steps
+            late = [(13 * j) % 499 for j in range(21)]
+            specs["late"] = (late, 6)
+            self._load(pool, "late", late)
+            sinks["late"] = _submit(sched, "late", 6)
+            for _ in range(20):
+                sched.step_once()
+            for s, (tokens, steps) in specs.items():
+                assert sinks[s].tokens == m.reference_generate(
+                    tokens, steps), f"session {s} diverged"
+            d = sched.describe()
+            assert d["retired"] == 4 and d["steps"] > 0
+            assert d["batch_occupancy_avg"] > 1.0
+        finally:
+            sched.stop()
+            pool.close()
+
+    def test_max_batch_admits_per_step(self):
+        pool = _mk_pool()
+        sched = _mk_sched(pool, max_batch=2)
+        try:
+            sinks = []
+            for i in range(3):
+                tokens = [(i + j) % 97 for j in range(8)]
+                self._load(pool, f"s{i}", tokens)
+                sinks.append(_submit(sched, f"s{i}", 2))
+            assert sched.step_once() == 2          # roster capped at 2
+            assert sched.active() == 2 and sched.queued() == 1
+            sched.step_once()                      # first two retire
+            assert sched.step_once() == 1          # third admitted
+            sched.step_once()
+            assert all(s.tokens is not None for s in sinks)
+        finally:
+            sched.stop()
+            pool.close()
+
+    def test_interactive_preemption_preserves_progress(self):
+        m = _model()
+        pool = _mk_pool()
+        sched = _mk_sched(pool, max_batch=1, interactive_priority_max=1)
+        try:
+            batch_toks = [3 * j % 97 for j in range(16)]
+            self._load(pool, "batch", batch_toks, priority=3)
+            b = _submit(sched, "batch", 10, priority=3)
+            for _ in range(3):
+                sched.step_once()
+            assert sched.active() == 1
+            inter_toks = [5 * j % 89 for j in range(8)]
+            self._load(pool, "inter", inter_toks, priority=0)
+            i = _submit(sched, "inter", 4, priority=0)
+            # next boundary: batch preempted mid-decode, interactive in
+            sched.step_once()
+            assert sched.preempted.get_value() == 1
+            for _ in range(12):
+                sched.step_once()
+            assert i.tokens == m.reference_generate(inter_toks, 4)
+            # the preempted session RESUMED from its next token
+            assert b.tokens == m.reference_generate(batch_toks, 10)
+        finally:
+            sched.stop()
+            pool.close()
+
+    def test_deadline_expired_in_queue(self):
+        from brpc_tpu.rpc import errors
+        pool = _mk_pool()
+        sched = _mk_sched(pool, max_batch=4)
+        try:
+            self._load(pool, "s", [1] * 8)
+            sink = _submit(sched, "s", 4,
+                           deadline_us=time.monotonic_ns() // 1000 - 10)
+            sched.step_once()
+            assert sink.error is not None
+            assert sink.error[0] == errors.ERPCTIMEDOUT
+            assert sched.expired.get_value() == 1
+        finally:
+            sched.stop()
+            pool.close()
+
+    def test_unknown_and_evicted_session_refusals(self):
+        from brpc_tpu.rpc import errors
+        pool = _mk_pool(num_blocks=1, block_tokens=8)
+        sched = _mk_sched(pool)
+        try:
+            sink = _submit(sched, "ghost", 4)
+            sched.step_once()
+            assert sink.error[0] == errors.EREQUEST
+            self._load(pool, "victim", [1] * 8, priority=3)
+            self._load(pool, "usurper", [2] * 8, priority=0)  # evicts
+            sink2 = _submit(sched, "victim", 4)
+            sched.step_once()
+            assert sink2.error[0] == errors.ELIMIT
+            assert "re-prefill" in sink2.error[1]
+        finally:
+            sched.stop()
+            pool.close()
+
+    def test_duplicate_submit_refused_and_custody_safe(self):
+        """A retry storm re-issuing a Decode whose first copy is still
+        running is REFUSED: two roster entries on one session would let
+        the first completion release the pool blocks the second still
+        gathers through (cross-tenant bytes after block reuse — the
+        soak caught this as a token mismatch)."""
+        from brpc_tpu.rpc import errors
+        m = _model()
+        pool = _mk_pool()
+        sched = _mk_sched(pool, max_batch=4)
+        try:
+            tokens = [9 * j % 97 for j in range(12)]
+            self._load(pool, "dup", tokens)
+            first = _submit(sched, "dup", 6)
+            second = _submit(sched, "dup", 6)
+            assert second.error is not None
+            assert second.error[0] == errors.EREQUEST
+            assert "duplicate" in second.error[1]
+            for _ in range(8):
+                sched.step_once()
+            assert first.tokens == m.reference_generate(tokens, 6)
+            # ownership released at completion: a FRESH submit works
+            third = _submit(sched, "dup", 3)
+            for _ in range(5):
+                sched.step_once()
+            assert third.tokens == m.reference_generate(tokens, 3)
+        finally:
+            sched.stop()
+            pool.close()
+
+    def test_compiled_step_parity(self):
+        """The jit-compiled XLA step produces the numpy step's tokens
+        bit for bit (the TPU-pod shape, parity-pinned)."""
+        from brpc_tpu.butil import flags as fl
+        m = _model()
+        pool = _mk_pool(num_blocks=32, block_tokens=8)
+        sched = _mk_sched(pool, max_batch=4)
+        saved = fl.get_flag("serving_compiled_step")
+        fl.set_flag("serving_compiled_step", True)
+        try:
+            sinks = {}
+            specs = {}
+            for i in range(3):
+                tokens = [(11 * i + j) % 499 for j in range(10 + 7 * i)]
+                specs[f"c{i}"] = (tokens, 6)
+                self._load(pool, f"c{i}", tokens)
+                sinks[f"c{i}"] = _submit(sched, f"c{i}", 6)
+            for _ in range(10):
+                sched.step_once()
+            for s, (tokens, steps) in specs.items():
+                assert sinks[s].tokens == m.reference_generate(
+                    tokens, steps)
+            assert sched.describe()["compiled_step"] is True
+        finally:
+            fl.set_flag("serving_compiled_step", saved)
+            sched.stop()
+            pool.close()
+
+    def test_step_loop_survives_a_step_exception(self):
+        """One bad roster must not wedge the worker: the loop fails the
+        crashed roster with EINTERNAL and keeps serving (review
+        finding: an unguarded step thread died permanently and every
+        later Decode queued forever)."""
+        from brpc_tpu.rpc import errors
+        m = _model()
+        pool = _mk_pool()
+        sched = _mk_sched(pool, max_batch=4, auto_start=True)
+        try:
+            boom = {"armed": True}
+            orig = sched._step_numpy
+
+            def exploding(bt):
+                if boom["armed"]:
+                    boom["armed"] = False
+                    raise RuntimeError("injected step fault")
+                return orig(bt)
+
+            sched._step_numpy = exploding
+            tokens = [3 * j % 97 for j in range(8)]
+            self._load(pool, "crash", tokens)
+            sink = _submit(sched, "crash", 4)
+            deadline = time.monotonic() + 5.0
+            while sink.error is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sink.error is not None, "crashed roster never failed"
+            assert sink.error[0] == errors.EINTERNAL
+            # the loop is ALIVE: a fresh session decodes bit-exact
+            self._load(pool, "after", tokens)
+            sink2 = _submit(sched, "after", 4)
+            deadline = time.monotonic() + 5.0
+            while sink2.tokens is None and sink2.error is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sink2.tokens == m.reference_generate(tokens, 4)
+        finally:
+            sched.stop()
+            pool.close()
+
+    def test_stop_fails_pending_with_elogoff(self):
+        from brpc_tpu.rpc import errors
+        pool = _mk_pool()
+        sched = _mk_sched(pool)
+        try:
+            self._load(pool, "s", [1] * 8)
+            sink = _submit(sched, "s", 4)
+            sched.stop()
+            assert sink.error[0] == errors.ELOGOFF
+            late = _submit(sched, "s", 4)
+            assert late.error[0] == errors.ELOGOFF
+        finally:
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# Service level: the rebuilt disaggregated workers.
+# ---------------------------------------------------------------------------
+
+class TestServingServices:
+    def _decode_worker(self, name, **kw):
+        from examples.disagg_serving.workers import DecodeService
+        server = rpc.Server()
+        svc = DecodeService(**kw)
+        server.add_service(svc)
+        assert server.start(f"mem://{name}") == 0
+        return server, svc
+
+    def _load_session(self, ch, session, tokens, priority=None,
+                      tenant=""):
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+        m = _model()
+        kv = np.asarray(m.toy_kv_blocks(tokens)).tobytes()
+        cntl = rpc.Controller()
+        if priority is not None:
+            cntl.priority = priority
+        if tenant:
+            cntl.tenant = tenant
+        cntl.request_attachment.append(kv)
+        ch.call_method("Decode.LoadKv", cntl, EchoRequest(
+            message=json.dumps({"session": session,
+                                "seq_len": len(tokens),
+                                "last_token": tokens[-1]})),
+            EchoResponse)
+        return cntl
+
+    def test_batched_decode_end_to_end_route_asserted(self):
+        """N concurrent Decode RPCs share the step loop: every reply
+        bit-exact, batch occupancy > 1, and the route asserted through
+        the /status serving block."""
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+        m = _model()
+        server, svc = self._decode_worker("serv-batched")
+        ch = rpc.Channel()
+        ch.init("mem://serv-batched",
+                options=rpc.ChannelOptions(timeout_ms=30000))
+        try:
+            # 200-step sessions: lifetimes of several ms, far beyond
+            # client-thread start stagger even under suite-wide CPU
+            # contention — the roster genuinely overlaps (a 12-step
+            # variant measured occupancy exactly 1.0 on a loaded host)
+            specs = {f"b{i}": ([(3 * i + j) % 997
+                                for j in range(24 + 8 * i)], 200)
+                     for i in range(6)}
+            for s, (tokens, _) in specs.items():
+                assert not self._load_session(ch, s, tokens).failed()
+            results = {}
+            lock = threading.Lock()
+
+            def decode(s, steps):
+                cntl = rpc.Controller()
+                resp = ch.call_method("Decode.Decode", cntl,
+                                      EchoRequest(message=json.dumps(
+                                          {"session": s,
+                                           "steps": steps})),
+                                      EchoResponse)
+                with lock:
+                    results[s] = (cntl.failed(), cntl.error_text,
+                                  json.loads(resp.message)["tokens"]
+                                  if not cntl.failed() else None)
+
+            threads = [threading.Thread(target=decode, args=(s, steps))
+                       for s, (_, steps) in specs.items()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for s, (tokens, steps) in specs.items():
+                failed, err, toks = results[s]
+                assert not failed, (s, err)
+                assert toks == m.reference_generate(tokens, steps), s
+            d = svc.describe_serving()
+            assert d["scheduler"]["retired"] == 6
+            assert d["scheduler"]["batch_occupancy_avg"] > 1.0
+            assert svc.live_sessions() == 0    # released on completion
+            # the /status page carries the serving block
+            ctype, body = server._builtin.dispatch("status")
+            blk = json.loads(body)["serving"]["Decode"]
+            assert blk["scheduler"]["steps"] > 0
+            assert blk["pool"]["blocks_total"] > 0
+        finally:
+            ch.close()
+            svc.close()
+            server.stop()
+
+    def test_sync_mode_matches_batch_mode(self):
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+        m = _model()
+        server, svc = self._decode_worker("serv-sync")
+        ch = rpc.Channel()
+        ch.init("mem://serv-sync",
+                options=rpc.ChannelOptions(timeout_ms=30000))
+        try:
+            tokens = [(17 * j) % 499 for j in range(40)]
+            want = m.reference_generate(tokens, 9)
+            for mode in ("sync", "batch"):
+                s = f"m-{mode}"
+                assert not self._load_session(ch, s, tokens).failed()
+                cntl = rpc.Controller()
+                body = {"session": s, "steps": 9}
+                if mode == "sync":
+                    body["mode"] = "sync"
+                resp = ch.call_method("Decode.Decode", cntl,
+                                      EchoRequest(message=json.dumps(
+                                          body)), EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert json.loads(resp.message)["tokens"] == want, mode
+        finally:
+            ch.close()
+            svc.close()
+            server.stop()
+
+    def test_pool_saturated_sheds_with_retry_hint(self):
+        from brpc_tpu.rpc import errors
+        from brpc_tpu.serving import KvPoolOptions
+        m = _model()
+        server, svc = self._decode_worker(
+            "serv-sat", pool_options=KvPoolOptions(
+                bytes_per_token=m.KV_LAYERS * m.KV_DMODEL,
+                num_blocks=2, block_tokens=8))
+        ch = rpc.Channel()
+        ch.init("mem://serv-sat",
+                options=rpc.ChannelOptions(timeout_ms=30000,
+                                           max_retry=0))
+        try:
+            # interactive KV owns the pool; a batch load is SHED with a
+            # retry hint, not failed into the unknown
+            assert not self._load_session(ch, "inter", [1] * 16,
+                                          priority=0).failed()
+            cntl = self._load_session(ch, "batch", [2] * 8, priority=3,
+                                      tenant="bulk")
+            assert cntl.failed() and cntl.error_code_ == errors.ELIMIT
+            assert cntl.retry_after_ms > 0
+            assert svc.live_sessions() == 1
+        finally:
+            ch.close()
+            svc.close()
+            server.stop()
+
+    def test_idle_worker_reclaims_parked_session_without_traffic(self):
+        """THE ISSUE-14 regression at the RPC level: LoadKv parks a
+        session, NO further traffic of any kind arrives, and the
+        worker's pool reclaims it by timer."""
+        from brpc_tpu.serving import KvPoolOptions
+        m = _model()
+        server, svc = self._decode_worker(
+            "serv-idle", pool_options=KvPoolOptions(
+                bytes_per_token=m.KV_LAYERS * m.KV_DMODEL,
+                num_blocks=8, block_tokens=8, ttl_s=0.15,
+                sweep_interval_s=0.05))
+        ch = rpc.Channel()
+        ch.init("mem://serv-idle",
+                options=rpc.ChannelOptions(timeout_ms=30000))
+        try:
+            assert not self._load_session(ch, "parked",
+                                          [3] * 12).failed()
+            assert svc.live_sessions() == 1
+            deadline = time.monotonic() + 5.0
+            while svc.live_sessions() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert svc.live_sessions() == 0, \
+                "parked session not reclaimed on an idle worker"
+            assert svc.sessions_expired >= 1
+        finally:
+            ch.close()
+            svc.close()
+            server.stop()
+
+    def test_rpc_press_serving_mode(self):
+        """The open-loop session generator (tools/rpc_press --serving):
+        mixed tenants at a fixed arrival rate, per-tenant tokens/s in
+        the summary, and the in-process pool/scheduler occupancy
+        reported through the serving status block."""
+        import io
+
+        import jax
+        from brpc_tpu.tools.rpc_press import run_press_serving
+        from examples.disagg_serving.workers import (start_decode_worker,
+                                                     start_prefill_worker,
+                                                     start_router)
+        devs = jax.devices()
+        prefill = start_prefill_worker("ici://7", device=devs[7])
+        decode = start_decode_worker("mem://press-dec")
+        router = start_router("mem://press-router", "ici://7",
+                              ["mem://press-dec"])
+        try:
+            res = run_press_serving(
+                "mem://press-router", duration=1.5, arrival_rps=40.0,
+                batch_ratio=2, seq_range="16-32", steps_range="4-16",
+                out=io.StringIO())
+            assert res["issued"] >= 20, res
+            for tenant in ("inter", "bulk"):
+                c = res["per_tenant"][tenant]
+                assert c["ok"] > 0 and c["failures"] == 0, res
+                assert c["session_tokens_per_s_p50"] > 0, res
+            assert res["tokens_per_s"] > 0
+            blk = next(v for k, v in res["serving_status"].items()
+                       if "Decode" in k)
+            assert blk["pool"]["blocks_total"] > 0
+            assert blk["scheduler"]["steps"] > 0
+        finally:
+            for server in (router, prefill, decode):
+                for svc in server._services.values():
+                    if hasattr(svc, "close"):
+                        svc.close()
+                server.stop()
+
+    def test_lalb_router_shifts_load_to_fast_worker(self):
+        """The divided-weight loop: feedback drives selection — a slow
+        worker's share collapses."""
+        from brpc_tpu.serving import LoadAwareRouter
+        router = LoadAwareRouter(["mem://lalb-fast", "mem://lalb-slow"])
+        try:
+            for _ in range(40):
+                router.feedback("mem://lalb-fast", 0, 1000)
+                router.feedback("mem://lalb-slow", 0, 50000)
+            picks = {"mem://lalb-fast": 0, "mem://lalb-slow": 0}
+            for _ in range(300):
+                url = router.pick()
+                picks[url] += 1
+                router.feedback(url, 0,
+                                1000 if url.endswith("fast") else 50000)
+            assert picks["mem://lalb-fast"] > 0.65 * 300, picks
+            d = router.describe()
+            assert d["balancer"] == "la"
+            assert d["weights"]["mem://lalb-fast"] > \
+                d["weights"]["mem://lalb-slow"]
+        finally:
+            router.close()
+
+    def test_router_retries_dead_decode_worker(self):
+        """A Generate whose chosen decode worker is DEAD re-prefills
+        against another one — zero client-visible failures (the elastic
+        chaos contract's unit half)."""
+        import jax
+        from examples.disagg_serving.workers import (start_decode_worker,
+                                                     start_prefill_worker,
+                                                     start_router)
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+        m = _model()
+        devs = jax.devices()
+        prefill = start_prefill_worker("ici://6", device=devs[6])
+        alive = start_decode_worker("mem://rr-alive")
+        dead = start_decode_worker("mem://rr-dead")
+        router = start_router("mem://rr-router", "ici://6",
+                              ["mem://rr-dead", "mem://rr-alive"])
+        servers = [router, prefill, alive]
+        try:
+            # the dead worker stops before any traffic: whichever
+            # attempt picks it fails and the router must recover
+            for svc in dead._services.values():
+                if hasattr(svc, "close"):
+                    svc.close()
+            dead.stop()
+            ch = rpc.Channel()
+            ch.init("mem://rr-router",
+                    options=rpc.ChannelOptions(timeout_ms=60000))
+            tokens = [(7 * j) % 499 for j in range(32)]
+            for _ in range(4):
+                cntl = rpc.Controller()
+                resp = ch.call_method(
+                    "Router.Generate", cntl,
+                    EchoRequest(message=json.dumps(
+                        {"tokens": tokens, "steps": 6})), EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                out = json.loads(resp.message)
+                assert out["tokens"] == m.reference_generate(tokens, 6)
+                assert out["decode_worker"] == "mem://rr-alive"
+            ch.close()
+        finally:
+            for server in servers:
+                for svc in server._services.values():
+                    if hasattr(svc, "close"):
+                        svc.close()
+                server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler units (injected clock + load).
+# ---------------------------------------------------------------------------
+
+class TestAutoscaler:
+    def _mk(self, loads, size0=1, **kw):
+        from brpc_tpu.serving import (AutoscalerOptions,
+                                      LoadThresholdAutoscaler)
+        state = {"size": size0, "ups": 0, "downs": 0, "i": 0}
+
+        def load_fn():
+            i = min(state["i"], len(loads) - 1)
+            state["i"] += 1
+            return loads[i]
+
+        def up():
+            state["size"] += 1
+            state["ups"] += 1
+            return True
+
+        def down():
+            state["size"] -= 1
+            state["downs"] += 1
+            return True
+
+        opts = AutoscalerOptions(**kw)
+        a = LoadThresholdAutoscaler(load_fn, lambda: state["size"],
+                                    up, down, options=opts)
+        return a, state
+
+    def test_hysteresis_and_cooldown(self):
+        a, st = self._mk([0.9, 0.9, 0.9, 0.9, 0.9],
+                         samples_to_scale=2, cooldown_s=10.0,
+                         max_size=4)
+        assert a.tick(now=0.0) is None      # 1 high sample: not yet
+        assert a.tick(now=1.0) == "up"      # 2 consecutive: scale
+        assert st["size"] == 2
+        assert a.tick(now=2.0) is None      # cooldown holds
+        assert a.tick(now=3.0) is None
+        # sustained high load keeps accumulating through the cooldown:
+        # the next action fires the moment the cooldown lifts
+        assert a.tick(now=12.0) == "up"
+        assert a.tick(now=13.0) is None     # new cooldown holds again
+        assert st["ups"] == 2
+
+    def test_scale_down_and_min_size(self):
+        a, st = self._mk([0.1] * 6, size0=2, samples_to_scale=2,
+                         cooldown_s=0.0, min_size=1)
+        assert a.tick(now=0.0) is None
+        assert a.tick(now=1.0) == "down"
+        assert st["size"] == 1
+        # at min_size: low load never goes below
+        assert a.tick(now=2.0) is None
+        assert a.tick(now=3.0) is None
+        assert st["size"] == 1
+
+    def test_max_size_and_mid_band_resets_runs(self):
+        a, st = self._mk([0.9, 0.5, 0.9, 0.9], samples_to_scale=2,
+                         cooldown_s=0.0, max_size=2)
+        assert a.tick(now=0.0) is None
+        assert a.tick(now=1.0) is None      # mid-band sample reset
+        assert a.tick(now=2.0) is None
+        assert a.tick(now=3.0) == "up"
+        assert st["size"] == 2
+        d = a.describe()
+        assert d["scale_ups"] == 1 and d["size"] == 2
+        assert "load" in d["last"]
+
+
+# ---------------------------------------------------------------------------
+# Elastic chaos: scale-up + kill + revive + scale-down mid-traffic, one
+# subprocess hosting a real (1-member) pod so the epoch is observable.
+# ---------------------------------------------------------------------------
+
+_ELASTIC_CHAOS_CHILD = r"""
+import json, os, sys, threading, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+coord = sys.argv[1]
+
+from brpc_tpu.ici.fabric import FabricNode
+node = FabricNode.initialize(coord, num_processes=1, process_id=0)
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.ici.pod import Pod
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+pod = Pod.join("serving-chaos")
+
+from brpc_tpu.serving import (AutoscalerOptions, BatchSchedulerOptions,
+                              KvPoolOptions, LoadThresholdAutoscaler)
+from examples.disagg_serving.model import (KV_DMODEL, KV_LAYERS, VOCAB,
+                                           reference_generate)
+from examples.disagg_serving.workers import (DecodeService,
+                                             start_prefill_worker,
+                                             start_router)
+from examples.example_echo_pb2 import EchoRequest, EchoResponse
+
+BPT = KV_LAYERS * KV_DMODEL
+
+def mk_decode(dev_url):
+    server = rpc.Server()
+    svc = DecodeService(
+        pool_options=KvPoolOptions(bytes_per_token=BPT, num_blocks=512,
+                                   block_tokens=16),
+        sched_options=BatchSchedulerOptions(vocab=VOCAB, max_batch=4))
+    server.add_service(svc)
+    assert server.start(dev_url) == 0
+    return server, svc
+
+prefill = start_prefill_worker("ici://0")
+dec_a, svc_a = mk_decode("ici://1")
+router = start_router("mem://chaos-router", "ici://0", ["ici://1"])
+rsvc = next(iter(router._services.values()))
+epoch0 = pod.epoch(refresh=True)
+
+# ---- elastic mechanism: the autoscaler's scale callbacks ----------------
+workers = {"ici://1": (dec_a, svc_a)}
+wlock = threading.Lock()
+
+def current_load():
+    with wlock:
+        svcs = [s for (_, s) in workers.values()]
+    if not svcs:
+        return 1.0
+    load = 0.0
+    for s in svcs:
+        d = s.scheduler.describe()
+        load += (d["active"] + sum(d["pending_by_band"])) \
+            / max(d["max_batch"], 1)
+    return load / len(svcs)
+
+def scale_up():
+    with wlock:
+        if "ici://2" in workers:
+            return False
+        server, svc = mk_decode("ici://2")
+        workers["ici://2"] = (server, svc)
+    rsvc.add_decode_target("ici://2")
+    return True
+
+def scale_down():
+    with wlock:
+        if "ici://2" not in workers:
+            return False
+        server, svc = workers.pop("ici://2")
+    rsvc.remove_decode_target("ici://2")
+    time.sleep(0.1)
+    server.stop(grace_s=1.0)
+    svc.close()
+    return True
+
+def size_fn():
+    with wlock:
+        return len(workers)
+
+scaler = LoadThresholdAutoscaler(
+    current_load, size_fn, scale_up, scale_down,
+    options=AutoscalerOptions(high_water=0.75, low_water=0.1,
+                              interval_s=0.1, samples_to_scale=2,
+                              cooldown_s=1.5, min_size=1, max_size=2),
+    pod=pod)
+scaler.start()
+
+# ---- traffic ------------------------------------------------------------
+stop_evt = threading.Event()
+stats = {"ok": 0, "shed": 0, "fail": 0, "mismatch": 0}
+slock = threading.Lock()
+ch_opts = rpc.ChannelOptions(timeout_ms=30000)
+
+def client(wid, priority, pace_s, steps):
+    ch = rpc.Channel(); ch.init("mem://chaos-router", options=ch_opts)
+    i = 0
+    while not stop_evt.is_set():
+        tokens = [(wid * 31 + i * 7 + j) %% 997 for j in range(24)]
+        i += 1
+        cntl = rpc.Controller()
+        cntl.priority = priority
+        cntl.tenant = "inter" if priority == 0 else "bulk"
+        resp = ch.call_method("Router.Generate", cntl,
+                              EchoRequest(message=json.dumps(
+                                  {"tokens": tokens, "steps": steps})),
+                              EchoResponse)
+        with slock:
+            if cntl.failed():
+                if cntl.error_code_ == rpc.errors.ELIMIT:
+                    stats["shed"] += 1
+                else:
+                    stats["fail"] += 1
+                    sys.stderr.write("CLIENT FAIL: %%s %%s\n"
+                                     %% (cntl.error_code_,
+                                        cntl.error_text))
+            else:
+                toks = json.loads(resp.message)["tokens"]
+                if toks == reference_generate(tokens, steps):
+                    stats["ok"] += 1
+                else:
+                    stats["mismatch"] += 1
+        if pace_s:
+            time.sleep(pace_s)
+    ch.close()
+
+# batch sessions are LONG (400 tokens): they live tens of steps in the
+# roster, so 6 concurrent batch clients genuinely saturate max_batch=4
+# and the load signal (roster + queue pressure) crosses the high-water
+# mark — the toy decode is otherwise too fast to ever look loaded
+threads = [threading.Thread(target=client, args=(w, 0, 0.05, 6))
+           for w in range(2)]
+threads += [threading.Thread(target=client, args=(10 + w, 3, 0.0, 400))
+            for w in range(6)]
+for t in threads: t.start()
+
+def wait_for(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    raise AssertionError("timeout waiting for " + what)
+
+try:
+    # phase 1: the batch flood pushes load over the high-water mark and
+    # the autoscaler scales decode ici://2 up (epoch bump via advertise)
+    wait_for(lambda: scaler.scale_ups.get_value() >= 1, 30.0,
+             "scale-up (load=%%s)" %% current_load())
+    wait_for(lambda: "ici://2" in rsvc._router.targets(), 5.0,
+             "router membership")
+    time.sleep(1.0)
+
+    # phase 2: KILL decode A mid-traffic (no drain).  In-flight
+    # sessions on A fail server-side; the router re-prefills them on B
+    # — zero client-visible failures.
+    dec_a.stop(grace_s=0)
+    svc_a.close()
+    rsvc.remove_decode_target("ici://1")
+    with wlock:
+        workers.pop("ici://1", None)
+    time.sleep(1.5)
+
+    # phase 3: REVIVE A (restart on the same device; advertise bumps
+    # the epoch again) and hand it back to the router
+    dec_a2, svc_a2 = mk_decode("ici://1")
+    with wlock:
+        workers["ici://1"] = (dec_a2, svc_a2)
+    rsvc.add_decode_target("ici://1")
+    time.sleep(1.0)
+finally:
+    # phase 4: drop the batch flood; load falls under the low-water
+    # mark and the autoscaler scales ici://2 back down
+    stop_evt.set()
+for t in threads: t.join()
+wait_for(lambda: scaler.scale_downs.get_value() >= 1, 20.0,
+         "scale-down (load=%%s)" %% current_load())
+
+scaler.stop()
+epoch1 = pod.epoch(refresh=True)
+desc = pod.describe()
+assert "autoscaler" in desc, "autoscaler missing from pod describe"
+
+result = {
+    "ok": stats["ok"], "shed": stats["shed"], "fail": stats["fail"],
+    "mismatch": stats["mismatch"],
+    "epoch_delta": epoch1 - epoch0,
+    "scale_ups": scaler.scale_ups.get_value(),
+    "scale_downs": scaler.scale_downs.get_value(),
+    "router": rsvc.describe_serving()["router"],
+}
+print("CHAOS_RESULT " + json.dumps(result), flush=True)
+
+for server, svc in list(workers.values()):
+    svc.close(); server.stop()
+for svc in router._services.values():
+    if hasattr(svc, "close"): svc.close()
+router.stop()
+for svc in prefill._services.values():
+    if hasattr(svc, "close"): svc.close()
+prefill.stop()
+pod.leave()
+"""
+
+
+class TestElasticChaosServing:
+    def test_scale_up_kill_revive_scale_down_under_traffic(self):
+        """The tier-1 elastic chaos leg: a 1-member pod serving mixed
+        interactive/batch traffic scales a decode worker up on load,
+        survives a KILL of the original worker, revives it, and scales
+        back down — zero client-visible failures, every completion
+        bit-exact, the epoch delta covering every membership
+        transition."""
+        from netalloc import alloc_port
+        coord = f"127.0.0.1:{alloc_port('serving_chaos')}"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env.pop("JAX_NUM_PROCESSES", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             _ELASTIC_CHAOS_CHILD % {"repo": REPO}, coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            out, _ = proc.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        assert proc.returncode == 0, out[-4000:]
+        line = next(l for l in out.splitlines()
+                    if l.startswith("CHAOS_RESULT "))
+        res = json.loads(line[len("CHAOS_RESULT "):])
+        # zero client-visible failures; batch sheds allowed (that IS
+        # the absorb-the-pressure contract), mismatches never
+        assert res["fail"] == 0, res
+        assert res["mismatch"] == 0, res
+        assert res["ok"] > 20, res
+        assert res["scale_ups"] >= 1 and res["scale_downs"] >= 1, res
+        # every transition moved the epoch: initial 3 advertises are in
+        # epoch0; up(+1) kill-withdraw(+1) revive(+1) down(+>=1)
+        assert res["epoch_delta"] >= 4, res
+        # the router retried around the kill rather than surfacing it
+        assert res["router"]["generate_failures"] == 0, res
