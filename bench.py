@@ -218,6 +218,28 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
         server_u.stop()
     finally:
         _fl.set_flag("ici_fused_dispatch", _fused_prev)
+    # single-lock batched bvar A/B leg (ISSUE 15): the same headline
+    # shape with bvar_batched_record=False — the PR-13 five-lock record
+    # path — on a FRESH server generation (the flag binds per
+    # (recorder, thread) at first record, and a new server means new
+    # MethodStatus recorders), same process, same warmed jit.  The
+    # headline above already runs batched (flag default on).
+    lat_py_bvar_legacy = []
+    _bvar_prev = _fl.get_flag("bvar_batched_record")
+    _fl.set_flag("bvar_batched_record", False)
+    try:
+        server_b = rpc.Server(opts)
+        server_b.add_service(EchoService())
+        server_b.start("ici://0")
+        ch_b = rpc.Channel()
+        ch_b.init("ici://0",
+                  options=rpc.ChannelOptions(timeout_ms=10000,
+                                             max_retry=0,
+                                             ici_local_device=0))
+        lat_py_bvar_legacy = drive(max(iters // 2, 150), chan=ch_b)
+        server_b.stop()
+    finally:
+        _fl.set_flag("bvar_batched_record", _bvar_prev)
     if cpp_loop > 0:
         p50, src = cpp_loop, "cpp_loop"
     elif lat_native:
@@ -249,6 +271,12 @@ def bench_echo_p50(iters: int = 500, payload_bytes: int = 4096):
         "py_handler_unfused_p99_us":
             (lat_py_unfused[int(len(lat_py_unfused) * 0.99)]
              if lat_py_unfused else -1.0),
+        "py_handler_bvar_unbatched_p50_us":
+            (lat_py_bvar_legacy[len(lat_py_bvar_legacy) // 2]
+             if lat_py_bvar_legacy else -1.0),
+        "py_handler_bvar_unbatched_p99_us":
+            (lat_py_bvar_legacy[int(len(lat_py_bvar_legacy) * 0.99)]
+             if lat_py_bvar_legacy else -1.0),
         "frames_per_rpc": frames_per_rpc,
         "py_handler_xdev_p50_us": lat_py_xdev[len(lat_py_xdev) // 2],
         "py_handler_xdev_p99_us": lat_py_xdev[int(len(lat_py_xdev) * 0.99)],
@@ -2256,6 +2284,220 @@ def bench_serving_soak(soak_s: float = 12.0) -> dict:
     return result
 
 
+def bench_serving_kv_handoff(iters: int = 60, seq: int = 1024) -> dict:
+    """The zero-copy KV handoff tier (ISSUE 15): per-session LoadKv
+    p50/p99 and bytes-copied, adopted/scattered vs the PR-14
+    materialize path, flag-flipped IN ONE RUN on two planes:
+
+      * loopback (``mem://``) — the prefill device payload arrives as
+        the caller's own DEVICE-block IOBuf → the scattered route;
+      * native-ici (``ici://``) — the payload arrives as a PARKED
+        ``NativeAttachment`` handle → ``take_segments`` custody →
+        the scattered route, no view inflation.
+
+    (The shm plane's adopted route needs two processes; its
+    byte-exactness + route assertion live in the tier-1 2-process test
+    — this bench keeps both legs in-process so the A/B is same-run.)
+    Every call's route is asserted through the ``serving_kv_load_*``
+    counter deltas; ``*_copy_x`` is host-copy-passes × payload ÷ bytes
+    moved (1.0 = the zero-intermediate-copy contract, 3.0 = the PR-14
+    materialize → transpose → fill chain)."""
+    import json as _json
+
+    import jax
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc
+    from brpc_tpu.butil import flags as _fl
+    from brpc_tpu.serving import KvPoolOptions, kv_load_stats
+    from brpc_tpu.serving import kv_source as _ks
+    from examples.disagg_serving.model import (KV_DMODEL, KV_LAYERS,
+                                               kv_nbytes, toy_kv_blocks)
+    from examples.disagg_serving.workers import DecodeService
+    from examples.example_echo_pb2 import EchoRequest, EchoResponse
+
+    payload_bytes = kv_nbytes(seq)
+    tokens = [(13 * j) % 997 for j in range(seq)]
+    kv = toy_kv_blocks(tokens)
+    jax.block_until_ready(kv)
+
+    def mk_worker(addr):
+        server = rpc.Server()
+        svc = DecodeService(pool_options=KvPoolOptions(
+            bytes_per_token=KV_LAYERS * KV_DMODEL,
+            num_blocks=max(2 * (seq // 16 + 1), 256), block_tokens=16,
+            use_timers=False))
+        server.add_service(svc)
+        assert server.start(addr) == 0
+        return server, svc
+
+    def drive(ch, svc, n, tag):
+        lats = []
+        for i in range(n + 5):
+            sid = f"{tag}{i}"
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(kv)
+            t0 = time.perf_counter_ns()
+            ch.call_method("Decode.LoadKv", cntl, EchoRequest(
+                message=_json.dumps({"session": sid, "seq_len": seq,
+                                     "last_token": tokens[-1]})),
+                EchoResponse)
+            t1 = time.perf_counter_ns()
+            if cntl.failed():
+                raise RuntimeError(f"LoadKv failed: {cntl.error_text}")
+            svc.pool.release(sid)
+            if i >= 5:
+                lats.append((t1 - t0) / 1000.0)
+        lats.sort()
+        return lats
+
+    out = {"payload_bytes": payload_bytes, "seq": seq, "iters": iters}
+    # pool-boundary legs FIRST: the byte-moving operation itself (source
+    # → pool blocks), no RPC around it — on a 1-core host the loopback/
+    # ici RPC legs below carry ~2 ms of scheduler-dispatch constant that
+    # dilutes the per-byte win (the 4b/4c 1-core precedent; recorded in
+    # kv_rpc_note)
+    from brpc_tpu.butil.iobuf import IOBuf
+    from brpc_tpu.serving import PagedKvPool, load_wire_attachment
+    import numpy as _np
+    host_bytes = _np.asarray(kv).tobytes()
+    pool = PagedKvPool(KvPoolOptions(
+        bytes_per_token=KV_LAYERS * KV_DMODEL,
+        num_blocks=max(2 * (seq // 16 + 1), 256), block_tokens=16,
+        use_timers=False))
+    try:
+        def pool_adopt(i):
+            buf = IOBuf()
+            buf.append_user_data(memoryview(host_bytes))
+            load_wire_attachment(pool, buf, f"pa{i}", seq, KV_LAYERS,
+                                 KV_DMODEL, last_token=tokens[-1])
+            pool.release(f"pa{i}")
+
+        def pool_mat(i):
+            blob = bytes(host_bytes)      # the to_bytes materialization
+            rows = _np.frombuffer(blob, _np.uint8).reshape(
+                KV_LAYERS, seq, KV_DMODEL).transpose(1, 0, 2).reshape(
+                seq, KV_LAYERS * KV_DMODEL)
+            pool.load(f"pm{i}", rows, last_token=tokens[-1])
+            pool.release(f"pm{i}")
+
+        for tag, fn in (("adopt", pool_adopt), ("materialize", pool_mat)):
+            lats = []
+            for i in range(iters + 5):
+                t0 = time.perf_counter_ns()
+                fn(i)
+                t1 = time.perf_counter_ns()
+                if i >= 5:
+                    lats.append((t1 - t0) / 1000.0)
+            lats.sort()
+            out[f"kv_pool_{tag}_p50_us"] = round(lats[len(lats) // 2], 1)
+            out[f"kv_pool_{tag}_p99_us"] = round(
+                lats[int(len(lats) * 0.99)], 1)
+    finally:
+        pool.close()
+    out["kv_pool_adopt_speedup_x"] = round(
+        out["kv_pool_materialize_p50_us"] / out["kv_pool_adopt_p50_us"],
+        3)
+    for plane, addr in (("loopback", "mem://kvh-bench"),
+                        ("ici", "ici://6")):
+        server, svc = mk_worker(addr)
+        ch = rpc.Channel()
+        ch.init(addr, options=rpc.ChannelOptions(timeout_ms=30000,
+                                                 max_retry=0))
+        try:
+            for mode, flag in (("adopt", True), ("materialize", False)):
+                prev = _fl.get_flag("serving_kv_adopt")
+                _fl.set_flag("serving_kv_adopt", flag)
+                try:
+                    s0 = kv_load_stats()
+                    lats = drive(ch, svc, iters, f"{plane[0]}{mode[0]}")
+                    s1 = kv_load_stats()
+                finally:
+                    _fl.set_flag("serving_kv_adopt", prev)
+                moved = (iters + 5) * payload_bytes
+                copy_x = (s1["copy_bytes"] - s0["copy_bytes"]) / moved
+                route = (_ks.MATERIALIZED if not flag else
+                         (_ks.SCATTERED
+                          if s1[_ks.SCATTERED] > s0[_ks.SCATTERED]
+                          else _ks.ADOPTED))
+                # route ASSERTED per leg: every call took exactly one
+                # route, and it is the one the flag demands
+                assert s1[route] - s0[route] == iters + 5, (
+                    plane, mode, s0, s1)
+                out[f"kv_{plane}_{mode}_p50_us"] = round(
+                    lats[len(lats) // 2], 1)
+                out[f"kv_{plane}_{mode}_p99_us"] = round(
+                    lats[int(len(lats) * 0.99)], 1)
+                out[f"kv_{plane}_{mode}_copy_x"] = round(copy_x, 3)
+                out[f"kv_{plane}_{mode}_route"] = route
+        finally:
+            ch.close()
+            svc.close()
+            server.stop()
+    out["kv_adopt_speedup_loopback_x"] = round(
+        out["kv_loopback_materialize_p50_us"]
+        / out["kv_loopback_adopt_p50_us"], 3)
+    out["kv_adopt_speedup_ici_x"] = round(
+        out["kv_ici_materialize_p50_us"] / out["kv_ici_adopt_p50_us"], 3)
+    # the acceptance booleans, computed where the data is
+    out["pass_copy_bound"] = (
+        out["kv_loopback_adopt_copy_x"] <= 1.01
+        and out["kv_ici_adopt_copy_x"] <= 1.01
+        and out["kv_loopback_materialize_copy_x"] >= 2.0
+        and out["kv_ici_materialize_copy_x"] >= 2.0)
+    # the measurable-improvement bound lives at the pool boundary — the
+    # operation the ISSUE targets; the RPC legs carry a ~2 ms 1-core
+    # scheduler-dispatch constant that must still not REGRESS
+    out["pass_p50_improves"] = (
+        out["kv_pool_adopt_p50_us"] < out["kv_pool_materialize_p50_us"]
+        and out["kv_loopback_adopt_p50_us"]
+        <= 1.05 * out["kv_loopback_materialize_p50_us"]
+        and out["kv_ici_adopt_p50_us"]
+        <= 1.05 * out["kv_ici_materialize_p50_us"])
+    import os
+    if (os.cpu_count() or 1) <= 1:
+        out["kv_rpc_note"] = (
+            "1-core host: the loopback/ici RPC legs include ~2 ms of "
+            "tasklet-dispatch + completion-wake constant per LoadKv "
+            "that dwarfs the per-byte win at this payload size; the "
+            "pool-boundary legs isolate the byte-moving operation "
+            "(multi-core hosts shrink the constant, the 4b/4c "
+            "precedent)")
+    return out
+
+
+def bench_bvar_record() -> dict:
+    """Single-lock batched bvar recording (ISSUE 15 satellite): ns per
+    ``LatencyRecorder << us`` with the five-agent shared lock vs the
+    PR-13 five-lock path, same run (the flag binds per (recorder,
+    thread) at first record, so each leg uses a fresh recorder)."""
+    from brpc_tpu.butil import flags as _fl
+    from brpc_tpu import bvar
+
+    def leg(flag, n=150000):
+        prev = _fl.get_flag("bvar_batched_record")
+        _fl.set_flag("bvar_batched_record", flag)
+        try:
+            rec = bvar.LatencyRecorder()
+            t0 = time.perf_counter_ns()
+            for _ in range(n):
+                rec << 50
+            dt = (time.perf_counter_ns() - t0) / n
+            assert rec.count() == n
+        finally:
+            _fl.set_flag("bvar_batched_record", prev)
+        return dt
+
+    legacy = leg(False)
+    batched = leg(True)
+    return {
+        "bvar_record_unbatched_ns": round(legacy, 1),
+        "bvar_record_batched_ns": round(batched, 1),
+        "bvar_record_cut_pct": round(100.0 * (1 - batched / legacy), 1)
+        if legacy > 0 else -1.0,
+    }
+
+
 def device_backend_reachable() -> bool:
     """Fast-fail probe for the device backend (VERDICT r1 #1): under the
     axon tunnel, jax backend init dials the terminal's stateless port —
@@ -2517,6 +2759,19 @@ def main() -> None:
              "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}) \
         if device_ok else {}
     print(f"# pod serving soak: {soak}", file=sys.stderr)
+    # serving_kv_handoff (ISSUE 15): per-session LoadKv p50/p99 +
+    # bytes-copied, adopted/scattered vs the PR-14 materialize path,
+    # flag-flipped in ONE run, routes asserted per leg
+    kvh = _run_subbench("serving_kv", timeout_s=240) if device_ok else {}
+    print(f"# serving kv handoff: {kvh}", file=sys.stderr)
+    # single-lock batched bvar recording (ISSUE 15 satellite): pure-host
+    # microbench, no device needed
+    try:
+        bvr = bench_bvar_record()
+        print(f"# bvar record: {bvr}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"# bvar record bench failed: {e}", file=sys.stderr)
+        bvr = {}
     target_us = 10.0
     # Metric of record: a MESH-CROSSING p50 — the payload actually
     # changes chips (VERDICT r5 weak #1: the old headline was a
@@ -2594,6 +2849,10 @@ def main() -> None:
             echo.get("py_handler_unfused_p50_us", -1.0), 1),
         "ici_py_handler_unfused_p99_us": round(
             echo.get("py_handler_unfused_p99_us", -1.0), 1),
+        "ici_py_handler_bvar_unbatched_p50_us": round(
+            echo.get("py_handler_bvar_unbatched_p50_us", -1.0), 1),
+        "ici_py_handler_bvar_unbatched_p99_us": round(
+            echo.get("py_handler_bvar_unbatched_p99_us", -1.0), 1),
         "ici_frames_per_rpc": echo.get("frames_per_rpc", -1),
         "ici_py_handler_xdev_echo_p50_us": round(
             echo.get("py_handler_xdev_p50_us", -1.0), 1),
@@ -2770,6 +3029,37 @@ def main() -> None:
             "serving_status", {}).get("ici://1", {}).get(
             "scheduler", {}).get("batch_occupancy_avg", -1.0),
         "pod_serving_status": soak.get("serving_status", {}),
+        # ISSUE-15 zero-copy KV handoff: LoadKv p50/p99 + bytes-copied,
+        # adopted/scattered vs the PR-14 materialize path, same-run A/B,
+        # routes asserted per leg via the serving_kv_load_* deltas
+        "serving_kv_loopback_adopt_p50_us": kvh.get(
+            "kv_loopback_adopt_p50_us", -1.0),
+        "serving_kv_loopback_materialize_p50_us": kvh.get(
+            "kv_loopback_materialize_p50_us", -1.0),
+        "serving_kv_ici_adopt_p50_us": kvh.get(
+            "kv_ici_adopt_p50_us", -1.0),
+        "serving_kv_ici_materialize_p50_us": kvh.get(
+            "kv_ici_materialize_p50_us", -1.0),
+        "serving_kv_adopt_copy_x": kvh.get(
+            "kv_loopback_adopt_copy_x", -1.0),
+        "serving_kv_materialize_copy_x": kvh.get(
+            "kv_loopback_materialize_copy_x", -1.0),
+        "serving_kv_adopt_speedup_loopback_x": kvh.get(
+            "kv_adopt_speedup_loopback_x", -1.0),
+        "serving_kv_adopt_speedup_ici_x": kvh.get(
+            "kv_adopt_speedup_ici_x", -1.0),
+        "serving_kv_pass_copy_bound": kvh.get("pass_copy_bound", False),
+        "serving_kv_pass_p50_improves": kvh.get("pass_p50_improves",
+                                                False),
+        # ISSUE-15 single-lock batched bvar recording: ns per
+        # LatencyRecorder sample, batched vs the PR-13 five-lock path,
+        # plus the echo-shaped A/B (py_handler_bvar_unbatched_* in the
+        # echo extra above)
+        "bvar_record_batched_ns": bvr.get("bvar_record_batched_ns",
+                                          -1.0),
+        "bvar_record_unbatched_ns": bvr.get("bvar_record_unbatched_ns",
+                                            -1.0),
+        "bvar_record_cut_pct": bvr.get("bvar_record_cut_pct", -1.0),
     }
     # single-device allreduce is local-HBM bandwidth, not ICI: label it so
     # no reader mistakes it for line rate (VERDICT r3 #3a)
@@ -2802,7 +3092,8 @@ if __name__ == "__main__":
               "collective_fanout": bench_collective_fanout,
               "collective_single": bench_collective_single,
               "pod_prefill_decode": bench_pod_prefill_decode,
-              "serving_soak": bench_serving_soak}[sys.argv[2]]
+              "serving_soak": bench_serving_soak,
+              "serving_kv": bench_serving_kv_handoff}[sys.argv[2]]
         print(_json.dumps(fn()))
     else:
         main()

@@ -12,12 +12,30 @@ from __future__ import annotations
 import threading
 from typing import List, Optional
 
+from ..butil import flags as _flags
 from ..butil.misc import fast_rand_less_than
 from .variable import Variable, PassiveStatus
 from .reducer import Adder, Maxer, Reducer
 from .window import PerSecond, _ReducerSampler, SamplerCollector
 
 _SAMPLES_PER_AGENT = 254        # reference: PercentileInterval<254>
+
+# Single-lock batched recording (ISSUE 15, the ROADMAP 4c residue
+# lead): `rec << us` sits on every request's accounting path, and the
+# PR-13 fast tuple still paid FIVE per-agent lock acquisitions per
+# record (sum, count, max, qps-count, percentile).  With the flag on, a
+# thread's five agents are CREATED sharing one lock, so the whole
+# record is one acquisition + five inline updates; readers keep taking
+# each agent's lock (the same object five times over) so the
+# write-local structure and the sampler's combine discipline are
+# unchanged.  The flag is read once per (recorder, thread) at agent
+# bind time — a fresh recorder (new server / MethodStatus) under a
+# flipped flag gives the A/B leg.
+_flags.define_flag(
+    "bvar_batched_record", True,
+    "record LatencyRecorder samples under ONE shared per-thread lock "
+    "(five agents, one acquisition) instead of five per-agent locks; "
+    "off restores the PR-13 record path for same-run A/B")
 
 
 class _PercentileSample:
@@ -76,10 +94,10 @@ class Percentile(Reducer):
             a.value.add(int(latency))
         return self
 
-    def _agent(self):
+    def _agent(self, lock=None):
         a = getattr(self._tls, "agent", None)
         if a is None:
-            a = super()._agent()
+            a = super()._agent(lock)
             a.value = _PercentileSample()
         return a
 
@@ -140,7 +158,9 @@ class LatencyRecorder(Variable):
         # on every request's accounting path (MethodStatus.on_responded),
         # and five reducer dispatches (tls getattr + lambda op each)
         # measured ~3 µs/record — one tls load + inline updates keeps it
-        # under 1.  Readers still take each agent's own lock, so the
+        # under 1.  Under bvar_batched_record the five agents also SHARE
+        # one lock (see the flag above), so the whole record is a single
+        # acquisition.  Readers still take each agent's own lock, so the
         # write-local structure is unchanged.
         self._tls_fast = threading.local()
         super().__init__(None)
@@ -155,18 +175,49 @@ class LatencyRecorder(Variable):
         self._win_percentile.expose_percentiles(prefix)
         return ok
 
+    def _bind_agents(self):
+        """Resolve this thread's five agents once.  Batched mode creates
+        them sharing ONE lock; when any agent pre-exists with its own
+        lock (another recorder path bound it first) the shared-lock
+        invariant can't hold and the tuple degrades to per-agent
+        locking — correctness never depends on the mode."""
+        if _flags.get_flag("bvar_batched_record"):
+            lock = threading.Lock()
+            s = self._latency._sum._agent(lock)
+            c = self._latency._count._agent(lock)
+            m = self._max_latency._agent(lock)
+            n = self._count._agent(lock)
+            p = self._percentile._agent(lock)
+            if (s.lock is c.lock and c.lock is m.lock
+                    and m.lock is n.lock and n.lock is p.lock):
+                return (s.lock, s, c, m, n, p,
+                        self._percentile._identity)
+            return (None, s, c, m, n, p, self._percentile._identity)
+        return (None, self._latency._sum._agent(),
+                self._latency._count._agent(),
+                self._max_latency._agent(), self._count._agent(),
+                self._percentile._agent(), self._percentile._identity)
+
     def __lshift__(self, latency_us: int) -> "LatencyRecorder":
         latency_us = int(latency_us)
         tls = self._tls_fast
         ag = getattr(tls, "agents", None)
         if ag is None:
-            ag = tls.agents = (self._latency._sum._agent(),
-                               self._latency._count._agent(),
-                               self._max_latency._agent(),
-                               self._count._agent(),
-                               self._percentile._agent(),
-                               self._percentile._identity)
-        s, c, m, n, p, pident = ag
+            ag = tls.agents = self._bind_agents()
+        lock, s, c, m, n, p, pident = ag
+        if lock is not None:
+            # batched: ONE acquisition covers all five updates
+            with lock:
+                s.value += latency_us
+                c.value += 1
+                if latency_us > m.value:
+                    m.value = latency_us
+                n.value += 1
+                v = p.value
+                if v is pident:      # window reset swapped the reservoir
+                    v = p.value = _PercentileSample()
+                v.add(latency_us)
+            return self
         with s.lock:
             s.value += latency_us
         with c.lock:

@@ -37,10 +37,21 @@ class Reducer(Variable, Generic[T]):
         self._tls = threading.local()
         super().__init__(name)
 
-    def _agent(self) -> _Agent:
+    def _agent(self, lock=None) -> _Agent:
+        """This thread's agent, created on first use.  ``lock`` (a
+        CALLER-SUPPLIED lock) backs LatencyRecorder's single-lock
+        batched recording (ISSUE 15): its five per-thread agents share
+        ONE lock so a record is one acquisition instead of five.  The
+        shared lock is installed BEFORE the agent is published to
+        readers (swapping the lock on a published agent would race a
+        concurrent get_value).  An agent that already exists keeps its
+        own lock; the caller detects the mismatch and falls back to
+        per-agent locking."""
         a = getattr(self._tls, "agent", None)
         if a is None:
             a = _Agent(self._identity)
+            if lock is not None:
+                a.lock = lock
             self._tls.agent = a
             with self._agents_lock:
                 self._agents.append(a)

@@ -471,21 +471,36 @@ class NativeAttachment(IOBuf):
         if not h:
             return                     # surrendered/disposed: empty
         self._h = 0
+        for arr, nbytes in self._take_parked(h):
+            self.append_device_array_unchecked(arr, nbytes)
+
+    def _take_parked(self, h: int) -> list:
+        """Consume the parked native entry for ``h`` plus its registry
+        keys, returning ``[(array, nbytes), ...]`` — the ONE custody
+        walk behind both exits-into-Python (``_materialize`` and
+        ``take_segments``).  On any failure every not-yet-taken key is
+        released before the raise (the view can no longer exit, so a
+        stranded key would pin its array forever); releasing keys a
+        native dispose already dropped is a no-op, never a
+        double-free."""
         fns = _att_fns
+        metas = self._seg_meta
         if fns is None or fns[0](h) < 0:    # att_take consumes the entry
+            release = _registry.release
+            for key, _n, _d in metas:
+                release(key)
             raise KeyError(f"ici native att handle {h} missing")
         take = _registry.take
-        metas = self._seg_meta
+        out = []
         for i, (key, nbytes, _dev) in enumerate(metas):
             arr = take(key)
             if arr is None:
-                # custody bug surface: keep exactly-one-exit for the
-                # REST of the list before raising
                 release = _registry.release
                 for k2, _n2, _d2 in metas[i + 1:]:
                     release(k2)
                 raise KeyError(f"ici device ref {key} missing")
-            self.append_device_array_unchecked(arr, nbytes)
+            out.append((arr, nbytes))
+        return out
 
     # ---- cheap overrides (no materialization) ------------------------
     def __len__(self) -> int:
@@ -503,7 +518,34 @@ class NativeAttachment(IOBuf):
         return (f"NativeAttachment(size={self._total}, "
                 f"handle={self._h:#x}, lazy)")
 
+    @property
+    def parked(self) -> bool:
+        """True while the seg list is still in NATIVE custody (never
+        materialized, handle not yet exited) — the predicate outside
+        callers (the serving KV loader) route on instead of reaching
+        into the view's slots."""
+        return not self._mat and bool(self._h)
+
     # ---- custody exits -----------------------------------------------
+    def take_segments(self) -> list:
+        """Fourth custody exit (ISSUE 15): take the parked segs into
+        Python as raw ``(array, nbytes)`` pairs WITHOUT building IOBuf
+        blocks — the serving KV scatter-loader's surface (the bytes go
+        straight into pool blocks, so Block/BlockRef construction would
+        be pure overhead).  Consumes the handle and the registry keys
+        (exactly-one-exit holds: afterwards the view reads as an EMPTY
+        IOBuf and pool-recycle/GC disposes are no-ops).  On a custody
+        bug mid-walk the remaining keys are released before the raise,
+        same as materialization."""
+        if self._mat or not self._h:
+            raise ValueError(
+                "take_segments: view already materialized or exited")
+        IOBuf.__init__(self)           # _refs/_size: the view is now an
+        self._mat = True               # inert empty buffer
+        h = self._h
+        self._h = 0
+        return self._take_parked(h)
+
     def _surrender_native(self) -> int:
         """Hand the parked entry back to native (the response pass-
         through): returns the handle and forgets it — the respond

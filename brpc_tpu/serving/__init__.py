@@ -11,6 +11,11 @@ workers (``examples/disagg_serving`` is built ON this package):
   * :mod:`.scheduler` — ``ContinuousBatchScheduler``: one batched
     decode step per tick over the active session set, sessions
     admitted/retired/preempted BETWEEN steps;
+  * :mod:`.kv_source` — the zero-copy KV handoff (ISSUE 15): wire
+    attachment segments (shm ring claims, parked native att handles,
+    loopback device blocks) scatter DIRECTLY into the pool blocks
+    ``load_into`` reserves — one copy pass, route-asserted via
+    ``serving_kv_load_*`` counters;
   * :mod:`.router` — ``LoadAwareRouter``: prefill→decode routing by
     load through the LALB divided-weight balancer, with elastic
     membership from a naming url (``pod://``);
@@ -21,6 +26,8 @@ workers (``examples/disagg_serving`` is built ON this package):
 from .autoscaler import AutoscalerOptions, LoadThresholdAutoscaler
 from .kv_pool import (KvPoolOptions, PagedKvPool, PoolSaturated,
                       SessionBusy)
+from .kv_source import (WireKvSource, kv_load_stats, load_wire_attachment,
+                        wire_source)
 from .router import LoadAwareRouter
 from .scheduler import (BatchSchedulerOptions, ContinuousBatchScheduler,
                         StepRequest)
@@ -36,4 +43,8 @@ __all__ = [
     "PoolSaturated",
     "SessionBusy",
     "StepRequest",
+    "WireKvSource",
+    "kv_load_stats",
+    "load_wire_attachment",
+    "wire_source",
 ]

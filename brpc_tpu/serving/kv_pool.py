@@ -33,10 +33,19 @@ over a fixed block pool).  One pool per decode worker:
     its step roster; pinned sessions are never evicted or expired (their
     block tables are live in the current batched program).
 
-Custody: a session's bytes enter the pool exactly once (the ``load``
-copy out of the RPC attachment — on the native-ici plane that is the
-single materialization of the parked NativeAttachment handle) and leave
-by exactly one of release / evict / expire / close.
+Custody: a session's bytes enter the pool exactly once and leave by
+exactly one of release / evict / expire / close.  Two entry surfaces:
+
+  * ``load`` — the caller already holds the whole session as one
+    contiguous token-major array (the PR-14 materialized path, kept for
+    A/B and for sources that cannot scatter);
+  * ``load_into`` (ISSUE 15) — the block table is RESERVED first, then
+    the caller's ``fill`` writes token rows DIRECTLY into the arena
+    blocks, so a loader never materializes the session as one
+    intermediate array.  The serving loader feeds this from the wire:
+    shm ring claims and parked native att segments scatter straight
+    into the reserved blocks (``serving/kv_source.py``), one copy pass
+    total.
 """
 from __future__ import annotations
 
@@ -111,10 +120,19 @@ class KvPoolOptions:
 class _KvSession:
     """One session's block table (access under the pool lock; the
     numeric fields are immutable after load, so the scheduler may READ
-    blocks/seq_len/acc/last_token from its roster snapshot lock-free)."""
+    blocks/seq_len/acc/last_token from its roster snapshot lock-free).
+
+    ``pinned`` is a COUNT (ISSUE 15), not a flag: the step roster holds
+    one pin per roster entry and a zero-copy ``snapshot(view=True)``
+    reader holds another — either alone fences eviction/expiry, and
+    releasing one must not unfence the other.  ``release_pending``
+    marks a ``release`` that arrived while pinned: the free is DEFERRED
+    to the last unpin instead of yanking blocks out from under a
+    reader (or being silently dropped)."""
 
     __slots__ = ("session", "tenant", "priority", "seq_len", "last_token",
-                 "acc", "blocks", "last_used", "pinned")
+                 "acc", "blocks", "last_used", "pinned",
+                 "release_pending", "contiguous")
 
     def __init__(self, session: str, tenant: str, priority: int,
                  seq_len: int, last_token: int, acc: int,
@@ -127,7 +145,12 @@ class _KvSession:
         self.acc = acc
         self.blocks = blocks             # np.int64 (n_blocks,)
         self.last_used = now
-        self.pinned = False
+        self.pinned = 0
+        self.release_pending = False
+        # blocks are immutable after commit, so the one-ascending-
+        # extent test is computed ONCE here — snapshot(view=True)'s
+        # per-read eligibility is a field read, not an array compare
+        self.contiguous = bool((np.diff(blocks) == 1).all())
 
 
 class PagedKvPool:
@@ -157,6 +180,13 @@ class PagedKvPool:
         self._store = np.zeros(
             (o.num_blocks, o.block_tokens * o.bytes_per_token), np.uint8)
         self._pos_sums = np.zeros((o.num_blocks, o.block_tokens), np.int64)
+        # row-sum accumulator dtype: int32 sums measured 2.7x faster
+        # than int64 on the uint8 arena (numpy SIMD), and a row of
+        # bytes_per_token 255s fits int32 up to ~8.4 MB/token — fall
+        # back to int64 beyond (the arena itself stays int64 either way)
+        self._sum_dtype = (np.int32
+                           if o.bytes_per_token * 255 < 2**31 - 1
+                           else np.int64)
         # the batched decode step's gather surface: a VIEW over the
         # reduction arena (C-contiguous reshape shares memory), fixed
         # shape for the whole pool lifetime — jit-friendly
@@ -172,6 +202,8 @@ class PagedKvPool:
         self.bytes_in = bvar.Adder("serving_kv_pool_bytes_in")
         self.evictions = bvar.Adder("serving_kv_pool_evictions")
         self.expirations = bvar.Adder("serving_kv_pool_expired")
+        # load_into fills that raised: the reservation aborted clean
+        self.fill_aborts = bvar.Adder("serving_kv_pool_fill_aborts")
         self._counters: Dict[tuple, bvar.Adder] = {}
         self._tenant_labels: set = set()
 
@@ -229,33 +261,14 @@ class PagedKvPool:
             raise ValueError("token_rows must hold at least one token")
         pri = self._clip_priority(priority)
         need = self.blocks_for(seq_len)
-        if need > o.num_blocks:
-            raise PoolSaturated(need, o.num_blocks)
-        row_sums = rows.sum(axis=1, dtype=np.int64)
+        row_sums = rows.sum(axis=1, dtype=self._sum_dtype)
         now = self._now()
         bt = o.block_tokens
         with self._lock:
-            if self._closed:
-                raise RuntimeError("kv pool is closed")
-            old = self._tables.get(session)
-            if old is not None:
-                if old.pinned:
-                    # NEVER free a rostered session's blocks out from
-                    # under the running batched step
-                    raise SessionBusy(session)
-                # a re-prefilled session replaces its previous table
-                self._free_session_locked(old, "reloaded")
-            if need > len(self._free):
-                victims = self._pick_victims_locked(
-                    need - len(self._free), pri)
-                if victims is None:
-                    raise PoolSaturated(need, len(self._free))
-                for v in victims:
-                    self._free_session_locked(v, "pressure")
-            blocks = np.empty(need, np.int64)
+            blocks, deferred_old = self._reserve_locked(session, need,
+                                                        pri)
             for k in range(need):
-                blk = self._free.pop()
-                blocks[k] = blk
+                blk = int(blocks[k])
                 chunk = rows[k * bt:(k + 1) * bt]
                 n = chunk.shape[0]
                 flat = chunk.reshape(-1)
@@ -267,13 +280,152 @@ class PagedKvPool:
                     self._store[blk, flat.size:] = 0
                     self._pos_sums[blk, n:] = 0
             s = _KvSession(session, tenant, pri, seq_len, last_token,
-                           int(row_sums.sum()), blocks, now)
-            self._tables[session] = s
-            self._recent_evicted.pop(session, None)
-            self._schedule_sweep_locked()
+                           int(row_sums.sum(dtype=np.int64)), blocks,
+                           now)
+            self._commit_locked(s, deferred_old)
         self.loads << 1
         self.bytes_in << int(rows.size)
         return s
+
+    def load_into(self, session: str, seq_len: int,
+                  fill: Callable[[List[np.ndarray]], None], *,
+                  last_token: int, tenant: str = "",
+                  priority: Optional[int] = None) -> _KvSession:
+        """Reserve the block table FIRST, then fill blocks IN PLACE —
+        the zero-intermediate-copy loader surface (ISSUE 15).
+
+        ``fill(views)`` receives an ordered list of writable
+        ``(n_rows, bytes_per_token)`` uint8 views — one per CONTIGUOUS
+        EXTENT of reserved blocks, together covering exactly
+        ``seq_len`` token rows (a fresh or steady pool allocates one
+        extent, so the common fill is ONE strided pass; a fragmented
+        pool hands out more, smaller views).  It must write every row
+        (a partial write would publish a table over stale arena bytes).
+        It runs UNDER the pool lock — reserved blocks are off the free
+        list and in no table, so eviction cannot touch them; the hold
+        is what keeps a same-session reload's replace-then-fill atomic
+        and fences ``close()``'s free-list rebuild, and it matches
+        ``load``'s existing hold-through-the-copy discipline (an
+        outside-the-lock fill with a commit-time re-check is a known
+        follow-on) — so ``fill`` must not call back into this pool.
+        If ``fill`` raises, the reservation ABORTS clean: blocks
+        return to the free list, no session entry is created — a
+        same-session RELOAD keeps its previous KV valid whenever the
+        free list alone covered the reservation (see
+        ``_reserve_locked``) — and the exception propagates (the RPC
+        layer's eviction-mid-load / bad-source path).  After a successful fill the pool derives the
+        reduction arena (``pos_sums``/``acc``) from the written bytes,
+        zeroes the partial tail so no prior tenant's bytes survive
+        adoption, and commits the table — byte-for-byte the state
+        ``load`` builds from a pre-materialized array."""
+        o = self.options
+        if seq_len <= 0:
+            raise ValueError("seq_len must be >= 1")
+        pri = self._clip_priority(priority)
+        need = self.blocks_for(seq_len)
+        now = self._now()
+        bt = o.block_tokens
+        bpt = o.bytes_per_token
+        with self._lock:
+            blocks, deferred_old = self._reserve_locked(session, need,
+                                                        pri)
+            # coalesce the reservation into contiguous extents: per-
+            # extent numpy ops amortize over whole runs of blocks
+            # instead of paying call overhead per 16-token block
+            extents = []              # (first_block, n_blocks, n_rows)
+            left = seq_len
+            b0 = int(blocks[0])
+            k = 1
+            for i in range(1, need):
+                b = int(blocks[i])
+                if b == b0 + k:
+                    k += 1
+                    continue
+                rows = min(left, k * bt)
+                extents.append((b0, k, rows))
+                left -= rows
+                b0, k = b, 1
+            extents.append((b0, k, min(left, k * bt)))
+            views = [self._store[e0:e0 + ek].reshape(-1, bpt)[:rows]
+                     for e0, ek, rows in extents]
+            try:
+                fill(views)
+            except BaseException:
+                # abort clean: the reservation never became a session
+                self._return_blocks_locked(blocks)
+                self.fill_aborts << 1
+                raise
+            acc = 0
+            for (e0, ek, rows), v in zip(extents, views):
+                sums = v.sum(axis=1, dtype=self._sum_dtype)
+                ps = self._pos_sums[e0:e0 + ek].reshape(-1)
+                ps[:rows] = sums
+                acc += int(sums.sum(dtype=np.int64))
+                if rows < ek * bt:
+                    # zero the tail so no prior tenant's bytes survive
+                    # in the partially-filled final block
+                    ps[rows:] = 0
+                    self._store[e0:e0 + ek].reshape(-1)[rows * bpt:] = 0
+            s = _KvSession(session, tenant, pri, seq_len, last_token,
+                           acc, blocks, now)
+            self._commit_locked(s, deferred_old)
+        self.loads << 1
+        self.bytes_in << seq_len * bpt
+        return s
+
+    # fablint: lock-held(_lock)
+    def _reserve_locked(self, session: str, need: int, pri: int):
+        """Allocate ``need`` blocks for ``session`` (evicting under
+        pressure per the band/weight/LRU policy): the shared first half
+        of ``load`` and ``load_into``.  Returns ``(blocks,
+        deferred_old)`` — blocks are OFF the free list but not yet in
+        any table; the caller fills them and commits (or returns them
+        on a fill failure).  A same-session reload keeps the OLD entry
+        alive as ``deferred_old`` whenever the free list alone covers
+        the reservation, so an aborted fill leaves the previous KV
+        valid (``_commit_locked`` frees it); only a reservation that
+        NEEDS the old blocks for capacity reclaims them up front — the
+        one case an abort genuinely cannot restore."""
+        o = self.options
+        if need > o.num_blocks:
+            raise PoolSaturated(need, o.num_blocks)
+        if self._closed:
+            raise RuntimeError("kv pool is closed")
+        old = self._tables.get(session)
+        deferred_old = None
+        if old is not None:
+            if old.pinned:
+                # NEVER free a rostered session's blocks out from
+                # under the running batched step
+                raise SessionBusy(session)
+            if need <= len(self._free):
+                deferred_old = old
+            else:
+                # a re-prefill bigger than the free space reclaims its
+                # own previous table first
+                self._free_session_locked(old, "reloaded")
+        if need > len(self._free):
+            victims = self._pick_victims_locked(
+                need - len(self._free), pri)
+            if victims is None:
+                raise PoolSaturated(need, len(self._free))
+            for v in victims:
+                self._free_session_locked(v, "pressure")
+        blocks = np.empty(need, np.int64)
+        for k in range(need):
+            blocks[k] = self._free.pop()
+        return blocks, deferred_old
+
+    # fablint: lock-held(_lock)
+    def _commit_locked(self, s: _KvSession, deferred_old) -> None:
+        if deferred_old is not None:
+            # the reload's fill succeeded: NOW retire the replaced
+            # table (still under the same lock hold, so no reader ever
+            # saw a gap)
+            self._free_session_locked(deferred_old, "reloaded")
+        self._tables[s.session] = s
+        self._recent_evicted.pop(s.session, None)
+        self._schedule_sweep_locked()
 
     # fablint: lock-held(_lock)
     def _pick_victims_locked(self, blocks_needed: int,
@@ -294,9 +446,19 @@ class PagedKvPool:
         return victims if have >= blocks_needed else None
 
     # fablint: lock-held(_lock)
+    def _return_blocks_locked(self, blocks) -> None:
+        """Put blocks back KEEPING the free list sorted descending —
+        the invariant that makes ``pop()`` hand out ASCENDING runs, so
+        ``load_into`` reservations coalesce into few contiguous extents
+        (one strided fill pass each) instead of 1-block shards.  Timsort
+        on the mostly-sorted list is microseconds at pool sizes."""
+        self._free.extend(int(b) for b in blocks)
+        self._free.sort(reverse=True)
+
+    # fablint: lock-held(_lock)
     def _free_session_locked(self, s: _KvSession, reason: str) -> None:
         self._tables.pop(s.session, None)
-        self._free.extend(int(b) for b in s.blocks)
+        self._return_blocks_locked(s.blocks)
         if reason in ("pressure", "expired"):
             self._recent_evicted[s.session] = reason
             while len(self._recent_evicted) > 256:
@@ -311,11 +473,21 @@ class PagedKvPool:
 
     def release(self, session: str) -> bool:
         """Session finished: return its blocks (the decode-complete
-        path).  Idempotent."""
+        path).  Idempotent.  A PINNED session is not freed NOW — a pin
+        means a roster entry or a zero-copy snapshot view is still
+        reading these blocks, and freeing them would hand the bytes to
+        the next loader mid-read — but the release is ACCEPTED and
+        deferred to the last unpin (a race between a completion's
+        release and a concurrent reader's pin window must not leak the
+        blocks forever).  Every in-tree completion path unpins before
+        releasing, so the deferral only fires on genuine races."""
         with self._lock:
             s = self._tables.get(session)
             if s is None:
                 return False
+            if s.pinned:
+                s.release_pending = True
+                return True
             self._free_session_locked(s, "released")
             return True
 
@@ -339,42 +511,87 @@ class PagedKvPool:
                 s.last_used = now
 
     def pin(self, session: str) -> bool:
-        """Fence a session against eviction/expiry (step-roster entry).
-        False when the session is gone."""
+        """Fence a session against eviction/expiry (step-roster entry
+        or snapshot view; counted — pins nest).  False when the session
+        is gone — including LOGICALLY gone: a deferred release
+        (``release_pending``) means the pool already reported this
+        session released, so no NEW reader may pin it while the last
+        old reader drains."""
         with self._lock:
             s = self._tables.get(session)
-            if s is None:
+            if s is None or s.release_pending:
                 return False
-            s.pinned = True
+            s.pinned += 1
             return True
 
     def unpin(self, session: str) -> None:
         now = self._now()
+        unbalanced = False
         with self._lock:
             s = self._tables.get(session)
             if s is not None:
-                s.pinned = False
+                if s.pinned:
+                    s.pinned -= 1
+                else:
+                    # an unpin nobody holds: swallowing it silently
+                    # would let the NEXT unpin steal a live holder's
+                    # fence (eviction under a reader's view) — scream
+                    unbalanced = True
                 s.last_used = now
+                if not s.pinned and s.release_pending:
+                    # a release arrived during the pin window: the last
+                    # reader out frees the blocks
+                    self._free_session_locked(s, "released")
+        if unbalanced:
+            from ..butil import logging as log
+            log.error("kv pool: unbalanced unpin of session %r "
+                      "(no pin held) — caller bug", session)
 
     def materialize(self, session: str) -> Optional[np.ndarray]:
-        """Copy a session's token rows back out, ``(seq_len,
-        bytes_per_token)`` — the sync/one-RPC decode path and the
-        byte-exactness tests."""
+        """COPY a session's token rows back out, ``(seq_len,
+        bytes_per_token)`` — the byte-exactness tests' surface.  The
+        read-only SYNC path should use ``snapshot(view=True)`` instead
+        (the ISSUE-15 bugfix: a contiguous-extent session reads as a
+        zero-copy pinned view, no reshape copy) — that surface returns
+        an explicit ``is_view`` flag so the caller knows whether an
+        unpin is owed; this one stays copy-only exactly so no caller
+        can lose that flag."""
         snap = self.snapshot(session)
         return snap[0] if snap is not None else None
 
-    def snapshot(self, session: str):
+    def snapshot(self, session: str, *, view: bool = False):
         """``(rows, seq_len, last_token)`` under ONE lock acquisition —
         the sync decode path's atomic read (a separate get() +
         materialize() pair could straddle an eviction and pair the old
-        entry's metadata with the new entry's bytes)."""
+        entry's metadata with the new entry's bytes).
+
+        ``view=True`` returns ``(rows, seq_len, last_token, is_view)``:
+        when the session's blocks are one contiguous ascending extent,
+        ``rows`` is a READ-ONLY view straight into the arena (no copy)
+        and the session is PINNED — the caller MUST ``unpin(session)``
+        when done reading, BEFORE any release.  Non-contiguous sessions
+        (or pools under a straddle risk the caller can't fence) keep
+        the copy, ``is_view=False``, no pin owed — the copy is what
+        makes a concurrent eviction safe there, so it stays."""
         o = self.options
         with self._lock:
             s = self._tables.get(session)
-            if s is None:
+            if s is None or s.release_pending:
+                # a deferred release means "already released" to every
+                # NEW reader — only the pinned old readers drain it
                 return None
-            rows = self._store[s.blocks].reshape(
+            blocks = s.blocks
+            if view and s.contiguous:
+                b0 = int(blocks[0])
+                rows = self._store[b0:b0 + len(blocks)].reshape(
+                    -1, o.bytes_per_token)[:s.seq_len]
+                rows.flags.writeable = False   # read-only for the
+                s.pinned += 1                  # caller, arena intact
+                return rows, s.seq_len, s.last_token, True
+            rows = self._store[blocks].reshape(
                 -1, o.bytes_per_token)[:s.seq_len].copy()
+            if view:
+                return rows, s.seq_len, s.last_token, False
             return rows, s.seq_len, s.last_token
 
     # ---- expiry ---------------------------------------------------------
@@ -456,6 +673,7 @@ class PagedKvPool:
             "bytes_in": self.bytes_in.get_value(),
             "evictions": self.evictions.get_value(),
             "expired": self.expirations.get_value(),
+            "fill_aborts": self.fill_aborts.get_value(),
             "by_tenant": by_class,
             "ttl_s": o.ttl_s,
         }

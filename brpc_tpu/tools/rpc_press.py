@@ -461,6 +461,18 @@ def run_press_serving(server: str, duration: float = 5.0,
     stats = collect_serving_stats()
     if stats:
         result["serving_status"] = stats
+        # kv-load route counts (ISSUE 15): which path carried the
+        # sessions' KV bytes into the pool — adopted (host claims in
+        # place) / scattered (device segs / parked native handles) /
+        # materialized (the PR-14 fallback) — plus the host-copy-passes
+        # byte counter.  Gated like serving_status: the counters are
+        # process-global, so a remote-only press run would otherwise
+        # report its own all-zero locals as the server's route truth.
+        try:
+            from brpc_tpu.serving import kv_load_stats
+            result["kv_load_routes"] = kv_load_stats()
+        except Exception:
+            pass
     print(json.dumps(result), file=out)
     for ch in channels:
         ch.close()

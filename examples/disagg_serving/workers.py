@@ -8,9 +8,13 @@ attachments), but the decode side is now the REAL serving subsystem
   * **PrefillService** (``Prefill``): prompt → quantized KV blocks on
     its own device, HANDED OFF to the router-chosen decode worker as a
     DEVICE-payload attachment (``DecodeService.LoadKv``).  Cross-process
-    this rides the fabric's sequenced device plane; on the native-ici
-    plane the attachment moves under PR-12 custody (one parked handle,
-    zero Python seg walks until the pool copy).
+    this rides the fabric's sequenced device plane or the shm ring; on
+    the native-ici plane the attachment moves under PR-12 custody (one
+    parked handle).  Wherever it lands, LoadKv scatters the wire bytes
+    DIRECTLY into the paged pool's reserved blocks (ISSUE 15): shm
+    claims are consumed in place, parked handles taken segment-wise —
+    one copy pass, no per-session host materialization
+    (``serving_kv_load_*`` counters carry the per-route truth).
   * **DecodeService** (``LoadKv`` / ``Decode``): KV pages into a
     :class:`~brpc_tpu.serving.PagedKvPool` (admission-aware eviction,
     TimerThread expiry — an idle worker reclaims parked sessions with
@@ -48,7 +52,9 @@ from brpc_tpu.butil import debug_sync as _dbg
 from brpc_tpu.serving import (BatchSchedulerOptions,
                               ContinuousBatchScheduler, KvPoolOptions,
                               LoadAwareRouter, PagedKvPool, PoolSaturated,
-                              SessionBusy, StepRequest)
+                              SessionBusy, StepRequest, kv_load_stats,
+                              load_wire_attachment)
+from brpc_tpu.serving import kv_source as _kv_source
 from examples.example_echo_pb2 import EchoRequest, EchoResponse
 
 from .model import (KV_DMODEL, KV_LAYERS, VOCAB, kv_nbytes, toy_decode,
@@ -171,9 +177,14 @@ class DecodeService(rpc.Service):
 
     def describe_serving(self) -> dict:
         """The /status serving block: step rate, batch occupancy, pool
-        pages, evictions by reason/tenant."""
+        pages, evictions by reason/tenant, KV-load routes.  Unlike the
+        per-instance scheduler/pool blocks, ``kv_load`` is the
+        PROCESS-WIDE route ledger (the counters live in
+        ``serving/kv_source.py``) — with several decode workers in one
+        process it sums all of them, and says so via ``scope``."""
         return {"scheduler": self.scheduler.describe(),
-                "pool": self.pool.describe()}
+                "pool": self.pool.describe(),
+                "kv_load": {**kv_load_stats(), "scope": "process"}}
 
     @rpc.method(EchoRequest, EchoResponse)
     def LoadKv(self, cntl, request, response, done):
@@ -186,21 +197,45 @@ class DecodeService(rpc.Service):
             done()
             return
         want = kv_nbytes(seq_len)
-        blob = cntl.request_attachment.to_bytes()
-        if len(blob) != want:
+        att = cntl.request_attachment
+        # len() answers from the descriptor total on every plane —
+        # a parked NativeAttachment is NOT materialized by this check
+        if len(att) != want:
             cntl.set_failed(rpc.errors.EREQUEST,
-                            f"kv size {len(blob)} != {want}")
+                            f"kv size {len(att)} != {want}")
             done()
             return
-        # layer-major wire layout → token-major pool rows, ONE transpose
-        # at the pool boundary (each block row is one token's bytes)
-        rows = np.frombuffer(blob, np.uint8).reshape(
-            KV_LAYERS, seq_len, KV_DMODEL).transpose(1, 0, 2).reshape(
-            seq_len, BYTES_PER_TOKEN)
         try:
-            self.pool.load(session, rows, last_token=req["last_token"],
-                           tenant=cntl.tenant or req.get("tenant", ""),
-                           priority=cntl.priority)
+            if _kv_source.adopt_enabled():
+                # ISSUE 15: the wire bytes scatter DIRECTLY into the
+                # reserved pool blocks — shm ring claims consumed in
+                # place (slot retired right after the fill), parked
+                # native att segments taken block-wise, ONE copy pass;
+                # the layer-major → token-major transpose happens
+                # inside the strided scatter, never as its own pass
+                load_wire_attachment(
+                    self.pool, att, session, seq_len, KV_LAYERS,
+                    KV_DMODEL, last_token=req["last_token"],
+                    tenant=cntl.tenant or req.get("tenant", ""),
+                    priority=cntl.priority)
+                # drop the attachment refs NOW: the ring claim's
+                # consume-to-release credit returns on this line, not
+                # at controller recycle
+                att.clear()
+            else:
+                # the PR-14 path, byte-for-byte (the A/B leg):
+                # materialize (copy 1), transpose-reshape (copy 2),
+                # pool fill (copy 3)
+                blob = att.to_bytes()
+                rows = np.frombuffer(blob, np.uint8).reshape(
+                    KV_LAYERS, seq_len, KV_DMODEL).transpose(
+                    1, 0, 2).reshape(seq_len, BYTES_PER_TOKEN)
+                self.pool.load(session, rows,
+                               last_token=req["last_token"],
+                               tenant=cntl.tenant or req.get("tenant",
+                                                             ""),
+                               priority=cntl.priority)
+                _kv_source.stats.record(_kv_source.MATERIALIZED, want, 3)
         except PoolSaturated:
             # memory pressure with nothing evictable in an equal-or-
             # less-protected band: a shed, not a failure
@@ -263,8 +298,11 @@ class DecodeService(rpc.Service):
     def _decode_sync(self, cntl, session, steps, release, response,
                      done) -> None:
         """The pre-batching one-RPC-one-shot path (bench A/B baseline):
-        materialize the session out of the pool and decode inline."""
-        snap = self.pool.snapshot(session)
+        read the session out of the pool and decode inline.  The read
+        is a zero-copy VIEW when the session's blocks are one
+        contiguous extent (the ISSUE-15 materialize bugfix) — pinned
+        for exactly the decode, unpinned before the release."""
+        snap = self.pool.snapshot(session, view=True)
         if snap is None:
             reason = self.pool.evicted_reason(session)
             if reason is not None:
@@ -276,11 +314,15 @@ class DecodeService(rpc.Service):
                                 f"unknown session {session!r}")
             done()
             return
-        rows, seq_len, last_token = snap
-        # token-major rows → the model's layer-major flat layout
-        flat = rows.reshape(seq_len, KV_LAYERS, KV_DMODEL).transpose(
-            1, 0, 2).reshape(-1)
-        toks = toy_decode(flat, seq_len, last_token, steps)
+        rows, seq_len, last_token, is_view = snap
+        try:
+            # token-major rows → the model's layer-major flat layout
+            flat = rows.reshape(seq_len, KV_LAYERS, KV_DMODEL).transpose(
+                1, 0, 2).reshape(-1)
+            toks = toy_decode(flat, seq_len, last_token, steps)
+        finally:
+            if is_view:
+                self.pool.unpin(session)
         with self._lock:
             self.decode_steps += steps
         if release:

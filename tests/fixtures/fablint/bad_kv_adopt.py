@@ -1,0 +1,33 @@
+"""Seeded violation for the zero-copy KV adoption path (ISSUE 15): a
+pool-like class that RESERVES blocks under the pool lock but runs the
+in-place fill and publishes the session table OUTSIDE it — the exact
+shape ``PagedKvPool.load_into`` must never take: between the dropped
+lock and the publish, an eviction under pressure can hand one of the
+reserved (not-yet-tabled) blocks to another loader, and both sessions
+then scatter into the same arena rows (one tenant's KV bytes readable
+through the other's block table)."""
+import threading
+
+
+class KvAdoptPool:
+    _GUARDED_BY = {"_free": "_lock", "_tables": "_lock"}
+
+    def __init__(self, arena):
+        self._lock = threading.Lock()
+        self._free = list(range(8))
+        self._tables = {}
+        self._arena = arena
+
+    def load_into_racy(self, session, n, fill):
+        with self._lock:
+            blocks = [self._free.pop() for _ in range(n)]
+        views = [self._arena[b] for b in blocks]
+        fill(views)                    # fill outside the lock, and...
+        self._tables[session] = blocks    # line 26: the violation
+
+    def load_into_guarded(self, session, n, fill):
+        with self._lock:
+            blocks = [self._free.pop() for _ in range(n)]
+            fill([self._arena[b] for b in blocks])
+            self._tables[session] = blocks
+            return blocks

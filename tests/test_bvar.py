@@ -128,6 +128,48 @@ class TestLatencyRecorder:
         assert r.average() == 15
         assert r.sum() == 30 and r.count() == 2
 
+    def test_batched_record_shares_one_lock(self):
+        """Single-lock batched recording (ISSUE 15): under the default
+        flag a thread's five agents share ONE lock object (a record is
+        one acquisition), reads stay correct across threads, and the
+        windowed percentile still samples."""
+        from brpc_tpu.butil import flags as _fl
+        assert _fl.get_flag("bvar_batched_record") is True
+        rec = bvar.LatencyRecorder(window_size=10)
+        rec << 100
+        lock, s, c, m, n, p, _ident = rec._tls_fast.agents
+        assert lock is not None
+        assert s.lock is lock and c.lock is lock and m.lock is lock
+        assert n.lock is lock and p.lock is lock
+
+        def w(v):
+            for _ in range(2000):
+                rec << v
+
+        ts = [threading.Thread(target=w, args=(v,)) for v in (10, 30)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert rec.count() == 4001
+        assert rec.max_latency() == 100
+        bvar.SamplerCollector.instance().sample_once()
+        assert rec.latency_percentile(0.5) > 0
+
+    def test_unbatched_flag_restores_per_agent_locks(self):
+        from brpc_tpu.butil import flags as _fl
+        prev = _fl.get_flag("bvar_batched_record")
+        _fl.set_flag("bvar_batched_record", False)
+        try:
+            rec = bvar.LatencyRecorder()
+            rec << 50
+            lock, s, c, *_rest = rec._tls_fast.agents
+            assert lock is None
+            assert s.lock is not c.lock
+            assert rec.count() == 1 and rec.latency() == 50.0
+        finally:
+            _fl.set_flag("bvar_batched_record", prev)
+
 
 class TestMultiDimension:
     def test_labelled_stats(self):

@@ -130,6 +130,18 @@ class TestFixtureViolations:
         assert "_free" in out[0].message and "_lock" in out[0].message
         assert out[0].path.endswith("bad_kv_pool.py")
 
+    def test_unguarded_kv_adopt_publish_reported_with_line(self):
+        """The zero-copy KV adoption path (ISSUE 15): reserving blocks
+        under the pool lock but filling + publishing the session table
+        outside it is caught at the exact file:line — between the
+        dropped lock and the publish an eviction can hand a reserved
+        block to another loader (two sessions scattering into one
+        arena row)."""
+        out = _findings("bad_kv_adopt.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 26)]
+        assert "_tables" in out[0].message and "_lock" in out[0].message
+        assert out[0].path.endswith("bad_kv_adopt.py")
+
     def test_clean_fixture_is_silent(self):
         out = _findings(
             "clean_module.py",
@@ -225,7 +237,8 @@ class TestZeroFindingsGate:
                "ici/fabric.py", "ici/transport.py", "ici/device_plane.py",
                "policy/load_balancers.py", "butil/resource_pool.py",
                "bthread/scheduler.py", "serving/kv_pool.py",
-               "serving/scheduler.py", "serving/autoscaler.py"]
+               "serving/kv_source.py", "serving/scheduler.py",
+               "serving/autoscaler.py"]
         for rel in hot:
             src = open(os.path.join(PKG, rel)).read()
             assert "_GUARDED_BY" in src, f"{rel} lost its guard map"

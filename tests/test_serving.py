@@ -1,12 +1,18 @@
-"""Production serving subsystem (brpc_tpu/serving, ISSUE 14).
+"""Production serving subsystem (brpc_tpu/serving, ISSUE 14 + the
+ISSUE-15 zero-copy KV handoff).
 
-Five legs:
+Legs:
 
   * **PagedKvPool units** — block accounting, byte-exact custody,
     admission-aware eviction order (band before weight before LRU, the
     protected-band fence), pins, and the TIMER-DRIVEN expiry sweep (the
     ISSUE-14 bugfix regression: a parked session on an otherwise-idle
     worker is reclaimed with zero new traffic);
+  * **zero-copy KV handoff** (ISSUE 15) — byte parity of the adopted /
+    scattered / materialized load routes incl. straddling segments and
+    partial-tail zeroing, abort-clean fills, counted pins, the
+    snapshot-view materialize bugfix, RPC-level route assertions with
+    custody census, and the 2-PROCESS shm claim-to-pool leg;
   * **ContinuousBatchScheduler units** (manual stepping) — per-step
     admit/retire, tokens bit-exact against the single-process reference
     under staggered joins, interactive preemption preserving progress,
@@ -282,6 +288,344 @@ class TestPagedKvPool:
             assert pool.evicted_reason("s") == "expired"
         finally:
             pool.close()
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy KV handoff (ISSUE 15): attachment bytes land DIRECTLY in
+# pool blocks — byte parity across all three load routes (straddling
+# segments, partial tails, prior-tenant zeroing), custody census,
+# abort-clean fills, and the snapshot-view bugfix pins.
+# ---------------------------------------------------------------------------
+
+def _wire(tokens):
+    """Prompt → the layer-major wire payload LoadKv receives."""
+    return np.asarray(_model().toy_kv_blocks(tokens))
+
+
+class TestKvZeroCopyHandoff:
+    def _adopt(self, pool, session, tokens, segments, **kw):
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.serving import load_wire_attachment
+        m = _model()
+        buf = IOBuf()
+        for seg in segments:
+            buf.append_user_data(memoryview(seg))
+        kw.setdefault("last_token", tokens[-1])
+        return load_wire_attachment(pool, buf, session, len(tokens),
+                                    m.KV_LAYERS, m.KV_DMODEL, **kw)
+
+    def test_three_routes_byte_parity_incl_straddle(self):
+        """adopted (host segs) vs scattered (device segs) vs
+        materialized (load) produce IDENTICAL pool state — stored rows,
+        pos_sums arena, and acc — including a multi-segment source cut
+        at boundaries that straddle blocks, tokens, and even a single
+        layer row."""
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.serving import (kv_load_stats,
+                                      load_wire_attachment, wire_source)
+        m = _model()
+        tokens = [(7 * j) % 499 for j in range(21)]     # partial tail
+        blob = _wire(tokens).tobytes()
+        pool = _mk_pool(num_blocks=16, block_tokens=8)
+        try:
+            s0 = kv_load_stats()
+            # adopted: one host segment
+            self._adopt(pool, "one", tokens, [blob])
+            # adopted: segments cut mid-token and mid-layer-row
+            cuts = [0, 13, 777, 781, 5000, 5003, len(blob)]
+            segs = [blob[cuts[i]:cuts[i + 1]]
+                    for i in range(len(cuts) - 1)]
+            self._adopt(pool, "straddle", tokens, segs)
+            # scattered: the device-array shape (loopback plane)
+            buf = IOBuf()
+            buf.append_device_array(m.toy_kv_blocks(tokens))
+            load_wire_attachment(pool, buf, "dev", 21, m.KV_LAYERS,
+                                 m.KV_DMODEL, last_token=tokens[-1])
+            # scattered with an OFFSET device ref (a cut moved the ref,
+            # not the bytes): only the referenced slice crosses D2H
+            import jax.numpy as jnp
+            padded = jnp.concatenate([
+                jnp.zeros(7, jnp.uint8),
+                jnp.asarray(np.frombuffer(blob, np.uint8))])
+            buf2 = IOBuf()
+            buf2.append_device_array(padded)
+            buf2.pop_front(7)
+            load_wire_attachment(pool, buf2, "devcut", 21, m.KV_LAYERS,
+                                 m.KV_DMODEL, last_token=tokens[-1])
+            # materialized: the PR-14 reference
+            ref = pool.load("ref", _rows(tokens), last_token=tokens[-1])
+            want = _rows(tokens)
+            for name in ("one", "straddle", "dev", "devcut"):
+                s = pool.get(name)
+                assert np.array_equal(pool.materialize(name), want), name
+                assert s.acc == ref.acc, name
+                for k in range(len(s.blocks)):
+                    assert np.array_equal(
+                        pool._pos_sums[int(s.blocks[k])],
+                        pool._pos_sums[int(ref.blocks[k])]), (name, k)
+            s1 = kv_load_stats()
+            assert s1["adopted"] - s0["adopted"] == 2
+            assert s1["scattered"] - s0["scattered"] == 2
+            # one copy pass per adopted/scattered load
+            assert s1["copy_bytes"] - s0["copy_bytes"] == 4 * len(blob)
+        finally:
+            pool.close()
+
+    def test_partial_tail_zeroed_after_prior_tenant_adoption(self):
+        """Tail-zeroing must hold on the ADOPTED path too: a short
+        session scattered over a block a longer prior tenant filled
+        leaves no stale bytes or reduction sums in the tail."""
+        pool = _mk_pool(num_blocks=2, block_tokens=8)
+        try:
+            full = [7] * 16
+            self._adopt(pool, "x", full, [_wire(full).tobytes()])
+            pool.release("x")
+            short = [11] * 9                       # 2 blocks, 7 stale
+            s = self._adopt(pool, "y", short, [_wire(short).tobytes()])
+            tail_blk = int(s.blocks[1])
+            bpt = pool.options.bytes_per_token
+            assert pool._pos_sums[tail_blk, 1:].sum() == 0
+            assert pool._store[tail_blk, bpt:].sum() == 0
+            assert np.array_equal(pool.materialize("y"), _rows(short))
+        finally:
+            pool.close()
+
+    def test_fill_abort_returns_blocks_clean(self):
+        """A fill that raises mid-load aborts the reservation: blocks
+        back on the free list, no session entry, the failure counted —
+        the eviction-mid-load / bad-source custody leg."""
+        pool = _mk_pool(num_blocks=8, block_tokens=8)
+        try:
+            free0 = pool.describe()["blocks_free"]
+            aborts0 = pool.fill_aborts.get_value()
+
+            def bad_fill(views):
+                views[0][0, 0] = 1          # partial write, then die
+                raise RuntimeError("source died mid-scatter")
+
+            with pytest.raises(RuntimeError, match="mid-scatter"):
+                pool.load_into("victim", 20, bad_fill, last_token=1)
+            assert pool.get("victim") is None
+            assert pool.describe()["blocks_free"] == free0
+            assert pool.fill_aborts.get_value() == aborts0 + 1
+            # the pool still loads fine afterwards
+            t = [5] * 20
+            self._adopt(pool, "after", t, [_wire(t).tobytes()])
+            assert np.array_equal(pool.materialize("after"), _rows(t))
+            # a RELOAD whose fill aborts keeps the session's PREVIOUS
+            # KV valid when the free list covered the reservation (the
+            # old table's free is deferred to commit)
+            with pytest.raises(RuntimeError, match="mid-scatter"):
+                pool.load_into("after", 20, bad_fill, last_token=1)
+            assert np.array_equal(pool.materialize("after"), _rows(t))
+        finally:
+            pool.close()
+
+    def test_free_list_keeps_extent_order_after_churn(self):
+        """The descending-sorted free list invariant: after arbitrary
+        release order, pops still hand out ASCENDING block runs so
+        adopted fills coalesce into few contiguous extents (the perf
+        contract load_into's one-strided-pass fill depends on)."""
+        pool = _mk_pool(num_blocks=8, block_tokens=8)
+        try:
+            for name in ("a", "b", "c", "d"):
+                pool.load(name, _rows([1] * 16), last_token=1)
+            for name in ("c", "a", "d", "b"):   # scrambled release
+                pool.release(name)
+            assert pool._free == sorted(pool._free, reverse=True)
+            s = pool.load("big", _rows([2] * 64), last_token=2)
+            assert np.array_equal(
+                s.blocks, np.arange(int(s.blocks[0]),
+                                    int(s.blocks[0]) + 8))
+        finally:
+            pool.close()
+
+    def test_snapshot_view_and_straddle_copy(self):
+        """THE ISSUE-15 materialize bugfix, pinned both ways: a
+        contiguous-extent session snapshots as a READ-ONLY zero-copy
+        view (pinned until unpin; release refused while read), and a
+        non-contiguous session keeps the defensive copy."""
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        try:
+            t = [3] * 16
+            pool.load("v", _rows(t), last_token=3)
+            rows, seq, last, is_view = pool.snapshot("v", view=True)
+            assert is_view and not rows.flags.writeable
+            assert np.shares_memory(rows, pool._store)
+            assert np.array_equal(rows, _rows(t))
+            # pinned: eviction fenced; a racing release is DEFERRED to
+            # the last unpin, never dropped and never freed mid-read
+            assert pool.expire_idle(now=pool._now() + 1e9) == 0
+            assert pool.release("v") is True      # accepted, deferred
+            assert pool.get("v") is not None      # ...but not yet freed
+            pool.unpin("v")                       # last reader out
+            assert pool.get("v") is None          # now freed
+            assert pool.release("v") is False     # idempotent: gone
+            # force non-contiguous: fill, punch a hole, reload bigger
+            pool.load("f1", _rows([1] * 8), last_token=1)
+            pool.load("f2", _rows([2] * 8), last_token=2)
+            pool.load("f3", _rows([3] * 8), last_token=3)
+            pool.release("f2")
+            pool.release("f1")
+            pool.load("nc", _rows([4] * 24), last_token=4)  # 0,1,3
+            s = pool.get("nc")
+            assert not np.array_equal(
+                s.blocks, np.arange(int(s.blocks[0]),
+                                    int(s.blocks[0]) + 3))
+            rows, _seq, _last, is_view = pool.snapshot("nc", view=True)
+            assert not is_view
+            assert not np.shares_memory(rows, pool._store)
+            assert np.array_equal(rows, _rows([4] * 24))
+            # no pin owed on the copy path
+            assert pool.release("nc") is True
+            # legacy 3-tuple surface unchanged; materialize stays
+            # copy-only (it cannot carry the is-a-pin-owed flag)
+            pool.load("old", _rows(t), last_token=3)
+            snap = pool.snapshot("old")
+            assert len(snap) == 3
+            mat = pool.materialize("old")
+            assert not np.shares_memory(mat, pool._store)
+        finally:
+            pool.close()
+
+    def test_pins_are_counted_not_boolean(self):
+        """A roster pin and a snapshot-view pin on the SAME session
+        nest: releasing one must not unfence the other (the pinned
+        bool→count change)."""
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        try:
+            pool.load("s", _rows([1] * 8), last_token=1)
+            assert pool.pin("s")                      # roster
+            rows, *_rest, is_view = pool.snapshot("s", view=True)
+            assert is_view                            # + view pin
+            pool.unpin("s")                           # view done
+            # still fenced by the roster pin
+            assert pool.expire_idle(now=pool._now() + 1e9) == 0
+            assert pool.release("s") is True          # deferred again
+            assert pool.get("s") is not None
+            # a deferred-released session is LOGICALLY gone to new
+            # readers: no new pin, no new snapshot — only the old
+            # pinned reader drains it
+            assert pool.pin("s") is False
+            assert pool.snapshot("s") is None
+            pool.unpin("s")                           # roster out: freed
+            assert pool.get("s") is None
+        finally:
+            pool.close()
+
+    def test_rpc_routes_asserted_and_custody_drains(self):
+        """Service level: LoadKv over loopback rides the scattered
+        route (DEVICE block), the flag-off leg rides materialized, and
+        over the NATIVE-ICI plane the parked att handle is taken
+        segment-wise — byte-exact decode on every route, with the att
+        table and device-ref registry drained after each (the census
+        fixture enforces it again at teardown)."""
+        import gc
+
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.ici import native_plane as npl
+        from brpc_tpu.serving import kv_load_stats
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+        m = _model()
+        tokens = [(17 * j) % 499 for j in range(40)]
+        want = m.reference_generate(tokens, 9)
+
+        def load(ch, session):
+            kv = m.toy_kv_blocks(tokens)
+            cntl = rpc.Controller()
+            cntl.request_attachment.append_device_array(kv)
+            ch.call_method("Decode.LoadKv", cntl, EchoRequest(
+                message=json.dumps({"session": session,
+                                    "seq_len": len(tokens),
+                                    "last_token": tokens[-1]})),
+                EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+
+        def decode(ch, session):
+            cntl = rpc.Controller()
+            resp = ch.call_method("Decode.Decode", cntl, EchoRequest(
+                message=json.dumps({"session": session, "steps": 9,
+                                    "mode": "sync"})), EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            return json.loads(resp.message)["tokens"]
+
+        from examples.disagg_serving.workers import DecodeService
+        for plane, addr in (("loopback", "mem://kv-route"),
+                            ("ici", "ici://5")):
+            server = rpc.Server()
+            svc = DecodeService()
+            server.add_service(svc)
+            assert server.start(addr) == 0
+            ch = rpc.Channel()
+            ch.init(addr, options=rpc.ChannelOptions(timeout_ms=30000))
+            try:
+                s0 = kv_load_stats()
+                load(ch, "r1")
+                assert decode(ch, "r1") == want, plane
+                s1 = kv_load_stats()
+                assert s1["scattered"] - s0["scattered"] == 1, plane
+                assert s1["materialized"] == s0["materialized"], plane
+                # flag-off leg: the PR-14 path byte-for-byte
+                _fl.set_flag("serving_kv_adopt", False)
+                try:
+                    load(ch, "r2")
+                finally:
+                    _fl.set_flag("serving_kv_adopt", True)
+                assert decode(ch, "r2") == want, plane
+                s2 = kv_load_stats()
+                assert s2["materialized"] - s1["materialized"] == 1
+                gc.collect()
+                assert npl.registry().live() == 0, plane
+                assert npl.att_table_live() == 0, plane
+                # the /status serving block carries the route counters
+                blk = svc.describe_serving()
+                assert blk["kv_load"]["scattered"] >= 1
+            finally:
+                ch.close()
+                svc.close()
+                server.stop()
+
+    def test_saturated_adopted_load_sheds_clean(self):
+        """PoolSaturated during an ADOPTED load (reservation refused
+        before any fill): the RPC sheds with a retry hint and no
+        custody leaks — the eviction-mid-load RPC leg."""
+        from brpc_tpu.rpc import errors
+        from brpc_tpu.serving import KvPoolOptions
+        from examples.disagg_serving.workers import DecodeService
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+        m = _model()
+        server = rpc.Server()
+        svc = DecodeService(pool_options=KvPoolOptions(
+            bytes_per_token=m.KV_LAYERS * m.KV_DMODEL,
+            num_blocks=2, block_tokens=8, use_timers=False))
+        server.add_service(svc)
+        assert server.start("mem://kv-shed") == 0
+        ch = rpc.Channel()
+        ch.init("mem://kv-shed",
+                options=rpc.ChannelOptions(timeout_ms=30000,
+                                           max_retry=0))
+        try:
+            def load(session, tokens, priority):
+                kv = m.toy_kv_blocks(tokens)
+                cntl = rpc.Controller()
+                cntl.priority = priority
+                cntl.request_attachment.append_device_array(kv)
+                ch.call_method("Decode.LoadKv", cntl, EchoRequest(
+                    message=json.dumps({"session": session,
+                                        "seq_len": len(tokens),
+                                        "last_token": tokens[-1]})),
+                    EchoResponse)
+                return cntl
+
+            assert not load("inter", [1] * 16, 0).failed()
+            cntl = load("batch", [2] * 8, 3)
+            assert cntl.failed() and cntl.error_code_ == errors.ELIMIT
+            assert cntl.retry_after_ms > 0
+            assert svc.live_sessions() == 1
+        finally:
+            ch.close()
+            svc.close()
+            server.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -864,6 +1208,128 @@ class TestAutoscaler:
         d = a.describe()
         assert d["scale_ups"] == 1 and d["size"] == 2
         assert "load" in d["last"]
+
+
+# ---------------------------------------------------------------------------
+# 2-process shm claim-to-pool (ISSUE 15): the KV payload crosses the
+# fabric's shared-memory ring and the zero-copy CLAIM is consumed
+# DIRECTLY into the decode worker's pool blocks — route asserted on
+# both layers (rpc_fabric_route shm bytes AND serving_kv_load_adopted),
+# decode byte-exact against the single-process reference.
+# ---------------------------------------------------------------------------
+
+_KV_SHM_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); coord = sys.argv[2]
+from brpc_tpu.ici.fabric import FabricNode, FabricSocket
+node = FabricNode.initialize(coord, num_processes=2, process_id=pid)
+kv = node._kv
+import brpc_tpu.policy
+from brpc_tpu import rpc, ici
+from brpc_tpu.rpc.socket import list_sockets
+from brpc_tpu.ici.route import route_stats
+from examples.disagg_serving import model as m
+from examples.example_echo_pb2 import EchoRequest, EchoResponse
+mesh = ici.IciMesh(); ici.IciMesh.set_default(mesh)
+
+SEQ, STEPS, N = 512, 7, 4
+PAYLOAD = m.kv_nbytes(SEQ)
+
+def fabric_socks():
+    return [s for s in list_sockets() if isinstance(s, FabricSocket)]
+
+if pid == 0:
+    from brpc_tpu.serving import KvPoolOptions, kv_load_stats
+    from examples.disagg_serving.workers import DecodeService
+    server = rpc.Server()
+    svc = DecodeService(pool_options=KvPoolOptions(
+        bytes_per_token=m.KV_LAYERS * m.KV_DMODEL, num_blocks=256,
+        block_tokens=16, use_timers=False))
+    server.add_service(svc)
+    assert server.start("ici://0") == 0
+    kv.key_value_set("kvshm_srv_up", "1")
+    kv.wait_at_barrier("kvshm_done", 180000)
+    # route truth, decode-worker side: the claims came off the shm
+    # ring AND landed in the pool via the adopted route (no per-session
+    # host materialization)
+    socks = fabric_socks()
+    assert socks and socks[0].shm_bound(), "server socket has no shm ring"
+    assert socks[0].shm_bytes_claimed >= N * PAYLOAD, \
+        socks[0].shm_bytes_claimed
+    st = kv_load_stats()
+    # host-bulk sessions rode the ring and were consumed in place
+    # (adopted); the device-payload session re-emerged as a DEVICE
+    # array on this side and scattered.  NOTHING materialized.
+    assert st["adopted"] >= N, st
+    assert st["scattered"] >= 1, st
+    assert st["materialized"] == 0, st
+    # exactly one copy pass per session, either route
+    assert st["copy_bytes"] == \
+        (st["adopted"] + st["scattered"]) * PAYLOAD, st
+    blk = svc.describe_serving()
+    assert blk["kv_load"]["adopted"] >= N
+    svc.close(); server.stop()
+    print("KVSHM0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("kvshm_srv_up", 60000)
+    local_dev = next(i for i, d in enumerate(jax.devices())
+                     if d.process_index == pid)
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=120000,
+                                                  max_retry=0))
+    # i < N: the KV crosses as HOST bulk bytes — the ring carries them
+    # and the receiver's zero-copy claim is consumed straight into the
+    # pool (adopted).  i == N: the device-payload shape — the fabric
+    # re-emerges it as a DEVICE array on the server, which scatters.
+    for i in range(N + 1):
+        tokens = [(11 * i + j) %% 997 for j in range(SEQ)]
+        payload = m.toy_kv_blocks(tokens, device=jax.devices()[local_dev])
+        jax.block_until_ready(payload)
+        cntl = rpc.Controller()
+        if i < N:
+            cntl.request_attachment.append(np.asarray(payload).tobytes())
+        else:
+            cntl.request_attachment.append_device_array(payload)
+        ch.call_method("Decode.LoadKv", cntl, EchoRequest(
+            message=json.dumps({"session": "s%%d" %% i, "seq_len": SEQ,
+                                "last_token": tokens[-1]})), EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        dc = rpc.Controller()
+        resp = ch.call_method("Decode.Decode", dc, EchoRequest(
+            message=json.dumps({"session": "s%%d" %% i,
+                                "steps": STEPS, "mode": "sync"})),
+            EchoResponse)
+        assert not dc.failed(), dc.error_text
+        got = json.loads(resp.message)["tokens"]
+        assert got == m.reference_generate(tokens, STEPS), \
+            "claim-to-pool decode mismatch at session %%d" %% i
+    s = fabric_socks()[0]
+    assert s.shm_bound(), "client socket has no shm ring"
+    assert s.shm_bytes_sent >= N * PAYLOAD, s.shm_bytes_sent
+    rs = route_stats()
+    assert rs.get("shm", {}).get("bytes", 0) >= N * PAYLOAD, rs
+    kv.wait_at_barrier("kvshm_done", 180000)
+    ch.close()
+    print("KVSHM1_OK", flush=True)
+"""
+
+
+def test_kv_shm_claim_lands_in_pool_2proc():
+    """The adopted route end to end across TWO processes: prefill-side
+    KV bytes ride the fabric's shm ring, the receiver's zero-copy ring
+    claim scatters straight into PagedKvPool blocks (adopted counter +
+    shm route counters assert both layers), and sync decode reproduces
+    the single-process reference bit-exact."""
+    from test_fabric import _run_pair
+    outs = _run_pair(_KV_SHM_CHILD % {"repo": REPO}, timeout=300)
+    assert "KVSHM0_OK" in outs[0]
+    assert "KVSHM1_OK" in outs[1]
 
 
 # ---------------------------------------------------------------------------
