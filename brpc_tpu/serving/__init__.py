@@ -5,9 +5,14 @@ workers (``examples/disagg_serving`` is built ON this package):
 
   * :mod:`.kv_pool` — ``PagedKvPool``: fixed-size device blocks, a free
     list, per-session block tables, admission-aware eviction (the PR-9
-    tenant/priority policy decides who absorbs memory pressure), and a
+    tenant/priority policy decides who absorbs memory pressure), a
     TimerThread-driven expiry sweep (idle workers reclaim parked KV
-    with zero traffic);
+    with zero traffic), and — since ISSUE 16 — copy-on-write PREFIX
+    SHARING (sessions with a block-aligned common prefix map the same
+    refcounted physical blocks; ``write_rows`` CoW-splits on mutation)
+    plus OUTSIDE-THE-LOCK fills (``load_into`` reserves under the pool
+    lock, scatters unlocked, commits with a re-check — concurrent
+    LoadKv fills no longer serialize);
   * :mod:`.scheduler` — ``ContinuousBatchScheduler``: one batched
     decode step per tick over the active session set, sessions
     admitted/retired/preempted BETWEEN steps;

@@ -1,5 +1,6 @@
 """Paged KV-block pool: fixed-size device blocks, free-list custody,
-per-session block tables, admission-aware eviction, timer-driven expiry.
+per-session block tables, admission-aware eviction, timer-driven expiry,
+copy-on-write prefix sharing.
 
 The serving subsystem's memory manager (ROADMAP item 3; the shape every
 production LLM server converged on — vLLM's PagedAttention block tables
@@ -12,6 +13,19 @@ over a fixed block pool).  One pool per decode worker:
     decode step gathers from — one fancy-index gather per step through
     the block tables, never a per-session copy).  A session holds an
     ordered block list; fragmentation is impossible by construction.
+  * **Copy-on-write prefix sharing** (ISSUE 16): at commit time FULL
+    blocks are content-hashed (a chained CRC over the block run, so the
+    key encodes position-in-prefix) against a pool-wide prefix index —
+    when N sessions' token rows share a block-aligned prefix they map
+    the SAME physical blocks under a per-block REFCOUNT (the block-level
+    analog of the counted session pin: a shared block outlives any one
+    owner and frees only when the last refcount drops).  Every index
+    hit is BYTE-VERIFIED before sharing, so a hash collision degrades
+    to no-sharing, never to cross-session bytes.  Divergence past the
+    common prefix keeps private tail blocks, and an in-place
+    ``write_rows`` on a shared block performs a CoW SPLIT to a private
+    copy first.  ``serving_kv_prefix_share=False`` restores the PR-15
+    private-blocks world byte-for-byte for same-run A/B.
   * **Admission-aware eviction** (the PR-9 integration): under memory
     pressure the pool evicts parked sessions in PRIORITY-BAND order —
     sheddable/batch bands (higher band number) before interactive ones,
@@ -21,7 +35,9 @@ over a fixed block pool).  One pool per decode worker:
     weights come from the same ``AdmissionOptions.tenant_weight``
     table the WFQ admission queue uses (``KvPoolOptions.from_admission``),
     so "who absorbs the pressure" is ONE policy across queueing and
-    memory.
+    memory.  Victim selection simulates the refcount decrements, so a
+    victim whose blocks other sessions still share contributes only the
+    blocks that would actually free.
   * **Timer-driven expiry**, not traffic-driven (the ISSUE-14 bugfix):
     the old example swept stale sessions only inside ``LoadKv``, so an
     idle decode worker parked expired KV forever.  Here the sweep is a
@@ -34,22 +50,31 @@ over a fixed block pool).  One pool per decode worker:
     block tables are live in the current batched program).
 
 Custody: a session's bytes enter the pool exactly once and leave by
-exactly one of release / evict / expire / close.  Two entry surfaces:
+exactly one of release / evict / expire / close — where "leave" for a
+SHARED block means its refcount decrement, the physical free happening
+only at zero.  Two entry surfaces:
 
   * ``load`` — the caller already holds the whole session as one
     contiguous token-major array (the PR-14 materialized path, kept for
-    A/B and for sources that cannot scatter);
+    A/B and for sources that cannot scatter).  Since ISSUE 16 it is a
+    thin delegation to ``load_into`` with a row-copy fill, so both
+    surfaces ride ONE reserve/fill/commit shape (locking parity is
+    structural, not duplicated);
   * ``load_into`` (ISSUE 15) — the block table is RESERVED first, then
     the caller's ``fill`` writes token rows DIRECTLY into the arena
     blocks, so a loader never materializes the session as one
     intermediate array.  The serving loader feeds this from the wire:
     shm ring claims and parked native att segments scatter straight
     into the reserved blocks (``serving/kv_source.py``), one copy pass
-    total.
+    total.  Since ISSUE 16 the fill runs OUTSIDE the pool lock by
+    default (``serving_kv_concurrent_fill``): reserve under the lock,
+    scatter unlocked, COMMIT WITH A RE-CHECK — so concurrent LoadKv
+    fills no longer serialize on one decode host.
 """
 from __future__ import annotations
 
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -57,6 +82,24 @@ import numpy as np
 
 from .. import bvar
 from ..butil import debug_sync as _dbg
+from ..butil import flags as _flags
+
+_flags.define_flag(
+    "serving_kv_prefix_share", True,
+    "content-hash FULL KV blocks at load commit so sessions sharing a "
+    "block-aligned prefix map the same physical blocks under a "
+    "refcount (byte-verified on every hit; divergence or write_rows "
+    "triggers a CoW split to a private copy).  False restores the "
+    "PR-15 private-blocks-per-session behavior byte-for-byte for "
+    "same-run A/B")
+
+_flags.define_flag(
+    "serving_kv_concurrent_fill", True,
+    "run load_into's fill OUTSIDE the pool lock: reserve under the "
+    "lock, scatter unlocked, commit with a re-check — concurrent "
+    "LoadKv fills proceed in parallel instead of serializing.  False "
+    "restores the PR-15 hold-through-the-fill discipline byte-for-byte "
+    "for same-run A/B")
 
 
 class SessionBusy(RuntimeError):
@@ -64,7 +107,9 @@ class SessionBusy(RuntimeError):
     re-prefill while the first decode still runs.  Freeing a rostered
     session's blocks would hand them to the new bytes mid-program (the
     running gather would read the replacement's KV), so the reload is
-    refused — the RPC layer maps this to a retryable shed."""
+    refused — the RPC layer maps this to a retryable shed.  The same
+    refusal fires at COMMIT time when a concurrent loader won the race
+    for the session id and its entry got pinned before our re-check."""
 
     def __init__(self, session: str):
         super().__init__(
@@ -120,7 +165,9 @@ class KvPoolOptions:
 class _KvSession:
     """One session's block table (access under the pool lock; the
     numeric fields are immutable after load, so the scheduler may READ
-    blocks/seq_len/acc/last_token from its roster snapshot lock-free).
+    blocks/seq_len/acc/last_token from its roster snapshot lock-free —
+    ``write_rows`` preserves this by publishing a NEW blocks array on a
+    CoW split, never mutating the one a roster snapshot may hold).
 
     ``pinned`` is a COUNT (ISSUE 15), not a flag: the step roster holds
     one pin per roster entry and a zero-copy ``snapshot(view=True)``
@@ -128,7 +175,11 @@ class _KvSession:
     releasing one must not unfence the other.  ``release_pending``
     marks a ``release`` that arrived while pinned: the free is DEFERRED
     to the last unpin instead of yanking blocks out from under a
-    reader (or being silently dropped)."""
+    reader (or being silently dropped).  ISSUE 16 extends the same
+    counted-holder idea one level down: a PHYSICAL block shared across
+    sessions carries a pool-side refcount (``PagedKvPool._refs``) that
+    outlives any one owner — a session's free decrements, the block
+    only rejoins the free list at zero."""
 
     __slots__ = ("session", "tenant", "priority", "seq_len", "last_token",
                  "acc", "blocks", "last_used", "pinned",
@@ -150,6 +201,8 @@ class _KvSession:
         # blocks are immutable after commit, so the one-ascending-
         # extent test is computed ONCE here — snapshot(view=True)'s
         # per-read eligibility is a field read, not an array compare
+        # (prefix-share dedupe and CoW splits recompute it when they
+        # publish a substituted array)
         self.contiguous = bool((np.diff(blocks) == 1).all())
 
 
@@ -163,6 +216,9 @@ class PagedKvPool:
     _GUARDED_BY = {
         "_free": "_lock",
         "_tables": "_lock",
+        "_refs": "_lock",
+        "_prefix_index": "_lock",
+        "_block_hash": "_lock",
         "_recent_evicted": "_lock",
         "_sweep_timer": "_lock",
         "_closed": "_lock",
@@ -177,6 +233,10 @@ class PagedKvPool:
         self._now = now or time.monotonic
         self._lock = _dbg.make_lock("PagedKvPool._lock")
         self._counters_lock = _dbg.make_lock("PagedKvPool._counters_lock")
+        # the arenas are DELIBERATELY unguarded: a reserved block is off
+        # the free list and in no table, so its rows have exactly one
+        # writer (the in-flight fill) and no reader — the disjoint-row
+        # discipline that makes the outside-the-lock fill safe
         self._store = np.zeros(
             (o.num_blocks, o.block_tokens * o.bytes_per_token), np.uint8)
         self._pos_sums = np.zeros((o.num_blocks, o.block_tokens), np.int64)
@@ -193,6 +253,16 @@ class PagedKvPool:
         self.pos_sums_flat = self._pos_sums.reshape(-1)
         self._free: List[int] = list(range(o.num_blocks - 1, -1, -1))
         self._tables: Dict[str, _KvSession] = {}
+        # per-PHYSICAL-block refcount for every block owned by >= 1
+        # session table (1 = private, >= 2 = prefix-shared); reserved
+        # blocks mid-fill are in neither _free nor _refs, so
+        # len(_free) + len(_refs) + in-flight == num_blocks always
+        self._refs: Dict[int, int] = {}
+        # chained-CRC prefix hash -> physical block, plus the reverse
+        # map for unregistration at free time.  The index is a LOOKUP
+        # ACCELERATOR only: every hit is byte-verified before sharing
+        self._prefix_index: Dict[int, int] = {}
+        self._block_hash: Dict[int, int] = {}
         # recently-evicted ids → reason, so a late Decode gets a typed
         # "re-prefill" shed instead of an unknown-session error
         self._recent_evicted: Dict[str, str] = {}
@@ -204,6 +274,14 @@ class PagedKvPool:
         self.expirations = bvar.Adder("serving_kv_pool_expired")
         # load_into fills that raised: the reservation aborted clean
         self.fill_aborts = bvar.Adder("serving_kv_pool_fill_aborts")
+        # ISSUE 16 truth: blocks shared at commit, CoW splits, commit
+        # re-checks that found a raced incumbent, and the fill-route
+        # counters the concurrency tests assert per call
+        self.prefix_hits = bvar.Adder("serving_kv_pool_prefix_hits")
+        self.cow_splits = bvar.Adder("serving_kv_pool_cow_splits")
+        self.commit_races = bvar.Adder("serving_kv_pool_commit_races")
+        self.locked_fills = bvar.Adder("serving_kv_pool_locked_fills")
+        self.unlocked_fills = bvar.Adder("serving_kv_pool_unlocked_fills")
         self._counters: Dict[tuple, bvar.Adder] = {}
         self._tenant_labels: set = set()
 
@@ -247,7 +325,13 @@ class PagedKvPool:
         shape ``(seq_len, bytes_per_token)`` — the caller transposes the
         model's layer-major layout once here, so every block row is one
         token's bytes and paging never splits a token.  Raises
-        :class:`PoolSaturated` when eviction cannot make room."""
+        :class:`PoolSaturated` when eviction cannot make room.
+
+        Since ISSUE 16 this is a delegation to :meth:`load_into` with a
+        row-copy fill: both entry surfaces ride the SAME
+        reserve/fill/commit shape (and the same flags), so locking
+        discipline, abort semantics, prefix sharing, and the concurrent
+        fill can never drift between them."""
         o = self.options
         rows = np.ascontiguousarray(token_rows, dtype=np.uint8)
         if rows.ndim != 2 or rows.shape[1] != o.bytes_per_token:
@@ -259,33 +343,17 @@ class PagedKvPool:
             # a 0-token session would build an empty block table the
             # batched step cannot index — reject at the boundary
             raise ValueError("token_rows must hold at least one token")
-        pri = self._clip_priority(priority)
-        need = self.blocks_for(seq_len)
-        row_sums = rows.sum(axis=1, dtype=self._sum_dtype)
-        now = self._now()
-        bt = o.block_tokens
-        with self._lock:
-            blocks, deferred_old = self._reserve_locked(session, need,
-                                                        pri)
-            for k in range(need):
-                blk = int(blocks[k])
-                chunk = rows[k * bt:(k + 1) * bt]
-                n = chunk.shape[0]
-                flat = chunk.reshape(-1)
-                self._store[blk, :flat.size] = flat
-                self._pos_sums[blk, :n] = row_sums[k * bt:k * bt + n]
-                if n < bt:
-                    # zero the tail so no prior tenant's bytes survive
-                    # in a partially-filled block
-                    self._store[blk, flat.size:] = 0
-                    self._pos_sums[blk, n:] = 0
-            s = _KvSession(session, tenant, pri, seq_len, last_token,
-                           int(row_sums.sum(dtype=np.int64)), blocks,
-                           now)
-            self._commit_locked(s, deferred_old)
-        self.loads << 1
-        self.bytes_in << int(rows.size)
-        return s
+
+        def fill(views: List[np.ndarray]) -> None:
+            off = 0
+            for v in views:
+                n = v.shape[0]
+                v[:] = rows[off:off + n]
+                off += n
+
+        return self.load_into(session, seq_len, fill,
+                              last_token=last_token, tenant=tenant,
+                              priority=priority)
 
     def load_into(self, session: str, seq_len: int,
                   fill: Callable[[List[np.ndarray]], None], *,
@@ -301,91 +369,146 @@ class PagedKvPool:
         extent, so the common fill is ONE strided pass; a fragmented
         pool hands out more, smaller views).  It must write every row
         (a partial write would publish a table over stale arena bytes).
-        It runs UNDER the pool lock — reserved blocks are off the free
-        list and in no table, so eviction cannot touch them; the hold
-        is what keeps a same-session reload's replace-then-fill atomic
-        and fences ``close()``'s free-list rebuild, and it matches
-        ``load``'s existing hold-through-the-copy discipline (an
-        outside-the-lock fill with a commit-time re-check is a known
-        follow-on) — so ``fill`` must not call back into this pool.
+
+        With ``serving_kv_concurrent_fill`` ON (the default) the fill
+        runs OUTSIDE the pool lock — the ISSUE-16 concurrency lever:
+        reserved blocks are off the free list and in no table, so no
+        eviction, expiry, or concurrent loader can touch their arena
+        rows, and two LoadKv fills scatter in parallel.  The commit
+        then RE-CHECKS under the lock: a pool closed mid-fill raises
+        (``close()`` already reclaimed every block); a concurrent
+        loader that committed the same session id mid-fill is replaced
+        last-commit-wins when unpinned, or aborts THIS fill with
+        :class:`SessionBusy` when the incumbent got pinned (counted in
+        ``commit_races`` either way).  OFF restores the PR-15
+        hold-through-the-fill discipline byte-for-byte — in that shape
+        ``fill`` must not call back into this pool.
+
         If ``fill`` raises, the reservation ABORTS clean: blocks
         return to the free list, no session entry is created — a
         same-session RELOAD keeps its previous KV valid whenever the
         free list alone covered the reservation (see
         ``_reserve_locked``) — and the exception propagates (the RPC
-        layer's eviction-mid-load / bad-source path).  After a successful fill the pool derives the
-        reduction arena (``pos_sums``/``acc``) from the written bytes,
-        zeroes the partial tail so no prior tenant's bytes survive
-        adoption, and commits the table — byte-for-byte the state
-        ``load`` builds from a pre-materialized array."""
+        layer's eviction-mid-load / bad-source path).  After a
+        successful fill the pool derives the reduction arena
+        (``pos_sums``/``acc``) from the written bytes, zeroes the
+        partial tail so no prior tenant's bytes survive adoption,
+        dedupes full blocks against the prefix index
+        (``serving_kv_prefix_share``), and commits the table —
+        byte-for-byte the state ``load`` builds from a pre-materialized
+        array."""
         o = self.options
         if seq_len <= 0:
             raise ValueError("seq_len must be >= 1")
         pri = self._clip_priority(priority)
         need = self.blocks_for(seq_len)
         now = self._now()
-        bt = o.block_tokens
         bpt = o.bytes_per_token
-        with self._lock:
-            blocks, deferred_old = self._reserve_locked(session, need,
-                                                        pri)
-            # coalesce the reservation into contiguous extents: per-
-            # extent numpy ops amortize over whole runs of blocks
-            # instead of paying call overhead per 16-token block
-            extents = []              # (first_block, n_blocks, n_rows)
-            left = seq_len
-            b0 = int(blocks[0])
-            k = 1
-            for i in range(1, need):
-                b = int(blocks[i])
-                if b == b0 + k:
-                    k += 1
-                    continue
-                rows = min(left, k * bt)
-                extents.append((b0, k, rows))
-                left -= rows
-                b0, k = b, 1
-            extents.append((b0, k, min(left, k * bt)))
-            views = [self._store[e0:e0 + ek].reshape(-1, bpt)[:rows]
-                     for e0, ek, rows in extents]
+        if _flags.get_flag("serving_kv_concurrent_fill"):
+            with self._lock:
+                blocks, deferred_old = self._reserve_locked(session, need,
+                                                            pri)
+            # the fill below touches only the unguarded arenas through
+            # rows nothing else references (reserved blocks are
+            # invisible to every other pool operation)
+            extents, views = self._extent_views(blocks, seq_len)
             try:
                 fill(views)
+                acc = self._derive_sums(extents, views, seq_len)
             except BaseException:
                 # abort clean: the reservation never became a session
-                self._return_blocks_locked(blocks)
+                with self._lock:
+                    self._abort_fill_locked(blocks)
                 self.fill_aborts << 1
                 raise
-            acc = 0
-            for (e0, ek, rows), v in zip(extents, views):
-                sums = v.sum(axis=1, dtype=self._sum_dtype)
-                ps = self._pos_sums[e0:e0 + ek].reshape(-1)
-                ps[:rows] = sums
-                acc += int(sums.sum(dtype=np.int64))
-                if rows < ek * bt:
-                    # zero the tail so no prior tenant's bytes survive
-                    # in the partially-filled final block
-                    ps[rows:] = 0
-                    self._store[e0:e0 + ek].reshape(-1)[rows * bpt:] = 0
             s = _KvSession(session, tenant, pri, seq_len, last_token,
                            acc, blocks, now)
-            self._commit_locked(s, deferred_old)
+            with self._lock:
+                self._commit_locked(s, deferred_old)
+            self.unlocked_fills << 1
+        else:
+            with self._lock:
+                blocks, deferred_old = self._reserve_locked(session, need,
+                                                            pri)
+                extents, views = self._extent_views(blocks, seq_len)
+                try:
+                    fill(views)
+                except BaseException:
+                    # abort clean: the reservation never became a
+                    # session (close() cannot race — we hold the lock)
+                    self._return_blocks_locked(blocks)
+                    self.fill_aborts << 1
+                    raise
+                acc = self._derive_sums(extents, views, seq_len)
+                s = _KvSession(session, tenant, pri, seq_len, last_token,
+                               acc, blocks, now)
+                self._commit_locked(s, deferred_old)
+            self.locked_fills << 1
         self.loads << 1
         self.bytes_in << seq_len * bpt
         return s
+
+    def _extent_views(self, blocks: np.ndarray, seq_len: int):
+        """Coalesce a reservation into contiguous extents and build the
+        writable fill views: per-extent numpy ops amortize over whole
+        runs of blocks instead of paying call overhead per 16-token
+        block.  Touches only the unguarded arena (reserved rows have
+        exactly one writer), so it runs with or without the pool lock."""
+        o = self.options
+        bt, bpt = o.block_tokens, o.bytes_per_token
+        need = len(blocks)
+        extents = []              # (first_block, n_blocks, n_rows)
+        left = seq_len
+        b0 = int(blocks[0])
+        k = 1
+        for i in range(1, need):
+            b = int(blocks[i])
+            if b == b0 + k:
+                k += 1
+                continue
+            rows = min(left, k * bt)
+            extents.append((b0, k, rows))
+            left -= rows
+            b0, k = b, 1
+        extents.append((b0, k, min(left, k * bt)))
+        views = [self._store[e0:e0 + ek].reshape(-1, bpt)[:rows]
+                 for e0, ek, rows in extents]
+        return extents, views
+
+    def _derive_sums(self, extents, views, seq_len: int) -> int:
+        """Derive the reduction arena from the filled bytes and zero the
+        partial tail so no prior tenant's bytes survive adoption.
+        Returns the session accumulator.  Unguarded-arena-only, same
+        rationale as :meth:`_extent_views`."""
+        o = self.options
+        bt, bpt = o.block_tokens, o.bytes_per_token
+        acc = 0
+        for (e0, ek, rows), v in zip(extents, views):
+            sums = v.sum(axis=1, dtype=self._sum_dtype)
+            ps = self._pos_sums[e0:e0 + ek].reshape(-1)
+            ps[:rows] = sums
+            acc += int(sums.sum(dtype=np.int64))
+            if rows < ek * bt:
+                # zero the tail so no prior tenant's bytes survive
+                # in the partially-filled final block
+                ps[rows:] = 0
+                self._store[e0:e0 + ek].reshape(-1)[rows * bpt:] = 0
+        return acc
 
     # fablint: lock-held(_lock)
     def _reserve_locked(self, session: str, need: int, pri: int):
         """Allocate ``need`` blocks for ``session`` (evicting under
         pressure per the band/weight/LRU policy): the shared first half
         of ``load`` and ``load_into``.  Returns ``(blocks,
-        deferred_old)`` — blocks are OFF the free list but not yet in
-        any table; the caller fills them and commits (or returns them
-        on a fill failure).  A same-session reload keeps the OLD entry
-        alive as ``deferred_old`` whenever the free list alone covers
-        the reservation, so an aborted fill leaves the previous KV
-        valid (``_commit_locked`` frees it); only a reservation that
-        NEEDS the old blocks for capacity reclaims them up front — the
-        one case an abort genuinely cannot restore."""
+        deferred_old)`` — blocks are OFF the free list and in no table
+        (invisible to eviction, expiry, and every concurrent loader);
+        the caller fills them and commits (or returns them on a fill
+        failure).  A same-session reload keeps the OLD entry alive as
+        ``deferred_old`` whenever the free list alone covers the
+        reservation, so an aborted fill leaves the previous KV valid
+        (``_commit_locked`` frees it); only a reservation that NEEDS
+        the old blocks for capacity reclaims them up front — the one
+        case an abort genuinely cannot restore."""
         o = self.options
         if need > o.num_blocks:
             raise PoolSaturated(need, o.num_blocks)
@@ -417,32 +540,133 @@ class PagedKvPool:
         return blocks, deferred_old
 
     # fablint: lock-held(_lock)
+    def _abort_fill_locked(self, blocks) -> None:
+        """Return an aborted outside-the-lock reservation — UNLESS the
+        pool closed mid-fill, whose free-list rebuild already reclaimed
+        every block (returning ours again would double-count them)."""
+        if not self._closed:
+            self._return_blocks_locked(blocks)
+
+    # fablint: lock-held(_lock)
     def _commit_locked(self, s: _KvSession, deferred_old) -> None:
-        if deferred_old is not None:
-            # the reload's fill succeeded: NOW retire the replaced
-            # table (still under the same lock hold, so no reader ever
-            # saw a gap)
-            self._free_session_locked(deferred_old, "reloaded")
+        """Publish a filled reservation: the COMMIT-TIME RE-CHECK of
+        the outside-the-lock fill (a no-op re-check when the caller
+        held the lock through the fill).  Order matters: the raced/
+        pinned check FIRST (an abort must return the ORIGINAL blocks,
+        never deduped substitutes another session owns), then prefix
+        dedupe + refcounts, and only then the incumbent's free — so a
+        same-content reload SHARES its predecessor's blocks for the
+        one lock hold both are alive, and the decrement leaves them
+        owned by the new entry alone."""
+        if self._closed:
+            # close() raced the fill: its free-list rebuild already
+            # reclaimed every block — publishing (or returning) now
+            # would resurrect custody close() ended
+            raise RuntimeError("kv pool is closed")
+        cur = self._tables.get(s.session)
+        if cur is not None and cur is not deferred_old:
+            # a concurrent loader committed this session id mid-fill
+            self.commit_races << 1
+            if cur.pinned:
+                # the incumbent is in a roster/view — OUR fill aborts
+                self._return_blocks_locked(s.blocks)
+                raise SessionBusy(s.session)
+            # last-commit-wins: retire the raced incumbent (after
+            # dedupe below would be too late — but sharing against it
+            # is still possible because the free only happens further
+            # down, after refcounts pin the shared blocks)
+        if _flags.get_flag("serving_kv_prefix_share"):
+            self._dedupe_blocks_locked(s)
+        for b in s.blocks:
+            b = int(b)
+            self._refs[b] = self._refs.get(b, 0) + 1
+        if cur is not None:
+            # deferred_old or the raced unpinned incumbent: either way
+            # the fill succeeded, NOW retire the replaced table (still
+            # under the same lock hold, so no reader ever saw a gap)
+            self._free_session_locked(cur, "reloaded")
         self._tables[s.session] = s
         self._recent_evicted.pop(s.session, None)
         self._schedule_sweep_locked()
+
+    # fablint: lock-held(_lock)
+    def _dedupe_blocks_locked(self, s: _KvSession) -> None:
+        """Map ``s``'s FULL blocks onto existing physical blocks where
+        a byte-identical block-aligned prefix already lives in the pool
+        (ISSUE 16).  The key is a CHAINED crc32 over the block run, so
+        equal keys mean equal position-in-prefix candidates; every hit
+        is BYTE-VERIFIED before substitution, so a collision degrades
+        to a miss, never to sharing wrong bytes.  Sharing stops at the
+        first miss (prefixes only — a mid-sequence match cannot share
+        because the chain key diverged), but hashing continues so this
+        session's full blocks register as donors for longer prefixes.
+        Partial tail blocks never share and never register."""
+        o = self.options
+        blocks = s.blocks
+        full = s.seq_len // o.block_tokens
+        h = 0
+        sharing = True
+        new_blocks = None
+        returned = []
+        for k in range(full):
+            blk = int(blocks[k])
+            data = self._store[blk]
+            h = zlib.crc32(data, h)
+            if sharing:
+                eb = self._prefix_index.get(h)
+                if (eb is not None and eb != blk and eb in self._refs
+                        and np.array_equal(self._store[eb], data)):
+                    # verified content match: map this position onto
+                    # the existing physical block, hand ours back
+                    if new_blocks is None:
+                        new_blocks = blocks.copy()
+                    new_blocks[k] = eb
+                    returned.append(blk)
+                    self.prefix_hits << 1
+                    continue
+                sharing = False
+            if h not in self._prefix_index:
+                self._prefix_index[h] = blk
+                self._block_hash[blk] = h
+        if new_blocks is not None:
+            s.blocks = new_blocks
+            s.contiguous = bool((np.diff(new_blocks) == 1).all())
+            self._return_blocks_locked(returned)
+
+    # fablint: lock-held(_lock)
+    def _unregister_block_locked(self, blk: int) -> None:
+        """Drop a freed (or about-to-be-overwritten) block from the
+        prefix index so no future load shares stale content."""
+        h = self._block_hash.pop(blk, None)
+        if h is not None and self._prefix_index.get(h) == blk:
+            del self._prefix_index[h]
 
     # fablint: lock-held(_lock)
     def _pick_victims_locked(self, blocks_needed: int,
                              requester_pri: int):
         """Eviction order under pressure: most-sheddable band first,
         lighter tenants before heavier inside a band, LRU inside a
-        class; never a band more protected than the requester's."""
+        class; never a band more protected than the requester's.  A
+        victim only contributes the blocks that would ACTUALLY free —
+        the refcount decrements are simulated cumulatively across the
+        victim list, so two sessions sharing a prefix free its blocks
+        only when BOTH are on the list."""
         cands = [s for s in self._tables.values()
                  if not s.pinned and s.priority >= requester_pri]
         cands.sort(key=lambda s: (-s.priority, self._weight(s.tenant),
                                   s.last_used))
         victims, have = [], 0
+        sim: Dict[int, int] = {}
         for s in cands:
             if have >= blocks_needed:
                 break
             victims.append(s)
-            have += len(s.blocks)
+            for b in s.blocks:
+                b = int(b)
+                taken = sim.get(b, 0)
+                sim[b] = taken + 1
+                if self._refs.get(b, 1) - taken == 1:
+                    have += 1
         return victims if have >= blocks_needed else None
 
     # fablint: lock-held(_lock)
@@ -457,8 +681,23 @@ class PagedKvPool:
 
     # fablint: lock-held(_lock)
     def _free_session_locked(self, s: _KvSession, reason: str) -> None:
+        """Retire a session's table: DECREMENT each block's refcount,
+        physically freeing (and unregistering from the prefix index)
+        only the blocks that hit zero — a prefix another session still
+        shares survives its co-owner's eviction/release/expiry."""
         self._tables.pop(s.session, None)
-        self._return_blocks_locked(s.blocks)
+        dead = []
+        for b in s.blocks:
+            b = int(b)
+            r = self._refs.get(b, 1) - 1
+            if r <= 0:
+                self._refs.pop(b, None)
+                self._unregister_block_locked(b)
+                dead.append(b)
+            else:
+                self._refs[b] = r
+        if dead:
+            self._return_blocks_locked(dead)
         if reason in ("pressure", "expired"):
             self._recent_evicted[s.session] = reason
             while len(self._recent_evicted) > 256:
@@ -490,6 +729,91 @@ class PagedKvPool:
                 return True
             self._free_session_locked(s, "released")
             return True
+
+    # ---- mutation / CoW -------------------------------------------------
+    def write_rows(self, session: str, start_token: int,
+                   rows: np.ndarray) -> int:
+        """Overwrite token rows of a LIVE session in place — the CoW
+        mutation surface (ISSUE 16).  A target block whose refcount is
+        > 1 is SPLIT first: a private copy is allocated (evicting under
+        the session's own priority if the free list is empty), the
+        shared original keeps its other owners untouched, and the
+        session publishes a NEW blocks array (roster snapshots holding
+        the old array keep reading the old — still valid — physical
+        blocks).  A private block that is REGISTERED as a prefix donor
+        is unregistered before the overwrite so no later load shares
+        its stale hash.  Returns the number of CoW splits performed.
+        Callers must not write under their own outstanding
+        ``snapshot(view=True)`` read — the same discipline the roster
+        pin documents."""
+        o = self.options
+        bt, bpt = o.block_tokens, o.bytes_per_token
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        if rows.ndim != 2 or rows.shape[1] != bpt:
+            raise ValueError(
+                f"rows must be (n, {bpt}), got {rows.shape}")
+        n = rows.shape[0]
+        if n <= 0:
+            raise ValueError("rows must hold at least one token")
+        now = self._now()
+        with self._lock:
+            s = self._tables.get(session)
+            if s is None or s.release_pending:
+                raise KeyError(session)
+            if start_token < 0 or start_token + n > s.seq_len:
+                raise ValueError(
+                    f"write [{start_token}, {start_token + n}) outside "
+                    f"session of {s.seq_len} tokens")
+            first_b = start_token // bt
+            last_b = (start_token + n - 1) // bt
+            new_blocks = None
+            splits = 0
+            for k in range(first_b, last_b + 1):
+                blk = int(s.blocks[k] if new_blocks is None
+                          else new_blocks[k])
+                if self._refs.get(blk, 1) > 1:
+                    # CoW split: other sessions own these bytes too
+                    if not self._free:
+                        victims = self._pick_victims_locked(
+                            1, s.priority)
+                        if victims is None:
+                            raise PoolSaturated(1, 0)
+                        for v in victims:
+                            self._free_session_locked(v, "pressure")
+                    nb = self._free.pop()
+                    self._store[nb] = self._store[blk]
+                    self._pos_sums[nb] = self._pos_sums[blk]
+                    self._refs[blk] -= 1
+                    self._refs[nb] = 1
+                    if new_blocks is None:
+                        new_blocks = s.blocks.copy()
+                    new_blocks[k] = nb
+                    splits += 1
+                    self.cow_splits << 1
+                else:
+                    # private — but a registered donor's content is
+                    # about to change: drop it from the index
+                    self._unregister_block_locked(blk)
+            if new_blocks is not None:
+                s.blocks = new_blocks
+                s.contiguous = bool((np.diff(new_blocks) == 1).all())
+            acc_delta = 0
+            for k in range(first_b, last_b + 1):
+                blk = int(s.blocks[k])
+                t0 = max(start_token, k * bt)
+                t1 = min(start_token + n, (k + 1) * bt)
+                src = rows[t0 - start_token:t1 - start_token]
+                sl0 = t0 - k * bt
+                self._store[blk].reshape(bt, bpt)[
+                    sl0:sl0 + (t1 - t0)] = src
+                new_sums = src.sum(axis=1, dtype=self._sum_dtype)
+                old = self._pos_sums[blk, sl0:sl0 + (t1 - t0)]
+                acc_delta += (int(new_sums.sum(dtype=np.int64))
+                              - int(old.sum(dtype=np.int64)))
+                self._pos_sums[blk, sl0:sl0 + (t1 - t0)] = new_sums
+            s.acc += acc_delta
+            s.last_used = now
+            return splits
 
     # ---- lookup / scheduler surface -----------------------------------
     def get(self, session: str) -> Optional[_KvSession]:
@@ -569,10 +893,12 @@ class PagedKvPool:
         when the session's blocks are one contiguous ascending extent,
         ``rows`` is a READ-ONLY view straight into the arena (no copy)
         and the session is PINNED — the caller MUST ``unpin(session)``
-        when done reading, BEFORE any release.  Non-contiguous sessions
-        (or pools under a straddle risk the caller can't fence) keep
-        the copy, ``is_view=False``, no pin owed — the copy is what
-        makes a concurrent eviction safe there, so it stays."""
+        when done reading, BEFORE any release.  The read-only flag is
+        what keeps a view over PREFIX-SHARED blocks safe: no reader can
+        scribble on bytes other sessions gather through.  Non-contiguous
+        sessions (or pools under a straddle risk the caller can't
+        fence) keep the copy, ``is_view=False``, no pin owed — the copy
+        is what makes a concurrent eviction safe there, so it stays."""
         o = self.options
         with self._lock:
             s = self._tables.get(session)
@@ -640,6 +966,9 @@ class PagedKvPool:
             timer = self._sweep_timer
             self._sweep_timer = None
             self._tables.clear()
+            self._refs.clear()
+            self._prefix_index.clear()
+            self._block_hash.clear()
             self._free = list(range(self.options.num_blocks - 1, -1, -1))
         if timer is not None:
             from ..bthread.timer_thread import TimerThread
@@ -653,9 +982,13 @@ class PagedKvPool:
             sessions = len(self._tables)
             pinned = sum(1 for s in self._tables.values() if s.pinned)
             per_tenant: Dict[str, int] = {}
+            logical = 0
             for s in self._tables.values():
                 key = s.tenant or "shared"
                 per_tenant[key] = per_tenant.get(key, 0) + len(s.blocks)
+                logical += len(s.blocks)
+            shared = sum(1 for r in self._refs.values() if r > 1)
+            physical = len(self._refs)
         with self._counters_lock:
             by_class = {f"{what}[{tenant or 'shared'}]": a.get_value()
                         for (what, tenant), a in self._counters.items()}
@@ -676,4 +1009,23 @@ class PagedKvPool:
             "fill_aborts": self.fill_aborts.get_value(),
             "by_tenant": by_class,
             "ttl_s": o.ttl_s,
+            # ISSUE 16: prefix-sharing / concurrent-fill truth —
+            # logical blocks are session-table entries, physical are
+            # distinct live blocks; the ratio is the capacity win
+            "prefix": {
+                "enabled": bool(_flags.get_flag(
+                    "serving_kv_prefix_share")),
+                "concurrent_fill": bool(_flags.get_flag(
+                    "serving_kv_concurrent_fill")),
+                "shared_blocks": shared,
+                "prefix_hits": self.prefix_hits.get_value(),
+                "cow_splits": self.cow_splits.get_value(),
+                "commit_races": self.commit_races.get_value(),
+                "locked_fills": self.locked_fills.get_value(),
+                "unlocked_fills": self.unlocked_fills.get_value(),
+                "logical_blocks": logical,
+                "physical_blocks": physical,
+                "sharing_ratio": (round(logical / physical, 3)
+                                  if physical else 1.0),
+            },
         }

@@ -141,7 +141,11 @@ class WireKvSource:
     segment list, splitting at segment boundaries wherever they fall
     (mid-block, mid-token, even mid-layer-row).  Instances are
     single-use: ``fill`` once, then the loader drops the object so
-    claim credit / array refs release deterministically."""
+    claim credit / array refs release deterministically.  A ``fill``
+    after :meth:`release` raises loudly — since ISSUE 16 the pool runs
+    fills OUTSIDE its lock, so a stale callback invoked late must fail
+    typed instead of scattering zero segments and publishing a table
+    over stale arena bytes."""
 
     __slots__ = ("route", "layers", "seq_len", "dmodel", "_segs",
                  "_starts")
@@ -163,7 +167,12 @@ class WireKvSource:
         return self._starts[-1]
 
     def fill(self, views: List[np.ndarray]) -> None:
-        """The ``PagedKvPool.load_into`` fill callback."""
+        """The ``PagedKvPool.load_into`` fill callback (may run outside
+        the pool lock; it only writes the reserved views)."""
+        if not self._segs:
+            raise RuntimeError(
+                "WireKvSource.fill after release(): sources are "
+                "single-use — build a fresh source per load")
         L, D = self.layers, self.dmodel
         if len(self._segs) == 1:
             wire = self._segs[0].reshape(L, self.seq_len, D)
@@ -268,10 +277,13 @@ def load_wire_attachment(pool, att: IOBuf, session: str, seq_len: int,
                          tenant: str = "",
                          priority: Optional[int] = None):
     """The whole zero-copy handoff in one call: build the source, let
-    the pool reserve-and-fill, record the route, and release the
-    segment views (ring credit back, device refs dropped) whether the
-    load committed or aborted.  Pool refusals (PoolSaturated /
-    SessionBusy) propagate for the RPC layer's shed mapping."""
+    the pool reserve-and-fill (outside the pool lock by default since
+    ISSUE 16, so concurrent LoadKv scatters proceed in parallel),
+    record the route, and release the segment views (ring credit back,
+    device refs dropped) whether the load committed or aborted.  Pool
+    refusals (PoolSaturated / SessionBusy — the latter now also fired
+    by the commit-time re-check when a raced loader's entry got
+    pinned mid-fill) propagate for the RPC layer's shed mapping."""
     src = wire_source(att, layers, seq_len, dmodel)
     try:
         want = seq_len * layers * dmodel

@@ -409,6 +409,11 @@ class ContinuousBatchScheduler:
         self.tokens_out << len(roster)
 
     def _build_arrays(self, roster: List[StepRequest]) -> None:
+        # r.kv.blocks may be PREFIX-SHARED (ISSUE 16): two rostered
+        # sessions with a common prefix gather through the SAME physical
+        # block ids — correct by construction (the gather only reads),
+        # and the roster pin on each session keeps every shared block's
+        # refcount holder alive for the step's lifetime
         maxb = max(len(r.kv.blocks) for r in roster)
         tbl = np.zeros((len(roster), maxb), np.int64)
         for k, r in enumerate(roster):

@@ -314,7 +314,9 @@ def run_press_serving(server: str, duration: float = 5.0,
     per-tenant session counts, shed/failure split, per-session
     tokens/s p50/p99, end-to-end latency, and the serving /status
     block (pool occupancy, step rate, batch occupancy) for every
-    in-process serving server."""
+    in-process serving server, plus each in-process pool's
+    ``kv_prefix`` CoW block (shared_blocks / prefix_hits /
+    sharing_ratio, ISSUE 16)."""
     import concurrent.futures
     import json as _json
 
@@ -473,6 +475,20 @@ def run_press_serving(server: str, duration: float = 5.0,
             result["kv_load_routes"] = kv_load_stats()
         except Exception:
             pass
+        # prefix-sharing truth (ISSUE 16): each in-process pool's CoW
+        # block — shared_blocks / prefix_hits / cow_splits / the
+        # physical-vs-logical sharing_ratio / fill-route counters —
+        # lifted out of the per-service describe_serving() blocks so a
+        # press run can assert capacity claims without scraping
+        # /status.  Same in-process gate as serving_status: remote-only
+        # runs omit it instead of reporting local zeros.
+        prefix = {
+            label: blk["pool"]["prefix"]
+            for label, blk in stats.items()
+            if isinstance(blk.get("pool"), dict)
+            and "prefix" in blk["pool"]}
+        if prefix:
+            result["kv_prefix"] = prefix
     print(json.dumps(result), file=out)
     for ch in channels:
         ch.close()

@@ -247,7 +247,10 @@ class DecodeService(rpc.Service):
         except SessionBusy as e:
             # re-prefill raced the running decode: retry once it
             # completes — freeing the rostered blocks mid-program
-            # would corrupt the batched step
+            # would corrupt the batched step.  Since ISSUE 16 this is
+            # also the COMMIT-TIME abort of an outside-the-lock fill
+            # (a concurrent LoadKv won the session id and its entry
+            # got pinned before our re-check) — same shed, same retry
             cntl.retry_after_ms = 10
             cntl.set_failed(rpc.errors.ELIMIT, str(e))
             done()
@@ -399,7 +402,11 @@ class RouterService(rpc.Service):
             if decode_url is None:
                 break
             # one session id per attempt: a retry re-prefills, never
-            # half-reuses a dead worker's parked KV
+            # half-reuses a dead worker's parked KV.  When the retry
+            # lands on the SAME worker, its LoadKv dedupes against the
+            # original session's still-parked blocks (ISSUE 16 prefix
+            # sharing) — the re-prefill's full blocks commit as
+            # refcount bumps, not new arena pages
             session = f"s{base_session}" if attempt == 0 \
                 else f"s{base_session}r{attempt}"
             pc = rpc.Controller()

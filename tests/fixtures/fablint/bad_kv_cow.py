@@ -1,0 +1,43 @@
+"""Seeded violation for the CoW prefix-sharing pool (ISSUE 16): a
+pool-like class that reserves under the lock and runs the fill OUTSIDE
+it — which is correct, reserved blocks are off the free list and in no
+table so nothing else can touch them — but then COMMITS the session
+table WITHOUT re-acquiring the lock for the commit-time re-check: the
+publish races close()'s free-list rebuild (the blocks get double-
+owned) and a concurrent same-session loader (two tables can point at
+one set of refcounted blocks with the loser's refcounts leaked), the
+exact shape ``PagedKvPool._commit_locked`` exists to prevent."""
+import threading
+
+
+class KvCowPool:
+    _GUARDED_BY = {"_free": "_lock", "_tables": "_lock",
+                   "_refs": "_lock"}
+
+    def __init__(self, arena):
+        self._lock = threading.Lock()
+        self._free = list(range(8))
+        self._tables = {}
+        self._refs = {}
+        self._arena = arena
+
+    def load_into_unchecked(self, session, n, fill):
+        with self._lock:
+            blocks = [self._free.pop() for _ in range(n)]
+        fill([self._arena[b] for b in blocks])   # unlocked fill: fine
+        self._tables[session] = blocks   # line 28: commit, no re-check
+        return blocks
+
+    def load_into_checked(self, session, n, fill):
+        with self._lock:
+            blocks = [self._free.pop() for _ in range(n)]
+        fill([self._arena[b] for b in blocks])
+        with self._lock:                 # the commit-time re-check
+            cur = self._tables.get(session)
+            if cur is not None:
+                self._free.extend(blocks)
+                return cur
+            for b in blocks:
+                self._refs[b] = self._refs.get(b, 0) + 1
+            self._tables[session] = blocks
+        return blocks

@@ -142,6 +142,18 @@ class TestFixtureViolations:
         assert "_tables" in out[0].message and "_lock" in out[0].message
         assert out[0].path.endswith("bad_kv_adopt.py")
 
+    def test_unchecked_cow_commit_reported_with_line(self):
+        """The CoW prefix-sharing pool (ISSUE 16): an outside-the-lock
+        fill is FINE (reserved blocks are invisible to every other pool
+        operation), but the commit must re-acquire the lock for the
+        re-check — a lock-free table publish is caught at the exact
+        file:line (it races close()'s free-list rebuild and concurrent
+        same-session loaders)."""
+        out = _findings("bad_kv_cow.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("guarded-state", 28)]
+        assert "_tables" in out[0].message and "_lock" in out[0].message
+        assert out[0].path.endswith("bad_kv_cow.py")
+
     def test_clean_fixture_is_silent(self):
         out = _findings(
             "clean_module.py",

@@ -13,6 +13,15 @@ Legs:
     partial-tail zeroing, abort-clean fills, counted pins, the
     snapshot-view materialize bugfix, RPC-level route assertions with
     custody census, and the 2-PROCESS shm claim-to-pool leg;
+  * **CoW prefix sharing + outside-the-lock fills** (ISSUE 16) — the
+    >= 5x capacity A/B on a 50 %-shared-prefix mix, refcounted dedupe
+    accounting, mid-block divergence and ``write_rows`` CoW splits with
+    co-owners' bytes intact, refcount-aware eviction order, reload
+    keeping other tenants' bytes, read-only views over shared blocks,
+    load/load_into locking parity under BOTH fill disciplines, the
+    two-thread concurrent-fill stress, the commit-race window
+    (last-commit-wins / pinned SessionBusy abort), and the RPC-level
+    concurrent LoadKv leg with /status truth and custody census;
   * **ContinuousBatchScheduler units** (manual stepping) — per-step
     admit/retire, tokens bit-exact against the single-process reference
     under staggered joins, interactive preemption preserving progress,
@@ -149,8 +158,11 @@ class TestPagedKvPool:
     def test_lru_eviction_within_band_and_touch(self):
         pool = _mk_pool(num_blocks=4, block_tokens=8)
         try:
-            for name in ("old", "mid", "new"):
-                pool.load(name, _rows([1] * 8), last_token=1,
+            # DISTINCT content per session: identical rows would
+            # prefix-share one physical block (ISSUE 16) and the pool
+            # would never feel the pressure this test is about
+            for i, name in enumerate(("old", "mid", "new")):
+                pool.load(name, _rows([10 + i] * 8), last_token=1,
                           priority=2)
                 time.sleep(0.002)
             pool.touch("old")                 # now "mid" is LRU
@@ -629,6 +641,472 @@ class TestKvZeroCopyHandoff:
 
 
 # ---------------------------------------------------------------------------
+# Copy-on-write prefix sharing + outside-the-lock fills (ISSUE 16).
+# ---------------------------------------------------------------------------
+
+class TestKvPrefixSharing:
+    def test_capacity_on_shared_prefix_mix_ab(self):
+        """The acceptance A/B at pool level: a 50 %-shared-prefix mix
+        (sessions alternate two 96-token system prompts + a unique
+        4-token tail) fits >= 5x more concurrent sessions at fixed
+        arena size with sharing ON than OFF, with zero byte mismatches
+        across the whole resident set on both legs."""
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.serving import PoolSaturated
+        pre_a = [(7 * j) % 499 for j in range(96)]     # 12 full blocks
+        pre_b = [(11 * j + 3) % 499 for j in range(96)]
+
+        def mk(i):
+            pre = pre_a if i % 2 == 0 else pre_b
+            return pre + [(13 * i + j + 1) % 499 for j in range(4)]
+
+        cap = {}
+        try:
+            for flag in (True, False):
+                _fl.set_flag("serving_kv_prefix_share", flag)
+                pool = _mk_pool(num_blocks=64, block_tokens=8)
+                loaded = []
+                try:
+                    i = 0
+                    while i < 200:
+                        toks = mk(i)
+                        name = f"cap{i}"
+                        try:
+                            pool.load(name, _rows(toks),
+                                      last_token=toks[-1])
+                        except PoolSaturated:
+                            break
+                        # pinned: capacity under load, not LRU churn
+                        assert pool.pin(name)
+                        loaded.append((name, toks))
+                        i += 1
+                    for name, toks in loaded:
+                        assert np.array_equal(pool.materialize(name),
+                                              _rows(toks)), name
+                    cap[flag] = len(loaded)
+                    d = pool.describe()["prefix"]
+                    if flag:
+                        # both 12-block prompts fully shared
+                        assert d["shared_blocks"] == 24
+                        assert d["sharing_ratio"] > 2.0
+                        assert d["prefix_hits"] > 0
+                    else:
+                        assert d["shared_blocks"] == 0
+                        assert d["prefix_hits"] == 0
+                finally:
+                    for name, _ in loaded:
+                        pool.unpin(name)
+                    pool.close()
+        finally:
+            _fl.set_flag("serving_kv_prefix_share", True)
+        assert cap[True] >= 5 * cap[False], cap
+
+    def test_identical_sessions_share_all_full_blocks(self):
+        pool = _mk_pool(num_blocks=16, block_tokens=8)
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]  # 2 FULL blocks
+            a = pool.load("a", _rows(toks), last_token=toks[-1])
+            free1 = len(pool._free)
+            b = pool.load("b", _rows(toks), last_token=toks[-1])
+            assert np.array_equal(a.blocks, b.blocks)
+            # the second load kept ZERO new physical blocks
+            assert len(pool._free) == free1
+            assert all(pool._refs[int(x)] == 2 for x in a.blocks)
+            d = pool.describe()["prefix"]
+            assert d["shared_blocks"] == 2 and d["prefix_hits"] == 2
+            assert d["logical_blocks"] == 4
+            assert d["physical_blocks"] == 2
+            assert d["sharing_ratio"] == 2.0
+            # releasing one owner keeps the other byte-exact (refcount
+            # order: the physical free happens at ZERO, not at first)
+            pool.release("a")
+            assert np.array_equal(pool.materialize("b"), _rows(toks))
+            pool.release("b")
+            assert len(pool._free) == 16
+            assert not pool._refs and not pool._prefix_index \
+                and not pool._block_hash
+        finally:
+            pool.close()
+
+    def test_cow_divergence_mid_block_and_write_split(self):
+        pool = _mk_pool(num_blocks=16, block_tokens=8)
+        try:
+            pre = [(5 * j) % 499 for j in range(8)]    # 1 full block
+            ta = pre + [7, 8, 9, 10]
+            tb = pre + [7, 8, 99, 10]      # diverges MID second block
+            a = pool.load("a", _rows(ta), last_token=ta[-1])
+            b = pool.load("b", _rows(tb), last_token=tb[-1])
+            assert int(a.blocks[0]) == int(b.blocks[0])   # shared
+            assert int(a.blocks[1]) != int(b.blocks[1])   # private
+            assert np.array_equal(pool.materialize("a"), _rows(ta))
+            assert np.array_equal(pool.materialize("b"), _rows(tb))
+            # a SHORTER session still shares the longer one's prefix
+            c = pool.load("c", _rows(pre), last_token=pre[-1])
+            assert int(c.blocks[0]) == int(a.blocks[0])
+            # in-place mutation of the shared block CoW-splits: the
+            # co-owners' bytes survive untouched
+            splits0 = pool.cow_splits.get_value()
+            new_row = np.full((1, pool.options.bytes_per_token), 7,
+                              np.uint8)
+            assert pool.write_rows("b", 0, new_row) == 1
+            assert pool.cow_splits.get_value() == splits0 + 1
+            assert int(pool.get("b").blocks[0]) != int(a.blocks[0])
+            assert np.array_equal(pool.materialize("a"), _rows(ta))
+            assert np.array_equal(pool.materialize("c"), _rows(pre))
+            got = pool.materialize("b")
+            assert np.array_equal(got[0], new_row[0])
+            assert np.array_equal(got[1:], _rows(tb)[1:])
+            # the reduction arena followed the write
+            assert pool.get("b").acc == int(got.sum(dtype=np.int64))
+        finally:
+            pool.close()
+
+    def test_shared_block_eviction_refcount_order(self):
+        """Evicting one co-owner of a shared prefix frees NOTHING (the
+        victim simulation knows); pressure that needs those blocks
+        takes BOTH owners, and a pinned co-owner saturates instead."""
+        from brpc_tpu.serving import PoolSaturated
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        try:
+            toks = [(9 * j) % 499 for j in range(16)]  # 2 full blocks
+            pool.load("a", _rows(toks), last_token=1, priority=3)
+            time.sleep(0.002)
+            pool.load("b", _rows(toks), last_token=1, priority=3)
+            big = [(2 * j + 1) % 499 for j in range(24)]   # 3 blocks
+            # with one co-owner PINNED the shared blocks cannot free:
+            # a typed shed, never a corrupting eviction
+            assert pool.pin("b")
+            with pytest.raises(PoolSaturated):
+                pool.load("big", _rows(big), last_token=1, priority=2)
+            pool.unpin("b")
+            # unpinned: evicting LRU "a" alone frees nothing, so the
+            # picker takes BOTH
+            pool.load("big", _rows(big), last_token=1, priority=2)
+            assert pool.get("a") is None and pool.get("b") is None
+            assert np.array_equal(pool.materialize("big"), _rows(big))
+            assert len(pool._free) == 1
+        finally:
+            pool.close()
+
+    def test_reload_shared_prefix_keeps_other_tenants_bytes(self):
+        pool = _mk_pool(num_blocks=16, block_tokens=8)
+        try:
+            pre = [(3 * j + 1) % 499 for j in range(16)]
+            ta = pre + [5, 6, 7]
+            tb = pre + [8, 9, 10]
+            pool.load("a", _rows(ta), last_token=1)
+            pool.load("b", _rows(tb), last_token=1)
+            hits0 = pool.prefix_hits.get_value()
+            # reload b with DIFFERENT content: a's bytes survive
+            tb2 = [(7 * j + 2) % 499 for j in range(20)]
+            pool.load("b", _rows(tb2), last_token=1)
+            assert np.array_equal(pool.materialize("a"), _rows(ta))
+            assert np.array_equal(pool.materialize("b"), _rows(tb2))
+            # reload b BACK to the shared prefix: dedupes against a
+            pool.load("b", _rows(tb), last_token=1)
+            assert pool.prefix_hits.get_value() >= hits0 + 2
+            assert int(pool.get("b").blocks[0]) == \
+                int(pool.get("a").blocks[0])
+            assert np.array_equal(pool.materialize("a"), _rows(ta))
+            assert np.array_equal(pool.materialize("b"), _rows(tb))
+        finally:
+            pool.close()
+
+    def test_readonly_view_over_shared_blocks(self):
+        pool = _mk_pool(num_blocks=16, block_tokens=8)
+        try:
+            toks = [(13 * j + 5) % 499 for j in range(16)]  # 2 FULL
+            pool.load("a", _rows(toks), last_token=toks[-1])
+            b = pool.load("b", _rows(toks), last_token=toks[-1])
+            # a fully-shared run is still one ascending extent
+            assert b.contiguous
+            rows, seq, last, is_view = pool.snapshot("b", view=True)
+            assert is_view and not rows.flags.writeable
+            with pytest.raises((ValueError, RuntimeError)):
+                rows[0, 0] = 1
+            assert np.array_equal(rows, _rows(toks))
+            pool.unpin("b")
+        finally:
+            pool.close()
+
+    def test_load_and_load_into_parity_both_disciplines(self):
+        """The locking-parity satellite: load() delegates to
+        load_into(), so both surfaces ride ONE reserve/fill/commit
+        shape — identical session state and identical fill-route
+        counters under BOTH fill disciplines."""
+        from brpc_tpu.butil import flags as _fl
+        toks = [(11 * j) % 499 for j in range(20)]
+        rows = _rows(toks)
+        try:
+            for conc in (True, False):
+                _fl.set_flag("serving_kv_concurrent_fill", conc)
+                pool = _mk_pool(num_blocks=16, block_tokens=8)
+                try:
+                    a = pool.load("a", rows, last_token=toks[-1])
+
+                    def fill(views):
+                        off = 0
+                        for v in views:
+                            v[:] = rows[off:off + v.shape[0]]
+                            off += v.shape[0]
+
+                    b = pool.load_into("b", len(toks), fill,
+                                       last_token=toks[-1])
+                    route = (pool.unlocked_fills if conc
+                             else pool.locked_fills)
+                    other = (pool.locked_fills if conc
+                             else pool.unlocked_fills)
+                    assert route.get_value() == 2
+                    assert other.get_value() == 0
+                    assert a.acc == b.acc and a.seq_len == b.seq_len
+                    assert np.array_equal(pool.materialize("a"),
+                                          pool.materialize("b"))
+                    # identical content: b shared a's FULL blocks
+                    assert np.array_equal(a.blocks[:2], b.blocks[:2])
+                finally:
+                    pool.close()
+        finally:
+            _fl.set_flag("serving_kv_concurrent_fill", True)
+
+    def test_concurrent_load_into_stress(self):
+        """Two threads load/materialize/release disjoint session sets
+        concurrently (fills outside the lock): byte-exact, no
+        double-free, census intact after full release."""
+        pool = _mk_pool(num_blocks=64, block_tokens=8)
+        errors = []
+        N = 40
+
+        def worker(tag, salt):
+            try:
+                for i in range(N):
+                    toks = [(7 * j + 31 * i + salt) % 499
+                            for j in range(12 + (i % 3) * 8)]
+                    name = f"{tag}{i}"
+                    pool.load(name, _rows(toks), last_token=toks[-1])
+                    got = pool.materialize(name)
+                    if not np.array_equal(got, _rows(toks)):
+                        errors.append(f"{name}: byte mismatch")
+                    pool.release(name)
+            except Exception as e:   # pragma: no cover
+                errors.append(f"{tag}: {e!r}")
+
+        ts = [threading.Thread(target=worker, args=("x", 1)),
+              threading.Thread(target=worker, args=("y", 2))]
+        try:
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert errors == []
+            # no double-free, no leak: every block back exactly once,
+            # the descending order invariant intact
+            assert len(pool._free) == 64
+            assert pool._free == sorted(pool._free, reverse=True)
+            assert len(set(pool._free)) == 64
+            assert not pool._refs
+            assert pool.describe()["prefix"]["unlocked_fills"] == 2 * N
+        finally:
+            pool.close()
+
+    def test_concurrent_fill_no_longer_serializes(self):
+        """The concurrency claim asserted structurally: with the flag
+        ON a second session's load COMPLETES while another fill is
+        parked inside the pool; with the flag OFF the same load cannot
+        finish until the stalled fill releases the pool lock."""
+        from brpc_tpu.butil import flags as _fl
+        toks_a = [(3 * j + 1) % 499 for j in range(16)]
+        toks_b = [(5 * j + 2) % 499 for j in range(16)]
+        try:
+            for conc in (True, False):
+                _fl.set_flag("serving_kv_concurrent_fill", conc)
+                pool = _mk_pool(num_blocks=32, block_tokens=8)
+                in_fill = threading.Event()
+                unblock = threading.Event()
+                done_b = threading.Event()
+                try:
+                    def slow_fill(views):
+                        rows = _rows(toks_a)
+                        off = 0
+                        for v in views:
+                            v[:] = rows[off:off + v.shape[0]]
+                            off += v.shape[0]
+                        in_fill.set()
+                        assert unblock.wait(10)
+
+                    ta = threading.Thread(
+                        target=lambda: pool.load_into(
+                            "a", len(toks_a), slow_fill,
+                            last_token=toks_a[-1]))
+                    ta.start()
+                    assert in_fill.wait(10)
+                    tb = threading.Thread(target=lambda: (
+                        pool.load("b", _rows(toks_b),
+                                  last_token=toks_b[-1]),
+                        done_b.set()))
+                    tb.start()
+                    if conc:
+                        assert done_b.wait(5), \
+                            "concurrent fill serialized"
+                    else:
+                        assert not done_b.wait(0.3), \
+                            "locked fill should serialize"
+                    unblock.set()
+                    ta.join(10)
+                    tb.join(10)
+                    assert done_b.is_set()
+                    assert np.array_equal(pool.materialize("a"),
+                                          _rows(toks_a))
+                    assert np.array_equal(pool.materialize("b"),
+                                          _rows(toks_b))
+                    d = pool.describe()["prefix"]
+                    if conc:
+                        assert d["unlocked_fills"] == 2
+                        assert d["locked_fills"] == 0
+                    else:
+                        assert d["locked_fills"] == 2
+                        assert d["unlocked_fills"] == 0
+                finally:
+                    unblock.set()
+                    pool.close()
+        finally:
+            _fl.set_flag("serving_kv_concurrent_fill", True)
+
+    def test_commit_race_last_commit_wins_and_pinned_abort(self):
+        """Two loaders race ONE session id across the fill window: the
+        later commit wins when the incumbent is unpinned; a PINNED
+        incumbent aborts the late fill with SessionBusy — blocks
+        returned, incumbent bytes intact, race counted either way."""
+        from brpc_tpu.serving import SessionBusy
+        pool = _mk_pool(num_blocks=32, block_tokens=8)
+        toks_slow = [(3 * j + 2) % 499 for j in range(12)]
+        toks_fast = [(9 * j + 4) % 499 for j in range(12)]
+        try:
+            for pinned in (False, True):
+                in_fill = threading.Event()
+                unblock = threading.Event()
+                result = {}
+
+                def slow_fill(views):
+                    rows = _rows(toks_slow)
+                    off = 0
+                    for v in views:
+                        v[:] = rows[off:off + v.shape[0]]
+                        off += v.shape[0]
+                    in_fill.set()
+                    assert unblock.wait(10)
+
+                def racer():
+                    try:
+                        pool.load_into("s", len(toks_slow), slow_fill,
+                                       last_token=toks_slow[-1])
+                        result["ok"] = True
+                    except SessionBusy:
+                        result["busy"] = True
+
+                races0 = pool.commit_races.get_value()
+                t = threading.Thread(target=racer)
+                t.start()
+                assert in_fill.wait(10)
+                # the fast loader commits the same id mid-fill
+                pool.load("s", _rows(toks_fast),
+                          last_token=toks_fast[-1])
+                if pinned:
+                    assert pool.pin("s")
+                free_before = len(pool._free)
+                unblock.set()
+                t.join(10)
+                assert pool.commit_races.get_value() == races0 + 1
+                if pinned:
+                    assert result.get("busy") and "ok" not in result
+                    assert np.array_equal(pool.materialize("s"),
+                                          _rows(toks_fast))
+                    pool.unpin("s")
+                else:
+                    assert result.get("ok")
+                    assert np.array_equal(pool.materialize("s"),
+                                          _rows(toks_slow))
+                # either way the loser's 2 blocks came back
+                assert len(pool._free) == free_before + 2
+                pool.release("s")
+                result.clear()
+            assert len(pool._free) == 32 and not pool._refs
+        finally:
+            pool.close()
+
+    def test_rpc_concurrent_loadkv_shares_prefix_and_status(self):
+        """Service level: two CONCURRENT LoadKv RPCs ride the
+        outside-the-lock fill (route-asserted from counter deltas),
+        the identical prompts prefix-share one set of physical
+        blocks, both decodes are byte-exact, /status carries the new
+        truth, and custody drains."""
+        import gc
+
+        from brpc_tpu.ici import native_plane as npl
+        from examples.disagg_serving.workers import DecodeService
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+        m = _model()
+        tokens = [(19 * j) % 499 for j in range(48)]
+        want = m.reference_generate(tokens, 7)
+        server = rpc.Server()
+        svc = DecodeService()
+        server.add_service(svc)
+        assert server.start("mem://kv-prefix") == 0
+        ch = rpc.Channel()
+        ch.init("mem://kv-prefix",
+                options=rpc.ChannelOptions(timeout_ms=30000))
+        try:
+            p0 = svc.describe_serving()["pool"]["prefix"]
+            errs = []
+
+            def load(session):
+                try:
+                    kv = m.toy_kv_blocks(tokens)
+                    cntl = rpc.Controller()
+                    cntl.request_attachment.append_device_array(kv)
+                    ch.call_method("Decode.LoadKv", cntl, EchoRequest(
+                        message=json.dumps(
+                            {"session": session,
+                             "seq_len": len(tokens),
+                             "last_token": tokens[-1]})),
+                        EchoResponse)
+                    if cntl.failed():
+                        errs.append(cntl.error_text)
+                except Exception as e:   # pragma: no cover
+                    errs.append(repr(e))
+
+            ts = [threading.Thread(target=load, args=(f"p{i}",))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert errs == []
+            p1 = svc.describe_serving()["pool"]["prefix"]
+            assert p1["unlocked_fills"] - p0["unlocked_fills"] == 2
+            assert p1["locked_fills"] == p0["locked_fills"]
+            assert p1["shared_blocks"] >= 1
+            assert p1["prefix_hits"] - p0["prefix_hits"] >= 1
+            assert p1["sharing_ratio"] > 1.0
+            for i in range(2):
+                cntl = rpc.Controller()
+                resp = ch.call_method(
+                    "Decode.Decode", cntl, EchoRequest(
+                        message=json.dumps({"session": f"p{i}",
+                                            "steps": 7,
+                                            "mode": "sync"})),
+                    EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert json.loads(resp.message)["tokens"] == want
+            gc.collect()
+            assert npl.registry().live() == 0
+            assert npl.att_table_live() == 0
+        finally:
+            ch.close()
+            svc.close()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
 # Continuous-batching scheduler (manual stepping).
 # ---------------------------------------------------------------------------
 
@@ -1064,6 +1542,14 @@ class TestServingServices:
                        if "Decode" in k)
             assert blk["pool"]["blocks_total"] > 0
             assert blk["scheduler"]["steps"] > 0
+            # the ISSUE-16 prefix block rides the summary (same
+            # in-process gate as serving_status)
+            pfx = next(v for k, v in res["kv_prefix"].items()
+                       if "Decode" in k)
+            assert pfx["sharing_ratio"] >= 1.0
+            assert pfx["unlocked_fills"] > 0     # the default route
+            for key in ("shared_blocks", "prefix_hits", "cow_splits"):
+                assert key in pfx
         finally:
             for server in (router, prefill, decode):
                 for svc in server._services.values():
