@@ -564,11 +564,17 @@ class PagedKvPool:
             # would resurrect custody close() ended
             raise RuntimeError("kv pool is closed")
         cur = self._tables.get(s.session)
-        if cur is not None and cur is not deferred_old:
-            # a concurrent loader committed this session id mid-fill
-            self.commit_races << 1
+        if cur is not None:
+            if cur is not deferred_old:
+                # a concurrent loader committed this session id mid-fill
+                self.commit_races << 1
             if cur.pinned:
-                # the incumbent is in a roster/view — OUR fill aborts
+                # the incumbent — a raced commit OR our own
+                # deferred_old that a roster/view pinned during the
+                # outside-the-lock fill window — is being READ right
+                # now: OUR fill aborts, its blocks stay intact (the
+                # reserve-time pinned check cannot see a pin that
+                # arrives mid-fill, so the re-check must)
                 self._return_blocks_locked(s.blocks)
                 raise SessionBusy(s.session)
             # last-commit-wins: retire the raced incumbent (after
@@ -643,16 +649,19 @@ class PagedKvPool:
 
     # fablint: lock-held(_lock)
     def _pick_victims_locked(self, blocks_needed: int,
-                             requester_pri: int):
+                             requester_pri: int, exclude=None):
         """Eviction order under pressure: most-sheddable band first,
         lighter tenants before heavier inside a band, LRU inside a
         class; never a band more protected than the requester's.  A
         victim only contributes the blocks that would ACTUALLY free —
         the refcount decrements are simulated cumulatively across the
         victim list, so two sessions sharing a prefix free its blocks
-        only when BOTH are on the list."""
+        only when BOTH are on the list.  ``exclude`` fences one session
+        out of the candidate set (``write_rows`` evicting on behalf of
+        the session it is mutating must never pick that session)."""
         cands = [s for s in self._tables.values()
-                 if not s.pinned and s.priority >= requester_pri]
+                 if not s.pinned and s.priority >= requester_pri
+                 and s is not exclude]
         cands.sort(key=lambda s: (-s.priority, self._weight(s.tenant),
                                   s.last_used))
         victims, have = [], 0
@@ -771,15 +780,25 @@ class PagedKvPool:
             for k in range(first_b, last_b + 1):
                 blk = int(s.blocks[k] if new_blocks is None
                           else new_blocks[k])
+                if self._refs.get(blk, 1) > 1 and not self._free:
+                    # a split needs a free block: evict — NEVER the
+                    # session being written (unpinned + a stale
+                    # last_used would otherwise make it the likely
+                    # LRU pick, and freeing it mid-write mutates a
+                    # zombie over blocks back on the free list)
+                    victims = self._pick_victims_locked(
+                        1, s.priority, exclude=s)
+                    if victims is None:
+                        raise PoolSaturated(1, 0)
+                    for v in victims:
+                        self._free_session_locked(v, "pressure")
                 if self._refs.get(blk, 1) > 1:
-                    # CoW split: other sessions own these bytes too
-                    if not self._free:
-                        victims = self._pick_victims_locked(
-                            1, s.priority)
-                        if victims is None:
-                            raise PoolSaturated(1, 0)
-                        for v in victims:
-                            self._free_session_locked(v, "pressure")
+                    # CoW split: other sessions own these bytes too.
+                    # RE-CHECKED after any eviction — taking the last
+                    # co-owner drops the refcount to 1 and the block
+                    # is already private; splitting then would strand
+                    # it at refcount 0, off both the free list and
+                    # every table
                     nb = self._free.pop()
                     self._store[nb] = self._store[blk]
                     self._pos_sums[nb] = self._pos_sums[blk]

@@ -1033,6 +1033,134 @@ class TestKvPrefixSharing:
         finally:
             pool.close()
 
+    def test_pin_during_reload_fill_window_aborts_commit(self):
+        """A same-session reload whose OLD entry gets PINNED (roster
+        or snapshot view) during the outside-the-lock fill window must
+        not free the pinned blocks at commit: the late fill aborts
+        with SessionBusy, the incumbent's bytes stay intact, and the
+        reservation returns clean.  The reserve-time pinned check
+        cannot see this pin — only the commit-time re-check can."""
+        from brpc_tpu.serving import SessionBusy
+        pool = _mk_pool(num_blocks=32, block_tokens=8)
+        toks_old = [(3 * j + 2) % 499 for j in range(12)]
+        toks_new = [(9 * j + 4) % 499 for j in range(12)]
+        in_fill = threading.Event()
+        unblock = threading.Event()
+        result = {}
+        try:
+            pool.load("s", _rows(toks_old), last_token=toks_old[-1])
+
+            def slow_fill(views):
+                rows = _rows(toks_new)
+                off = 0
+                for v in views:
+                    v[:] = rows[off:off + v.shape[0]]
+                    off += v.shape[0]
+                in_fill.set()
+                assert unblock.wait(10)
+
+            def reloader():
+                try:
+                    pool.load_into("s", len(toks_new), slow_fill,
+                                   last_token=toks_new[-1])
+                    result["ok"] = True
+                except SessionBusy:
+                    result["busy"] = True
+
+            t = threading.Thread(target=reloader)
+            t.start()
+            assert in_fill.wait(10)
+            # the old entry enters a roster/view mid-fill
+            assert pool.pin("s")
+            races0 = pool.commit_races.get_value()
+            free_before = len(pool._free)
+            unblock.set()
+            t.join(10)
+            assert result.get("busy") and "ok" not in result
+            # our own deferred_old is not a two-loader race
+            assert pool.commit_races.get_value() == races0
+            assert np.array_equal(pool.materialize("s"),
+                                  _rows(toks_old))
+            assert len(pool._free) == free_before + 2
+            pool.unpin("s")
+            pool.release("s")
+            assert len(pool._free) == 32 and not pool._refs
+        finally:
+            unblock.set()
+            pool.close()
+
+    def test_write_rows_never_evicts_writing_session(self):
+        """``write_rows`` needing a free block for a CoW split must
+        never evict the session it is mutating (the writer's stale
+        last_used made it the likely LRU pick), and when the eviction
+        takes the block's last CO-OWNER the refcount re-check writes
+        IN PLACE instead of stranding a 0-refcount block off both the
+        free list and every table."""
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        try:
+            shared = [(9 * j) % 499 for j in range(16)]  # 2 full blocks
+            other = [(2 * j + 1) % 499 for j in range(16)]
+            pool.load("a", _rows(shared), last_token=shared[-1])
+            time.sleep(0.002)
+            pool.load("b", _rows(shared), last_token=shared[-1])
+            time.sleep(0.002)
+            pool.load("c", _rows(other), last_token=other[-1])
+            assert not pool._free   # a+b share 2 blocks, c owns 2
+            splits0 = pool.cow_splits.get_value()
+            new_row = np.full((1, pool.options.bytes_per_token), 7,
+                              np.uint8)
+            # "a" is the unpinned LRU candidate — the bug evicted it
+            # out from under its own write
+            assert pool.write_rows("a", 0, new_row) == 0
+            s = pool.get("a")
+            assert s is not None, "writer evicted itself"
+            got = pool.materialize("a")
+            assert np.array_equal(got[0], new_row[0])
+            assert np.array_equal(got[1:], _rows(shared)[1:])
+            # the eviction took co-owner "b", so the re-check wrote in
+            # place: no split, no stranded block — census exact
+            assert pool.cow_splits.get_value() == splits0
+            assert pool.get("b") is None
+            assert all(pool._refs[int(x)] == 1 for x in s.blocks)
+            assert len(pool._free) + len(pool._refs) == 4
+            pool.release("a")
+            assert len(pool._free) == 4 and not pool._refs \
+                and not pool._prefix_index and not pool._block_hash
+        finally:
+            pool.close()
+
+    def test_write_rows_split_after_eviction_keeps_coowner(self):
+        """When the eviction for a split frees a THIRD session (the
+        co-owner is pinned and survives), the refcount re-check still
+        splits and the co-owner's bytes stay intact."""
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        try:
+            shared = [(9 * j) % 499 for j in range(16)]
+            other = [(2 * j + 1) % 499 for j in range(16)]
+            pool.load("a", _rows(shared), last_token=shared[-1])
+            pool.load("b", _rows(shared), last_token=shared[-1])
+            pool.load("c", _rows(other), last_token=other[-1])
+            assert pool.pin("a")
+            splits0 = pool.cow_splits.get_value()
+            new_row = np.full((1, pool.options.bytes_per_token), 7,
+                              np.uint8)
+            assert pool.write_rows("b", 0, new_row) == 1
+            assert pool.cow_splits.get_value() == splits0 + 1
+            # "c" paid for the split block; pinned "a" is untouched
+            assert pool.get("c") is None
+            assert np.array_equal(pool.materialize("a"), _rows(shared))
+            got = pool.materialize("b")
+            assert np.array_equal(got[0], new_row[0])
+            assert np.array_equal(got[1:], _rows(shared)[1:])
+            assert int(pool.get("b").blocks[0]) != \
+                int(pool.get("a").blocks[0])
+            pool.unpin("a")
+            pool.release("a")
+            pool.release("b")
+            assert len(pool._free) == 4 and not pool._refs
+        finally:
+            pool.close()
+
     def test_rpc_concurrent_loadkv_shares_prefix_and_status(self):
         """Service level: two CONCURRENT LoadKv RPCs ride the
         outside-the-lock fill (route-asserted from counter deltas),
