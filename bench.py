@@ -2758,6 +2758,111 @@ def bench_bvar_record() -> dict:
     }
 
 
+def bench_chaos_matrix() -> dict:
+    """Kill-every-plane chaos matrix, engine tier (ISSUE 17): one
+    PlaneHealth record per revival policy — prober (the fabric bulk/shm
+    shape), timer (device/xfer), epoch (collective fanout) — driven
+    through KILL, BLACK-HOLE and SLOW in-process.  Pass per cell = the
+    exact unified ``rpc_fabric_plane_<name>_{down,reprobe,revived,
+    ramp}`` delta the engine contract promises (SLOW = zero movement),
+    plus the measured down→revived wall latency for the threaded
+    policy.  Pure host, no device backend.  The real-wire rows run in
+    tests/test_chaos_fabric.py's pair scenarios; this bench pins the
+    ENGINE's matrix into the nightly JSON line."""
+    import threading
+    from brpc_tpu.ici import plane_health as ph
+    from brpc_tpu.ici.route import plane_stats
+    from brpc_tpu.rpc import fault_injection as fi
+
+    def delta(name, before):
+        after = plane_stats()
+        return {ev: after.get(f"{name}_{ev}", 0)
+                - before.get(f"{name}_{ev}", 0)
+                for ev in ("down", "reprobe", "revived", "ramp")}
+
+    out = {}
+
+    # KILL × prober: the threaded loop owns the comeback; time it
+    attached = threading.Event()
+    box = {}
+
+    def prober():
+        box["rec"].revived()
+        attached.set()
+        return True
+
+    rec = box["rec"] = ph.register_plane(
+        "bm_prober", prober=prober, attached=attached.is_set,
+        backoff_base=0.005, backoff_cap=0.01)
+    before = plane_stats()
+    rec.mark_down("bench kill")
+    t0 = time.perf_counter()
+    rec.kick()
+    ok = attached.wait(10)
+    out["chaos_kill_prober_revive_ms"] = round(
+        (time.perf_counter() - t0) * 1e3, 2)
+    ok = ok and rec.usable() is True          # clears the ramp
+    out["pass_kill_prober"] = ok and delta("bm_prober", before) == \
+        {"down": 1, "reprobe": 1, "revived": 1, "ramp": 1}
+
+    # BLACK-HOLE × timer: latch holds, lapse revives, next call ramps
+    rec = ph.register_plane("bm_timer", retry_s=lambda: 0.05)
+    before = plane_stats()
+    rec.mark_down("bench blackhole")
+    held = rec.usable() is False
+    time.sleep(0.08)
+    revived = rec.usable() is True and rec.usable() is True
+    out["pass_blackhole_timer"] = held and revived \
+        and delta("bm_timer", before) == \
+        {"down": 1, "reprobe": 1, "revived": 1, "ramp": 1}
+
+    # KILL + BLACK-HOLE × epoch: membership death is epoch-gated,
+    # a transient reason is timer-gated under stable membership
+    epoch = {"n": 1}
+    rec = ph.register_plane(
+        "bm_epoch", epoch_fn=lambda: epoch["n"],
+        transient_reasons=("bench blackhole",),
+        reprobe_s=lambda: 0.05)
+    before = plane_stats()
+    rec.mark_down("bench kill")
+    time.sleep(0.08)
+    gated = rec.usable() is False       # waiting never resurrects it
+    epoch["n"] = 2
+    revived = rec.usable() is True and rec.usable() is True
+    rec.mark_down("bench blackhole")
+    held = rec.usable() is False
+    time.sleep(0.08)
+    timed = rec.usable() is True and rec.usable() is True
+    out["pass_kill_blackhole_epoch"] = gated and revived and held \
+        and timed and delta("bm_epoch", before) == \
+        {"down": 2, "reprobe": 2, "revived": 2, "ramp": 2}
+
+    # SLOW × every policy: latency is not death — zero engine movement
+    specs = {
+        "bm_slow_p": dict(prober=lambda: True, attached=lambda: True),
+        "bm_slow_t": dict(retry_s=lambda: 0.05),
+        "bm_slow_e": dict(epoch_fn=lambda: 1),
+    }
+    plan = fi.FabricFaultPlan(plane_slow_ms={n: 5 for n in specs})
+    before = plane_stats()
+    slow_ok = True
+    with fi.inject_fabric(plan):
+        for name, policy in specs.items():
+            r = ph.register_plane(name, **policy)
+            plan.on_plane_op(None, name)
+            slow_ok = (slow_ok and r.usable() is True
+                       and r.snapshot()["downs"] == 0
+                       and delta(name, before) == {"down": 0,
+                                                   "reprobe": 0,
+                                                   "revived": 0,
+                                                   "ramp": 0})
+    out["pass_slow_no_degrade"] = slow_ok \
+        and plan.injected["plane_slow"] == 3
+    out["chaos_matrix_pass"] = all(
+        v for k, v in out.items() if k.startswith("pass_"))
+    return out
+
+
 def device_backend_reachable() -> bool:
     """Fast-fail probe for the device backend (VERDICT r1 #1): under the
     axon tunnel, jax backend init dials the terminal's stateless port —
@@ -3038,6 +3143,11 @@ def main() -> None:
     except Exception as e:  # pragma: no cover
         print(f"# bvar record bench failed: {e}", file=sys.stderr)
         bvr = {}
+    # ISSUE-17 plane-health chaos matrix: pure-host engine tier (the
+    # real-wire rows live in the chaos pair scenarios); spawns a
+    # revival thread so it rides its own subprocess
+    cmx = _run_subbench("chaos_matrix", timeout_s=120)
+    print(f"# chaos matrix: {cmx}", file=sys.stderr)
     target_us = 10.0
     # Metric of record: a MESH-CROSSING p50 — the payload actually
     # changes chips (VERDICT r5 weak #1: the old headline was a
@@ -3350,6 +3460,12 @@ def main() -> None:
         "bvar_record_unbatched_ns": bvr.get("bvar_record_unbatched_ns",
                                             -1.0),
         "bvar_record_cut_pct": bvr.get("bvar_record_cut_pct", -1.0),
+        # ISSUE-17 plane-health chaos matrix: every revival policy ×
+        # {kill, black-hole, slow} against the one shared engine, pass
+        # = exact unified-counter deltas per cell
+        "chaos_matrix_pass": cmx.get("chaos_matrix_pass", False),
+        "chaos_kill_prober_revive_ms": cmx.get(
+            "chaos_kill_prober_revive_ms", -1.0),
     }
     # single-device allreduce is local-HBM bandwidth, not ICI: label it so
     # no reader mistakes it for line rate (VERDICT r3 #3a)
@@ -3387,7 +3503,8 @@ if __name__ == "__main__":
               "pod_prefill_decode": bench_pod_prefill_decode,
               "serving_soak": bench_serving_soak,
               "serving_kv": bench_serving_kv_handoff,
-              "serving_kv_prefix": bench_serving_kv_prefix}[sys.argv[2]]
+              "serving_kv_prefix": bench_serving_kv_prefix,
+              "chaos_matrix": bench_chaos_matrix}[sys.argv[2]]
         print(_json.dumps(fn()))
     else:
         main()

@@ -431,26 +431,34 @@ class CollectiveFanoutPlane:
     # under _lock with per-key ONCE-GUARD builds OUTSIDE it (an XLA
     # compile can take seconds; holding the cache lock across it starves
     # every other fan-out's lookup — the Collectives._cached bug this PR
-    # also fixes at its origin).  Health state has its own lock: a
-    # screen must never wait on a compile to learn the route is down.
+    # also fixes at its origin).  Health STATE lives in the shared
+    # PlaneHealth engine (ici/plane_health.py, epoch-gated policy) on
+    # its own lock: a screen must never wait on a compile to learn the
+    # route is down.
     _GUARDED_BY = {
         "_programs": "_lock",
         "_building": "_lock",
-        "_down": "_health_lock",
-        "_down_reason": "_health_lock",
-        "_down_epoch": "_health_lock",
-        "_down_at": "_health_lock",
     }
 
     def __init__(self) -> None:
+        from ..ici import plane_health as _ph
         self._lock = _dbg.make_lock("CollectiveFanoutPlane._lock")
-        self._health_lock = _dbg.make_lock("CollectiveFanoutPlane._health")
         self._programs: "collections.OrderedDict" = collections.OrderedDict()
         self._building: Dict[Tuple, threading.Event] = {}
-        self._down = False
-        self._down_reason = ""
-        self._down_epoch = -1
-        self._down_at = 0.0
+        # the plane's health record: epoch-gated revival (a member
+        # re-advertising moves the clock) with the transient-reason
+        # reprobe timer; the legacy rpc_fabric_route_collective_*
+        # family keeps flowing via the events hook so the unified
+        # rpc_fabric_plane_collective_* counters ADD to it, not replace
+        self._health = _ph.register_plane(
+            "collective",
+            _dbg.make_lock("CollectiveFanoutPlane._health"),
+            epoch_fn=self._epoch,
+            transient_reasons=_TRANSIENT_REASONS,
+            reprobe_s=lambda: _flags.get_flag("ici_fanout_reprobe_s"),
+            events=self._record_legacy,
+            on_down=self._log_down,
+            on_revive=self._log_revive)
         self.sequencer = FanoutSequencer()
 
     @classmethod
@@ -478,57 +486,41 @@ class CollectiveFanoutPlane:
             e += pod.epoch()
         return e
 
-    def mark_down(self, reason: str) -> None:
-        import time as _time
+    def _record_legacy(self, event: str, reason: str) -> None:
         from ..ici import route as _route
-        with self._health_lock:
-            if self._down:
-                return
-            self._down = True
-            self._down_reason = reason
-            self._down_epoch = self._epoch()
-            self._down_at = _time.monotonic()
-        _route.record_collective("degraded", reason)
+        _route.record_collective(event, reason)
+
+    def _log_down(self, reason: str) -> None:
         log.warning("collective fan-out route DOWN (%s); per-member RPC "
                     "fallback until the pod epoch moves%s", reason,
                     " or the reprobe window elapses"
                     if reason in _TRANSIENT_REASONS else "")
 
-    def route_usable(self) -> bool:
-        """Healthy, or down-but-revivable: the epoch moved (a member
-        re-advertised), or — for TRANSIENT reasons only (a program
-        raised, an announce was refused) — the reprobe window elapsed.
-        Without the timer, one bad execution would degrade every method
-        on this process forever under stable membership; membership
-        reasons stay epoch-gated (a dead member does not resurrect by
-        waiting)."""
-        import time as _time
-        with self._health_lock:
-            if not self._down:
-                return True
-            down_epoch = self._down_epoch
-            transient_expired = (
-                self._down_reason in _TRANSIENT_REASONS
-                and _time.monotonic() - self._down_at
-                >= _flags.get_flag("ici_fanout_reprobe_s"))
-        if not transient_expired and self._epoch() <= down_epoch:
-            return False
-        from ..ici import route as _route
-        with self._health_lock:
-            if not self._down:
-                return True
-            self._down = False
-            reason, self._down_reason = self._down_reason, ""
-        _route.record_collective("revived", reason)
+    def _log_revive(self, reason: str, via: str) -> None:
+        from ..ici import plane_health as _ph
         log.info("collective fan-out route REVIVED (%s past %s)",
-                 "reprobe window" if transient_expired else "epoch moved",
-                 reason)
-        return True
+                 "reprobe window" if via == _ph.VIA_TIMER
+                 else "epoch moved", reason)
+
+    def mark_down(self, reason: str) -> None:
+        self._health.mark_down(reason)
+
+    def route_usable(self) -> bool:
+        """Healthy, or down-but-revivable — the engine's epoch-gated
+        policy: the epoch moved (a member re-advertised), or — for
+        TRANSIENT reasons only (a program raised, an announce was
+        refused) — the reprobe window elapsed.  Without the timer, one
+        bad execution would degrade every method on this process
+        forever under stable membership; membership reasons stay
+        epoch-gated (a dead member does not resurrect by waiting)."""
+        return self._health.usable()
 
     def health(self) -> dict:
-        with self._health_lock:
-            return {"down": self._down, "reason": self._down_reason,
-                    "down_epoch": self._down_epoch}
+        from ..ici import plane_health as _ph
+        snap = self._health.snapshot()
+        return {"down": snap["state"] != _ph.UP,
+                "reason": snap["reason"],
+                "down_epoch": snap["down_epoch"]}
 
     # ---- screen --------------------------------------------------------
     def screen(self, subs, method_full_name: str, cntl, pchan=None) \
@@ -872,6 +864,7 @@ class CollectiveFanoutPlane:
             "dtype": str(getattr(operand, "dtype", "uint8")),
             "uuid": uuid, "cpid": cpid,
         }).encode()
+        from ..rpc import fault_injection as _fi
         timeout = _flags.get_flag("ici_fanout_xproc_timeout_s")
         deadline = _time.monotonic() + timeout
         waiters = []
@@ -888,6 +881,12 @@ class CollectiveFanoutPlane:
                         f"member pid {pid} has no control channel")
                 w = _AnnounceWaiter()
                 _announce_waiters_put(uuid, pid, w)
+                plan = _fi.fabric_active()
+                if plan is not None and plan.on_collective_announce():
+                    # injected black-hole: the member never sees the
+                    # announce — the waiter times out below (R_ANNOUNCE)
+                    waiters.append((pid, w))
+                    continue
                 try:
                     send(_fab._F_COLL_CALL, body)
                 except OSError as e:

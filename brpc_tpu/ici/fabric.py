@@ -36,7 +36,6 @@ import ctypes
 import itertools
 import json
 import os as _os
-import random
 import socket as _pysocket
 import struct
 import threading
@@ -49,8 +48,10 @@ from ..butil import logging as log
 from ..butil.iobuf import IOBuf, IOPortal, DEVICE
 from ..rpc import errors
 from ..rpc import fault_injection as _fi
+from ..rpc import rpc_dump as _rdump
 from ..rpc.socket import Socket
 from . import device_plane as _dp
+from . import plane_health as _ph
 from . import route as _route
 from .transport import CreditWindow, OrderedDelivery
 
@@ -108,7 +109,7 @@ _flags.define_flag("ici_bulk_claim_timeout_s", 60.0,
 # slot-descriptor rides the control channel (kinds 5/6 + stream
 # FRAME_DATA_SHM).  Death (segment kill, peer crash mid-slot, mapping
 # failure) degrades to the UDS/TCP bulk tier through the same PR-2
-# machinery and revives in the background (_F_SHM_REESTABLISH).
+# machinery and revives in the background (the shm revival handshake).
 _flags.define_flag("ici_fabric_shm", True,
                    "same-host fabric pairs add the mmap ring bulk tier "
                    "(False: UDS/TCP bulk only)")
@@ -224,11 +225,11 @@ _F_PULLED = 6      # u64 uuid — receiver finished pulling (CQ completion)
 _F_FIN = 7
 # bulk-plane degradation + revival (self-healing; the control channel
 # stays the source of truth so every transition is ORDERED relative to
-# the descriptors that reference the bulk plane)
-_F_BULK_DOWN = 8          # sender observed bulk death; peer degrades too
-_F_BULK_REESTABLISH = 9   # json: {bulk_key} — client re-parked a conn
-_F_BULK_OK = 10           # server claimed + attached the re-parked conn
-_F_BULK_ERR = 11          # claim failed/refused; client backs off, retries
+# the descriptors that reference the bulk plane).  Consecutive ops:
+# DOWN (sender observed death; peer degrades too), REESTABLISH (json
+# {bulk_key} — client re-parked a conn), OK (server claimed + attached
+# it), ERR (claim failed/refused; client backs off, retries).
+_F_BULK_DOWN, _F_BULK_REESTABLISH, _F_BULK_OK, _F_BULK_ERR = 8, 9, 10, 11
 # connectionless liveness probe (rpc/health_check.py): answers whether a
 # server is listening at ici://target WITHOUT creating a fabric socket
 _F_PING = 12              # u32 target_dev
@@ -245,15 +246,20 @@ _F_GOODBYE = 15
 # a client-side send goes out with seq -1 in its kind-4 descriptor and
 # receives its assignment in this frame (u64 uuid, i64 seq)
 _F_DPLANE_SEQ = 16
-# shm ring degradation + revival (mirrors _F_BULK_*): the control
-# channel stays the source of truth so every transition is ORDERED
-# relative to the kind-5/6 and FRAME_DATA_SHM descriptors that
+# shm ring degradation + revival (mirrors the bulk row above, same
+# consecutive DOWN/REESTABLISH/OK/ERR ops — REESTABLISH carries json
+# {shm_seg}, a fresh segment for the server to attach + unlink): the
+# control channel stays the source of truth so every transition is
+# ORDERED relative to the kind-5/6 and FRAME_DATA_SHM descriptors that
 # reference the ring.  Older peers ignore unknown frame types.
-_F_SHM_DOWN = 17          # sender observed ring death; peer degrades too
-_F_SHM_REESTABLISH = 18   # json: {shm_seg, shm_bytes} — client created
-                          # a fresh segment for the server to attach
-_F_SHM_OK = 19            # server attached (and unlinked) the segment
-_F_SHM_ERR = 20           # attach failed/refused; client backs off
+_F_SHM_DOWN, _F_SHM_REESTABLISH, _F_SHM_OK, _F_SHM_ERR = 17, 18, 19, 20
+# read-loop dispatch for the two self-healing planes rides ONE table
+# (op index = ftype - the plane's DOWN base, relying on the consecutive
+# numbering above): {ftype: (plane, op)} with op 0..3 =
+# down/reestablish/ok/err — see FabricSocket._on_plane_frame
+_PLANE_FRAMES = {b + i: (w, i)
+                 for w, b in (("bulk", _F_BULK_DOWN), ("shm", _F_SHM_DOWN))
+                 for i in range(4)}
 # Compiled collective fan-out announce (channels/collective_fanout.py):
 # the fan-out client is the order master — it commits a fan-out group at
 # a dense seq and announces it over each remote member's control channel
@@ -804,7 +810,7 @@ class FabricNode:
     def create_shm_segment(self) -> Tuple[int, Optional[str], object]:
         """Create a fresh ring segment as the dialing side: (handle,
         name, lib); (0, None, None) when shm is unavailable.  The name
-        rides the control channel (HELLO or _F_SHM_REESTABLISH); the
+        rides the control channel (HELLO or the shm revival frame); the
         ATTACHING side unlinks after mapping, so the /dev/shm entry
         lives only for the handshake round trip."""
         if not self._shm_ok or self._shm_lib is None:
@@ -1096,6 +1102,9 @@ class CollectiveSequencer:
         _dp.plane().annotate_transfer(
             t, "seq admit queue_wait_us="
                f"{(time.monotonic_ns() - t.posted_ns) // 1000}")
+        plan = _fi.fabric_active()
+        if plan is not None:
+            plan.on_plane_op(sock, "device")    # SLOW chaos injector
         try:
             if _dp.xproc_compiled_ok():
                 _dp.plane().execute_remote(t)
@@ -1126,20 +1135,22 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
     """Cross-process ici socket: control TCP + transfer-server pulls,
     with the same credit window as the in-process IciSocket."""
 
-    # fablint guarded-state contract: the bulk-plane handle swap and
-    # revival flags commute under _bulk_lock (the PR-2 review-finding
-    # class), staging under _staged_lock, inbox + credit batching under
-    # _inbox_lock, device-plane latch/executors under _dplane_lock.
+    # fablint guarded-state contract: the bulk-plane handle swap
+    # commutes under _bulk_lock (the PR-2 review-finding class),
+    # staging under _staged_lock, inbox + credit batching under
+    # _inbox_lock, device-plane executors under _dplane_lock.
     # The cumulative bulk byte counters are written by concurrent
     # writer threads (multiple streams share one socket) and so live
-    # under _bulk_lock too.
+    # under _bulk_lock too.  Health/revival STATE (down flags, revival
+    # wanted/running, the device re-probe latch) lives in the per-plane
+    # PlaneHealth records (ici/plane_health.py, its own guard map) —
+    # the bulk/shm records share _bulk_lock and the device record
+    # shares _dplane_lock, so the old commute guarantees still hold.
     _GUARDED_BY = {
         "_bulk": "_bulk_lock",
         "_blib": "_bulk_lock",
         "_bulk_epoch": "_bulk_lock",
         "_reestab_pending": "_bulk_lock",
-        "_reestab_running": "_bulk_lock",
-        "_reestab_wanted": "_bulk_lock",
         "bulk_bytes_sent": "_bulk_lock",
         "bulk_bytes_claimed": "_bulk_lock",
         "_shm": "_bulk_lock",
@@ -1150,15 +1161,12 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         "_shm_stripes": "_bulk_lock",
         "_shm_dead_stripes": "_bulk_lock",
         "_shm_reestab_pending": "_bulk_lock",
-        "_shm_reestab_running": "_bulk_lock",
-        "_shm_reestab_wanted": "_bulk_lock",
         "shm_bytes_sent": "_bulk_lock",
         "shm_bytes_claimed": "_bulk_lock",
         "_staged": "_staged_lock",
         "_inbox": "_inbox_lock",
         "_consumed_unacked": "_inbox_lock",
         "_dplane_seq": "_dplane_lock",
-        "_dplane_down_until": "_dplane_lock",
         "_dplane_closed": "_dplane_lock",
     }
 
@@ -1208,13 +1216,6 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._reestab_pending: Optional[Tuple] = None   # (lib, handle)
         self._reestab_ok = False
         self._reestab_evt = threading.Event()
-        # revival-loop ownership, both guarded by _bulk_lock: `running`
-        # is cleared by the loop ATOMICALLY with its decision to exit
-        # (is_alive() would race the thread's last instructions), and
-        # `wanted` records a degrade that arrived while a loop was
-        # already up so it keeps going instead of exiting
-        self._reestab_running = False
-        self._reestab_wanted = False
         # shm ring tier (same-host peers; bound only after BOTH ends
         # acked the segment at handshake).  Shares _bulk_lock: the two
         # bulk planes' handle swaps commute under one lock and every
@@ -1236,8 +1237,6 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._shm_reestab_pending: Optional[Tuple] = None  # (lib, h, name)
         self._shm_reestab_ok = False
         self._shm_reestab_evt = threading.Event()
-        self._shm_reestab_running = False
-        self._shm_reestab_wanted = False
         # kind-1 transfer-server staging needs the module on BOTH ends:
         # ours to stage, the peer's to pull.  A peer whose jax build
         # lacks jax.experimental.transfer publishes no "xfer" contact —
@@ -1257,12 +1256,54 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         self._dplane_peer = \
             node.peer_info(peer_pid).get("dplane3", 0) >= 3
         self._dplane_lock = _dbg.make_lock("FabricSocket._dplane_lock")
-        self._dplane_down_until = 0.0      # 0 = up; else re-probe deadline
         self._dplane_seq: Optional[CollectiveSequencer] = None   # lazy
         self._dplane_closed = False
         self.dplane_bytes_sent = 0         # cumulative, for tests/builtin
         self.dplane_bytes_recv = 0
         self.dplane_fallbacks = 0
+        # ---- plane-health records (ici/plane_health.py) ----------------
+        # ONE shared engine owns every plane's UP/DOWN/REESTABLISHING
+        # bookkeeping, revival policy, and the unified
+        # rpc_fabric_plane_* counters; this socket keeps only the
+        # MECHANICS (dial, handshake payloads, teardown, native alive
+        # probes).  bulk/shm records share _bulk_lock with the handle
+        # swap — the instant-death suppression needs health flags and
+        # handles deciding under ONE lock hold — and the device record
+        # shares _dplane_lock with the sequencer state.
+        def _gone():
+            return self.failed or self._peer_gone()
+
+        self._plane_bulk = _ph.register_plane(
+            "bulk", self._bulk_lock,
+            probe=lambda n: bool(self._bulk_alive()),
+            gate=lambda: not (self.is_server_side or _gone()),
+            prober=self._bulk_revive_attempt,
+            attached=lambda: bool(self._bulk),
+            dead=_gone,
+            thread_name="fabric_bulk_revive",
+            seed=self.id ^ 0x5DEECE66D)
+        self._plane_shm = _ph.register_plane(
+            "shm", self._bulk_lock,
+            probe=self.shm_route_usable,
+            gate=lambda: not (self.is_server_side or _gone()
+                              or not self._shm_peer),
+            prober=self._shm_revive_attempt,
+            attached=lambda: bool(self._shm),
+            dead=_gone,
+            thread_name="fabric_shm_revive",
+            seed=self.id ^ 0x73686D)
+        self._plane_device = _ph.register_plane(
+            "device", self._dplane_lock,
+            retry_s=lambda: _flags.get_flag("ici_device_plane_retry_s"),
+            on_reprobe=lambda: log.info(
+                "fabric %s: device plane re-probing", self.remote_side))
+        self._plane_xfer = _ph.register_plane(
+            "xfer", _dbg.make_lock("FabricSocket._xfer_plane_lock"),
+            probe=lambda n: self._xfer_usable,
+            retry_s=lambda: _flags.get_flag("ici_device_plane_retry_s"))
+        self._planes = {"bulk": self._plane_bulk, "shm": self._plane_shm,
+                        "device": self._plane_device,
+                        "xfer": self._plane_xfer}
 
     def _attach_bulk(self, lib, handle: int) -> None:
         """Bind the native bulk data-plane connection (both ends hold one
@@ -1285,16 +1326,24 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             plan = _fi.fabric_active()
             if plan is not None:
                 plan.on_bulk_attach(self, lib, handle)
+            # an INITIAL attach finds the record UP and counts nothing;
+            # a re-attach flips DOWN/REESTABLISHING back to UP and arms
+            # the breaker's half-open ramp
+            self._plane_bulk.revived()
 
     # ---- bulk-plane degradation + revival ------------------------------
     # Bulk death with a LIVE control channel no longer kills the socket:
     # the handle is dropped (writers route inline / via the transfer
-    # server from the next frame on), the peer is told via _F_BULK_DOWN,
-    # and the client side re-establishes in the background with
-    # exponential backoff + jitter — a fresh parked conn bound through
-    # the _F_BULK_REESTABLISH handshake on the control channel, whose
-    # serial ordering guarantees no descriptor can reference the new
-    # conn before both ends attached it.
+    # server from the next frame on), the peer is told via the plane
+    # down-notify frame, and the client side re-establishes in the
+    # background.  The STATE machine — down/reestablishing flags,
+    # exponential backoff + jitter, instant-death suppression, the
+    # unified counters — lives in the shared PlaneHealth engine
+    # (ici/plane_health.py); this socket supplies the MECHANICS: one
+    # dial-and-handshake attempt (_bulk_revive_attempt) whose fresh
+    # parked conn is bound through the revival handshake on the control
+    # channel, whose serial ordering guarantees no descriptor can
+    # reference the new conn before both ends attached it.
 
     def bulk_epoch(self) -> int:
         with self._bulk_lock:
@@ -1336,87 +1385,54 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             lib.brpc_tpu_fab_conn_close(h)
         log.warning("fabric %s: bulk plane down (%s) — inline fallback "
                     "engaged", self.remote_side, reason)
-        if notify and not self._peer_gone():
-            try:
-                self._ctrl_send(_F_BULK_DOWN, b"")
-            except OSError:
-                pass
-        self._kick_bulk_reestablish()
+        self._plane_bulk.mark_down(reason)
+        if notify:
+            self._plane_notify_down("bulk")
+        # client side only (the engine's gate enforces it): ensure a
+        # revival loop is running, at most one at a time
+        self._plane_bulk.kick()
 
-    def _kick_bulk_reestablish(self) -> None:
-        """Client side only (the end that dialed originally): ensure a
-        re-dial loop is running, at most one at a time.  `wanted` and
-        `running` are decided under ONE lock hold so a kick can never
-        land in the gap where a finishing loop has decided to exit but
-        is_alive() would still read True — that gap used to suppress
-        revival forever when a freshly attached conn died instantly."""
-        if self.is_server_side or self.failed or self._peer_gone():
+    def _plane_notify_down(self, which: str) -> None:
+        """Tell the peer the plane died so it degrades too; the
+        receiving side degrades with notify=False (no echo ping-pong)."""
+        if self._peer_gone():
             return
-        with self._bulk_lock:
-            self._reestab_wanted = True
-            if self._reestab_running:
-                return           # the live loop will observe `wanted`
-            self._reestab_running = True
-        # fablint: thread-quiesced(self-terminating: exits on attach, socket failure or peer gone; _close_bulk sets _reestab_evt to unblock a parked wait)
-        threading.Thread(target=self._bulk_reestablish_loop,
-                         name="fabric_bulk_revive", daemon=True).start()
+        try:
+            self._ctrl_send(
+                _F_BULK_DOWN if which == "bulk" else _F_SHM_DOWN, b"")
+        except OSError:
+            pass
 
-    def _bulk_reestablish_loop(self) -> None:
-        rng = random.Random(self.id ^ 0x5DEECE66D)
-        delay = 0.05
-        while True:
-            if self.failed or self._peer_gone():
-                with self._bulk_lock:
-                    self._reestab_running = False
-                return
-            with self._bulk_lock:
-                if self._bulk or not self._reestab_wanted:
-                    # attached (or request consumed): exit — atomically
-                    # with clearing `running`, so a racing kick either
-                    # saw running=True before this point (and set
-                    # `wanted`, keeping us looping) or spawns a new loop
-                    self._reestab_wanted = False
-                    self._reestab_running = False
-                    return
-            # backoff BEFORE each attempt (first one included): the plane
-            # just died, and frames sent in the gap ride the inline path
-            # anyway — dialing in the same instant the peer is tearing
-            # down mostly burns a connection
-            time.sleep(delay * (1.0 + 0.25 * rng.random()))
-            delay = min(delay * 2, 1.0)
-            with self._bulk_lock:
-                if self._bulk:
-                    continue            # re-attached while we slept
-            if self.failed or self._peer_gone():
-                continue                # exit via the top-of-loop path
-            h, key, lib, is_uds = self.node.dial_bulk(self.peer_pid)
-            if h:
-                self._bulk_is_uds = is_uds
-                self._reestab_evt.clear()
-                self._reestab_ok = False
-                with self._bulk_lock:
-                    self._reestab_pending = (lib, h)
-                try:
-                    self._ctrl_send(_F_BULK_REESTABLISH,
-                                    json.dumps({"bulk_key": key}).encode())
-                    ok = self._reestab_evt.wait(5.0) and self._reestab_ok
-                except OSError:
-                    ok = False
-                if ok:
-                    log.info("fabric %s: bulk plane re-established "
-                             "(epoch %d)", self.remote_side,
-                             self.bulk_epoch())
-                    continue    # exit via the top-of-loop check, which
-                    # clears `running` atomically — and keeps looping
-                    # instead if the fresh conn already died (a degrade
-                    # re-set `wanted` in the meantime)
-                # timed out / refused: reclaim the pending handle unless
-                # the read loop already attached it
-                with self._bulk_lock:
-                    pending, self._reestab_pending = \
-                        self._reestab_pending, None
-                if pending is not None:
-                    lib.brpc_tpu_fab_conn_close(h)
+    def _bulk_revive_attempt(self) -> bool:
+        """ONE re-dial + handshake attempt, run by the engine's backoff
+        loop: dial a fresh conn, park it pending, and ask the server to
+        claim it; the attach itself happens on the read loop
+        (_on_bulk_reply) so descriptor ordering holds."""
+        h, key, lib, is_uds = self.node.dial_bulk(self.peer_pid)
+        if not h:
+            return False
+        self._bulk_is_uds = is_uds
+        self._reestab_evt.clear()
+        self._reestab_ok = False
+        with self._bulk_lock:
+            self._reestab_pending = (lib, h)
+        try:
+            self._ctrl_send(_F_BULK_REESTABLISH,
+                            json.dumps({"bulk_key": key}).encode())
+            ok = self._reestab_evt.wait(5.0) and self._reestab_ok
+        except OSError:
+            ok = False
+        if ok:
+            log.info("fabric %s: bulk plane re-established (epoch %d)",
+                     self.remote_side, self.bulk_epoch())
+            return True
+        # timed out / refused: reclaim the pending handle unless the
+        # read loop already attached it
+        with self._bulk_lock:
+            pending, self._reestab_pending = self._reestab_pending, None
+        if pending is not None:
+            lib.brpc_tpu_fab_conn_close(h)
+        return False
 
     def _on_bulk_reestablish(self, req: dict) -> None:
         """Server side: claim the conn the client re-parked on our bulk
@@ -1457,11 +1473,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
     # ---- shm ring tier: attach / degrade / revive ----------------------
     # Mirrors the bulk-plane self-healing above: ring death with a live
     # control channel degrades to the socket bulk tier (route table),
-    # the peer is told via _F_SHM_DOWN, and the CLIENT side (the end
-    # that created the original segment) re-creates one in the
-    # background, bound through the _F_SHM_REESTABLISH handshake whose
-    # serial control ordering guarantees no kind-5/6 descriptor can
-    # reference the new ring before both ends attached it.
+    # the peer is told via the plane down-notify frame, and the CLIENT
+    # side (the end that created the original segment) re-creates one
+    # in the background — the same shared PlaneHealth engine drives the
+    # state/backoff, this socket supplies one create-and-handshake
+    # attempt (_shm_revive_attempt) whose serial control ordering
+    # guarantees no kind-5/6 descriptor can reference the new ring
+    # before both ends attached it.
 
     def _attach_shm(self, lib, handle: int) -> None:
         """Bind the shm ring pair (0 = no shm tier).  Re-attachment
@@ -1489,6 +1507,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             plan = _fi.fabric_active()
             if plan is not None:
                 plan.on_shm_attach(self, lib, handle)
+            # initial attach: no-op (record UP); re-attach: revival
+            self._plane_shm.revived()
 
     def shm_bound(self) -> bool:
         with self._bulk_lock:
@@ -1537,8 +1557,8 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 # closed): descriptors already flushed — or batched and
                 # about to flush — reference bytes that are PUBLISHED
                 # and parked in it, and the serial control channel may
-                # deliver them to us after the _F_SHM_DOWN that caused
-                # this call.  Closing here would strand those claims
+                # deliver them to us after the shm down-notify that
+                # caused this call.  Closing here would strand those claims
                 # (rc -2) and kill their streams even though every byte
                 # is sitting in the mapping.  Bounded at one retired
                 # ring: a second death closes the first.
@@ -1554,72 +1574,41 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                 lib.brpc_tpu_shm_close(old_dead)
         log.warning("fabric %s: shm ring down (%s) — socket bulk tier "
                     "engaged", self.remote_side, reason)
-        if notify and not self._peer_gone():
-            try:
-                self._ctrl_send(_F_SHM_DOWN, b"")
-            except OSError:
-                pass
-        self._kick_shm_reestablish()
+        self._plane_shm.mark_down(reason)
+        if notify:
+            self._plane_notify_down("shm")
+        # client side only (the end that created the original segment;
+        # the engine's gate enforces it): ensure one revival loop
+        self._plane_shm.kick()
 
-    def _kick_shm_reestablish(self) -> None:
-        """Client side only (the end that created the original segment):
-        ensure one re-create loop is running — the same wanted/running
-        single-lock-hold discipline as _kick_bulk_reestablish."""
-        if self.is_server_side or self.failed or self._peer_gone() \
-                or not self._shm_peer:
-            return
+    def _shm_revive_attempt(self) -> bool:
+        """ONE re-create + handshake attempt, run by the engine's
+        backoff loop: create a fresh segment, park it pending, and ask
+        the server to attach it; our own attach happens on the read
+        loop (_on_shm_reply) so descriptor ordering holds."""
+        h, name, lib = self.node.create_shm_segment()
+        if not h:
+            return False
+        self._shm_reestab_evt.clear()
+        self._shm_reestab_ok = False
         with self._bulk_lock:
-            self._shm_reestab_wanted = True
-            if self._shm_reestab_running:
-                return           # the live loop will observe `wanted`
-            self._shm_reestab_running = True
-        # fablint: thread-quiesced(self-terminating: exits on attach, socket failure or peer gone; _close_shm sets _shm_reestab_evt to unblock a parked wait)
-        threading.Thread(target=self._shm_reestablish_loop,
-                         name="fabric_shm_revive", daemon=True).start()
-
-    def _shm_reestablish_loop(self) -> None:
-        rng = random.Random(self.id ^ 0x73686D)
-        delay = 0.05
-        while True:
-            if self.failed or self._peer_gone():
-                with self._bulk_lock:
-                    self._shm_reestab_running = False
-                return
-            with self._bulk_lock:
-                if self._shm or not self._shm_reestab_wanted:
-                    self._shm_reestab_wanted = False
-                    self._shm_reestab_running = False
-                    return
-            time.sleep(delay * (1.0 + 0.25 * rng.random()))
-            delay = min(delay * 2, 1.0)
-            with self._bulk_lock:
-                if self._shm:
-                    continue            # re-attached while we slept
-            if self.failed or self._peer_gone():
-                continue                # exit via the top-of-loop path
-            h, name, lib = self.node.create_shm_segment()
-            if h:
-                self._shm_reestab_evt.clear()
-                self._shm_reestab_ok = False
-                with self._bulk_lock:
-                    self._shm_reestab_pending = (lib, h, name)
-                try:
-                    self._ctrl_send(_F_SHM_REESTABLISH,
-                                    json.dumps({"shm_seg": name}).encode())
-                    ok = self._shm_reestab_evt.wait(5.0) \
-                        and self._shm_reestab_ok
-                except OSError:
-                    ok = False
-                if ok:
-                    log.info("fabric %s: shm ring re-established "
-                             "(epoch %d)", self.remote_side,
-                             self.shm_epoch())
-                    continue    # exit via the top-of-loop check
-                with self._bulk_lock:
-                    pending, self._shm_reestab_pending = \
-                        self._shm_reestab_pending, None
-                if pending is not None:
-                    self.node.drop_shm_segment(pending[1], pending[2])
+            self._shm_reestab_pending = (lib, h, name)
+        try:
+            self._ctrl_send(_F_SHM_REESTABLISH,
+                            json.dumps({"shm_seg": name}).encode())
+            ok = self._shm_reestab_evt.wait(5.0) and self._shm_reestab_ok
+        except OSError:
+            ok = False
+        if ok:
+            log.info("fabric %s: shm ring re-established (epoch %d)",
+                     self.remote_side, self.shm_epoch())
+            return True
+        with self._bulk_lock:
+            pending, self._shm_reestab_pending = \
+                self._shm_reestab_pending, None
+        if pending is not None:
+            self.node.drop_shm_segment(pending[1], pending[2])
+        return False
 
     def _on_shm_reestablish(self, req: dict) -> None:
         """Server side: attach the fresh segment the client created;
@@ -1656,6 +1645,33 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             ok = False
         self._shm_reestab_ok = ok and pending is not None
         self._shm_reestab_evt.set()
+
+    def _on_plane_frame(self, which: str, op: int, body: bytes) -> None:
+        """One read-loop dispatch row for both self-healing planes
+        (_PLANE_FRAMES).  op 0: the peer observed the plane's death
+        first — degrade without echoing (no notify ping-pong); the
+        client side starts revival.  op 1: the client parked/created a
+        fresh plane — the server attaches it HERE on the read loop, so
+        the attach is ordered BEFORE any descriptor that will use it.
+        op 2/3: the server's ok/err reply to our pending attempt."""
+        if op == 0:
+            if which == "bulk":
+                self._bulk_plane_down(f"peer reported {which} death",
+                                      notify=False)
+            else:
+                self._shm_plane_down(f"peer reported {which} death",
+                                     notify=False)
+        elif op == 1:
+            req = json.loads(body)
+            if which == "bulk":
+                self._on_bulk_reestablish(req)
+            else:
+                self._on_shm_reestablish(req)
+        else:
+            if which == "bulk":
+                self._on_bulk_reply(op == 2)
+            else:
+                self._on_shm_reply(op == 2)
 
     def _close_shm(self) -> None:
         """Socket-level teardown of the shm tier (no revival).  Claimed
@@ -1721,14 +1737,9 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
             return False
         if not _dp.xproc_compiled_ok() and not self._bulk_alive():
             return False       # bulk-carried leg needs a live bulk plane
-        with self._dplane_lock:
-            if self._dplane_down_until:
-                if time.monotonic() < self._dplane_down_until:
-                    return False
-                self._dplane_down_until = 0.0     # re-probe window
-                log.info("fabric %s: device plane re-probing",
-                         self.remote_side)
-        return True
+        # the down-latch + lapsed-latch re-probe is the engine's
+        # timer policy (the record shares _dplane_lock)
+        return self._plane_device.usable(nbytes)
 
     def _dplane_sequencer(self) -> Optional["CollectiveSequencer"]:
         """The socket's (lazily created) collective sequencer; None after
@@ -1753,16 +1764,16 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
 
     def _device_plane_down(self, reason: str) -> None:
         """Degrade: device payloads ride the PR-2 bulk/inline machinery
-        from the next frame until the re-probe deadline lapses."""
-        retry = _flags.get_flag("ici_device_plane_retry_s")
-        with self._dplane_lock:
-            already = self._dplane_down_until > time.monotonic()
-            self._dplane_down_until = time.monotonic() + retry
+        from the next frame until the re-probe deadline lapses (the
+        engine's timer policy re-arms the deadline on repeat failures
+        while counting/logging only the actual transition)."""
+        first = self._plane_device.mark_down(reason)
         self.dplane_fallbacks += 1
-        if not already:
+        if first:
             log.warning("fabric %s: device plane down (%s) — bulk/inline "
                         "fallback engaged, re-probe in %.1fs",
-                        self.remote_side, reason, retry)
+                        self.remote_side, reason,
+                        _flags.get_flag("ici_device_plane_retry_s"))
 
     def _dplane_execute_bulk(self, t) -> None:
         """The bulk-carried xproc leg: this backend has no compiled
@@ -1815,6 +1826,31 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         with self._dplane_lock:
             seqr = self._dplane_seq
         return None if seqr is None else seqr.describe()
+
+    # ---- the route table's plane-health gate ---------------------------
+    def plane_usable(self, plane: str, nbytes: int = 0) -> bool:
+        """ONE health/capability gate for route.candidates(): engine
+        state first (a down plane is skipped without probing; a lapsed
+        timer latch re-probes), then the plane's own capability probe
+        (ring fit, native alive check, xfer contact)."""
+        rec = self._planes.get(plane)
+        return rec is not None and rec.usable(nbytes)
+
+    def _xfer_plane_down(self, reason: str) -> None:
+        """Degrade the transfer-server route (today only chaos plans
+        refusing a stage drive this): the xfer record rides the same
+        timer-latch revival as the device plane, so a refused stage
+        falls through in-frame and the route returns after the
+        re-probe window."""
+        if self._plane_xfer.mark_down(reason):
+            log.warning("fabric %s: xfer plane down (%s) — inline "
+                        "fallback engaged", self.remote_side, reason)
+
+    def describe_planes(self) -> dict:
+        """Per-plane health snapshots for the /ici builtin ``planes``
+        block (state/reason/down_epoch/reprobe_in per plane)."""
+        return {name: rec.snapshot()
+                for name, rec in self._planes.items()}
 
     def start_io(self) -> None:
         self._reader = threading.Thread(target=self._read_loop,
@@ -1887,6 +1923,9 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     pass
                 raise ConnectionError("fabric control channel: "
                                       "injected sever")
+        if ftype in _PLANE_FRAMES and _rdump.dump_enabled():
+            # A/B parity seam: the plane-healing handshake, as sent
+            _rdump.maybe_dump_fabric_frame(self, "out", ftype, body)
         with self._conn_wlock:
             _send_frame(self._conn, ftype, body)
 
@@ -2053,6 +2092,13 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                                 pass
                         break
                     if rt == _route.XFER:
+                        plan = _fi.fabric_active()
+                        if plan is not None and plan.on_xfer_stage(self):
+                            # injected refusal: degrade the xfer record
+                            # and fall through IN-FRAME (nothing is
+                            # committed yet), like the planes above
+                            self._xfer_plane_down("injected stage refusal")
+                            continue
                         if not hasattr(arr, "devices"):
                             # forwarding a host-delivered numpy over an
                             # xfer-mode socket: the transfer server
@@ -2156,6 +2202,9 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
         the frame can never fit the ring (route elsewhere; the ring is
         healthy) and ConnectionError on death/timeout (degrade).  The
         uuid's top byte names the stripe (shm_tag_uuid)."""
+        plan = _fi.fabric_active()
+        if plan is not None:
+            plan.on_plane_op(self, "shm")      # SLOW chaos injector
         if isinstance(data, (bytes, bytearray)):
             ptr = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) \
                 if isinstance(data, bytearray) else \
@@ -2368,27 +2417,12 @@ class FabricSocket(CreditWindow, OrderedDelivery, Socket):
                     self._on_credits(struct.unpack("<Q", body)[0])
                 elif ftype == _F_PULLED:
                     self._on_pulled(struct.unpack("<Q", body)[0])
-                elif ftype == _F_BULK_DOWN:
-                    # peer observed bulk death first: degrade without
-                    # echoing (no notify ping-pong); the client side
-                    # starts revival
-                    self._bulk_plane_down("peer reported bulk death",
-                                          notify=False)
-                elif ftype == _F_BULK_REESTABLISH:
-                    self._on_bulk_reestablish(json.loads(body))
-                elif ftype == _F_BULK_OK:
-                    self._on_bulk_reply(True)
-                elif ftype == _F_BULK_ERR:
-                    self._on_bulk_reply(False)
-                elif ftype == _F_SHM_DOWN:
-                    self._shm_plane_down("peer reported shm death",
-                                         notify=False)
-                elif ftype == _F_SHM_REESTABLISH:
-                    self._on_shm_reestablish(json.loads(body))
-                elif ftype == _F_SHM_OK:
-                    self._on_shm_reply(True)
-                elif ftype == _F_SHM_ERR:
-                    self._on_shm_reply(False)
+                elif ftype in _PLANE_FRAMES:
+                    if _rdump.dump_enabled():
+                        _rdump.maybe_dump_fabric_frame(
+                            self, "in", ftype, body)
+                    which, op = _PLANE_FRAMES[ftype]
+                    self._on_plane_frame(which, op, body)
                 elif ftype == _F_GOODBYE:
                     self._on_goodbye()
                 elif ftype == _F_DPLANE_SEQ:

@@ -1,0 +1,372 @@
+"""ONE plane-health state machine for every data plane (ROADMAP item 1).
+
+Route *selection* was centralized in :mod:`ici.route` (the PR-9 table);
+the *robustness* half — degradation, re-probe, revival — stayed smeared
+across the planes: the fabric bulk and shm tiers each carried a private
+down/revival handshake and revival thread, the device plane a timer
+latch, and the collective fan-out its own degrade/reprobe/epoch machine.
+The reference delegates exactly this to one place: liveness is the
+health-checker's job, never the naming/selection layer's.
+
+This module is that one place.  A plane registers a :class:`PlaneHealth`
+record (``register_plane``) and keeps only its MECHANICS — dial,
+handshake payloads, teardown, the native alive probe.  The record owns:
+
+  * the state transitions ``UP -> DOWN(reason) -> REESTABLISHING -> UP``
+    and the one-transition-one-count discipline behind the unified
+    ``rpc_fabric_plane_<name>_{down,reprobe,revived,ramp}`` counter
+    family (ici/route.py);
+  * the revival policy — exactly one of three, selected by what the
+    plane registers:
+
+      ``prober``     threaded revival (fabric bulk/shm): a background
+                     loop with exponential backoff + seeded jitter calls
+                     the plane's one-attempt prober until the plane's
+                     attach path reports :meth:`revived`.  ``kick``
+                     decides ``wanted``/``running`` under ONE lock hold,
+                     so a kick can never land in the gap where a
+                     finishing loop has decided to exit but
+                     ``is_alive()`` would still read True — that gap
+                     used to suppress revival forever when a freshly
+                     attached plane died instantly;
+      ``retry_s``    timer latch (device/xfer planes): ``mark_down``
+                     arms a re-probe deadline; the first ``usable``
+                     after it lapses revives optimistically (the next
+                     failure re-latches);
+      ``epoch_fn``   epoch gate (collective fan-out): revival when the
+                     membership epoch moves past the one recorded at
+                     degrade — plus, for ``transient_reasons`` only, a
+                     ``reprobe_s`` timer (one bad execution must not
+                     degrade the route forever under stable membership);
+
+  * the circuit-breaker ramp: a revival arms ``half_open``; the first
+    ``usable`` verdict under real traffic closes it and counts ``ramp``
+    — "revived" is claimed by the handshake/timer, "ramped" only by
+    actual traffic clearing the gate again.
+
+The record's lock is SUPPLIED by the plane (``lock=``) so the health
+flags commute with the plane's own handle swap under one lock — the
+fabric socket passes its ``_bulk_lock``, which is what makes the
+instant-death suppression above airtight.  ``attached()`` therefore runs
+WITH that lock held; every other callback runs outside it.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from ..butil import debug_sync as _dbg
+from . import route as _route
+
+UP = "up"
+DOWN = "down"
+REESTABLISHING = "reestablishing"
+
+# revival channels reported to on_revive: the threaded prober's
+# handshake, a lapsed re-probe latch, or the membership epoch moving
+VIA_HANDSHAKE = "handshake"
+VIA_TIMER = "timer"
+VIA_EPOCH = "epoch"
+
+
+class PlaneHealth:
+    """One plane's health record — see the module docstring for the
+    split between state (here) and mechanics (the registering plane)."""
+
+    # fablint guarded-state contract: every mutable flag commutes under
+    # the plane-supplied lock (for the fabric planes that IS the
+    # socket's _bulk_lock / _dplane_lock, so health decisions and the
+    # handle swap serialize together).
+    _GUARDED_BY = {
+        "state": "_lock",
+        "reason": "_lock",
+        "down_at": "_lock",
+        "down_epoch": "_lock",
+        "down_until": "_lock",
+        "wanted": "_lock",
+        "running": "_lock",
+        "half_open": "_lock",
+        "probe_failures": "_lock",
+        "downs": "_lock",
+        "revivals": "_lock",
+    }
+
+    def __init__(self, name: str, lock, *,
+                 probe: Optional[Callable[[int], bool]] = None,
+                 gate: Optional[Callable[[], bool]] = None,
+                 prober: Optional[Callable[[], bool]] = None,
+                 attached: Optional[Callable[[], bool]] = None,
+                 dead: Optional[Callable[[], bool]] = None,
+                 on_down: Optional[Callable[[str], None]] = None,
+                 on_revive: Optional[Callable[[str, str], None]] = None,
+                 on_reprobe: Optional[Callable[[], None]] = None,
+                 events: Optional[Callable] = None,
+                 thread_name: str = "plane_revive",
+                 seed: int = 0,
+                 backoff_base: float = 0.05, backoff_cap: float = 1.0,
+                 retry_s: Optional[Callable[[], float]] = None,
+                 epoch_fn: Optional[Callable[[], int]] = None,
+                 transient_reasons: Tuple[str, ...] = (),
+                 reprobe_s: Optional[Callable[[], float]] = None):
+        self.name = name
+        self._lock = lock
+        self._probe = probe
+        self._gate = gate
+        self._prober = prober
+        self._attached = attached
+        self._dead = dead
+        self._on_down = on_down
+        self._on_revive = on_revive
+        self._on_reprobe = on_reprobe
+        self._events = events
+        self._thread_name = thread_name
+        self._seed = seed
+        self._backoff_base = backoff_base
+        self._backoff_cap = backoff_cap
+        self._retry_s = retry_s
+        self._epoch_fn = epoch_fn
+        self._transient_reasons = tuple(transient_reasons)
+        self._reprobe_s = reprobe_s
+        self.state = UP
+        self.reason = ""
+        self.down_at = 0.0
+        self.down_epoch = -1
+        self.down_until = 0.0        # timer policy: 0 = up
+        self.wanted = False          # threaded policy: revival requested
+        self.running = False         # threaded policy: one loop is up
+        self.half_open = False       # revived, not yet ramped by traffic
+        self.probe_failures = 0      # consecutive failed revival probes
+        self.downs = 0
+        self.revivals = 0
+
+    # ---- degrade -------------------------------------------------------
+    def mark_down(self, reason: str) -> bool:
+        """Record the DOWN transition.  Returns True when THIS call did
+        the transition (counters + callbacks fired); False when the
+        plane was already down (the timer policy still re-arms its
+        re-probe deadline, matching the old device-plane latch)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._retry_s is not None:
+                first = not self.down_until > now
+                self.down_until = now + float(self._retry_s())
+            else:
+                first = self.state == UP
+            self.reason = reason
+            if not first:
+                return False
+            self.state = DOWN
+            self.down_at = now
+            self.half_open = False
+            if self._epoch_fn is not None:
+                # under the lock, like the machine this replaces: the
+                # epoch recorded can never postdate a move that a racing
+                # usable() already revived on
+                self.down_epoch = self._epoch_fn()
+            self.downs += 1
+        _route.record_plane(self.name, "down")
+        if self._events is not None:
+            self._events("degraded", reason)
+        if self._on_down is not None:
+            self._on_down(reason)
+        return True
+
+    # ---- threaded revival (fabric bulk / shm) --------------------------
+    def kick(self) -> None:
+        """Ensure exactly one revival loop is running.  ``wanted`` and
+        ``running`` are decided under ONE lock hold — see the module
+        docstring for why that single hold is load-bearing."""
+        if self._prober is None:
+            return
+        if self._gate is not None and not self._gate():
+            return
+        with self._lock:
+            self.wanted = True
+            if self.running:
+                return           # the live loop will observe `wanted`
+            self.running = True
+            if self.state != UP:
+                self.state = REESTABLISHING
+        # fablint: thread-quiesced(self-terminating: exits on attach, plane teardown or peer gone; the owning plane's close path sets its handshake event to unblock a parked prober)
+        threading.Thread(target=self._revival_loop,
+                         name=self._thread_name, daemon=True).start()
+
+    def _revival_loop(self) -> None:
+        rng = random.Random(self._seed)
+        delay = self._backoff_base
+        while True:
+            if self._dead is not None and self._dead():
+                with self._lock:
+                    self.running = False
+                return
+            with self._lock:
+                if self._attached() or not self.wanted:
+                    # attached (or request consumed): exit — atomically
+                    # with clearing `running`, so a racing kick either
+                    # saw running=True before this point (and set
+                    # `wanted`, keeping us looping) or spawns a new loop
+                    self.wanted = False
+                    self.running = False
+                    return
+            # backoff BEFORE each attempt (first one included): the
+            # plane just died, and frames sent in the gap ride the
+            # fallback route anyway — probing in the same instant the
+            # peer is tearing down mostly burns a connection
+            time.sleep(delay * (1.0 + 0.25 * rng.random()))
+            delay = min(delay * 2, self._backoff_cap)
+            with self._lock:
+                if self._attached():
+                    continue            # re-attached while we slept
+            if self._dead is not None and self._dead():
+                continue                # exit via the top-of-loop path
+            _route.record_plane(self.name, "reprobe")
+            if not self._prober():
+                with self._lock:
+                    self.probe_failures += 1
+            # on success the plane's attach path called revived(); the
+            # top-of-loop check exits (clearing `running` atomically) —
+            # or keeps looping if the fresh plane already died and a
+            # degrade re-set `wanted` in the meantime
+
+    def revived(self) -> bool:
+        """The plane's attach path reports the plane healthy again.
+        Counts a revival only when the record was down (an INITIAL
+        attach is not a revival) and arms the breaker's half-open ramp
+        — the next ``usable`` verdict under real traffic closes it."""
+        with self._lock:
+            if self.state == UP:
+                return False
+            reason, self.reason = self.reason, ""
+            self.state = UP
+            self.down_until = 0.0
+            self.probe_failures = 0
+            self.half_open = True
+            self.revivals += 1
+        _route.record_plane(self.name, "revived")
+        if self._events is not None:
+            self._events("revived", reason)
+        if self._on_revive is not None:
+            self._on_revive(reason, VIA_HANDSHAKE)
+        return True
+
+    # ---- the route table's gate ----------------------------------------
+    def usable(self, nbytes: int = 0) -> bool:
+        """Gate one use of the plane (``route.candidates`` consults
+        exactly this).  UP runs the plane's own capability probe;
+        DOWN consults the revival policy; a threaded-revival plane
+        stays unusable until its prober's attach lands."""
+        with self._lock:
+            state = self.state
+            ramp = state == UP and self.half_open
+            if ramp:
+                self.half_open = False
+        if ramp:
+            _route.record_plane(self.name, "ramp")
+        if state != UP:
+            if self._prober is not None:
+                return False     # the revival loop owns the comeback
+            if self._retry_s is not None:
+                if not self._lapse():
+                    return False
+            elif self._epoch_fn is not None:
+                if not self._epoch_revive():
+                    return False
+            else:
+                return False
+        return self._probe(nbytes) if self._probe is not None else True
+
+    def _lapse(self) -> bool:
+        """Timer policy: revive when the re-probe deadline lapsed —
+        optimistic, the next failure re-latches."""
+        with self._lock:
+            if self.state == UP:
+                return True
+            if self.down_until and time.monotonic() < self.down_until:
+                return False
+            reason, self.reason = self.reason, ""
+            self.state = UP
+            self.down_until = 0.0
+            self.probe_failures = 0
+            self.half_open = True
+            self.revivals += 1
+        _route.record_plane(self.name, "reprobe")
+        _route.record_plane(self.name, "revived")
+        if self._on_reprobe is not None:
+            self._on_reprobe()
+        if self._events is not None:
+            self._events("revived", reason)
+        if self._on_revive is not None:
+            self._on_revive(reason, VIA_TIMER)
+        return True
+
+    def _epoch_revive(self) -> bool:
+        """Epoch policy: healthy, or down-but-revivable — the epoch
+        moved (a member re-advertised), or, for TRANSIENT reasons only,
+        the reprobe window elapsed.  Without the timer one bad
+        execution would degrade the route forever under stable
+        membership; membership reasons stay epoch-gated (a dead member
+        does not resurrect by waiting)."""
+        with self._lock:
+            if self.state == UP:
+                return True
+            down_epoch = self.down_epoch
+            transient_expired = (
+                self.reason in self._transient_reasons
+                and self._reprobe_s is not None
+                and time.monotonic() - self.down_at
+                >= float(self._reprobe_s()))
+        if not transient_expired and self._epoch_fn() <= down_epoch:
+            return False
+        with self._lock:
+            if self.state == UP:
+                return True
+            reason, self.reason = self.reason, ""
+            self.state = UP
+            self.probe_failures = 0
+            self.half_open = True
+            self.revivals += 1
+        _route.record_plane(self.name, "reprobe")
+        _route.record_plane(self.name, "revived")
+        if self._events is not None:
+            self._events("revived", reason)
+        if self._on_revive is not None:
+            self._on_revive(reason,
+                            VIA_TIMER if transient_expired else VIA_EPOCH)
+        return True
+
+    # ---- observability -------------------------------------------------
+    def snapshot(self) -> dict:
+        """The /ici ``planes`` block's per-plane row: state, reason,
+        the epoch recorded at degrade, seconds until the next re-probe
+        (timer policies), and the lifetime transition tallies."""
+        now = time.monotonic()
+        with self._lock:
+            out = {"state": self.state, "reason": self.reason,
+                   "down_epoch": self.down_epoch,
+                   "downs": self.downs, "revivals": self.revivals,
+                   "probe_failures": self.probe_failures,
+                   "half_open": self.half_open}
+            if self.down_until:
+                out["reprobe_in"] = round(
+                    max(0.0, self.down_until - now), 3)
+            elif (self.state != UP and self._reprobe_s is not None
+                    and self.reason in self._transient_reasons):
+                out["reprobe_in"] = round(max(
+                    0.0, self.down_at + float(self._reprobe_s()) - now), 3)
+        return out
+
+
+def register_plane(name: str, lock=None, **policy) -> PlaneHealth:
+    """Register one plane with the shared engine: returns its
+    :class:`PlaneHealth` record.  ``lock`` is the plane's own guard
+    (defaulted to a fresh debug-tracked lock); ``policy`` is the
+    keyword surface of :class:`PlaneHealth` — exactly one of
+    ``prober``/``retry_s``/``epoch_fn`` selects the revival policy,
+    ``probe``/``gate`` wire the capability checks, and the ``on_*`` /
+    ``events`` hooks keep logs and legacy counter families with the
+    registering plane."""
+    if lock is None:
+        lock = _dbg.make_lock(f"plane_health.{name}")
+    return PlaneHealth(name, lock, **policy)

@@ -72,11 +72,11 @@ def candidates(sock, cls: str, nbytes: int) -> List[str]:
         if nbytes < _flags.get_flag("ici_stream_bulk_threshold"):
             return [INLINE]
     out: List[str] = []
-    if sock.shm_route_usable(nbytes):
+    if sock.plane_usable(SHM, nbytes):
         out.append(SHM)
-    if sock._bulk_alive():
+    if sock.plane_usable(BULK, nbytes):
         out.append(BULK)
-    if cls == DEVICE and sock._xfer_usable:
+    if cls == DEVICE and sock.plane_usable(XFER, nbytes):
         out.append(XFER)
     out.append(INLINE)
     return out
@@ -129,6 +129,43 @@ def route_stats() -> dict:
         items = list(_counters.items())
     return {label: {"frames": f.get_value(), "bytes": b.get_value()}
             for label, (f, b) in items}
+
+
+# ---- the unified plane-health event family (ici/plane_health.py) -------
+#
+# One taxonomy for EVERY data plane's health transitions:
+# ``rpc_fabric_plane_<name>_<event>`` where event is ``down`` (UP ->
+# DOWN, counted once per transition), ``reprobe`` (one revival attempt
+# — a prober dial or a lapsed timer latch), ``revived`` (back UP), and
+# ``ramp`` (the breaker's half-open gate cleared by real traffic after
+# a revival).  Emitted ONLY by the PlaneHealth engine, so /vars shows
+# the same four verbs for bulk, shm, device, xfer, and collective.
+# Same publish-once/read-lock-free discipline as _counter_pair.
+
+_plane_events = {}
+
+
+def record_plane(name: str, event: str, n: int = 1) -> None:
+    """Count one plane-health event (``down``/``reprobe``/``revived``/
+    ``ramp``) for plane ``name``."""
+    label = f"{name}_{event}"
+    adder = _plane_events.get(label)
+    if adder is None:
+        with _counters_lock:
+            adder = _plane_events.get(label)
+            if adder is None:
+                from .. import bvar
+                adder = _plane_events[label] = bvar.Adder(
+                    name=f"rpc_fabric_plane_{label}")
+    adder << n
+
+
+def plane_stats() -> dict:
+    """Snapshot {``<plane>_<event>``: count} for /ici's ``planes``
+    block and the chaos-matrix assertions."""
+    with _counters_lock:
+        items = list(_plane_events.items())
+    return {label: a.get_value() for label, a in items}
 
 
 # ---- the COLLECTIVE route (channels/collective_fanout.py) --------------

@@ -371,6 +371,32 @@ def _ici(server, q):
     except Exception:
         pass
     try:
+        # unified plane health (ici/plane_health.py): per-socket
+        # state/reason/down_epoch/reprobe_in for bulk/shm/device/xfer,
+        # the collective plane's record, and the engine's event
+        # counters (rpc_fabric_plane_<name>_{down,reprobe,revived,ramp})
+        planes = {}
+        from ...ici.fabric import FabricSocket as _FS
+        from ..socket import list_sockets as _ls
+        socks = {}
+        for s in _ls():
+            if isinstance(s, _FS):
+                socks[str(s.remote_side)] = s.describe_planes()
+        if socks:
+            planes["sockets"] = socks
+        from ...channels import collective_fanout as _cfp
+        inst = _cfp.CollectiveFanoutPlane._instance
+        if inst is not None:
+            planes["collective"] = inst._health.snapshot()
+        from ...ici.route import plane_stats
+        ev = plane_stats()
+        if ev:
+            planes["events"] = ev
+        if planes:
+            out["planes"] = planes
+    except Exception:
+        pass
+    try:
         # compiled fan-out plane: health, entry order cursor, compile
         # cache, registered device-handler methods
         from ...channels import collective_fanout as _cf

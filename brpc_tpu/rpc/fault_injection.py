@@ -168,6 +168,19 @@ class FabricFaultPlan:
       collective_fail_execs       refuse the next N compiled fan-out
                                   executions regardless of participants
                                   (transient execution failure)
+      collective_drop_announces   silently swallow the next N fan-out
+                                  announces (black-hole: the member
+                                  never sees the call; the client times
+                                  out with R_ANNOUNCE and degrades the
+                                  collective route in-call)
+      xfer_refuse_stages          refuse the next N transfer-server
+                                  stages — the xfer route degrades
+                                  in-frame to inline before any
+                                  descriptor exists
+      plane_slow_ms               {plane: ms} SLOW injector — one
+                                  python-level sleep per op on that
+                                  plane ("slow, not dead": a correct
+                                  health machine must NOT degrade it)
 
     ``injected`` counts what actually fired, keyed by knob name."""
 
@@ -188,7 +201,10 @@ class FabricFaultPlan:
                  shm_drop_frames: int = 0,
                  refuse_shm_handshakes: int = 0,
                  collective_kill_device: Optional[int] = None,
-                 collective_fail_execs: int = 0):
+                 collective_fail_execs: int = 0,
+                 collective_drop_announces: int = 0,
+                 xfer_refuse_stages: int = 0,
+                 plane_slow_ms: Optional[dict] = None):
         self.match = match
         self.control_sever_after_frames = control_sever_after_frames
         self.control_drop_ratio = control_drop_ratio
@@ -206,6 +222,9 @@ class FabricFaultPlan:
         self._refuse_shm = refuse_shm_handshakes
         self.collective_kill_device = collective_kill_device
         self._fail_coll_execs = collective_fail_execs
+        self._drop_announces = collective_drop_announces
+        self._refuse_xfer = xfer_refuse_stages
+        self.plane_slow_ms = dict(plane_slow_ms or {})
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._ctrl_out = 0           # outbound control frames seen
@@ -213,7 +232,9 @@ class FabricFaultPlan:
         self.injected = {"control_sever": 0, "control_drop": 0,
                          "bulk_chaos": 0, "refuse_bulk": 0,
                          "refuse_hello": 0, "die": 0, "device_plane": 0,
-                         "shm_chaos": 0, "refuse_shm": 0, "collective": 0}
+                         "shm_chaos": 0, "refuse_shm": 0, "collective": 0,
+                         "coll_announce_drop": 0, "xfer": 0,
+                         "plane_slow": 0}
 
     def _matches(self, socket) -> bool:
         return self.match is None or bool(self.match(socket))
@@ -355,6 +376,79 @@ class FabricFaultPlan:
                 self.injected["refuse_hello"] += 1
                 return True
         return False
+
+    # -- plane-scoped hooks (the kill-every-plane matrix) ----------------
+    def on_xfer_stage(self, socket=None) -> bool:
+        """True → refuse this transfer-server stage (the xfer route
+        degrades in-frame to inline, before any descriptor exists)."""
+        if socket is not None and not self._matches(socket):
+            return False
+        with self._lock:
+            if self._refuse_xfer > 0:
+                self._refuse_xfer -= 1
+                self.injected["xfer"] += 1
+                return True
+        return False
+
+    def on_plane_op(self, socket, plane: str) -> None:
+        """SLOW injector: delay one operation on ``plane`` by
+        ``plane_slow_ms[plane]`` — the "slow, not dead" fault.  Traffic
+        completes late; a correct health machine must NOT degrade."""
+        ms = self.plane_slow_ms.get(plane, 0)
+        if not ms or (socket is not None and not self._matches(socket)):
+            return
+        with self._lock:
+            self.injected["plane_slow"] += 1
+        time.sleep(ms / 1000.0)
+
+    def on_collective_announce(self) -> bool:
+        """True → silently swallow this fan-out announce (black-hole):
+        the member never sees it; the client times out with R_ANNOUNCE
+        and degrades the collective route in-call."""
+        with self._lock:
+            if self._drop_announces > 0:
+                self._drop_announces -= 1
+                self.injected["coll_announce_drop"] += 1
+                return True
+        return False
+
+
+# ---- plane-scoped chaos verbs (the kill-every-plane matrix) ------------
+
+KILL = "kill"            # the plane dies NOW (sever / mark dead)
+BLACKHOLE = "blackhole"  # bytes vanish silently (received frames drop)
+SLOW = "slow"            # ops delayed, not dead — must NOT degrade
+
+
+def chaos_plane(sock, plane: str, mode: str, value: int = 0) -> bool:
+    """Apply one failure mode to a LIVE plane of one fabric socket,
+    mid-traffic — the chaos matrix's verb.  bulk/shm reach through the
+    native chaos entry points on the CURRENT handle (so the fault hits
+    the attached plane, not a future one); returns True when armed.
+    The shm ring has no native delay mode — SLOW there rides
+    ``plane_slow_ms`` via a FabricFaultPlan instead, and so do the
+    device/xfer/collective planes (their kill/black-hole shapes are
+    plan knobs: post/stage refusal, announce drops)."""
+    if plane not in ("bulk", "shm"):
+        return False
+    with sock._bulk_lock:
+        h = sock._bulk if plane == "bulk" else sock._shm
+        lib = sock._blib if plane == "bulk" else sock._shmlib
+    if not h or lib is None:
+        return False
+    fn = (lib.brpc_tpu_fab_chaos if plane == "bulk"
+          else lib.brpc_tpu_shm_chaos)
+    if mode == KILL:
+        fn(h, CHAOS_SEVER_NOW, 0)
+    elif mode == BLACKHOLE:
+        fn(h, CHAOS_DROP_FRAMES, value or 1_000_000)
+    elif mode == SLOW:
+        if plane == "shm":
+            return False
+        fn(h, CHAOS_DELAY_PARK_MS, value or 20)
+    else:
+        return False
+    return True
 
 
 _fabric_active: Optional[FabricFaultPlan] = None

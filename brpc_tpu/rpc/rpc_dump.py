@@ -85,6 +85,59 @@ def list_dump_files(directory: Optional[str] = None) -> List[str]:
                   if f.startswith("requests."))
 
 
+# ---- fabric plane-frame traces (the plane-health A/B parity seam) ------
+#
+# The plane-health refactor promises the bulk/shm revival handshakes
+# stay frame-for-frame identical on the wire.  That claim is PROVEN,
+# not assumed: when ``rpc_dump`` is on, every plane-healing control
+# frame a fabric socket sends or receives is appended (JSON lines) to
+# ``fabric_planes.trace`` under ``rpc_dump_dir``; the parity test
+# compares the recorded sequences against goldens.  The CALLER filters
+# to the eight self-healing frame types (never DATA/CREDIT), so the
+# hook costs one set-membership test per control frame when off.
+
+_FABRIC_TRACE_NAME = "fabric_planes.trace"
+_fab_trace_lock = threading.Lock()
+
+
+def maybe_dump_fabric_frame(sock, direction: str, ftype: int,
+                            body: bytes) -> bool:
+    """Append one fabric plane-healing control frame to the trace
+    (JSON line: socket id, direction "in"/"out", ftype, body hex)."""
+    if not dump_enabled():
+        return False
+    import json
+    rec = json.dumps({"sock": getattr(sock, "id", 0),
+                      "dir": direction, "ftype": ftype,
+                      "body": body.hex()})
+    d = _flags.get_flag("rpc_dump_dir")
+    with _fab_trace_lock:
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, _FABRIC_TRACE_NAME), "a") as f:
+                f.write(rec + "\n")
+        except OSError:
+            return False
+    return True
+
+
+def load_fabric_trace(directory: Optional[str] = None) -> List[dict]:
+    """Read the plane-frame trace back as dicts in wire order (empty
+    when no trace was recorded)."""
+    import json
+    d = directory or _flags.get_flag("rpc_dump_dir")
+    path = os.path.join(d, _FABRIC_TRACE_NAME)
+    if not os.path.isfile(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
 def load_dumped_frames(path: str) -> List[bytes]:
     """Split a dump file back into frames (parse by header sizes)."""
     from ..policy.tpu_std import MAGIC, HEADER_SIZE

@@ -43,6 +43,7 @@ from .. import bvar
 from ..butil import debug_sync as _dbg
 from ..butil import flags as _flags
 from ..butil.iobuf import DEVICE, IOBuf
+from ..ici import route as _route
 
 _flags.define_flag(
     "serving_kv_adopt", True,
@@ -219,19 +220,47 @@ class WireKvSource:
         self._starts = [0]
 
 
+def _load_route(sock, cls: str, nbytes: int) -> str:
+    """Adopt-vs-scatter through the SHARED route table (ISSUE 17) —
+    the payload class here is the same HOST/DEVICE split that orders
+    ``route.candidates()``, not a private kind ladder:
+
+      * DEVICE-class bytes always scatter (the D2H crossing is the
+        wire transfer itself, never a host copy pass);
+      * HOST-class bytes adopt in place, UNLESS the carrying socket is
+        known and its plane-health records say every descriptor plane
+        (shm, bulk) has left UP — then the load is recorded SCATTERED,
+        so the route-assertion surface never claims an in-place
+        adoption rode a healthy plane it didn't.  Custody is safe on
+        both labels (a retired ring keeps claimed slots alive until
+        the last ref dies); what the consultation changes is that the
+        counters tell the truth about plane state at load time.
+    """
+    if cls == _route.DEVICE:
+        return SCATTERED
+    if sock is not None and _route.SHM not in (
+            planes := _route.candidates(sock, _route.HOST, nbytes)) \
+            and _route.BULK not in planes:
+        return SCATTERED
+    return ADOPTED
+
+
 def wire_source(att: IOBuf, layers: int, seq_len: int,
-                dmodel: int) -> WireKvSource:
-    """Build the scatter source for one LoadKv attachment, routing by
-    what the attachment IS:
+                dmodel: int, sock=None) -> WireKvSource:
+    """Build the scatter source for one LoadKv attachment.  The VIEW
+    mechanics stay per-block (custody is what the attachment is); the
+    adopt-vs-scatter ROUTE comes from :func:`_load_route`, which asks
+    ``route.candidates()`` / plane-health when ``sock`` (the fabric
+    socket that carried the request) is supplied:
 
       * an untouched parked ``NativeAttachment`` → ``take_segments()``
-        (the custody exit that never builds IOBuf blocks) → scattered;
+        (the custody exit that never builds IOBuf blocks), DEVICE
+        class;
       * a plain IOBuf → zero-copy views per backing block: HOST/USER
         blocks (shm ring claims, bulk claims, inline bytes) viewed via
-        ``np.frombuffer`` → adopted; DEVICE blocks (loopback / an
-        already-materialized native view) via ``np.asarray`` →
-        scattered (the D2H crossing is the wire transfer itself, not a
-        host copy pass).
+        ``np.frombuffer``; DEVICE blocks (loopback / an
+        already-materialized native view) via ``np.asarray`` (the D2H
+        crossing is the wire transfer itself, not a host copy pass).
     """
     take = getattr(att, "take_segments", None)
     if take is not None and att.parked:
@@ -245,7 +274,9 @@ def wire_source(att: IOBuf, layers: int, seq_len: int,
             if view.shape[0] != nbytes:
                 view = view[:nbytes]
             segs.append(view)
-        return WireKvSource(segs, SCATTERED, layers, seq_len, dmodel)
+        return WireKvSource(
+            segs, _load_route(sock, _route.DEVICE, len(att)),
+            layers, seq_len, dmodel)
     segs = []
     dev = False
     for i in range(att.backing_block_num()):
@@ -268,14 +299,18 @@ def wire_source(att: IOBuf, layers: int, seq_len: int,
             seg = np.frombuffer(b.data, np.uint8)[
                 r.offset:r.offset + r.length]
         segs.append(seg)
-    return WireKvSource(segs, SCATTERED if dev else ADOPTED,
-                        layers, seq_len, dmodel)
+    return WireKvSource(
+        segs,
+        _load_route(sock, _route.DEVICE if dev else _route.HOST,
+                    len(att)),
+        layers, seq_len, dmodel)
 
 
 def load_wire_attachment(pool, att: IOBuf, session: str, seq_len: int,
                          layers: int, dmodel: int, *, last_token: int,
                          tenant: str = "",
-                         priority: Optional[int] = None):
+                         priority: Optional[int] = None,
+                         sock=None):
     """The whole zero-copy handoff in one call: build the source, let
     the pool reserve-and-fill (outside the pool lock by default since
     ISSUE 16, so concurrent LoadKv scatters proceed in parallel),
@@ -284,7 +319,7 @@ def load_wire_attachment(pool, att: IOBuf, session: str, seq_len: int,
     refusals (PoolSaturated / SessionBusy — the latter now also fired
     by the commit-time re-check when a raced loader's entry got
     pinned mid-fill) propagate for the RPC layer's shed mapping."""
-    src = wire_source(att, layers, seq_len, dmodel)
+    src = wire_source(att, layers, seq_len, dmodel, sock=sock)
     try:
         want = seq_len * layers * dmodel
         if src.total != want:

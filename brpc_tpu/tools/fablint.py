@@ -46,6 +46,18 @@ Passes (default command)
     This is the exact class behind the PR 2/4 exit-race flakes (static
     destructors racing live reader threads).
 
+``plane-state``
+    Per-plane health bookkeeping lives in ONE place
+    (``ici/plane_health.py``) since ISSUE 17.  Any module OTHER than
+    that file that (a) assigns a per-plane state field on ``self``/
+    ``cls`` — ``_reestab_wanted``/``_running`` (plain or ``_shm_``-
+    prefixed), ``_down``, ``_down_reason``, ``_down_epoch``,
+    ``_down_at``, or any ``*_down_until`` latch — or (b) spawns a
+    ``threading.Thread`` whose target name says revive/reestablish/
+    reprobe, is growing a FIFTH hand-rolled health machine; the fix is
+    ``plane_health.register_plane(...)`` with the plane keeping only
+    its mechanics (dial, handshake payload, teardown).
+
 Dead-code passes (``deadcode`` subcommand)
 ------------------------------------------
 
@@ -92,7 +104,7 @@ import tokenize
 from typing import Dict, List, Optional, Set, Tuple
 
 CONCURRENCY_RULES = ("guarded-state", "lock-order", "blocking-under-lock",
-                     "thread-hygiene", "bad-suppression")
+                     "thread-hygiene", "plane-state", "bad-suppression")
 DEADCODE_RULES = ("dead-import", "unreachable", "dead-global")
 
 # terminal callee names that can block the calling thread (pass 3).
@@ -106,6 +118,16 @@ _BLOCKING_NAMES = {
 _SUBPROCESS_NAMES = {"run", "Popen", "check_output", "check_call", "call"}
 
 _LOCKISH_RE = re.compile(r"lock|mutex", re.IGNORECASE)
+
+# pass 5 (plane-state): the field names the four pre-ISSUE-17 health
+# machines used — re-declaring one outside plane_health.py is the
+# signature of a new hand-rolled machine, and the revival-thread regex
+# catches the loop that always comes with it
+_PLANE_STATE_RE = re.compile(
+    r"^(?:_(?:shm_)?reestab_(?:wanted|running)|_down|_down_reason|"
+    r"_down_epoch|_down_at|\w*_down_until)$")
+_PLANE_THREAD_RE = re.compile(r"revive|reestab|reprobe", re.IGNORECASE)
+_PLANE_HEALTH_BASENAME = "plane_health.py"
 
 _DIRECTIVE_RE = re.compile(r"#\s*fablint:\s*(.*)$")
 _IGNORE_RE = re.compile(r"ignore\[([\w\-, ]+)\]\s*(.*)$")
@@ -416,11 +438,13 @@ class ModuleLint:
             if isinstance(node, ast.Attribute):
                 self._check_attr_access(node, held, class_name, fn_node,
                                         guard_exempt)
+                self._check_plane_state_attr(node)
             elif isinstance(node, ast.Name):
                 self._check_global_access(node, held, fn_node, guard_exempt)
             elif isinstance(node, ast.Call):
                 self._check_blocking(node, held)
                 self._check_thread_spawn(node)
+                self._check_plane_state_thread(node)
             elif isinstance(node, (ast.Lambda,)):
                 pass        # lambdas run later; their bodies are tiny and
                 # attribute checks inside would be against a reset held
@@ -595,6 +619,45 @@ class ModuleLint:
         return any(
             re.search(r"\b%s\s*\.\s*join\s*\(" % re.escape(nm), self.source)
             for nm in names)
+
+    # ---- pass 5: plane-state containment --------------------------------
+    def _check_plane_state_attr(self, node: ast.Attribute) -> None:
+        if os.path.basename(self.path) == _PLANE_HEALTH_BASENAME:
+            return
+        if not isinstance(node.ctx, (ast.Store, ast.Del)):
+            return
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id in ("self", "cls")):
+            return
+        if not _PLANE_STATE_RE.match(node.attr):
+            return
+        self._report(
+            "plane-state", node.lineno,
+            f"per-plane health state field '{node.attr}' declared outside "
+            f"ici/plane_health.py — register the plane with "
+            f"plane_health.register_plane() instead of growing a private "
+            f"down/reestablish machine")
+
+    def _check_plane_state_thread(self, node: ast.Call) -> None:
+        if os.path.basename(self.path) == _PLANE_HEALTH_BASENAME:
+            return
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name != "Thread":
+            return
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            tgt = v.attr if isinstance(v, ast.Attribute) else (
+                v.id if isinstance(v, ast.Name) else "")
+            if _PLANE_THREAD_RE.search(tgt):
+                self._report(
+                    "plane-state", node.lineno,
+                    f"revival thread (target '{tgt}') spawned outside "
+                    f"ici/plane_health.py — the engine owns every plane's "
+                    f"revival loop; planes supply only a prober callback")
 
     # ---- dead-code passes ----------------------------------------------
     def run_deadcode(self) -> None:

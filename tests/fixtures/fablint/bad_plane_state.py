@@ -1,0 +1,28 @@
+"""Seeded violation for plane-state containment (ISSUE 17): a plane
+class re-growing its own health machine — a private down-latch family
+plus a hand-rolled revival thread — instead of registering with
+``ici/plane_health.register_plane``.  Both halves of the rule must fire
+at their exact lines: the state-field declarations and the thread
+spawn."""
+import threading
+
+
+class RogueBulkPlane:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reestab_wanted = False    # line 14: plane-state (field)
+        self._down_reason = ""          # line 15: plane-state (field)
+
+    def degrade(self, reason: str) -> None:
+        with self._lock:
+            self._down_reason = reason  # line 19: plane-state (field)
+        t = threading.Thread(           # line 20: plane-state (thread)
+            target=self._revive_loop,
+            name="rogue_revive", daemon=True)
+        t.start()
+        t.join(0)
+
+    def _revive_loop(self) -> None:
+        with self._lock:
+            self._reestab_wanted = True  # line 28: plane-state (field)

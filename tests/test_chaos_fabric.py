@@ -259,6 +259,27 @@ class TestFaultPlanSemantics:
         assert plan.injected["refuse_bulk"] == 2
         assert plan.injected["refuse_hello"] == 1
 
+    def test_plane_scoped_budgets_are_exact(self):
+        """The kill-every-plane matrix's plan knobs: announce drops and
+        xfer-stage refusals are exact budgets, the SLOW injector delays
+        only the planes it names (and counts every delay)."""
+        plan = fi.FabricFaultPlan(collective_drop_announces=2,
+                                  xfer_refuse_stages=1,
+                                  plane_slow_ms={"shm": 20})
+        assert plan.on_collective_announce()
+        assert plan.on_collective_announce()
+        assert not plan.on_collective_announce()   # budget spent
+        assert plan.injected["coll_announce_drop"] == 2
+        assert plan.on_xfer_stage() and not plan.on_xfer_stage()
+        assert plan.injected["xfer"] == 1
+        t0 = time.monotonic()
+        plan.on_plane_op(None, "shm")              # named: delayed
+        assert time.monotonic() - t0 >= 0.02
+        t0 = time.monotonic()
+        plan.on_plane_op(None, "bulk")             # unnamed: untouched
+        assert time.monotonic() - t0 < 0.02
+        assert plan.injected["plane_slow"] == 1
+
 
 # ---------------------------------------------------------------------------
 # Stream claim failure fails the STREAM, not the socket (receiver side).
@@ -418,6 +439,160 @@ class TestRevivalUnits:
         assert c._retry_backoff_s() == c2._retry_backoff_s()
         c.retry_backoff_ms = 0
         assert c._retry_backoff_s() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# The engine-level chaos matrix (ici/plane_health.py): every revival
+# policy × {kill, black-hole, slow}, one PlaneHealth record per cell,
+# asserted through the unified rpc_fabric_plane_<name>_{down, reprobe,
+# revived, ramp} counter family.  The real-wire rows ride the pair
+# scenarios: _SHM_PLANE_MATRIX walks the shm plane through all three
+# modes mid-traffic; BD/DF/RR cover bulk kill/black-hole/refusal; the
+# DP scenario plus the plan knobs (test_plane_scoped_budgets_are_exact)
+# cover the device/xfer/collective shapes.
+# ---------------------------------------------------------------------------
+
+class TestPlaneHealthChaosMatrix:
+    @staticmethod
+    def _delta(name, before):
+        from brpc_tpu.ici.route import plane_stats
+        after = plane_stats()
+        return {ev: after.get(f"{name}_{ev}", 0)
+                - before.get(f"{name}_{ev}", 0)
+                for ev in ("down", "reprobe", "revived", "ramp")}
+
+    def test_prober_policy_kill_then_handshake_revival(self):
+        """KILL × threaded policy (the fabric bulk/shm shape): the loop
+        owns the comeback — usable() stays False until the prober's
+        attach lands, one failed dial counts a reprobe without a
+        revival, and the first post-revival verdict clears the ramp."""
+        from brpc_tpu.ici import plane_health as ph
+        from brpc_tpu.ici.route import plane_stats
+        name = "mx_prober"
+        attached = threading.Event()
+        box = {"probes": 0}
+
+        def prober():
+            box["probes"] += 1
+            if box["probes"] < 2:
+                return False             # first dial refused
+            box["rec"].revived()         # the attach path reports healthy
+            attached.set()
+            return True
+
+        rec = box["rec"] = ph.register_plane(
+            name, prober=prober, attached=attached.is_set,
+            backoff_base=0.01, backoff_cap=0.02)
+        before = plane_stats()
+        assert rec.usable() is True
+        assert rec.mark_down("chaos kill") is True
+        assert rec.mark_down("chaos kill") is False  # one transition
+        assert rec.usable() is False     # the loop owns the comeback
+        rec.kick()
+        assert attached.wait(10), "revival loop never attached"
+        snap = rec.snapshot()
+        assert snap["state"] == ph.UP and snap["half_open"], snap
+        assert snap["downs"] == 1 and snap["revivals"] == 1, snap
+        assert rec.usable() is True      # real traffic clears the ramp
+        assert rec.snapshot()["half_open"] is False
+        assert self._delta(name, before) == \
+            {"down": 1, "reprobe": 2, "revived": 1, "ramp": 1}
+        deadline = time.monotonic() + 5
+        while rec.running and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not rec.running and not rec.wanted, \
+            "revival loop must quiesce after the attach"
+
+    def test_timer_policy_blackhole_latch_lapses_then_relatches(self):
+        """BLACK-HOLE × timer policy (the device/xfer shape): the latch
+        holds inside the window, re-degrading re-arms WITHOUT a second
+        down count, the lapse revives optimistically via VIA_TIMER, and
+        the next failure re-latches."""
+        from brpc_tpu.ici import plane_health as ph
+        from brpc_tpu.ici.route import plane_stats
+        name = "mx_timer"
+        vias = []
+        rec = ph.register_plane(
+            name, retry_s=lambda: 0.25,
+            on_revive=lambda reason, via: vias.append((reason, via)))
+        before = plane_stats()
+        assert rec.mark_down("post timed out") is True
+        assert rec.usable() is False          # inside the latch window
+        assert "reprobe_in" in rec.snapshot()
+        assert rec.mark_down("post timed out") is False  # re-arms only
+        time.sleep(0.35)
+        assert rec.usable() is True           # lapse revives (reprobe)
+        assert vias == [("post timed out", ph.VIA_TIMER)]
+        assert rec.usable() is True           # next verdict: the ramp
+        assert self._delta(name, before) == \
+            {"down": 1, "reprobe": 1, "revived": 1, "ramp": 1}
+        assert rec.mark_down("post timed out") is True  # re-latches
+        assert rec.usable() is False
+        assert self._delta(name, before)["down"] == 2
+
+    def test_epoch_policy_kill_gated_blackhole_timed(self):
+        """KILL/BLACK-HOLE × epoch policy (the collective shape): a
+        membership death never resurrects by waiting — only the epoch
+        moving revives it (VIA_EPOCH) — while a transient black-hole
+        reason revives after the reprobe window under STABLE membership
+        (VIA_TIMER)."""
+        from brpc_tpu.ici import plane_health as ph
+        from brpc_tpu.ici.route import plane_stats
+        name = "mx_epoch"
+        epoch = {"n": 7}
+        vias = []
+        rec = ph.register_plane(
+            name, epoch_fn=lambda: epoch["n"],
+            transient_reasons=("announce timeout",),
+            reprobe_s=lambda: 0.25,
+            on_revive=lambda reason, via: vias.append((reason, via)))
+        before = plane_stats()
+        # kill: "member dead" is NOT transient
+        assert rec.mark_down("member dead") is True
+        assert rec.snapshot()["down_epoch"] == 7
+        assert rec.usable() is False
+        time.sleep(0.3)
+        assert rec.usable() is False, \
+            "a dead member must not resurrect by waiting"
+        epoch["n"] = 8                        # the membership moves
+        assert rec.usable() is True
+        assert vias == [("member dead", ph.VIA_EPOCH)]
+        assert rec.usable() is True           # ramp
+        # black-hole: a swallowed announce IS transient
+        assert rec.mark_down("announce timeout") is True
+        assert rec.usable() is False          # window open, epoch stable
+        time.sleep(0.35)
+        assert rec.usable() is True
+        assert vias[-1] == ("announce timeout", ph.VIA_TIMER)
+        assert rec.usable() is True           # ramp again
+        assert self._delta(name, before) == \
+            {"down": 2, "reprobe": 2, "revived": 2, "ramp": 2}
+
+    def test_slow_never_degrades_any_policy(self):
+        """SLOW × every policy: latency is not death.  The injector
+        delays the op (and counts it); no mark_down is ever issued, so
+        the engine must show ZERO movement for all three families."""
+        from brpc_tpu.ici import plane_health as ph
+        from brpc_tpu.ici.route import plane_stats
+        specs = {
+            "mx_slow_p": dict(prober=lambda: True, attached=lambda: True),
+            "mx_slow_t": dict(retry_s=lambda: 0.1),
+            "mx_slow_e": dict(epoch_fn=lambda: 1),
+        }
+        plan = fi.FabricFaultPlan(
+            plane_slow_ms={n: 10 for n in specs})
+        before = plane_stats()
+        with fi.inject_fabric(plan):
+            for name, policy in specs.items():
+                rec = ph.register_plane(name, **policy)
+                for _ in range(3):
+                    plan.on_plane_op(None, name)   # the op runs late...
+                    assert rec.usable() is True    # ...but stays UP
+                snap = rec.snapshot()
+                assert snap["state"] == ph.UP and snap["downs"] == 0
+                assert self._delta(name, before) == \
+                    {"down": 0, "reprobe": 0, "revived": 0, "ramp": 0}
+        assert plan.injected["plane_slow"] == 9
 
 
 # ---------------------------------------------------------------------------
@@ -1282,3 +1457,315 @@ def test_chaos_drain_under_load_zero_client_failures():
     outs = _run_pair(_DRAIN_UNDER_LOAD % {"repo": REPO}, timeout=300)
     assert "DL0_OK" in outs[0]
     assert "DL1_OK" in outs[1]
+
+
+# ---------------------------------------------------------------------------
+# The plane-health chaos matrix on the REAL wire (shm tier engaged).
+# ---------------------------------------------------------------------------
+
+# Same prelude, shm ON: these scenarios target the ring tier's health
+# machinery itself (and the bulk tier underneath it as the fallback).
+_SHM_PRELUDE = _CHILD_PRELUDE.replace(
+    '_prelude_fl.set_flag("ici_fabric_shm", False)',
+    '_prelude_fl.set_flag("ici_fabric_shm", True)')
+
+# One client walks the shm plane through SLOW -> KILL (with the bulk
+# fallback SLOWED underneath) -> BLACK-HOLE mid-traffic, with ZERO
+# client-visible RPC failures: SLOW completes late without a degrade,
+# KILL degrades in-frame onto the (slow) bulk tier and the background
+# handshake revives the ring, BLACK-HOLE (the server's scan drops our
+# published frames) times out the peer's claim, fails THAT stream only,
+# and revives once more — every transition asserted through the unified
+# plane counters, /ici snapshot states, and the breaker ramp.
+_SHM_PLANE_MATRIX = _SHM_PRELUDE + r"""
+from brpc_tpu.butil import flags as _fl
+from brpc_tpu.ici.route import plane_stats
+_fl.set_flag("ici_bulk_claim_timeout_s", 1.0)
+CHUNK = 256 * 1024
+
+if pid == 0:
+    class EchoSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "srv:" + request.message
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+            done()
+
+    state = {"closed": 0}
+    closed_evt = threading.Event()
+
+    class Sink:
+        def on_received_messages(self, sid, msgs):
+            pass
+        def on_closed(self, sid):
+            state["closed"] += 1
+            closed_evt.set()
+
+    class StreamSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Start(self, cntl, request, response, done):
+            rpc.stream_accept(cntl, rpc.StreamOptions(handler=Sink()))
+            response.message = "ok"
+            done()
+
+    server = rpc.Server()
+    server.add_service(EchoSvc())
+    server.add_service(StreamSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("sm_srv_up", "1")
+    # BLACK-HOLE arming: the server is the RECEIVE side of the client's
+    # stream frames, so the drop must sit on OUR ring handle — and only
+    # after the kill-phase revival re-attached our end
+    kv.blocking_key_value_get("sm_arm_bh", 120000)
+    srv = fabric_socks()
+    assert srv, "no fabric socket server-side"
+    sv = srv[0]
+    deadline = time.time() + 30
+    while not sv.shm_bound() and time.time() < deadline:
+        time.sleep(0.02)
+    assert sv.shm_bound(), "server never re-attached the revived ring"
+    assert fi.chaos_plane(sv, "shm", fi.BLACKHOLE, 4), "arming failed"
+    kv.key_value_set("sm_bh_armed", "1")
+    assert closed_evt.wait(120), "black-holed stream never failed"
+    assert not sv.failed, "server socket must survive the black-hole"
+    kv.wait_at_barrier("sm_done", 180000)
+    st = plane_stats()
+    # the KILL (peer-notified) and the BLACK-HOLE (our own claim
+    # timeout) each degraded this end, and each revival re-attached it
+    assert st.get("shm_down", 0) >= 2, st
+    assert st.get("shm_revived", 0) >= 2, st
+    assert not sv.failed
+    server.stop()
+    print("SM0_OK", flush=True)
+else:
+    kv.blocking_key_value_get("sm_srv_up", 60000)
+    payload = bytes(bytearray((i * 7 + 3) & 0xFF for i in range(CHUNK)))
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+
+    def echo(tag):
+        cntl = rpc.Controller()
+        cntl.request_attachment.append(payload)
+        ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message=tag),
+                       EchoResponse)
+        assert not cntl.failed(), (tag, cntl.error_text)
+        assert cntl.response_attachment.to_bytes() == payload, tag
+
+    # ---- phase 0: healthy — bytes ride the ring ----
+    echo("healthy")
+    s = fabric_socks()[0]
+    assert s.shm_bound() and s.shm_bytes_sent >= CHUNK, s.shm_bytes_sent
+    assert s.describe_planes()["shm"]["state"] == "up"
+    base = plane_stats()
+
+    # ---- phase 1: SLOW — ops delayed, not dead; must NOT degrade ----
+    plan = fi.FabricFaultPlan(plane_slow_ms={"shm": 40})
+    with fi.inject_fabric(plan):
+        echo("slow-shm")
+    assert plan.injected["plane_slow"] >= 1, plan.injected
+    now = plane_stats()
+    assert now.get("shm_down", 0) == base.get("shm_down", 0), \
+        "SLOW must not degrade the shm plane"
+    assert s.describe_planes()["shm"]["state"] == "up"
+
+    # ---- phase 2: KILL shm mid-traffic, bulk SLOWED underneath ----
+    # the same frame degrades shm in-frame onto the delayed bulk tier:
+    # late, never lost, zero client-visible failures
+    assert fi.chaos_plane(s, "shm", fi.KILL), "kill arming failed"
+    assert fi.chaos_plane(s, "bulk", fi.SLOW, 150), "slow arming failed"
+    bulk_sent = s.bulk_bytes_sent
+    t0 = time.monotonic()
+    echo("kill-shm")
+    slow_dt = time.monotonic() - t0
+    with s._bulk_lock:
+        bh, blib = s._bulk, s._blib
+    if bh:
+        blib.brpc_tpu_fab_chaos(bh, fi.CHAOS_CLEAR, 0)
+    now = plane_stats()
+    assert now.get("shm_down", 0) == base.get("shm_down", 0) + 1, now
+    assert now.get("bulk_down", 0) == base.get("bulk_down", 0), \
+        "a slowed bulk plane must NOT degrade"
+    assert s.bulk_bytes_sent >= bulk_sent + CHUNK, \
+        "the killed ring's bytes must ride the bulk tier"
+    assert slow_dt >= 0.1, (slow_dt, "the delayed park never engaged")
+    deadline = time.time() + 30
+    while s.describe_planes()["shm"]["state"] != "up" \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert s.describe_planes()["shm"]["state"] == "up", \
+        "shm never revived after the kill"
+    assert s.shm_epoch() >= 2, s.shm_epoch()
+    now = plane_stats()
+    assert now.get("shm_revived", 0) >= base.get("shm_revived", 0) + 1
+    sent = s.shm_bytes_sent
+    echo("post-revival")
+    assert s.shm_bytes_sent >= sent + CHUNK, \
+        "the revived ring must carry traffic again"
+    now = plane_stats()
+    assert now.get("shm_ramp", 0) > base.get("shm_ramp", 0), \
+        "the half-open ramp never cleared under real traffic"
+
+    # ---- phase 3: BLACK-HOLE — bytes vanish at the peer's scan ----
+    # the server drops OUR published stream frames; its claim times out
+    # (ici_bulk_claim_timeout_s=1), fails THAT stream (descriptor
+    # consistency), degrades only its shm plane, and RSTs us — the
+    # socket survives, and the peer-notified death revives once more
+    kv.key_value_set("sm_arm_bh", "1")
+    kv.blocking_key_value_get("sm_bh_armed", 60000)
+    down_before = plane_stats().get("shm_down", 0)
+    cntl = rpc.Controller()
+    stream = rpc.stream_create(cntl,
+                               rpc.StreamOptions(max_buf_size=8 << 20))
+    ch.call_method("StreamSvc.Start", cntl, EchoRequest(message="s"),
+                   EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(10)
+    try:
+        stream.write(IOBuf(payload), timeout=30)
+    except (ConnectionError, OSError):
+        pass
+    deadline = time.time() + 20
+    while not stream.closed and time.time() < deadline:
+        time.sleep(0.02)
+    assert stream.closed, "black-holed stream must fail"
+    assert not s.failed, "socket must survive the black-hole"
+    deadline = time.time() + 30
+    while (plane_stats().get("shm_down", 0) == down_before
+           or s.describe_planes()["shm"]["state"] != "up") \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    assert plane_stats().get("shm_down", 0) > down_before, \
+        "the peer-reported death never degraded our record"
+    assert s.describe_planes()["shm"]["state"] == "up", \
+        "shm never revived after the black-hole"
+    # the whole walk was invisible at the RPC layer: one more echo
+    # rides the fresh ring, byte-exact
+    sent = s.shm_bytes_sent
+    echo("post-blackhole")
+    assert s.shm_bytes_sent >= sent + CHUNK
+    assert not s.failed
+    kv.wait_at_barrier("sm_done", 180000)
+    print("SM1_OK", flush=True)
+"""
+
+
+def test_chaos_shm_plane_matrix_slow_kill_blackhole_zero_failures():
+    outs = _run_pair(_SHM_PLANE_MATRIX % {"repo": REPO}, timeout=300)
+    assert "SM0_OK" in outs[0]
+    assert "SM1_OK" in outs[1]
+
+
+# A/B parity through the rpc_dump seam: the engine-ported bulk/shm
+# revival handshakes must be FRAME-FOR-FRAME identical to the
+# pre-refactor wire protocol (fabric.py's _F_* framing comments are the
+# golden): DOWN (empty body) then REESTABLISH ({"bulk_key"} /
+# {"shm_seg"} json) outbound, exactly one empty-body OK back, never an
+# ERR — and healthy traffic emits ZERO plane frames (both families show
+# exactly one handshake after exactly one kill each).
+_PLANE_PARITY = _SHM_PRELUDE + r"""
+import json as _json
+import tempfile
+from brpc_tpu.butil import flags as _fl
+
+CHUNK = 256 * 1024
+
+if pid == 0:
+    class EchoSvc(rpc.Service):
+        @rpc.method(EchoRequest, EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "srv:" + request.message
+            if len(cntl.request_attachment):
+                cntl.response_attachment.append(cntl.request_attachment)
+            done()
+
+    server = rpc.Server(); server.add_service(EchoSvc())
+    assert server.start("ici://0") == 0
+    kv.key_value_set("pp_srv_up", "1")
+    kv.wait_at_barrier("pp_done", 180000)
+    server.stop()
+    print("PP0_OK", flush=True)
+else:
+    dump_dir = tempfile.mkdtemp(prefix="plane_parity_")
+    _fl.set_flag("rpc_dump", True)
+    _fl.set_flag("rpc_dump_dir", dump_dir)
+    kv.blocking_key_value_get("pp_srv_up", 60000)
+    payload = bytes(bytearray((i * 5 + 1) & 0xFF for i in range(CHUNK)))
+    ch = rpc.Channel()
+    ch.init("ici://0", options=rpc.ChannelOptions(timeout_ms=60000,
+                                                  max_retry=0))
+
+    def echo(tag):
+        cntl = rpc.Controller()
+        cntl.request_attachment.append(payload)
+        ch.call_method("EchoSvc.Echo", cntl, EchoRequest(message=tag),
+                       EchoResponse)
+        assert not cntl.failed(), (tag, cntl.error_text)
+        assert cntl.response_attachment.to_bytes() == payload, tag
+
+    echo("healthy")                # plane attach: no healing frames
+    s = fabric_socks()[0]
+    assert s.shm_bound() and s._bulk
+
+    # kill the BULK conn: the next send's route probe detects it at the
+    # frame boundary, bytes ride shm, the handshake revives bulk
+    assert fi.chaos_plane(s, "bulk", fi.KILL)
+    echo("bulk-killed")
+    deadline = time.time() + 30
+    while s.bulk_epoch() < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert s.bulk_epoch() >= 2, "bulk never re-established"
+
+    # kill the SHM ring: same discipline, bytes ride the revived bulk
+    assert fi.chaos_plane(s, "shm", fi.KILL)
+    echo("shm-killed")
+    deadline = time.time() + 30
+    while s.shm_epoch() < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert s.shm_epoch() >= 2, "shm never re-established"
+    echo("both-revived")
+
+    from brpc_tpu.rpc import rpc_dump as _rd
+    trace = [r for r in _rd.load_fabric_trace(dump_dir)
+             if r["sock"] == s.id]
+    assert trace, "rpc_dump recorded no plane frames"
+
+    def frames(lo, direction):
+        return [r for r in trace
+                if r["dir"] == direction and lo <= r["ftype"] <= lo + 3]
+
+    # ---- bulk family (DOWN/REESTABLISH/OK/ERR = 8/9/10/11) ----
+    out = frames(8, "out")
+    assert [r["ftype"] for r in out] == [8, 9], out
+    assert out[0]["body"] == "", "DOWN carries an empty body"
+    req = _json.loads(bytes.fromhex(out[1]["body"]))
+    assert set(req) == {"bulk_key"} and req["bulk_key"], req
+    ins = frames(8, "in")
+    assert [r["ftype"] for r in ins] == [10], ins
+    assert ins[0]["body"] == "", "BULK_OK carries an empty body"
+
+    # ---- shm family (DOWN/REESTABLISH/OK/ERR = 17/18/19/20) ----
+    out = frames(17, "out")
+    assert [r["ftype"] for r in out] == [17, 18], out
+    assert out[0]["body"] == "", "SHM_DOWN carries an empty body"
+    req = _json.loads(bytes.fromhex(out[1]["body"]))
+    assert set(req) == {"shm_seg"} and req["shm_seg"], req
+    ins = frames(17, "in")
+    assert [r["ftype"] for r in ins] == [19], ins
+    assert ins[0]["body"] == "", "SHM_OK carries an empty body"
+
+    # wire order per family: death precedes the re-park request, which
+    # precedes the peer's OK
+    order = [r["ftype"] for r in trace]
+    assert order.index(8) < order.index(9) < order.index(10)
+    assert order.index(17) < order.index(18) < order.index(19)
+    kv.wait_at_barrier("pp_done", 180000)
+    print("PP1_OK", flush=True)
+"""
+
+
+def test_chaos_plane_handshake_parity_via_rpc_dump_goldens():
+    outs = _run_pair(_PLANE_PARITY % {"repo": REPO}, timeout=300)
+    assert "PP0_OK" in outs[0]
+    assert "PP1_OK" in outs[1]

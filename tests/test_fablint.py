@@ -154,6 +154,20 @@ class TestFixtureViolations:
         assert "_tables" in out[0].message and "_lock" in out[0].message
         assert out[0].path.endswith("bad_kv_cow.py")
 
+    def test_rogue_plane_state_machine_reported_with_lines(self):
+        """ISSUE 17: a plane growing its own down/reestablish machine —
+        private state fields plus a hand-rolled revival thread — is
+        caught at every declaration site; the fix the message names is
+        plane_health.register_plane()."""
+        out = _findings("bad_plane_state.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [
+            ("plane-state", 14), ("plane-state", 15),
+            ("plane-state", 19), ("plane-state", 20),
+            ("plane-state", 28)]
+        msgs = " | ".join(f.message for f in out)
+        assert "register_plane" in msgs and "revival loop" in msgs
+        assert out[0].path.endswith("bad_plane_state.py")
+
     def test_clean_fixture_is_silent(self):
         out = _findings(
             "clean_module.py",
@@ -247,6 +261,7 @@ class TestZeroFindingsGate:
         # carries a guard map the analyzer enforces
         hot = ["rpc/socket.py", "rpc/stream.py", "rpc/health_check.py",
                "ici/fabric.py", "ici/transport.py", "ici/device_plane.py",
+               "ici/plane_health.py",
                "policy/load_balancers.py", "butil/resource_pool.py",
                "bthread/scheduler.py", "serving/kv_pool.py",
                "serving/kv_source.py", "serving/scheduler.py",

@@ -383,6 +383,62 @@ class TestKvZeroCopyHandoff:
         finally:
             pool.close()
 
+    def test_load_route_consults_plane_health(self):
+        """ISSUE 17 seam: when the fabric socket that carried the
+        LoadKv is supplied, the adopt-vs-scatter label comes from the
+        SHARED route table — every descriptor plane down means the load
+        records SCATTERED, a healthy plane (or no sock, the in-process
+        path) keeps ADOPTED, and DEVICE-class payloads scatter no
+        matter what the planes say."""
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.ici import fabric as _  # registers route flags
+        from brpc_tpu.ici import route as _route
+        from brpc_tpu.serving.kv_source import (ADOPTED, SCATTERED,
+                                                wire_source)
+
+        class _Sock:
+            def __init__(self, up):
+                self.up = up
+
+            def plane_usable(self, plane, nbytes=0):
+                return self.up
+
+        # big enough to clear ici_fabric_bulk_host_min (64 KiB): below
+        # it candidates() short-circuits to [INLINE] regardless of
+        # plane health
+        big = bytes(128 * 1024)
+        layers, dmodel = 4, 64
+        seq = len(big) // (layers * dmodel)
+
+        def host_buf():
+            buf = IOBuf()
+            buf.append_user_data(memoryview(big))
+            return buf
+
+        # no sock: the in-process path, label untouched
+        assert wire_source(host_buf(), layers, seq,
+                           dmodel).route == ADOPTED
+        # healthy descriptor planes: adopt in place
+        assert wire_source(host_buf(), layers, seq, dmodel,
+                           sock=_Sock(True)).route == ADOPTED
+        # every descriptor plane has left UP: the counters must not
+        # claim an in-place adoption rode a healthy plane
+        assert wire_source(host_buf(), layers, seq, dmodel,
+                           sock=_Sock(False)).route == SCATTERED
+        # sanity: the fake's truth table IS what candidates() consults
+        assert _route.SHM in _route.candidates(_Sock(True), _route.HOST,
+                                               len(big))
+        assert _route.candidates(_Sock(False), _route.HOST,
+                                 len(big)) == [_route.INLINE]
+        # DEVICE class scatters even on healthy planes (the D2H
+        # crossing is the wire transfer itself)
+        import jax.numpy as jnp
+        dev = IOBuf()
+        dev.append_device_array(
+            jnp.zeros(len(big), jnp.uint8))
+        assert wire_source(dev, layers, seq, dmodel,
+                           sock=_Sock(True)).route == SCATTERED
+
     def test_partial_tail_zeroed_after_prior_tenant_adoption(self):
         """Tail-zeroing must hold on the ADOPTED path too: a short
         session scattered over a block a longer prior tenant filled
