@@ -2726,6 +2726,186 @@ def bench_serving_kv_prefix(iters: int = 40, seq: int = 2048) -> dict:
     return out
 
 
+def bench_serving_kv_tiers(iters: int = 24, seq: int = 256) -> dict:
+    """Tiered KV memory + live migration (ISSUE 19), three legs:
+
+      * **restore p50** — ``iters`` explicit spill/materialize round
+        trips on a host-backed pool; per-restore wall time is measured
+        here and cross-checked against the pool's own
+        ``tiers.restore_p50_us`` window, every restore byte-exact;
+      * **capacity under pressure A/B** — same arena, same load
+        pattern, ``serving_kv_spill`` ON vs OFF; the acceptance bound
+        is ON retaining STRICTLY more live (still-retrievable)
+        sessions than OFF, with every retained session verified
+        byte-exact (spill-on retains them ALL — nobody drops);
+      * **migration cutover** — two loopback mem:// decode workers,
+        ``Decode.MigrateOut`` A→B timed end-to-end (snapshot + wire +
+        destination commit + cutover), destination bytes verified
+        against the source prompt, ``bytes_moved`` asserted from the
+        process migration ledger."""
+    import json as _json
+
+    import brpc_tpu.policy  # noqa: F401
+    from brpc_tpu import rpc
+    from brpc_tpu.butil import flags as _fl
+    from brpc_tpu.serving import KvPoolOptions, PagedKvPool
+    from brpc_tpu.serving.migration import migration_stats
+    from examples.disagg_serving.model import (KV_DMODEL, KV_LAYERS,
+                                               toy_kv_blocks)
+    from examples.disagg_serving.workers import DecodeService
+    from examples.example_echo_pb2 import EchoRequest, EchoResponse
+    import numpy as _np
+
+    bpt = KV_LAYERS * KV_DMODEL
+
+    def rows_of(tokens):
+        kv = _np.asarray(toy_kv_blocks(tokens))
+        n = len(tokens)
+        return _np.ascontiguousarray(kv.reshape(
+            KV_LAYERS, n, KV_DMODEL).transpose(1, 0, 2).reshape(n, bpt))
+
+    out = {"seq": seq, "iters": iters}
+
+    # ---- restore-from-host p50 -------------------------------------------
+    bt = 16
+    blocks_per = seq // bt
+    toks = [(3 * j + 1) % 997 for j in range(seq)]
+    rows = rows_of(toks)
+    pool = PagedKvPool(KvPoolOptions(
+        bytes_per_token=bpt, num_blocks=2 * blocks_per, block_tokens=bt,
+        host_blocks=2 * blocks_per, use_timers=False))
+    try:
+        pool.load("r", rows, last_token=toks[-1])
+        lat_us = []
+        for _ in range(iters):
+            assert pool.spill("r")
+            t0 = time.perf_counter_ns()
+            got = pool.materialize("r")
+            t1 = time.perf_counter_ns()
+            assert got is not None and _np.array_equal(got, rows)
+            lat_us.append((t1 - t0) / 1e3)
+        lat_us.sort()
+        d = pool.describe()["tiers"]
+        assert d["restores"] == iters and d["demotions"] == iters
+        assert d["restore_corrupt"] == 0
+        out["restore_p50_us"] = round(lat_us[len(lat_us) // 2], 1)
+        out["restore_p99_us"] = round(
+            lat_us[min(len(lat_us) - 1, int(len(lat_us) * 0.99))], 1)
+        # the pool's own rolling window agrees with the external clock
+        out["restore_pool_p50_us"] = d["restore_p50_us"]
+        out["restore_blocks"] = blocks_per
+    finally:
+        pool.close()
+
+    # ---- capacity under pressure A/B --------------------------------------
+    n_sessions, nb = 24, 8
+    alive = {}
+    for flag in (True, False):
+        prev = _fl.get_flag("serving_kv_spill")
+        _fl.set_flag("serving_kv_spill", flag)
+        pool = PagedKvPool(KvPoolOptions(
+            bytes_per_token=bpt, num_blocks=nb, block_tokens=bt,
+            host_blocks=2 * n_sessions, use_timers=False))
+        try:
+            sessions = {}
+            for i in range(n_sessions):
+                stoks = [(7 * i + j) % 997 for j in range(2 * bt)]
+                pool.load(f"s{i}", rows_of(stoks),
+                          last_token=stoks[-1])
+                sessions[f"s{i}"] = stoks
+            live = 0
+            for name, stoks in sessions.items():
+                got = pool.materialize(name)
+                if got is not None:
+                    assert _np.array_equal(got, rows_of(stoks)), name
+                    live += 1
+            alive[flag] = live
+            if flag:
+                td = pool.describe()["tiers"]
+                out["capacity_demotions"] = td["demotions"]
+                out["capacity_restores"] = td["restores"]
+        finally:
+            pool.close()
+            _fl.set_flag("serving_kv_spill", prev)
+    out["capacity_sessions_spill_on"] = alive[True]
+    out["capacity_sessions_spill_off"] = alive[False]
+    # spill-on keeps EVERY session retrievable; spill-off only holds
+    # what the device arena holds
+    out["pass_spill_capacity"] = (alive[True] == n_sessions
+                                  and alive[True] > alive[False])
+
+    # ---- live-migration cutover over loopback -----------------------------
+    def worker(tag):
+        server = rpc.Server()
+        svc = DecodeService(pool_options=KvPoolOptions(
+            bytes_per_token=bpt, num_blocks=64, block_tokens=bt,
+            use_timers=False))
+        server.add_service(svc)
+        assert server.start(f"mem://kvt-{tag}") == 0
+        return server, svc
+
+    server_a, svc_a = worker("a")
+    server_b, svc_b = worker("b")
+    ch = rpc.Channel()
+    ch.init("mem://kvt-a",
+            options=rpc.ChannelOptions(timeout_ms=30000, max_retry=0))
+    try:
+        m0 = migration_stats()
+        cntl = rpc.Controller()
+        cntl.request_attachment.append_device_array(toy_kv_blocks(toks))
+        ch.call_method("Decode.LoadKv", cntl, EchoRequest(
+            message=_json.dumps({"session": "mig", "seq_len": seq,
+                                 "last_token": toks[-1]})),
+            EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        cut_ms = []
+        for i in range(max(4, iters // 4)):
+            src_ch, dest = (ch, "mem://kvt-b")
+            if i % 2 == 1:
+                # migrate it back so every iteration is a real move
+                src_ch = rpc.Channel()
+                src_ch.init("mem://kvt-b", options=rpc.ChannelOptions(
+                    timeout_ms=30000, max_retry=0))
+                dest = "mem://kvt-a"
+            mc = rpc.Controller()
+            t0 = time.perf_counter_ns()
+            resp = src_ch.call_method(
+                "Decode.MigrateOut", mc,
+                EchoRequest(message=_json.dumps(
+                    {"session": "mig", "dest": dest})), EchoResponse)
+            t1 = time.perf_counter_ns()
+            assert not mc.failed(), mc.error_text
+            assert _json.loads(resp.message)["migrated"]
+            cut_ms.append((t1 - t0) / 1e6)
+            if src_ch is not ch:
+                src_ch.close()
+        n_mig = len(cut_ms)
+        # n_mig is even: the session ends back on A — verify custody
+        # and bytes there (the source copy is GONE from B)
+        got = svc_a.pool.materialize("mig")
+        assert got is not None and _np.array_equal(got, rows)
+        assert svc_b.pool.get("mig") is None
+        m1 = migration_stats()
+        assert m1["migrations_out"] - m0["migrations_out"] == n_mig
+        assert m1["cutovers"] - m0["cutovers"] == n_mig
+        cut_ms.sort()
+        out["migrations"] = n_mig
+        out["migrate_cutover_p50_ms"] = round(
+            cut_ms[len(cut_ms) // 2], 2)
+        out["migrate_bytes_moved"] = (m1["bytes_moved"]
+                                      - m0["bytes_moved"])
+        out["pass_migration"] = (
+            m1["aborts"] == m0["aborts"]
+            and out["migrate_bytes_moved"] == n_mig * seq * bpt)
+    finally:
+        ch.close()
+        svc_a.close()
+        svc_b.close()
+        server_a.stop()
+        server_b.stop()
+    return out
+
+
 def bench_bvar_record() -> dict:
     """Single-lock batched bvar recording (ISSUE 15 satellite): ns per
     ``LatencyRecorder << us`` with the five-agent shared lock vs the
@@ -3135,6 +3315,12 @@ def main() -> None:
     kvp = _run_subbench("serving_kv_prefix", timeout_s=240) \
         if device_ok else {}
     print(f"# serving kv prefix: {kvp}", file=sys.stderr)
+    # serving_kv_tiers (ISSUE 19): restore-from-host p50, capacity
+    # under pressure A/B (spill on/off), live-migration cutover over
+    # loopback — custody + bytes_moved asserted from the ledger
+    kvt = _run_subbench("serving_kv_tiers", timeout_s=240) \
+        if device_ok else {}
+    print(f"# serving kv tiers: {kvt}", file=sys.stderr)
     # single-lock batched bvar recording (ISSUE 15 satellite): pure-host
     # microbench, no device needed
     try:
@@ -3451,6 +3637,24 @@ def main() -> None:
             "pass_concurrent_fill", False),
         "serving_kv_pass_rpc_copy_parity": kvp.get(
             "pass_rpc_copy_parity", False),
+        # ISSUE-19 tiered KV + live migration: restore-from-host p50,
+        # capacity-under-pressure A/B (spill on retains strictly
+        # more), loopback migration cutover p50 with the bytes-moved
+        # ledger asserted
+        "serving_kv_tiers_restore_p50_us": kvt.get(
+            "restore_p50_us", -1.0),
+        "serving_kv_tiers_capacity_on": kvt.get(
+            "capacity_sessions_spill_on", -1),
+        "serving_kv_tiers_capacity_off": kvt.get(
+            "capacity_sessions_spill_off", -1),
+        "serving_kv_tiers_migrate_cutover_p50_ms": kvt.get(
+            "migrate_cutover_p50_ms", -1.0),
+        "serving_kv_tiers_migrate_bytes": kvt.get(
+            "migrate_bytes_moved", -1),
+        "serving_kv_tiers_pass_spill_capacity": kvt.get(
+            "pass_spill_capacity", False),
+        "serving_kv_tiers_pass_migration": kvt.get(
+            "pass_migration", False),
         # ISSUE-15 single-lock batched bvar recording: ns per
         # LatencyRecorder sample, batched vs the PR-13 five-lock path,
         # plus the echo-shaped A/B (py_handler_bvar_unbatched_* in the
@@ -3504,6 +3708,7 @@ if __name__ == "__main__":
               "serving_soak": bench_serving_soak,
               "serving_kv": bench_serving_kv_handoff,
               "serving_kv_prefix": bench_serving_kv_prefix,
+              "serving_kv_tiers": bench_serving_kv_tiers,
               "chaos_matrix": bench_chaos_matrix}[sys.argv[2]]
         print(_json.dumps(fn()))
     else:

@@ -23,7 +23,13 @@ workers (``examples/disagg_serving`` is built ON this package):
     ``serving_kv_load_*`` counters;
   * :mod:`.router` — ``LoadAwareRouter``: prefill→decode routing by
     load through the LALB divided-weight balancer, with elastic
-    membership from a naming url (``pod://``);
+    membership from a naming url (``pod://``) and — since ISSUE 19 —
+    session AFFINITY (``bind_session``/``rebind``: the migration
+    cutover is one atomic affinity flip);
+  * :mod:`.migration` — live cross-worker KV migration (ISSUE 19):
+    ``migrate_out`` ships a pinned session's blocks to another pool
+    under a transfer-deadline plane-health latch, source authoritative
+    until the destination commits;
   * :mod:`.autoscaler` — ``LoadThresholdAutoscaler``: the elastic-pod
     capacity loop (watermarks + hysteresis + cooldown → scale
     callbacks; Server→Pod advertise/withdraw hooks move the epoch).
@@ -31,8 +37,10 @@ workers (``examples/disagg_serving`` is built ON this package):
 from .autoscaler import AutoscalerOptions, LoadThresholdAutoscaler
 from .kv_pool import (KvPoolOptions, PagedKvPool, PoolSaturated,
                       SessionBusy)
-from .kv_source import (WireKvSource, kv_load_stats, load_wire_attachment,
-                        wire_source)
+from .kv_source import (WireKvSource, kv_load_stats,
+                        load_token_major_attachment,
+                        load_wire_attachment, wire_source)
+from .migration import migrate_out, migration_stats
 from .router import LoadAwareRouter
 from .scheduler import (BatchSchedulerOptions, ContinuousBatchScheduler,
                         StepRequest)
@@ -50,6 +58,9 @@ __all__ = [
     "StepRequest",
     "WireKvSource",
     "kv_load_stats",
+    "load_token_major_attachment",
     "load_wire_attachment",
+    "migrate_out",
+    "migration_stats",
     "wire_source",
 ]

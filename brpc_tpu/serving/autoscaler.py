@@ -55,12 +55,20 @@ class LoadThresholdAutoscaler:
                  scale_up: Callable[[], bool],
                  scale_down: Callable[[], bool],
                  options: Optional[AutoscalerOptions] = None,
-                 pod=None):
+                 pod=None,
+                 drain: Optional[Callable[[], None]] = None):
+        """``drain`` (ISSUE 19): invoked before every ``scale_down``
+        so the operator rebalances the doomed worker's live sessions
+        first — migrate them to a surviving worker (or spill them to
+        the host tier) instead of letting the kill turn them into
+        re-prefills.  A raising drain is logged and the scale-down
+        still proceeds (capacity policy outranks a failing drain)."""
         self.options = options or AutoscalerOptions()
         self._load_fn = load_fn
         self._size_fn = size_fn
         self._scale_up = scale_up
         self._scale_down = scale_down
+        self._drain = drain
         self._pod = pod
         self._lock = _dbg.make_lock("LoadThresholdAutoscaler._lock")
         self._stop = threading.Event()
@@ -152,6 +160,13 @@ class LoadThresholdAutoscaler:
                 self._last["reason"] = reason
         if fire is None:
             return None
+        if action == "down" and self._drain is not None:
+            try:
+                self._drain()
+            except Exception:
+                from ..butil import logging as log
+                log.error("autoscaler drain before scale_down failed",
+                          exc_info=True)
         ok = False
         try:
             ok = bool(fire())

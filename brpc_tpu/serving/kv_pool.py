@@ -1,6 +1,6 @@
 """Paged KV-block pool: fixed-size device blocks, free-list custody,
 per-session block tables, admission-aware eviction, timer-driven expiry,
-copy-on-write prefix sharing.
+copy-on-write prefix sharing, host-tier spill/restore.
 
 The serving subsystem's memory manager (ROADMAP item 3; the shape every
 production LLM server converged on — vLLM's PagedAttention block tables
@@ -70,11 +70,25 @@ only at zero.  Two entry surfaces:
     default (``serving_kv_concurrent_fill``): reserve under the lock,
     scatter unlocked, COMMIT WITH A RE-CHECK — so concurrent LoadKv
     fills no longer serialize on one decode host.
+
+ISSUE 19 adds the HOST TIER (ROADMAP 2b): with ``host_blocks > 0`` the
+victim picker's "evict" becomes "demote" — a pressure victim's blocks
+are copied into a host arena (a refcounted shared block spills ONCE)
+and the session becomes retrievable instead of dead.  Any later touch
+(get / pin / snapshot / write_rows / the scheduler's roster add)
+RESTORES it through the same reserve / fill-outside-the-lock / commit
+shape ``load_into`` rides, with a chained-CRC byte verification so a
+corrupted host block degrades to a typed re-prefill shed, never to
+serving wrong bytes.  The spill path registers as a plane-health row
+("spill", timer-latch policy) so a failing host arena degrades
+in-policy — demotes stop, eviction falls back to the PR-16 behavior —
+and revives through the standard reprobe/ramp counters.
 """
 from __future__ import annotations
 
 import time
 import zlib
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -100,6 +114,19 @@ _flags.define_flag(
     "LoadKv fills proceed in parallel instead of serializing.  False "
     "restores the PR-15 hold-through-the-fill discipline byte-for-byte "
     "for same-run A/B")
+
+_flags.define_flag(
+    "serving_kv_spill", True,
+    "demote pressure victims to the host arena tier instead of "
+    "evicting them (pools built with host_blocks > 0).  False restores "
+    "the PR-16 evict-on-pressure behavior byte-for-byte for same-run "
+    "A/B — the capacity-under-pressure bench leg flips exactly this")
+
+_flags.define_flag(
+    "serving_kv_spill_reprobe_s", 0.25,
+    "spill plane-health timer latch: how long after a demote/restore "
+    "IO failure before the first use re-probes the host tier "
+    "optimistically")
 
 
 class SessionBusy(RuntimeError):
@@ -140,6 +167,9 @@ class KvPoolOptions:
     block_tokens: int = 16
     bands: int = 4                   # priority bands, 0 = most protected
     default_priority: int = 2        # sessions arriving without one
+    # host-tier arena size in blocks (ISSUE 19): 0 disables spill —
+    # pressure evicts exactly as before
+    host_blocks: int = 0
     ttl_s: float = 120.0             # idle-session expiry
     sweep_interval_s: float = 0.0    # 0 = auto: ttl_s / 4, floored
     use_timers: bool = True          # False: tests drive expire_idle()
@@ -206,6 +236,33 @@ class _KvSession:
         self.contiguous = bool((np.diff(blocks) == 1).all())
 
 
+class _SpilledSession:
+    """One session parked in the host tier (access under the pool
+    lock).  ``hblocks`` indexes the host arena; ``crcs`` holds the
+    CHAINED crc32 per block position, computed from the DEVICE bytes at
+    demote time — the restore path recomputes the chain from the host
+    copy and any divergence aborts the restore into a typed re-prefill
+    shed, never into serving corrupted bytes.  ``acc`` survives the
+    round trip so a restored session's decode recurrence is bit-exact
+    without re-deriving the reduction arena from scratch."""
+
+    __slots__ = ("session", "tenant", "priority", "seq_len",
+                 "last_token", "acc", "hblocks", "crcs", "last_used")
+
+    def __init__(self, session: str, tenant: str, priority: int,
+                 seq_len: int, last_token: int, acc: int,
+                 hblocks: np.ndarray, crcs: List[int], now: float):
+        self.session = session
+        self.tenant = tenant
+        self.priority = priority
+        self.seq_len = seq_len
+        self.last_token = last_token
+        self.acc = acc
+        self.hblocks = hblocks           # np.int64 (n_blocks,)
+        self.crcs = crcs                 # chained crc32 per position
+        self.last_used = now
+
+
 class PagedKvPool:
     """The paged KV arena.  Thread-safe; one per decode worker."""
 
@@ -220,6 +277,13 @@ class PagedKvPool:
         "_prefix_index": "_lock",
         "_block_hash": "_lock",
         "_recent_evicted": "_lock",
+        "_host_free": "_lock",
+        "_spilled": "_lock",
+        "_host_refs": "_lock",
+        "_spill_map": "_lock",
+        "_restoring": "_lock",
+        "_spill_fault": "_lock",
+        "_restore_us": "_lock",
         "_sweep_timer": "_lock",
         "_closed": "_lock",
         "_counters": "_counters_lock",
@@ -266,6 +330,37 @@ class PagedKvPool:
         # recently-evicted ids → reason, so a late Decode gets a typed
         # "re-prefill" shed instead of an unknown-session error
         self._recent_evicted: Dict[str, str] = {}
+        # ---- host tier (ISSUE 19) — all empty when host_blocks == 0.
+        # The host arena itself is unguarded for the same disjoint-row
+        # reason as the device arenas: a host block is written exactly
+        # once (at demote, under the lock) and read by at most one
+        # restore, which holds its own host refcount for the copy.
+        self._host_store = np.zeros(
+            (o.host_blocks, o.block_tokens * o.bytes_per_token),
+            np.uint8)
+        self._host_free: List[int] = list(
+            range(o.host_blocks - 1, -1, -1))
+        self._spilled: Dict[str, _SpilledSession] = {}
+        # per-HOST-block refcount: spilled sessions sharing a prefix
+        # share ONE host copy (a shared block spills once); an in-flight
+        # restore holds an extra count so a concurrent drop of the
+        # record can never free host bytes mid-copy
+        self._host_refs: Dict[int, int] = {}
+        # live device block -> its host copy: the demote-time dedupe
+        # accelerator.  An entry is valid exactly while the device
+        # block's bytes are unchanged — invalidated on physical free,
+        # on an in-place private write, and when the host copy frees
+        self._spill_map: Dict[int, int] = {}
+        self._restoring: set = set()
+        self._spill_fault: Optional[str] = None   # test injection
+        self._restore_us: deque = deque(maxlen=512)
+        self._spill_health = None
+        if o.host_blocks > 0:
+            from ..ici.plane_health import register_plane
+            self._spill_health = register_plane(
+                "spill",
+                retry_s=lambda: float(_flags.get_flag(
+                    "serving_kv_spill_reprobe_s")))
         self._sweep_timer = None
         self._closed = False
         self.loads = bvar.Adder("serving_kv_pool_loads")
@@ -282,6 +377,15 @@ class PagedKvPool:
         self.commit_races = bvar.Adder("serving_kv_pool_commit_races")
         self.locked_fills = bvar.Adder("serving_kv_pool_locked_fills")
         self.unlocked_fills = bvar.Adder("serving_kv_pool_unlocked_fills")
+        # ISSUE 19 tier truth: demote/restore round trips, restores
+        # that failed byte verification (degraded to re-prefill), and
+        # spilled sessions dropped under HOST-tier pressure
+        self.demotions = bvar.Adder("serving_kv_pool_demotions")
+        self.restores = bvar.Adder("serving_kv_pool_restores")
+        self.restore_corrupt = bvar.Adder(
+            "serving_kv_pool_restore_corrupt")
+        self.host_evictions = bvar.Adder(
+            "serving_kv_pool_host_evictions")
         self._counters: Dict[tuple, bvar.Adder] = {}
         self._tenant_labels: set = set()
 
@@ -528,11 +632,20 @@ class PagedKvPool:
                 # own previous table first
                 self._free_session_locked(old, "reloaded")
         if need > len(self._free):
+            spill = self._spill_usable_locked()
             victims = self._pick_victims_locked(
-                need - len(self._free), pri)
+                need - len(self._free), pri, spill=spill)
             if victims is None:
                 raise PoolSaturated(need, len(self._free))
             for v in victims:
+                # eviction becomes DEMOTION when the host tier is
+                # usable; a per-victim demote failure (host arena
+                # full / injected IO fault) falls back to the PR-16
+                # evict, so the picker's free-bytes simulation stays
+                # exact either way — _free_session_locked runs under
+                # both outcomes, only the reason differs
+                if spill and self._demote_session_locked(v):
+                    continue
                 self._free_session_locked(v, "pressure")
         blocks = np.empty(need, np.int64)
         for k in range(need):
@@ -586,6 +699,10 @@ class PagedKvPool:
         for b in s.blocks:
             b = int(b)
             self._refs[b] = self._refs.get(b, 0) + 1
+        # fresh bytes supersede any parked host copy of this id — a
+        # re-prefill must never leave a stale spilled record behind
+        # for a later restore to resurrect
+        self._drop_spilled_locked(s.session)
         if cur is not None:
             # deferred_old or the raced unpinned incumbent: either way
             # the fill succeeded, NOW retire the replaced table (still
@@ -649,7 +766,8 @@ class PagedKvPool:
 
     # fablint: lock-held(_lock)
     def _pick_victims_locked(self, blocks_needed: int,
-                             requester_pri: int, exclude=None):
+                             requester_pri: int, exclude=None,
+                             spill: bool = False):
         """Eviction order under pressure: most-sheddable band first,
         lighter tenants before heavier inside a band, LRU inside a
         class; never a band more protected than the requester's.  A
@@ -658,12 +776,62 @@ class PagedKvPool:
         victim list, so two sessions sharing a prefix free its blocks
         only when BOTH are on the list.  ``exclude`` fences one session
         out of the candidate set (``write_rows`` evicting on behalf of
-        the session it is mutating must never pick that session)."""
+        the session it is mutating must never pick that session).
+
+        ``spill=True`` (ISSUE 19): victims will be DEMOTED, not killed,
+        so the ordering PREFERS taking a whole shared-owner set over an
+        unshared live session of the same protection class — the set's
+        blocks spill ONCE for all its owners, and taking it whole is
+        the only way its shared blocks free at all (PR 16's picker
+        saturated there).  Candidates are grouped into shared-block
+        connected components; a group sorts by its MOST PROTECTED
+        member's band (taking any member means taking the set, so the
+        set is as protected as its most protected owner), shared sets
+        before singletons within a band, then lightest member weight,
+        then oldest member LRU.  The cumulative free-bytes simulation
+        is IDENTICAL to the ungrouped path — grouping only reorders."""
         cands = [s for s in self._tables.values()
                  if not s.pinned and s.priority >= requester_pri
                  and s is not exclude]
-        cands.sort(key=lambda s: (-s.priority, self._weight(s.tenant),
-                                  s.last_used))
+        if spill and len(cands) > 1:
+            parent = list(range(len(cands)))
+
+            def find(x: int) -> int:
+                while parent[x] != x:
+                    parent[x] = parent[parent[x]]
+                    x = parent[x]
+                return x
+
+            block_owner: Dict[int, int] = {}
+            for i, s in enumerate(cands):
+                for b in s.blocks:
+                    b = int(b)
+                    if self._refs.get(b, 1) > 1:
+                        j = block_owner.get(b)
+                        if j is None:
+                            block_owner[b] = i
+                        else:
+                            ra, rb = find(i), find(j)
+                            if ra != rb:
+                                parent[rb] = ra
+            comps: Dict[int, List[_KvSession]] = {}
+            for i, s in enumerate(cands):
+                comps.setdefault(find(i), []).append(s)
+            groups = list(comps.values())
+            groups.sort(key=lambda g: (
+                -min(s.priority for s in g),
+                0 if len(g) > 1 else 1,
+                min(self._weight(s.tenant) for s in g),
+                min(s.last_used for s in g)))
+            for g in groups:
+                g.sort(key=lambda s: (-s.priority,
+                                      self._weight(s.tenant),
+                                      s.last_used))
+            cands = [s for g in groups for s in g]
+        else:
+            cands.sort(key=lambda s: (-s.priority,
+                                      self._weight(s.tenant),
+                                      s.last_used))
         victims, have = [], 0
         sim: Dict[int, int] = {}
         for s in cands:
@@ -702,6 +870,10 @@ class PagedKvPool:
             if r <= 0:
                 self._refs.pop(b, None)
                 self._unregister_block_locked(b)
+                # a physically-freed block's bytes are about to be
+                # rewritten by the next reservation: its host-copy
+                # mapping is stale the moment it leaves custody
+                self._spill_map.pop(b, None)
                 dead.append(b)
             else:
                 self._refs[b] = r
@@ -716,8 +888,291 @@ class PagedKvPool:
             self.expirations << 1
         elif reason == "pressure":
             self.evictions << 1
-        self._count("released" if reason == "released"
-                    else f"evicted_{reason}", s.tenant)
+        elif reason == "spilled":
+            # demotion, not death: the session is retrievable from the
+            # host tier, so it gets neither a _recent_evicted entry nor
+            # an eviction count
+            self.demotions << 1
+        if reason == "released":
+            self._count("released", s.tenant)
+        elif reason == "spilled":
+            self._count("spilled", s.tenant)
+        else:
+            self._count(f"evicted_{reason}", s.tenant)
+
+    # ---- host tier: spill / restore (ISSUE 19) -------------------------
+    # fablint: lock-held(_lock)
+    def _spill_usable_locked(self) -> bool:
+        """Demotion is on exactly when the pool HAS a host arena, the
+        A/B flag says so, and the spill plane-health row is usable —
+        a latched IO failure turns pressure back into PR-16 eviction
+        until the timer latch lapses and the plane revives."""
+        return (self.options.host_blocks > 0
+                and bool(_flags.get_flag("serving_kv_spill"))
+                and self._spill_health.usable())
+
+    # fablint: lock-held(_lock)
+    def _demote_session_locked(self, s: _KvSession) -> bool:
+        """Copy ``s``'s blocks into the host arena and retire its
+        device table ("spilled" — retrievable, not dead).  A device
+        block that already has a live host copy (a co-owner spilled
+        first, or shares the block with an already-spilled session)
+        reuses it with a refcount bump — a SHARED BLOCK SPILLS ONCE.
+        Returns False without side effects on the session when the
+        host tier cannot take it (arena full even after reclaiming
+        older spilled sessions, or the injected IO fault) — the caller
+        falls back to eviction."""
+        if self._spill_fault == "demote":
+            # injected demote-IO failure: latch the plane down so
+            # pressure stops routing victims at a failing host arena
+            self._spill_health.mark_down("demote_io")
+            return False
+        need_new = 0
+        for b in s.blocks:
+            b = int(b)
+            if b not in self._spill_map:
+                need_new += 1
+        if need_new > len(self._host_free) and \
+                not self._host_reclaim_locked(
+                    need_new - len(self._host_free), s.priority):
+            return False
+        hblocks = np.empty(len(s.blocks), np.int64)
+        crcs: List[int] = []
+        chain = 0
+        new_host: List[int] = []
+        for k, b in enumerate(s.blocks):
+            b = int(b)
+            data = self._store[b]
+            chain = zlib.crc32(data, chain)
+            crcs.append(chain)
+            hb = self._spill_map.get(b)
+            if hb is None:
+                hb = self._host_free.pop()
+                self._host_store[hb] = data
+                self._spill_map[b] = hb
+                new_host.append(hb)
+            self._host_refs[hb] = self._host_refs.get(hb, 0) + 1
+            hblocks[k] = hb
+        now = self._now()
+        self._spilled[s.session] = _SpilledSession(
+            s.session, s.tenant, s.priority, s.seq_len, s.last_token,
+            s.acc, hblocks, crcs, now)
+        self._free_session_locked(s, "spilled")
+        return True
+
+    # fablint: lock-held(_lock)
+    def _host_reclaim_locked(self, shortage: int,
+                             requester_pri: int) -> bool:
+        """Make room in the HOST arena by dropping the most sheddable
+        spilled sessions — same band/weight/LRU order and the same
+        cumulative refcount simulation as the device picker, fenced to
+        bands no more protected than the demoting session's.  Sessions
+        mid-restore are skipped (their host bytes are being read).
+        Dropped sessions die for real: typed "pressure" shed."""
+        cands = [sp for sess, sp in self._spilled.items()
+                 if sess not in self._restoring
+                 and sp.priority >= requester_pri]
+        cands.sort(key=lambda sp: (-sp.priority,
+                                   self._weight(sp.tenant),
+                                   sp.last_used))
+        victims, have = [], 0
+        sim: Dict[int, int] = {}
+        for sp in cands:
+            if have >= shortage:
+                break
+            victims.append(sp)
+            for h in sp.hblocks:
+                h = int(h)
+                taken = sim.get(h, 0)
+                sim[h] = taken + 1
+                if self._host_refs.get(h, 1) - taken == 1:
+                    have += 1
+        if have < shortage:
+            return False
+        for sp in victims:
+            self._drop_spilled_locked(sp.session)
+            self._recent_evicted[sp.session] = "pressure"
+            while len(self._recent_evicted) > 256:
+                self._recent_evicted.pop(
+                    next(iter(self._recent_evicted)))
+            self.host_evictions << 1
+            self._count("evicted_pressure", sp.tenant)
+        return True
+
+    # fablint: lock-held(_lock)
+    def _drop_spilled_locked(self, session: str) -> None:
+        """Retire one spilled record: decrement its host refcounts,
+        freeing (and unmapping) only the host blocks that hit zero."""
+        sp = self._spilled.pop(session, None)
+        if sp is not None:
+            self._host_unref_locked(sp.hblocks)
+
+    # fablint: lock-held(_lock)
+    def _host_unref_locked(self, hblocks) -> None:
+        dead = []
+        for h in hblocks:
+            h = int(h)
+            r = self._host_refs.get(h, 1) - 1
+            if r <= 0:
+                self._host_refs.pop(h, None)
+                dead.append(h)
+            else:
+                self._host_refs[h] = r
+        if dead:
+            dead_set = set(dead)
+            # a freed host block's device->host mapping is stale: a
+            # later demote must never alias a recycled host slot
+            for b in [b for b, h in self._spill_map.items()
+                      if h in dead_set]:
+                del self._spill_map[b]
+            self._host_free.extend(dead)
+            self._host_free.sort(reverse=True)
+
+    def _maybe_restore(self, session: str) -> None:
+        """Fault a spilled session back in if (and only if) it is
+        host-resident — the cheap pre-check every lookup surface
+        calls before taking its own locked path."""
+        with self._lock:
+            if session in self._tables or session not in self._spilled:
+                return
+        self._restore(session)
+
+    def _restore(self, session: str) -> Optional[_KvSession]:
+        """Bring a spilled session back to the device tier, riding the
+        SAME reserve / fill-outside-the-lock / commit shape as
+        ``load_into``: device blocks reserved under the lock (evicting
+        or demoting others under the session's own priority), the
+        host→device copy and reduction-arena rebuild run OUTSIDE it
+        (the restore holds its own host refcounts so a concurrent drop
+        of the record cannot free the bytes mid-copy), and the commit
+        re-checks under a relock.  The chained CRC recorded at demote
+        is recomputed from the HOST bytes during the copy: any
+        mismatch aborts the restore and the session degrades to a
+        typed "corrupt" re-prefill shed — wrong bytes are never
+        published.  Returns None when the restore could not happen
+        (device saturation, lost race, IO fault) — the caller sheds."""
+        o = self.options
+        bt, bpt = o.block_tokens, o.bytes_per_token
+        t0 = time.perf_counter_ns()
+        while True:
+            with self._lock:
+                s = self._tables.get(session)
+                if s is not None:
+                    return s
+                sp = self._spilled.get(session)
+                if sp is None:
+                    return None
+                if session not in self._restoring:
+                    self._restoring.add(session)
+                    try:
+                        blocks, _ = self._reserve_locked(
+                            session, len(sp.hblocks), sp.priority)
+                    except PoolSaturated:
+                        # no device room even after pressure: the
+                        # session STAYS spilled (retryable shed), the
+                        # host copy intact
+                        self._restoring.discard(session)
+                        return None
+                    for h in sp.hblocks:
+                        self._host_refs[int(h)] += 1
+                    fault = self._spill_fault
+                    break
+            # another thread is restoring this session: wait it out
+            time.sleep(0.0005)
+        # ---- outside the lock: reserved rows have exactly one writer,
+        # and our extra host refs pin the source bytes
+        ok = True
+        io_fail = fault == "restore"
+        if not io_fail:
+            chain = 0
+            for k in range(len(blocks)):
+                data = self._host_store[int(sp.hblocks[k])]
+                chain = zlib.crc32(data, chain)
+                if chain != sp.crcs[k]:
+                    ok = False
+                    break
+                b = int(blocks[k])
+                self._store[b] = data
+                self._pos_sums[b] = self._store[b].reshape(
+                    bt, bpt).sum(axis=1, dtype=np.int64)
+        now = self._now()
+        with self._lock:
+            self._restoring.discard(session)
+            if self._closed:
+                # close() rebuilt the free list and cleared the host
+                # tier — nothing left to return or unref
+                return None
+            self._host_unref_locked(sp.hblocks)
+            if io_fail:
+                # transport failed, host bytes presumed intact: keep
+                # the record, latch the plane, shed
+                self._return_blocks_locked(blocks)
+                self._spill_health.mark_down("restore_io")
+                return None
+            if not ok:
+                # byte verification failed: the host copy is corrupt —
+                # drop it and degrade to a typed re-prefill, NOT a
+                # plane event (corruption is not plane death)
+                self._return_blocks_locked(blocks)
+                if self._spilled.get(session) is sp:
+                    self._drop_spilled_locked(session)
+                self._recent_evicted[session] = "corrupt"
+                while len(self._recent_evicted) > 256:
+                    self._recent_evicted.pop(
+                        next(iter(self._recent_evicted)))
+                self.restore_corrupt << 1
+                return None
+            cur = self._tables.get(session)
+            if cur is not None:
+                # a re-prefill committed fresh bytes mid-restore: the
+                # fresh load wins, our copy aborts
+                self._return_blocks_locked(blocks)
+                return cur
+            if self._spilled.get(session) is not sp:
+                # the record was released/expired/reclaimed mid-copy
+                self._return_blocks_locked(blocks)
+                return None
+            s = _KvSession(session, sp.tenant, sp.priority, sp.seq_len,
+                           sp.last_token, sp.acc, blocks, now)
+            # same commit as a load: prefix dedupe means the FIRST
+            # restored co-owner re-registers the shared blocks and
+            # every later restore maps onto them — one physical copy
+            # restores N sessions
+            self._commit_locked(s, None)
+            self._drop_spilled_locked(session)
+            self.restores << 1
+            self._restore_us.append(
+                (time.perf_counter_ns() - t0) // 1000)
+        return s
+
+    def spill(self, session: str) -> bool:
+        """Demote one session to the host tier NOW — the autoscaler's
+        drain surface (scale-down demotes its live sessions instead of
+        killing them).  A pinned session refuses with
+        :class:`SessionBusy` (it is being read); False when the
+        session is unknown or the host tier cannot take it."""
+        with self._lock:
+            s = self._tables.get(session)
+            if s is None:
+                return False
+            if s.pinned:
+                raise SessionBusy(session)
+            if not self._spill_usable_locked():
+                return False
+            return self._demote_session_locked(s)
+
+    def spilled_sessions(self) -> List[str]:
+        with self._lock:
+            return list(self._spilled)
+
+    def inject_spill_fault(self, mode: Optional[str]) -> None:
+        """Chaos hook: ``"demote"`` fails every demote attempt,
+        ``"restore"`` fails every restore copy (both latch the spill
+        plane down), ``None`` heals."""
+        if mode not in (None, "demote", "restore"):
+            raise ValueError(f"unknown spill fault {mode!r}")
+        with self._lock:
+            self._spill_fault = mode
 
     def release(self, session: str) -> bool:
         """Session finished: return its blocks (the decode-complete
@@ -732,6 +1187,17 @@ class PagedKvPool:
         with self._lock:
             s = self._tables.get(session)
             if s is None:
+                sp = self._spilled.get(session)
+                if sp is not None:
+                    # released while parked in the host tier: drop the
+                    # record directly, no restore round trip.  An
+                    # in-flight restore survives the drop (it holds
+                    # its own host refs for the copy) and its commit
+                    # re-check observes the record identity changed,
+                    # aborting into "released" instead of publishing
+                    self._drop_spilled_locked(session)
+                    self._count("released", sp.tenant)
+                    return True
                 return False
             if s.pinned:
                 s.release_pending = True
@@ -765,6 +1231,7 @@ class PagedKvPool:
         if n <= 0:
             raise ValueError("rows must hold at least one token")
         now = self._now()
+        self._maybe_restore(session)
         with self._lock:
             s = self._tables.get(session)
             if s is None or s.release_pending:
@@ -811,8 +1278,12 @@ class PagedKvPool:
                     self.cow_splits << 1
                 else:
                     # private — but a registered donor's content is
-                    # about to change: drop it from the index
+                    # about to change: drop it from the index, and
+                    # drop any host copy mapped to the OLD bytes so a
+                    # later demote re-copies instead of aliasing stale
+                    # content
                     self._unregister_block_locked(blk)
+                    self._spill_map.pop(blk, None)
             if new_blocks is not None:
                 s.blocks = new_blocks
                 s.contiguous = bool((np.diff(new_blocks) == 1).all())
@@ -837,13 +1308,23 @@ class PagedKvPool:
     # ---- lookup / scheduler surface -----------------------------------
     def get(self, session: str) -> Optional[_KvSession]:
         with self._lock:
-            return self._tables.get(session)
+            s = self._tables.get(session)
+            if s is not None or session not in self._spilled:
+                return s
+        # host-resident: fault it back in (the scheduler's roster add
+        # and every read surface restore transparently)
+        return self._restore(session)
 
     def evicted_reason(self, session: str) -> Optional[str]:
         """Why a recently-missing session is gone ("pressure" /
-        "expired"), so the RPC layer sheds with a typed re-prefill hint
-        instead of an unknown-session error."""
+        "expired" / "corrupt"), so the RPC layer sheds with a typed
+        re-prefill hint instead of an unknown-session error.  A
+        session still PARKED in the host tier answers "spilled": its
+        restore just failed transiently (device saturation / spill
+        plane down) and a retry may succeed without a re-prefill."""
         with self._lock:
+            if session in self._spilled:
+                return "spilled"
             return self._recent_evicted.get(session)
 
     def touch(self, session: str) -> None:
@@ -852,6 +1333,12 @@ class PagedKvPool:
             s = self._tables.get(session)
             if s is not None:
                 s.last_used = now
+            else:
+                sp = self._spilled.get(session)
+                if sp is not None:
+                    # keep-alive reaches the host tier too — touch is
+                    # deliberately NOT a restore trigger
+                    sp.last_used = now
 
     def pin(self, session: str) -> bool:
         """Fence a session against eviction/expiry (step-roster entry
@@ -859,7 +1346,9 @@ class PagedKvPool:
         is gone — including LOGICALLY gone: a deferred release
         (``release_pending``) means the pool already reported this
         session released, so no NEW reader may pin it while the last
-        old reader drains."""
+        old reader drains.  A host-resident session is RESTORED first:
+        a pin is a read-intent, and reads happen on the device tier."""
+        self._maybe_restore(session)
         with self._lock:
             s = self._tables.get(session)
             if s is None or s.release_pending:
@@ -919,6 +1408,7 @@ class PagedKvPool:
         fence) keep the copy, ``is_view=False``, no pin owed — the copy
         is what makes a concurrent eviction safe there, so it stays."""
         o = self.options
+        self._maybe_restore(session)
         with self._lock:
             s = self._tables.get(session)
             if s is None or s.release_pending:
@@ -970,6 +1460,19 @@ class PagedKvPool:
                 if not s.pinned and now - s.last_used > ttl:
                     self._free_session_locked(s, "expired")
                     n += 1
+            for sess, sp in list(self._spilled.items()):
+                # spilled sessions age out on the same TTL — an idle
+                # host tier must not park bytes forever either
+                if sess not in self._restoring \
+                        and now - sp.last_used > ttl:
+                    self._drop_spilled_locked(sess)
+                    self._recent_evicted[sess] = "expired"
+                    while len(self._recent_evicted) > 256:
+                        self._recent_evicted.pop(
+                            next(iter(self._recent_evicted)))
+                    self.expirations << 1
+                    self._count("evicted_expired", sp.tenant)
+                    n += 1
         return n
 
     # ---- lifecycle / observability --------------------------------------
@@ -989,6 +1492,12 @@ class PagedKvPool:
             self._prefix_index.clear()
             self._block_hash.clear()
             self._free = list(range(self.options.num_blocks - 1, -1, -1))
+            self._spilled.clear()
+            self._host_refs.clear()
+            self._spill_map.clear()
+            self._restoring.clear()
+            self._host_free = list(
+                range(self.options.host_blocks - 1, -1, -1))
         if timer is not None:
             from ..bthread.timer_thread import TimerThread
             TimerThread.instance().unschedule(timer)
@@ -1008,6 +1517,12 @@ class PagedKvPool:
                 logical += len(s.blocks)
             shared = sum(1 for r in self._refs.values() if r > 1)
             physical = len(self._refs)
+            host_free = len(self._host_free)
+            spilled_sessions = len(self._spilled)
+            spilled_blocks = len(self._host_refs)
+            restore_us = sorted(self._restore_us)
+            plane = (self._spill_health.snapshot()
+                     if self._spill_health is not None else None)
         with self._counters_lock:
             by_class = {f"{what}[{tenant or 'shared'}]": a.get_value()
                         for (what, tenant), a in self._counters.items()}
@@ -1047,4 +1562,41 @@ class PagedKvPool:
                 "sharing_ratio": (round(logical / physical, 3)
                                   if physical else 1.0),
             },
+            # ISSUE 19: tiered-memory truth — resident vs host-parked
+            # sessions, demote/restore round trips, restore latency,
+            # and the spill plane-health row.  "migration" is the
+            # PROCESS-WIDE pool-to-pool transfer ledger (the counters
+            # live in serving/migration.py)
+            "tiers": self._describe_tiers(
+                sessions, host_free, spilled_sessions, spilled_blocks,
+                restore_us, plane),
         }
+
+    def _describe_tiers(self, resident: int, host_free: int,
+                        spilled_sessions: int, spilled_blocks: int,
+                        restore_us: List[int], plane) -> dict:
+        o = self.options
+        out = {
+            "enabled": (o.host_blocks > 0
+                        and bool(_flags.get_flag("serving_kv_spill"))),
+            "host_blocks_total": o.host_blocks,
+            "host_blocks_free": host_free,
+            "resident_sessions": resident,
+            "spilled_sessions": spilled_sessions,
+            "spilled_blocks": spilled_blocks,
+            "demotions": self.demotions.get_value(),
+            "restores": self.restores.get_value(),
+            "restore_corrupt": self.restore_corrupt.get_value(),
+            "host_evictions": self.host_evictions.get_value(),
+            "restore_p50_us": (restore_us[len(restore_us) // 2]
+                               if restore_us else 0),
+        }
+        if plane is not None:
+            out["plane"] = plane
+        try:
+            from . import migration as _migration
+            out["migration"] = {**_migration.migration_stats(),
+                                "scope": "process"}
+        except Exception:   # pragma: no cover - import cycles only
+            pass
+        return out

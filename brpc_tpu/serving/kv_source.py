@@ -333,3 +333,23 @@ def load_wire_attachment(pool, att: IOBuf, session: str, seq_len: int,
         src.release()
     stats.record(src.route, seq_len * layers * dmodel, 1)
     return s
+
+
+def load_token_major_attachment(pool, att: IOBuf, session: str,
+                                seq_len: int, *, last_token: int,
+                                tenant: str = "",
+                                priority: Optional[int] = None,
+                                sock=None):
+    """The KV MIGRATION ingest (ISSUE 19): the payload is already
+    token-major ``(seq_len, bytes_per_token)`` — a pool-to-pool
+    transfer ships the source pool's row layout verbatim, so there is
+    no layer transpose to undo.  Declaring ``layers=1`` with
+    ``dmodel=bytes_per_token`` makes the wire layout identical to the
+    pool's block rows and the scatter one strided copy per extent;
+    everything else (route accounting, segment custody, the pool's
+    reserve/fill-outside-the-lock/commit with SessionBusy/saturation
+    sheds) is byte-for-byte :func:`load_wire_attachment`."""
+    return load_wire_attachment(
+        pool, att, session, seq_len, 1, pool.options.bytes_per_token,
+        last_token=last_token, tenant=tenant, priority=priority,
+        sock=sock)

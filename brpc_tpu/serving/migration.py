@@ -1,0 +1,213 @@
+"""Live cross-worker KV migration (ISSUE 19, ROADMAP 2c): pool-to-pool
+block transfer so the router/autoscaler REBALANCE a live session onto
+another decode worker instead of re-prefilling around a kill.
+
+The custody story is deliberately conservative — the SOURCE copy stays
+authoritative until the destination has COMMITTED:
+
+  * :func:`migrate_out` PINS the source session (restoring it from the
+    host tier first if it was spilled — a migration is a read), takes
+    an atomic snapshot, and copies the payload bytes UP FRONT.  The
+    copy is what makes the deadline latch safe: a hung ``send`` thread
+    abandoned past the deadline holds its own bytes, so it can never
+    ship arena rows that were freed and reused after the abort.  On a
+    TPU pod the block transfer itself is the pallas
+    ``make_async_remote_copy`` device-plane DMA (SNIPPETS [2]); this
+    host-staged copy is the portable shape and the honest residue.
+  * ``send(meta, payload) -> (ok, err, shed)`` is the caller's wire
+    (the disagg example drives ``Decode.MigrateIn`` on the destination,
+    which loads the token-major payload through the pool's ordinary
+    reserve/fill-outside-the-lock/commit path).  ``shed=True`` marks a
+    CLEAN refusal — destination saturated or the session id busy there
+    — which aborts the migration without degrading the plane.
+  * the "migrate" plane-health row carries the liveness signal the
+    PR-17 residue asked for: a TRANSFER-DEADLINE LATCH.  A send that
+    neither completes nor fails within the deadline marks the plane
+    down (``transfer_deadline``) and the migration aborts with the
+    source intact — a hung peer is detected by the deadline, not by a
+    client in the blast radius, and every later ``migrate_out`` refuses
+    FAST until the timer latch lapses and the plane revives through the
+    standard reprobe/revived/ramp counters.
+  * only after the destination commits does the source release: the
+    caller's ``on_cutover`` (the atomic routing flip —
+    ``LoadAwareRouter.rebind``) runs FIRST, then the source pin drops
+    and the blocks free.  A mid-migration kill of either end leaves the
+    surviving copy authoritative and the router's PR-14 re-prefill
+    retry path covers the gap.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Tuple
+
+from .. import bvar
+from ..butil import debug_sync as _dbg
+from ..butil import flags as _flags
+
+_flags.define_flag(
+    "serving_migrate_deadline_ms", 2000,
+    "transfer-deadline latch for live KV migration: a send that "
+    "neither completes nor fails within this window marks the migrate "
+    "plane down and the migration aborts with the source copy intact")
+
+_flags.define_flag(
+    "serving_migrate_reprobe_s", 0.5,
+    "migrate plane-health timer latch: how long after a transfer "
+    "deadline / peer failure before the next migrate_out re-probes "
+    "the plane optimistically")
+
+
+class _MigrationStats:
+    """Process-wide migration ledger: the /status serving ``tiers``
+    block's ``migration`` half and the chaos tests' assertion surface.
+    Adders are write-local; the last-abort diagnostic is the guarded
+    half."""
+
+    _GUARDED_BY = {"_last_abort": "_lock"}
+
+    def __init__(self):
+        self._lock = _dbg.make_lock("migration._MigrationStats._lock")
+        self._last_abort = ""
+        self.migrations_out = bvar.Adder("serving_kv_migrations_out")
+        self.migrations_in = bvar.Adder("serving_kv_migrations_in")
+        self.cutovers = bvar.Adder("serving_kv_migration_cutovers")
+        self.aborts = bvar.Adder("serving_kv_migration_aborts")
+        self.bytes_moved = bvar.Adder("serving_kv_migration_bytes")
+
+    def abort(self, reason: str) -> None:
+        self.aborts << 1
+        with self._lock:
+            self._last_abort = reason
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            last = self._last_abort
+        return {
+            "migrations_out": self.migrations_out.get_value(),
+            "migrations_in": self.migrations_in.get_value(),
+            "cutovers": self.cutovers.get_value(),
+            "aborts": self.aborts.get_value(),
+            "bytes_moved": self.bytes_moved.get_value(),
+            "last_abort": last,
+        }
+
+
+stats = _MigrationStats()
+
+_health = None
+_health_lock = _dbg.make_lock("migration._health_lock")
+
+
+def migrate_health():
+    """The process-wide "migrate" plane-health row (timer-latch
+    policy), created lazily so a process that never migrates never
+    registers the plane."""
+    global _health
+    with _health_lock:
+        if _health is None:
+            from ..ici.plane_health import register_plane
+            _health = register_plane(
+                "migrate",
+                retry_s=lambda: float(_flags.get_flag(
+                    "serving_migrate_reprobe_s")))
+        return _health
+
+
+def migration_stats() -> dict:
+    """Ledger + plane row — ``describe()['tiers']['migration']``,
+    rpc_press's serving summary, and the chaos assertions read this."""
+    out = stats.snapshot()
+    with _health_lock:
+        h = _health
+    if h is not None:
+        out["plane"] = h.snapshot()
+    return out
+
+
+def migrate_out(pool, session: str,
+                send: Callable[[dict, bytes],
+                               Tuple[bool, str, bool]], *,
+                scheduler=None,
+                on_cutover: Optional[Callable[[], None]] = None,
+                deadline_ms: Optional[int] = None) -> Tuple[bool, str]:
+    """Move one session's KV to another worker's pool.  Returns
+    ``(ok, reason)`` — every failure leaves the SOURCE copy
+    authoritative and serving.
+
+    ``send(meta, payload)`` ships the token-major payload to the
+    destination and returns ``(ok, err, shed)``; it runs on its own
+    thread under the transfer-deadline latch.  ``scheduler`` (when
+    given) fences sessions the decode roster owns — migrating a
+    session mid-decode would cut over under a running batched step.
+    ``on_cutover`` is the atomic routing flip, invoked after the
+    destination committed and BEFORE the source releases."""
+    health = migrate_health()
+    if not health.usable():
+        # the plane is latched down (hung peer / dead transfer): refuse
+        # fast, no client in the blast radius
+        stats.abort("plane down")
+        return False, "migrate plane down (latched): retry later"
+    if scheduler is not None and scheduler.owns(session):
+        stats.abort("session decoding")
+        return False, f"session {session!r} is decoding: drain first"
+    if not pool.pin(session):
+        stats.abort("unknown session")
+        return False, f"unknown session {session!r}"
+    try:
+        snap = pool.snapshot(session)
+        s = pool.get(session)
+        if snap is None or s is None:
+            stats.abort("session vanished")
+            return False, f"session {session!r} vanished under the pin"
+        rows, seq_len, last_token = snap
+        meta = {"session": session, "seq_len": int(seq_len),
+                "last_token": int(last_token), "tenant": s.tenant,
+                "priority": int(s.priority)}
+        # the up-front copy: after this line the send thread owns its
+        # own bytes — an abandoned (deadline-latched) sender can never
+        # read arena rows the abort path freed for reuse
+        payload = rows.tobytes()
+        result = {}
+        done = threading.Event()
+
+        def _runner():
+            try:
+                result["r"] = send(meta, payload)
+            except Exception as e:   # a raising send is a dead peer
+                result["r"] = (False, f"{type(e).__name__}: {e}", False)
+            finally:
+                done.set()
+
+        dl_ms = deadline_ms if deadline_ms is not None else int(
+            _flags.get_flag("serving_migrate_deadline_ms"))
+        # fablint: thread-quiesced(daemon sender owns a private payload copy; abandoned past the deadline it can only set an Event nobody waits on)
+        threading.Thread(target=_runner, name="kv_migrate_send",
+                         daemon=True).start()
+        if not done.wait(dl_ms / 1000.0):
+            # the PR-17 residue fix: a hung peer is DETECTED here, by
+            # the transfer deadline, and latches the plane down — not
+            # by some later client timing out into the blast radius
+            health.mark_down("transfer_deadline")
+            stats.abort("transfer deadline")
+            return False, (f"transfer exceeded {dl_ms}ms deadline: "
+                           "migrate plane latched down")
+        ok, err, shed = result["r"]
+        if not ok:
+            if not shed:
+                # transport-level failure (dead socket, refused
+                # connection): the peer, not the payload, is the
+                # problem — latch the plane
+                health.mark_down("peer_unreachable")
+            stats.abort(err or "send failed")
+            return False, err or "send failed"
+        # destination committed: cut over, then (and only then) let
+        # the source copy go
+        stats.migrations_out << 1
+        stats.bytes_moved << len(payload)
+        if on_cutover is not None:
+            on_cutover()
+        stats.cutovers << 1
+    finally:
+        pool.unpin(session)
+    pool.release(session)
+    return True, ""

@@ -35,9 +35,14 @@ class LoadAwareRouter:
     _GUARDED_BY = {
         "_channels": "_lock",
         "_picks": "_lock",
+        "_affinity": "_lock",
+        "_rebinds": "_lock",
         "_refresher": "_lock",
         "_closed": "_lock",
     }
+
+    # session-affinity cardinality cap: session ids are wire input
+    MAX_BOUND_SESSIONS = 8192
 
     def __init__(self, targets, channel_options=None,
                  refresh_interval_s: float = 0.5):
@@ -48,6 +53,12 @@ class LoadAwareRouter:
         self._lb = LocalityAwareLB()
         self._channels: Dict[str, object] = {}
         self._picks: Dict[str, int] = {}
+        # session -> decode worker url: the live-migration cutover
+        # surface (ISSUE 19).  A rebind IS the atomic routing flip —
+        # one dict write under the lock, so a reader sees the old
+        # worker or the new one, never neither
+        self._affinity: Dict[str, str] = {}
+        self._rebinds = 0
         self._closed = False
         self._refresher: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -153,6 +164,39 @@ class LoadAwareRouter:
                  latency_us: int) -> None:
         self._lb.feedback(parse_endpoint(url), error_code, latency_us)
 
+    # ---- session affinity (ISSUE 19: the migration cutover flip) -------
+    def bind_session(self, session: str, url: str) -> None:
+        """Pin a live session to the decode worker holding its KV, so
+        follow-up decodes (and a migration's cutover) route by session,
+        not by weight."""
+        with self._lock:
+            while len(self._affinity) >= self.MAX_BOUND_SESSIONS:
+                self._affinity.pop(next(iter(self._affinity)))
+            self._affinity[session] = url
+
+    def session_url(self, session: str) -> Optional[str]:
+        with self._lock:
+            return self._affinity.get(session)
+
+    def rebind(self, session: str, url: str) -> Optional[str]:
+        """The ATOMIC cutover: point a session's affinity at the
+        migration destination.  Returns the previous binding (None if
+        unbound) — the caller that owns the source copy uses it to
+        release after the flip, never before."""
+        with self._lock:
+            prev = self._affinity.get(session)
+            while session not in self._affinity \
+                    and len(self._affinity) >= self.MAX_BOUND_SESSIONS:
+                self._affinity.pop(next(iter(self._affinity)))
+            self._affinity[session] = url
+            if prev is not None and prev != url:
+                self._rebinds += 1
+            return prev
+
+    def unbind(self, session: str) -> None:
+        with self._lock:
+            self._affinity.pop(session, None)
+
     # ---- lifecycle / observability --------------------------------------
     def close(self) -> None:
         self._stop.set()
@@ -171,9 +215,12 @@ class LoadAwareRouter:
         pick distribution per decode worker."""
         with self._lock:
             picks = dict(self._picks)
+            bound = len(self._affinity)
+            rebinds = self._rebinds
         weights = {}
         for e in self._lb.servers():
             weights[str(e.endpoint)] = round(
                 self._lb.weight_of(e.endpoint), 1)
         return {"balancer": "la", "weights": weights, "picks": picks,
+                "sessions_bound": bound, "rebinds": rebinds,
                 "naming": self._naming_url or "static"}

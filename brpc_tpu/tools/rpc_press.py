@@ -316,7 +316,9 @@ def run_press_serving(server: str, duration: float = 5.0,
     block (pool occupancy, step rate, batch occupancy) for every
     in-process serving server, plus each in-process pool's
     ``kv_prefix`` CoW block (shared_blocks / prefix_hits /
-    sharing_ratio, ISSUE 16)."""
+    sharing_ratio, ISSUE 16) and ``kv_tiers`` tiered-memory block
+    (spilled sessions, demote/restore round trips, the spill plane
+    row, and the process-wide migration ledger, ISSUE 19)."""
     import concurrent.futures
     import json as _json
 
@@ -489,6 +491,19 @@ def run_press_serving(server: str, duration: float = 5.0,
             and "prefix" in blk["pool"]}
         if prefix:
             result["kv_prefix"] = prefix
+        # tiered-memory truth (ISSUE 19): each in-process pool's
+        # host-tier block — resident vs spilled sessions, demote /
+        # restore round trips with restore_p50_us, the spill
+        # plane-health row, and the process-wide migration ledger
+        # (migrations in/out, cutovers, aborts, bytes_moved).  Same
+        # in-process gate: remote-only runs omit it.
+        tiers = {
+            label: blk["pool"]["tiers"]
+            for label, blk in stats.items()
+            if isinstance(blk.get("pool"), dict)
+            and "tiers" in blk["pool"]}
+        if tiers:
+            result["kv_tiers"] = tiers
     print(json.dumps(result), file=out)
     for ch in channels:
         ch.close()

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 from typing import Dict, Optional, Union
 
@@ -53,8 +54,10 @@ from brpc_tpu.serving import (BatchSchedulerOptions,
                               ContinuousBatchScheduler, KvPoolOptions,
                               LoadAwareRouter, PagedKvPool, PoolSaturated,
                               SessionBusy, StepRequest, kv_load_stats,
-                              load_wire_attachment)
+                              load_token_major_attachment,
+                              load_wire_attachment, migrate_out)
 from brpc_tpu.serving import kv_source as _kv_source
+from brpc_tpu.serving import migration as _migration
 from examples.example_echo_pb2 import EchoRequest, EchoResponse
 
 from .model import (KV_DMODEL, KV_LAYERS, VOCAB, kv_nbytes, toy_decode,
@@ -144,11 +147,13 @@ class DecodeService(rpc.Service):
     # ("loads" stays out of the guard map: the analyzer would match the
     # attribute name on any receiver, including json.loads — the counter
     # is still only written under _lock)
-    _GUARDED_BY = {"kv_bytes_in": "_lock", "decode_steps": "_lock"}
+    _GUARDED_BY = {"kv_bytes_in": "_lock", "decode_steps": "_lock",
+                   "_channels": "_lock"}
 
     def __init__(self, device=None,
                  pool_options: Optional[KvPoolOptions] = None,
-                 sched_options: Optional[BatchSchedulerOptions] = None):
+                 sched_options: Optional[BatchSchedulerOptions] = None,
+                 channel_options: Optional[rpc.ChannelOptions] = None):
         self.device = device
         self.pool = PagedKvPool(pool_options or KvPoolOptions(
             bytes_per_token=BYTES_PER_TOKEN, num_blocks=1024,
@@ -157,6 +162,13 @@ class DecodeService(rpc.Service):
             self.pool, sched_options or BatchSchedulerOptions(
                 vocab=VOCAB, max_batch=64))
         self._lock = _dbg.make_lock("DecodeService._lock")
+        self.channel_options = channel_options or rpc.ChannelOptions(
+            timeout_ms=60000)
+        self._channels: Dict[str, rpc.Channel] = {}   # migrate peers
+        #: chaos hook (ISSUE 19): an UNSET Event here black-holes
+        #: MigrateIn — the handler parks until the test releases it,
+        #: so the source's transfer-deadline latch is what fires
+        self.migrate_in_gate: Optional[threading.Event] = None
         self.loads = 0
         self.kv_bytes_in = 0
         self.decode_steps = 0
@@ -174,6 +186,19 @@ class DecodeService(rpc.Service):
     def close(self) -> None:
         self.scheduler.stop()
         self.pool.close()
+        with self._lock:
+            chans, self._channels = list(self._channels.values()), {}
+        for ch in chans:
+            ch.close()
+
+    def _channel_to(self, target: str) -> rpc.Channel:
+        with self._lock:
+            ch = self._channels.get(target)
+            if ch is None:
+                ch = rpc.Channel()
+                ch.init(target, options=self.channel_options)
+                self._channels[target] = ch
+            return ch
 
     def describe_serving(self) -> dict:
         """The /status serving block: step rate, batch occupancy, pool
@@ -259,6 +284,91 @@ class DecodeService(rpc.Service):
             self.loads += 1
             self.kv_bytes_in += want
         _reply(response, done, session=session, loaded=want)
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def MigrateIn(self, cntl, request, response, done):
+        """Destination half of a live migration (ISSUE 19): a peer
+        pool's TOKEN-MAJOR block payload lands through the ordinary
+        reserve/fill-outside-the-lock/commit path.  Refusals are the
+        same retryable sheds as LoadKv — a saturated or busy
+        destination aborts the migration cleanly, the SOURCE copy
+        stays authoritative, no plane event."""
+        gate = self.migrate_in_gate
+        if gate is not None:
+            gate.wait()          # chaos: black-hole until released
+        req = json.loads(request.message)
+        session = req["session"]
+        seq_len = req["seq_len"]
+        bpt = self.pool.options.bytes_per_token
+        want = seq_len * bpt
+        att = cntl.request_attachment
+        if seq_len <= 0 or len(att) != want:
+            cntl.set_failed(rpc.errors.EREQUEST,
+                            f"migrate payload {len(att)} != {want}")
+            done()
+            return
+        try:
+            load_token_major_attachment(
+                self.pool, att, session, seq_len,
+                last_token=req["last_token"],
+                tenant=req.get("tenant", ""),
+                priority=req.get("priority"))
+            att.clear()
+        except PoolSaturated:
+            cntl.retry_after_ms = 20
+            cntl.set_failed(rpc.errors.ELIMIT,
+                            "kv pool saturated (shed): migration "
+                            "refused, source stays authoritative")
+            done()
+            return
+        except SessionBusy as e:
+            cntl.retry_after_ms = 10
+            cntl.set_failed(rpc.errors.ELIMIT, str(e))
+            done()
+            return
+        _migration.stats.migrations_in << 1
+        with self._lock:
+            self.kv_bytes_in += want
+        _reply(response, done, session=session, loaded=want)
+
+    @rpc.method(EchoRequest, EchoResponse)
+    def MigrateOut(self, cntl, request, response, done):
+        """Source half: ship one session to the ``dest`` decode worker
+        (``Decode.MigrateIn`` there) under the transfer-deadline
+        plane-health latch.  The source copy serves until the
+        destination commits; only then is it released — an abort at
+        any point leaves the source authoritative and reads as a
+        retryable shed to the caller."""
+        req = json.loads(request.message)
+        session = req["session"]
+        dest = req["dest"]
+        ch = self._channel_to(dest)
+
+        def send(meta, payload):
+            mc = rpc.Controller()
+            mc.request_attachment.append(payload)
+            ch.call_method("Decode.MigrateIn", mc,
+                           EchoRequest(message=json.dumps(meta)),
+                           EchoResponse)
+            if mc.failed():
+                # ELIMIT from the destination is a clean shed
+                # (saturated/busy), not a dead peer
+                return (False, mc.error_text,
+                        mc.error_code_ == rpc.errors.ELIMIT)
+            return True, "", False
+        ok, err = migrate_out(
+            self.pool, session, send, scheduler=self.scheduler,
+            deadline_ms=req.get("deadline_ms"))
+        if not ok:
+            # every abort is a shed: the source copy still serves, a
+            # retry (here or around a re-prefill) stays cheap
+            cntl.retry_after_ms = 10
+            cntl.set_failed(rpc.errors.ELIMIT,
+                            f"migration failed (shed): {err}")
+            done()
+            return
+        _reply(response, done, session=session, migrated=True,
+               dest=dest)
 
     @rpc.method(EchoRequest, EchoResponse)
     def Decode(self, cntl, request, response, done):

@@ -1,0 +1,43 @@
+"""Seeded violation for the tiered KV pool (ISSUE 19): a spill-capable
+pool that demotes a session to the host arena WITHOUT re-acquiring the
+lock for the publish — the host-block copy outside the lock is fine
+(the host slot was popped off the free list under the lock, nothing
+else can touch it), but publishing the spilled record and bumping the
+host refcount lock-free races a concurrent release/restore of the same
+session: the refcount the restore path decrements may not exist yet,
+leaking the host block forever — the shape
+``PagedKvPool._demote_session_locked`` exists to prevent."""
+import threading
+
+
+class KvSpillPool:
+    _GUARDED_BY = {"_spilled": "_lock", "_host_refs": "_lock",
+                   "_host_free": "_lock"}
+
+    def __init__(self, store, host_store):
+        self._lock = threading.Lock()
+        self._spilled = {}
+        self._host_refs = {}
+        self._host_free = list(range(8))
+        self._store = store
+        self._host_store = host_store
+
+    def demote_unchecked(self, session, blk):
+        with self._lock:
+            hb = self._host_free.pop()
+        self._host_store[hb] = self._store[blk]   # unlocked copy: fine
+        self._host_refs[hb] = 1          # line 29: refcount, no lock
+        self._spilled[session] = hb      # line 30: publish, no lock
+        return hb
+
+    def demote_checked(self, session, blk):
+        with self._lock:
+            hb = self._host_free.pop()
+        self._host_store[hb] = self._store[blk]
+        with self._lock:                 # the publish-time re-check
+            if session in self._spilled:
+                self._host_free.append(hb)
+                return self._spilled[session]
+            self._host_refs[hb] = self._host_refs.get(hb, 0) + 1
+            self._spilled[session] = hb
+        return hb

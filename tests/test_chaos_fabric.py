@@ -1769,3 +1769,209 @@ def test_chaos_plane_handshake_parity_via_rpc_dump_goldens():
     outs = _run_pair(_PLANE_PARITY % {"repo": REPO}, timeout=300)
     assert "PP0_OK" in outs[0]
     assert "PP1_OK" in outs[1]
+
+
+# ---------------------------------------------------------------------------
+# Live KV migration under chaos (ISSUE 19): kill the destination, hang
+# the transfer (black-hole), kill the source post-cutover — all mid-soak
+# with ZERO client-visible failures and a bit-exact token stream.
+# ---------------------------------------------------------------------------
+
+class TestLiveMigrationChaos:
+    @staticmethod
+    def _decode_worker(name):
+        from examples.disagg_serving.workers import DecodeService
+        server = rpc.Server()
+        svc = DecodeService()
+        server.add_service(svc)
+        assert server.start(f"mem://{name}") == 0
+        return server, svc
+
+    def test_migration_chaos_matrix_zero_client_failures(self):
+        """The acceptance leg: a client decodes one live session the
+        whole time (the soak) while the operator path migrates it A→B
+        through three injected faults — (a) destination KILLED so the
+        transfer dies at the wire, (b) destination BLACK-HOLED (the
+        MigrateIn handler parks on an unset gate) so the PR-17-residue
+        transfer-deadline latch is what detects the hang, then the
+        plane revives through the timer latch and the migration lands
+        with the cutover flip, (c) the SOURCE killed post-cutover.  The
+        soak sees zero failures and its concatenated token stream is
+        bit-exact against the single-process reference."""
+        import json as _json
+
+        import numpy as np
+
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.ici.route import plane_stats
+        from brpc_tpu.serving import LoadAwareRouter, migration_stats
+        from examples.disagg_serving import model as m
+        from examples.example_echo_pb2 import EchoRequest, EchoResponse
+
+        url_a, url_b = "mem://mig-a", "mem://mig-b"
+        server_a, svc_a = self._decode_worker("mig-a")
+        server_b, svc_b = self._decode_worker("mig-b")
+        router = LoadAwareRouter([url_a, url_b])
+        chans = {}
+
+        def chan(url):
+            ch = chans.get(url)
+            if ch is None:
+                ch = rpc.Channel()
+                ch.init(url, options=rpc.ChannelOptions(
+                    timeout_ms=30000, max_retry=0))
+                chans[url] = ch
+            return ch
+
+        def call(url, method, body, deadline=None):
+            cntl = rpc.Controller()
+            resp = chan(url).call_method(
+                f"Decode.{method}", cntl,
+                EchoRequest(message=_json.dumps(body)), EchoResponse)
+            return cntl, resp
+
+        toks = [(7 * j) % 499 for j in range(24)]
+        kv = np.asarray(m.toy_kv_blocks(toks)).tobytes()
+        lc = rpc.Controller()
+        lc.request_attachment.append(kv)
+        chan(url_a).call_method("Decode.LoadKv", lc, EchoRequest(
+            message=_json.dumps({"session": "s", "seq_len": len(toks),
+                                 "last_token": toks[-1]})),
+            EchoResponse)
+        assert not lc.failed(), lc.error_text
+        router.bind_session("s", url_a)
+
+        # the soak: ONE live session decoding the whole time, routed by
+        # affinity.  quiesce serializes client decodes against the
+        # operator's migrate+flip so the test's bit-exactness assert is
+        # deterministic (in production the scheduler fence + the
+        # last-commit-wins reload cover the overlap)
+        quiesce = threading.Lock()
+        stop = threading.Event()
+        stream, failures = [], []
+
+        def soak():
+            while not stop.is_set():
+                with quiesce:
+                    url = router.session_url("s")
+                    cntl, resp = call(url, "Decode",
+                                      {"session": "s", "steps": 2,
+                                       "release": False})
+                    if cntl.failed():
+                        failures.append((url, cntl.error_code_,
+                                         cntl.error_text))
+                    else:
+                        stream.extend(
+                            _json.loads(resp.message)["tokens"])
+                time.sleep(0.002)
+
+        t = threading.Thread(target=soak, daemon=True)
+        before = plane_stats()
+        st0 = migration_stats()
+        try:
+            _fl.set_flag("serving_migrate_reprobe_s", 0.2)
+            t.start()
+            deadline = time.monotonic() + 10
+            while len(stream) < 4 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(stream) >= 4, "soak never produced tokens"
+
+            # ---- leg (a): destination KILLED pre-commit ----
+            with quiesce:
+                svc_b.close()
+                server_b.stop()
+                cntl, _ = call(url_a, "MigrateOut",
+                               {"session": "s", "dest": url_b})
+                assert cntl.failed()
+                assert cntl.error_code_ == errors.ELIMIT
+                st = migration_stats()
+                assert st["plane"]["state"] == "down"
+                assert st["plane"]["reason"] == "peer_unreachable"
+                # the source copy never stopped being authoritative
+                assert svc_a.pool.get("s") is not None
+            time.sleep(0.05)          # soak decodes on A meanwhile
+
+            # restart B; the plane is STILL latched: the next migrate
+            # refuses fast, without even dialing the peer
+            server_b, svc_b = self._decode_worker("mig-b")
+            with quiesce:
+                t0 = time.monotonic()
+                cntl, _ = call(url_a, "MigrateOut",
+                               {"session": "s", "dest": url_b})
+                assert cntl.failed() and "latched" in cntl.error_text
+                assert time.monotonic() - t0 < 1.0
+            time.sleep(0.25)          # the timer latch lapses
+
+            # ---- leg (b): destination BLACK-HOLED (hung transfer) ----
+            with quiesce:
+                gate = threading.Event()        # unset: park MigrateIn
+                svc_b.migrate_in_gate = gate
+                cntl, _ = call(url_a, "MigrateOut",
+                               {"session": "s", "dest": url_b,
+                                "deadline_ms": 250})
+                assert cntl.failed() and "deadline" in cntl.error_text
+                st = migration_stats()
+                assert st["plane"]["state"] == "down"
+                assert st["plane"]["reason"] == "transfer_deadline"
+                assert svc_a.pool.get("s") is not None
+                # latched again: fast refusal while the peer still hangs
+                cntl, _ = call(url_a, "MigrateOut",
+                               {"session": "s", "dest": url_b})
+                assert cntl.failed() and "latched" in cntl.error_text
+                # un-black-hole: the parked transfer drains, the latch
+                # lapses, and the SAME migration now lands
+                gate.set()
+                svc_b.migrate_in_gate = None
+                time.sleep(0.3)
+                cntl, resp = call(url_a, "MigrateOut",
+                                  {"session": "s", "dest": url_b,
+                                   "deadline_ms": 5000})
+                assert not cntl.failed(), cntl.error_text
+                assert _json.loads(resp.message)["migrated"]
+                # the atomic cutover flip, then the source is gone
+                assert router.rebind("s", url_b) == url_a
+                assert svc_a.pool.get("s") is None
+                assert svc_b.pool.get("s") is not None
+            time.sleep(0.05)          # soak decodes on B now
+
+            # ---- leg (c): SOURCE killed post-cutover ----
+            with quiesce:
+                svc_a.close()
+                server_a.stop()
+            time.sleep(0.05)          # soak unaffected: affinity → B
+        finally:
+            stop.set()
+            t.join(10)
+            _fl.set_flag("serving_migrate_reprobe_s", 0.5)
+
+        # ---- verdicts -------------------------------------------------
+        assert failures == [], failures
+        assert len(stream) >= 10
+        # every 2-step decode restarts from the session's stored KV
+        # (decode does not persist generated tokens), so the soak's
+        # stream is the reference pair repeated — INCLUDING every chunk
+        # decoded on B after the cutover: the migrated bytes are the
+        # source bytes
+        want = m.reference_generate(toks, 2)
+        assert stream == want * (len(stream) // 2)
+        st = migration_stats()
+        assert st["migrations_out"] >= st0["migrations_out"] + 1
+        assert st["migrations_in"] >= st0["migrations_in"] + 1
+        assert st["aborts"] >= st0["aborts"] + 4
+        after = plane_stats()
+        # leg (a) peer death + leg (b) deadline = two down transitions,
+        # each revived through the standard reprobe counters
+        assert after.get("migrate_down", 0) \
+            >= before.get("migrate_down", 0) + 2
+        assert after.get("migrate_revived", 0) \
+            >= before.get("migrate_revived", 0) + 2
+        assert router.describe()["rebinds"] == 1
+
+        for ch in chans.values():
+            ch.close()
+        router.close()
+        try:
+            svc_b.close()
+            server_b.stop()
+        except Exception:
+            pass

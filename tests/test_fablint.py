@@ -154,6 +154,21 @@ class TestFixtureViolations:
         assert "_tables" in out[0].message and "_lock" in out[0].message
         assert out[0].path.endswith("bad_kv_cow.py")
 
+    def test_unlocked_spill_publish_reported_with_lines(self):
+        """The tiered KV pool (ISSUE 19): demoting a session to the
+        host arena must publish the spilled record AND bump the host
+        refcount under the lock — a lock-free publish races a
+        concurrent release/restore (the refcount the restore
+        decrements may not exist yet, leaking the host block), caught
+        at both exact file:lines."""
+        out = _findings("bad_kv_spill.py", fablint.CONCURRENCY_RULES)
+        assert [(f.rule, f.line) for f in out] == [
+            ("guarded-state", 29), ("guarded-state", 30)]
+        assert "_host_refs" in out[0].message
+        assert "_spilled" in out[1].message
+        assert all("_lock" in f.message for f in out)
+        assert out[0].path.endswith("bad_kv_spill.py")
+
     def test_rogue_plane_state_machine_reported_with_lines(self):
         """ISSUE 17: a plane growing its own down/reestablish machine —
         private state fields plus a hand-rolled revival thread — is
@@ -265,7 +280,8 @@ class TestZeroFindingsGate:
                "policy/load_balancers.py", "butil/resource_pool.py",
                "bthread/scheduler.py", "serving/kv_pool.py",
                "serving/kv_source.py", "serving/scheduler.py",
-               "serving/autoscaler.py"]
+               "serving/autoscaler.py", "serving/router.py",
+               "serving/migration.py"]
         for rel in hot:
             src = open(os.path.join(PKG, rel)).read()
             assert "_GUARDED_BY" in src, f"{rel} lost its guard map"

@@ -1291,6 +1291,576 @@ class TestKvPrefixSharing:
 
 
 # ---------------------------------------------------------------------------
+# Tiered KV memory: host-tier spill / restore (ISSUE 19).
+# ---------------------------------------------------------------------------
+
+class TestKvTiers:
+    def test_pressure_demotes_and_restore_is_byte_exact(self):
+        """The tentpole invariant: the victim the PR-16 picker would
+        have EVICTED instead demotes to the host tier, and the next
+        lookup faults it back in byte-exact."""
+        pool = _mk_pool(num_blocks=4, block_tokens=8, host_blocks=8)
+        try:
+            ta = [(3 * j) % 499 for j in range(16)]
+            tb = [(5 * j + 1) % 499 for j in range(16)]
+            pool.load("a", _rows(ta), last_token=ta[-1])
+            pool.load("b", _rows(tb), last_token=tb[-1])
+            # pressure: "a" (LRU) demotes instead of dying
+            tc = [(7 * j + 2) % 499 for j in range(16)]
+            pool.load("c", _rows(tc), last_token=tc[-1])
+            assert pool.spilled_sessions() == ["a"]
+            assert pool.evicted_reason("a") == "spilled"
+            d = pool.describe()["tiers"]
+            assert d["demotions"] == 1 and d["spilled_sessions"] == 1
+            assert d["spilled_blocks"] == 2
+            assert d["host_blocks_free"] == 6
+            # restore (transparent, via materialize→get): "b" demotes
+            # to make device room, "a" comes back byte-exact
+            assert np.array_equal(pool.materialize("a"), _rows(ta))
+            assert "a" not in pool.spilled_sessions()
+            d = pool.describe()["tiers"]
+            assert d["restores"] == 1 and d["restore_p50_us"] > 0
+            assert d["plane"]["state"] == "up"
+            # nobody died: zero evictions, all three sessions live
+            assert pool.evictions.get_value() == 0
+            for name, toks in (("a", ta), ("b", tb), ("c", tc)):
+                assert np.array_equal(pool.materialize(name),
+                                      _rows(toks)), name
+        finally:
+            pool.close()
+
+    def test_spill_off_flag_is_the_pr16_eviction_ab(self):
+        from brpc_tpu.butil import flags as _fl
+        pool = _mk_pool(num_blocks=2, block_tokens=8, host_blocks=8)
+        try:
+            _fl.set_flag("serving_kv_spill", False)
+            ta = [3] * 16
+            pool.load("a", _rows(ta), last_token=3)
+            pool.load("b", _rows([5] * 16), last_token=5)
+            assert pool.spilled_sessions() == []
+            assert pool.get("a") is None
+            assert pool.evicted_reason("a") == "pressure"
+        finally:
+            _fl.set_flag("serving_kv_spill", True)
+            pool.close()
+
+    def test_corrupt_host_copy_degrades_to_reprefill(self):
+        """Byte verification on restore: a corrupted host block makes
+        the restore ABORT into a typed "corrupt" re-prefill shed —
+        wrong bytes are never published, and the plane stays up
+        (corruption is not plane death)."""
+        pool = _mk_pool(num_blocks=2, block_tokens=8, host_blocks=4)
+        try:
+            ta = [(3 * j) % 499 for j in range(16)]
+            pool.load("a", _rows(ta), last_token=ta[-1])
+            pool.load("b", _rows([5] * 16), last_token=5)   # spills a
+            assert pool.spilled_sessions() == ["a"]
+            hb = int(pool._spilled["a"].hblocks[0])
+            pool._host_store[hb, 7] ^= 0xFF                 # flip a byte
+            assert pool.get("a") is None
+            assert pool.materialize("a") is None
+            assert pool.evicted_reason("a") == "corrupt"
+            # the restore's own reservation demoted "b" first; only
+            # "a"'s corrupt record died
+            assert pool.spilled_sessions() == ["b"]
+            d = pool.describe()["tiers"]
+            assert d["restore_corrupt"] == 1 and d["restores"] == 0
+            assert d["plane"]["state"] == "up"
+            # "a"'s 2 host blocks reclaimed; "b" still holds 2
+            assert d["host_blocks_free"] == 2
+            # the surviving session's bytes never moved
+            assert np.array_equal(pool.materialize("b"), _rows([5] * 16))
+            assert pool.describe()["tiers"]["host_blocks_free"] == 4
+        finally:
+            pool.close()
+
+    def test_shared_prefix_spills_once_restores_n(self):
+        """A refcounted shared block spills ONE host copy and restores
+        N sessions: demote both co-owners, census the host arena, then
+        restore both and assert the dedupe re-shares the blocks."""
+        pool = _mk_pool(num_blocks=8, block_tokens=8, host_blocks=4)
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]   # 2 FULL blocks
+            pool.load("a", _rows(toks), last_token=toks[-1])
+            pool.load("b", _rows(toks), last_token=toks[-1])
+            assert pool.describe()["prefix"]["shared_blocks"] == 2
+            assert pool.spill("a") and pool.spill("b")
+            d = pool.describe()["tiers"]
+            assert d["spilled_sessions"] == 2
+            # the 2 shared device blocks took 2 host blocks TOTAL (one
+            # copy each), not 4 — the co-owner rode the _spill_map
+            assert d["host_blocks_free"] == 2
+            assert d["spilled_blocks"] == 2
+            assert all(r == 2 for r in pool._host_refs.values())
+            # restore both: first re-registers, second dedupes onto it
+            assert np.array_equal(pool.materialize("a"), _rows(toks))
+            assert np.array_equal(pool.materialize("b"), _rows(toks))
+            sa, sb = pool.get("a"), pool.get("b")
+            assert np.array_equal(sa.blocks, sb.blocks)
+            assert all(pool._refs[int(x)] == 2 for x in sa.blocks)
+            d = pool.describe()["tiers"]
+            assert d["restores"] == 2 and d["spilled_sessions"] == 0
+            assert d["host_blocks_free"] == 4 and not pool._host_refs
+        finally:
+            pool.close()
+
+    def test_pinned_session_refuses_spill(self):
+        pool = _mk_pool(num_blocks=4, block_tokens=8, host_blocks=4)
+        try:
+            from brpc_tpu.serving import SessionBusy
+            pool.load("a", _rows([3] * 16), last_token=3)
+            assert pool.pin("a")
+            with pytest.raises(SessionBusy):
+                pool.spill("a")
+            pool.unpin("a")
+            assert pool.spill("a")
+            assert pool.spilled_sessions() == ["a"]
+        finally:
+            pool.close()
+
+    def test_picker_prefers_whole_shared_set_over_unshared(self):
+        """Satellite 2: with demotion available the picker takes the
+        whole shared-owner GROUP (higher per-victim yield once the set
+        completes) before any unshared live session, and the cumulative
+        free-bytes simulation stays exact: what the picker promised is
+        exactly what demotion freed."""
+        pool = _mk_pool(num_blocks=6, block_tokens=8, host_blocks=8)
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]   # 2 full blocks
+            pool.load("s1", _rows(toks), last_token=toks[-1])
+            pool.load("s2", _rows(toks), last_token=toks[-1])  # shares
+            pool.load("u", _rows([7] * 16), last_token=7)  # unshared
+            # census: s1+s2 share 2 physical, u owns 2 → 2 free
+            assert len(pool._free) == 2
+            free_before = len(pool._free)
+            victims = pool._pick_victims_locked(4, pool.options
+                                                .default_priority,
+                                                spill=True)
+            names = [v.session for v in victims]
+            # the SHARED SET first — both owners, before the unshared
+            assert set(names[:2]) == {"s1", "s2"}
+            assert names[2] == "u"
+            # drive the actual demotion through pressure and assert the
+            # simulation was exact: 4 blocks wanted, 4 blocks freed
+            big = [(11 * j) % 499 for j in range(48)]   # 6 blocks
+            pool.load("big", _rows(big), last_token=big[-1])
+            # promised 4 freed + 2 already free == exactly the 6 taken
+            assert free_before == 2 and len(pool._free) == 0
+            assert set(pool.spilled_sessions()) == {"s1", "s2", "u"}
+            assert np.array_equal(pool.materialize("big"), _rows(big))
+        finally:
+            pool.close()
+
+    def test_capacity_under_pressure_ab_spill_retains_more(self):
+        """Acceptance A/B: same arena, same load pattern — spill-on
+        retains STRICTLY more live (still-retrievable) sessions than
+        spill-off, and every retained session is byte-exact."""
+        from brpc_tpu.butil import flags as _fl
+        alive = {}
+        try:
+            for flag in (True, False):
+                _fl.set_flag("serving_kv_spill", flag)
+                pool = _mk_pool(num_blocks=8, block_tokens=8,
+                                host_blocks=32)
+                sessions = {}
+                try:
+                    for i in range(16):
+                        toks = [(7 * i + j) % 499 for j in range(16)]
+                        pool.load(f"s{i}", _rows(toks),
+                                  last_token=toks[-1])
+                        sessions[f"s{i}"] = toks
+                    live = 0
+                    for name, toks in sessions.items():
+                        got = pool.materialize(name)
+                        if got is not None:
+                            assert np.array_equal(got, _rows(toks)), name
+                            live += 1
+                    alive[flag] = live
+                finally:
+                    pool.close()
+        finally:
+            _fl.set_flag("serving_kv_spill", True)
+        # spill-on keeps EVERY session retrievable; spill-off can only
+        # hold what the device arena holds
+        assert alive[True] == 16
+        assert alive[True] > alive[False], alive
+
+    def test_spill_plane_faults_latch_and_revive(self):
+        """Chaos at the pool level: an injected demote-IO failure
+        latches the spill plane down (pressure degrades to PR-16
+        eviction — no client hangs on a dead host arena), and the
+        timer latch revives it through the standard counters."""
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.ici import route
+        pool = _mk_pool(num_blocks=2, block_tokens=8, host_blocks=8)
+        try:
+            _fl.set_flag("serving_kv_spill_reprobe_s", 0.1)
+            before = route.plane_stats()
+            pool.inject_spill_fault("demote")
+            pool.load("a", _rows([3] * 16), last_token=3)
+            pool.load("b", _rows([5] * 16), last_token=5)  # pressure
+            # demote failed → fell back to eviction, plane latched
+            assert pool.spilled_sessions() == []
+            assert pool.evicted_reason("a") == "pressure"
+            d = pool.describe()["tiers"]
+            assert d["plane"]["state"] == "down"
+            assert d["plane"]["reason"] == "demote_io"
+            pool.inject_spill_fault(None)
+            # while latched, pressure KEEPS evicting (fast, no retry
+            # storm at the failing arena)
+            pool.load("c", _rows([7] * 16), last_token=7)
+            assert pool.spilled_sessions() == []
+            time.sleep(0.15)       # the timer latch lapses
+            pool.load("e", _rows([11] * 16), last_token=11)
+            assert pool.spilled_sessions() == ["c"]
+            after = route.plane_stats()
+            assert after["spill_down"] >= before.get("spill_down",
+                                                     0) + 1
+            assert after["spill_reprobe"] >= before.get("spill_reprobe",
+                                                        0) + 1
+            assert after["spill_revived"] >= before.get("spill_revived",
+                                                        0) + 1
+            assert pool.describe()["tiers"]["plane"]["state"] == "up"
+        finally:
+            _fl.set_flag("serving_kv_spill_reprobe_s", 0.25)
+            pool.close()
+
+    def test_restore_io_fault_keeps_host_copy_and_sheds(self):
+        pool = _mk_pool(num_blocks=2, block_tokens=8, host_blocks=8)
+        try:
+            from brpc_tpu.butil import flags as _fl
+            _fl.set_flag("serving_kv_spill_reprobe_s", 0.05)
+            ta = [(3 * j) % 499 for j in range(16)]
+            pool.load("a", _rows(ta), last_token=ta[-1])
+            assert pool.spill("a")
+            pool.inject_spill_fault("restore")
+            assert pool.get("a") is None          # shed, not corrupt
+            assert pool.spilled_sessions() == ["a"]   # record intact
+            assert pool.describe()["tiers"]["plane"]["reason"] \
+                == "restore_io"
+            pool.inject_spill_fault(None)
+            time.sleep(0.1)
+            assert np.array_equal(pool.materialize("a"), _rows(ta))
+        finally:
+            _fl.set_flag("serving_kv_spill_reprobe_s", 0.25)
+            pool.close()
+
+    def test_restore_saturated_stays_spilled(self):
+        """No device room even after pressure (everything pinned): the
+        restore refuses, the session STAYS host-resident, and the
+        scheduler-visible reason is the retryable "spilled"."""
+        pool = _mk_pool(num_blocks=2, block_tokens=8, host_blocks=8)
+        try:
+            ta = [(3 * j) % 499 for j in range(16)]
+            pool.load("a", _rows(ta), last_token=ta[-1])
+            assert pool.spill("a")
+            pool.load("b", _rows([5] * 16), last_token=5)
+            assert pool.pin("b")                  # device arena fenced
+            assert pool.get("a") is None
+            assert pool.spilled_sessions() == ["a"]
+            assert pool.evicted_reason("a") == "spilled"
+            pool.unpin("b")
+            assert np.array_equal(pool.materialize("a"), _rows(ta))
+        finally:
+            pool.close()
+
+    def test_host_arena_reclaim_drops_oldest_spilled(self):
+        """Host arena full: demoting one more session reclaims the
+        most sheddable SPILLED session (band→weight→LRU, typed
+        "pressure" shed) rather than refusing the demotion."""
+        _clock = [100.0]
+        pool = _mk_pool(num_blocks=2, block_tokens=8, host_blocks=2,
+                        now=lambda: _clock[0])
+        try:
+            ta = [(3 * j) % 499 for j in range(16)]
+            pool.load("a", _rows(ta), last_token=ta[-1])
+            _clock[0] += 1
+            pool.load("b", _rows([5] * 16), last_token=5)  # spills a
+            assert pool.spilled_sessions() == ["a"]
+            _clock[0] += 1
+            pool.load("c", _rows([7] * 16), last_token=7)  # spills b
+            # host arena (2 blocks) could not hold both: "a" died for
+            # real to make room for "b"
+            assert pool.spilled_sessions() == ["b"]
+            assert pool.evicted_reason("a") == "pressure"
+            d = pool.describe()["tiers"]
+            assert d["host_evictions"] == 1 and d["demotions"] == 2
+            assert np.array_equal(pool.materialize("b"), _rows([5] * 16))
+        finally:
+            pool.close()
+
+    def test_release_of_spilled_session_frees_host_blocks(self):
+        pool = _mk_pool(num_blocks=2, block_tokens=8, host_blocks=4)
+        try:
+            pool.load("a", _rows([3] * 16), last_token=3)
+            assert pool.spill("a")
+            assert pool.release("a")
+            assert pool.spilled_sessions() == []
+            assert pool.describe()["tiers"]["host_blocks_free"] == 4
+            assert not pool.release("a")
+        finally:
+            pool.close()
+
+    def test_scheduler_decodes_through_restored_session(self):
+        """Service-level truth: a spilled session submitted to the
+        scheduler restores transparently and the tokens are bit-exact
+        against the never-spilled reference."""
+        m = _model()
+        pool = _mk_pool(num_blocks=8, block_tokens=8, host_blocks=8)
+        sched = _mk_sched(pool, max_batch=4)
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]
+            want = m.reference_generate(toks, 6)
+            pool.load("a", _rows(toks), last_token=toks[-1])
+            assert pool.spill("a")
+            sink = _submit(sched, "a", 6)
+            for _ in range(10):
+                sched.step_once()
+                if sink.tokens is not None or sink.error:
+                    break
+            assert sink.error is None, sink.error
+            assert sink.tokens == want
+            assert pool.describe()["tiers"]["restores"] == 1
+        finally:
+            sched.stop()
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# Live cross-worker migration (ISSUE 19).
+# ---------------------------------------------------------------------------
+
+class TestKvMigration:
+    def _mk_pair(self):
+        src = _mk_pool(num_blocks=8, block_tokens=8)
+        dst = _mk_pool(num_blocks=8, block_tokens=8)
+        return src, dst
+
+    def _sender(self, dst):
+        def send(meta, payload):
+            rows = np.frombuffer(payload, np.uint8).reshape(
+                meta["seq_len"], dst.options.bytes_per_token)
+            dst.load(meta["session"], rows,
+                     last_token=meta["last_token"],
+                     tenant=meta["tenant"], priority=meta["priority"])
+            return True, "", False
+        return send
+
+    def test_migrate_out_cutover_then_release(self):
+        """Custody: the cutover flip runs while the SOURCE copy is
+        still resident; only after it does the source release."""
+        from brpc_tpu.serving import migrate_out
+        src, dst = self._mk_pair()
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]
+            src.load("m1", _rows(toks), last_token=toks[-1])
+            order = []
+
+            def flip():
+                assert src.get("m1") is not None   # source still live
+                order.append("flip")
+            ok, err = migrate_out(src, "m1", self._sender(dst),
+                                  on_cutover=flip)
+            assert ok, err
+            assert order == ["flip"]
+            assert src.get("m1") is None           # released after
+            assert np.array_equal(dst.materialize("m1"), _rows(toks))
+        finally:
+            src.close()
+            dst.close()
+
+    def test_shed_abort_keeps_source_no_plane_event(self):
+        from brpc_tpu.ici import route
+        from brpc_tpu.serving import migrate_out, migration_stats
+        src, dst = self._mk_pair()
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]
+            src.load("m1", _rows(toks), last_token=toks[-1])
+            before = route.plane_stats()
+            a0 = migration_stats()["aborts"]
+
+            def shed(meta, payload):
+                return False, "kv pool saturated (shed)", True
+            ok, err = migrate_out(src, "m1", shed)
+            assert not ok and "saturated" in err
+            assert migration_stats()["aborts"] == a0 + 1
+            # a clean shed does NOT latch the plane
+            after = route.plane_stats()
+            assert after.get("migrate_down", 0) \
+                == before.get("migrate_down", 0)
+            assert np.array_equal(src.materialize("m1"), _rows(toks))
+        finally:
+            src.close()
+            dst.close()
+
+    def test_transfer_deadline_latches_and_revives(self):
+        """Satellite 1 (the PR-17 residue): a HUNG peer is detected by
+        the transfer-deadline latch — the migrate plane goes down with
+        no client in the blast radius, later migrations refuse FAST,
+        and the timer latch revives through reprobe/revived."""
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.ici import route
+        from brpc_tpu.serving import migrate_out
+        src, dst = self._mk_pair()
+        gate = threading.Event()
+        try:
+            _fl.set_flag("serving_migrate_reprobe_s", 0.1)
+            toks = [(3 * j) % 499 for j in range(16)]
+            src.load("m1", _rows(toks), last_token=toks[-1])
+            before = route.plane_stats()
+
+            def hung(meta, payload):
+                gate.wait(5.0)
+                return True, "", False
+            t0 = time.monotonic()
+            ok, err = migrate_out(src, "m1", hung, deadline_ms=150)
+            assert not ok and "deadline" in err
+            assert time.monotonic() - t0 < 2.0
+            # latched: the next migrate refuses in microseconds, no
+            # send is even attempted
+            calls = []
+            ok, err = migrate_out(
+                src, "m1", lambda m, p: calls.append(1) or (True, "",
+                                                            False))
+            assert not ok and "latched" in err and not calls
+            # the source never stopped serving
+            assert np.array_equal(src.materialize("m1"), _rows(toks))
+            gate.set()
+            time.sleep(0.15)
+            ok, err = migrate_out(src, "m1", self._sender(dst))
+            assert ok, err
+            after = route.plane_stats()
+            assert after["migrate_down"] >= before.get("migrate_down",
+                                                       0) + 1
+            assert after["migrate_revived"] \
+                >= before.get("migrate_revived", 0) + 1
+            assert np.array_equal(dst.materialize("m1"), _rows(toks))
+        finally:
+            _fl.set_flag("serving_migrate_reprobe_s", 0.5)
+            gate.set()
+            src.close()
+            dst.close()
+
+    def test_peer_unreachable_latches_plane(self):
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.serving import migrate_out, migration_stats
+        src, dst = self._mk_pair()
+        try:
+            _fl.set_flag("serving_migrate_reprobe_s", 0.05)
+            toks = [3] * 16
+            src.load("m1", _rows(toks), last_token=3)
+
+            def dead(meta, payload):
+                raise ConnectionError("connection refused")
+            ok, err = migrate_out(src, "m1", dead)
+            assert not ok and "ConnectionError" in err
+            st = migration_stats()
+            assert st["plane"]["state"] == "down"
+            assert st["plane"]["reason"] == "peer_unreachable"
+            assert np.array_equal(src.materialize("m1"), _rows(toks))
+            # the latch is PROCESS-wide: heal it (timer lapse + probe)
+            # so later migration tests start from an UP plane
+            from brpc_tpu.serving.migration import migrate_health
+            time.sleep(0.1)
+            assert migrate_health().usable()
+        finally:
+            _fl.set_flag("serving_migrate_reprobe_s", 0.5)
+            src.close()
+            dst.close()
+
+    def test_scheduler_fence_refuses_decoding_session(self):
+        from brpc_tpu.serving import migrate_out
+        src, dst = self._mk_pair()
+        sched = _mk_sched(src, max_batch=4)
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]
+            src.load("m1", _rows(toks), last_token=toks[-1])
+            _submit(sched, "m1", 50)
+            sched.step_once()  # roster admits m1 → owned
+            ok, err = migrate_out(src, "m1", self._sender(dst),
+                                  scheduler=sched)
+            assert not ok and "decoding" in err
+        finally:
+            sched.stop()
+            src.close()
+            dst.close()
+
+    def test_spilled_session_migrates_via_restore(self):
+        """A migration is a READ: a host-parked session restores
+        first, then ships — the destination gets device-verified
+        bytes, never the raw host copy."""
+        from brpc_tpu.serving import migrate_out
+        src = _mk_pool(num_blocks=4, block_tokens=8, host_blocks=4)
+        dst = _mk_pool(num_blocks=8, block_tokens=8)
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]
+            src.load("m1", _rows(toks), last_token=toks[-1])
+            assert src.spill("m1")
+            ok, err = migrate_out(src, "m1", self._sender(dst))
+            assert ok, err
+            assert src.describe()["tiers"]["restores"] == 1
+            assert src.spilled_sessions() == []
+            assert np.array_equal(dst.materialize("m1"), _rows(toks))
+        finally:
+            src.close()
+            dst.close()
+
+    def test_router_affinity_bind_rebind_unbind(self):
+        """The cutover surface: rebind is the atomic routing flip and
+        reports the previous binding so the caller releases the source
+        AFTER the flip."""
+        from brpc_tpu.serving import LoadAwareRouter
+        r = LoadAwareRouter(["ici://0", "ici://1"])
+        try:
+            assert r.session_url("s") is None
+            r.bind_session("s", "ici://0")
+            assert r.session_url("s") == "ici://0"
+            assert r.rebind("s", "ici://1") == "ici://0"
+            assert r.session_url("s") == "ici://1"
+            assert r.rebind("new", "ici://0") is None
+            d = r.describe()
+            assert d["sessions_bound"] == 2 and d["rebinds"] == 1
+            r.unbind("s")
+            assert r.session_url("s") is None
+            # cardinality cap: binds never grow without bound
+            for i in range(r.MAX_BOUND_SESSIONS + 10):
+                r.bind_session(f"x{i}", "ici://0")
+            assert r.describe()["sessions_bound"] \
+                <= r.MAX_BOUND_SESSIONS
+        finally:
+            r.close()
+
+    def test_autoscaler_drain_runs_before_scale_down(self):
+        from brpc_tpu.serving import (AutoscalerOptions,
+                                      LoadThresholdAutoscaler)
+        order = []
+        a = LoadThresholdAutoscaler(
+            load_fn=lambda: 0.0, size_fn=lambda: 2,
+            scale_up=lambda: True,
+            scale_down=lambda: order.append("down") or True,
+            drain=lambda: order.append("drain"),
+            options=AutoscalerOptions(samples_to_scale=1,
+                                      cooldown_s=0.0))
+        assert a.tick(now=1.0) == "down"
+        assert order == ["drain", "down"]
+        # a raising drain logs and the scale-down still proceeds
+        order.clear()
+
+        def bad_drain():
+            order.append("drain")
+            raise RuntimeError("migrate failed")
+        a2 = LoadThresholdAutoscaler(
+            load_fn=lambda: 0.0, size_fn=lambda: 2,
+            scale_up=lambda: True,
+            scale_down=lambda: order.append("down") or True,
+            drain=bad_drain,
+            options=AutoscalerOptions(samples_to_scale=1,
+                                      cooldown_s=0.0))
+        assert a2.tick(now=1.0) == "down"
+        assert order == ["drain", "down"]
+
+
+# ---------------------------------------------------------------------------
 # Continuous-batching scheduler (manual stepping).
 # ---------------------------------------------------------------------------
 
@@ -1734,6 +2304,13 @@ class TestServingServices:
             assert pfx["unlocked_fills"] > 0     # the default route
             for key in ("shared_blocks", "prefix_hits", "cow_splits"):
                 assert key in pfx
+            # the ISSUE-19 tiers block rides the same gate
+            tiers = next(v for k, v in res["kv_tiers"].items()
+                         if "Decode" in k)
+            for key in ("demotions", "restores", "restore_p50_us",
+                        "spilled_sessions", "migration"):
+                assert key in tiers
+            assert tiers["migration"]["scope"] == "process"
         finally:
             for server in (router, prefill, decode):
                 for svc in server._services.values():
