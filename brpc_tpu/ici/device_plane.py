@@ -60,6 +60,7 @@ from .. import bvar
 from ..butil import flags as _flags
 from ..butil import debug_sync as _dbg
 from ..butil import logging as log
+from ..butil import custody_ledger as _ledger
 from ..bthread.device_waiter import DeviceCompletion, device_on_ready
 from .mesh import IciMesh
 
@@ -303,6 +304,13 @@ class DevicePlane:
         "match_timeouts": "_lock",
     }
 
+    # fablint custody contract (ISSUE 20): every tracked transfer (its
+    # source HBM pin rides the _active entry) must untrack — completion,
+    # failure, and the lame-duck fail_pending sweep are the exits.  The
+    # post_* sites carry custody-moved markers because the release fires
+    # asynchronously from the CQ callback, not on the posting path.
+    _CUSTODY = {"_track": ("_untrack",)}
+
     # cache bounds: steady workloads repost a handful of (size, route)
     # shapes, but arbitrary attachment sizes would otherwise compile and
     # pin one executable + one device-resident zeros row PER DISTINCT
@@ -503,7 +511,7 @@ class DevicePlane:
         if not remote:
             with self._lock:
                 self._pending[t.uuid] = t
-        self._track(t)
+        self._track(t)  # fablint: custody-moved(completion-path) the CQ done()/_fail callback untracks when the transfer completes or dies; fail_pending sweeps the orphans
         self._recent.append(t)
         self._annotate(t, "posted")
         self._sweep_stale()
@@ -551,7 +559,7 @@ class DevicePlane:
         t = DeviceTransfer(uuid, src_dev, dst_dev, nbytes,
                            trace_id=trace_id,
                            parent_span_id=parent_span_id)
-        self._track(t)
+        self._track(t)  # fablint: custody-moved(completion-path) finish_remote/execute_remote completion or failure untracks; fail_pending sweeps the orphans
         self._recent.append(t)
         self._annotate(t, "recv enqueued")
         return t
@@ -667,10 +675,14 @@ class DevicePlane:
 
     # ---- drain barrier (lame-duck server stop) -------------------------
     def _track(self, t: DeviceTransfer) -> None:
+        _ledger.acquire("dev.transfer", (id(self), t.uuid))
         with self._lock:
             self._active.add(t)
 
     def _untrack(self, t: DeviceTransfer) -> None:
+        # non-strict: discard is idempotent (a fail_pending sweep can
+        # race the CQ callback), so a second untrack must stay a no-op
+        _ledger.release("dev.transfer", (id(self), t.uuid))
         with self._lock:
             self._active.discard(t)
 

@@ -178,6 +178,12 @@ class _DevRegistry:
     once and removed exactly once (the exactly-one-exit custody
     invariant), so there is no read-modify-write to race."""
 
+    # fablint custody contract (ISSUE 20): every registered device ref
+    # leaves through take (Python assumes custody) or release (drop);
+    # keys parked in wire segments / IOBuf handles carry custody-moved
+    # markers at the put site naming the structure that owes the exit.
+    _CUSTODY = {"put": ("take", "release")}
+
     def __init__(self):
         self._m: Dict[int, Any] = {}
         self._next = itertools.count(1).__next__
@@ -1301,7 +1307,7 @@ class ServerBinding:
             self._respond_one(token, errors.ELIMIT,
                               f"{full} concurrency limit", collector)
             return
-        cntl = self._pool.acquire()
+        cntl = self._pool.acquire()  # fablint: custody-moved(request-lifecycle) the shim rides the request; _maybe_recycle releases it back to the pool when the response (or failure path) completes
         d = cntl.__dict__
         log_id = r.log_id
         if log_id:
@@ -1455,7 +1461,7 @@ class ServerBinding:
         server = self._server
         stage_flag, record_stage = _stage_modules()
         stages = stage_flag.value == "on"
-        cntl = server_controller_pool.acquire()
+        cntl = server_controller_pool.acquire()  # fablint: custody-moved(request-lifecycle) the shim rides the request; _maybe_recycle releases it back to the pool when the response (or failure path) completes
         if log_id:
             cntl.log_id = log_id
         cntl.server = server
@@ -2143,7 +2149,7 @@ class ChannelBinding:
                     if seg_arr is None:
                         seg_arr = tls["seg1"] = (IciSegC * 1)()
                     e = seg_arr[0]
-                    e.key = _registry.put(arr)
+                    e.key = _registry.put(arr)  # fablint: custody-moved(wire-segment) the key rides the IciSeg to the native sender, which takes/releases it after the DMA posts
                     e.nbytes = nbytes
                     IM = _IciMesh
                     hit = _devidx_cache.get(id(arr)) \
@@ -2302,9 +2308,12 @@ def native_ici_echo_p50_us(iters: int = 3000, payload: int = 128,
         return -1.0
     key, nbytes, dev = 0, 0, 0
     if device_array is not None:
-        key = _registry.put(device_array)    # borrowed for the bench
+        # compute the descriptor BEFORE registering: _device_index can
+        # raise (stale mesh), and a raise after put would leak the key
+        # past the try/finally below (fablint custody true positive)
         nbytes = device_array.nbytes
         dev = _device_index(device_array)
+        key = _registry.put(device_array)    # borrowed for the bench
     try:
         ns = lib.brpc_tpu_ici_echo_p50_ns(iters, payload, key, nbytes, dev)
         return ns / 1000.0 if ns > 0 else -1.0

@@ -26,6 +26,7 @@ from typing import Any, Callable, Optional
 
 from ..butil.iobuf import IOBuf
 from ..butil.endpoint import EndPoint
+from ..butil import custody_ledger as _ledger
 from ..bthread import id as bthread_id
 from ..bthread.timer_thread import TimerThread
 from . import errors
@@ -579,6 +580,12 @@ class ControllerPool:
 
     _GUARDED_BY = {"_free": "_lock"}
 
+    # fablint custody contract (ISSUE 20): a pooled shim handed out by
+    # acquire() comes back through release() exactly once; the id
+    # version makes a double release a no-op, the ledger makes a NO
+    # release attributable to its acquiring call site.
+    _CUSTODY = {"acquire": ("release",)}
+
     def __init__(self, capacity: int = 1024):
         from ..butil import debug_sync as _dbg
         from ..butil.resource_pool import ResourcePool
@@ -595,12 +602,14 @@ class ControllerPool:
         d = c.__dict__
         d["_pool_rid"] = self._ids.get_resource(c)
         d["_recycle_pool"] = self
+        _ledger.acquire("cntl", (id(self), d["_pool_rid"]))
         return c
 
     def release(self, c: Controller) -> None:
         rid = c.__dict__.get("_pool_rid", 0)
         if not rid or not self._ids.return_resource(rid):
             return                   # not ours / already released: drop
+        _ledger.release("cntl", (id(self), rid))
         # native att custody (ISSUE 12): pool-recycle is the blessed
         # drop point for an attachment view whose handle never exited
         # (handler ignored it / response failed before the pass-back) —

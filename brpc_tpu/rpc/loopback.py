@@ -274,7 +274,7 @@ def _serve(server, full_name: str, client_cntl: Controller,
     thread; semantically the loopback ProcessRpcRequest."""
     t0 = state.t0
     done = state.done
-    cntl = server_controller_pool.acquire()
+    cntl = server_controller_pool.acquire()  # fablint: custody-moved(request-lifecycle) the shim rides the request; _maybe_recycle releases it back to the pool when the response (or failure path) completes
     cntl.server = server
     if client_cntl.log_id:
         cntl.log_id = client_cntl.log_id

@@ -27,6 +27,7 @@ from ..butil import flags as _flags
 from ..butil.iobuf import IOBuf
 from ..butil import debug_sync as _dbg
 from ..butil.resource_pool import ResourcePool
+from ..butil import custody_ledger as _ledger
 from ..bthread.butex import Butex
 from ..bthread.execution_queue import ExecutionQueue
 from . import errors
@@ -581,11 +582,18 @@ class Stream:
 
 # ---- stream registry (versioned ids like SocketId) ---------------------
 
+# fablint custody contract (ISSUE 20): a registry slot handed out by
+# get_resource comes back through return_resource exactly once (the
+# versioned id rejects doubles); _pool_remove is the single drop point
+# every close path funnels through.
+_CUSTODY = {"get_resource": ("return_resource",)}
+
 _streams: ResourcePool = ResourcePool()
 
 
 def _pool_remove(sid: int) -> None:
     _streams.return_resource(sid)
+    _ledger.release("stream", (sid,))
 
 
 def stream_create(cntl, options: Optional[StreamOptions] = None) -> Stream:
@@ -593,6 +601,7 @@ def stream_create(cntl, options: Optional[StreamOptions] = None) -> Stream:
     stream.cpp:732)."""
     s = Stream(options or StreamOptions(), is_client=True)
     s.sid = _streams.get_resource(s)
+    _ledger.acquire("stream", (s.sid,))
     cntl.stream_creator = s
     return s
 
@@ -602,6 +611,7 @@ def stream_accept(cntl, options: Optional[StreamOptions] = None) -> Stream:
     stream.cpp:756)."""
     s = Stream(options or StreamOptions(), is_client=False)
     s.sid = _streams.get_resource(s)
+    _ledger.acquire("stream", (s.sid,))
     cntl.accepted_stream_id = s.sid
     return s
 

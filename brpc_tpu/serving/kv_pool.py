@@ -95,6 +95,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from .. import bvar
+from ..butil import custody_ledger as _ledger
 from ..butil import debug_sync as _dbg
 from ..butil import flags as _flags
 
@@ -288,6 +289,24 @@ class PagedKvPool:
         "_closed": "_lock",
         "_counters": "_counters_lock",
         "_tenant_labels": "_counters_lock",
+    }
+
+    # fablint custody contract (ISSUE 20).  A pin is owed an unpin; a
+    # reservation is owed exactly one of commit / abort / return (the
+    # restore path resolves through _finish_restore_locked); the block
+    # refcounts free through _free_session_locked (or an inline
+    # guarded decrement), the host-tier refcounts through
+    # _host_unref_locked.  The methods named here are the protocol
+    # implementation and are exempt from the acquire-release rule;
+    # everything else that acquires must release on every exit path.
+    _CUSTODY = {
+        "pin": ("unpin",),
+        "pinned": ("unpin",),
+        "_reserve_locked": ("_commit_locked", "_abort_fill_locked",
+                            "_return_blocks_locked",
+                            "_finish_restore_locked"),
+        "_refs": ("_free_session_locked", "_return_blocks_locked"),
+        "_host_refs": ("_host_unref_locked", "_finish_restore_locked"),
     }
 
     def __init__(self, options: KvPoolOptions,
@@ -512,41 +531,59 @@ class PagedKvPool:
             with self._lock:
                 blocks, deferred_old = self._reserve_locked(session, need,
                                                             pri)
+            _ledger.acquire("kv.reserve", (id(self), id(blocks)))
             # the fill below touches only the unguarded arenas through
             # rows nothing else references (reserved blocks are
-            # invisible to every other pool operation)
-            extents, views = self._extent_views(blocks, seq_len)
+            # invisible to every other pool operation).  EVERYTHING
+            # between the reserve and the commit sits inside the try:
+            # the extent-view build and the session construction can
+            # raise under allocator pressure just like the fill, and
+            # an abort must reach the reservation from every one of
+            # those edges (ISSUE 20 — the custody pass proves this)
             try:
+                extents, views = self._extent_views(blocks, seq_len)
                 fill(views)
                 acc = self._derive_sums(extents, views, seq_len)
+                s = _KvSession(session, tenant, pri, seq_len, last_token,
+                               acc, blocks, now)
             except BaseException:
                 # abort clean: the reservation never became a session
                 with self._lock:
                     self._abort_fill_locked(blocks)
+                _ledger.release("kv.reserve", (id(self), id(blocks)))
                 self.fill_aborts << 1
                 raise
-            s = _KvSession(session, tenant, pri, seq_len, last_token,
-                           acc, blocks, now)
-            with self._lock:
-                self._commit_locked(s, deferred_old)
+            try:
+                with self._lock:
+                    self._commit_locked(s, deferred_old)
+            finally:
+                # a SessionBusy / closed-pool commit refusal already
+                # returned the blocks internally: custody ends either way
+                _ledger.release("kv.reserve", (id(self), id(blocks)))
             self.unlocked_fills << 1
         else:
             with self._lock:
                 blocks, deferred_old = self._reserve_locked(session, need,
                                                             pri)
-                extents, views = self._extent_views(blocks, seq_len)
+                _ledger.acquire("kv.reserve", (id(self), id(blocks)))
                 try:
+                    extents, views = self._extent_views(blocks, seq_len)
                     fill(views)
+                    acc = self._derive_sums(extents, views, seq_len)
+                    s = _KvSession(session, tenant, pri, seq_len,
+                                   last_token, acc, blocks, now)
                 except BaseException:
                     # abort clean: the reservation never became a
                     # session (close() cannot race — we hold the lock)
                     self._return_blocks_locked(blocks)
+                    _ledger.release("kv.reserve", (id(self), id(blocks)))
                     self.fill_aborts << 1
                     raise
-                acc = self._derive_sums(extents, views, seq_len)
-                s = _KvSession(session, tenant, pri, seq_len, last_token,
-                               acc, blocks, now)
-                self._commit_locked(s, deferred_old)
+                try:
+                    self._commit_locked(s, deferred_old)
+                finally:
+                    _ledger.release("kv.reserve",
+                                    (id(self), id(blocks)))
             self.locked_fills << 1
         self.loads << 1
         self.bytes_in << seq_len * bpt
@@ -738,7 +775,7 @@ class PagedKvPool:
             if sharing:
                 eb = self._prefix_index.get(h)
                 if (eb is not None and eb != blk and eb in self._refs
-                        and np.array_equal(self._store[eb], data)):
+                        and np.array_equal(self._store[eb], data)):  # fablint: ignore[blocking-under-lock] dedupe byte-verify: one block-sized compare under _lock is the accepted PR-16 collision fence; moving it outside would race the donor's free (ROADMAP 5 residue)
                     # verified content match: map this position onto
                     # the existing physical block, hand ours back
                     if new_blocks is None:
@@ -951,6 +988,7 @@ class PagedKvPool:
                 self._host_store[hb] = data
                 self._spill_map[b] = hb
                 new_host.append(hb)
+            # fablint: custody-moved(spill-record) the ref lives in the _SpilledSession entry below; _drop_spilled_locked / _host_unref_locked balance it
             self._host_refs[hb] = self._host_refs.get(hb, 0) + 1
             hblocks[k] = hb
         now = self._now()
@@ -1075,74 +1113,107 @@ class PagedKvPool:
                         return None
                     for h in sp.hblocks:
                         self._host_refs[int(h)] += 1
+                    _ledger.acquire("kv.reserve",
+                                    (id(self), id(blocks)))
                     fault = self._spill_fault
                     break
             # another thread is restoring this session: wait it out
             time.sleep(0.0005)
         # ---- outside the lock: reserved rows have exactly one writer,
-        # and our extra host refs pin the source bytes
+        # and our extra host refs pin the source bytes.  The copy sits
+        # inside a try: an allocator failure mid-copy must still drop
+        # the host refs and return the reservation (ISSUE 20), and
+        # EVERY outcome resolves through the one declared custody exit,
+        # _finish_restore_locked
         ok = True
-        io_fail = fault == "restore"
-        if not io_fail:
-            chain = 0
-            for k in range(len(blocks)):
-                data = self._host_store[int(sp.hblocks[k])]
-                chain = zlib.crc32(data, chain)
-                if chain != sp.crcs[k]:
-                    ok = False
-                    break
-                b = int(blocks[k])
-                self._store[b] = data
-                self._pos_sums[b] = self._store[b].reshape(
-                    bt, bpt).sum(axis=1, dtype=np.int64)
-        now = self._now()
+        try:
+            io_fail = fault == "restore"
+            if not io_fail:
+                chain = 0
+                for k in range(len(blocks)):
+                    data = self._host_store[int(sp.hblocks[k])]
+                    chain = zlib.crc32(data, chain)
+                    if chain != sp.crcs[k]:
+                        ok = False
+                        break
+                    b = int(blocks[k])
+                    self._store[b] = data
+                    self._pos_sums[b] = self._store[b].reshape(
+                        bt, bpt).sum(axis=1, dtype=np.int64)
+            now = self._now()
+        except BaseException:
+            with self._lock:
+                self._finish_restore_locked(session, sp, blocks, t0,
+                                            ok=False, io_fail=False,
+                                            now=None, failed=True)
+            raise
         with self._lock:
-            self._restoring.discard(session)
-            if self._closed:
-                # close() rebuilt the free list and cleared the host
-                # tier — nothing left to return or unref
-                return None
-            self._host_unref_locked(sp.hblocks)
-            if io_fail:
-                # transport failed, host bytes presumed intact: keep
-                # the record, latch the plane, shed
-                self._return_blocks_locked(blocks)
-                self._spill_health.mark_down("restore_io")
-                return None
-            if not ok:
-                # byte verification failed: the host copy is corrupt —
-                # drop it and degrade to a typed re-prefill, NOT a
-                # plane event (corruption is not plane death)
-                self._return_blocks_locked(blocks)
-                if self._spilled.get(session) is sp:
-                    self._drop_spilled_locked(session)
-                self._recent_evicted[session] = "corrupt"
-                while len(self._recent_evicted) > 256:
-                    self._recent_evicted.pop(
-                        next(iter(self._recent_evicted)))
-                self.restore_corrupt << 1
-                return None
-            cur = self._tables.get(session)
-            if cur is not None:
-                # a re-prefill committed fresh bytes mid-restore: the
-                # fresh load wins, our copy aborts
-                self._return_blocks_locked(blocks)
-                return cur
-            if self._spilled.get(session) is not sp:
-                # the record was released/expired/reclaimed mid-copy
-                self._return_blocks_locked(blocks)
-                return None
-            s = _KvSession(session, sp.tenant, sp.priority, sp.seq_len,
-                           sp.last_token, sp.acc, blocks, now)
-            # same commit as a load: prefix dedupe means the FIRST
-            # restored co-owner re-registers the shared blocks and
-            # every later restore maps onto them — one physical copy
-            # restores N sessions
-            self._commit_locked(s, None)
-            self._drop_spilled_locked(session)
-            self.restores << 1
-            self._restore_us.append(
-                (time.perf_counter_ns() - t0) // 1000)
+            return self._finish_restore_locked(session, sp, blocks, t0,
+                                               ok=ok, io_fail=io_fail,
+                                               now=now)
+
+    # fablint: lock-held(_lock)
+    def _finish_restore_locked(self, session: str, sp, blocks, t0, *,
+                               ok: bool, io_fail: bool,
+                               now: Optional[float],
+                               failed: bool = False):
+        """The restore's single custody-resolution point, declared as
+        the release of BOTH the device reservation and the restore's
+        host refs: exactly one of commit / return-blocks / close-race
+        custody-end happens here, under one lock hold."""
+        _ledger.release("kv.reserve", (id(self), id(blocks)))
+        self._restoring.discard(session)
+        if self._closed:
+            # close() rebuilt the free list and cleared the host
+            # tier — nothing left to return or unref
+            return None
+        self._host_unref_locked(sp.hblocks)
+        if failed:
+            # the outside-the-lock copy RAISED (allocator pressure /
+            # test hook): host record intact, reservation returns, the
+            # exception propagates to the caller
+            self._return_blocks_locked(blocks)
+            return None
+        if io_fail:
+            # transport failed, host bytes presumed intact: keep
+            # the record, latch the plane, shed
+            self._return_blocks_locked(blocks)
+            self._spill_health.mark_down("restore_io")
+            return None
+        if not ok:
+            # byte verification failed: the host copy is corrupt —
+            # drop it and degrade to a typed re-prefill, NOT a
+            # plane event (corruption is not plane death)
+            self._return_blocks_locked(blocks)
+            if self._spilled.get(session) is sp:
+                self._drop_spilled_locked(session)
+            self._recent_evicted[session] = "corrupt"
+            while len(self._recent_evicted) > 256:
+                self._recent_evicted.pop(
+                    next(iter(self._recent_evicted)))
+            self.restore_corrupt << 1
+            return None
+        cur = self._tables.get(session)
+        if cur is not None:
+            # a re-prefill committed fresh bytes mid-restore: the
+            # fresh load wins, our copy aborts
+            self._return_blocks_locked(blocks)
+            return cur
+        if self._spilled.get(session) is not sp:
+            # the record was released/expired/reclaimed mid-copy
+            self._return_blocks_locked(blocks)
+            return None
+        s = _KvSession(session, sp.tenant, sp.priority, sp.seq_len,
+                       sp.last_token, sp.acc, blocks, now)
+        # same commit as a load: prefix dedupe means the FIRST
+        # restored co-owner re-registers the shared blocks and
+        # every later restore maps onto them — one physical copy
+        # restores N sessions
+        self._commit_locked(s, None)
+        self._drop_spilled_locked(session)
+        self.restores << 1
+        self._restore_us.append(
+            (time.perf_counter_ns() - t0) // 1000)
         return s
 
     def spill(self, session: str) -> bool:
@@ -1354,6 +1425,7 @@ class PagedKvPool:
             if s is None or s.release_pending:
                 return False
             s.pinned += 1
+            _ledger.acquire("kv.pin", (id(self), session))
             return True
 
     def unpin(self, session: str) -> None:
@@ -1364,6 +1436,8 @@ class PagedKvPool:
             if s is not None:
                 if s.pinned:
                     s.pinned -= 1
+                    _ledger.release("kv.pin", (id(self), session),
+                                    strict=True)
                 else:
                     # an unpin nobody holds: swallowing it silently
                     # would let the NEXT unpin steal a live holder's
@@ -1420,8 +1494,10 @@ class PagedKvPool:
                 b0 = int(blocks[0])
                 rows = self._store[b0:b0 + len(blocks)].reshape(
                     -1, o.bytes_per_token)[:s.seq_len]
-                rows.flags.writeable = False   # read-only for the
-                s.pinned += 1                  # caller, arena intact
+                rows.flags.writeable = False   # read-only: arena intact
+                # fablint: custody-moved(caller) the view pin is owed back through the caller's unpin before any release — the documented view=True contract
+                s.pinned += 1
+                _ledger.acquire("kv.pin", (id(self), session))
                 return rows, s.seq_len, s.last_token, True
             rows = self._store[blocks].reshape(
                 -1, o.bytes_per_token)[:s.seq_len].copy()
@@ -1498,6 +1574,10 @@ class PagedKvPool:
             self._restoring.clear()
             self._host_free = list(
                 range(self.options.host_blocks - 1, -1, -1))
+        # custody ends with the pool: the free-list rebuild reclaimed
+        # every block, outstanding pins die with the tables
+        _ledger.drop_prefix("kv.pin", id(self))
+        _ledger.drop_prefix("kv.reserve", id(self))
         if timer is not None:
             from ..bthread.timer_thread import TimerThread
             TimerThread.instance().unschedule(timer)

@@ -94,6 +94,13 @@ class _MigrationStats:
 
 stats = _MigrationStats()
 
+# fablint custody contract (ISSUE 20): the source pin taken by
+# migrate_out must drop on EVERY exit — abort, deadline latch, shed,
+# and the cutover success path all funnel through the one finally.
+_CUSTODY = {
+    "pin": ("unpin",),
+}
+
 _health = None
 _health_lock = _dbg.make_lock("migration._health_lock")
 

@@ -338,6 +338,7 @@ class ContinuousBatchScheduler:
         """Pin + roster one admitted request; returns (code, text,
         hint) on refusal, None on success."""
         kv = self.pool.get(req.session)
+        # fablint: custody-moved(decode-roster) the pin rides req into _active; every roster exit (completion, shed, deadline expiry, drain) unpins before dropping the request
         if kv is None or not self.pool.pin(req.session):
             reason = self.pool.evicted_reason(req.session)
             self.rejected << 1

@@ -17,6 +17,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Runtime custody ledger (ISSUE 20): every tier-1 test runs with the
+# declared acquire/release points instrumented, so the census below can
+# name the ACQUIRING file:line of a leaked pin/reservation/handle —
+# not just the test that tripped over it.  Must be set before any
+# brpc_tpu import (the flag is read at define time, like
+# BRPC_TPU_DEBUG_LOCK_ORDER).
+os.environ.setdefault("BRPC_TPU_DEBUG_CUSTODY", "1")
+
 # The env var alone is not enough when a TPU platform plugin (e.g. the axon
 # tunnel) is installed — pin the platform explicitly before any test touches
 # jax.
@@ -98,13 +106,22 @@ def _census():
         atts = np_mod.att_table_live()
     else:
         devrefs = atts = 0
-    return threads, sockets, streams, pins, cntls, devrefs, atts
+    # custody ledger multiset: (resource, key, acquiring site) with a
+    # multiplicity per outstanding hold — the attribution leg.  A leak
+    # that ALSO shows up above gets its acquiring file:line from here.
+    from brpc_tpu.butil import custody_ledger
+    ledger = {}
+    for r in custody_ledger.outstanding():
+        k = (r["resource"], tuple(r["key"]), r["site"])
+        ledger[k] = ledger.get(k, 0) + 1
+    return threads, sockets, streams, pins, cntls, devrefs, atts, ledger
 
 
 def _leaks_vs(base):
-    threads0, sockets0, streams0, pins0, cntls0, devrefs0, atts0 = base
-    threads1, sockets1, streams1, pins1, cntls1, devrefs1, atts1 = \
-        _census()
+    (threads0, sockets0, streams0, pins0, cntls0, devrefs0, atts0,
+     ledger0) = base
+    (threads1, sockets1, streams1, pins1, cntls1, devrefs1, atts1,
+     ledger1) = _census()
     leaks = []
     for t in threads1 - threads0:
         leaks.append(f"non-daemon thread {t.name!r}")
@@ -129,6 +146,13 @@ def _leaks_vs(base):
     if atts1 > atts0:
         leaks.append(f"native att-table entries parked: {atts1} "
                      f"(was {atts0}) — an att handle never exited")
+    for k, n in ledger1.items():
+        extra = n - ledger0.get(k, 0)
+        if extra > 0:
+            resource, key, site = k
+            leaks.append(
+                f"custody ledger: {extra} unreleased {resource!r} "
+                f"hold(s) acquired at {site} (key={list(key)})")
     return leaks
 
 

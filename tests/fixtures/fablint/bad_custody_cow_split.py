@@ -1,0 +1,42 @@
+"""The PR-16 CoW-split refcount leak, re-expressed as a fixture
+(ISSUE 20 acceptance): the split path acquires the new private block's
+refcount under the lock, then runs the block copy OUTSIDE it — correct
+for latency — but the copy can raise (shape mismatch, arena torn down)
+and nothing rolls the freshly-acquired count back.  The real bug
+shipped in PagedKvPool.write_rows and was caught by review, not
+tooling; this shape is what the custody rule now catches at the
+acquiring line."""
+import threading
+
+
+class CowPool:
+    _GUARDED_BY = {"_refs": "_lock", "_free": "_lock"}
+    _CUSTODY = {"_refs": ("_unref_locked",)}
+
+    def __init__(self, arena):
+        self._lock = threading.Lock()
+        self._refs = {}
+        self._free = list(range(8))
+        self._arena = arena
+        self._tables = {}
+
+    # fablint: lock-held(_lock)
+    def _unref_locked(self, b) -> None:
+        n = self._refs.get(b, 1) - 1
+        if n <= 0:
+            self._refs.pop(b, None)
+            self._free.append(b)
+        else:
+            self._refs[b] = n
+
+    def cow_split_leaky(self, session, i):
+        with self._lock:
+            nb = self._free.pop()
+            self._refs[nb] = self._refs.get(nb, 0) + 1   # line 35
+        self._copy_block(nb, session, i)   # can raise -> nb's ref leaks
+        with self._lock:
+            self._tables[session][i] = nb
+        return nb
+
+    def _copy_block(self, nb, session, i) -> None:
+        self._arena[nb][:] = self._arena[self._tables[session][i]]

@@ -1,0 +1,43 @@
+"""Seeded violation for the custody rule (ISSUE 20): a pin taken and
+released on the straight-line path, but the work BETWEEN them can raise
+— the exception edge leaks the pin.  This is the general shape behind
+every "leaked under fault injection, fine in the happy path" custody
+bug; the fix is a try whose broad handler or finally releases."""
+import threading
+
+
+class SessionPinPool:
+    _GUARDED_BY = {"_pins": "_lock"}
+    _CUSTODY = {"pin": ("unpin",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pins = {}
+
+    def pin(self, session) -> bool:
+        with self._lock:
+            self._pins[session] = self._pins.get(session, 0) + 1
+        return True
+
+    def unpin(self, session) -> None:
+        with self._lock:
+            n = self._pins.get(session, 0) - 1
+            if n <= 0:
+                self._pins.pop(session, None)
+            else:
+                self._pins[session] = n
+
+
+def snapshot_pinned(pool: SessionPinPool, session, reader):
+    pool.pin(session)            # line 32: the exception edge leaks this
+    rows = reader(session)       # reader can raise -> no unpin runs
+    pool.unpin(session)
+    return rows
+
+
+def snapshot_pinned_fixed(pool: SessionPinPool, session, reader):
+    pool.pin(session)
+    try:
+        return reader(session)
+    finally:
+        pool.unpin(session)

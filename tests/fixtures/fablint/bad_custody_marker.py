@@ -1,0 +1,29 @@
+"""Seeded violation for the suppression-hygiene rule (ISSUE 20): a
+``custody-moved`` transfer marker WITHOUT a reason.  The marker mutes
+the path-sensitive custody analysis for that acquisition, so a bare one
+is an unexplained mute — exactly what bad-suppression exists to
+reject (same doctrine as reason-less ``fablint: ignore``)."""
+import threading
+
+
+class SessionPinPool:
+    _GUARDED_BY = {"_pins": "_lock"}
+    _CUSTODY = {"pin": ("unpin",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pins = {}
+
+    def pin(self, session) -> bool:
+        with self._lock:
+            self._pins[session] = self._pins.get(session, 0) + 1
+        return True
+
+    def unpin(self, session) -> None:
+        with self._lock:
+            self._pins.pop(session, None)
+
+
+def roster_add(pool: SessionPinPool, session, roster):
+    pool.pin(session)  # fablint: custody-moved(roster)
+    roster.append(session)

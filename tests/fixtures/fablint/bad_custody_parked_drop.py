@@ -1,0 +1,31 @@
+"""The PR-6 parked-transfer drop, re-expressed as a fixture (ISSUE 20
+acceptance): a transfer is tracked into the drain table, then a refusal
+branch returns WITHOUT untracking and without a transfer marker — the
+parked entry (and the HBM pin it represents) leaks until process exit.
+The real bug dropped a parked native transfer on the admission-refusal
+path; this is the lexical shape the custody rule pins."""
+import threading
+
+
+class TransferPlane:
+    _GUARDED_BY = {"_active": "_lock"}
+    _CUSTODY = {"_track": ("_untrack",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = set()
+
+    def _track(self, t) -> None:
+        with self._lock:
+            self._active.add(t)
+
+    def _untrack(self, t) -> None:
+        with self._lock:
+            self._active.discard(t)
+
+    def post(self, t, admitted: bool):
+        self._track(t)           # line 27: the refusal branch drops it
+        if not admitted:
+            return None          # parked transfer leaks here
+        self._untrack(t)
+        return t

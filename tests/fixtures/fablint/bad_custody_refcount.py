@@ -1,0 +1,30 @@
+"""Seeded violation for refcount-balance (ISSUE 20): a declared
+refcount field mutated OUTSIDE its _GUARDED_BY lock.  Two writers
+interleaving the read-modify-write lose a count — the block frees while
+an owner still points at it (use-after-free) or never frees (leak)."""
+import threading
+
+
+class RefBlocks:
+    _GUARDED_BY = {"_refs": "_lock"}
+    _CUSTODY = {"_refs": ("_free_block",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._refs = {}
+        self._free = []
+
+    def _free_block(self, b) -> None:
+        with self._lock:
+            self._refs.pop(b, None)
+            self._free.append(b)
+
+    def share_unguarded(self, b):
+        self._refs[b] += 1       # line 23: += 1 outside 'with _lock:'
+        self._free_block(b)
+
+    def share_guarded(self, b):
+        with self._lock:
+            # fablint: custody-moved(share-table) the co-owner recorded below owes the balancing decrement through _free_block
+            self._refs[b] += 1
+            self._refs[b] = self._refs[b]

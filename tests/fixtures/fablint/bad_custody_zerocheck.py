@@ -1,0 +1,30 @@
+"""Seeded violation for refcount-balance (ISSUE 20): a guarded
+decrement with NO dominating zero-check that frees.  A count that
+reaches zero silently strands the block — nothing ever returns it to
+the free list (the PR-16 CoW-split leak was exactly a decrement path
+that forgot its zero-check free)."""
+import threading
+
+
+class RefBlocks:
+    _GUARDED_BY = {"_refs": "_lock"}
+    _CUSTODY = {"_refs": ("_free_block",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._refs = {}
+        self._free = []
+
+    def _free_block(self, b) -> None:
+        self._refs.pop(b, None)
+        self._free.append(b)
+
+    def unshare_stranding(self, b):
+        with self._lock:
+            self._refs[b] -= 1   # line 24: zero is never checked/freed
+
+    def unshare_checked(self, b):
+        with self._lock:
+            self._refs[b] -= 1
+            if self._refs[b] <= 0:
+                self._free_block(b)

@@ -1,0 +1,112 @@
+"""Clean custody module (ISSUE 20): every idiom the custody +
+refcount-balance rules must ACCEPT — the reasoned transfer marker, the
+owning-return, try/finally and broad-handler release, the `> 1` guard
+and the `if r <= 0: free()` zero-check.  tests/test_fablint.py asserts
+zero findings here."""
+import threading
+
+
+class PinRegistry:
+    _GUARDED_BY = {"_pins": "_lock", "_refs": "_lock"}
+    _CUSTODY = {
+        "pin": ("unpin",),
+        "put": ("take", "release_key"),
+        "_refs": ("_free_block",),
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pins = {}
+        self._refs = {}
+        self._free = []
+        self._m = {}
+        self._next = 0
+
+    def pin(self, session) -> bool:
+        with self._lock:
+            self._pins[session] = self._pins.get(session, 0) + 1
+        return True
+
+    def unpin(self, session) -> None:
+        with self._lock:
+            self._pins.pop(session, None)
+
+    def put(self, arr) -> int:
+        self._next += 1
+        self._m[self._next] = arr
+        return self._next
+
+    def take(self, key: int):
+        return self._m.pop(key, None)
+
+    def release_key(self, key: int) -> None:
+        self._m.pop(key, None)
+
+    def _free_block(self, b) -> None:
+        with self._lock:
+            self._refs.pop(b, None)
+            self._free.append(b)
+
+    # ---- refcount shapes the rule accepts ------------------------------
+    def share(self, b):
+        with self._lock:
+            # fablint: custody-moved(share-table) the recorded co-owner owes the balancing _free_block on its release path
+            self._refs[b] = self._refs.get(b, 0) + 1
+
+    def unshare(self, b):
+        with self._lock:
+            r = self._refs.get(b, 1) - 1
+            if r <= 0:
+                self._free_block(b)
+            else:
+                self._refs[b] = r
+
+    def unshare_guarded(self, b):
+        with self._lock:
+            if self._refs.get(b, 1) > 1:
+                self._refs[b] -= 1
+
+
+def with_finally(reg: PinRegistry, session, reader):
+    """try/finally release: the canonical exception-safe hold."""
+    reg.pin(session)
+    try:
+        return reader(session)
+    finally:
+        reg.unpin(session)
+
+
+def with_handler(reg: PinRegistry, session, reader):
+    """Broad-handler release on the exception edge, release on the
+    fall-through — both exits covered."""
+    reg.pin(session)
+    try:
+        rows = reader(session)
+    except Exception:
+        reg.unpin(session)
+        raise
+    reg.unpin(session)
+    return rows
+
+
+def owning_return(reg: PinRegistry, arr):
+    """The acquired key IS the return value: custody moves to the
+    caller with the object."""
+    key = reg.put(arr)
+    return key
+
+
+def transfer_marker(reg: PinRegistry, session, roster):
+    """Reasoned custody-moved marker: the roster owns the pin now."""
+    reg.pin(session)  # fablint: custody-moved(roster) every roster exit unpins before dropping the entry
+    roster.append(session)
+
+
+def conditional_hold(reg: PinRegistry, session, reader):
+    """The refused branch holds nothing; the held branch releases."""
+    if not reg.pin(session):
+        return None
+    try:
+        return reader(session)
+    finally:
+        reg.unpin(session)

@@ -66,6 +66,13 @@ def _run_pair(script: str, timeout: int = 240, expect_rc=(0, 0)):
         report = os.path.join(tmpdir, f"debug_sync_{i}.json")
         env["BRPC_TPU_DEBUG_SYNC_REPORT"] = report
         report_paths.append(report)
+        # custody ledger leg (ISSUE 20): each child records declared
+        # acquire/release points; the parent asserts zero outstanding
+        # holds (and zero unmatched strict releases) at clean exit, so
+        # a pin/handle leaked UNDER CHAOS names its acquiring file:line
+        env["BRPC_TPU_DEBUG_CUSTODY"] = "1"
+        env["BRPC_TPU_CUSTODY_REPORT"] = os.path.join(
+            tmpdir, f"custody_{i}.json")
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script, str(i), coord],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -95,6 +102,18 @@ def _run_pair(script: str, timeout: int = 240, expect_rc=(0, 0)):
         assert not rep["long_holds"], (
             f"child {i}: long lock holds under chaos:\n"
             + json.dumps(rep["long_holds"], indent=2))
+        cpath = os.path.join(tmpdir, f"custody_{i}.json")
+        assert os.path.exists(cpath), (
+            f"child {i} exited 0 but wrote no custody ledger report")
+        with open(cpath) as f:
+            crep = json.load(f)
+        assert not crep["outstanding"], (
+            f"child {i}: custody holds leaked under chaos "
+            f"(acquiring site named per hold):\n"
+            + json.dumps(crep["outstanding"], indent=2))
+        assert not crep["unmatched_releases"], (
+            f"child {i}: unmatched strict releases under chaos:\n"
+            + json.dumps(crep["unmatched_releases"], indent=2))
     return outs
 
 
@@ -1144,7 +1163,9 @@ else:
     # barrier (it would wait on the killed process) — but still hand
     # the parent the debug_sync graph it asserts on
     from brpc_tpu.butil import debug_sync as _dbg
+    from brpc_tpu.butil import custody_ledger as _cl
     _dbg.dump_report_now()
+    _cl.dump_report_now()
     sys.stdout.flush()
     os._exit(0)
 """

@@ -1,0 +1,148 @@
+"""Runtime custody ledger (ISSUE 20, butil/custody_ledger.py).
+
+The static custody pass proves the lexical shape; this ledger is the
+runtime complement — every declared acquire/release point records a
+stack-tagged entry, so a leak names the ACQUIRING file:line.  Tier-1
+runs entirely under ``BRPC_TPU_DEBUG_CUSTODY=1`` (conftest), so these
+tests drive the same instrumentation the census asserts on.
+"""
+import inspect
+
+import numpy as np
+import pytest
+
+from brpc_tpu.butil import custody_ledger
+
+from test_serving import _mk_pool, _rows
+
+
+def _acquire_here(resource, key):
+    # one helper frame so the default depth lands on OUR caller line,
+    # mirroring the instrumented-method shape (pool.pin -> acquire)
+    custody_ledger.acquire(resource, key)
+
+
+def _release_strict_here(resource, key):
+    custody_ledger.release(resource, key, strict=True)
+
+
+class TestLedgerCore:
+    def test_enabled_under_tier1(self):
+        # conftest exports BRPC_TPU_DEBUG_CUSTODY=1 before any import
+        assert custody_ledger.enabled()
+
+    def test_acquires_nest_and_release_drops_one(self):
+        key = ("nest-test",)
+        def mine():
+            return [r for r in custody_ledger.outstanding()
+                    if r["resource"] == "t.nest"]
+        assert mine() == []
+        _acquire_here("t.nest", key)
+        _acquire_here("t.nest", key)
+        assert len(mine()) == 2
+        custody_ledger.release("t.nest", key)
+        assert len(mine()) == 1
+        custody_ledger.release("t.nest", key)
+        assert mine() == []
+
+    def test_nonstrict_release_of_unknown_key_is_ignored(self):
+        rep0 = custody_ledger.report()
+        custody_ledger.release("t.unknown", ("nobody",))
+        rep = custody_ledger.report()
+        assert len(rep["unmatched_releases"]) == \
+            len(rep0["unmatched_releases"])
+
+    def test_strict_unmatched_release_recorded_with_site(self):
+        n0 = len(custody_ledger.report()["unmatched_releases"])
+        line = inspect.currentframe().f_lineno + 1
+        _release_strict_here("t.strict", ("nobody",))
+        um = custody_ledger.report()["unmatched_releases"]
+        assert len(um) == n0 + 1
+        assert um[-1]["resource"] == "t.strict"
+        assert um[-1]["site"] == f"test_custody_ledger.py:{line}"
+
+    def test_drop_prefix_forgets_one_owner_scope(self):
+        _acquire_here("t.pfx", (1, "a"))
+        _acquire_here("t.pfx", (1, "b"))
+        _acquire_here("t.pfx", (2, "c"))
+        assert custody_ledger.drop_prefix("t.pfx", 1) == 2
+        left = [r for r in custody_ledger.outstanding()
+                if r["resource"] == "t.pfx"]
+        assert [r["key"] for r in left] == [[2, "c"]]
+        custody_ledger.release("t.pfx", (2, "c"))
+
+    def test_disabled_hooks_are_noops(self, monkeypatch):
+        monkeypatch.setattr(custody_ledger, "enabled", lambda: False)
+        custody_ledger.acquire("t.off", ("x",))
+        custody_ledger.release("t.off", ("x",))
+        assert custody_ledger.drop_prefix("t.off", "x") == 0
+        monkeypatch.undo()
+        assert all(r["resource"] != "t.off"
+                   for r in custody_ledger.outstanding())
+
+
+class TestLeakAttribution:
+    """The ISSUE-20 acceptance criterion: a deliberately-injected leak
+    is attributed to its acquiring file:line, through the REAL pool."""
+
+    def test_deliberate_pin_leak_names_this_files_line(self):
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        try:
+            toks = [3] * 16
+            pool.load("s1", _rows(toks), last_token=3)
+
+            def pins():
+                return [r for r in custody_ledger.outstanding()
+                        if r["resource"] == "kv.pin"
+                        and r["key"][1] == "s1"]
+
+            assert pins() == []
+            # the deliberate leak: pin and walk away
+            leak_line = inspect.currentframe().f_lineno + 1
+            assert pool.pin("s1")
+            out = pins()
+            assert len(out) == 1
+            assert out[0]["site"] == \
+                f"test_custody_ledger.py:{leak_line}"
+            # the report carries the same attribution the chaos
+            # parent asserts on
+            rep = custody_ledger.report()
+            assert not rep["ok"]
+            # balance it so the census (and this very ledger) stay
+            # clean — the leak above was the injected one
+            pool.unpin("s1")
+            assert pins() == []
+        finally:
+            pool.close()
+
+    def test_pool_close_ends_custody_of_everything_it_owned(self):
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        pool.load("s1", _rows([3] * 16), last_token=3)
+        assert pool.pin("s1")      # deliberately leaked across close
+        pool.close()
+        assert all(r["key"][0] != id(pool)
+                   for r in custody_ledger.outstanding()
+                   if r["resource"] in ("kv.pin", "kv.reserve"))
+
+
+class TestEchoBenchRegression:
+    def test_device_index_failure_leaks_no_registry_key(self,
+                                                        monkeypatch):
+        """Sweep true positive (native_plane echo bench): _device_index
+        raising between put() and the try/finally leaked the registry
+        key pre-fix; the descriptor is now computed before put."""
+        from brpc_tpu.ici import native_plane as npl
+        if npl.native.load() is None or not npl.ensure_hooks():
+            pytest.skip("native ici lib unavailable")
+        import jax.numpy as jnp
+        arr = jnp.zeros((16,), dtype=jnp.uint8)
+        base = npl.registry().live()
+
+        def boom(a):
+            raise RuntimeError("stale mesh generation")
+
+        monkeypatch.setattr(npl, "_device_index", boom)
+        with pytest.raises(RuntimeError):
+            npl.native_ici_echo_p50_us(iters=1, payload=8,
+                                       device_array=arr)
+        assert npl.registry().live() == base

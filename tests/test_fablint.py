@@ -190,6 +190,87 @@ class TestFixtureViolations:
         assert out == [], [str(f) for f in out]
 
 
+class TestCustodyFixtures:
+    """The ISSUE-20 custody family: path-sensitive acquire/release plus
+    refcount balance, each seeded violation pinned at exact file:line."""
+
+    def test_exception_edge_leak_reported_with_line(self):
+        out = _findings("bad_custody_exc.py", fablint.CUSTODY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("custody", 32)]
+        assert "'pin'" in out[0].message and "raise" in out[0].message
+        assert out[0].path.endswith("bad_custody_exc.py")
+
+    def test_unguarded_refcount_increment_reported_with_line(self):
+        out = _findings("bad_custody_refcount.py", fablint.CUSTODY_RULES)
+        assert [(f.rule, f.line) for f in out] == \
+            [("refcount-balance", 23)]
+        assert "_refs" in out[0].message and "_lock" in out[0].message
+
+    def test_decrement_without_zero_check_reported_with_line(self):
+        out = _findings("bad_custody_zerocheck.py",
+                        fablint.CUSTODY_RULES)
+        assert [(f.rule, f.line) for f in out] == \
+            [("refcount-balance", 24)]
+        assert "zero-check" in out[0].message
+        assert "strands" in out[0].message
+
+    def test_reasonless_custody_moved_marker_is_a_finding(self):
+        out = _findings("bad_custody_marker.py",
+                        fablint.CUSTODY_RULES + ("bad-suppression",))
+        assert [(f.rule, f.line) for f in out] == \
+            [("bad-suppression", 28)]
+        assert "custody-moved" in out[0].message
+
+    def test_pr16_cow_split_shape_reported_with_line(self):
+        # the PR-16 CoW-split refcount leak, re-expressed: the freshly
+        # acquired private-block ref leaks on the copy's exception edge
+        out = _findings("bad_custody_cow_split.py",
+                        fablint.CUSTODY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("custody", 35)]
+        assert "'_refs'" in out[0].message
+
+    def test_pr6_parked_transfer_drop_reported_with_line(self):
+        # the PR-6 parked-transfer drop, re-expressed: the refusal
+        # branch returns without untracking and without a marker
+        out = _findings("bad_custody_parked_drop.py",
+                        fablint.CUSTODY_RULES)
+        assert [(f.rule, f.line) for f in out] == [("custody", 27)]
+        assert "'_track'" in out[0].message
+        assert "returns without releasing" in out[0].message
+
+    def test_clean_custody_fixture_is_silent(self):
+        # the accepted idioms: reasoned transfer marker, owning-return,
+        # try/finally + broad-handler release, `> 1` guard, zero-check
+        out = _findings("clean_custody.py",
+                        fablint.ALL_RULES + ("bad-suppression",))
+        assert out == [], [str(f) for f in out]
+
+    def test_large_copy_under_lock_reported(self, tmp_path):
+        # satellite: blocking-under-lock knows block-sized copy calls
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "import threading\nimport numpy as np\n"
+            "_lock = threading.Lock()\n"
+            "def f(a, b):\n"
+            "    with _lock:\n"
+            "        return a.tobytes() and np.array_equal(a, b)\n")
+        out = fablint.run([str(mod)], fablint.CONCURRENCY_RULES)
+        assert {(f.rule, f.line) for f in out} == \
+            {("blocking-under-lock", 6)}
+        msgs = " | ".join(f.message for f in out)
+        assert "large copy" in msgs
+
+    def test_custody_maps_on_all_six_modules(self):
+        # the ISSUE-20 annotation contract: every custody-carrying
+        # module declares its acquire/release protocol
+        six = ["serving/kv_pool.py", "ici/device_plane.py",
+               "ici/native_plane.py", "rpc/controller.py",
+               "rpc/stream.py", "serving/migration.py"]
+        for rel in six:
+            src = open(os.path.join(PKG, rel)).read()
+            assert "_CUSTODY" in src, f"{rel} lost its custody map"
+
+
 class TestAnalyzerMechanics:
     def test_reasonless_suppression_is_a_finding(self, tmp_path):
         mod = tmp_path / "m.py"
@@ -254,6 +335,52 @@ class TestZeroFindingsGate:
     def test_package_deadcode_clean(self):
         out = fablint.run([PKG], fablint.DEADCODE_RULES)
         assert out == [], "\n".join(str(f) for f in out)
+
+    def test_package_custody_clean(self):
+        out = fablint.run([PKG],
+                          fablint.CUSTODY_RULES + ("bad-suppression",))
+        assert out == [], "\n".join(str(f) for f in out)
+
+    def test_cli_custody_subcommand_exits_zero(self):
+        res = subprocess.run(
+            [sys.executable, "-m", "brpc_tpu.tools.fablint", "custody",
+             "--json", PKG],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert json.loads(res.stdout) == []
+
+    def test_cli_all_subcommand_exits_zero(self):
+        res = subprocess.run(
+            [sys.executable, "-m", "brpc_tpu.tools.fablint", "all", PKG],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert res.returncode == 0, res.stdout + res.stderr
+
+    def test_cli_rules_selection_bisects(self):
+        # --rules narrows the family: only refcount-balance findings
+        # from a fixture that trips both custody rules
+        res = subprocess.run(
+            [sys.executable, "-m", "brpc_tpu.tools.fablint", "custody",
+             "--rules", "refcount-balance", "--json",
+             os.path.join(FIXTURES, "bad_custody_cow_split.py")],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert json.loads(res.stdout) == []
+        res = subprocess.run(
+            [sys.executable, "-m", "brpc_tpu.tools.fablint", "custody",
+             "--rules=custody", "--json",
+             os.path.join(FIXTURES, "bad_custody_cow_split.py")],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert res.returncode == 1, res.stdout + res.stderr
+        data = json.loads(res.stdout)
+        assert [d["rule"] for d in data] == ["custody"]
+
+    def test_cli_rules_unknown_name_exits_two(self):
+        res = subprocess.run(
+            [sys.executable, "-m", "brpc_tpu.tools.fablint",
+             "--rules", "no-such-rule", PKG],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert res.returncode == 2
+        assert "unknown rule" in res.stderr
 
     def test_cli_exits_zero_and_emits_json(self):
         res = subprocess.run(

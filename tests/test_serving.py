@@ -1627,6 +1627,94 @@ class TestKvTiers:
 
 
 # ---------------------------------------------------------------------------
+# Custody-sweep regressions (ISSUE 20): the true positives the static
+# custody pass found, each drivable — these fail on the pre-fix shape.
+# ---------------------------------------------------------------------------
+
+class TestCustodyRegressions:
+    def _assert_no_reserve_outstanding(self, pool):
+        from brpc_tpu.butil import custody_ledger
+        held = [r for r in custody_ledger.outstanding()
+                if r["resource"] == "kv.reserve"
+                and r["key"][0] == id(pool)]
+        assert held == [], held
+
+    @pytest.mark.parametrize("concurrent", [True, False])
+    def test_session_construction_failure_aborts_reservation(
+            self, monkeypatch, concurrent):
+        """Sweep true positive (load_into): _extent_views and the
+        _KvSession construction sit between reserve and commit — a
+        raise there leaked the reservation pre-fix (blocks off the
+        free list forever).  Both fill disciplines now route every
+        edge through the abort."""
+        from brpc_tpu.butil import flags as _fl
+        from brpc_tpu.serving import kv_pool as kp
+        pool = _mk_pool(num_blocks=4, block_tokens=8)
+        try:
+            _fl.set_flag("serving_kv_concurrent_fill", concurrent)
+            free0 = len(pool._free)
+            aborts0 = pool.fill_aborts.get_value()
+
+            real = kp._KvSession
+
+            def boom(*a, **kw):
+                raise MemoryError("allocator pressure mid-load")
+
+            monkeypatch.setattr(kp, "_KvSession", boom)
+            with pytest.raises(MemoryError):
+                pool.load("s1", _rows([3] * 16), last_token=3)
+            monkeypatch.setattr(kp, "_KvSession", real)
+            # the reservation aborted clean: free list restored, abort
+            # counted, no ledger hold, and the pool still loads at
+            # full capacity
+            assert len(pool._free) == free0
+            assert pool.fill_aborts.get_value() == aborts0 + 1
+            self._assert_no_reserve_outstanding(pool)
+            assert pool.get("s1") is None
+            toks = [(3 * j) % 499 for j in range(16)]
+            pool.load("s1", _rows(toks), last_token=toks[-1])
+            assert np.array_equal(pool.materialize("s1"), _rows(toks))
+        finally:
+            _fl.set_flag("serving_kv_concurrent_fill", True)
+            pool.close()
+
+    def test_restore_copy_failure_releases_reservation_and_host_refs(
+            self, monkeypatch):
+        """Sweep true positive (_restore): the outside-the-lock
+        host→device copy can raise (allocator pressure); pre-fix that
+        leaked the device reservation AND the restore's host refs.
+        Every outcome now resolves through _finish_restore_locked —
+        the exception propagates, the session stays spilled, and the
+        host copy restores byte-exact afterwards."""
+        from brpc_tpu.serving import kv_pool as kp
+        pool = _mk_pool(num_blocks=4, block_tokens=8, host_blocks=4)
+        try:
+            toks = [(3 * j) % 499 for j in range(16)]
+            pool.load("a", _rows(toks), last_token=toks[-1])
+            assert pool.spill("a")
+            free0 = len(pool._free)
+
+            real = kp.zlib.crc32
+
+            def boom(data, chain=0):
+                raise MemoryError("copy failed mid-restore")
+
+            monkeypatch.setattr(kp.zlib, "crc32", boom)
+            with pytest.raises(MemoryError):
+                pool.get("a")
+            monkeypatch.setattr(kp.zlib, "crc32", real)
+            # reservation returned, host record + refs intact, no
+            # ledger hold; the next lookup restores byte-exact
+            assert len(pool._free) == free0
+            assert pool.spilled_sessions() == ["a"]
+            self._assert_no_reserve_outstanding(pool)
+            assert np.array_equal(pool.materialize("a"), _rows(toks))
+            assert pool.describe()["tiers"]["restores"] == 1
+        finally:
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
 # Live cross-worker migration (ISSUE 19).
 # ---------------------------------------------------------------------------
 
